@@ -1,0 +1,22 @@
+"""bayesian_consensus_engine_tpu — TPU-native reliability-weighted consensus.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+consensus-nexus/bayesian-consensus-engine: reliability-weighted consensus over
+probability signals for prediction markets, with persistent per-(source,
+market) reliability that updates after outcomes and decays over time.
+
+Layers (bottom → top), mirroring the reference's layer map but TPU-first:
+
+  utils/     constants (public contract) + id interning + time conversion
+  ops/       pure JAX kernels: batched consensus, decay, update, tiebreak,
+             Pallas fused fast path
+  core/      validation + scalar bit-exact engine + batched array engine
+  state/     reliability stores: SQLite (durable/compat), device-tensor (HBM)
+  models/    market orchestration, cross-market aggregation, tie-breaking
+  parallel/  device mesh + shard_map sharded consensus/update step
+  cli        command-line surface (byte-compatible with the reference CLI)
+
+The scalar path imports no JAX; array paths import it lazily.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+"""Core consensus layer: validation + scalar engine + batched array engine."""
+
+from bayesian_consensus_engine_tpu.utils.config import SCHEMA_VERSION
+from bayesian_consensus_engine_tpu.core.validate import (
+    ValidationError,
+    validate_input_payload,
+)
+from bayesian_consensus_engine_tpu.core.engine import compute_consensus
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "ValidationError",
+    "validate_input_payload",
+    "compute_consensus",
+]

@@ -1,0 +1,142 @@
+"""Reliability-weighted consensus — scalar reference-semantics path.
+
+Observable behaviour matches the reference kernel
+(reference: src/bayesian_engine/core.py:63-180) exactly, including float
+summation order, so the golden fixture
+(reference: tests/fixtures/golden_regression.json — consensus
+0.6966666666666667) reproduces byte-for-byte:
+
+  * duplicate signals from one source are averaged in signal order
+  * sources are processed in sorted-id order
+  * consensus = Σ(p̄·w)/Σw and confidence = Σ(c·w)/Σw accumulate
+    left-to-right over the sorted sources
+
+Unlike the reference's O(unique_sources × signals) re-scan loop
+(core.py:108-128), this is a single pass over the signals plus one pass over
+the sorted unique sources — same floats, linear time.  The batched TPU path
+lives in :mod:`..ops` / :mod:`.batch` and is selected with ``backend=`` —
+``"python"`` stays the default so the CLI/golden contract is bit-exact by
+construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+from bayesian_consensus_engine_tpu.utils.config import (
+    DEFAULT_CONFIDENCE,
+    DEFAULT_RELIABILITY,
+    SCHEMA_VERSION,
+)
+
+# Re-exported for API parity with the reference module surface
+# (reference: core.py:7-15 exposes SCHEMA_VERSION and ValidationError).
+from bayesian_consensus_engine_tpu.core.validate import (  # noqa: F401
+    ValidationError,
+    validate_input_payload,
+)
+
+def compute_consensus(
+    signals: Sequence[Mapping[str, Any]],
+    source_reliability: Mapping[str, Mapping[str, float]] | None = None,
+    backend: str = "python",
+) -> dict[str, Any]:
+    """Weighted-average consensus over per-source probability signals.
+
+    Args:
+        signals: sequence of ``{"sourceId": str, "probability": float}``.
+        source_reliability: optional per-source ``{"reliability", "confidence"}``
+            mapping; absent sources use cold-start defaults and are listed in
+            ``diagnostics.coldStartSources``.
+        backend: ``"python"`` (default, bit-exact scalar path) or
+            ``"jax"``/``"tpu"`` (batched array path, see ``core.batch``).
+
+    Returns the full v1.0.0 output document (consensus, confidence,
+    sourceWeights, normalization, diagnostics).
+    """
+    if backend not in ("python", "jax", "tpu"):
+        raise ValueError(f"unknown backend: {backend!r}")
+    if backend != "python" and signals:
+        from bayesian_consensus_engine_tpu.core.batch import compute_consensus_jax
+
+        return compute_consensus_jax(signals, source_reliability)
+
+    if not signals:
+        # Fresh document per call — callers mutate results (CLI dryRun stamp).
+        return {
+            "schemaVersion": SCHEMA_VERSION,
+            "consensus": None,
+            "confidence": 0.0,
+            "sourceWeights": [],
+            "normalization": {"totalWeight": 0.0, "sourceCount": 0},
+            "diagnostics": {"status": "no_signals", "sources": 0},
+        }
+
+    reliability_map: Mapping[str, Mapping[str, float]] = source_reliability or {}
+
+    # Single pass: bucket probabilities per source in signal order (preserves
+    # the reference's duplicate-averaging float order, core.py:115-116).
+    by_source: dict[str, list[float]] = {}
+    for signal in signals:
+        by_source.setdefault(signal["sourceId"], []).append(signal["probability"])
+
+    ordered_ids = sorted(by_source)
+
+    # Float-semantics note: builtin sum() (Neumaier-compensated for floats on
+    # CPython ≥3.12) is used exactly where the reference uses it — per-source
+    # means (core.py:116) and the two weighted reductions (core.py:135-144) —
+    # while totalWeight accumulates naively like the reference's `+=` loop
+    # (core.py:120). Mixing these up costs 1 ulp on random inputs.
+    per_source: list[tuple[str, float, float, float]] = []
+    total_weight = 0.0
+    for sid in ordered_ids:
+        entry = reliability_map.get(sid, {})
+        reliability = entry.get("reliability", DEFAULT_RELIABILITY)
+        confidence = entry.get("confidence", DEFAULT_CONFIDENCE)
+        probs = by_source[sid]
+        mean_prob = sum(probs) / len(probs)
+        total_weight += reliability
+        per_source.append((sid, mean_prob, reliability, confidence))
+
+    if total_weight == 0:
+        consensus: float | None = None
+        overall_confidence = 0.0
+    else:
+        weighted_prob = sum(
+            mean_prob * weight for _sid, mean_prob, weight, _conf in per_source
+        )
+        weighted_conf = sum(
+            confidence * weight for _sid, _mean_prob, weight, confidence in per_source
+        )
+        consensus = weighted_prob / total_weight
+        overall_confidence = weighted_conf / total_weight
+
+    source_weights = [
+        {
+            "sourceId": sid,
+            "weight": weight,
+            "normalizedWeight": weight / total_weight if total_weight > 0 else 0.0,
+        }
+        for sid, _mean_prob, weight, _confidence in per_source
+    ]
+
+    return {
+        "schemaVersion": SCHEMA_VERSION,
+        "consensus": consensus,
+        "confidence": overall_confidence,
+        "sourceWeights": source_weights,
+        "normalization": {
+            "totalWeight": total_weight,
+            "sourceCount": len(ordered_ids),
+        },
+        "diagnostics": {
+            "status": "computed",
+            "sources": len(signals),
+            "uniqueSources": len(ordered_ids),
+            "coldStartSources": [
+                sid
+                for sid, _mean_prob, _weight, _confidence in per_source
+                if sid not in reliability_map
+            ],
+        },
+    }

@@ -1,0 +1,21 @@
+"""Test harness configuration.
+
+Force JAX onto the host CPU with 8 virtual devices BEFORE jax is imported
+anywhere, so mesh/sharding tests exercise real multi-device code paths
+without TPU hardware — the TPU analogue of the reference's use of SQLite
+":memory:" for hermetic store tests (reference: tests/test_reliability.py:24-29).
+"""
+
+import os
+import sys
+import pathlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Make the repo root importable when tests run without an installed package.
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))

@@ -1,0 +1,80 @@
+"""Constants are a public contract — every literal is pinned.
+
+Mirrors the reference's config test strategy (reference: tests/test_config.py:
+20-103): a change to any value is a behavioural change and must fail loudly.
+"""
+
+from bayesian_consensus_engine_tpu.utils import config
+
+
+class TestColdStartDefaults:
+    def test_default_reliability_is_50_percent(self):
+        assert config.DEFAULT_RELIABILITY == 0.50
+
+    def test_default_confidence_is_25_percent(self):
+        # The reference's docs claim 0.50 in places; the code path uses 0.25
+        # (reference: config.py:18, test_config.py:24-26). Code wins.
+        assert config.DEFAULT_CONFIDENCE == 0.25
+
+    def test_defaults_are_valid_probabilities(self):
+        assert 0.0 <= config.DEFAULT_RELIABILITY <= 1.0
+        assert 0.0 <= config.DEFAULT_CONFIDENCE <= 1.0
+
+
+class TestUpdateConstraints:
+    def test_max_update_step_is_10_percent(self):
+        assert config.MAX_UPDATE_STEP == 0.10
+
+    def test_base_learning_rate_is_15_percent(self):
+        # Reference hides this in reliability.py:34; we centralise it here.
+        assert config.BASE_LEARNING_RATE == 0.15
+
+    def test_confidence_growth_rate_is_10_percent(self):
+        assert config.CONFIDENCE_GROWTH_RATE == 0.10
+
+    def test_raw_step_exceeds_cap_so_cap_binds(self):
+        assert config.BASE_LEARNING_RATE > config.MAX_UPDATE_STEP
+
+
+class TestTieBreaking:
+    def test_tie_tolerance(self):
+        assert config.TIE_TOLERANCE == 1e-9
+        assert config.TIE_TOLERANCE > 0
+
+
+class TestDecay:
+    def test_half_life_is_30_days(self):
+        assert config.DECAY_HALF_LIFE_DAYS == 30
+
+    def test_floor_is_10_percent(self):
+        assert config.DECAY_MINIMUM == 0.10
+
+    def test_floor_below_cold_start(self):
+        assert config.DECAY_MINIMUM < config.DEFAULT_RELIABILITY
+
+
+class TestSchema:
+    def test_schema_version(self):
+        assert config.SCHEMA_VERSION == "1.0.0"
+        assert isinstance(config.SCHEMA_VERSION, str)
+
+
+class TestValidationLimits:
+    def test_limits(self):
+        assert config.MIN_SOURCE_ID_LENGTH == 1
+        assert config.MAX_SOURCE_ID_LENGTH == 256
+        assert config.MAX_SIGNALS_PER_REQUEST == 1000
+        assert config.MIN_SOURCE_ID_LENGTH < config.MAX_SOURCE_ID_LENGTH
+
+
+class TestParamStructs:
+    def test_update_params_mirror_constants(self):
+        p = config.as_update_params()
+        assert p.base_learning_rate == config.BASE_LEARNING_RATE
+        assert p.max_step == config.MAX_UPDATE_STEP
+        assert p.confidence_growth == config.CONFIDENCE_GROWTH_RATE
+
+    def test_decay_params_mirror_constants(self):
+        p = config.as_decay_params()
+        assert p.half_life_days == config.DECAY_HALF_LIFE_DAYS
+        assert p.floor == config.DECAY_MINIMUM

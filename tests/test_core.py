@@ -1,0 +1,214 @@
+"""Validation contract + scalar consensus engine behaviour.
+
+Covers the reference's core test surface (reference: tests/test_core.py) plus
+additional engine-semantics cases the golden fixtures rely on: duplicate
+averaging, sorted-source determinism, cold-start listing, zero-weight path.
+"""
+
+import pytest
+
+from bayesian_consensus_engine_tpu.core import (
+    SCHEMA_VERSION,
+    ValidationError,
+    compute_consensus,
+    validate_input_payload,
+)
+
+
+def _valid_payload() -> dict:
+    return {
+        "schemaVersion": SCHEMA_VERSION,
+        "marketId": "market-1",
+        "signals": [
+            {"sourceId": "agent-a", "probability": 0.6},
+            {"sourceId": "agent-b", "probability": 0.4},
+        ],
+    }
+
+
+class TestValidation:
+    def test_accepts_valid_payload(self):
+        validate_input_payload(_valid_payload())
+
+    def test_missing_schema_version_message(self):
+        payload = _valid_payload()
+        del payload["schemaVersion"]
+        with pytest.raises(ValidationError) as exc:
+            validate_input_payload(payload)
+        assert str(exc.value) == "schemaVersion is required"
+
+    def test_schema_version_mismatch(self):
+        payload = _valid_payload()
+        payload["schemaVersion"] = "2.0.0"
+        with pytest.raises(ValidationError) as exc:
+            validate_input_payload(payload)
+        assert "schemaVersion must be" in str(exc.value)
+
+    def test_market_id_required_and_non_empty(self):
+        payload = _valid_payload()
+        payload["marketId"] = "   "
+        with pytest.raises(ValidationError, match="marketId must be a non-empty string"):
+            validate_input_payload(payload)
+        del payload["marketId"]
+        with pytest.raises(ValidationError, match="marketId is required"):
+            validate_input_payload(payload)
+
+    def test_signals_must_be_array(self):
+        payload = _valid_payload()
+        payload["signals"] = {"sourceId": "a"}
+        with pytest.raises(ValidationError, match="signals must be an array"):
+            validate_input_payload(payload)
+
+    def test_signal_must_be_object(self):
+        payload = _valid_payload()
+        payload["signals"] = ["not-a-dict"]
+        with pytest.raises(ValidationError, match=r"signals\[0\] must be an object"):
+            validate_input_payload(payload)
+
+    def test_source_id_non_empty(self):
+        payload = _valid_payload()
+        payload["signals"][1]["sourceId"] = ""
+        with pytest.raises(ValidationError, match=r"signals\[1\].sourceId must be a non-empty string"):
+            validate_input_payload(payload)
+
+    def test_probability_out_of_range(self):
+        payload = _valid_payload()
+        payload["signals"][0]["probability"] = 1.2
+        with pytest.raises(ValidationError) as exc:
+            validate_input_payload(payload)
+        assert "must be between 0 and 1" in str(exc.value)
+
+    def test_probability_must_be_number(self):
+        payload = _valid_payload()
+        payload["signals"][0]["probability"] = "0.5"
+        with pytest.raises(ValidationError, match=r"signals\[0\].probability must be a number"):
+            validate_input_payload(payload)
+
+    def test_validation_error_is_value_error(self):
+        assert issubclass(ValidationError, ValueError)
+
+
+class TestEmptySignals:
+    def test_empty_shape(self):
+        result = compute_consensus([])
+        assert result == {
+            "schemaVersion": SCHEMA_VERSION,
+            "consensus": None,
+            "confidence": 0.0,
+            "sourceWeights": [],
+            "normalization": {"totalWeight": 0.0, "sourceCount": 0},
+            "diagnostics": {"status": "no_signals", "sources": 0},
+        }
+
+    def test_empty_result_is_fresh_per_call(self):
+        a = compute_consensus([])
+        a["diagnostics"]["dryRun"] = True
+        a["sourceWeights"].append({"x": 1})
+        b = compute_consensus([])
+        assert "dryRun" not in b["diagnostics"]
+        assert b["sourceWeights"] == []
+
+
+class TestConsensusMath:
+    def test_cold_start_equal_weights(self):
+        result = compute_consensus(
+            [
+                {"sourceId": "a", "probability": 0.6},
+                {"sourceId": "b", "probability": 0.8},
+            ]
+        )
+        assert result["consensus"] == pytest.approx(0.7)
+        assert result["confidence"] == pytest.approx(0.25)
+        assert result["normalization"]["totalWeight"] == pytest.approx(1.0)
+        assert result["diagnostics"]["coldStartSources"] == ["a", "b"]
+
+    def test_reliability_weighting(self):
+        result = compute_consensus(
+            [
+                {"sourceId": "good", "probability": 1.0},
+                {"sourceId": "bad", "probability": 0.0},
+            ],
+            {
+                "good": {"reliability": 0.9, "confidence": 0.8},
+                "bad": {"reliability": 0.1, "confidence": 0.2},
+            },
+        )
+        assert result["consensus"] == pytest.approx(0.9)
+        assert result["confidence"] == pytest.approx((0.8 * 0.9 + 0.2 * 0.1) / 1.0)
+        assert result["diagnostics"]["coldStartSources"] == []
+
+    def test_duplicate_signals_averaged_per_source(self):
+        result = compute_consensus(
+            [
+                {"sourceId": "a", "probability": 0.2},
+                {"sourceId": "a", "probability": 0.4},
+                {"sourceId": "b", "probability": 0.9},
+            ]
+        )
+        # a's signals average to 0.3 before weighting; equal weights → 0.6
+        assert result["consensus"] == pytest.approx(0.6)
+        assert result["diagnostics"]["sources"] == 3
+        assert result["diagnostics"]["uniqueSources"] == 2
+
+    def test_source_weights_sorted_by_id(self):
+        result = compute_consensus(
+            [
+                {"sourceId": "zeta", "probability": 0.5},
+                {"sourceId": "alpha", "probability": 0.5},
+                {"sourceId": "mid", "probability": 0.5},
+            ]
+        )
+        ids = [w["sourceId"] for w in result["sourceWeights"]]
+        assert ids == ["alpha", "mid", "zeta"]
+
+    def test_zero_total_weight_yields_null_consensus(self):
+        result = compute_consensus(
+            [{"sourceId": "a", "probability": 0.7}],
+            {"a": {"reliability": 0.0, "confidence": 0.5}},
+        )
+        assert result["consensus"] is None
+        assert result["confidence"] == 0.0
+        assert result["sourceWeights"][0]["normalizedWeight"] == 0.0
+
+    def test_partial_reliability_entry_fills_defaults(self):
+        # Present-but-partial entries use defaults for missing keys yet are
+        # NOT cold-start (reference semantics: membership test on the dict,
+        # core.py:167-170).
+        result = compute_consensus(
+            [{"sourceId": "a", "probability": 0.5}],
+            {"a": {}},
+        )
+        assert result["sourceWeights"][0]["weight"] == 0.5
+        assert result["diagnostics"]["coldStartSources"] == []
+
+    def test_summation_semantics_match_builtin_sum(self):
+        # Regression for a 1-ulp drift: the weighted reductions must use
+        # builtin sum() (Neumaier-compensated on CPython >= 3.12), while
+        # totalWeight accumulates naively — the exact mix the reference uses
+        # (reference: core.py:116,120,135-144).
+        import random
+
+        rng = random.Random(7)
+        sigs = [
+            {"sourceId": f"s{i % 9}", "probability": rng.random()} for i in range(40)
+        ]
+        rel = {f"s{i}": {"reliability": rng.random(), "confidence": rng.random()}
+               for i in range(9)}
+        result = compute_consensus(sigs, rel)
+
+        by_source: dict[str, list[float]] = {}
+        for s in sigs:
+            by_source.setdefault(s["sourceId"], []).append(s["probability"])
+        ordered = sorted(by_source)
+        total_weight = 0.0
+        for sid in ordered:
+            total_weight += rel[sid]["reliability"]
+        expected = sum(
+            (sum(by_source[sid]) / len(by_source[sid])) * rel[sid]["reliability"]
+            for sid in ordered
+        ) / total_weight
+        assert result["consensus"] == expected  # exact, not approx
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError, match="unknown backend"):
+            compute_consensus([{"sourceId": "a", "probability": 0.5}], backend="cuda")

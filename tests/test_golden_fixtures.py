@@ -1,0 +1,126 @@
+"""Golden regression + simulation datasets — the bit-exact parity gate.
+
+The golden fixture stores input AND the full expected output document; the
+scalar engine must reproduce it exactly (reference pattern:
+tests/test_golden_fixtures.py:48-70, fixture consensus 0.6966666666666667).
+Simulation fixtures exercise agreement / polarization / outlier scenarios.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from bayesian_consensus_engine_tpu.core import (
+    SCHEMA_VERSION,
+    compute_consensus,
+    validate_input_payload,
+)
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+SIM_NAMES = [
+    "sim_uniform_agreement.json",
+    "sim_polarized_split.json",
+    "sim_single_outlier.json",
+]
+
+
+def _load(name: str) -> dict:
+    return json.loads((FIXTURES / name).read_text(encoding="utf-8"))
+
+
+class TestGoldenRegression:
+    def test_exact_output_match(self):
+        fixture = _load("golden_regression.json")
+        validate_input_payload(fixture["input"])
+        result = compute_consensus(fixture["input"]["signals"])
+        assert result == fixture["expectedOutput"], (
+            "Golden regression mismatch:\n"
+            + json.dumps(result, indent=2)
+        )
+
+    def test_byte_exact_json_serialization(self):
+        """Stronger than dict equality: serialized bytes match too."""
+        fixture = _load("golden_regression.json")
+        result = compute_consensus(fixture["input"]["signals"])
+        assert json.dumps(result, indent=2) == json.dumps(
+            fixture["expectedOutput"], indent=2
+        )
+
+    def test_deterministic_across_runs(self):
+        fixture = _load("golden_regression.json")
+        signals = fixture["input"]["signals"]
+        outputs = [compute_consensus(signals) for _ in range(10)]
+        assert all(o == outputs[0] for o in outputs[1:])
+
+    def test_fixture_schema_version_matches_code(self):
+        fixture = _load("golden_regression.json")
+        assert fixture["input"]["schemaVersion"] == SCHEMA_VERSION
+        assert fixture["expectedOutput"]["schemaVersion"] == SCHEMA_VERSION
+
+
+class TestSimulationDatasets:
+    @pytest.fixture(params=SIM_NAMES)
+    def sim(self, request) -> dict:
+        return _load(request.param)
+
+    def test_passes_validation(self, sim):
+        validate_input_payload(sim["input"])
+
+    def test_output_well_formed(self, sim):
+        result = compute_consensus(sim["input"]["signals"])
+        for key in (
+            "schemaVersion",
+            "consensus",
+            "confidence",
+            "sourceWeights",
+            "normalization",
+            "diagnostics",
+        ):
+            assert key in result
+        assert result["schemaVersion"] == SCHEMA_VERSION
+
+    def test_json_round_trip(self, sim):
+        result = compute_consensus(sim["input"]["signals"])
+        assert json.loads(json.dumps(result)) == result
+
+    def test_deterministic(self, sim):
+        signals = sim["input"]["signals"]
+        assert compute_consensus(signals) == compute_consensus(signals)
+
+
+class TestScenarioSemantics:
+    def test_uniform_agreement_converges_near_cluster(self):
+        sim = _load("sim_uniform_agreement.json")
+        result = compute_consensus(sim["input"]["signals"])
+        assert 0.78 <= result["consensus"] <= 0.82
+
+    def test_polarized_split_lands_between_camps(self):
+        sim = _load("sim_polarized_split.json")
+        result = compute_consensus(sim["input"]["signals"])
+        assert 0.15 < result["consensus"] < 0.85
+
+    def test_single_outlier_drags_mean_down(self):
+        sim = _load("sim_single_outlier.json")
+        result = compute_consensus(sim["input"]["signals"])
+        # 4 sources ~0.60 + one 0.05 outlier, equal weights → ~0.492
+        assert result["consensus"] == pytest.approx(
+            (0.60 + 0.62 + 0.58 + 0.61 + 0.05) / 5
+        )
+
+
+class TestFixtureIntegrity:
+    """Every fixture file must be valid JSON with required meta keys."""
+
+    @pytest.fixture(params=["golden_regression.json"] + SIM_NAMES)
+    def fixture(self, request) -> dict:
+        return _load(request.param)
+
+    def test_has_meta(self, fixture):
+        assert "description" in fixture
+        assert "schemaVersion" in fixture
+        assert "input" in fixture
+
+    def test_input_validates(self, fixture):
+        validate_input_payload(fixture["input"])
