@@ -1,0 +1,199 @@
+"""Command-line surface — byte-compatible with the reference CLI.
+
+Same contract as the reference (reference: src/bayesian_engine/cli.py):
+
+    bce-tpu [--db PATH] [--dry-run] [--input FILE] [COMMAND ...]
+
+Subcommands: ``consensus``, ``report-outcome``, ``list-sources``; invoking
+with no subcommand runs legacy consensus on ``--input``/stdin without a DB.
+Output is pretty-printed JSON on stdout; validation errors go to stderr with
+exit code 1. Preserved quirks: ``--input`` exists both top-level and on the
+consensus subcommand (quirk #13); DB subcommand failures print ``Error: ...``
+and exit 1.
+
+Extension (additive, does not change reference-shaped outputs): ``--backend
+{python,jax,tpu}`` selects the consensus engine implementation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+from bayesian_consensus_engine_tpu.core import (
+    ValidationError,
+    compute_consensus,
+    validate_input_payload,
+)
+from bayesian_consensus_engine_tpu.state import SQLiteReliabilityStore
+
+
+def _read_payload(input_path: str | None) -> dict[str, Any]:
+    """Load the JSON payload from a file or stdin (reference: cli.py:14-22)."""
+    if input_path:
+        with open(input_path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    if sys.stdin.isatty():
+        raise ValidationError("Input required: provide --input <file> or JSON via stdin")
+    return json.load(sys.stdin)
+
+
+def _emit(document: dict[str, Any]) -> None:
+    print(json.dumps(document, indent=2))
+
+
+def _run_consensus(args: argparse.Namespace) -> None:
+    try:
+        payload = _read_payload(args.input)
+        validate_input_payload(payload)
+
+        source_reliability = None
+        if args.db:
+            with SQLiteReliabilityStore(args.db) as store:
+                source_reliability = {}
+                for signal in payload.get("signals", []):
+                    sid = signal.get("sourceId")
+                    if sid:
+                        record = store.get_reliability(
+                            sid, payload["marketId"], apply_decay=True
+                        )
+                        source_reliability[sid] = {
+                            "reliability": record.reliability,
+                            "confidence": record.confidence,
+                        }
+
+        result = compute_consensus(
+            payload["signals"], source_reliability, backend=args.backend
+        )
+        if args.dry_run:
+            result["diagnostics"]["dryRun"] = True
+        _emit(result)
+    except (json.JSONDecodeError, ValidationError) as exc:
+        print(f"Validation error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+    except NotImplementedError as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+
+
+def _run_legacy_consensus(args: argparse.Namespace) -> None:
+    """No-subcommand mode: consensus from --input/stdin, no DB lookups."""
+    try:
+        payload = _read_payload(args.input)
+        validate_input_payload(payload)
+        result = compute_consensus(payload["signals"], backend=args.backend)
+        if args.dry_run:
+            result["diagnostics"]["dryRun"] = True
+        _emit(result)
+    except (json.JSONDecodeError, ValidationError) as exc:
+        print(f"Validation error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+    except NotImplementedError as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+
+
+def _run_report_outcome(args: argparse.Namespace) -> None:
+    if not args.db:
+        print("Error: --db is required for report-outcome", file=sys.stderr)
+        raise SystemExit(1)
+    try:
+        with SQLiteReliabilityStore(args.db) as store:
+            record = store.update_reliability(
+                source_id=args.source_id,
+                market_id=args.market_id,
+                outcome_correct=args.correct,
+                dry_run=args.dry_run,
+            )
+        _emit(
+            {
+                "sourceId": record.source_id,
+                "marketId": record.market_id,
+                "reliability": record.reliability,
+                "confidence": record.confidence,
+                "updatedAt": record.updated_at,
+                "dryRun": args.dry_run,
+            }
+        )
+    except Exception as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+
+
+def _run_list_sources(args: argparse.Namespace) -> None:
+    if not args.db:
+        print("Error: --db is required for list-sources", file=sys.stderr)
+        raise SystemExit(1)
+    try:
+        with SQLiteReliabilityStore(args.db) as store:
+            records = store.list_sources(market_id=args.market_id)
+        _emit(
+            {
+                "sources": [
+                    {
+                        "sourceId": r.source_id,
+                        "marketId": r.market_id,
+                        "reliability": r.reliability,
+                        "confidence": r.confidence,
+                        "updatedAt": r.updated_at,
+                    }
+                    for r in records
+                ],
+                "count": len(records),
+            }
+        )
+    except Exception as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="bce-tpu",
+        description="TPU-native Bayesian-weighted consensus engine with reliability tracking",
+    )
+    parser.add_argument("--db", type=str, help="Path to SQLite database file (default: in-memory)")
+    parser.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="Compute without persisting changes (zero DB writes)",
+    )
+    parser.add_argument("--input", type=str, help="Path to JSON input file (for consensus command)")
+    parser.add_argument(
+        "--backend",
+        choices=("python", "jax", "tpu"),
+        default="python",
+        help="Consensus engine implementation (default: python, bit-exact)",
+    )
+
+    sub = parser.add_subparsers(dest="command", help="Available commands")
+
+    consensus = sub.add_parser("consensus", help="Compute consensus from signals")
+    consensus.add_argument("--input", help="Path to JSON input file")
+    consensus.set_defaults(handler=_run_consensus)
+
+    outcome = sub.add_parser("report-outcome", help="Report outcome and update reliability")
+    outcome.add_argument("--source-id", required=True, help="Source identifier")
+    outcome.add_argument("--market-id", required=True, help="Market identifier")
+    outcome.add_argument("--correct", action="store_true", help="Outcome was correct")
+    outcome.set_defaults(handler=_run_report_outcome)
+
+    listing = sub.add_parser("list-sources", help="List sources with reliability data")
+    listing.add_argument("--market-id", help="Filter by market ID")
+    listing.set_defaults(handler=_run_list_sources)
+
+    return parser
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    if args.command is None:
+        _run_legacy_consensus(args)
+    else:
+        args.handler(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,8 +57,13 @@ def compute_consensus(
     if backend not in ("python", "jax", "tpu"):
         raise ValueError(f"unknown backend: {backend!r}")
     if backend != "python" and signals:
-        from bayesian_consensus_engine_tpu.core.batch import compute_consensus_jax
-
+        try:
+            from bayesian_consensus_engine_tpu.core.batch import compute_consensus_jax
+        except ImportError as exc:
+            raise NotImplementedError(
+                f"backend {backend!r} requires the batched array path "
+                "(core.batch), which is not available in this build"
+            ) from exc
         return compute_consensus_jax(signals, source_reliability)
 
     if not signals:

@@ -1,0 +1,27 @@
+"""Domain models: markets, cross-market aggregation, tie-breaking."""
+
+from bayesian_consensus_engine_tpu.models.market import (
+    CrossMarketAggregator,
+    Market,
+    MarketId,
+    MarketStatus,
+    MarketStore,
+    SourcePerformance,
+)
+from bayesian_consensus_engine_tpu.models.tiebreak import (
+    AgentSignal,
+    DeterministicTieBreaker,
+    TieBreakDiagnostics,
+)
+
+__all__ = [
+    "CrossMarketAggregator",
+    "Market",
+    "MarketId",
+    "MarketStatus",
+    "MarketStore",
+    "SourcePerformance",
+    "AgentSignal",
+    "DeterministicTieBreaker",
+    "TieBreakDiagnostics",
+]

@@ -1,0 +1,322 @@
+"""Multi-market orchestration and cross-market analytics.
+
+Behavioural parity with the reference market layer
+(reference: src/bayesian_engine/market.py:41-408), with one structural
+difference: consensus can be computed through any
+:class:`~..state.sqlite_store.ReliabilityStore` implementation — including
+the HBM tensor store — and ``compute_all_consensus`` has a batched sibling
+in ``core.batch`` that replaces the per-market Python loop with one vmapped
+kernel (the reference's M×S scaling wall, market.py:200-221).
+
+Preserved reference quirks: empty-market consensus returns a reduced 4-key
+document (quirk #8); nothing ever transitions a market to CLOSED (quirk #14);
+a source is "correct" iff (probability >= 0.5) == outcome.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+from bayesian_consensus_engine_tpu.core.engine import compute_consensus
+from bayesian_consensus_engine_tpu.state.sqlite_store import ReliabilityStore
+from bayesian_consensus_engine_tpu.state.update_math import utc_now_iso
+from bayesian_consensus_engine_tpu.utils.config import SCHEMA_VERSION
+
+
+@dataclass(frozen=True)
+class MarketId:
+    """Identifier for a market/question; supports ``cat:subcat:...`` structure."""
+
+    value: str
+
+    def __post_init__(self) -> None:
+        if not self.value or not self.value.strip():
+            raise ValueError("Market ID cannot be empty")
+
+    def __str__(self) -> str:
+        return self.value
+
+    def __repr__(self) -> str:
+        return f"MarketId({self.value!r})"
+
+    @property
+    def category(self) -> Optional[str]:
+        """Leading segment of a ``cat:...`` id, else None."""
+        return self.value.split(":")[0] if ":" in self.value else None
+
+    @property
+    def parts(self) -> List[str]:
+        return self.value.split(":")
+
+    def matches(self, pattern: str) -> bool:
+        """Glob match (``crypto:*``, ``*:price``, exact ids)."""
+        return fnmatch.fnmatch(self.value, pattern)
+
+
+class MarketStatus(str, Enum):
+    OPEN = "open"          # accepting signals
+    CLOSED = "closed"      # no more signals, outcome pending
+    RESOLVED = "resolved"  # outcome known
+
+
+@dataclass
+class Market:
+    """One market: status, collected signals, optional resolved outcome."""
+
+    id: MarketId
+    status: MarketStatus = MarketStatus.OPEN
+    signals: List[Dict[str, Any]] = field(default_factory=list)
+    consensus_result: Optional[Dict[str, Any]] = None
+    outcome: Optional[bool] = None
+    created_at: str = field(default_factory=utc_now_iso)
+    resolved_at: Optional[str] = None
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def add_signal(self, signal: Dict[str, Any]) -> None:
+        if self.status != MarketStatus.OPEN:
+            raise ValueError(f"Cannot add signal to {self.status} market")
+        self.signals.append(signal)
+
+    def compute_consensus(
+        self,
+        source_reliability: Optional[Dict[str, Dict[str, float]]] = None,
+        backend: str = "python",
+    ) -> Dict[str, Any]:
+        """Consensus for this market, stamped with ``marketId``.
+
+        Empty market → reduced 4-key document (no sourceWeights/normalization/
+        diagnostics), unlike core's empty-signals shape (reference quirk #8).
+        """
+        if not self.signals:
+            return {
+                "schemaVersion": SCHEMA_VERSION,
+                "consensus": None,
+                "confidence": 0.0,
+                "marketId": str(self.id),
+            }
+        result = compute_consensus(self.signals, source_reliability, backend=backend)
+        result["marketId"] = str(self.id)
+        self.consensus_result = result
+        return result
+
+    def resolve(self, outcome: bool) -> None:
+        self.outcome = outcome
+        self.status = MarketStatus.RESOLVED
+        self.resolved_at = utc_now_iso()
+
+
+class MarketStore:
+    """In-memory registry of markets keyed by id string."""
+
+    def __init__(self) -> None:
+        self._markets: Dict[str, Market] = {}
+
+    def create_market(
+        self,
+        market_id: MarketId,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> Market:
+        key = str(market_id)
+        if key in self._markets:
+            raise ValueError(f"Market {market_id} already exists")
+        market = Market(id=market_id, metadata=metadata or {})
+        self._markets[key] = market
+        return market
+
+    def get_market(self, market_id: MarketId) -> Optional[Market]:
+        return self._markets.get(str(market_id))
+
+    def get_or_create(self, market_id: MarketId) -> Market:
+        return self.get_market(market_id) or self.create_market(market_id)
+
+    def add_signal(self, market_id: MarketId, signal: Dict[str, Any]) -> Market:
+        market = self.get_or_create(market_id)
+        market.add_signal(signal)
+        return market
+
+    def list_markets(
+        self,
+        status: Optional[MarketStatus] = None,
+        pattern: Optional[str] = None,
+    ) -> List[Market]:
+        markets = list(self._markets.values())
+        if status is not None:
+            markets = [m for m in markets if m.status == status]
+        if pattern is not None:
+            markets = [m for m in markets if m.id.matches(pattern)]
+        return markets
+
+    def compute_all_consensus(
+        self,
+        reliability_store: Optional[ReliabilityStore] = None,
+        backend: str = "python",
+    ) -> Dict[str, Dict[str, Any]]:
+        """Consensus for every OPEN market (decayed reliability per source).
+
+        This is the loop the TPU path replaces wholesale — see
+        ``core.batch.compute_batch_consensus`` for the vmapped (M×S) kernel
+        over a packed signal tensor.
+        """
+        results: Dict[str, Dict[str, Any]] = {}
+        for market in self.list_markets(status=MarketStatus.OPEN):
+            source_rel: Optional[Dict[str, Dict[str, float]]] = None
+            if reliability_store is not None:
+                source_rel = {}
+                for signal in market.signals:
+                    sid = signal["sourceId"]
+                    if sid not in source_rel:
+                        record = reliability_store.get_reliability(
+                            sid, str(market.id), apply_decay=True
+                        )
+                        source_rel[sid] = {
+                            "reliability": record.reliability,
+                            "confidence": record.confidence,
+                        }
+            results[str(market.id)] = market.compute_consensus(source_rel, backend=backend)
+        return results
+
+
+@dataclass
+class SourcePerformance:
+    """A source's aggregate track record across resolved markets."""
+
+    source_id: str
+    total_markets: int
+    correct_predictions: int
+    wrong_predictions: int
+    reliability: float
+    markets: List[str] = field(default_factory=list)
+
+    @property
+    def accuracy(self) -> float:
+        total = self.correct_predictions + self.wrong_predictions
+        return self.correct_predictions / total if total else 0.0
+
+
+class CrossMarketAggregator:
+    """Analytics across markets: source scorecards, category summaries,
+    cross-market consensus aggregation."""
+
+    def __init__(self, market_store: MarketStore):
+        self._store = market_store
+
+    def summarize_sources(
+        self,
+        patterns: Optional[List[str]] = None,
+    ) -> Dict[str, SourcePerformance]:
+        """Per-source accuracy over RESOLVED markets (optionally filtered)."""
+        markets = self._store.list_markets(status=MarketStatus.RESOLVED)
+        if patterns:
+            markets = [
+                m for m in markets if any(m.id.matches(p) for p in patterns)
+            ]
+
+        tallies: Dict[str, Dict[str, Any]] = {}
+        for market in markets:
+            if market.outcome is None:
+                continue
+            for signal in market.signals:
+                sid = signal["sourceId"]
+                stats = tallies.setdefault(
+                    sid, {"total": 0, "correct": 0, "wrong": 0, "markets": []}
+                )
+                stats["total"] += 1
+                stats["markets"].append(str(market.id))
+                # Binary correctness: predicted-true iff probability >= 0.5.
+                predicted_true = signal.get("probability", 0.5) >= 0.5
+                if predicted_true == market.outcome:
+                    stats["correct"] += 1
+                else:
+                    stats["wrong"] += 1
+
+        summary: Dict[str, SourcePerformance] = {}
+        for sid, stats in tallies.items():
+            judged = stats["correct"] + stats["wrong"]
+            summary[sid] = SourcePerformance(
+                source_id=sid,
+                total_markets=stats["total"],
+                correct_predictions=stats["correct"],
+                wrong_predictions=stats["wrong"],
+                reliability=stats["correct"] / judged if judged else 0.5,
+                markets=stats["markets"],
+            )
+        return summary
+
+    def summarize_category(self, category: str) -> Dict[str, Any]:
+        markets = self._store.list_markets(pattern=f"{category}:*")
+        resolved = [m for m in markets if m.status == MarketStatus.RESOLVED]
+        open_markets = [m for m in markets if m.status == MarketStatus.OPEN]
+        return {
+            "category": category,
+            "total_markets": len(markets),
+            "resolved": len(resolved),
+            "open": len(open_markets),
+            "markets": [str(m.id) for m in markets],
+        }
+
+    def aggregate_consensus(
+        self,
+        patterns: List[str],
+        method: str = "weighted_average",
+    ) -> Dict[str, Any]:
+        """Combine cached per-market consensus across matching markets.
+
+        Methods: confidence-weighted average, upper median, binary majority.
+        """
+        markets: List[Market] = []
+        for pattern in patterns:
+            markets.extend(self._store.list_markets(pattern=pattern))
+
+        if not markets:
+            return {
+                "schemaVersion": SCHEMA_VERSION,
+                "consensus": None,
+                "confidence": 0.0,
+                "marketsIncluded": 0,
+            }
+
+        entries = [
+            {
+                "marketId": str(m.id),
+                "consensus": m.consensus_result["consensus"],
+                "confidence": m.consensus_result.get("confidence", 0.5),
+            }
+            for m in markets
+            if m.consensus_result and m.consensus_result.get("consensus") is not None
+        ]
+
+        if not entries:
+            return {
+                "schemaVersion": SCHEMA_VERSION,
+                "consensus": None,
+                "confidence": 0.0,
+                "marketsIncluded": len(markets),
+            }
+
+        if method == "weighted_average":
+            total_conf = sum(e["confidence"] for e in entries)
+            if total_conf == 0:
+                aggregated = sum(e["consensus"] for e in entries) / len(entries)
+            else:
+                aggregated = (
+                    sum(e["consensus"] * e["confidence"] for e in entries) / total_conf
+                )
+        elif method == "median":
+            ordered = sorted(e["consensus"] for e in entries)
+            aggregated = ordered[len(ordered) // 2]  # upper median, like reference
+        elif method == "majority":
+            votes = [1 if e["consensus"] >= 0.5 else 0 for e in entries]
+            aggregated = sum(votes) / len(votes)
+        else:
+            raise ValueError(f"Unknown aggregation method: {method}")
+
+        return {
+            "schemaVersion": SCHEMA_VERSION,
+            "consensus": aggregated,
+            "confidence": sum(e["confidence"] for e in entries) / len(entries),
+            "marketsIncluded": len(entries),
+            "method": method,
+        }

@@ -1,0 +1,24 @@
+"""Reliability state layer: durable SQLite backend, HBM tensor backend,
+namespaced fallback wrapper, and the shared decay/update math."""
+
+from bayesian_consensus_engine_tpu.state.records import ReliabilityRecord
+from bayesian_consensus_engine_tpu.state.sqlite_store import (
+    ReliabilityStore,
+    SQLiteReliabilityStore,
+)
+from bayesian_consensus_engine_tpu.state.decay import (
+    apply_reliability_decay,
+    compute_decay_factor,
+    days_since_update,
+    decay_reliability_if_needed,
+)
+
+__all__ = [
+    "ReliabilityRecord",
+    "ReliabilityStore",
+    "SQLiteReliabilityStore",
+    "apply_reliability_decay",
+    "compute_decay_factor",
+    "days_since_update",
+    "decay_reliability_if_needed",
+]

@@ -1,0 +1,243 @@
+"""Durable SQLite reliability store — the compatibility/checkpoint backend.
+
+API and observable semantics match the reference store
+(reference: src/bayesian_engine/reliability.py:59-285):
+
+  * per-(source_id, market_id) rows, WAL journal, autocommit
+  * cold-start reads return defaults WITHOUT persisting a row
+  * ``apply_decay=True`` decays reliability at read time only
+  * ``compute_update`` / ``update_reliability(dry_run=True)`` never write
+  * UPSERT on conflict; ``list_sources`` returns sorted records
+
+In the TPU architecture this store is the *durable checkpoint format*: the
+HBM-resident :class:`~.tensor_store.TensorReliabilityStore` imports from and
+flushes to this exact schema, so CLI and on-disk state stay drop-in
+compatible with the reference.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from pathlib import Path
+from typing import List, Optional, Protocol, Union, runtime_checkable
+
+from bayesian_consensus_engine_tpu.utils.config import (
+    DECAY_HALF_LIFE_DAYS,
+    DECAY_MINIMUM,
+    DEFAULT_CONFIDENCE,
+    DEFAULT_RELIABILITY,
+)
+from bayesian_consensus_engine_tpu.state.decay import (
+    apply_reliability_decay,
+    days_since_update,
+)
+from bayesian_consensus_engine_tpu.state.records import ReliabilityRecord
+from bayesian_consensus_engine_tpu.state.update_math import apply_outcome, utc_now_iso
+
+_SCHEMA_SQL = """
+CREATE TABLE IF NOT EXISTS sources (
+    source_id   TEXT    NOT NULL,
+    market_id   TEXT    NOT NULL,
+    reliability REAL    NOT NULL DEFAULT 0.5,
+    confidence  REAL    NOT NULL DEFAULT 0.5,
+    updated_at  TEXT    NOT NULL,
+    PRIMARY KEY (source_id, market_id)
+);
+"""
+
+_UPSERT_SQL = """
+INSERT INTO sources (source_id, market_id, reliability, confidence, updated_at)
+VALUES (?, ?, ?, ?, ?)
+ON CONFLICT(source_id, market_id)
+DO UPDATE SET reliability = excluded.reliability,
+              confidence  = excluded.confidence,
+              updated_at  = excluded.updated_at
+"""
+
+
+@runtime_checkable
+class ReliabilityStore(Protocol):
+    """Interface every reliability backend implements.
+
+    The TPU path is gated behind this seam (BASELINE.json north star): the
+    consensus/market layers accept any implementation — SQLite (durable),
+    device-tensor (HBM), or namespaced wrapper.
+    """
+
+    def get_reliability(
+        self, source_id: str, market_id: str, apply_decay: bool = False
+    ) -> ReliabilityRecord: ...
+
+    def update_reliability(
+        self,
+        source_id: str,
+        market_id: str,
+        outcome_correct: bool,
+        dry_run: bool = False,
+    ) -> ReliabilityRecord: ...
+
+    def list_sources(self, market_id: Optional[str] = None) -> List[ReliabilityRecord]: ...
+
+    def close(self) -> None: ...
+
+
+class SQLiteReliabilityStore:
+    """SQLite-backed per-(source, market) reliability scores.
+
+    Use ``":memory:"`` (the default) for an ephemeral store in tests.
+    """
+
+    def __init__(self, db_path: Union[str, Path] = ":memory:") -> None:
+        self._db_path = str(db_path)
+        # Autocommit (isolation_level=None) + WAL: single-writer workload with
+        # cheap concurrent reads, matching the reference's durability contract.
+        self._conn = sqlite3.connect(self._db_path, isolation_level=None)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self._conn.row_factory = sqlite3.Row
+        self._conn.executescript(_SCHEMA_SQL)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get_reliability(
+        self,
+        source_id: str,
+        market_id: str,
+        apply_decay: bool = False,
+    ) -> ReliabilityRecord:
+        """Fetch one record; cold-start defaults if absent (never persisted).
+
+        With ``apply_decay=True`` the returned reliability is decayed by time
+        since ``updated_at``; the stored value is untouched.
+        """
+        row = self._conn.execute(
+            "SELECT reliability, confidence, updated_at FROM sources"
+            " WHERE source_id = ? AND market_id = ?",
+            (source_id, market_id),
+        ).fetchone()
+
+        if row is None:
+            return ReliabilityRecord(
+                source_id=source_id,
+                market_id=market_id,
+                reliability=DEFAULT_RELIABILITY,
+                confidence=DEFAULT_CONFIDENCE,
+                updated_at="",
+            )
+
+        reliability = row["reliability"]
+        updated_at = row["updated_at"]
+        if apply_decay and updated_at:
+            elapsed = days_since_update(updated_at)
+            if elapsed > 0:
+                reliability = apply_reliability_decay(
+                    reliability, elapsed, DECAY_HALF_LIFE_DAYS, DECAY_MINIMUM
+                )
+
+        return ReliabilityRecord(
+            source_id=source_id,
+            market_id=market_id,
+            reliability=reliability,
+            confidence=row["confidence"],
+            updated_at=updated_at,
+        )
+
+    def list_sources(self, market_id: Optional[str] = None) -> List[ReliabilityRecord]:
+        """All stored records, sorted; optionally filtered to one market."""
+        if market_id is None:
+            rows = self._conn.execute(
+                "SELECT source_id, market_id, reliability, confidence, updated_at"
+                " FROM sources ORDER BY source_id, market_id"
+            ).fetchall()
+        else:
+            rows = self._conn.execute(
+                "SELECT source_id, market_id, reliability, confidence, updated_at"
+                " FROM sources WHERE market_id = ? ORDER BY source_id",
+                (market_id,),
+            ).fetchall()
+        return [
+            ReliabilityRecord(
+                source_id=r["source_id"],
+                market_id=r["market_id"],
+                reliability=r["reliability"],
+                confidence=r["confidence"],
+                updated_at=r["updated_at"],
+            )
+            for r in rows
+        ]
+
+    # -- writes --------------------------------------------------------------
+
+    def compute_update(
+        self,
+        source_id: str,
+        market_id: str,
+        outcome_correct: bool,
+    ) -> ReliabilityRecord:
+        """Dry-run the post-outcome update: new values, zero writes.
+
+        Reads the UNDECAYED stored value (decay is read-time only —
+        reference: reliability.py:161, quirk preserved).
+        """
+        current = self.get_reliability(source_id, market_id)
+        new_rel, new_conf = apply_outcome(
+            current.reliability, current.confidence, outcome_correct
+        )
+        return ReliabilityRecord(
+            source_id=source_id,
+            market_id=market_id,
+            reliability=new_rel,
+            confidence=new_conf,
+            updated_at=utc_now_iso(),
+        )
+
+    def update_reliability(
+        self,
+        source_id: str,
+        market_id: str,
+        outcome_correct: bool,
+        dry_run: bool = False,
+    ) -> ReliabilityRecord:
+        """Apply (and, unless ``dry_run``, persist) a post-outcome update."""
+        record = self.compute_update(source_id, market_id, outcome_correct)
+        if dry_run:
+            return record
+        self._conn.execute(
+            _UPSERT_SQL,
+            (
+                record.source_id,
+                record.market_id,
+                record.reliability,
+                record.confidence,
+                record.updated_at,
+            ),
+        )
+        return record
+
+    def put_record(self, record: ReliabilityRecord) -> None:
+        """Upsert a fully-specified record (bulk import/seed path).
+
+        Extension over the reference surface: used by the tensor store's
+        checkpoint flush and by namespaced seeding.
+        """
+        self._conn.execute(
+            _UPSERT_SQL,
+            (
+                record.source_id,
+                record.market_id,
+                record.reliability,
+                record.confidence,
+                record.updated_at,
+            ),
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def __enter__(self) -> "SQLiteReliabilityStore":
+        return self
+
+    def __exit__(self, *exc_info: object) -> None:
+        self.close()

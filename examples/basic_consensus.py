@@ -1,0 +1,33 @@
+"""Minimal library flow: validate a payload, compute consensus, read diagnostics.
+
+Run from the repo root:  python examples/basic_consensus.py
+"""
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from bayesian_consensus_engine_tpu.core import (
+    compute_consensus,
+    validate_input_payload,
+)
+
+payload = {
+    "schemaVersion": "1.0.0",
+    "marketId": "demo-market",
+    "signals": [
+        {"sourceId": "forecaster-1", "probability": 0.72},
+        {"sourceId": "forecaster-2", "probability": 0.65},
+        {"sourceId": "model-x", "probability": 0.80},
+    ],
+}
+
+validate_input_payload(payload)
+result = compute_consensus(payload["signals"])
+
+print(json.dumps(result, indent=2))
+print()
+print(f"Consensus probability: {result['consensus']:.2%}")
+print(f"Cold-start sources:    {result['diagnostics']['coldStartSources']}")

@@ -1,0 +1,42 @@
+"""Reliability lifecycle: outcomes move scores, decay erodes them, consensus
+weights shift accordingly.
+
+Run from the repo root:  python examples/reliability_tracking.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from bayesian_consensus_engine_tpu.core import compute_consensus
+from bayesian_consensus_engine_tpu.state import SQLiteReliabilityStore
+
+with SQLiteReliabilityStore(":memory:") as store:
+    # A source that keeps being right, one that keeps being wrong.
+    for _ in range(4):
+        store.update_reliability("oracle", "btc-2026", outcome_correct=True)
+        store.update_reliability("contrarian", "btc-2026", outcome_correct=False)
+
+    for record in store.list_sources():
+        print(
+            f"{record.source_id:12s} reliability={record.reliability:.2f} "
+            f"confidence={record.confidence:.3f}"
+        )
+
+    reliability = {
+        r.source_id: {"reliability": r.reliability, "confidence": r.confidence}
+        for r in store.list_sources()
+    }
+    result = compute_consensus(
+        [
+            {"sourceId": "oracle", "probability": 0.9},
+            {"sourceId": "contrarian", "probability": 0.1},
+        ],
+        reliability,
+    )
+    print(f"\nWeighted consensus: {result['consensus']:.3f}  (oracle dominates)")
+
+    # Dry-run: preview the next update without writing.
+    preview = store.update_reliability("oracle", "btc-2026", True, dry_run=True)
+    print(f"Dry-run preview:    {preview.reliability:.2f} (nothing persisted)")

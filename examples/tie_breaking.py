@@ -1,0 +1,25 @@
+"""Deterministic tie-break between conflicting agent predictions.
+
+Run from the repo root:  python examples/tie_breaking.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from bayesian_consensus_engine_tpu.models import AgentSignal, DeterministicTieBreaker
+
+agents = [
+    AgentSignal("fast-model", prediction=0.8, confidence=0.7, weight=1.0, reliability_score=0.6),
+    AgentSignal("slow-model", prediction=0.8, confidence=0.9, weight=1.0, reliability_score=0.7),
+    AgentSignal("heuristic", prediction=0.3, confidence=0.5, weight=0.5, reliability_score=0.4),
+]
+
+winner, diagnostics = DeterministicTieBreaker().resolve(agents)
+
+print(f"Winning prediction: {winner}")
+print(f"Resolved by:        {diagnostics.tie_resolved_by}")
+print(f"Confidence var:     {diagnostics.confidence_variance}")
+for prediction, metrics in diagnostics.groups.items():
+    print(f"  group {prediction}: {metrics}")

@@ -1,0 +1,190 @@
+"""Process-level CLI integration: exit codes, stdout JSON, stderr messages.
+
+Mirrors the reference's subprocess test style (reference:
+tests/test_integration.py, tests/test_dry_run.py): drive
+``python -m bayesian_consensus_engine_tpu.cli`` end-to-end, assert state only
+through the public surface (a second CLI process), never by DB peeking.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = str(Path(__file__).resolve().parents[1])
+
+
+def run_cli(args: list[str], stdin_payload: dict | None = None):
+    return subprocess.run(
+        [sys.executable, "-m", "bayesian_consensus_engine_tpu.cli", *args],
+        capture_output=True,
+        text=True,
+        input=json.dumps(stdin_payload) if stdin_payload is not None else None,
+        cwd=REPO_ROOT,
+    )
+
+
+def _payload(signals=None) -> dict:
+    return {
+        "schemaVersion": "1.0.0",
+        "marketId": "market-1",
+        "signals": signals
+        if signals is not None
+        else [{"sourceId": "agent-a", "probability": 0.5}],
+    }
+
+
+class TestLegacyMode:
+    def test_input_file(self, tmp_path: Path):
+        f = tmp_path / "in.json"
+        f.write_text(json.dumps(_payload()), encoding="utf-8")
+        proc = run_cli(["--input", str(f)])
+        assert proc.returncode == 0
+        out = json.loads(proc.stdout)
+        assert out["schemaVersion"] == "1.0.0"
+        assert out["consensus"] == 0.5
+
+    def test_stdin(self):
+        proc = run_cli([], stdin_payload=_payload())
+        assert proc.returncode == 0
+        assert json.loads(proc.stdout)["consensus"] == 0.5
+
+    def test_missing_schema_version_exits_1(self):
+        bad = _payload()
+        del bad["schemaVersion"]
+        proc = run_cli([], stdin_payload=bad)
+        assert proc.returncode == 1
+        assert "schemaVersion is required" in proc.stderr
+
+    def test_malformed_json_exits_1(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "bayesian_consensus_engine_tpu.cli"],
+            capture_output=True,
+            text=True,
+            input="{not json",
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 1
+        assert "Validation error" in proc.stderr
+
+    def test_dry_run_stamps_diagnostics(self):
+        proc = run_cli(["--dry-run"], stdin_payload=_payload())
+        assert json.loads(proc.stdout)["diagnostics"]["dryRun"] is True
+
+
+class TestConsensusSubcommand:
+    def test_stdin(self):
+        proc = run_cli(["consensus"], stdin_payload=_payload())
+        assert proc.returncode == 0
+        assert json.loads(proc.stdout)["consensus"] == 0.5
+
+    def test_subcommand_input_flag(self, tmp_path: Path):
+        f = tmp_path / "in.json"
+        f.write_text(json.dumps(_payload()), encoding="utf-8")
+        proc = run_cli(["consensus", "--input", str(f)])
+        assert proc.returncode == 0
+
+    def test_golden_fixture_byte_exact_via_cli(self):
+        fixture = json.loads(
+            (Path(REPO_ROOT) / "tests/fixtures/golden_regression.json").read_text()
+        )
+        proc = run_cli(["consensus"], stdin_payload=fixture["input"])
+        assert proc.returncode == 0
+        assert proc.stdout == json.dumps(fixture["expectedOutput"], indent=2) + "\n"
+
+    def test_db_reliability_lookup(self, tmp_path: Path):
+        db = tmp_path / "rel.db"
+        # Build reliability through the public surface: report outcomes.
+        for _ in range(3):
+            run_cli([
+                "--db", str(db), "report-outcome",
+                "--source-id", "good", "--market-id", "market-1", "--correct",
+            ])
+            run_cli([
+                "--db", str(db), "report-outcome",
+                "--source-id", "bad", "--market-id", "market-1",
+            ])
+        payload = _payload(
+            [
+                {"sourceId": "good", "probability": 1.0},
+                {"sourceId": "bad", "probability": 0.0},
+            ]
+        )
+        proc = run_cli(["--db", str(db), "consensus"], stdin_payload=payload)
+        out = json.loads(proc.stdout)
+        assert out["consensus"] > 0.7  # good outweighs bad
+        assert out["diagnostics"]["coldStartSources"] == []
+
+
+class TestReportOutcome:
+    def test_requires_db(self):
+        proc = run_cli(["report-outcome", "--source-id", "a", "--market-id", "m"])
+        assert proc.returncode == 1
+        assert "--db is required" in proc.stderr
+
+    def test_correct_outcome(self, tmp_path: Path):
+        proc = run_cli([
+            "--db", str(tmp_path / "r.db"), "report-outcome",
+            "--source-id", "a", "--market-id", "m", "--correct",
+        ])
+        assert proc.returncode == 0
+        out = json.loads(proc.stdout)
+        assert out["sourceId"] == "a"
+        assert out["marketId"] == "m"
+        assert out["reliability"] == 0.6
+        assert out["dryRun"] is False
+
+    def test_incorrect_outcome(self, tmp_path: Path):
+        proc = run_cli([
+            "--db", str(tmp_path / "r.db"), "report-outcome",
+            "--source-id", "a", "--market-id", "m",
+        ])
+        assert json.loads(proc.stdout)["reliability"] == 0.4
+
+
+class TestDryRun:
+    def test_dry_run_report_outcome_persists_nothing(self, tmp_path: Path):
+        db = tmp_path / "r.db"
+        proc = run_cli([
+            "--db", str(db), "--dry-run", "report-outcome",
+            "--source-id", "a", "--market-id", "m", "--correct",
+        ])
+        assert proc.returncode == 0
+        out = json.loads(proc.stdout)
+        assert out["dryRun"] is True
+        assert out["reliability"] > 0.5
+        # Zero writes — verified through the public surface.
+        listing = run_cli(["--db", str(db), "list-sources"])
+        assert json.loads(listing.stdout)["count"] == 0
+
+    def test_without_dry_run_persists(self, tmp_path: Path):
+        db = tmp_path / "r.db"
+        run_cli([
+            "--db", str(db), "report-outcome",
+            "--source-id", "a", "--market-id", "m", "--correct",
+        ])
+        listing = run_cli(["--db", str(db), "list-sources"])
+        assert json.loads(listing.stdout)["count"] == 1
+
+
+class TestListSources:
+    def test_requires_db(self):
+        proc = run_cli(["list-sources"])
+        assert proc.returncode == 1
+        assert "--db is required" in proc.stderr
+
+    def test_empty_db(self, tmp_path: Path):
+        proc = run_cli(["--db", str(tmp_path / "r.db"), "list-sources"])
+        out = json.loads(proc.stdout)
+        assert out == {"sources": [], "count": 0}
+
+    def test_market_filter(self, tmp_path: Path):
+        db = tmp_path / "r.db"
+        run_cli(["--db", str(db), "report-outcome", "--source-id", "a",
+                 "--market-id", "m-1", "--correct"])
+        run_cli(["--db", str(db), "report-outcome", "--source-id", "a",
+                 "--market-id", "m-2", "--correct"])
+        proc = run_cli(["--db", str(db), "list-sources", "--market-id", "m-1"])
+        out = json.loads(proc.stdout)
+        assert out["count"] == 1
+        assert out["sources"][0]["marketId"] == "m-1"

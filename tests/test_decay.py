@@ -1,0 +1,141 @@
+"""Half-life decay math — scalar path.
+
+Pin the same behaviours the reference pins (reference: tests/test_decay.py):
+factor values at 0/1/2 half-lives, floor clamping, timestamp parsing edge
+cases (None/empty/invalid/naive/future), and the combined helper.
+"""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from bayesian_consensus_engine_tpu.state.decay import (
+    apply_reliability_decay,
+    compute_decay_factor,
+    days_since_update,
+    decay_reliability_if_needed,
+)
+
+
+class TestDecayFactor:
+    def test_zero_elapsed_is_one(self):
+        assert compute_decay_factor(0) == 1.0
+
+    def test_negative_elapsed_is_one(self):
+        assert compute_decay_factor(-5) == 1.0
+
+    def test_one_half_life(self):
+        assert compute_decay_factor(30) == pytest.approx(0.5)
+
+    def test_two_half_lives(self):
+        assert compute_decay_factor(60) == pytest.approx(0.25)
+
+    def test_custom_half_life(self):
+        assert compute_decay_factor(7, half_life_days=7) == pytest.approx(0.5)
+
+    def test_monotonically_decreasing(self):
+        values = [compute_decay_factor(t) for t in (0, 1, 10, 30, 90, 365)]
+        assert values == sorted(values, reverse=True)
+
+    def test_always_in_unit_interval(self):
+        for t in (0.001, 1, 100, 10000):
+            assert 0.0 < compute_decay_factor(t) <= 1.0
+
+
+class TestApplyDecay:
+    def test_no_elapsed_no_change(self):
+        assert apply_reliability_decay(0.8, 0) == 0.8
+
+    def test_one_half_life_midpoint_to_floor(self):
+        # 0.1 + (0.8 - 0.1) * 0.5 = 0.45
+        assert apply_reliability_decay(0.8, 30, min_reliability=0.1) == pytest.approx(0.45)
+
+    def test_very_old_hits_floor(self):
+        assert apply_reliability_decay(0.8, 100000, min_reliability=0.1) == pytest.approx(0.1)
+
+    def test_never_below_floor(self):
+        for t in (1, 30, 365, 100000):
+            assert apply_reliability_decay(0.9, t) >= 0.10
+
+    def test_value_already_at_floor_stays(self):
+        assert apply_reliability_decay(0.10, 500) == pytest.approx(0.10)
+
+    def test_value_below_floor_pulled_up_to_floor(self):
+        # floor + (0.05-0.1)*factor < floor → clamped to floor
+        assert apply_reliability_decay(0.05, 30) == 0.10
+
+    def test_clamped_to_one(self):
+        assert apply_reliability_decay(1.0, 0.0001) <= 1.0
+
+
+class TestDaysSinceUpdate:
+    def test_none_is_zero(self):
+        assert days_since_update(None) == 0.0
+
+    def test_empty_string_is_zero(self):
+        assert days_since_update("") == 0.0
+
+    def test_invalid_timestamp_is_zero(self):
+        assert days_since_update("not-a-timestamp") == 0.0
+
+    def test_datetime_object(self):
+        now = datetime(2026, 1, 31, tzinfo=timezone.utc)
+        then = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        assert days_since_update(then, now=now) == pytest.approx(30.0)
+
+    def test_iso_string(self):
+        now = datetime(2026, 1, 2, tzinfo=timezone.utc)
+        assert days_since_update("2026-01-01T00:00:00+00:00", now=now) == pytest.approx(1.0)
+
+    def test_naive_timestamp_assumed_utc(self):
+        now = datetime(2026, 1, 2, tzinfo=timezone.utc)
+        assert days_since_update("2026-01-01T00:00:00", now=now) == pytest.approx(1.0)
+
+    def test_future_timestamp_clamped_to_zero(self):
+        now = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        assert days_since_update("2026-06-01T00:00:00+00:00", now=now) == 0.0
+
+    def test_fractional_days(self):
+        now = datetime(2026, 1, 1, 12, 0, 0, tzinfo=timezone.utc)
+        assert days_since_update("2026-01-01T00:00:00+00:00", now=now) == pytest.approx(0.5)
+
+
+class TestDecayIfNeeded:
+    def test_cold_start_not_decayed(self):
+        assert decay_reliability_if_needed(0.8, None) == (0.8, False)
+
+    def test_same_instant_not_decayed(self):
+        now = datetime.now(timezone.utc)
+        value, was_decayed = decay_reliability_if_needed(0.8, now, now=now)
+        assert value == 0.8
+        assert was_decayed is False
+
+    def test_old_update_decayed(self):
+        now = datetime.now(timezone.utc)
+        stamp = (now - timedelta(days=30)).isoformat()
+        value, was_decayed = decay_reliability_if_needed(0.8, stamp, now=now)
+        assert was_decayed is True
+        assert value == pytest.approx(0.45, abs=1e-6)
+
+    def test_matches_reference_implementation(self):
+        """Cross-check the full scalar decay pipeline against the reference."""
+        import sys
+
+        sys.path.insert(0, "/root/reference/src")
+        try:
+            from bayesian_engine import decay as ref
+        except ImportError:
+            pytest.skip("reference not mounted")
+        finally:
+            sys.path.remove("/root/reference/src")
+
+        now = datetime(2026, 7, 1, tzinfo=timezone.utc)
+        for rel in (0.0, 0.05, 0.1, 0.3, 0.5, 0.77, 1.0):
+            for days in (0, 0.5, 1, 29.9, 30, 60, 365, 9999):
+                stamp = (now - timedelta(days=days)).isoformat()
+                assert days_since_update(stamp, now=now) == ref.days_since_update(
+                    stamp, now=now
+                )
+                assert apply_reliability_decay(rel, days) == ref.apply_reliability_decay(
+                    rel, days
+                )

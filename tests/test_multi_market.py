@@ -1,0 +1,255 @@
+"""Market orchestration, store queries, and cross-market aggregation."""
+
+import pytest
+
+from bayesian_consensus_engine_tpu.models import (
+    CrossMarketAggregator,
+    Market,
+    MarketId,
+    MarketStatus,
+    MarketStore,
+    SourcePerformance,
+)
+from bayesian_consensus_engine_tpu.state import SQLiteReliabilityStore
+
+
+class TestMarketId:
+    def test_str(self):
+        assert str(MarketId("crypto-btc-1")) == "crypto-btc-1"
+
+    def test_repr(self):
+        assert repr(MarketId("x")) == "MarketId('x')"
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError, match="cannot be empty"):
+            MarketId("  ")
+
+    def test_category(self):
+        assert MarketId("crypto:btc:price").category == "crypto"
+        assert MarketId("simple-id").category is None
+
+    def test_parts(self):
+        assert MarketId("crypto:btc:price").parts == ["crypto", "btc", "price"]
+
+    def test_matches(self):
+        mid = MarketId("crypto:btc:1")
+        assert mid.matches("crypto:btc:1")
+        assert mid.matches("crypto:*:1")
+        assert mid.matches("crypto:*")
+        assert mid.matches("*")
+        assert not mid.matches("sports:*")
+        assert not mid.matches("crypto:btc:2")
+
+    def test_frozen_hashable(self):
+        assert {MarketId("a"): 1}[MarketId("a")] == 1
+
+
+class TestMarket:
+    def test_new_market_open_and_empty(self):
+        market = Market(id=MarketId("m"))
+        assert market.status == MarketStatus.OPEN
+        assert market.signals == []
+        assert market.created_at != ""
+
+    def test_add_signal(self):
+        market = Market(id=MarketId("m"))
+        market.add_signal({"sourceId": "a", "probability": 0.7})
+        assert len(market.signals) == 1
+
+    def test_add_signal_rejected_when_resolved(self):
+        market = Market(id=MarketId("m"))
+        market.resolve(True)
+        with pytest.raises(ValueError, match="Cannot add signal"):
+            market.add_signal({"sourceId": "a", "probability": 0.7})
+
+    def test_empty_consensus_reduced_shape(self):
+        """Quirk #8: empty market yields a 4-key doc, not core's empty shape."""
+        result = Market(id=MarketId("m")).compute_consensus()
+        assert result == {
+            "schemaVersion": "1.0.0",
+            "consensus": None,
+            "confidence": 0.0,
+            "marketId": "m",
+        }
+
+    def test_consensus_stamped_and_cached(self):
+        market = Market(id=MarketId("m"))
+        market.add_signal({"sourceId": "a", "probability": 0.7})
+        market.add_signal({"sourceId": "b", "probability": 0.8})
+        result = market.compute_consensus()
+        assert result["consensus"] == pytest.approx(0.75)
+        assert result["marketId"] == "m"
+        assert market.consensus_result is result
+
+    def test_resolve(self):
+        market = Market(id=MarketId("m"))
+        market.resolve(True)
+        assert market.status == MarketStatus.RESOLVED
+        assert market.outcome is True
+        assert market.resolved_at is not None
+
+    def test_closed_status_exists(self):
+        # Quirk #14: CLOSED is defined; nothing transitions to it automatically.
+        assert MarketStatus.CLOSED.value == "closed"
+
+
+class TestMarketStore:
+    def test_create_and_get(self):
+        store = MarketStore()
+        market = store.create_market(MarketId("m"))
+        assert store.get_market(MarketId("m")) is market
+
+    def test_duplicate_create_rejected(self):
+        store = MarketStore()
+        store.create_market(MarketId("m"))
+        with pytest.raises(ValueError, match="already exists"):
+            store.create_market(MarketId("m"))
+
+    def test_get_or_create(self):
+        store = MarketStore()
+        m1 = store.get_or_create(MarketId("m"))
+        assert m1.status == MarketStatus.OPEN
+        assert store.get_or_create(MarketId("m")) is m1
+
+    def test_add_signal_creates_market(self):
+        store = MarketStore()
+        store.add_signal(MarketId("m"), {"sourceId": "a", "probability": 0.5})
+        assert len(store.get_market(MarketId("m")).signals) == 1
+
+    def test_list_by_status(self):
+        store = MarketStore()
+        store.create_market(MarketId("open-1"))
+        store.create_market(MarketId("resolved-1")).resolve(True)
+        open_markets = store.list_markets(status=MarketStatus.OPEN)
+        assert [m.id.value for m in open_markets] == ["open-1"]
+
+    def test_list_by_pattern(self):
+        store = MarketStore()
+        for mid in ("crypto:a", "crypto:b", "sports:a"):
+            store.create_market(MarketId(mid))
+        assert len(store.list_markets(pattern="crypto:*")) == 2
+
+    def test_compute_all_consensus_without_store(self):
+        store = MarketStore()
+        store.add_signal(MarketId("m1"), {"sourceId": "a", "probability": 0.6})
+        store.add_signal(MarketId("m2"), {"sourceId": "b", "probability": 0.8})
+        results = store.compute_all_consensus()
+        assert results["m1"]["consensus"] == pytest.approx(0.6)
+        assert results["m2"]["consensus"] == pytest.approx(0.8)
+
+    def test_compute_all_consensus_with_reliability(self):
+        markets = MarketStore()
+        markets.add_signal(MarketId("m"), {"sourceId": "good", "probability": 1.0})
+        markets.add_signal(MarketId("m"), {"sourceId": "bad", "probability": 0.0})
+        with SQLiteReliabilityStore(":memory:") as rel:
+            for _ in range(5):
+                rel.update_reliability("good", "m", outcome_correct=True)
+                rel.update_reliability("bad", "m", outcome_correct=False)
+            results = markets.compute_all_consensus(rel)
+        # good ≈ 1.0 reliability, bad ≈ 0.0 → consensus pulled toward 1.0
+        assert results["m"]["consensus"] > 0.9
+
+    def test_skips_resolved_markets(self):
+        store = MarketStore()
+        store.add_signal(MarketId("m1"), {"sourceId": "a", "probability": 0.6})
+        store.get_market(MarketId("m1")).resolve(True)
+        assert store.compute_all_consensus() == {}
+
+
+def _resolved_store() -> MarketStore:
+    """agent-a right twice; agent-b right once, wrong once."""
+    store = MarketStore()
+    m1 = store.get_or_create(MarketId("crypto:btc"))
+    m1.add_signal({"sourceId": "agent-a", "probability": 0.8})
+    m1.add_signal({"sourceId": "agent-b", "probability": 0.7})
+    m1.compute_consensus()
+    m1.resolve(True)
+
+    m2 = store.get_or_create(MarketId("crypto:eth"))
+    m2.add_signal({"sourceId": "agent-a", "probability": 0.9})
+    m2.add_signal({"sourceId": "agent-b", "probability": 0.2})
+    m2.compute_consensus()
+    m2.resolve(True)
+    return store
+
+
+class TestCrossMarketAggregator:
+    def test_summarize_sources(self):
+        agg = CrossMarketAggregator(_resolved_store())
+        perf = agg.summarize_sources()
+        assert perf["agent-a"].correct_predictions == 2
+        assert perf["agent-a"].accuracy == 1.0
+        assert perf["agent-b"].correct_predictions == 1
+        assert perf["agent-b"].wrong_predictions == 1
+        assert perf["agent-b"].accuracy == 0.5
+
+    def test_summarize_sources_pattern_filter(self):
+        agg = CrossMarketAggregator(_resolved_store())
+        perf = agg.summarize_sources(patterns=["crypto:btc"])
+        assert perf["agent-a"].total_markets == 1
+
+    def test_boundary_probability_counts_as_true(self):
+        store = MarketStore()
+        m = store.get_or_create(MarketId("m"))
+        m.add_signal({"sourceId": "edge", "probability": 0.5})
+        m.resolve(True)
+        perf = CrossMarketAggregator(store).summarize_sources()
+        assert perf["edge"].correct_predictions == 1  # 0.5 >= 0.5 → True
+
+    def test_summarize_category(self):
+        agg = CrossMarketAggregator(_resolved_store())
+        summary = agg.summarize_category("crypto")
+        assert summary["category"] == "crypto"
+        assert summary["total_markets"] == 2
+        assert summary["resolved"] == 2
+        assert summary["open"] == 0
+
+    def test_aggregate_weighted_average(self):
+        agg = CrossMarketAggregator(_resolved_store())
+        result = agg.aggregate_consensus(["crypto:*"])
+        assert result["marketsIncluded"] == 2
+        assert result["consensus"] is not None
+        assert result["method"] == "weighted_average"
+
+    def test_aggregate_median_upper(self):
+        agg = CrossMarketAggregator(_resolved_store())
+        result = agg.aggregate_consensus(["crypto:*"], method="median")
+        # Two entries → upper median (index len//2 == 1)
+        values = sorted(
+            m.consensus_result["consensus"]
+            for m in _resolved_store().list_markets()
+        )
+        assert result["consensus"] == pytest.approx(values[1])
+
+    def test_aggregate_majority(self):
+        agg = CrossMarketAggregator(_resolved_store())
+        result = agg.aggregate_consensus(["crypto:*"], method="majority")
+        assert result["method"] == "majority"
+        assert 0.0 <= result["consensus"] <= 1.0
+
+    def test_aggregate_unknown_method(self):
+        agg = CrossMarketAggregator(_resolved_store())
+        with pytest.raises(ValueError, match="Unknown aggregation method"):
+            agg.aggregate_consensus(["*"], method="mode")
+
+    def test_aggregate_no_matches(self):
+        agg = CrossMarketAggregator(MarketStore())
+        result = agg.aggregate_consensus(["nothing:*"])
+        assert result["consensus"] is None
+        assert result["marketsIncluded"] == 0
+
+    def test_aggregate_markets_without_cached_consensus(self):
+        store = MarketStore()
+        store.create_market(MarketId("m"))  # no consensus computed
+        result = CrossMarketAggregator(store).aggregate_consensus(["*"])
+        assert result["consensus"] is None
+        assert result["marketsIncluded"] == 1
+
+
+class TestSourcePerformance:
+    def test_accuracy(self):
+        perf = SourcePerformance("a", 10, 7, 3, 0.7)
+        assert perf.accuracy == 0.7
+
+    def test_zero_judged_accuracy(self):
+        assert SourcePerformance("a", 0, 0, 0, 0.5).accuracy == 0.0

@@ -1,0 +1,203 @@
+"""SQLite reliability store — semantics and durability.
+
+Mirrors the reference store coverage (reference: tests/test_reliability.py):
+cold-start non-persistence, capped/clamped updates, confidence growth,
+per-market isolation, sorted listing, reconnect durability, frozen records —
+plus decay-on-read and the dry-run zero-write contract.
+"""
+
+import dataclasses
+from datetime import datetime, timedelta, timezone
+from pathlib import Path
+
+import pytest
+
+from bayesian_consensus_engine_tpu.utils.config import (
+    DEFAULT_CONFIDENCE,
+    DEFAULT_RELIABILITY,
+    MAX_UPDATE_STEP,
+)
+from bayesian_consensus_engine_tpu.state import (
+    ReliabilityRecord,
+    ReliabilityStore,
+    SQLiteReliabilityStore,
+)
+
+
+@pytest.fixture
+def store():
+    with SQLiteReliabilityStore(":memory:") as s:
+        yield s
+
+
+@pytest.fixture
+def file_store(tmp_path: Path):
+    with SQLiteReliabilityStore(tmp_path / "rel.db") as s:
+        yield s
+
+
+class TestColdStart:
+    def test_unseen_source_returns_defaults(self, store):
+        rec = store.get_reliability("nobody", "market-1")
+        assert rec.reliability == DEFAULT_RELIABILITY
+        assert rec.confidence == DEFAULT_CONFIDENCE
+        assert rec.updated_at == ""
+        assert rec.source_id == "nobody"
+        assert rec.market_id == "market-1"
+
+    def test_cold_start_read_does_not_persist(self, store):
+        store.get_reliability("nobody", "market-1")
+        assert store.list_sources() == []
+
+    def test_decayed_cold_start_still_defaults(self, store):
+        rec = store.get_reliability("nobody", "market-1", apply_decay=True)
+        assert rec.reliability == DEFAULT_RELIABILITY
+
+
+class TestOutcomeUpdates:
+    def test_correct_increases(self, store):
+        rec = store.update_reliability("a", "m", outcome_correct=True)
+        assert rec.reliability > DEFAULT_RELIABILITY
+
+    def test_incorrect_decreases(self, store):
+        rec = store.update_reliability("a", "m", outcome_correct=False)
+        assert rec.reliability < DEFAULT_RELIABILITY
+
+    def test_step_capped(self, store):
+        rec = store.update_reliability("a", "m", outcome_correct=True)
+        assert abs(rec.reliability - DEFAULT_RELIABILITY) <= MAX_UPDATE_STEP + 1e-12
+
+    def test_exact_first_step_value(self, store):
+        # raw +0.15 capped to +0.10 → 0.60; confidence 0.25 + 0.75*0.1 = 0.325
+        rec = store.update_reliability("a", "m", outcome_correct=True)
+        assert rec.reliability == pytest.approx(0.60)
+        assert rec.confidence == pytest.approx(0.325)
+
+    def test_clamped_to_zero(self, store):
+        for _ in range(20):
+            rec = store.update_reliability("a", "m", outcome_correct=False)
+        assert rec.reliability >= 0.0
+        assert rec.reliability == pytest.approx(0.0)
+
+    def test_clamped_to_one(self, store):
+        for _ in range(20):
+            rec = store.update_reliability("a", "m", outcome_correct=True)
+        assert rec.reliability <= 1.0
+        assert rec.reliability == pytest.approx(1.0)
+
+    def test_confidence_grows_monotonically_toward_one(self, store):
+        prev = DEFAULT_CONFIDENCE
+        for _ in range(50):
+            rec = store.update_reliability("a", "m", outcome_correct=True)
+            assert rec.confidence > prev or rec.confidence == pytest.approx(1.0)
+            assert rec.confidence <= 1.0
+            prev = rec.confidence
+
+    def test_update_persists(self, store):
+        store.update_reliability("a", "m", outcome_correct=True)
+        rec = store.get_reliability("a", "m")
+        assert rec.updated_at != ""
+        assert rec.reliability == pytest.approx(0.60)
+
+    def test_updates_accumulate(self, store):
+        r1 = store.update_reliability("a", "m", outcome_correct=True).reliability
+        r2 = store.update_reliability("a", "m", outcome_correct=True).reliability
+        assert r2 > r1
+
+    def test_per_market_isolation(self, store):
+        store.update_reliability("a", "m-1", outcome_correct=True)
+        store.update_reliability("a", "m-2", outcome_correct=False)
+        assert store.get_reliability("a", "m-1").reliability > DEFAULT_RELIABILITY
+        assert store.get_reliability("a", "m-2").reliability < DEFAULT_RELIABILITY
+
+    def test_update_applies_to_undecayed_value(self, store):
+        """Decay is read-time only; updates read the stored (undecayed) value."""
+        store.update_reliability("a", "m", outcome_correct=True)  # 0.60 stored
+        # Backdate the row far into the past so decayed != stored.
+        old = (datetime.now(timezone.utc) - timedelta(days=300)).isoformat()
+        store.put_record(ReliabilityRecord("a", "m", 0.60, 0.325, old))
+        decayed = store.get_reliability("a", "m", apply_decay=True).reliability
+        assert decayed < 0.60  # sanity: decay visible on read
+        rec = store.update_reliability("a", "m", outcome_correct=True)
+        assert rec.reliability == pytest.approx(0.70)  # 0.60 + 0.10, not decayed
+
+
+class TestDryRun:
+    def test_compute_update_never_writes(self, store):
+        rec = store.compute_update("a", "m", outcome_correct=True)
+        assert rec.reliability == pytest.approx(0.60)
+        assert store.list_sources() == []
+
+    def test_dry_run_flag_never_writes(self, store):
+        rec = store.update_reliability("a", "m", outcome_correct=True, dry_run=True)
+        assert rec.reliability == pytest.approx(0.60)
+        assert store.list_sources() == []
+        assert store.get_reliability("a", "m").updated_at == ""
+
+
+class TestListSources:
+    def test_empty(self, store):
+        assert store.list_sources() == []
+
+    def test_lists_all(self, store):
+        store.update_reliability("src-b", "m-1", True)
+        store.update_reliability("src-a", "m-2", False)
+        records = store.list_sources()
+        assert {r.source_id for r in records} == {"src-a", "src-b"}
+
+    def test_filter_by_market(self, store):
+        store.update_reliability("a", "m-1", True)
+        store.update_reliability("a", "m-2", True)
+        only = store.list_sources(market_id="m-1")
+        assert len(only) == 1
+        assert only[0].market_id == "m-1"
+
+    def test_sorted_output(self, store):
+        for sid in ("zed", "alpha", "mike"):
+            store.update_reliability(sid, "m", True)
+        ids = [r.source_id for r in store.list_sources()]
+        assert ids == sorted(ids)
+
+
+class TestDurability:
+    def test_survives_reconnect(self, tmp_path: Path):
+        db = tmp_path / "rel.db"
+        with SQLiteReliabilityStore(db) as s:
+            s.update_reliability("a", "m", outcome_correct=True)
+        with SQLiteReliabilityStore(db) as s:
+            rec = s.get_reliability("a", "m")
+            assert rec.reliability > DEFAULT_RELIABILITY
+            assert rec.confidence > DEFAULT_CONFIDENCE
+
+    def test_schema_created_on_new_db(self, tmp_path: Path):
+        import sqlite3
+
+        db = tmp_path / "new.db"
+        SQLiteReliabilityStore(db).close()
+        conn = sqlite3.connect(db)
+        row = conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' AND name='sources'"
+        ).fetchone()
+        conn.close()
+        assert row is not None
+
+    def test_file_store_fixture_works(self, file_store):
+        file_store.update_reliability("a", "m", True)
+        assert len(file_store.list_sources()) == 1
+
+
+class TestRecord:
+    def test_frozen(self):
+        rec = ReliabilityRecord("a", "m", 0.5, 0.25, "")
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            rec.reliability = 0.9  # type: ignore[misc]
+
+    def test_equality(self):
+        assert ReliabilityRecord("a", "m", 0.5, 0.25, "t") == ReliabilityRecord(
+            "a", "m", 0.5, 0.25, "t"
+        )
+
+
+class TestProtocol:
+    def test_sqlite_store_satisfies_interface(self, store):
+        assert isinstance(store, ReliabilityStore)

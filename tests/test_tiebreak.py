@@ -1,0 +1,172 @@
+"""Deterministic tie-break hierarchy (weight density → max reliability →
+smallest prediction) + diagnostics labeling quirks."""
+
+import pytest
+
+from bayesian_consensus_engine_tpu.models.tiebreak import (
+    AgentSignal,
+    DeterministicTieBreaker,
+    TieBreakDiagnostics,
+)
+
+
+class TestAgentSignal:
+    def test_valid(self):
+        s = AgentSignal("a1", 0.75, 0.8, 0.9, 0.7)
+        assert s.agent_id == "a1"
+        assert s.prediction == 0.75
+        assert s.weight == 0.9
+
+    def test_defaults(self):
+        s = AgentSignal("a1", 0.75, 0.8)
+        assert s.weight == 1.0
+        assert s.reliability_score == 0.5
+
+    def test_confidence_bounds(self):
+        with pytest.raises(ValueError, match="confidence must be in"):
+            AgentSignal("a1", 0.5, 1.5)
+        with pytest.raises(ValueError, match="confidence must be in"):
+            AgentSignal("a1", 0.5, -0.1)
+
+    def test_reliability_bounds(self):
+        with pytest.raises(ValueError, match="reliability_score must be in"):
+            AgentSignal("a1", 0.5, 0.5, 1.0, 1.5)
+
+
+class TestResolve:
+    def setup_method(self):
+        self.breaker = DeterministicTieBreaker()
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError, match="empty agent list"):
+            self.breaker.resolve([])
+
+    def test_single_agent(self):
+        pred, diag = self.breaker.resolve([AgentSignal("a1", 0.75, 0.8)])
+        assert pred == 0.75
+        assert diag.method == "single_agent"
+        assert diag.tie_resolved_by == "unanimous"
+        assert diag.confidence_variance == 0.0
+        assert diag.groups == {0.75: {"count": 1}}
+
+    def test_unanimous(self):
+        agents = [
+            AgentSignal("a1", 0.75, 0.8, 0.9, 0.7),
+            AgentSignal("a2", 0.75, 0.75, 0.85, 0.6),
+            AgentSignal("a3", 0.75, 0.70, 0.80, 0.5),
+        ]
+        pred, diag = self.breaker.resolve(agents)
+        assert pred == 0.75
+        assert diag.tie_resolved_by == "unanimous"
+        assert diag.groups[0.75]["count"] == 3
+
+    def test_weight_density_primary(self):
+        agents = [
+            AgentSignal("a1", 0.75, 0.85, 0.9, 0.82),
+            AgentSignal("a2", 0.75, 0.80, 0.85, 0.78),
+            AgentSignal("a3", 0.25, 0.70, 0.6, 0.65),
+            AgentSignal("a4", 0.25, 0.65, 0.55, 0.70),
+            AgentSignal("a5", 0.25, 0.60, 0.50, 0.60),
+        ]
+        pred, diag = self.breaker.resolve(agents)
+        assert pred == 0.75
+        assert diag.tie_resolved_by == "weight_density"
+        assert diag.groups[0.75]["weight_density"] == 0.875
+        assert diag.groups[0.25]["weight_density"] == 0.55
+
+    def test_max_reliability_secondary_still_labeled_weight_density(self):
+        """Quirk #6: decision made by max_reliability, label says weight_density."""
+        agents = [
+            AgentSignal("a1", 0.75, 0.8, 1.0, 0.5),
+            AgentSignal("a2", 0.25, 0.8, 1.0, 0.9),
+        ]
+        pred, diag = self.breaker.resolve(agents)
+        assert pred == 0.25
+        assert diag.tie_resolved_by == "weight_density"
+
+    def test_smallest_prediction_tertiary(self):
+        """Quirk #5: full tie → smallest prediction wins (not lexicographic id)."""
+        agents = [
+            AgentSignal("a1", 0.75, 0.8, 1.0, 0.9),
+            AgentSignal("a2", 0.25, 0.8, 1.0, 0.9),
+        ]
+        pred, diag = self.breaker.resolve(agents)
+        assert pred == 0.25
+        assert diag.tie_resolved_by == "prediction_value_smallest"
+
+    def test_grouping_rounds_to_precision(self):
+        agents = [
+            AgentSignal("a1", 0.7500000001, 0.8),
+            AgentSignal("a2", 0.7500000002, 0.7),
+        ]
+        _pred, diag = self.breaker.resolve(agents)
+        assert list(diag.groups) == [0.75]
+        assert diag.groups[0.75]["count"] == 2
+
+    def test_custom_precision(self):
+        breaker = DeterministicTieBreaker(precision=1)
+        agents = [AgentSignal("a1", 0.74, 0.8), AgentSignal("a2", 0.71, 0.9)]
+        _pred, diag = breaker.resolve(agents)
+        assert list(diag.groups) == [0.7]
+
+    def test_diagnostics_structure(self):
+        agents = [
+            AgentSignal("a1", 0.75, 0.8, 0.9, 0.7),
+            AgentSignal("a2", 0.25, 0.6, 0.5, 0.5),
+        ]
+        _pred, diag = self.breaker.resolve(agents)
+        assert isinstance(diag, TieBreakDiagnostics)
+        assert diag.method == "prioritized_weight_density"
+        for key in ("count", "weight_density", "avg_confidence", "max_reliability"):
+            assert key in diag.groups[0.75]
+        assert diag.confidence_variance > 0
+
+    def test_determinism_under_input_permutation(self):
+        import itertools
+
+        agents = [
+            AgentSignal("a1", 0.3, 0.5, 1.0, 0.4),
+            AgentSignal("a2", 0.6, 0.7, 1.0, 0.4),
+            AgentSignal("a3", 0.9, 0.6, 1.0, 0.4),
+        ]
+        winners = {
+            self.breaker.resolve(list(perm))[0]
+            for perm in itertools.permutations(agents)
+        }
+        assert winners == {0.3}  # full tie → smallest prediction, any order
+
+    def test_matches_reference_implementation_randomized(self):
+        import random
+        import sys
+
+        sys.path.insert(0, "/root/reference/src")
+        try:
+            from bayesian_engine.tiebreak import (
+                AgentSignal as RefSignal,
+                DeterministicTieBreaker as RefBreaker,
+            )
+        except ImportError:
+            pytest.skip("reference not mounted")
+        finally:
+            sys.path.remove("/root/reference/src")
+
+        rng = random.Random(123)
+        ref_breaker = RefBreaker()
+        for _ in range(300):
+            n = rng.randint(1, 12)
+            raw = [
+                (
+                    f"a{i}",
+                    rng.choice([0.2, 0.5, 0.8, rng.random()]),
+                    rng.random(),
+                    rng.choice([1.0, rng.random()]),
+                    rng.choice([0.5, rng.random()]),
+                )
+                for i in range(n)
+            ]
+            ours = self.breaker.resolve([AgentSignal(*a) for a in raw])
+            theirs = ref_breaker.resolve([RefSignal(*a) for a in raw])
+            assert ours[0] == theirs[0]
+            assert ours[1].tie_resolved_by == theirs[1].tie_resolved_by
+            assert ours[1].groups == theirs[1].groups
+            assert ours[1].confidence_variance == theirs[1].confidence_variance
