@@ -1,0 +1,375 @@
+"""Host packing layer + batched array consensus engine.
+
+This is the seam where ragged, string-keyed payloads become dense int32/float
+tensors and back (the "ragged → dense" hard part of the TPU design):
+(market, source) pairs are assigned rows in **sorted-source order within each
+market** so device reductions add floats in the same order the scalar engine
+does, and output documents are rehydrated host-side with the exact reference
+shape. (Long-lived row assignment for persistent state uses
+``utils.interning.IdInterner`` in the tensor store; the per-batch slotting
+here is positional by construction.)
+
+Device work is two kernels from ``ops.consensus``:
+  1. per-pair duplicate-signal mean          (segment-mean over raw signals)
+  2. per-market weighted triple reduction    (Σw, Σp̄w, Σcw → consensus)
+
+The whole batch — any number of markets — is one jit call, replacing the
+reference's per-market Python loop + per-(source, market) SQLite query
+(reference: market.py:200-221, core.py:108-128).
+
+Dtype: float64 when ``jax.config.x64_enabled`` (parity gate), else float32.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.ops.consensus import (
+    pair_mean_from_flat,
+    weighted_sums_from_pairs,
+)
+from bayesian_consensus_engine_tpu.utils.config import (
+    DEFAULT_CONFIDENCE,
+    DEFAULT_RELIABILITY,
+    SCHEMA_VERSION,
+)
+
+from bayesian_consensus_engine_tpu.utils.dtypes import default_float_dtype
+
+#: host lookup: (source_id, market_id) → (reliability, confidence, known)
+ReliabilityLookup = Callable[[str, str], tuple[float, float, bool]]
+
+
+def cold_start_lookup(_sid: str, _mid: str) -> tuple[float, float, bool]:
+    return DEFAULT_RELIABILITY, DEFAULT_CONFIDENCE, False
+
+
+def mapping_lookup(
+    table: Mapping[str, Mapping[str, float]] | None,
+) -> ReliabilityLookup:
+    """Adapt the reference's per-source dict (one market) to a lookup.
+
+    Presence semantics match the scalar engine: a key that exists — even with
+    missing fields — is NOT cold-start (reference: core.py:110-112,167-170).
+    """
+    if table is None:
+        return cold_start_lookup
+
+    def lookup(sid: str, _mid: str) -> tuple[float, float, bool]:
+        entry = table.get(sid)
+        if entry is None:
+            return DEFAULT_RELIABILITY, DEFAULT_CONFIDENCE, False
+        return (
+            entry.get("reliability", DEFAULT_RELIABILITY),
+            entry.get("confidence", DEFAULT_CONFIDENCE),
+            True,
+        )
+
+    return lookup
+
+
+@dataclass(frozen=True)
+class PackedBatch:
+    """Dense packing of M markets' signals, ready for device kernels.
+
+    Pairs are ordered (market row asc, source id asc) — the float-summation
+    order of the scalar engine. ``pair_slice(m)`` gives market *m*'s pairs.
+    """
+
+    market_keys: list[str]            # row → market id
+    pair_market: np.ndarray           # i32[P]
+    pair_source_ids: list[str]        # row → source id (sorted within market)
+    pair_reliability: np.ndarray      # f[P]
+    pair_confidence: np.ndarray       # f[P]
+    pair_known: np.ndarray            # bool[P] — False ⇒ coldStartSources
+    flat_probs: np.ndarray            # f[N] raw signal probabilities
+    flat_pair: np.ndarray             # i32[N]
+    signals_per_market: np.ndarray    # i32[M] raw signal counts (diagnostics)
+    pair_offsets: np.ndarray          # i32[M+1] pair range per market
+
+    @property
+    def num_markets(self) -> int:
+        return len(self.market_keys)
+
+    @property
+    def num_pairs(self) -> int:
+        return len(self.pair_source_ids)
+
+    def pair_slice(self, market_row: int) -> slice:
+        return slice(
+            int(self.pair_offsets[market_row]), int(self.pair_offsets[market_row + 1])
+        )
+
+
+def pack_markets(
+    markets: Sequence[tuple[str, Sequence[Mapping[str, Any]]]],
+    lookup: ReliabilityLookup = cold_start_lookup,
+) -> PackedBatch:
+    """Intern, sort, and flatten raw (market_id, signals) payloads."""
+    dtype = np.float64  # host packing always f64; cast on device transfer
+
+    market_keys: list[str] = []
+    pair_market: list[int] = []
+    pair_source_ids: list[str] = []
+    pair_rel: list[float] = []
+    pair_conf: list[float] = []
+    pair_known: list[bool] = []
+    flat_probs: list[float] = []
+    flat_pair: list[int] = []
+    signals_per_market: list[int] = []
+    pair_offsets: list[int] = [0]
+
+    for market_row, (market_id, signals) in enumerate(markets):
+        market_keys.append(market_id)
+        signals_per_market.append(len(signals))
+
+        by_source: dict[str, list[float]] = {}
+        for signal in signals:
+            by_source.setdefault(signal["sourceId"], []).append(signal["probability"])
+
+        base = len(pair_source_ids)
+        ordered = sorted(by_source)
+        slot_of = {sid: base + i for i, sid in enumerate(ordered)}
+        for sid in ordered:
+            reliability, confidence, known = lookup(sid, market_id)
+            pair_market.append(market_row)
+            pair_source_ids.append(sid)
+            pair_rel.append(reliability)
+            pair_conf.append(confidence)
+            pair_known.append(known)
+
+        # Raw signals in original order → duplicate averaging keeps the
+        # scalar path's left-to-right accumulation order per pair.
+        for signal in signals:
+            flat_probs.append(signal["probability"])
+            flat_pair.append(slot_of[signal["sourceId"]])
+
+        pair_offsets.append(len(pair_source_ids))
+
+    return PackedBatch(
+        market_keys=market_keys,
+        pair_market=np.asarray(pair_market, dtype=np.int32),
+        pair_source_ids=pair_source_ids,
+        pair_reliability=np.asarray(pair_rel, dtype=dtype),
+        pair_confidence=np.asarray(pair_conf, dtype=dtype),
+        pair_known=np.asarray(pair_known, dtype=bool),
+        flat_probs=np.asarray(flat_probs, dtype=dtype),
+        flat_pair=np.asarray(flat_pair, dtype=np.int32),
+        signals_per_market=np.asarray(signals_per_market, dtype=np.int32),
+        pair_offsets=np.asarray(pair_offsets, dtype=np.int32),
+    )
+
+
+@partial(jax.jit, static_argnames=("num_pairs", "num_markets"))
+def _batch_kernel(
+    flat_probs: jax.Array,
+    flat_pair: jax.Array,
+    pair_reliability: jax.Array,
+    pair_confidence: jax.Array,
+    pair_market: jax.Array,
+    num_pairs: int,
+    num_markets: int,
+):
+    """One fused device pass: dedupe-mean → weighted per-market reductions.
+
+    Returns the three per-market sums; the two normalization divides happen
+    host-side in the formatter so golden byte-parity survives XLA's
+    divide→reciprocal-multiply rewrite.
+    """
+    pair_mean = pair_mean_from_flat(flat_probs, flat_pair, num_pairs)
+    total_weight, weighted_prob, weighted_conf = weighted_sums_from_pairs(
+        pair_mean, pair_reliability, pair_confidence, pair_market, num_markets
+    )
+    return pair_mean, total_weight, weighted_prob, weighted_conf
+
+
+def _next_bucket(n: int) -> int:
+    """Round up to a power of two (min 8) so jit compilations are reused
+    across batches instead of recompiling per exact shape."""
+    size = 8
+    while size < n:
+        size *= 2
+    return size
+
+
+def run_packed(batch: PackedBatch):
+    """Execute the batch kernel; returns host numpy results.
+
+    Inputs are padded to power-of-two buckets: padding signals/pairs are
+    routed to a dummy trailing market row with zero reliability, so they
+    cannot perturb real outputs, and results are sliced back to true sizes.
+    """
+    dtype = default_float_dtype()
+    num_markets = batch.num_markets
+    num_pairs = batch.num_pairs
+    markets_pad = _next_bucket(num_markets + 1)   # +1 dummy sink market
+    pairs_pad = _next_bucket(num_pairs + 1)       # +1 dummy sink pair
+    flat_pad = _next_bucket(len(batch.flat_probs))
+
+    def pad(array: np.ndarray, size: int, fill) -> np.ndarray:
+        out = np.full(size, fill, dtype=array.dtype)
+        out[: len(array)] = array
+        return out
+
+    pair_mean, total_weight, weighted_prob, weighted_conf = _batch_kernel(
+        jnp.asarray(pad(batch.flat_probs, flat_pad, 0.0), dtype=dtype),
+        jnp.asarray(pad(batch.flat_pair, flat_pad, pairs_pad - 1)),
+        jnp.asarray(pad(batch.pair_reliability, pairs_pad, 0.0), dtype=dtype),
+        jnp.asarray(pad(batch.pair_confidence, pairs_pad, 0.0), dtype=dtype),
+        jnp.asarray(pad(batch.pair_market, pairs_pad, markets_pad - 1)),
+        num_pairs=pairs_pad,
+        num_markets=markets_pad,
+    )
+    return (
+        np.asarray(pair_mean)[:num_pairs],
+        np.asarray(total_weight)[:num_markets],
+        np.asarray(weighted_prob)[:num_markets],
+        np.asarray(weighted_conf)[:num_markets],
+    )
+
+
+def _format_document(
+    batch: PackedBatch,
+    market_row: int,
+    total_weight: np.ndarray,
+    weighted_prob: np.ndarray,
+    weighted_conf: np.ndarray,
+) -> dict[str, Any]:
+    """Rehydrate one market's reference-shaped output document.
+
+    The two normalization divides happen here, on host Python floats, so the
+    document matches the scalar engine bit-for-bit when the device sums do.
+    """
+    pairs = batch.pair_slice(market_row)
+    tw = float(total_weight[market_row])
+    has_weight = tw != 0  # scalar parity: reference tests == 0 (core.py:131)
+
+    source_weights = []
+    cold_sources = []
+    for p in range(pairs.start, pairs.stop):
+        weight = float(batch.pair_reliability[p])
+        source_weights.append(
+            {
+                "sourceId": batch.pair_source_ids[p],
+                "weight": weight,
+                "normalizedWeight": weight / tw if has_weight else 0.0,
+            }
+        )
+        if not batch.pair_known[p]:
+            cold_sources.append(batch.pair_source_ids[p])
+
+    return {
+        "schemaVersion": SCHEMA_VERSION,
+        "consensus": float(weighted_prob[market_row]) / tw if has_weight else None,
+        "confidence": float(weighted_conf[market_row]) / tw if has_weight else 0.0,
+        "sourceWeights": source_weights,
+        "normalization": {
+            "totalWeight": tw,
+            "sourceCount": pairs.stop - pairs.start,
+        },
+        "diagnostics": {
+            "status": "computed",
+            "sources": int(batch.signals_per_market[market_row]),
+            "uniqueSources": pairs.stop - pairs.start,
+            "coldStartSources": cold_sources,
+        },
+    }
+
+
+def compute_batch_consensus(
+    markets: Sequence[tuple[str, Sequence[Mapping[str, Any]]]],
+    lookup: ReliabilityLookup = cold_start_lookup,
+) -> dict[str, dict[str, Any]]:
+    """Batched consensus over many markets in one device pass.
+
+    Returns ``{market_id: output_document}`` with each document in the
+    reference shape (stamped with ``marketId``, like
+    ``Market.compute_consensus`` — reference: market.py:112-128). Markets
+    with no signals get the reduced 4-key document (reference quirk #8).
+    """
+    live = [(mid, sigs) for mid, sigs in markets if sigs]
+    results: dict[str, dict[str, Any]] = {}
+
+    if live:
+        batch = pack_markets(live, lookup)
+        _pair_mean, total_weight, weighted_prob, weighted_conf = run_packed(batch)
+        for row, market_id in enumerate(batch.market_keys):
+            document = _format_document(
+                batch, row, total_weight, weighted_prob, weighted_conf
+            )
+            document["marketId"] = market_id
+            results[market_id] = document
+
+    for market_id, signals in markets:
+        if not signals:
+            results[market_id] = {
+                "schemaVersion": SCHEMA_VERSION,
+                "consensus": None,
+                "confidence": 0.0,
+                "marketId": market_id,
+            }
+    return results
+
+
+def store_lookup(store) -> ReliabilityLookup:
+    """Adapt a ``ReliabilityStore`` to a packing lookup (decay-on-read).
+
+    ``known`` is always True, matching the scalar market sweep: the reference
+    builds a reliability dict entry for every signalling source, so its sweep
+    never reports coldStartSources (reference: market.py:208-219).
+    """
+
+    def lookup(sid: str, mid: str) -> tuple[float, float, bool]:
+        record = store.get_reliability(sid, mid, apply_decay=True)
+        return record.reliability, record.confidence, True
+
+    return lookup
+
+
+def compute_all_consensus_batched(
+    market_store,
+    reliability_store=None,
+) -> dict[str, dict[str, Any]]:
+    """Batched twin of ``MarketStore.compute_all_consensus``.
+
+    One device pass over every OPEN market instead of the reference's
+    market-by-market Python loop (reference: market.py:200-221). Results are
+    cached on each ``Market`` (``consensus_result``) exactly like the scalar
+    sweep.
+    """
+    from bayesian_consensus_engine_tpu.models.market import MarketStatus
+
+    open_markets = market_store.list_markets(status=MarketStatus.OPEN)
+    payload = [(str(m.id), m.signals) for m in open_markets]
+    lookup = (
+        store_lookup(reliability_store)
+        if reliability_store is not None
+        else cold_start_lookup
+    )
+    results = compute_batch_consensus(payload, lookup)
+    for market in open_markets:
+        if market.signals:
+            market.consensus_result = results[str(market.id)]
+    return results
+
+
+def compute_consensus_jax(
+    signals: Sequence[Mapping[str, Any]],
+    source_reliability: Mapping[str, Mapping[str, float]] | None = None,
+) -> dict[str, Any]:
+    """Single-market array-path twin of ``engine.compute_consensus``.
+
+    Same output shape and key order; float values come from the device
+    kernels (float64 under x64, float32 otherwise). The scalar path remains
+    the byte-exact contract; this path is property-tested against it.
+    """
+    batch = pack_markets([("_", signals)], mapping_lookup(source_reliability))
+    _pair_mean, total_weight, weighted_prob, weighted_conf = run_packed(batch)
+    return _format_document(batch, 0, total_weight, weighted_prob, weighted_conf)

@@ -13,8 +13,9 @@ reference's own docs claim lexicographic-source-id; code wins — quirk #5),
 and ``tie_resolved_by`` reports "weight_density" even when the decision fell
 to max_reliability (quirk #6).
 
-A vectorised argsort formulation for huge agent pools lives in
-``ops.tiebreak``; this module stays stdlib-only.
+This module stays stdlib-only; tie-breaking is diagnostics-sized, not the
+hot loop (SURVEY §7: grouping-by-rounded-prediction is a sort/unique problem
+that can stay host-side).
 """
 
 from __future__ import annotations

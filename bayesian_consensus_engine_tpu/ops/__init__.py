@@ -1,0 +1,31 @@
+"""Pure JAX kernels: batched consensus, decay, and outcome update.
+
+Dtype-polymorphic (float32 for throughput, float64 under x64 for parity
+gates); no host state, no string ids — everything indexed int32.
+"""
+
+from bayesian_consensus_engine_tpu.ops.consensus import (
+    consensus_from_block,
+    consensus_from_pairs,
+    pair_mean_from_flat,
+)
+from bayesian_consensus_engine_tpu.ops.decay import (
+    decay_factor,
+    decayed_reliability,
+    decayed_reliability_at,
+)
+from bayesian_consensus_engine_tpu.ops.update import (
+    masked_outcome_update,
+    outcome_update,
+)
+
+__all__ = [
+    "consensus_from_block",
+    "consensus_from_pairs",
+    "pair_mean_from_flat",
+    "decay_factor",
+    "decayed_reliability",
+    "decayed_reliability_at",
+    "masked_outcome_update",
+    "outcome_update",
+]

@@ -1,0 +1,71 @@
+"""Vectorised half-life decay — jnp twin of ``state.decay``.
+
+Same contract as the scalar path (reference: decay.py:31-100):
+
+    factor  = 2^(-elapsed / half_life)           (1 where elapsed <= 0)
+    decayed = clamp(floor + (r - floor)·factor, floor, 1)
+
+Decay is a pure read-time transform over the whole reliability tensor; the
+stored tensor stays undecayed (reference quirk #9). Timestamps live on
+device as float epoch-days (conversion at the host boundary in
+``utils.timeconv``), so "elapsed days" is one subtract.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.utils.config import (
+    DECAY_HALF_LIFE_DAYS,
+    DECAY_MINIMUM,
+)
+
+Array = jax.Array
+
+
+def decay_factor(
+    elapsed_days: Array,
+    half_life_days: float = DECAY_HALF_LIFE_DAYS,
+) -> Array:
+    """Elementwise ``2^(-t/h)``, pinned to 1 for non-positive elapsed time."""
+    factor = jnp.exp2(-elapsed_days / half_life_days)
+    return jnp.where(elapsed_days > 0, factor, 1.0)
+
+
+def decayed_reliability(
+    reliability: Array,
+    elapsed_days: Array,
+    half_life_days: float = DECAY_HALF_LIFE_DAYS,
+    floor: float = DECAY_MINIMUM,
+) -> Array:
+    """Elementwise decay toward *floor*, clamped to [floor, 1].
+
+    Entries with non-positive elapsed time pass through UNCLAMPED, matching
+    the scalar path's early return (reference: decay.py:90-91) — a stored
+    value below the floor is only pulled up once time actually passes.
+    """
+    factor = jnp.exp2(-elapsed_days / half_life_days)
+    decayed = floor + (reliability - floor) * factor
+    clamped = jnp.clip(decayed, floor, 1.0)
+    return jnp.where(elapsed_days > 0, clamped, reliability)
+
+
+def decayed_reliability_at(
+    reliability: Array,
+    updated_days: Array,     # f[...] epoch-days of last update; <=0 ⇒ never
+    now_days: Array,         # scalar or broadcastable epoch-days "now"
+    exists: Array,           # bool[...] row-exists mask
+    half_life_days: float = DECAY_HALF_LIFE_DAYS,
+    floor: float = DECAY_MINIMUM,
+) -> Array:
+    """Read-time decay for tensor-store rows.
+
+    Non-existent rows and rows with no timestamp are returned untouched
+    (cold-start / "never updated" semantics, reference: decay.py:122-123,
+    reliability.py:115).
+    """
+    elapsed = jnp.maximum(now_days - updated_days, 0.0)
+    eligible = exists & (updated_days > 0)
+    decayed = decayed_reliability(reliability, elapsed, half_life_days, floor)
+    return jnp.where(eligible, decayed, reliability)

@@ -1,0 +1,64 @@
+"""Vectorised post-outcome reliability update — jnp twin of ``state.update_math``.
+
+Contract per element (reference: reliability.py:142-183):
+
+    delta        = clip(base_lr · direction, ±max_step)
+    reliability' = clamp(reliability + delta, 0, 1)
+    confidence'  = min(1, confidence + (1 - confidence)·growth)
+
+Updates read the UNDECAYED stored values (decay is read-only — reference
+quirk #9). The batched form takes a boolean ``correct`` vector so one kernel
+launch settles any number of outcomes; ``masked`` variants leave untouched
+rows bit-identical for scatter-free full-tensor updates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.utils.config import (
+    BASE_LEARNING_RATE,
+    CONFIDENCE_GROWTH_RATE,
+    MAX_UPDATE_STEP,
+)
+
+Array = jax.Array
+
+
+def outcome_update(
+    reliability: Array,
+    confidence: Array,
+    correct: Array,          # bool[...]
+) -> tuple[Array, Array]:
+    """Elementwise update for every entry; returns (reliability', confidence')."""
+    direction = jnp.where(correct, 1.0, -1.0)
+    delta = jnp.clip(BASE_LEARNING_RATE * direction, -MAX_UPDATE_STEP, MAX_UPDATE_STEP)
+    new_rel = jnp.clip(reliability + delta, 0.0, 1.0)
+    new_conf = jnp.minimum(
+        1.0, confidence + (1.0 - confidence) * CONFIDENCE_GROWTH_RATE
+    )
+    return new_rel, new_conf
+
+
+def masked_outcome_update(
+    reliability: Array,
+    confidence: Array,
+    correct: Array,          # bool[...] outcome direction per entry
+    touched: Array,          # bool[...] which entries actually get an outcome
+    now_days: Array,         # scalar epoch-days to stamp touched rows with
+    updated_days: Array,     # f[...] existing stamps
+) -> tuple[Array, Array, Array]:
+    """Full-tensor update applying outcomes only where ``touched``.
+
+    Untouched rows pass through unchanged (bit-identical), so this runs as a
+    dense fused kernel over the whole HBM tensor — no scatter — and is the
+    form the sharded cycle jits with buffer donation.
+    Returns (reliability', confidence', updated_days').
+    """
+    new_rel, new_conf = outcome_update(reliability, confidence, correct)
+    return (
+        jnp.where(touched, new_rel, reliability),
+        jnp.where(touched, new_conf, confidence),
+        jnp.where(touched, now_days, updated_days),
+    )

@@ -1,0 +1,31 @@
+"""Device mesh + sharded consensus/update cycle (shard_map over ICI)."""
+
+from bayesian_consensus_engine_tpu.parallel.mesh import (
+    MARKETS_AXIS,
+    SOURCES_AXIS,
+    block_sharding,
+    make_mesh,
+    market_sharding,
+    shard_block,
+    shard_market,
+)
+from bayesian_consensus_engine_tpu.parallel.sharded import (
+    CycleResult,
+    MarketBlockState,
+    build_cycle,
+    init_block_state,
+)
+
+__all__ = [
+    "MARKETS_AXIS",
+    "SOURCES_AXIS",
+    "block_sharding",
+    "make_mesh",
+    "market_sharding",
+    "shard_block",
+    "shard_market",
+    "CycleResult",
+    "MarketBlockState",
+    "build_cycle",
+    "init_block_state",
+]

@@ -1,0 +1,66 @@
+"""Device-mesh construction for the (markets × sources) workload.
+
+The framework's scale dimension is data (M markets × S sources), so the mesh
+has two logical axes:
+
+  * ``markets`` — pure data parallelism; no communication in the cycle.
+  * ``sources`` — splits each market's source slots; the per-market weight
+    normalisation (Σw, Σp̄w, Σcw) becomes a ``psum`` over this axis riding
+    ICI.
+
+Default policy puts all devices on ``markets`` (the reductions stay local);
+a 2-D mesh is for the regime where one market's source row outgrows a single
+device's VMEM/HBM arithmetic intensity (the 10k-source stress config).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MARKETS_AXIS = "markets"
+SOURCES_AXIS = "sources"
+
+
+def make_mesh(
+    shape: Optional[tuple[int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(markets, sources)`` mesh over *devices*.
+
+    ``shape=None`` → all devices on the markets axis (``(n, 1)``).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices), 1)
+    m_size, s_size = shape
+    if m_size * s_size != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {m_size * s_size} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices).reshape(m_size, s_size)
+    return Mesh(grid, (MARKETS_AXIS, SOURCES_AXIS))
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (M, K)-blocked tensors: rows over markets, cols over sources."""
+    return NamedSharding(mesh, PartitionSpec(MARKETS_AXIS, SOURCES_AXIS))
+
+
+def market_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for per-market (M,) vectors (replicated over sources)."""
+    return NamedSharding(mesh, PartitionSpec(MARKETS_AXIS))
+
+
+def shard_block(array: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place a blocked (M, K) array onto the mesh."""
+    return jax.device_put(array, block_sharding(mesh))
+
+
+def shard_market(array: jax.Array, mesh: Mesh) -> jax.Array:
+    """Place a per-market (M,) array onto the mesh."""
+    return jax.device_put(array, market_sharding(mesh))

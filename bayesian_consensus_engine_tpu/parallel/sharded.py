@@ -1,0 +1,147 @@
+"""Mesh-sharded consensus + reliability-update cycle — the framework's
+training-step equivalent.
+
+One jitted step runs, for every market in the batch simultaneously
+(replacing the reference's per-market loop + per-row SQLite I/O,
+reference: market.py:200-221 / reliability.py:185-231):
+
+  1. read-time decay of the reliability block          (elementwise)
+  2. reliability-weighted consensus                    (reduce over sources)
+  3. per-(source, market) outcome correctness          (elementwise)
+  4. capped post-outcome update of the UNDECAYED state (elementwise)
+
+State is an (M, K)-blocked :class:`MarketBlockState` pytree resident in HBM;
+``donate=True`` lets XLA update it in place. Under ``shard_map`` the blocks
+shard over a ``(markets, sources)`` mesh; the only communication is one
+``psum`` over the sources axis for the three weight sums — everything else is
+embarrassingly parallel over ICI-free elementwise work.
+
+Cold-start semantics: slots that signal but have no stored state weigh in at
+the cold-start defaults (reference: core.py:110-112) and get their first
+stored values from the update, matching scalar behaviour.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from bayesian_consensus_engine_tpu.ops.decay import decayed_reliability_at
+from bayesian_consensus_engine_tpu.ops.update import masked_outcome_update
+from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS
+from bayesian_consensus_engine_tpu.utils.config import (
+    DEFAULT_CONFIDENCE,
+    DEFAULT_RELIABILITY,
+)
+
+
+class MarketBlockState(NamedTuple):
+    """HBM-resident per-(market, source-slot) reliability state, (M, K)."""
+
+    reliability: jax.Array   # f[M, K] stored (undecayed) reliability
+    confidence: jax.Array    # f[M, K]
+    updated_days: jax.Array  # f[M, K] relative epoch-days of last update (0 ⇒ never)
+    exists: jax.Array        # bool[M, K] row-exists mask
+
+
+class CycleResult(NamedTuple):
+    state: MarketBlockState
+    consensus: jax.Array      # f[M] (NaN where total weight is 0)
+    confidence: jax.Array     # f[M]
+    total_weight: jax.Array   # f[M]
+
+
+def _cycle_math(
+    probs: jax.Array,        # f[M, K] per-slot mean probability
+    mask: jax.Array,         # bool[M, K] slot has a signal
+    outcome: jax.Array,      # bool[M] resolved market outcome
+    state: MarketBlockState,
+    now_days: jax.Array,     # scalar, relative epoch-days
+    axis_name: str | None,
+) -> CycleResult:
+    """The full cycle on one shard; psum over *axis_name* if sharded."""
+    # 1. decay is a read transform; cold slots read the cold-start prior.
+    stored = decayed_reliability_at(
+        state.reliability, state.updated_days, now_days, state.exists
+    )
+    read_rel = jnp.where(state.exists, stored, DEFAULT_RELIABILITY)
+    read_conf = jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE)
+
+    # 2. weighted sums along the (possibly sharded) sources axis.
+    w = jnp.where(mask, read_rel, 0.0)
+    total_weight = jnp.sum(w, axis=-1)
+    weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=-1)
+    weighted_conf = jnp.sum(jnp.where(mask, read_conf, 0.0) * w, axis=-1)
+    if axis_name is not None:
+        total_weight = jax.lax.psum(total_weight, axis_name)
+        weighted_prob = jax.lax.psum(weighted_prob, axis_name)
+        weighted_conf = jax.lax.psum(weighted_conf, axis_name)
+
+    has_weight = total_weight != 0  # scalar parity: reference tests == 0 (core.py:131)
+    safe_total = jnp.where(has_weight, total_weight, 1.0)
+    consensus = jnp.where(has_weight, weighted_prob / safe_total, jnp.nan)
+    confidence_out = jnp.where(has_weight, weighted_conf / safe_total, 0.0)
+
+    # 3. binary correctness: predicted-true iff p >= 0.5 (reference:
+    #    market.py:296-303), judged against the market outcome.
+    correct = (probs >= 0.5) == outcome[:, None]
+
+    # 4. capped update on the UNDECAYED stored state; only signalling slots.
+    new_rel, new_conf, new_updated = masked_outcome_update(
+        state.reliability,
+        jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE),
+        correct,
+        mask,
+        now_days,
+        state.updated_days,
+    )
+    # A cold slot's update starts from the cold-start prior, not stored 0.5*:
+    # stored reliability already defaults to DEFAULT_RELIABILITY at init, so
+    # reliability needs no special-casing; exists flips on for touched slots.
+    new_state = MarketBlockState(
+        reliability=new_rel,
+        confidence=new_conf,
+        updated_days=new_updated,
+        exists=state.exists | mask,
+    )
+    return CycleResult(new_state, consensus, confidence_out, total_weight)
+
+
+def build_cycle(mesh: Mesh | None = None, donate: bool = True):
+    """Compile the consensus+update cycle, optionally sharded over *mesh*.
+
+    Returns ``cycle(probs, mask, outcome, state, now_days) -> CycleResult``.
+    With a mesh, blocked inputs shard as (markets, sources) and per-market
+    outputs as (markets,); the sources-axis reduction is a single psum.
+    """
+    if mesh is None:
+        fn = partial(_cycle_math, axis_name=None)
+    else:
+        block = P(MARKETS_AXIS, SOURCES_AXIS)
+        market = P(MARKETS_AXIS)
+        state_spec = MarketBlockState(block, block, block, block)
+        fn = shard_map(
+            partial(_cycle_math, axis_name=SOURCES_AXIS),
+            mesh=mesh,
+            in_specs=(block, block, market, state_spec, P()),
+            out_specs=CycleResult(state_spec, market, market, market),
+        )
+    return jax.jit(fn, donate_argnums=(3,) if donate else ())
+
+
+def init_block_state(
+    num_markets: int, num_slots: int, dtype=jnp.float32
+) -> MarketBlockState:
+    """Fresh all-cold state block (every slot at the cold-start prior)."""
+    shape = (num_markets, num_slots)
+    return MarketBlockState(
+        reliability=jnp.full(shape, DEFAULT_RELIABILITY, dtype=dtype),
+        confidence=jnp.full(shape, DEFAULT_CONFIDENCE, dtype=dtype),
+        updated_days=jnp.zeros(shape, dtype=dtype),
+        exists=jnp.zeros(shape, dtype=bool),
+    )

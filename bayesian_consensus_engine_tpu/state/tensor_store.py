@@ -1,0 +1,401 @@
+"""Device-tensor reliability store — the HBM-resident state backend.
+
+The reference keeps reliability in SQLite and pays one query per
+(source, market) per read (reference: market.py:213-215 — the M×S scaling
+wall). Here the same state lives as flat arrays indexed by interned pair row:
+
+    reliability f[R]   confidence f[R]   updated_days f[R]   exists bool[R]
+
+with a host sidecar (pair interner, ISO timestamp strings) for everything
+string- or contract-shaped. Three access tiers:
+
+  1. **Record API** — drop-in :class:`~.sqlite_store.ReliabilityStore`
+     parity (get/update/list/dry-run/cold-start semantics, scalar host math
+     → bit-identical to the SQLite backend).
+  2. **Batch API** — ``batch_get_reliability`` / ``batch_update_reliability``:
+     one vectorised kernel over any number of pairs.
+  3. **Device tier** — ``device_state()`` exports the pytree consumed by the
+     jitted consensus+update+decay cycle (``parallel.sharded``); ``absorb()``
+     writes a mutated pytree back. This is what bench/TPU paths use so state
+     never leaves HBM between cycles.
+
+Durability: SQLite import/export (``from_sqlite`` / ``flush_to_sqlite``)
+keeps on-disk checkpoints byte-compatible with the reference's DB files —
+the SQLite file *is* the checkpoint format (SURVEY §5).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence, Union
+from pathlib import Path
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu.utils.config import (
+    DECAY_HALF_LIFE_DAYS,
+    DECAY_MINIMUM,
+    DEFAULT_CONFIDENCE,
+    DEFAULT_RELIABILITY,
+)
+from bayesian_consensus_engine_tpu.state.decay import (
+    apply_reliability_decay,
+    days_since_update,
+)
+from bayesian_consensus_engine_tpu.state.records import ReliabilityRecord
+from bayesian_consensus_engine_tpu.state.update_math import (
+    apply_outcome,
+    apply_outcome_batch,
+    utc_now_iso,
+)
+from bayesian_consensus_engine_tpu.utils.interning import IdInterner
+from bayesian_consensus_engine_tpu.utils.timeconv import (
+    NEVER,
+    iso_to_days,
+    now_days,
+)
+
+_GROW = 2
+_MIN_CAPACITY = 64
+
+
+class DeviceReliabilityState(NamedTuple):
+    """Pytree of device arrays — the HBM-resident state the kernels consume.
+
+    ``updated_days`` is epoch-days relative to ``epoch0`` (small magnitudes →
+    float32-safe elapsed-time subtraction on TPU); ``epoch0`` rides along as
+    a static float.
+    """
+
+    reliability: "np.ndarray"
+    confidence: "np.ndarray"
+    updated_days: "np.ndarray"
+    exists: "np.ndarray"
+
+
+class TensorReliabilityStore:
+    """Reliability scores in flat tensors with interned (source, market) rows."""
+
+    def __init__(self, capacity: int = _MIN_CAPACITY) -> None:
+        capacity = max(capacity, _MIN_CAPACITY)
+        self._pairs = IdInterner()  # (source_id, market_id) → row
+        self._rel = np.full(capacity, DEFAULT_RELIABILITY, dtype=np.float64)
+        self._conf = np.full(capacity, DEFAULT_CONFIDENCE, dtype=np.float64)
+        self._days = np.full(capacity, NEVER, dtype=np.float64)
+        self._exists = np.zeros(capacity, dtype=bool)
+        self._iso: List[str] = []
+        self._device_cache = None  # (DeviceReliabilityState, epoch0)
+
+    # -- row management ------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._pairs)
+
+    def _ensure_capacity(self, needed: int) -> None:
+        if needed <= len(self._rel):
+            return
+        new_cap = len(self._rel)
+        while new_cap < needed:
+            new_cap *= _GROW
+
+        def grow(array: np.ndarray, fill) -> np.ndarray:
+            out = np.full(new_cap, fill, dtype=array.dtype)
+            out[: len(array)] = array
+            return out
+
+        self._rel = grow(self._rel, DEFAULT_RELIABILITY)
+        self._conf = grow(self._conf, DEFAULT_CONFIDENCE)
+        self._days = grow(self._days, NEVER)
+        self._exists = grow(self._exists, False)
+
+    def _row_for(self, source_id: str, market_id: str) -> int:
+        """Row for a pair, allocating (but NOT marking existing) if new."""
+        before = len(self._pairs)
+        row = self._pairs.intern((source_id, market_id))
+        if row == before:  # freshly allocated
+            self._iso.append("")
+            self._ensure_capacity(row + 1)
+        return row
+
+    def _invalidate(self) -> None:
+        self._device_cache = None
+
+    # -- record API (ReliabilityStore protocol) ------------------------------
+
+    def get_reliability(
+        self,
+        source_id: str,
+        market_id: str,
+        apply_decay: bool = False,
+    ) -> ReliabilityRecord:
+        """Scalar read; cold-start defaults (never allocating) when absent."""
+        row = self._pairs.get((source_id, market_id))
+        if row < 0 or not self._exists[row]:
+            return ReliabilityRecord(
+                source_id=source_id,
+                market_id=market_id,
+                reliability=DEFAULT_RELIABILITY,
+                confidence=DEFAULT_CONFIDENCE,
+                updated_at="",
+            )
+        reliability = float(self._rel[row])
+        updated_at = self._iso[row]
+        if apply_decay and updated_at:
+            elapsed = days_since_update(updated_at)
+            if elapsed > 0:
+                reliability = apply_reliability_decay(
+                    reliability, elapsed, DECAY_HALF_LIFE_DAYS, DECAY_MINIMUM
+                )
+        return ReliabilityRecord(
+            source_id=source_id,
+            market_id=market_id,
+            reliability=reliability,
+            confidence=float(self._conf[row]),
+            updated_at=updated_at,
+        )
+
+    def compute_update(
+        self,
+        source_id: str,
+        market_id: str,
+        outcome_correct: bool,
+    ) -> ReliabilityRecord:
+        """Dry-run update math on the undecayed stored value; zero writes."""
+        current = self.get_reliability(source_id, market_id)
+        new_rel, new_conf = apply_outcome(
+            current.reliability, current.confidence, outcome_correct
+        )
+        return ReliabilityRecord(
+            source_id=source_id,
+            market_id=market_id,
+            reliability=new_rel,
+            confidence=new_conf,
+            updated_at=utc_now_iso(),
+        )
+
+    def update_reliability(
+        self,
+        source_id: str,
+        market_id: str,
+        outcome_correct: bool,
+        dry_run: bool = False,
+    ) -> ReliabilityRecord:
+        record = self.compute_update(source_id, market_id, outcome_correct)
+        if dry_run:
+            return record
+        self.put_record(record)
+        return record
+
+    def put_record(self, record: ReliabilityRecord) -> None:
+        """Upsert a fully-specified record (import/seed/flush-back path)."""
+        row = self._row_for(record.source_id, record.market_id)
+        self._rel[row] = record.reliability
+        self._conf[row] = record.confidence
+        self._days[row] = iso_to_days(record.updated_at)
+        self._exists[row] = True
+        self._iso[row] = record.updated_at
+        self._invalidate()
+
+    def list_sources(self, market_id: Optional[str] = None) -> List[ReliabilityRecord]:
+        selected = [
+            (key, row)
+            for key, row in self._pairs.items()
+            if self._exists[row] and (market_id is None or key[1] == market_id)
+        ]
+        selected.sort(key=lambda item: item[0])  # (source_id, market_id) order
+        return [
+            ReliabilityRecord(
+                source_id=key[0],
+                market_id=key[1],
+                reliability=float(self._rel[row]),
+                confidence=float(self._conf[row]),
+                updated_at=self._iso[row],
+            )
+            for key, row in selected
+        ]
+
+    def close(self) -> None:
+        """No external resources; present for store-API parity."""
+
+    def __enter__(self) -> "TensorReliabilityStore":
+        return self
+
+    def __exit__(self, *exc_info: object) -> None:
+        self.close()
+
+    # -- batch API -----------------------------------------------------------
+
+    def rows_for_pairs(
+        self, pairs: Sequence[tuple[str, str]], allocate: bool = True
+    ) -> np.ndarray:
+        """Intern pairs → int32 rows (−1 for unknown when not allocating)."""
+        if allocate:
+            return np.asarray([self._row_for(s, m) for s, m in pairs], dtype=np.int32)
+        return np.asarray(
+            [self._pairs.get((s, m)) for s, m in pairs], dtype=np.int32
+        )
+
+    def batch_get_reliability(
+        self,
+        pairs: Sequence[tuple[str, str]],
+        apply_decay: bool = False,
+        now: Optional[float] = None,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Vectorised read → (reliability, confidence, exists) arrays.
+
+        Decay (when requested) is evaluated at the single instant ``now``
+        (epoch-days; defaults to current time) for every pair — unlike the
+        per-query wall clock of the SQLite path, a batch is self-consistent.
+        """
+        rows = self.rows_for_pairs(pairs, allocate=False)
+        valid = rows >= 0
+        safe = np.where(valid, rows, 0)
+        exists = self._exists[safe] & valid
+        rel = np.where(exists, self._rel[safe], DEFAULT_RELIABILITY)
+        conf = np.where(exists, self._conf[safe], DEFAULT_CONFIDENCE)
+        if apply_decay:
+            stamp = np.where(exists, self._days[safe], NEVER)
+            current = now_days() if now is None else now
+            elapsed = np.maximum(current - stamp, 0.0)
+            eligible = exists & (stamp > NEVER) & (elapsed > 0)
+            factor = np.exp2(-elapsed / DECAY_HALF_LIFE_DAYS)
+            decayed = np.clip(
+                DECAY_MINIMUM + (rel - DECAY_MINIMUM) * factor, DECAY_MINIMUM, 1.0
+            )
+            rel = np.where(eligible, decayed, rel)
+        return rel, conf, exists
+
+    def batch_update_reliability(
+        self,
+        pairs: Sequence[tuple[str, str]],
+        correct: Sequence[bool],
+    ) -> None:
+        """Vectorised post-outcome update for any number of pairs.
+
+        Same per-element math as the scalar path (undecayed read, capped
+        delta, clamped, confidence growth); every touched row is stamped with
+        one shared timestamp. Duplicate pairs in one call apply once (last
+        direction wins), unlike sequential scalar calls — split the call if
+        sequential semantics are needed.
+        """
+        rows = self.rows_for_pairs(pairs, allocate=True)
+        correct_arr = np.asarray(correct, dtype=bool)
+        stamp_iso = utc_now_iso()
+        stamp_days = iso_to_days(stamp_iso)
+
+        new_rel, new_conf = apply_outcome_batch(
+            self._rel[rows], self._conf[rows], correct_arr
+        )
+        self._rel[rows] = new_rel
+        self._conf[rows] = new_conf
+        self._days[rows] = stamp_days
+        self._exists[rows] = True
+        for row in rows:
+            self._iso[row] = stamp_iso
+        self._invalidate()
+
+    # -- device tier ---------------------------------------------------------
+
+    def device_state(self, dtype=None):
+        """Materialise the HBM pytree (cached until the next host write).
+
+        Returns ``(DeviceReliabilityState, epoch0)`` where ``updated_days``
+        is relative to ``epoch0`` so float32 elapsed-time subtraction keeps
+        ~seconds resolution.
+        """
+        import jax.numpy as jnp
+
+        from bayesian_consensus_engine_tpu.utils.dtypes import default_float_dtype
+
+        if self._device_cache is not None:
+            return self._device_cache
+
+        dtype = dtype or default_float_dtype()
+        used = len(self._pairs)
+        stamps = self._days[:used]
+        live = stamps[stamps > NEVER]
+        epoch0 = float(live.min()) - 1.0 if live.size else 0.0
+        relative = np.where(stamps > NEVER, stamps - epoch0, 0.0)
+
+        state = DeviceReliabilityState(
+            reliability=jnp.asarray(self._rel[:used], dtype=dtype),
+            confidence=jnp.asarray(self._conf[:used], dtype=dtype),
+            updated_days=jnp.asarray(relative, dtype=dtype),
+            exists=jnp.asarray(self._exists[:used]),
+        )
+        self._device_cache = (state, epoch0)
+        return self._device_cache
+
+    def absorb(self, state: DeviceReliabilityState, epoch0: float) -> None:
+        """Write a mutated device pytree back into host-authoritative state.
+
+        Rows whose timestamp changed get a fresh ISO string derived from the
+        device stamp; all other sidecar strings are preserved exactly (so an
+        import→export round trip without updates is byte-identical).
+        """
+        from bayesian_consensus_engine_tpu.utils.timeconv import days_to_iso
+
+        used = len(self._pairs)
+        new_rel = np.asarray(state.reliability, dtype=np.float64)
+        new_conf = np.asarray(state.confidence, dtype=np.float64)
+        new_days_rel = np.asarray(state.updated_days, dtype=np.float64)
+        new_exists = np.asarray(state.exists, dtype=bool)
+        if len(new_rel) != used:
+            raise ValueError(
+                f"device state has {len(new_rel)} rows, store has {used}"
+            )
+        new_days = np.where(new_days_rel > 0, new_days_rel + epoch0, NEVER)
+
+        # The device may run float32; an untouched row's value round-trips
+        # through f32 and must NOT clobber the exact f64 host value. Overwrite
+        # only where the value changed *in device precision*.
+        device_dtype = np.asarray(state.reliability).dtype
+
+        def merge(host: np.ndarray, new: np.ndarray) -> np.ndarray:
+            changed = new != host.astype(device_dtype)
+            return np.where(changed, new.astype(np.float64), host)
+
+        # A row's stamp changed iff its relative device stamp differs from the
+        # host stamp re-expressed relative to epoch0 (in device precision).
+        host_relative = np.where(
+            self._days[:used] > NEVER, self._days[:used] - epoch0, 0.0
+        ).astype(device_dtype)
+        stamps_changed = np.asarray(state.updated_days) != host_relative
+
+        self._rel[:used] = merge(self._rel[:used], new_rel)
+        self._conf[:used] = merge(self._conf[:used], new_conf)
+        self._days[:used] = np.where(stamps_changed, new_days, self._days[:used])
+        self._exists[:used] = new_exists
+        for row in np.nonzero(stamps_changed)[0]:
+            self._iso[row] = days_to_iso(float(self._days[row]))
+        self._invalidate()
+
+    # -- durability (SQLite checkpoint format) -------------------------------
+
+    @classmethod
+    def from_sqlite(cls, db_path: Union[str, Path]) -> "TensorReliabilityStore":
+        """Load a reference-format SQLite DB into tensors (checkpoint resume)."""
+        from bayesian_consensus_engine_tpu.state.sqlite_store import (
+            SQLiteReliabilityStore,
+        )
+
+        store = cls()
+        with SQLiteReliabilityStore(db_path) as sqlite_store:
+            for record in sqlite_store.list_sources():
+                store.put_record(record)
+        return store
+
+    def flush_to_sqlite(self, db_path: Union[str, Path]) -> int:
+        """Write all existing rows into a reference-format SQLite DB.
+
+        Returns the number of rows written. The file is readable by the
+        reference CLI/store unchanged (checkpoint save).
+        """
+        from bayesian_consensus_engine_tpu.state.sqlite_store import (
+            SQLiteReliabilityStore,
+        )
+
+        records = self.list_sources()
+        with SQLiteReliabilityStore(db_path) as sqlite_store:
+            for record in records:
+                sqlite_store.put_record(record)
+        return len(records)

@@ -36,6 +36,26 @@ def apply_outcome(
     return new_reliability, new_confidence
 
 
+def apply_outcome_batch(reliability, confidence, correct):
+    """Vectorised (numpy) twin of :func:`apply_outcome` over arrays.
+
+    Same formula, elementwise; used by the tensor store's batch update. The
+    jnp twin for jitted device code is ``ops.update.outcome_update``.
+    """
+    import numpy as np
+
+    delta = np.clip(
+        BASE_LEARNING_RATE * np.where(np.asarray(correct, dtype=bool), 1.0, -1.0),
+        -MAX_UPDATE_STEP,
+        MAX_UPDATE_STEP,
+    )
+    new_reliability = np.clip(reliability + delta, 0.0, 1.0)
+    new_confidence = np.minimum(
+        1.0, confidence + (1.0 - confidence) * CONFIDENCE_GROWTH_RATE
+    )
+    return new_reliability, new_confidence
+
+
 def utc_now_iso() -> str:
     """Timestamp format stored in ``updated_at`` (reference: reliability.py:175)."""
     return datetime.now(timezone.utc).isoformat()

@@ -1,0 +1,15 @@
+"""Single policy point for the framework's floating dtype.
+
+float64 when JAX x64 is enabled (parity gates against the scalar engine),
+float32 otherwise (TPU throughput). Imported lazily so the scalar path never
+pays for JAX.
+"""
+
+from __future__ import annotations
+
+
+def default_float_dtype():
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.float64 if jax.config.x64_enabled else jnp.float32

@@ -1,0 +1,63 @@
+"""Id interning — the host boundary between ids and device row indices.
+
+TPUs compute over dense int32 indices, not strings. Source/market ids (or
+(source, market) pair keys) are interned once at ingest into stable rows;
+every device-side structure (reliability tensors, packed signal blocks) is
+keyed by row index, and ids are rehydrated only when formatting output
+documents. Determinism requirements from the output contract (sorted source
+ids, stable ``coldStartSources``) are satisfied on the host from the index
+maps, never on device. The tensor store keys rows by (source_id, market_id)
+tuples through this class.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Hashable, Iterable, List, TypeVar
+
+K = TypeVar("K", bound=Hashable)
+
+
+class IdInterner:
+    """Bidirectional key ↔ row map with first-seen row assignment."""
+
+    __slots__ = ("_to_row", "_to_id")
+
+    def __init__(self) -> None:
+        self._to_row: Dict[Hashable, int] = {}
+        self._to_id: List[Hashable] = []
+
+    def __len__(self) -> int:
+        return len(self._to_id)
+
+    def __contains__(self, identifier: Hashable) -> bool:
+        return identifier in self._to_row
+
+    def intern(self, identifier: Hashable) -> int:
+        """Return the row for *identifier*, assigning the next row if new."""
+        row = self._to_row.get(identifier)
+        if row is None:
+            row = len(self._to_id)
+            self._to_row[identifier] = row
+            self._to_id.append(identifier)
+        return row
+
+    def intern_all(self, identifiers: Iterable[Hashable]) -> List[int]:
+        return [self.intern(i) for i in identifiers]
+
+    def lookup(self, identifier: Hashable) -> int:
+        """Row for an already-interned id; raises KeyError if unknown."""
+        return self._to_row[identifier]
+
+    def get(self, identifier: Hashable, default: int = -1) -> int:
+        return self._to_row.get(identifier, default)
+
+    def id_of(self, row: int) -> Hashable:
+        return self._to_id[row]
+
+    def ids(self) -> List[Hashable]:
+        """All interned keys in row order (a copy)."""
+        return list(self._to_id)
+
+    def items(self):
+        """(key, row) pairs."""
+        return self._to_row.items()

@@ -1,0 +1,71 @@
+"""Measure the reference implementation's CPU throughput for the driver metric.
+
+The reference publishes no benchmarks (BASELINE.md), so the yardstick is its
+own hot path measured here: per-market consensus via
+``MarketStore.compute_all_consensus`` with decayed per-(source, market)
+SQLite reads, plus one ``update_reliability`` per (source, market) pair —
+one full "consensus + reliability-update cycle" over the batch
+(reference: market.py:200-221, reliability.py:185-231).
+
+Usage:  python scripts/measure_reference_baseline.py [markets] [sources_per_market]
+
+Prints markets/sec and the extrapolated cycles/sec at 1M markets — the
+constant baked into bench.py (re-run this script to refresh it).
+"""
+
+import random
+import sys
+import time
+
+sys.path.insert(0, "/root/reference/src")
+
+from bayesian_engine.market import MarketId, MarketStore  # noqa: E402
+from bayesian_engine.reliability import SQLiteReliabilityStore  # noqa: E402
+
+
+def measure(num_markets: int = 500, sources_per_market: int = 16) -> dict:
+    rng = random.Random(0)
+    store = MarketStore()
+    rel = SQLiteReliabilityStore(":memory:")
+    universe = [f"src-{i:05d}" for i in range(10_000)]
+
+    for m in range(num_markets):
+        mid = MarketId(f"market-{m:07d}")
+        for sid in rng.sample(universe, sources_per_market):
+            store.add_signal(mid, {"sourceId": sid, "probability": rng.random()})
+
+    # Warm the reliability table so reads hit real rows (worst case for the
+    # reference: every read pays decay + SQLite).
+    for market in store.list_markets():
+        for signal in market.signals:
+            rel.update_reliability(signal["sourceId"], str(market.id), True)
+
+    start = time.perf_counter()
+    results = store.compute_all_consensus(rel)
+    for market in store.list_markets():
+        outcome = rng.random() < 0.5
+        for signal in market.signals:
+            rel.update_reliability(
+                signal["sourceId"],
+                str(market.id),
+                (signal["probability"] >= 0.5) == outcome,
+            )
+    elapsed = time.perf_counter() - start
+
+    assert len(results) == num_markets
+    markets_per_sec = num_markets / elapsed
+    return {
+        "markets": num_markets,
+        "sources_per_market": sources_per_market,
+        "elapsed_s": elapsed,
+        "markets_per_sec": markets_per_sec,
+        "cycles_per_sec_at_1M": markets_per_sec / 1_000_000,
+    }
+
+
+if __name__ == "__main__":
+    markets = int(sys.argv[1]) if len(sys.argv) > 1 else 500
+    spm = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    out = measure(markets, spm)
+    for key, value in out.items():
+        print(f"{key}: {value}")

@@ -1,21 +1,24 @@
 """Test harness configuration.
 
-Force JAX onto the host CPU with 8 virtual devices BEFORE jax is imported
-anywhere, so mesh/sharding tests exercise real multi-device code paths
-without TPU hardware — the TPU analogue of the reference's use of SQLite
-":memory:" for hermetic store tests (reference: tests/test_reliability.py:24-29).
+Force JAX onto the host CPU with 8 virtual devices so mesh/sharding tests
+exercise real multi-device code paths without TPU hardware — the TPU analogue
+of the reference's use of SQLite ":memory:" for hermetic store tests
+(reference: tests/test_reliability.py:24-29).
+
+NOTE: env-var overrides (JAX_PLATFORMS / XLA_FLAGS) do NOT work here: this
+machine's ``sitecustomize`` imports jax at interpreter startup with
+JAX_PLATFORMS=axon already set, so the only effective override is
+``jax.config.update`` before the first backend use. TPU float64 emulation is
+inexact; the f64 parity gates REQUIRE the real CPU backend.
 """
 
-import os
 import sys
 import pathlib
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 # Make the repo root importable when tests run without an installed package.
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))

@@ -1,0 +1,226 @@
+"""Array path vs scalar path: property-based parity.
+
+The scalar engine is the byte-exact contract; the batched JAX path must match
+it to float64 tolerance on arbitrary inputs (and exactly on the golden
+fixture under x64). This is the CPU↔TPU parity gate of SURVEY.md §7 step 3.
+"""
+
+import json
+import math
+import pathlib
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+enable_x64 = jax.enable_x64
+
+from bayesian_consensus_engine_tpu.core import compute_consensus
+from bayesian_consensus_engine_tpu.core.batch import (
+    compute_batch_consensus,
+    compute_consensus_jax,
+    mapping_lookup,
+)
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+def random_case(rng: random.Random, max_sources: int = 9):
+    n = rng.randint(1, 25)
+    signals = [
+        {
+            "sourceId": f"s-{rng.randint(0, max_sources)}",
+            "probability": round(rng.random(), 6),
+        }
+        for _ in range(n)
+    ]
+    reliability = {}
+    for sid in {s["sourceId"] for s in signals}:
+        roll = rng.random()
+        if roll < 0.4:
+            reliability[sid] = {
+                "reliability": round(rng.random(), 6),
+                "confidence": round(rng.random(), 6),
+            }
+        elif roll < 0.5:
+            reliability[sid] = {}  # present-but-partial: not cold-start
+    return signals, (reliability or None)
+
+
+def assert_documents_close(array_doc, scalar_doc, rel_tol=1e-12):
+    assert array_doc["schemaVersion"] == scalar_doc["schemaVersion"]
+    for key in ("consensus", "confidence"):
+        a, b = array_doc[key], scalar_doc[key]
+        if b is None:
+            assert a is None
+        else:
+            assert math.isclose(a, b, rel_tol=rel_tol, abs_tol=1e-12), (key, a, b)
+    assert [w["sourceId"] for w in array_doc["sourceWeights"]] == [
+        w["sourceId"] for w in scalar_doc["sourceWeights"]
+    ]
+    for aw, bw in zip(array_doc["sourceWeights"], scalar_doc["sourceWeights"]):
+        assert math.isclose(aw["weight"], bw["weight"], rel_tol=rel_tol)
+        assert math.isclose(
+            aw["normalizedWeight"], bw["normalizedWeight"], rel_tol=rel_tol, abs_tol=1e-12
+        )
+    assert math.isclose(
+        array_doc["normalization"]["totalWeight"],
+        scalar_doc["normalization"]["totalWeight"],
+        rel_tol=rel_tol,
+    )
+    assert array_doc["normalization"]["sourceCount"] == scalar_doc["normalization"]["sourceCount"]
+    assert array_doc["diagnostics"] == scalar_doc["diagnostics"]
+
+
+class TestSingleMarketParity:
+    def test_randomized_parity_x64(self):
+        rng = random.Random(2026)
+        with enable_x64():
+            for _ in range(150):
+                signals, reliability = random_case(rng)
+                array_doc = compute_consensus_jax(signals, reliability)
+                scalar_doc = compute_consensus(signals, reliability)
+                assert_documents_close(array_doc, scalar_doc)
+
+    def test_randomized_parity_f32_loose(self):
+        rng = random.Random(7)
+        for _ in range(30):
+            signals, reliability = random_case(rng)
+            array_doc = compute_consensus_jax(signals, reliability)
+            scalar_doc = compute_consensus(signals, reliability)
+            if scalar_doc["consensus"] is not None:
+                assert math.isclose(
+                    array_doc["consensus"], scalar_doc["consensus"], rel_tol=1e-5
+                )
+
+    def test_golden_fixture_exact_under_x64(self):
+        fixture = json.loads((FIXTURES / "golden_regression.json").read_text())
+        with enable_x64():
+            array_doc = compute_consensus_jax(fixture["input"]["signals"])
+        assert array_doc == fixture["expectedOutput"]
+
+    def test_zero_weight_market(self):
+        with enable_x64():
+            doc = compute_consensus_jax(
+                [{"sourceId": "a", "probability": 0.7}],
+                {"a": {"reliability": 0.0, "confidence": 0.3}},
+            )
+        assert doc["consensus"] is None
+        assert doc["confidence"] == 0.0
+        assert doc["sourceWeights"][0]["normalizedWeight"] == 0.0
+
+    def test_negative_total_weight_matches_scalar(self):
+        # Out-of-domain but accepted input: the scalar engine (like the
+        # reference, core.py:131) only special-cases total_weight == 0, so a
+        # negative total divides through — both backends must agree.
+        signals = [{"sourceId": "a", "probability": 0.7}]
+        rel = {"a": {"reliability": -1.0, "confidence": 0.5}}
+        with enable_x64():
+            array_doc = compute_consensus_jax(signals, rel)
+        scalar_doc = compute_consensus(signals, rel)
+        assert array_doc["consensus"] == scalar_doc["consensus"] == pytest.approx(0.7)
+        assert array_doc["normalization"]["totalWeight"] == -1.0
+
+    def test_duplicate_signals_deduped(self):
+        with enable_x64():
+            doc = compute_consensus_jax(
+                [
+                    {"sourceId": "a", "probability": 0.2},
+                    {"sourceId": "a", "probability": 0.4},
+                    {"sourceId": "b", "probability": 0.9},
+                ]
+            )
+        assert doc["consensus"] == pytest.approx(0.6)
+        assert doc["diagnostics"]["sources"] == 3
+        assert doc["diagnostics"]["uniqueSources"] == 2
+
+    def test_backend_kwarg_routes_to_array_path(self):
+        signals = [{"sourceId": "a", "probability": 0.6}]
+        doc = compute_consensus(signals, backend="jax")
+        assert doc["consensus"] == pytest.approx(0.6, rel=1e-6)
+        # empty-signals stays on the scalar path regardless of backend
+        assert compute_consensus([], backend="tpu")["diagnostics"]["status"] == "no_signals"
+
+
+class TestBatchedMarkets:
+    def test_many_markets_one_pass(self):
+        rng = random.Random(99)
+        markets = []
+        expected = {}
+        with enable_x64():
+            for m in range(40):
+                signals, reliability = random_case(rng)
+                mid = f"market-{m}"
+                markets.append((mid, signals))
+                doc = compute_consensus(signals, reliability)
+                doc["marketId"] = mid
+                expected[mid] = (doc, reliability)
+
+            # Batched lookup dispatches per market id.
+            tables = {mid: rel for mid, (_doc, rel) in expected.items()}
+
+            def lookup(sid, mid):
+                return mapping_lookup(tables[mid])(sid, mid)
+
+            results = compute_batch_consensus(markets, lookup)
+
+        assert set(results) == set(expected)
+        for mid, (scalar_doc, _rel) in expected.items():
+            assert_documents_close(results[mid], scalar_doc)
+            assert results[mid]["marketId"] == mid
+
+    def test_market_sweep_matches_scalar_sweep(self):
+        from bayesian_consensus_engine_tpu.core.batch import (
+            compute_all_consensus_batched,
+        )
+        from bayesian_consensus_engine_tpu.models import MarketId, MarketStore
+        from bayesian_consensus_engine_tpu.state import SQLiteReliabilityStore
+
+        rng = random.Random(5)
+        markets = MarketStore()
+        with SQLiteReliabilityStore(":memory:") as rel:
+            for m in range(12):
+                mid = MarketId(f"sweep-{m}")
+                for _ in range(rng.randint(0, 6)):
+                    sid = f"s{rng.randint(0, 4)}"
+                    markets.add_signal(
+                        mid, {"sourceId": sid, "probability": round(rng.random(), 4)}
+                    )
+                    if rng.random() < 0.5:
+                        rel.update_reliability(sid, str(mid), rng.random() < 0.5)
+                markets.get_or_create(mid)
+
+            scalar = markets.compute_all_consensus(rel)
+            with enable_x64():
+                batched = compute_all_consensus_batched(markets, rel)
+
+        assert set(scalar) == set(batched)
+        for mid, scalar_doc in scalar.items():
+            batched_doc = batched[mid]
+            if "normalization" not in scalar_doc:  # empty-market reduced doc
+                assert batched_doc == scalar_doc
+                continue
+            # decay-on-read runs at slightly different wall-clock instants in
+            # the two sweeps; allow for that drift only.
+            assert_documents_close(batched_doc, scalar_doc, rel_tol=1e-6)
+            assert batched_doc["diagnostics"]["coldStartSources"] == []
+
+    def test_empty_market_reduced_document(self):
+        results = compute_batch_consensus([("empty", [])])
+        assert results["empty"] == {
+            "schemaVersion": "1.0.0",
+            "consensus": None,
+            "confidence": 0.0,
+            "marketId": "empty",
+        }
+
+    def test_mixed_empty_and_live(self):
+        results = compute_batch_consensus(
+            [
+                ("live", [{"sourceId": "a", "probability": 0.8}]),
+                ("empty", []),
+            ]
+        )
+        assert results["live"]["consensus"] == pytest.approx(0.8, rel=1e-6)
+        assert "normalization" not in results["empty"]

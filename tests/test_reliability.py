@@ -24,10 +24,21 @@ from bayesian_consensus_engine_tpu.state import (
 )
 
 
-@pytest.fixture
-def store():
-    with SQLiteReliabilityStore(":memory:") as s:
-        yield s
+# The semantic battery runs against BOTH backends: the durable SQLite store
+# and the HBM tensor store must be observably interchangeable (the
+# ReliabilityStore seam the TPU path is gated behind).
+@pytest.fixture(params=["sqlite", "tensor"])
+def store(request):
+    if request.param == "sqlite":
+        with SQLiteReliabilityStore(":memory:") as s:
+            yield s
+    else:
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        with TensorReliabilityStore() as s:
+            yield s
 
 
 @pytest.fixture

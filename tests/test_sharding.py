@@ -1,0 +1,182 @@
+"""Sharded cycle on a virtual 8-device CPU mesh.
+
+Every mesh topology must produce the same numbers as the unsharded cycle,
+and the cycle itself must preserve the scalar engine's semantics (decay on
+read, update undecayed state, cold-start priors, 0.5-threshold correctness).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.parallel import (
+    MarketBlockState,
+    build_cycle,
+    init_block_state,
+    make_mesh,
+)
+from bayesian_consensus_engine_tpu.utils.config import (
+    DEFAULT_CONFIDENCE,
+    DEFAULT_RELIABILITY,
+)
+
+
+M, K = 32, 16  # divisible by every mesh shape used below
+
+
+def _random_inputs(seed=0):
+    rng = np.random.default_rng(seed)
+    probs = jnp.asarray(rng.random((M, K)), dtype=jnp.float32)
+    mask = jnp.asarray(rng.random((M, K)) < 0.7)
+    outcome = jnp.asarray(rng.random(M) < 0.5)
+    state = MarketBlockState(
+        reliability=jnp.asarray(rng.uniform(0.1, 1.0, (M, K)), dtype=jnp.float32),
+        confidence=jnp.asarray(rng.uniform(0.0, 1.0, (M, K)), dtype=jnp.float32),
+        updated_days=jnp.asarray(
+            rng.choice([0.0, 5.0, 40.0, 400.0], (M, K)), dtype=jnp.float32
+        ),
+        exists=jnp.asarray(rng.random((M, K)) < 0.6),
+    )
+    now = jnp.float32(401.0)
+    return probs, mask, outcome, state, now
+
+
+def _as_np(result):
+    return jax.tree.map(np.asarray, result)
+
+
+class TestMeshTopologies:
+    def test_eight_devices_available(self):
+        assert jax.device_count() == 8
+
+    @pytest.mark.parametrize("shape", [(8, 1), (4, 2), (2, 4), (1, 8)])
+    def test_sharded_matches_unsharded(self, shape):
+        inputs = _random_inputs()
+        baseline = _as_np(build_cycle(mesh=None, donate=False)(*inputs))
+        mesh = make_mesh(shape)
+        sharded = _as_np(build_cycle(mesh=mesh, donate=False)(*inputs))
+
+        np.testing.assert_allclose(
+            sharded.consensus, baseline.consensus, rtol=1e-6, equal_nan=True
+        )
+        np.testing.assert_allclose(sharded.confidence, baseline.confidence, rtol=1e-6)
+        np.testing.assert_allclose(
+            sharded.total_weight, baseline.total_weight, rtol=1e-6
+        )
+        for field in MarketBlockState._fields:
+            np.testing.assert_allclose(
+                getattr(sharded.state, field),
+                getattr(baseline.state, field),
+                rtol=1e-6,
+                err_msg=field,
+            )
+
+    def test_bad_mesh_shape_rejected(self):
+        with pytest.raises(ValueError, match="needs 6 devices"):
+            make_mesh((3, 2))
+
+
+class TestCycleSemantics:
+    def test_cold_batch_consensus_is_unweighted_mean(self):
+        probs = jnp.full((4, 8), 0.7, dtype=jnp.float32)
+        mask = jnp.ones((4, 8), dtype=bool)
+        outcome = jnp.ones(4, dtype=bool)
+        state = init_block_state(4, 8)
+        result = build_cycle(donate=False)(probs, mask, outcome, state, jnp.float32(10.0))
+        np.testing.assert_allclose(np.asarray(result.consensus), 0.7, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(result.confidence), DEFAULT_CONFIDENCE, rtol=1e-6)
+
+    def test_update_moves_reliability_by_capped_step(self):
+        probs = jnp.array([[0.9, 0.2]], dtype=jnp.float32)  # slot0 right, slot1 wrong
+        mask = jnp.ones((1, 2), dtype=bool)
+        outcome = jnp.array([True])
+        state = init_block_state(1, 2)
+        result = build_cycle(donate=False)(probs, mask, outcome, state, jnp.float32(1.0))
+        rel = np.asarray(result.state.reliability)
+        assert rel[0, 0] == pytest.approx(DEFAULT_RELIABILITY + 0.10, rel=1e-6)
+        assert rel[0, 1] == pytest.approx(DEFAULT_RELIABILITY - 0.10, rel=1e-6)
+        assert np.asarray(result.state.exists).all()
+
+    def test_boundary_probability_counts_correct(self):
+        # p == 0.5 predicts True (reference: market.py:299).
+        probs = jnp.array([[0.5]], dtype=jnp.float32)
+        mask = jnp.ones((1, 1), dtype=bool)
+        state = init_block_state(1, 1)
+        up = build_cycle(donate=False)(
+            probs, mask, jnp.array([True]), state, jnp.float32(1.0)
+        )
+        assert float(up.state.reliability[0, 0]) > DEFAULT_RELIABILITY
+
+    def test_decay_applies_to_read_not_to_update_base(self):
+        # Stored 0.8 updated 30 (half-life) days ago: consensus sees 0.45,
+        # but a correct outcome updates 0.8 → 0.9 (undecayed base).
+        state = MarketBlockState(
+            reliability=jnp.array([[0.8]], dtype=jnp.float32),
+            confidence=jnp.array([[0.5]], dtype=jnp.float32),
+            updated_days=jnp.array([[10.0]], dtype=jnp.float32),
+            exists=jnp.array([[True]]),
+        )
+        probs = jnp.array([[0.9]], dtype=jnp.float32)
+        mask = jnp.ones((1, 1), dtype=bool)
+        result = build_cycle(donate=False)(
+            probs, mask, jnp.array([True]), state, jnp.float32(40.0)
+        )
+        assert float(result.total_weight[0]) == pytest.approx(0.45, rel=1e-5)
+        assert float(result.state.reliability[0, 0]) == pytest.approx(0.9, rel=1e-6)
+        assert float(result.state.updated_days[0, 0]) == pytest.approx(40.0)
+
+    def test_masked_slots_untouched(self):
+        state = init_block_state(1, 4)
+        probs = jnp.array([[0.9, 0.9, 0.9, 0.9]], dtype=jnp.float32)
+        mask = jnp.array([[True, False, True, False]])
+        result = build_cycle(donate=False)(
+            probs, mask, jnp.array([True]), state, jnp.float32(1.0)
+        )
+        exists = np.asarray(result.state.exists)
+        np.testing.assert_array_equal(exists, mask)
+        rel = np.asarray(result.state.reliability)
+        assert rel[0, 1] == DEFAULT_RELIABILITY  # untouched
+        assert rel[0, 0] == pytest.approx(0.6, rel=1e-6)
+
+    def test_zero_weight_market_nan_consensus(self):
+        probs = jnp.zeros((1, 2), dtype=jnp.float32)
+        mask = jnp.zeros((1, 2), dtype=bool)  # no signals at all
+        state = init_block_state(1, 2)
+        result = build_cycle(donate=False)(
+            probs, mask, jnp.array([True]), state, jnp.float32(1.0)
+        )
+        assert np.isnan(float(result.consensus[0]))
+        assert float(result.confidence[0]) == 0.0
+
+    def test_cycle_composes_over_steps(self):
+        """Two consecutive correct outcomes drive reliability up two steps."""
+        cycle = build_cycle(donate=False)
+        probs = jnp.array([[0.9]], dtype=jnp.float32)
+        mask = jnp.ones((1, 1), dtype=bool)
+        state = init_block_state(1, 1)
+        r1 = cycle(probs, mask, jnp.array([True]), state, jnp.float32(1.0))
+        r2 = cycle(probs, mask, jnp.array([True]), r1.state, jnp.float32(2.0))
+        assert float(r2.state.reliability[0, 0]) == pytest.approx(0.7, rel=1e-6)
+        assert float(r2.state.confidence[0, 0]) == pytest.approx(
+            0.25 + 0.75 * 0.1 + (1 - 0.325) * 0.1 + 0.0, rel=1e-4
+        ) or float(r2.state.confidence[0, 0]) == pytest.approx(0.3925, rel=1e-5)
+
+
+class TestDonation:
+    def test_donated_state_buffer_reused(self):
+        mesh = make_mesh((8, 1))
+        cycle = build_cycle(mesh=mesh, donate=True)
+        probs, mask, outcome, state, now = _random_inputs(1)
+        from bayesian_consensus_engine_tpu.parallel import shard_block, shard_market
+
+        state = MarketBlockState(*(shard_block(x, mesh) for x in state))
+        result = cycle(
+            shard_block(probs, mesh), shard_block(mask, mesh),
+            shard_market(outcome, mesh), state, now,
+        )
+        # Donated input buffers are invalidated after the call.
+        with pytest.raises(RuntimeError):
+            _ = np.asarray(state.reliability)
+        assert np.isfinite(np.asarray(result.state.reliability)).all()

@@ -1,0 +1,180 @@
+"""Tensor-store specifics: batch ops, SQLite checkpoint round-trip, device tier.
+
+(The shared record-API semantics battery runs in test_reliability.py against
+both backends.)
+"""
+
+import random
+from datetime import datetime, timedelta, timezone
+
+import numpy as np
+import pytest
+
+from bayesian_consensus_engine_tpu.state import (
+    ReliabilityRecord,
+    SQLiteReliabilityStore,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import TensorReliabilityStore
+from bayesian_consensus_engine_tpu.utils.timeconv import iso_to_days
+
+
+def _populated(n_sources=7, n_markets=5, seed=3) -> TensorReliabilityStore:
+    rng = random.Random(seed)
+    store = TensorReliabilityStore()
+    for s in range(n_sources):
+        for m in range(n_markets):
+            if rng.random() < 0.6:
+                for _ in range(rng.randint(1, 4)):
+                    store.update_reliability(f"s{s}", f"m{m}", rng.random() < 0.5)
+    return store
+
+
+class TestBatchGet:
+    def test_matches_scalar_reads(self):
+        store = _populated()
+        pairs = [(f"s{s}", f"m{m}") for s in range(8) for m in range(6)]  # incl. unknown
+        rel, conf, exists = store.batch_get_reliability(pairs)
+        for i, (sid, mid) in enumerate(pairs):
+            record = store.get_reliability(sid, mid)
+            assert rel[i] == record.reliability
+            assert conf[i] == record.confidence
+            assert exists[i] == (record.updated_at != "")
+
+    def test_decayed_batch_matches_scalar_at_same_instant(self):
+        store = TensorReliabilityStore()
+        now = datetime(2026, 7, 1, tzinfo=timezone.utc)
+        for age, sid in ((0, "fresh"), (30, "month"), (400, "ancient")):
+            stamp = (now - timedelta(days=age)).isoformat()
+            store.put_record(ReliabilityRecord(sid, "m", 0.8, 0.5, stamp))
+        pairs = [("fresh", "m"), ("month", "m"), ("ancient", "m"), ("ghost", "m")]
+        rel, _conf, exists = store.batch_get_reliability(
+            pairs, apply_decay=True, now=iso_to_days(now.isoformat())
+        )
+        assert rel[0] == pytest.approx(0.8)            # no elapsed time
+        assert rel[1] == pytest.approx(0.45)           # one half-life to floor
+        assert rel[2] == pytest.approx(0.10, abs=1e-3) # pinned at floor
+        assert rel[3] == 0.5 and not exists[3]         # cold start
+
+    def test_batch_get_never_allocates(self):
+        store = TensorReliabilityStore()
+        store.batch_get_reliability([("ghost", "m")] * 3)
+        assert store.list_sources() == []
+
+
+class TestBatchUpdate:
+    def test_matches_scalar_update_loop(self):
+        rng = random.Random(11)
+        scalar_store = TensorReliabilityStore()
+        batch_store = TensorReliabilityStore()
+        # Unique pairs per round (duplicates have documented last-wins semantics).
+        pairs = [(f"s{i}", f"m{i % 3}") for i in range(20)]
+        for _round in range(4):
+            corrects = [rng.random() < 0.5 for _ in pairs]
+            for (sid, mid), ok in zip(pairs, corrects):
+                scalar_store.update_reliability(sid, mid, ok)
+            batch_store.batch_update_reliability(pairs, corrects)
+        for sid, mid in pairs:
+            a = scalar_store.get_reliability(sid, mid)
+            b = batch_store.get_reliability(sid, mid)
+            assert a.reliability == b.reliability
+            assert a.confidence == b.confidence
+
+    def test_shared_timestamp_within_batch(self):
+        store = TensorReliabilityStore()
+        store.batch_update_reliability([("a", "m"), ("b", "m")], [True, False])
+        records = store.list_sources()
+        assert records[0].updated_at == records[1].updated_at != ""
+
+    def test_scale_10k_pairs(self):
+        store = TensorReliabilityStore()
+        pairs = [(f"s{i}", f"m{i % 100}") for i in range(10_000)]
+        store.batch_update_reliability(pairs, [True] * len(pairs))
+        rel, _conf, exists = store.batch_get_reliability(pairs)
+        assert exists.all()
+        assert np.allclose(rel, 0.6)
+
+
+class TestSQLiteRoundTrip:
+    def test_flush_and_reload_identical(self, tmp_path):
+        store = _populated()
+        db = tmp_path / "ckpt.db"
+        written = store.flush_to_sqlite(db)
+        assert written == len(store.list_sources())
+        reloaded = TensorReliabilityStore.from_sqlite(db)
+        assert reloaded.list_sources() == store.list_sources()
+
+    def test_checkpoint_readable_by_sqlite_backend(self, tmp_path):
+        store = _populated()
+        db = tmp_path / "ckpt.db"
+        store.flush_to_sqlite(db)
+        with SQLiteReliabilityStore(db) as sqlite_store:
+            assert sqlite_store.list_sources() == store.list_sources()
+
+    def test_sqlite_written_by_reference_semantics_loads(self, tmp_path):
+        db = tmp_path / "ref.db"
+        with SQLiteReliabilityStore(db) as sqlite_store:
+            sqlite_store.update_reliability("a", "m", True)
+            expected = sqlite_store.list_sources()
+        tensor_store = TensorReliabilityStore.from_sqlite(db)
+        assert tensor_store.list_sources() == expected
+
+
+class TestDeviceTier:
+    def test_device_state_round_trip_unchanged(self):
+        store = _populated()
+        before = store.list_sources()
+        state, epoch0 = store.device_state()
+        store.absorb(state, epoch0)
+        assert store.list_sources() == before  # byte-identical sidecar preserved
+
+    def test_device_state_values_match_host(self):
+        store = _populated()
+        state, _epoch0 = store.device_state()
+        for i, (sid, mid) in enumerate(store._pairs.ids()):
+            record = store.get_reliability(sid, mid)
+            assert float(state.reliability[i]) == pytest.approx(
+                record.reliability, rel=1e-6
+            )
+            assert bool(state.exists[i]) == (record.updated_at != "")
+
+    def test_absorb_updated_rows_get_fresh_timestamps(self):
+        import jax.numpy as jnp
+
+        store = TensorReliabilityStore()
+        store.update_reliability("a", "m", True)
+        old_iso = store.get_reliability("a", "m").updated_at
+        state, epoch0 = store.device_state()
+        bumped = state._replace(
+            reliability=jnp.full_like(state.reliability, 0.9),
+            updated_days=state.updated_days + 1.0,
+        )
+        store.absorb(bumped, epoch0)
+        record = store.get_reliability("a", "m")
+        assert record.reliability == pytest.approx(0.9, rel=1e-6)
+        assert record.updated_at != old_iso
+        assert iso_to_days(record.updated_at) > iso_to_days(old_iso)
+
+    def test_device_cache_invalidated_on_write(self):
+        store = _populated()
+        state1, _ = store.device_state()
+        store.update_reliability("new-source", "new-market", True)
+        state2, _ = store.device_state()
+        assert len(state2.reliability) == len(state1.reliability) + 1
+
+
+class TestCrossBackendEquivalence:
+    def test_same_history_same_records_modulo_timestamps(self):
+        rng = random.Random(42)
+        sqlite_store = SQLiteReliabilityStore(":memory:")
+        tensor_store = TensorReliabilityStore()
+        for _ in range(120):
+            sid, mid = f"s{rng.randint(0, 5)}", f"m{rng.randint(0, 3)}"
+            ok = rng.random() < 0.5
+            sqlite_store.update_reliability(sid, mid, ok)
+            tensor_store.update_reliability(sid, mid, ok)
+        a = sqlite_store.list_sources()
+        b = tensor_store.list_sources()
+        assert [(r.source_id, r.market_id, r.reliability, r.confidence) for r in a] == [
+            (r.source_id, r.market_id, r.reliability, r.confidence) for r in b
+        ]
+        sqlite_store.close()
