@@ -13,6 +13,7 @@ from bayesian_consensus_engine_tpu.parallel.sharded import (
     CycleResult,
     MarketBlockState,
     build_cycle,
+    build_cycle_loop,
     init_block_state,
 )
 
@@ -27,5 +28,6 @@ __all__ = [
     "CycleResult",
     "MarketBlockState",
     "build_cycle",
+    "build_cycle_loop",
     "init_block_state",
 ]

@@ -57,14 +57,20 @@ class CycleResult(NamedTuple):
 
 
 def _cycle_math(
-    probs: jax.Array,        # f[M, K] per-slot mean probability
+    probs: jax.Array,        # f[M, K] per-slot mean probability ((K, M) if slots_axis=0)
     mask: jax.Array,         # bool[M, K] slot has a signal
     outcome: jax.Array,      # bool[M] resolved market outcome
     state: MarketBlockState,
     now_days: jax.Array,     # scalar, relative epoch-days
     axis_name: str | None,
+    slots_axis: int = -1,
 ) -> CycleResult:
-    """The full cycle on one shard; psum over *axis_name* if sharded."""
+    """The full cycle on one shard; psum over *axis_name* if sharded.
+
+    ``slots_axis=0`` selects the slot-major (K, M) layout: markets ride the
+    128-wide lane dimension, which measures ~25% faster on TPU than (M, K)
+    with small K (the reduction becomes a K-deep sublane sum).
+    """
     # 1. decay is a read transform; cold slots read the cold-start prior.
     stored = decayed_reliability_at(
         state.reliability, state.updated_days, now_days, state.exists
@@ -74,9 +80,9 @@ def _cycle_math(
 
     # 2. weighted sums along the (possibly sharded) sources axis.
     w = jnp.where(mask, read_rel, 0.0)
-    total_weight = jnp.sum(w, axis=-1)
-    weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=-1)
-    weighted_conf = jnp.sum(jnp.where(mask, read_conf, 0.0) * w, axis=-1)
+    total_weight = jnp.sum(w, axis=slots_axis)
+    weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=slots_axis)
+    weighted_conf = jnp.sum(jnp.where(mask, read_conf, 0.0) * w, axis=slots_axis)
     if axis_name is not None:
         total_weight = jax.lax.psum(total_weight, axis_name)
         weighted_prob = jax.lax.psum(weighted_prob, axis_name)
@@ -89,7 +95,7 @@ def _cycle_math(
 
     # 3. binary correctness: predicted-true iff p >= 0.5 (reference:
     #    market.py:296-303), judged against the market outcome.
-    correct = (probs >= 0.5) == outcome[:, None]
+    correct = (probs >= 0.5) == jnp.expand_dims(outcome, slots_axis)
 
     # 4. capped update on the UNDECAYED stored state; only signalling slots.
     new_rel, new_conf, new_updated = masked_outcome_update(
@@ -112,26 +118,98 @@ def _cycle_math(
     return CycleResult(new_state, consensus, confidence_out, total_weight)
 
 
-def build_cycle(mesh: Mesh | None = None, donate: bool = True):
+def _specs(slot_major: bool):
+    """(block, market, slots_axis) partition specs for the chosen layout."""
+    if slot_major:
+        return P(SOURCES_AXIS, MARKETS_AXIS), P(MARKETS_AXIS), 0
+    return P(MARKETS_AXIS, SOURCES_AXIS), P(MARKETS_AXIS), -1
+
+
+def build_cycle(
+    mesh: Mesh | None = None,
+    donate: bool = True,
+    slot_major: bool = False,
+):
     """Compile the consensus+update cycle, optionally sharded over *mesh*.
 
     Returns ``cycle(probs, mask, outcome, state, now_days) -> CycleResult``.
     With a mesh, blocked inputs shard as (markets, sources) and per-market
     outputs as (markets,); the sources-axis reduction is a single psum.
+    ``slot_major=True`` expects all blocked arrays transposed to (K, M) —
+    the faster layout on TPU (markets on lanes).
     """
+    block, market, slots_axis = _specs(slot_major)
     if mesh is None:
-        fn = partial(_cycle_math, axis_name=None)
+        fn = partial(_cycle_math, axis_name=None, slots_axis=slots_axis)
     else:
-        block = P(MARKETS_AXIS, SOURCES_AXIS)
-        market = P(MARKETS_AXIS)
         state_spec = MarketBlockState(block, block, block, block)
         fn = shard_map(
-            partial(_cycle_math, axis_name=SOURCES_AXIS),
+            partial(_cycle_math, axis_name=SOURCES_AXIS, slots_axis=slots_axis),
             mesh=mesh,
             in_specs=(block, block, market, state_spec, P()),
             out_specs=CycleResult(state_spec, market, market, market),
         )
     return jax.jit(fn, donate_argnums=(3,) if donate else ())
+
+
+def build_cycle_loop(
+    mesh: Mesh | None = None,
+    slot_major: bool = True,
+    donate: bool = True,
+):
+    """Compile an N-cycle loop that runs entirely inside one jit dispatch.
+
+    ``loop(probs, mask, outcome, state, now0, steps) -> (state', consensus)``
+    runs ``steps`` consecutive cycles (day ``now0 + i`` each) with the state
+    carried on device — the shape of a production consensus/settlement loop,
+    and the only way to amortise per-dispatch overhead (measured ~4 ms/call
+    through the axon TPU tunnel vs ~1.4 ms of actual cycle compute at 1M×16).
+    ``steps`` is static: each distinct value compiles once.
+    """
+    block, market, slots_axis = _specs(slot_major)
+    compiled: dict[int, object] = {}
+
+    def compile_for(steps: int):
+        def loop_math(probs, mask, outcome, state, now0):
+            num_markets = outcome.shape[0]
+
+            def body(i, carry):
+                current, _ = carry
+                result = _cycle_math(
+                    probs, mask, outcome, current, now0 + i,
+                    axis_name=SOURCES_AXIS if mesh is not None else None,
+                    slots_axis=slots_axis,
+                )
+                return result.state, result.consensus
+
+            init_consensus = jnp.zeros(num_markets, probs.dtype)
+            if mesh is not None:
+                # Match the loop output's varying-axis type: consensus varies
+                # over the markets mesh axis inside shard_map.
+                init_consensus = jax.lax.pcast(
+                    init_consensus, (MARKETS_AXIS,), to="varying"
+                )
+            return jax.lax.fori_loop(0, steps, body, (state, init_consensus))
+
+        if mesh is None:
+            fn = loop_math
+        else:
+            state_spec = MarketBlockState(block, block, block, block)
+            fn = shard_map(
+                loop_math,
+                mesh=mesh,
+                in_specs=(block, block, market, state_spec, P()),
+                out_specs=(state_spec, market),
+            )
+        return jax.jit(fn, donate_argnums=(3,) if donate else ())
+
+    def loop(probs, mask, outcome, state, now0, steps: int):
+        fn = compiled.get(steps)
+        if fn is None:
+            fn = compiled[steps] = compile_for(steps)
+        return fn(probs, mask, outcome, state, now0)
+
+    return loop
 
 
 def init_block_state(

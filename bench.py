@@ -7,8 +7,14 @@ confidence update — the batched equivalent of the reference's
 ``compute_all_consensus`` + per-pair ``update_reliability`` sweep
 (reference: market.py:200-221, reliability.py:185-231).
 
-State stays resident in HBM across cycles (buffer donation); on multi-device
-hosts the blocks shard over a (markets, sources) mesh via shard_map.
+Measurement notes (all learned the hard way on this host):
+  * the timed loop runs INSIDE one jit (``build_cycle_loop`` → lax.fori_loop)
+    — per-dispatch overhead through the axon TPU tunnel is ~4 ms, 3× the
+    actual 1M×16 cycle compute, so chained host dispatches measure the tunnel
+  * state is slot-major (K, M): markets on the 128-lane minor dim (~25%
+    faster than (M, K) with K=16)
+  * on the axon tunnel ``block_until_ready`` does NOT force remote execution
+    — every timing fence is a scalar value fetch
 
 Prints exactly one JSON line:
     {"metric": ..., "value": N, "unit": "cycles/sec", "vs_baseline": N}
@@ -28,7 +34,7 @@ REFERENCE_BASELINE_CYCLES_PER_SEC = 0.0019838
 NUM_MARKETS = 1_000_000
 SLOTS_PER_MARKET = 16
 SOURCE_UNIVERSE = 10_000
-TIMED_STEPS = 30
+TIMED_STEPS = 100
 
 
 def build_workload(key, num_markets, slots, dtype):
@@ -54,45 +60,70 @@ def run(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET, timed_steps=TIMED_STEPS
 
     from bayesian_consensus_engine_tpu.parallel import (
         MarketBlockState,
-        build_cycle,
+        build_cycle_loop,
         init_block_state,
         make_mesh,
-        shard_block,
-        shard_market,
+    )
+    from bayesian_consensus_engine_tpu.parallel.mesh import (
+        MARKETS_AXIS,
+        SOURCES_AXIS,
     )
 
     devices = jax.devices()
+    # All devices on the markets axis: the reductions stay device-local and
+    # the cycle needs zero communication (mesh.py default policy).
     mesh = make_mesh() if len(devices) > 1 else None
     dtype = jnp.float32
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        block_sharding = NamedSharding(mesh, P(SOURCES_AXIS, MARKETS_AXIS))
+        market_sharding = NamedSharding(mesh, P(MARKETS_AXIS))
+    else:
+        block_sharding = market_sharding = None
 
     probs, mask, outcome, _src_idx = build_workload(
         jax.random.PRNGKey(0), num_markets, slots, dtype
     )
-    state = init_block_state(num_markets, slots, dtype=dtype)
-
+    # Slot-major layout: (K, M), markets on lanes.
+    probs, mask = probs.T, mask.T
     if mesh is not None:
-        probs, mask = shard_block(probs, mesh), shard_block(mask, mesh)
-        outcome = shard_market(outcome, mesh)
-        state = MarketBlockState(*(shard_block(x, mesh) for x in state))
+        probs = jax.device_put(probs, block_sharding)
+        mask = jax.device_put(mask, block_sharding)
+        outcome = jax.device_put(outcome, market_sharding)
 
-    cycle = build_cycle(mesh, donate=True)
-
-    # Warmup: compile + first executions. NOTE: on the axon TPU tunnel,
-    # block_until_ready does NOT force remote execution — only a value fetch
-    # does — so every timing fence below is a scalar fetch.
-    result = cycle(probs, mask, outcome, state, jnp.asarray(1.0, dtype))
-    result = cycle(probs, mask, outcome, result.state, jnp.asarray(2.0, dtype))
-    float(result.consensus[0])
-
-    start = time.perf_counter()
-    for step in range(timed_steps):
-        result = cycle(
-            probs, mask, outcome, result.state, jnp.asarray(3.0 + step, dtype)
+    def fresh_state():
+        """Slot-major state, pre-sharded, fully materialised (fenced)."""
+        state = MarketBlockState(
+            *(x.T for x in init_block_state(num_markets, slots, dtype=dtype))
         )
-    float(result.consensus[0])  # fences the whole chain
-    elapsed = time.perf_counter() - start
+        if mesh is not None:
+            state = MarketBlockState(
+                *(jax.device_put(x, block_sharding) for x in state)
+            )
+        float(state.reliability[0, 0])  # fence: construction outside the timer
+        return state
 
-    cycles_per_sec = timed_steps / elapsed
+    loop = build_cycle_loop(mesh, slot_major=True, donate=True)
+
+    # Warmup: compile + one full run (fenced by a value fetch — see notes).
+    state, consensus = loop(
+        probs, mask, outcome, fresh_state(), jnp.asarray(1.0, dtype), timed_steps
+    )
+    float(consensus[0])
+
+    best = float("inf")
+    for _trial in range(3):
+        state_in = fresh_state()
+        start = time.perf_counter()
+        state, consensus = loop(
+            probs, mask, outcome, state_in, jnp.asarray(10.0, dtype), timed_steps
+        )
+        float(consensus[0])  # fences the whole in-jit loop
+        best = min(best, (time.perf_counter() - start) / timed_steps)
+
+    cycles_per_sec = 1.0 / best
     return {
         "metric": (
             f"consensus+reliability-update cycles/sec at "

@@ -1,0 +1,117 @@
+"""Fused Pallas cycle ≡ XLA cycle, element-wise (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.ops.pallas_cycle import (
+    SlotMajorState,
+    build_pallas_cycle,
+    to_slot_major,
+)
+from bayesian_consensus_engine_tpu.parallel import (
+    MarketBlockState,
+    build_cycle,
+)
+
+M, K = 1024, 16
+TILE = 256
+
+
+def _inputs(seed=0):
+    rng = np.random.default_rng(seed)
+    probs = jnp.asarray(rng.random((M, K)), dtype=jnp.float32)
+    mask = jnp.asarray(rng.random((M, K)) < 0.8)
+    outcome = jnp.asarray(rng.random(M) < 0.5)
+    state = MarketBlockState(
+        reliability=jnp.asarray(rng.uniform(0.0, 1.0, (M, K)), dtype=jnp.float32),
+        confidence=jnp.asarray(rng.uniform(0.0, 1.0, (M, K)), dtype=jnp.float32),
+        updated_days=jnp.asarray(
+            rng.choice([0.0, 3.0, 35.0, 500.0], (M, K)), dtype=jnp.float32
+        ),
+        exists=jnp.asarray(rng.random((M, K)) < 0.5),
+    )
+    return probs, mask, outcome, state, jnp.float32(501.0)
+
+
+class TestFusedKernelEquivalence:
+    def test_matches_xla_cycle(self):
+        probs, mask, outcome, state, now = _inputs()
+        xla = build_cycle(mesh=None, donate=False)(probs, mask, outcome, state, now)
+
+        sm_probs, sm_mask, sm_outcome, sm_state = to_slot_major(
+            probs, mask, outcome, state
+        )
+        pallas_cycle = build_pallas_cycle(M, K, tile_markets=TILE, interpret=True)
+        new_state, consensus, confidence, tw = pallas_cycle(
+            sm_probs, sm_mask, sm_outcome, sm_state, now
+        )
+
+        np.testing.assert_allclose(
+            np.asarray(consensus)[0], np.asarray(xla.consensus),
+            rtol=1e-6, equal_nan=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(confidence)[0], np.asarray(xla.confidence), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(tw)[0], np.asarray(xla.total_weight), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(new_state.reliability).T,
+            np.asarray(xla.state.reliability),
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(new_state.confidence).T,
+            np.asarray(xla.state.confidence),
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(new_state.updated_days).T,
+            np.asarray(xla.state.updated_days),
+            rtol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(new_state.exists).T > 0, np.asarray(xla.state.exists)
+        )
+
+    def test_composes_over_steps(self):
+        probs, mask, outcome, state, now = _inputs(7)
+        pallas_cycle = build_pallas_cycle(M, K, tile_markets=TILE, interpret=True)
+        xla_cycle = build_cycle(mesh=None, donate=False)
+
+        sm = to_slot_major(probs, mask, outcome, state)
+        p_state = sm[3]
+        x_state = state
+        for step in range(3):
+            t = jnp.float32(502.0 + step)
+            p_state, p_cons, _, _ = pallas_cycle(sm[0], sm[1], sm[2], p_state, t)
+            x_result = xla_cycle(probs, mask, outcome, x_state, t)
+            x_state = x_result.state
+        np.testing.assert_allclose(
+            np.asarray(p_state.reliability).T,
+            np.asarray(x_state.reliability),
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(p_cons)[0], np.asarray(x_result.consensus),
+            rtol=1e-6, equal_nan=True,
+        )
+
+    def test_rejects_unaligned_markets(self):
+        with pytest.raises(ValueError, match="not a multiple"):
+            build_pallas_cycle(1000, K, tile_markets=256)
+
+    def test_in_place_aliasing_shapes(self):
+        # Output state buffers share shapes/dtypes with inputs (alias contract).
+        probs, mask, outcome, state, now = _inputs(3)
+        sm_probs, sm_mask, sm_outcome, sm_state = to_slot_major(
+            probs, mask, outcome, state
+        )
+        pallas_cycle = build_pallas_cycle(M, K, tile_markets=TILE, interpret=True)
+        new_state, *_ = pallas_cycle(sm_probs, sm_mask, sm_outcome, sm_state, now)
+        for new, old in zip(new_state, sm_state):
+            assert new.shape == old.shape and new.dtype == old.dtype

@@ -164,6 +164,88 @@ class TestCycleSemantics:
         ) or float(r2.state.confidence[0, 0]) == pytest.approx(0.3925, rel=1e-5)
 
 
+class TestSlotMajorLayout:
+    def test_slot_major_matches_row_major(self):
+        probs, mask, outcome, state, now = _random_inputs(2)
+        baseline = _as_np(build_cycle(mesh=None, donate=False)(probs, mask, outcome, state, now))
+        transposed = MarketBlockState(*(x.T for x in state))
+        slot = build_cycle(mesh=None, donate=False, slot_major=True)(
+            probs.T, mask.T, outcome, transposed, now
+        )
+        slot = _as_np(slot)
+        np.testing.assert_allclose(
+            slot.consensus, baseline.consensus, rtol=1e-6, equal_nan=True
+        )
+        for field in MarketBlockState._fields:
+            np.testing.assert_allclose(
+                getattr(slot.state, field).T,
+                getattr(baseline.state, field),
+                rtol=1e-6,
+                err_msg=field,
+            )
+
+    @pytest.mark.parametrize("shape", [(4, 2), (1, 8)])
+    def test_slot_major_sharded(self, shape):
+        probs, mask, outcome, state, now = _random_inputs(3)
+        baseline = _as_np(build_cycle(mesh=None, donate=False)(probs, mask, outcome, state, now))
+        mesh = make_mesh(shape)
+        transposed = MarketBlockState(*(x.T for x in state))
+        slot = _as_np(
+            build_cycle(mesh=mesh, donate=False, slot_major=True)(
+                probs.T, mask.T, outcome, transposed, now
+            )
+        )
+        np.testing.assert_allclose(
+            slot.consensus, baseline.consensus, rtol=1e-6, equal_nan=True
+        )
+
+
+class TestCycleLoop:
+    def test_loop_equals_repeated_single_cycles(self):
+        from bayesian_consensus_engine_tpu.parallel import build_cycle_loop
+
+        probs, mask, outcome, state, _now = _random_inputs(4)
+        single = build_cycle(mesh=None, donate=False)
+        current = state
+        for i in range(5):
+            result = single(probs, mask, outcome, current, jnp.float32(100.0 + i))
+            current = result.state
+
+        loop = build_cycle_loop(mesh=None, slot_major=True, donate=False)
+        transposed = MarketBlockState(*(x.T for x in state))
+        loop_state, loop_consensus = loop(
+            probs.T, mask.T, outcome, transposed, jnp.float32(100.0), 5
+        )
+        np.testing.assert_allclose(
+            np.asarray(loop_consensus), np.asarray(result.consensus),
+            rtol=1e-6, equal_nan=True,
+        )
+        for field in MarketBlockState._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(loop_state, field)).T,
+                np.asarray(getattr(current, field)),
+                rtol=1e-5,
+                err_msg=field,
+            )
+
+    def test_sharded_loop_matches_unsharded(self):
+        from bayesian_consensus_engine_tpu.parallel import build_cycle_loop
+
+        probs, mask, outcome, state, _now = _random_inputs(5)
+        transposed = MarketBlockState(*(x.T for x in state))
+        unsharded = build_cycle_loop(mesh=None, slot_major=True, donate=False)(
+            probs.T, mask.T, outcome, transposed, jnp.float32(50.0), 3
+        )
+        mesh = make_mesh((4, 2))
+        sharded = build_cycle_loop(mesh=mesh, slot_major=True, donate=False)(
+            probs.T, mask.T, outcome, transposed, jnp.float32(50.0), 3
+        )
+        np.testing.assert_allclose(
+            np.asarray(sharded[1]), np.asarray(unsharded[1]),
+            rtol=1e-6, equal_nan=True,
+        )
+
+
 class TestDonation:
     def test_donated_state_buffer_reused(self):
         mesh = make_mesh((8, 1))
