@@ -1,0 +1,2 @@
+"""Native (C) host-path accelerators. Built on demand by native/build.py;
+everything here has a pure-Python fallback in the importing module."""

@@ -108,42 +108,32 @@ class PackedBatch:
         )
 
 
-def pack_markets(
-    markets: Sequence[tuple[str, Sequence[Mapping[str, Any]]]],
-    lookup: ReliabilityLookup = cold_start_lookup,
-) -> PackedBatch:
-    """Intern, sort, and flatten raw (market_id, signals) payloads."""
-    dtype = np.float64  # host packing always f64; cast on device transfer
+try:  # native ingest packer (see native/fastpack.c; build with native/build.py)
+    from bayesian_consensus_engine_tpu._native import fastpack as _fastpack
+except ImportError:  # pure-Python fallback below — identical outputs
+    _fastpack = None
 
-    market_keys: list[str] = []
+
+def _pack_grouping_python(markets):
+    """Pure-Python twin of native/fastpack.c's grouping pass."""
     pair_market: list[int] = []
     pair_source_ids: list[str] = []
-    pair_rel: list[float] = []
-    pair_conf: list[float] = []
-    pair_known: list[bool] = []
     flat_probs: list[float] = []
     flat_pair: list[int] = []
     signals_per_market: list[int] = []
     pair_offsets: list[int] = [0]
 
-    for market_row, (market_id, signals) in enumerate(markets):
-        market_keys.append(market_id)
+    for market_row, (_market_id, signals) in enumerate(markets):
         signals_per_market.append(len(signals))
-
-        by_source: dict[str, list[float]] = {}
+        seen: dict[str, None] = {}
         for signal in signals:
-            by_source.setdefault(signal["sourceId"], []).append(signal["probability"])
+            seen[signal["sourceId"]] = None
 
         base = len(pair_source_ids)
-        ordered = sorted(by_source)
+        ordered = sorted(seen)
         slot_of = {sid: base + i for i, sid in enumerate(ordered)}
-        for sid in ordered:
-            reliability, confidence, known = lookup(sid, market_id)
-            pair_market.append(market_row)
-            pair_source_ids.append(sid)
-            pair_rel.append(reliability)
-            pair_conf.append(confidence)
-            pair_known.append(known)
+        pair_market.extend([market_row] * len(ordered))
+        pair_source_ids.extend(ordered)
 
         # Raw signals in original order → duplicate averaging keeps the
         # scalar path's left-to-right accumulation order per pair.
@@ -153,10 +143,50 @@ def pack_markets(
 
         pair_offsets.append(len(pair_source_ids))
 
+    return (
+        pair_market, pair_source_ids, flat_probs, flat_pair,
+        signals_per_market, pair_offsets,
+    )
+
+
+def pack_markets(
+    markets: Sequence[tuple[str, Sequence[Mapping[str, Any]]]],
+    lookup: ReliabilityLookup = cold_start_lookup,
+    native: bool | None = None,
+) -> PackedBatch:
+    """Intern, sort, and flatten raw (market_id, signals) payloads.
+
+    The grouping/flattening pass runs in the C extension when built
+    (``native=None`` auto-detects; True forces it, False forces the Python
+    twin — both produce identical outputs). The reliability ``lookup`` is a
+    user callable and always runs in Python, once per unique pair.
+    """
+    use_native = (_fastpack is not None) if native is None else native
+    if use_native and _fastpack is None:
+        raise RuntimeError(
+            "native packer requested but not built; run python native/build.py"
+        )
+
+    markets = list(markets)  # consumed twice (grouping pass + key/lookup pass)
+    grouping = (_fastpack.pack if use_native else _pack_grouping_python)(markets)
+    (pair_market, pair_source_ids, flat_probs, flat_pair,
+     signals_per_market, pair_offsets) = grouping
+
+    market_keys = [market_id for market_id, _signals in markets]
+    pair_rel: list[float] = []
+    pair_conf: list[float] = []
+    pair_known: list[bool] = []
+    for sid, market_row in zip(pair_source_ids, pair_market):
+        reliability, confidence, known = lookup(sid, market_keys[market_row])
+        pair_rel.append(reliability)
+        pair_conf.append(confidence)
+        pair_known.append(known)
+
+    dtype = np.float64  # host packing always f64; cast on device transfer
     return PackedBatch(
         market_keys=market_keys,
         pair_market=np.asarray(pair_market, dtype=np.int32),
-        pair_source_ids=pair_source_ids,
+        pair_source_ids=list(pair_source_ids),
         pair_reliability=np.asarray(pair_rel, dtype=dtype),
         pair_confidence=np.asarray(pair_conf, dtype=dtype),
         pair_known=np.asarray(pair_known, dtype=bool),

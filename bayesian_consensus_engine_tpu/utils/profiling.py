@@ -1,0 +1,50 @@
+"""Optional profiling hooks.
+
+The reference carries diagnostics in-band in its output documents and has no
+tracing subsystem (SURVEY §5); this module adds the TPU-side complement —
+thin wrappers over ``jax.profiler`` that are no-ops unless explicitly used,
+so the in-band diagnostics contract stays untouched.
+
+Usage:
+    from bayesian_consensus_engine_tpu.utils.profiling import trace
+
+    with trace("settlement-cycle", "/tmp/jax-trace"):
+        loop(probs, mask, outcome, state, now0, steps)
+    # → open /tmp/jax-trace in TensorBoard / Perfetto
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def trace(label: str, log_dir: str | None = None) -> Iterator[None]:
+    """Profile a block: XLA trace when *log_dir* is given, else annotation only."""
+    import jax
+
+    if log_dir is None:
+        with jax.profiler.TraceAnnotation(label):
+            yield
+    else:
+        with jax.profiler.trace(log_dir):
+            with jax.profiler.TraceAnnotation(label):
+                yield
+
+
+def annotate(label: str):
+    """Decorator: wrap a function in a named trace annotation."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            import jax
+
+            with jax.profiler.TraceAnnotation(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

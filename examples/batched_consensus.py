@@ -1,0 +1,32 @@
+"""Batched TPU path: consensus for many markets in one device pass.
+
+Run from the repo root:  python examples/batched_consensus.py
+"""
+
+import pathlib
+import random
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from bayesian_consensus_engine_tpu.core.batch import compute_batch_consensus
+
+rng = random.Random(0)
+markets = [
+    (
+        f"crypto:asset-{m}",
+        [
+            {"sourceId": f"model-{s}", "probability": round(rng.random(), 3)}
+            for s in range(rng.randint(2, 6))
+        ],
+    )
+    for m in range(8)
+]
+
+results = compute_batch_consensus(markets)
+
+for market_id, doc in results.items():
+    print(
+        f"{market_id:18s} consensus={doc['consensus']:.4f} "
+        f"sources={doc['diagnostics']['uniqueSources']}"
+    )

@@ -1,0 +1,40 @@
+"""The TPU settlement loop: N consensus+update cycles in one jit dispatch.
+
+Demonstrates the production-shaped hot path: blocked state resident on
+device, outcomes judged at p >= 0.5, reliability updated with the capped
+step, state carried across cycles without leaving HBM.
+
+Run from the repo root:  python examples/settlement_cycle.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.parallel import (
+    MarketBlockState,
+    build_cycle_loop,
+    init_block_state,
+)
+
+M, K = 1024, 8  # markets × source slots
+
+rng = np.random.default_rng(0)
+probs = jnp.asarray(rng.random((M, K)), dtype=jnp.float32).T      # slot-major
+mask = jnp.asarray(rng.random((M, K)) < 0.9).T
+outcome = jnp.asarray(rng.random(M) < 0.5)
+state = MarketBlockState(*(x.T for x in init_block_state(M, K)))
+
+loop = build_cycle_loop(mesh=None, slot_major=True, donate=True)
+state, consensus = loop(probs, mask, outcome, state, jnp.float32(0.0), 30)
+
+consensus = np.asarray(consensus)
+reliability = np.asarray(state.reliability)
+print(f"ran 30 cycles over {M} markets × {K} slots in one dispatch")
+print(f"consensus[:5]        = {np.round(consensus[:5], 4)}")
+print(f"mean reliability     = {reliability.mean():.3f} (drifted from 0.500)")
+print(f"reliability extremes = {reliability.min():.2f} .. {reliability.max():.2f}")

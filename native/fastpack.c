@@ -1,0 +1,188 @@
+/* fastpack — native signal-ingest packer for bayesian_consensus_engine_tpu.
+ *
+ * The TPU compute path consumes dense arrays; turning ragged JSON-shaped
+ * signal payloads into those arrays is the framework's host-side hot loop
+ * (core/batch.py:pack_markets). This module implements that inner loop in C
+ * against the CPython API: one pass per market grouping signals by source,
+ * sorted-source slot assignment, and flat (signal → pair-slot) emission.
+ *
+ * Contract: byte-for-byte the same outputs as the pure-Python packer (the
+ * fallback when this extension is not built); equivalence is enforced by
+ * tests/test_fastpack.py. The reliability lookup stays in Python — it is a
+ * user-supplied callable per (source, market) pair, O(pairs) not O(signals).
+ *
+ * Returns, for a list of (market_id, signals) tuples:
+ *   pair_market        list[int]   market row per (market, source) pair
+ *   pair_source_ids    list[str]   source id per pair (sorted within market)
+ *   flat_probs         list[float] raw probabilities in input order
+ *   flat_pair          list[int]   pair slot per raw signal
+ *   signals_per_market list[int]
+ *   pair_offsets       list[int]   pair range per market (len M+1)
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static int append_long(PyObject *list, long value) {
+    PyObject *obj = PyLong_FromLong(value);
+    if (!obj) return -1;
+    int rc = PyList_Append(list, obj);
+    Py_DECREF(obj);
+    return rc;
+}
+
+static PyObject *
+fastpack_pack(PyObject *Py_UNUSED(self), PyObject *args)
+{
+    PyObject *markets;
+    if (!PyArg_ParseTuple(args, "O", &markets))
+        return NULL;
+    PyObject *markets_fast = PySequence_Fast(markets, "markets must be a sequence");
+    if (!markets_fast) return NULL;
+
+    PyObject *pair_market = PyList_New(0);
+    PyObject *pair_source_ids = PyList_New(0);
+    PyObject *flat_probs = PyList_New(0);
+    PyObject *flat_pair = PyList_New(0);
+    PyObject *signals_per_market = PyList_New(0);
+    PyObject *pair_offsets = PyList_New(0);
+    PyObject *by_source = NULL, *slot_of = NULL, *ordered = NULL;
+    PyObject *key_source = NULL, *key_prob = NULL;
+
+    if (!pair_market || !pair_source_ids || !flat_probs || !flat_pair ||
+        !signals_per_market || !pair_offsets)
+        goto fail;
+
+    key_source = PyUnicode_InternFromString("sourceId");
+    key_prob = PyUnicode_InternFromString("probability");
+    if (!key_source || !key_prob) goto fail;
+
+    if (append_long(pair_offsets, 0) < 0) goto fail;
+
+    Py_ssize_t num_markets = PySequence_Fast_GET_SIZE(markets_fast);
+    for (Py_ssize_t m = 0; m < num_markets; m++) {
+        PyObject *entry = PySequence_Fast_GET_ITEM(markets_fast, m);  /* borrowed */
+        if (!PyTuple_Check(entry) && !PyList_Check(entry)) {
+            PyErr_SetString(PyExc_TypeError, "each market must be (id, signals)");
+            goto fail;
+        }
+        PyObject *signals = PySequence_GetItem(entry, 1);  /* new ref */
+        if (!signals) goto fail;
+        PyObject *signals_fast = PySequence_Fast(signals, "signals must be a sequence");
+        Py_DECREF(signals);
+        if (!signals_fast) goto fail;
+
+        Py_ssize_t num_signals = PySequence_Fast_GET_SIZE(signals_fast);
+        if (append_long(signals_per_market, (long)num_signals) < 0) {
+            Py_DECREF(signals_fast); goto fail;
+        }
+
+        /* Group: source id → first-seen order (value unused; dict preserves
+         * insertion order, we only need the key set). */
+        by_source = PyDict_New();
+        if (!by_source) { Py_DECREF(signals_fast); goto fail; }
+        for (Py_ssize_t s = 0; s < num_signals; s++) {
+            PyObject *signal = PySequence_Fast_GET_ITEM(signals_fast, s);
+            PyObject *sid = PyObject_GetItem(signal, key_source);  /* new */
+            if (!sid) { Py_DECREF(signals_fast); goto fail; }
+            if (PyDict_SetItem(by_source, sid, Py_None) < 0) {
+                Py_DECREF(sid); Py_DECREF(signals_fast); goto fail;
+            }
+            Py_DECREF(sid);
+        }
+
+        /* Sorted unique source ids → slot assignment. */
+        ordered = PyDict_Keys(by_source);
+        if (!ordered || PyList_Sort(ordered) < 0) { Py_DECREF(signals_fast); goto fail; }
+
+        Py_ssize_t base = PyList_GET_SIZE(pair_source_ids);
+        slot_of = PyDict_New();
+        if (!slot_of) { Py_DECREF(signals_fast); goto fail; }
+        Py_ssize_t num_unique = PyList_GET_SIZE(ordered);
+        for (Py_ssize_t u = 0; u < num_unique; u++) {
+            PyObject *sid = PyList_GET_ITEM(ordered, u);  /* borrowed */
+            PyObject *slot = PyLong_FromSsize_t(base + u);
+            if (!slot || PyDict_SetItem(slot_of, sid, slot) < 0) {
+                Py_XDECREF(slot); Py_DECREF(signals_fast); goto fail;
+            }
+            Py_DECREF(slot);
+            if (append_long(pair_market, (long)m) < 0 ||
+                PyList_Append(pair_source_ids, sid) < 0) {
+                Py_DECREF(signals_fast); goto fail;
+            }
+        }
+
+        /* Flat emission in original signal order (preserves the scalar
+         * engine's duplicate-averaging float order). */
+        for (Py_ssize_t s = 0; s < num_signals; s++) {
+            PyObject *signal = PySequence_Fast_GET_ITEM(signals_fast, s);
+            PyObject *sid = PyObject_GetItem(signal, key_source);
+            if (!sid) { Py_DECREF(signals_fast); goto fail; }
+            PyObject *slot = PyDict_GetItem(slot_of, sid);  /* borrowed */
+            Py_DECREF(sid);
+            if (!slot) {
+                PyErr_SetString(PyExc_RuntimeError, "slot lookup failed");
+                Py_DECREF(signals_fast); goto fail;
+            }
+            PyObject *prob = PyObject_GetItem(signal, key_prob);
+            if (!prob) { Py_DECREF(signals_fast); goto fail; }
+            if (PyList_Append(flat_probs, prob) < 0 ||
+                PyList_Append(flat_pair, slot) < 0) {
+                Py_DECREF(prob); Py_DECREF(signals_fast); goto fail;
+            }
+            Py_DECREF(prob);
+        }
+
+        if (append_long(pair_offsets, (long)PyList_GET_SIZE(pair_source_ids)) < 0) {
+            Py_DECREF(signals_fast); goto fail;
+        }
+        Py_DECREF(signals_fast);
+        Py_CLEAR(by_source);
+        Py_CLEAR(ordered);
+        Py_CLEAR(slot_of);
+    }
+
+    Py_DECREF(markets_fast);
+    Py_XDECREF(key_source);
+    Py_XDECREF(key_prob);
+    return Py_BuildValue(
+        "(NNNNNN)",
+        pair_market, pair_source_ids, flat_probs, flat_pair,
+        signals_per_market, pair_offsets);
+
+fail:
+    Py_XDECREF(markets_fast);
+    Py_XDECREF(pair_market);
+    Py_XDECREF(pair_source_ids);
+    Py_XDECREF(flat_probs);
+    Py_XDECREF(flat_pair);
+    Py_XDECREF(signals_per_market);
+    Py_XDECREF(pair_offsets);
+    Py_XDECREF(by_source);
+    Py_XDECREF(ordered);
+    Py_XDECREF(slot_of);
+    Py_XDECREF(key_source);
+    Py_XDECREF(key_prob);
+    return NULL;
+}
+
+static PyMethodDef fastpack_methods[] = {
+    {"pack", fastpack_pack, METH_VARARGS,
+     "pack(markets) -> (pair_market, pair_source_ids, flat_probs, flat_pair, "
+     "signals_per_market, pair_offsets)"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef fastpack_module = {
+    PyModuleDef_HEAD_INIT,
+    "fastpack",
+    "Native signal-ingest packer (C twin of core.batch.pack_markets grouping).",
+    -1,
+    fastpack_methods,
+};
+
+PyMODINIT_FUNC
+PyInit_fastpack(void)
+{
+    return PyModule_Create(&fastpack_module);
+}

@@ -21,4 +21,20 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
 # Make the repo root importable when tests run without an installed package.
-sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(_ROOT))
+
+# Best-effort build of the native ingest packer so a fresh checkout exercises
+# the C path too (tests skip it gracefully if no compiler is available).
+if not list((_ROOT / "bayesian_consensus_engine_tpu" / "_native").glob("fastpack*.so")):
+    try:
+        import importlib.util
+
+        _spec = importlib.util.spec_from_file_location(
+            "native_build", _ROOT / "native" / "build.py"
+        )
+        _module = importlib.util.module_from_spec(_spec)
+        _spec.loader.exec_module(_module)
+        _module.build()
+    except Exception:
+        pass

@@ -1,0 +1,116 @@
+"""Native ingest packer ≡ pure-Python packer, plus build tooling."""
+
+import random
+
+import numpy as np
+import pytest
+
+from bayesian_consensus_engine_tpu.core import batch as batch_mod
+from bayesian_consensus_engine_tpu.core.batch import mapping_lookup, pack_markets
+
+needs_native = pytest.mark.skipif(
+    batch_mod._fastpack is None,
+    reason="native fastpack not built (python native/build.py)",
+)
+
+
+def _random_markets(seed=0, num_markets=25):
+    rng = random.Random(seed)
+    markets = []
+    for m in range(num_markets):
+        signals = [
+            {
+                "sourceId": f"src-{rng.randint(0, 7)}",
+                "probability": round(rng.random(), 6),
+            }
+            for _ in range(rng.randint(0, 12))
+        ]
+        markets.append((f"market-{m}", signals))
+    return markets
+
+
+@needs_native
+class TestNativePythonEquivalence:
+    def test_identical_packing(self):
+        markets = _random_markets()
+        rel = {f"src-{i}": {"reliability": 0.1 * i, "confidence": 0.05 * i}
+               for i in range(5)}
+        lookup = mapping_lookup(rel)
+        native = pack_markets(markets, lookup, native=True)
+        python = pack_markets(markets, lookup, native=False)
+
+        assert native.market_keys == python.market_keys
+        assert native.pair_source_ids == python.pair_source_ids
+        np.testing.assert_array_equal(native.pair_market, python.pair_market)
+        np.testing.assert_array_equal(native.flat_probs, python.flat_probs)
+        np.testing.assert_array_equal(native.flat_pair, python.flat_pair)
+        np.testing.assert_array_equal(
+            native.signals_per_market, python.signals_per_market
+        )
+        np.testing.assert_array_equal(native.pair_offsets, python.pair_offsets)
+        np.testing.assert_array_equal(
+            native.pair_reliability, python.pair_reliability
+        )
+        np.testing.assert_array_equal(
+            native.pair_confidence, python.pair_confidence
+        )
+        np.testing.assert_array_equal(native.pair_known, python.pair_known)
+
+    def test_empty_and_single(self):
+        for markets in ([], [("only", [])], [("one", [{"sourceId": "a", "probability": 1.0}])]):
+            native = pack_markets(markets, native=True)
+            python = pack_markets(markets, native=False)
+            assert native.pair_source_ids == python.pair_source_ids
+            np.testing.assert_array_equal(native.pair_offsets, python.pair_offsets)
+
+    def test_duplicate_heavy(self):
+        markets = [
+            ("m", [{"sourceId": "a", "probability": p} for p in (0.1, 0.2, 0.3)]
+                  + [{"sourceId": "b", "probability": 0.9}])
+        ]
+        native = pack_markets(markets, native=True)
+        assert native.pair_source_ids == ["a", "b"]
+        np.testing.assert_array_equal(native.flat_pair, [0, 0, 0, 1])
+
+    def test_native_used_by_default_when_built(self):
+        # auto-detect prefers the native path when the extension is present
+        assert batch_mod._fastpack is not None
+
+    def test_faster_than_python(self):
+        import time
+
+        markets = _random_markets(seed=1, num_markets=2000)
+        t0 = time.perf_counter()
+        pack_markets(markets, native=True)
+        native_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        pack_markets(markets, native=False)
+        python_dt = time.perf_counter() - t0
+        # Non-regression guard only (real gain is ~1.3x; wide margin for CI
+        # noise — this catches the native path becoming pathologically slow,
+        # not small perf drift).
+        assert native_dt < python_dt * 2.0, (native_dt, python_dt)
+
+
+class TestFallback:
+    def test_python_path_always_available(self):
+        markets = _random_markets(seed=2)
+        packed = pack_markets(markets, native=False)
+        assert packed.num_markets == len(markets)
+
+    def test_force_native_without_build_raises(self, monkeypatch):
+        monkeypatch.setattr(batch_mod, "_fastpack", None)
+        with pytest.raises(RuntimeError, match="native packer requested"):
+            pack_markets(_random_markets(), native=True)
+
+    def test_build_script_importable(self):
+        import importlib.util
+        import pathlib
+
+        spec = importlib.util.spec_from_file_location(
+            "native_build",
+            pathlib.Path(__file__).parents[1] / "native" / "build.py",
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        assert callable(module.build)
