@@ -114,14 +114,16 @@ def _fused_cycle_kernel(
         BASE_LEARNING_RATE * direction, -MAX_UPDATE_STEP, MAX_UPDATE_STEP
     )
     touched = mask > 0
-    new_rel_ref[:] = jnp.where(touched, jnp.clip(rel + delta, 0.0, 1.0), rel)
-    # Untouched slots keep the exists-defaulted confidence (cold slots read as
-    # DEFAULT_CONFIDENCE), matching the XLA cycle which routes the defaulted
-    # value through its masked update (parallel/sharded.py step 4).
+    # Cold slots update from the cold-start prior; untouched slots pass
+    # through bit-identical (parallel/sharded.py step 4 semantics).
+    update_base = jnp.where(exists > 0, rel, DEFAULT_RELIABILITY)
+    new_rel_ref[:] = jnp.where(
+        touched, jnp.clip(update_base + delta, 0.0, 1.0), rel
+    )
     new_conf_ref[:] = jnp.where(
         touched,
         jnp.minimum(1.0, read_conf + (1.0 - read_conf) * CONFIDENCE_GROWTH_RATE),
-        read_conf,
+        conf,
     )
     new_upd_ref[:] = jnp.where(touched, now, upd)
     new_ex_ref[:] = jnp.maximum(exists, mask)

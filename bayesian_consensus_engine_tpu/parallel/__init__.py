@@ -15,6 +15,7 @@ from bayesian_consensus_engine_tpu.parallel.sharded import (
     build_cycle,
     build_cycle_loop,
     init_block_state,
+    pad_markets,
 )
 
 __all__ = [
@@ -30,4 +31,5 @@ __all__ = [
     "build_cycle",
     "build_cycle_loop",
     "init_block_state",
+    "pad_markets",
 ]

@@ -32,7 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from bayesian_consensus_engine_tpu.ops.decay import decayed_reliability_at
-from bayesian_consensus_engine_tpu.ops.update import masked_outcome_update
+from bayesian_consensus_engine_tpu.ops.update import outcome_update
 from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS
 from bayesian_consensus_engine_tpu.utils.config import (
     DEFAULT_CONFIDENCE,
@@ -41,12 +41,20 @@ from bayesian_consensus_engine_tpu.utils.config import (
 
 
 class MarketBlockState(NamedTuple):
-    """HBM-resident per-(market, source-slot) reliability state, (M, K)."""
+    """HBM-resident per-(market, source-slot) reliability state, (M, K).
+
+    ``exists`` may be ``None`` inside the cycle loop's carried state: the
+    mask is monotone (``exists | mask`` every step), so the loop tracks it
+    outside the carry and saves one full HBM tensor of read+write traffic
+    per cycle. A ``None``-exists state promises that cold slots already hold
+    the cold-start defaults (which :func:`init_block_state` guarantees and
+    the loop enforces with a one-time sanitise).
+    """
 
     reliability: jax.Array   # f[M, K] stored (undecayed) reliability
     confidence: jax.Array    # f[M, K]
     updated_days: jax.Array  # f[M, K] relative epoch-days of last update (0 ⇒ never)
-    exists: jax.Array        # bool[M, K] row-exists mask
+    exists: jax.Array | None  # bool[M, K] row-exists mask
 
 
 class CycleResult(NamedTuple):
@@ -72,11 +80,19 @@ def _cycle_math(
     with small K (the reduction becomes a K-deep sublane sum).
     """
     # 1. decay is a read transform; cold slots read the cold-start prior.
-    stored = decayed_reliability_at(
-        state.reliability, state.updated_days, now_days, state.exists
-    )
-    read_rel = jnp.where(state.exists, stored, DEFAULT_RELIABILITY)
-    read_conf = jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE)
+    if state.exists is None:
+        # Cold slots hold the defaults by contract (see MarketBlockState):
+        # gating decay on "ever updated" alone reproduces the masked reads.
+        read_rel = decayed_reliability_at(
+            state.reliability, state.updated_days, now_days, jnp.asarray(True)
+        )
+        read_conf = state.confidence
+    else:
+        stored = decayed_reliability_at(
+            state.reliability, state.updated_days, now_days, state.exists
+        )
+        read_rel = jnp.where(state.exists, stored, DEFAULT_RELIABILITY)
+        read_conf = jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE)
 
     # 2. weighted sums along the (possibly sharded) sources axis.
     w = jnp.where(mask, read_rel, 0.0)
@@ -98,22 +114,24 @@ def _cycle_math(
     correct = (probs >= 0.5) == jnp.expand_dims(outcome, slots_axis)
 
     # 4. capped update on the UNDECAYED stored state; only signalling slots.
-    new_rel, new_conf, new_updated = masked_outcome_update(
-        state.reliability,
-        jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE),
-        correct,
-        mask,
-        now_days,
-        state.updated_days,
-    )
-    # A cold slot's update starts from the cold-start prior, not stored 0.5*:
-    # stored reliability already defaults to DEFAULT_RELIABILITY at init, so
-    # reliability needs no special-casing; exists flips on for touched slots.
+    # A cold slot's update base is the cold-start prior (the reference's
+    # compute_update reads the defaulted record for missing rows,
+    # reference: reliability.py:161), not whatever the raw buffer holds;
+    # untouched slots pass through bit-identical (the reference never writes
+    # rows it wasn't asked to settle).
+    if state.exists is None:
+        update_base = state.reliability
+    else:
+        update_base = jnp.where(state.exists, state.reliability, DEFAULT_RELIABILITY)
+    updated_rel, updated_conf = outcome_update(update_base, read_conf, correct)
+    new_rel = jnp.where(mask, updated_rel, state.reliability)
+    new_conf = jnp.where(mask, updated_conf, state.confidence)
+    new_updated = jnp.where(mask, now_days, state.updated_days)
     new_state = MarketBlockState(
         reliability=new_rel,
         confidence=new_conf,
         updated_days=new_updated,
-        exists=state.exists | mask,
+        exists=None if state.exists is None else state.exists | mask,
     )
     return CycleResult(new_state, consensus, confidence_out, total_weight)
 
@@ -141,15 +159,32 @@ def build_cycle(
     block, market, slots_axis = _specs(slot_major)
     if mesh is None:
         fn = partial(_cycle_math, axis_name=None, slots_axis=slots_axis)
-    else:
-        state_spec = MarketBlockState(block, block, block, block)
+        return jax.jit(fn, donate_argnums=(3,) if donate else ())
+
+    # shard_map specs must mirror the state's pytree structure, which differs
+    # between exists-carrying and exists=None states — compile per structure.
+    compiled: dict[bool, object] = {}
+
+    def compile_for(has_exists: bool):
+        state_spec = MarketBlockState(
+            block, block, block, block if has_exists else None
+        )
         fn = shard_map(
             partial(_cycle_math, axis_name=SOURCES_AXIS, slots_axis=slots_axis),
             mesh=mesh,
             in_specs=(block, block, market, state_spec, P()),
             out_specs=CycleResult(state_spec, market, market, market),
         )
-    return jax.jit(fn, donate_argnums=(3,) if donate else ())
+        return jax.jit(fn, donate_argnums=(3,) if donate else ())
+
+    def cycle(probs, mask, outcome, state, now_days):
+        has_exists = state.exists is not None
+        fn = compiled.get(has_exists)
+        if fn is None:
+            fn = compiled[has_exists] = compile_for(has_exists)
+        return fn(probs, mask, outcome, state, now_days)
+
+    return cycle
 
 
 def build_cycle_loop(
@@ -167,20 +202,42 @@ def build_cycle_loop(
     ``steps`` is static: each distinct value compiles once.
     """
     block, market, slots_axis = _specs(slot_major)
-    compiled: dict[int, object] = {}
+    compiled: dict[tuple[int, bool], object] = {}
 
-    def compile_for(steps: int):
+    def compile_for(steps: int, has_exists: bool):
         def loop_math(probs, mask, outcome, state, now0):
             num_markets = outcome.shape[0]
 
+            # One-time sanitise, then drop `exists` from the carry: it is
+            # monotone under the fixed per-loop mask, so carrying it would
+            # re-read and re-write a full HBM tensor every cycle for a value
+            # reconstructible at the end (measured ~64 MiB/cycle at 1M×16).
+            # An exists=None input already promises defaulted cold slots.
+            if state.exists is None:
+                sanitised = state
+            else:
+                sanitised = MarketBlockState(
+                    reliability=jnp.where(
+                        state.exists, state.reliability, DEFAULT_RELIABILITY
+                    ),
+                    confidence=jnp.where(
+                        state.exists, state.confidence, DEFAULT_CONFIDENCE
+                    ),
+                    updated_days=jnp.where(state.exists, state.updated_days, 0.0),
+                    exists=None,
+                )
+
             def body(i, carry):
-                current, _ = carry
+                rel, conf, upd, _ = carry
                 result = _cycle_math(
-                    probs, mask, outcome, current, now0 + i,
+                    probs, mask, outcome,
+                    MarketBlockState(rel, conf, upd, None),
+                    now0 + i,
                     axis_name=SOURCES_AXIS if mesh is not None else None,
                     slots_axis=slots_axis,
                 )
-                return result.state, result.consensus
+                st = result.state
+                return st.reliability, st.confidence, st.updated_days, result.consensus
 
             init_consensus = jnp.zeros(num_markets, probs.dtype)
             if mesh is not None:
@@ -189,12 +246,40 @@ def build_cycle_loop(
                 init_consensus = jax.lax.pcast(
                     init_consensus, (MARKETS_AXIS,), to="varying"
                 )
-            return jax.lax.fori_loop(0, steps, body, (state, init_consensus))
+            rel, conf, upd, consensus = jax.lax.fori_loop(
+                0,
+                steps,
+                body,
+                (
+                    sanitised.reliability,
+                    sanitised.confidence,
+                    sanitised.updated_days,
+                    init_consensus,
+                ),
+            )
+            if steps == 0:
+                return state, init_consensus
+            if state.exists is None:
+                return MarketBlockState(rel, conf, upd, None), consensus
+            # Slots that never existed and never signalled pass through
+            # bit-identical, exactly as a chain of single cycles leaves them.
+            keep = state.exists | mask
+            return (
+                MarketBlockState(
+                    reliability=jnp.where(keep, rel, state.reliability),
+                    confidence=jnp.where(keep, conf, state.confidence),
+                    updated_days=jnp.where(keep, upd, state.updated_days),
+                    exists=keep,
+                ),
+                consensus,
+            )
 
         if mesh is None:
             fn = loop_math
         else:
-            state_spec = MarketBlockState(block, block, block, block)
+            state_spec = MarketBlockState(
+                block, block, block, block if has_exists else None
+            )
             fn = shard_map(
                 loop_math,
                 mesh=mesh,
@@ -204,12 +289,62 @@ def build_cycle_loop(
         return jax.jit(fn, donate_argnums=(3,) if donate else ())
 
     def loop(probs, mask, outcome, state, now0, steps: int):
-        fn = compiled.get(steps)
+        key = (steps, state.exists is not None)
+        fn = compiled.get(key)
         if fn is None:
-            fn = compiled[steps] = compile_for(steps)
+            fn = compiled[key] = compile_for(*key)
         return fn(probs, mask, outcome, state, now0)
 
     return loop
+
+
+def pad_markets(
+    probs: jax.Array,
+    mask: jax.Array,
+    outcome: jax.Array,
+    state: MarketBlockState | None = None,
+    multiple: int = 128,
+    slot_major: bool = True,
+) -> tuple[jax.Array, jax.Array, jax.Array, MarketBlockState | None, int]:
+    """Pad the markets axis up to a multiple of *multiple*.
+
+    TPU vector lanes are 128 wide; a markets axis that is not a lane multiple
+    leaves a ragged tail tile that costs ~20% of cycle throughput at 1M×16
+    (measured on v5e — see bench notes). Padded markets carry ``mask=False``
+    so they contribute zero weight, produce NaN consensus, and their state
+    rows stay cold; callers slice consensus back with ``[..., :num_markets]``.
+
+    Returns ``(probs, mask, outcome, state, padded_total)``; ``state=None``
+    passes through (build the padded state directly via
+    ``init_block_state(padded_total, ...)``).
+    """
+    markets_axis = 1 if slot_major else 0
+    num_markets = probs.shape[markets_axis]
+    padded_total = -(-num_markets // multiple) * multiple
+    extra = padded_total - num_markets
+    if extra == 0:
+        return probs, mask, outcome, state, padded_total
+
+    def pad_block(x, fill):
+        widths = [(0, 0), (0, 0)]
+        widths[markets_axis] = (0, extra)
+        return jnp.pad(x, widths, constant_values=fill)
+
+    padded_state = state
+    if state is not None:
+        padded_state = MarketBlockState(
+            reliability=pad_block(state.reliability, DEFAULT_RELIABILITY),
+            confidence=pad_block(state.confidence, DEFAULT_CONFIDENCE),
+            updated_days=pad_block(state.updated_days, 0.0),
+            exists=None if state.exists is None else pad_block(state.exists, False),
+        )
+    return (
+        pad_block(probs, 0),
+        pad_block(mask, False),
+        jnp.pad(outcome, (0, extra), constant_values=False),
+        padded_state,
+        padded_total,
+    )
 
 
 def init_block_state(

@@ -1,0 +1,104 @@
+"""Perf experiments on the real TPU: XLA cycle loop vs fused Pallas loop.
+
+Run:  python scripts/perf_experiments.py [--markets 1000000] [--steps 100]
+
+Compares, at the bench workload size (slot-major (K, M) float32):
+  * xla    — parallel.sharded.build_cycle_loop (the current bench path)
+  * pallas — ops.pallas_cycle fused kernel inside a lax.fori_loop
+
+Each timing fences with a scalar value fetch (block_until_ready does not
+force remote execution through the axon tunnel — see bench.py notes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.ops.pallas_cycle import (
+    SlotMajorState,
+    build_pallas_cycle,
+)
+from bayesian_consensus_engine_tpu.parallel import (
+    MarketBlockState,
+    build_cycle_loop,
+    init_block_state,
+)
+from bench import build_workload
+
+
+def time_loop(fn, *args, trials=3):
+    out = fn(*args)
+    _fence(out)
+    best = float("inf")
+    for _ in range(trials):
+        start = time.perf_counter()
+        out = fn(*args)
+        _fence(out)
+        best = min(best, time.perf_counter() - start)
+    return best, out
+
+
+def _fence(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    float(leaves[-1].reshape(-1)[0])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--markets", type=int, default=1_000_000)
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--tile", type=int, default=512)
+    args = ap.parse_args()
+
+    M, K, steps = args.markets, args.slots, args.steps
+    dtype = jnp.float32
+    probs, mask, outcome, _ = build_workload(jax.random.PRNGKey(0), M, K, dtype)
+    probs_t, mask_t = probs.T, mask.T  # (K, M)
+
+    # --- XLA loop (current bench path) --------------------------------------
+    loop = build_cycle_loop(mesh=None, slot_major=True, donate=False)
+    state = MarketBlockState(*(x.T for x in init_block_state(M, K, dtype=dtype)))
+    secs, _ = time_loop(
+        lambda: loop(probs_t, mask_t, outcome, state, jnp.asarray(1.0, dtype), steps)
+    )
+    print(f"xla    : {steps / secs:10.1f} cycles/sec  ({secs / steps * 1e3:.3f} ms/cycle)")
+
+    # --- Pallas fused loop ---------------------------------------------------
+    cycle = build_pallas_cycle(M, K, tile_markets=args.tile)
+
+    def pallas_loop_fn(probs, mask, outcome, state, now0, steps):
+        def body(i, carry):
+            st, _ = carry
+            st, consensus, _, _ = cycle(probs, mask, outcome, st, now0 + i)
+            return st, consensus
+
+        init = jnp.zeros((1, probs.shape[1]), probs.dtype)
+        return jax.lax.fori_loop(0, steps, body, (state, init))
+
+    pallas_loop = jax.jit(pallas_loop_fn, static_argnums=(5,))
+
+    f32 = lambda x: jnp.asarray(x, jnp.float32)
+    pstate = SlotMajorState(
+        reliability=jnp.full((K, M), 0.5, jnp.float32),
+        confidence=jnp.full((K, M), 0.25, jnp.float32),
+        updated_days=jnp.zeros((K, M), jnp.float32),
+        exists=jnp.zeros((K, M), jnp.float32),
+    )
+    pm, pk, po = f32(probs_t), f32(mask_t), f32(outcome)[None, :]
+    secs, _ = time_loop(
+        lambda: pallas_loop(pm, pk, po, pstate, jnp.float32(1.0), steps)
+    )
+    print(f"pallas : {steps / secs:10.1f} cycles/sec  ({secs / steps * 1e3:.3f} ms/cycle)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,178 @@
+"""Perf experiments round 2: bandwidth roofline + reduced-state cycle variants.
+
+Run: python scripts/perf_experiments2.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+
+from bench import build_workload
+
+M, K, STEPS = 1_048_576, 16, 100
+
+
+def time_loop(fn, *args, trials=3):
+    out = fn(*args)
+    float(jax.tree_util.tree_leaves(out)[-1].reshape(-1)[0])
+    best = float("inf")
+    for _ in range(trials):
+        start = time.perf_counter()
+        out = fn(*args)
+        float(jax.tree_util.tree_leaves(out)[-1].reshape(-1)[0])
+        best = min(best, time.perf_counter() - start)
+    return best
+
+
+def report(name, secs, nbytes):
+    per = secs / STEPS
+    print(
+        f"{name:24s}: {STEPS / secs:10.1f} cycles/sec  ({per * 1e3:.3f} ms/cycle, "
+        f"{nbytes / per / 1e9:.0f} GB/s effective)"
+    )
+
+
+def main():
+    dtype = jnp.float32
+    probs, mask, outcome, _ = build_workload(jax.random.PRNGKey(0), M, K, dtype)
+    probs, mask = probs.T, mask.T
+    mib = 1024 * 1024
+
+    # --- roofline probe: stream 3 (K, M) f32 arrays read+write in a loop ----
+    def stream_fn(a, b, c):
+        def body(i, carry):
+            a, b, c = carry
+            return (a * 1.000001 + 1e-9, b * 0.999999 + 1e-9, c + a * 1e-9)
+
+        return jax.lax.fori_loop(0, STEPS, body, (a, b, c))
+
+    stream = jax.jit(stream_fn)
+    arrs = [jnp.full((K, M), 0.5 + i * 0.1, dtype) for i in range(3)]
+    secs = time_loop(stream, *arrs)
+    report("stream 3rw", secs, 6 * 64 * mib)
+
+    # --- full 4-buffer cycle (current) --------------------------------------
+    from bayesian_consensus_engine_tpu.parallel import (
+        MarketBlockState,
+        build_cycle_loop,
+        init_block_state,
+    )
+
+    loop = build_cycle_loop(mesh=None, slot_major=True, donate=False)
+    state = MarketBlockState(*(x.T for x in init_block_state(M, K, dtype=dtype)))
+    secs = time_loop(
+        lambda: loop(probs, mask, outcome, state, jnp.asarray(1.0, dtype), STEPS)
+    )
+    report("xla 4-buf (current)", secs, (64 * 4 + 16 + 64 + 16 * 2 + 64 * 3) * mib)
+
+    # --- 3-buffer variant: exists dropped from the carried state ------------
+    # exists is monotone (exists | mask each step) and only gates reads for
+    # slots whose stored values are still the cold-start defaults — with state
+    # initialised at the defaults and decay gated on upd > 0, the masked reads
+    # are identical without it.
+    from bayesian_consensus_engine_tpu.utils.config import (
+        BASE_LEARNING_RATE,
+        CONFIDENCE_GROWTH_RATE,
+        DECAY_HALF_LIFE_DAYS,
+        DECAY_MINIMUM,
+        MAX_UPDATE_STEP,
+    )
+
+    def cycle3(probs, mask, outcome, rel, conf, upd, now):
+        elapsed = jnp.maximum(now - upd, 0.0)
+        factor = jnp.exp2(-elapsed / DECAY_HALF_LIFE_DAYS)
+        decayed = jnp.clip(
+            DECAY_MINIMUM + (rel - DECAY_MINIMUM) * factor, DECAY_MINIMUM, 1.0
+        )
+        read_rel = jnp.where(upd > 0, decayed, rel)
+        w = jnp.where(mask, read_rel, 0.0)
+        total_weight = jnp.sum(w, axis=0)
+        weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=0)
+        weighted_conf = jnp.sum(jnp.where(mask, conf, 0.0) * w, axis=0)
+        has_weight = total_weight != 0
+        safe_total = jnp.where(has_weight, total_weight, 1.0)
+        consensus = jnp.where(has_weight, weighted_prob / safe_total, jnp.nan)
+        correct = (probs >= 0.5) == outcome[None, :]
+        direction = jnp.where(correct, 1.0, -1.0)
+        delta = jnp.clip(
+            BASE_LEARNING_RATE * direction, -MAX_UPDATE_STEP, MAX_UPDATE_STEP
+        )
+        new_rel = jnp.where(mask, jnp.clip(rel + delta, 0.0, 1.0), rel)
+        new_conf = jnp.where(
+            mask, jnp.minimum(1.0, conf + (1.0 - conf) * CONFIDENCE_GROWTH_RATE), conf
+        )
+        new_upd = jnp.where(mask, now, upd)
+        return new_rel, new_conf, new_upd, consensus
+
+    def loop3_fn(probs, mask, outcome, rel, conf, upd, now0):
+        def body(i, carry):
+            rel, conf, upd, _ = carry
+            return cycle3(probs, mask, outcome, rel, conf, upd, now0 + i)
+
+        init = jnp.zeros(M, probs.dtype)
+        return jax.lax.fori_loop(0, STEPS, body, (rel, conf, upd, init))
+
+    loop3 = jax.jit(loop3_fn)
+    rel = jnp.full((K, M), 0.5, dtype)
+    conf = jnp.full((K, M), 0.25, dtype)
+    upd = jnp.zeros((K, M), dtype)
+    secs = time_loop(
+        lambda: loop3(probs, mask, outcome, rel, conf, upd, jnp.asarray(1.0, dtype))
+    )
+    report("xla 3-buf no-exists", secs, (64 * 3 + 16 + 64 + 64 * 3) * mib)
+
+    # --- 3-buf + precomputed masked probs (probs pre-zeroed where ~mask) ----
+    probs_masked = jnp.where(mask, probs, 0.0)
+
+    def cycle3b(probs_m, mask, outcome, rel, conf, upd, now):
+        elapsed = jnp.maximum(now - upd, 0.0)
+        factor = jnp.exp2(-elapsed / DECAY_HALF_LIFE_DAYS)
+        decayed = jnp.clip(
+            DECAY_MINIMUM + (rel - DECAY_MINIMUM) * factor, DECAY_MINIMUM, 1.0
+        )
+        read_rel = jnp.where(upd > 0, decayed, rel)
+        w = jnp.where(mask, read_rel, 0.0)
+        total_weight = jnp.sum(w, axis=0)
+        weighted_prob = jnp.sum(probs_m * w, axis=0)
+        weighted_conf = jnp.sum(jnp.where(mask, conf, 0.0) * w, axis=0)
+        has_weight = total_weight != 0
+        safe_total = jnp.where(has_weight, total_weight, 1.0)
+        consensus = jnp.where(has_weight, weighted_prob / safe_total, jnp.nan)
+        correct = (probs_m >= 0.5) == outcome[None, :]
+        direction = jnp.where(correct, 1.0, -1.0)
+        delta = jnp.clip(
+            BASE_LEARNING_RATE * direction, -MAX_UPDATE_STEP, MAX_UPDATE_STEP
+        )
+        new_rel = jnp.where(mask, jnp.clip(rel + delta, 0.0, 1.0), rel)
+        new_conf = jnp.where(
+            mask, jnp.minimum(1.0, conf + (1.0 - conf) * CONFIDENCE_GROWTH_RATE), conf
+        )
+        new_upd = jnp.where(mask, now, upd)
+        return new_rel, new_conf, new_upd, consensus
+
+    def loop3b_fn(probs_m, mask, outcome, rel, conf, upd, now0):
+        def body(i, carry):
+            rel, conf, upd, _ = carry
+            return cycle3b(probs_m, mask, outcome, rel, conf, upd, now0 + i)
+
+        init = jnp.zeros(M, probs_m.dtype)
+        return jax.lax.fori_loop(0, STEPS, body, (rel, conf, upd, init))
+
+    loop3b = jax.jit(loop3b_fn)
+    secs = time_loop(
+        lambda: loop3b(
+            probs_masked, mask, outcome, rel, conf, upd, jnp.asarray(1.0, dtype)
+        )
+    )
+    report("xla 3-buf premask", secs, (64 * 3 + 16 + 64 + 64 * 3) * mib)
+
+
+if __name__ == "__main__":
+    main()

@@ -80,12 +80,21 @@ class TestNativePythonEquivalence:
         import time
 
         markets = _random_markets(seed=1, num_markets=2000)
-        t0 = time.perf_counter()
+        # Warm both paths, then take best-of-3: a single-shot wall-clock
+        # comparison flakes on loaded CI runners (one scheduler stall can
+        # exceed any fixed margin).
         pack_markets(markets, native=True)
-        native_dt = time.perf_counter() - t0
-        t0 = time.perf_counter()
         pack_markets(markets, native=False)
-        python_dt = time.perf_counter() - t0
+
+        def best_of(native):
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                pack_markets(markets, native=native)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        native_dt, python_dt = best_of(True), best_of(False)
         # Non-regression guard only (real gain is ~1.3x; wide margin for CI
         # noise — this catches the native path becoming pathologically slow,
         # not small perf drift).

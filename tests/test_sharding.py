@@ -245,6 +245,91 @@ class TestCycleLoop:
             rtol=1e-6, equal_nan=True,
         )
 
+    def test_exists_none_state_accepted(self):
+        """A cycle's exists=None output state feeds back into loop and cycle."""
+        from bayesian_consensus_engine_tpu.parallel import build_cycle_loop
+
+        probs, mask, outcome, state, _now = _random_inputs(8)
+        none_state = MarketBlockState(
+            reliability=jnp.full((M, K), 0.5, jnp.float32),
+            confidence=jnp.full((M, K), 0.25, jnp.float32),
+            updated_days=jnp.zeros((M, K), jnp.float32),
+            exists=None,
+        )
+        single = build_cycle(mesh=None, donate=False)
+        r = single(probs, mask, outcome, none_state, jnp.float32(1.0))
+        assert r.state.exists is None
+
+        loop = build_cycle_loop(mesh=None, slot_major=False, donate=False)
+        loop_state, loop_consensus = loop(
+            probs, mask, outcome, r.state, jnp.float32(2.0), 2
+        )
+        assert loop_state.exists is None
+
+        # Equivalent exists-carrying run produces identical numbers.
+        full = MarketBlockState(
+            none_state.reliability,
+            none_state.confidence,
+            none_state.updated_days,
+            jnp.zeros((M, K), bool),
+        )
+        cur = single(probs, mask, outcome, full, jnp.float32(1.0)).state
+        ref_state, ref_consensus = loop(probs, mask, outcome, cur, jnp.float32(2.0), 2)
+        np.testing.assert_allclose(
+            np.asarray(loop_consensus), np.asarray(ref_consensus),
+            rtol=1e-6, equal_nan=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(loop_state.reliability), np.asarray(ref_state.reliability),
+            rtol=1e-6,
+        )
+
+        # Sharded variants accept both structures too.
+        mesh = make_mesh((4, 2))
+        sharded_single = build_cycle(mesh=mesh, donate=False)
+        sr = sharded_single(probs, mask, outcome, none_state, jnp.float32(1.0))
+        assert sr.state.exists is None
+        sharded_loop = build_cycle_loop(mesh=mesh, slot_major=False, donate=False)
+        ss, sc = sharded_loop(probs, mask, outcome, sr.state, jnp.float32(2.0), 2)
+        assert ss.exists is None
+        np.testing.assert_allclose(
+            np.asarray(sc), np.asarray(loop_consensus), rtol=1e-6, equal_nan=True
+        )
+
+    def test_padded_loop_matches_unpadded(self):
+        """Lane padding must not change any real market's outputs or state."""
+        from bayesian_consensus_engine_tpu.parallel import (
+            build_cycle_loop,
+            pad_markets,
+        )
+
+        probs, mask, outcome, state, _now = _random_inputs(6)
+        transposed = MarketBlockState(*(x.T for x in state))
+        loop = build_cycle_loop(mesh=None, slot_major=True, donate=False)
+        base_state, base_consensus = loop(
+            probs.T, mask.T, outcome, transposed, jnp.float32(50.0), 3
+        )
+
+        p_probs, p_mask, p_outcome, p_state, total = pad_markets(
+            probs.T, mask.T, outcome, transposed, multiple=128
+        )
+        assert total == 128 and p_probs.shape == (K, 128)
+        pad_state, pad_consensus = loop(
+            p_probs, p_mask, p_outcome, p_state, jnp.float32(50.0), 3
+        )
+        np.testing.assert_allclose(
+            np.asarray(pad_consensus)[:M], np.asarray(base_consensus),
+            rtol=1e-6, equal_nan=True,
+        )
+        assert np.isnan(np.asarray(pad_consensus)[M:]).all()
+        for field in MarketBlockState._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(pad_state, field))[:, :M],
+                np.asarray(getattr(base_state, field)),
+                rtol=1e-6,
+                err_msg=field,
+            )
+
 
 class TestDonation:
     def test_donated_state_buffer_reused(self):
