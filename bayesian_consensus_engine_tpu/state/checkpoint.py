@@ -1,0 +1,134 @@
+"""Checkpoint/resume for the device-resident settlement state.
+
+The reference's only durable state is its SQLite file — resume means
+reopening the DB (reference: reliability.py:36-45; persistence proven by
+reference tests/test_reliability.py:208-231). This framework keeps that
+story for drop-in compatibility (``TensorReliabilityStore.from_sqlite`` /
+``flush_to_sqlite``) and adds a TPU-native tier on top: orbax checkpoints
+of the HBM-resident cycle state, saved without leaving the JAX ecosystem.
+
+Two tiers, two jobs:
+
+  * **SQLite** — the interchange/archival format. Byte-compatible with the
+    reference CLI; holds the exact f64 host values and ISO timestamp strings.
+  * **Orbax** — the fast in-training-loop format. Saves the device pytree
+    (sharded arrays included) plus a JSON metadata blob (epoch0, step, user
+    extras) with atomic directory commits and retention, so a long-running
+    settlement loop can snapshot every N cycles and resume after preemption
+    without a host round-trip through strings.
+
+``MarketBlockState`` with ``exists=None`` (the cycle loop's reduced carry)
+checkpoints fine: ``None`` is an empty pytree subtree, and restore targets
+are taken from the ``like`` argument's structure.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional, Union
+
+import jax
+
+
+class CycleCheckpointer:
+    """Orbax-backed snapshot/resume for cycle-state pytrees.
+
+    Saves any JAX pytree (``MarketBlockState``, ``DeviceReliabilityState``,
+    plain dicts of arrays) together with a JSON-serialisable ``meta`` dict.
+    Writes are atomic (orbax commits a checkpoint directory only once fully
+    written) and pruned to ``max_to_keep`` most recent steps.
+    """
+
+    def __init__(self, directory: Union[str, Path], max_to_keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._directory = Path(directory).resolve()
+        self._manager = ocp.CheckpointManager(
+            self._directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                create=True,
+            ),
+        )
+
+    # -- write ---------------------------------------------------------------
+
+    def save(
+        self,
+        step: int,
+        state: Any,
+        meta: Optional[dict] = None,
+        force: bool = False,
+    ) -> bool:
+        """Snapshot *state* (+ JSON *meta*) as checkpoint *step*.
+
+        Returns True if a save happened (orbax may skip when an equal step
+        already exists unless ``force``).
+        """
+        ocp = self._ocp
+        saved = self._manager.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(meta or {}),
+            ),
+            force=force,
+        )
+        self._manager.wait_until_finished()
+        return bool(saved)
+
+    # -- read ----------------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._manager.all_steps())
+
+    def restore(
+        self,
+        step: Optional[int] = None,
+        like: Any = None,
+    ) -> tuple[Any, dict]:
+        """Restore ``(state, meta)`` from checkpoint *step* (default latest).
+
+        ``like`` — a pytree of arrays or ``jax.ShapeDtypeStruct`` with the
+        target structure/sharding/dtype; pass the pre-preemption template to
+        get arrays restored sharded onto the same mesh. Without it, arrays
+        come back host-resident with saved shapes/dtypes.
+        """
+        ocp = self._ocp
+        if step is None:
+            step = self._manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self._directory}")
+
+        if like is not None:
+            abstract = jax.tree.map(
+                lambda x: x
+                if isinstance(x, jax.ShapeDtypeStruct)
+                else jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+                ),
+                like,
+            )
+            state_args = ocp.args.StandardRestore(abstract)
+        else:
+            state_args = ocp.args.StandardRestore()
+        restored = self._manager.restore(
+            step,
+            args=ocp.args.Composite(state=state_args, meta=ocp.args.JsonRestore()),
+        )
+        return restored["state"], dict(restored["meta"] or {})
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        self._manager.close()
+
+    def __enter__(self) -> "CycleCheckpointer":
+        return self
+
+    def __exit__(self, *exc_info: object) -> None:
+        self.close()

@@ -53,10 +53,12 @@ def _fence(out):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--markets", type=int, default=1_000_000)
+    # Defaults must satisfy the pallas constraint markets % tile == 0 with
+    # tile a multiple of 128 (1_000_000 is not; 2^20 is the clean shape).
+    ap.add_argument("--markets", type=int, default=1_048_576)
     ap.add_argument("--slots", type=int, default=16)
     ap.add_argument("--steps", type=int, default=100)
-    ap.add_argument("--tile", type=int, default=512)
+    ap.add_argument("--tile", type=int, default=4096)
     args = ap.parse_args()
 
     M, K, steps = args.markets, args.slots, args.steps
