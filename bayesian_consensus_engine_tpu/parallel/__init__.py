@@ -9,6 +9,15 @@ from bayesian_consensus_engine_tpu.parallel.mesh import (
     shard_block,
     shard_market,
 )
+from bayesian_consensus_engine_tpu.parallel.ring import (
+    REDUCE_SPEC,
+    UPDATE_SPEC,
+    RingTieBreakResult,
+    build_ring_cycle,
+    build_ring_tiebreak,
+    reshard,
+    ring_allreduce,
+)
 from bayesian_consensus_engine_tpu.parallel.sharded import (
     CycleResult,
     MarketBlockState,
@@ -32,4 +41,11 @@ __all__ = [
     "build_cycle_loop",
     "init_block_state",
     "pad_markets",
+    "REDUCE_SPEC",
+    "UPDATE_SPEC",
+    "RingTieBreakResult",
+    "build_ring_cycle",
+    "build_ring_tiebreak",
+    "reshard",
+    "ring_allreduce",
 ]

@@ -1,0 +1,384 @@
+"""Ring / all-to-all source parallelism — the long-context tier.
+
+The reference has no sequences or attention (SURVEY §2.2): its scaling wall
+is the (markets × sources) loop nest (reference: market.py:200-221). The
+long-context analogue in this framework is the **sources axis**: one
+market's source row can outgrow a single device (10k sources × 1M markets
+is 40 GB per f32 tensor — past a v5e chip's HBM), so the slots axis is
+sharded over the mesh and per-market weight sums become cross-device
+reductions. This module maps the two classic long-sequence strategies onto
+that axis:
+
+* **Ring reduction** (ring attention's skeleton, Liu et al. 2023): each
+  device reduces its local slot chunk with a bounded working set
+  (``lax.scan`` over chunks), then the partial ``(Σw, Σp·w, Σc·w)`` triples
+  travel the ring one ``ppermute`` hop per step. Unlike attention, the
+  interaction is rank-1 (a segmented weighted sum, core.py:135-144), so
+  only the tiny per-market partials ride the ring — the O(M·K) blocks stay
+  put. The all-pairs (rank-2) case in this domain is the tie-break, below.
+* **All-to-all resharding** (DeepSpeed Ulysses' skeleton): the cycle has a
+  reduction phase that wants sources sharded and an elementwise update
+  phase that is embarrassingly parallel; :func:`reshard` flips a block
+  between the two layouts in one collective (XLA lowers the sharding flip
+  to an all-to-all over ICI).
+* **Ring tie-break**: grouping agents by rounded prediction
+  (reference: tiebreak.py:46-71) *is* an all-pairs interaction — each agent
+  needs group statistics over every agent with an equal key. At the
+  10k-source stress scale (SURVEY §7) the agents axis shards over the
+  mesh and blocks of (key, weight, reliability) rotate around the ring,
+  each device accumulating its local agents' group metrics against the
+  visiting block — exactly ring attention's "local queries vs visiting
+  keys/values" structure.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS
+from bayesian_consensus_engine_tpu.parallel.sharded import (
+    CycleResult,
+    MarketBlockState,
+    consensus_epilogue,
+    read_phase,
+    update_phase,
+)
+
+
+def ring_allreduce(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """Sum *x* over *axis_name* with an explicit ppermute ring.
+
+    Semantically identical to ``lax.psum(x, axis_name)`` (tested against
+    it); written out as the N-1-hop accumulation ring so the communication
+    schedule is explicit and each hop can overlap the caller's next chunk
+    of compute. ``axis_size`` is static (from ``mesh.shape``).
+    """
+    if axis_size == 1:
+        return x
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def hop(carry, _):
+        acc, buf = carry
+        buf = jax.lax.ppermute(buf, axis_name, perm)
+        return (acc + buf, buf), None
+
+    (acc, _), _ = jax.lax.scan(hop, (x, x), None, length=axis_size - 1)
+    return acc
+
+
+def build_ring_cycle(
+    mesh: Mesh,
+    chunk_slots: int | None = None,
+    donate: bool = True,
+):
+    """Consensus+update cycle with a chunked, ring-reduced sources axis.
+
+    Same contract as :func:`parallel.sharded.build_cycle` with a (M, K)
+    layout: blocked inputs shard as ``(markets, sources)``, per-market
+    outputs as ``(markets,)``. Differences, for the regime where the local
+    slot shard itself is long:
+
+    * the local reduction runs as a ``lax.scan`` over ``chunk_slots``-wide
+      chunks, bounding the live working set instead of materialising the
+      full masked/weighted (M_loc, K_loc) intermediates at once;
+    * the cross-device reduction is an explicit :func:`ring_allreduce`
+      instead of one fused psum.
+
+    Floating-point note: chunked+ring summation order differs from the
+    single-``jnp.sum`` path, so results match :func:`build_cycle` to fp
+    tolerance, not bit-exactly (the bit-exact contract lives in the scalar
+    engine; array paths are property-tested against it — SURVEY §7).
+    """
+    n_sources = mesh.shape[SOURCES_AXIS]
+    block = P(MARKETS_AXIS, SOURCES_AXIS)
+    market = P(MARKETS_AXIS)
+
+    def cycle_math(probs, mask, outcome, state, now_days):
+        read_rel, read_conf = read_phase(state, now_days)
+
+        k_loc = probs.shape[1]
+        chunk = chunk_slots or k_loc
+        n_chunks = -(-k_loc // chunk)
+        pad = n_chunks * chunk - k_loc
+
+        def pad_slots(x, fill):
+            return jnp.pad(x, ((0, 0), (0, pad)), constant_values=fill)
+
+        # (K_loc → n_chunks × chunk) so the scan streams chunk-sized slabs.
+        def chunked(x, fill):
+            padded = pad_slots(x, fill) if pad else x
+            return padded.reshape(x.shape[0], n_chunks, chunk).swapaxes(0, 1)
+
+        c_probs = chunked(probs, 0.0)
+        c_mask = chunked(mask, False)
+        c_rel = chunked(read_rel, 0.0)
+        c_conf = chunked(read_conf, 0.0)
+
+        def local_chunk(carry, slab):
+            tw, wp, wc = carry
+            p, m, r, c = slab
+            w = jnp.where(m, r, 0.0)
+            tw = tw + jnp.sum(w, axis=-1)
+            wp = wp + jnp.sum(jnp.where(m, p, 0.0) * w, axis=-1)
+            wc = wc + jnp.sum(jnp.where(m, c, 0.0) * w, axis=-1)
+            return (tw, wp, wc), None
+
+        zeros = jnp.zeros(probs.shape[0], probs.dtype)
+        (tw, wp, wc), _ = jax.lax.scan(
+            local_chunk, (zeros, zeros, zeros), (c_probs, c_mask, c_rel, c_conf)
+        )
+
+        # Partial triples ride the ring; one stacked buffer per hop.
+        triple = ring_allreduce(jnp.stack([tw, wp, wc]), SOURCES_AXIS, n_sources)
+        total_weight, weighted_prob, weighted_conf = triple
+
+        consensus, confidence_out = consensus_epilogue(
+            total_weight, weighted_prob, weighted_conf
+        )
+        # Update phase: elementwise, communication-free.
+        new_state = update_phase(
+            probs, mask, outcome, state, read_conf, now_days, slots_axis=-1
+        )
+        return CycleResult(new_state, consensus, confidence_out, total_weight)
+
+    # shard_map specs must mirror the state's pytree structure, which differs
+    # between exists-carrying and exists=None states — compile per structure
+    # (same pattern as sharded.build_cycle). check_vma=False: the ring
+    # produces a value-replicated result that the varying-manual-axes checker
+    # cannot prove replicated (ppermute+add has no invariant-producing type
+    # rule, unlike psum).
+    compiled: dict[bool, object] = {}
+
+    def compile_for(has_exists: bool):
+        state_spec = MarketBlockState(
+            block, block, block, block if has_exists else None
+        )
+        fn = shard_map(
+            cycle_math,
+            mesh=mesh,
+            in_specs=(block, block, market, state_spec, P()),
+            out_specs=CycleResult(state_spec, market, market, market),
+            check_vma=False,
+        )
+        return jax.jit(fn, donate_argnums=(3,) if donate else ())
+
+    def cycle(probs, mask, outcome, state, now_days):
+        has_exists = state.exists is not None
+        fn = compiled.get(has_exists)
+        if fn is None:
+            fn = compiled[has_exists] = compile_for(has_exists)
+        return fn(probs, mask, outcome, state, now_days)
+
+    return cycle
+
+
+def reshard(
+    x: jax.Array, mesh: Mesh, spec: P
+) -> jax.Array:
+    """Flip a block to *spec*'s layout in one collective (Ulysses-style).
+
+    The two layouts of interest for a (M, K) block:
+
+    * ``P(markets, sources)`` — reduction layout: each device holds a slot
+      shard of its market rows; weight sums need a sources-axis collective.
+    * ``P((markets, sources), None)`` — update layout: slots fully local,
+      markets split over every device; the elementwise update phase runs
+      with zero communication and perfect balance.
+
+    XLA lowers the flip to an all-to-all over ICI — the same exchange
+    DeepSpeed Ulysses uses to flip sequence↔head sharding.
+    """
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+UPDATE_SPEC = P((MARKETS_AXIS, SOURCES_AXIS), None)
+REDUCE_SPEC = P(MARKETS_AXIS, SOURCES_AXIS)
+
+
+class RingTieBreakResult(NamedTuple):
+    """Device-side tie-break outputs, one entry per market row.
+
+    ``resolved_by`` codes: 0 unanimous, 1 weight_density,
+    2 prediction_value_smallest — matching the scalar labels
+    (models/tiebreak.py, reference: tiebreak.py:119-133, including quirk #6:
+    a decision that actually fell to max_reliability still reports
+    weight_density).
+    """
+
+    prediction: jax.Array           # f[M] winning (rounded) prediction
+    weight_density: jax.Array       # f[M] winning group's density
+    max_reliability: jax.Array      # f[M] winning group's max reliability
+    resolved_by: jax.Array          # i32[M]
+    num_groups: jax.Array           # i32[M]
+    confidence_variance: jax.Array  # f[M] population variance over agents
+
+
+def build_ring_tiebreak(mesh: Mesh, precision: int = 6):
+    """Batched tie-break with the agents axis sharded and ring-rotated.
+
+    ``tiebreak(pred, weight, conf, rel, valid) -> RingTieBreakResult`` over
+    (M, A) blocks sharded ``P(markets, agents)`` (the agents axis rides the
+    mesh's sources axis). Blocks of (key, weight, reliability) rotate around
+    the ring; each device accumulates, for every local agent, its group's
+    {count, total_weight, max_reliability} against the visiting block —
+    ring attention's structure with group-key equality in place of QKᵀ.
+
+    Predictions are grouped on keys rounded to *precision* decimals
+    (reference: tiebreak.py:49-56); keys are quantised to int32 on device
+    (``round(pred·10^precision)``), which matches Python's ``round`` for
+    predictions that are not within float error of a half-ulp decimal tie.
+    Winner selection is the lexicographic hierarchy
+    (weight_density, max_reliability, smallest prediction)
+    (reference: tiebreak.py:112-117), realised as three masked pmax/pmin
+    passes; runner-up metrics are recomputed with the winner's group masked
+    out to classify ``resolved_by``.
+
+    Invalid lanes (``valid=False``) are padding: they join no group and
+    contribute nothing — the ragged-agents analogue of the cycle's mask.
+
+    Floating-point caveat: tie *classification* compares f32 group sums for
+    exact equality. The origin-ordered accumulation (see ``hop``) makes
+    those sums bit-identical across devices and rotation schedules, but a
+    tie the scalar engine sees in f64 can still split by one ulp in f32
+    (and vice versa) when group weight sums are not exactly representable —
+    the scalar tie-breaker remains the bit-exact contract; this path is the
+    at-scale batched one. (The reference's own f64 sums are insertion-order
+    dependent too, and its ``TIE_TOLERANCE`` constant is defined but never
+    enforced — reference quirk #2.)
+    """
+    n_agents_axis = mesh.shape[SOURCES_AXIS]
+    block = P(MARKETS_AXIS, SOURCES_AXIS)
+    market = P(MARKETS_AXIS)
+    scale = float(10**precision)
+    NEG = jnp.float32(-jnp.inf)
+
+    def lex_winner(keys, density, max_rel, pred_r, member):
+        """(density, max_rel, -pred) lexicographic argmax over valid agents.
+
+        Returns the winning group's (pred, density, max_rel) plus a
+        per-agent membership mask of that group. All reductions are
+        axis-local max/min followed by one psum-backed pmax/pmin.
+        """
+        d = jnp.where(member, density, NEG)
+        best_d = jax.lax.pmax(jnp.max(d, axis=-1), SOURCES_AXIS)
+        m1 = member & (density == best_d[:, None])
+
+        r = jnp.where(m1, max_rel, NEG)
+        best_r = jax.lax.pmax(jnp.max(r, axis=-1), SOURCES_AXIS)
+        m2 = m1 & (max_rel == best_r[:, None])
+
+        p = jnp.where(m2, pred_r, jnp.inf)
+        best_p = jax.lax.pmin(jnp.min(p, axis=-1), SOURCES_AXIS)
+        win_key = jnp.round(best_p * scale).astype(jnp.int32)
+        in_group = member & (keys == win_key[:, None])
+        return best_p, best_d, best_r, in_group
+
+    def tiebreak_math(pred, weight, conf, rel, valid):
+        pred = pred.astype(jnp.float32)
+        weight = weight.astype(jnp.float32)
+        conf = conf.astype(jnp.float32)
+        rel = rel.astype(jnp.float32)
+
+        keys = jnp.where(
+            valid, jnp.round(pred * scale).astype(jnp.int32), jnp.int32(-(2**31))
+        )
+        pred_r = keys.astype(jnp.float32) / scale  # the rounded prediction
+
+        # Ring accumulation of per-agent group stats. The rotating block
+        # carries (key, weight, rel, valid) stacked as f32. Float weight
+        # sums are accumulated into an origin-indexed buffer and reduced in
+        # fixed origin order 0..n-1 AFTER the ring completes: two agents of
+        # the same group on different devices then see bit-identical f32
+        # group sums (rotation arrival order differs per device; summing in
+        # arrival order would make exact tie detection device-dependent).
+        # count (int) and max-reliability are order-invariant and accumulate
+        # directly.
+        visiting0 = jnp.stack(
+            [keys.astype(jnp.float32), weight, rel, valid.astype(jnp.float32)]
+        )
+        perm = [(i, (i + 1) % n_agents_axis) for i in range(n_agents_axis)]
+        my_idx = jax.lax.axis_index(SOURCES_AXIS)
+
+        def hop(carry, t):
+            (count, tw_by_origin, mr), visiting = carry
+            v_key = visiting[0].astype(jnp.int32)
+            v_w, v_rel, v_valid = visiting[1], visiting[2], visiting[3] > 0
+            # (M, A_loc, A_visit) same-group mask — local agents × visitors.
+            same = (keys[:, :, None] == v_key[:, None, :]) & v_valid[:, None, :]
+            count = count + jnp.sum(same, axis=-1)
+            partial = jnp.sum(jnp.where(same, v_w[:, None, :], 0.0), axis=-1)
+            origin = jnp.mod(my_idx - t, n_agents_axis)
+            tw_by_origin = tw_by_origin.at[origin].set(partial)
+            mr = jnp.maximum(
+                mr, jnp.max(jnp.where(same, v_rel[:, None, :], NEG), axis=-1)
+            )
+            visiting = jax.lax.ppermute(visiting, SOURCES_AXIS, perm)
+            return ((count, tw_by_origin, mr), visiting), None
+
+        zero_i = jnp.zeros(keys.shape, jnp.int32)
+        zeros_by_origin = jnp.zeros((n_agents_axis,) + keys.shape, jnp.float32)
+        ((count, tw_by_origin, mr), _), _ = jax.lax.scan(
+            hop,
+            ((zero_i, zeros_by_origin, jnp.full(keys.shape, NEG)), visiting0),
+            jnp.arange(n_agents_axis),
+        )
+        tw = jnp.sum(tw_by_origin, axis=0)  # fixed origin order on every device
+
+        member = valid & (count > 0)
+        density = jnp.where(member, tw / jnp.maximum(count, 1), NEG)
+
+        best_p, best_d, best_r, in_win = lex_winner(
+            keys, density, mr, pred_r, member
+        )
+
+        # Runner-up: winner's group masked out, same hierarchy again.
+        others = member & ~in_win
+        ru_p, ru_d, ru_r, _ = lex_winner(keys, density, mr, pred_r, others)
+        any_other = jax.lax.psum(
+            jnp.sum(others, axis=-1), SOURCES_AXIS
+        ) > 0
+
+        # Σ 1/count over member agents counts the groups exactly.
+        inv = jnp.where(member, 1.0 / jnp.maximum(count, 1), 0.0)
+        num_groups = jnp.round(
+            jax.lax.psum(jnp.sum(inv, axis=-1), SOURCES_AXIS)
+        ).astype(jnp.int32)
+
+        full_tie = (best_d == ru_d) & (best_r == ru_r)
+        resolved_by = jnp.where(
+            ~any_other, 0, jnp.where(full_tie, 2, 1)
+        ).astype(jnp.int32)
+
+        # Population confidence variance over valid agents
+        # (reference: tiebreak.py:107-110).
+        n = jax.lax.psum(jnp.sum(valid, axis=-1), SOURCES_AXIS)
+        s1 = jax.lax.psum(jnp.sum(jnp.where(valid, conf, 0.0), axis=-1), SOURCES_AXIS)
+        s2 = jax.lax.psum(
+            jnp.sum(jnp.where(valid, conf * conf, 0.0), axis=-1), SOURCES_AXIS
+        )
+        nf = jnp.maximum(n, 1).astype(jnp.float32)
+        variance = jnp.maximum(s2 / nf - (s1 / nf) ** 2, 0.0)
+
+        return RingTieBreakResult(
+            prediction=best_p,
+            weight_density=best_d,
+            max_reliability=best_r,
+            resolved_by=resolved_by,
+            num_groups=num_groups,
+            confidence_variance=variance,
+        )
+
+    fn = shard_map(
+        tiebreak_math,
+        mesh=mesh,
+        in_specs=(block, block, block, block, block),
+        out_specs=RingTieBreakResult(*([market] * 6)),
+        check_vma=False,  # ring-accumulated stats defeat the vma checker
+    )
+    return jax.jit(fn)

@@ -64,6 +64,79 @@ class CycleResult(NamedTuple):
     total_weight: jax.Array   # f[M]
 
 
+def read_phase(
+    state: MarketBlockState, now_days: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Decay-on-read with cold-start defaults; returns (read_rel, read_conf).
+
+    Decay is a pure read transform; cold slots read the cold-start prior
+    (reference: core.py:110-112). With ``exists=None`` cold slots hold the
+    defaults by contract (see MarketBlockState), so gating decay on "ever
+    updated" alone reproduces the masked reads.
+    """
+    if state.exists is None:
+        read_rel = decayed_reliability_at(
+            state.reliability, state.updated_days, now_days, jnp.asarray(True)
+        )
+        read_conf = state.confidence
+    else:
+        stored = decayed_reliability_at(
+            state.reliability, state.updated_days, now_days, state.exists
+        )
+        read_rel = jnp.where(state.exists, stored, DEFAULT_RELIABILITY)
+        read_conf = jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE)
+    return read_rel, read_conf
+
+
+def consensus_epilogue(
+    total_weight: jax.Array,
+    weighted_prob: jax.Array,
+    weighted_conf: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Normalise the weighted sums; NaN consensus when total weight is 0.
+
+    Scalar parity: the reference tests ``total_weight == 0`` exactly
+    (core.py:131) and reports consensus ``None`` — NaN device-side.
+    """
+    has_weight = total_weight != 0
+    safe_total = jnp.where(has_weight, total_weight, 1.0)
+    consensus = jnp.where(has_weight, weighted_prob / safe_total, jnp.nan)
+    confidence_out = jnp.where(has_weight, weighted_conf / safe_total, 0.0)
+    return consensus, confidence_out
+
+
+def update_phase(
+    probs: jax.Array,
+    mask: jax.Array,
+    outcome: jax.Array,
+    state: MarketBlockState,
+    read_conf: jax.Array,
+    now_days: jax.Array,
+    slots_axis: int = -1,
+) -> MarketBlockState:
+    """Outcome correctness + capped update on the UNDECAYED stored state.
+
+    Correctness is predicted-true iff p >= 0.5 (reference: market.py:296-303)
+    judged against the market outcome. A cold slot's update base is the
+    cold-start prior (the reference's compute_update reads the defaulted
+    record for missing rows, reference: reliability.py:161), not whatever
+    the raw buffer holds; untouched slots pass through bit-identical (the
+    reference never writes rows it wasn't asked to settle).
+    """
+    correct = (probs >= 0.5) == jnp.expand_dims(outcome, slots_axis)
+    if state.exists is None:
+        update_base = state.reliability
+    else:
+        update_base = jnp.where(state.exists, state.reliability, DEFAULT_RELIABILITY)
+    updated_rel, updated_conf = outcome_update(update_base, read_conf, correct)
+    return MarketBlockState(
+        reliability=jnp.where(mask, updated_rel, state.reliability),
+        confidence=jnp.where(mask, updated_conf, state.confidence),
+        updated_days=jnp.where(mask, now_days, state.updated_days),
+        exists=None if state.exists is None else state.exists | mask,
+    )
+
+
 def _cycle_math(
     probs: jax.Array,        # f[M, K] per-slot mean probability ((K, M) if slots_axis=0)
     mask: jax.Array,         # bool[M, K] slot has a signal
@@ -79,22 +152,9 @@ def _cycle_math(
     128-wide lane dimension, which measures ~25% faster on TPU than (M, K)
     with small K (the reduction becomes a K-deep sublane sum).
     """
-    # 1. decay is a read transform; cold slots read the cold-start prior.
-    if state.exists is None:
-        # Cold slots hold the defaults by contract (see MarketBlockState):
-        # gating decay on "ever updated" alone reproduces the masked reads.
-        read_rel = decayed_reliability_at(
-            state.reliability, state.updated_days, now_days, jnp.asarray(True)
-        )
-        read_conf = state.confidence
-    else:
-        stored = decayed_reliability_at(
-            state.reliability, state.updated_days, now_days, state.exists
-        )
-        read_rel = jnp.where(state.exists, stored, DEFAULT_RELIABILITY)
-        read_conf = jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE)
+    read_rel, read_conf = read_phase(state, now_days)
 
-    # 2. weighted sums along the (possibly sharded) sources axis.
+    # Weighted sums along the (possibly sharded) sources axis.
     w = jnp.where(mask, read_rel, 0.0)
     total_weight = jnp.sum(w, axis=slots_axis)
     weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=slots_axis)
@@ -104,34 +164,11 @@ def _cycle_math(
         weighted_prob = jax.lax.psum(weighted_prob, axis_name)
         weighted_conf = jax.lax.psum(weighted_conf, axis_name)
 
-    has_weight = total_weight != 0  # scalar parity: reference tests == 0 (core.py:131)
-    safe_total = jnp.where(has_weight, total_weight, 1.0)
-    consensus = jnp.where(has_weight, weighted_prob / safe_total, jnp.nan)
-    confidence_out = jnp.where(has_weight, weighted_conf / safe_total, 0.0)
-
-    # 3. binary correctness: predicted-true iff p >= 0.5 (reference:
-    #    market.py:296-303), judged against the market outcome.
-    correct = (probs >= 0.5) == jnp.expand_dims(outcome, slots_axis)
-
-    # 4. capped update on the UNDECAYED stored state; only signalling slots.
-    # A cold slot's update base is the cold-start prior (the reference's
-    # compute_update reads the defaulted record for missing rows,
-    # reference: reliability.py:161), not whatever the raw buffer holds;
-    # untouched slots pass through bit-identical (the reference never writes
-    # rows it wasn't asked to settle).
-    if state.exists is None:
-        update_base = state.reliability
-    else:
-        update_base = jnp.where(state.exists, state.reliability, DEFAULT_RELIABILITY)
-    updated_rel, updated_conf = outcome_update(update_base, read_conf, correct)
-    new_rel = jnp.where(mask, updated_rel, state.reliability)
-    new_conf = jnp.where(mask, updated_conf, state.confidence)
-    new_updated = jnp.where(mask, now_days, state.updated_days)
-    new_state = MarketBlockState(
-        reliability=new_rel,
-        confidence=new_conf,
-        updated_days=new_updated,
-        exists=None if state.exists is None else state.exists | mask,
+    consensus, confidence_out = consensus_epilogue(
+        total_weight, weighted_prob, weighted_conf
+    )
+    new_state = update_phase(
+        probs, mask, outcome, state, read_conf, now_days, slots_axis
     )
     return CycleResult(new_state, consensus, confidence_out, total_weight)
 

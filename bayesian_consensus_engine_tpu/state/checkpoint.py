@@ -63,7 +63,11 @@ class CycleCheckpointer:
     ) -> bool:
         """Snapshot *state* (+ JSON *meta*) as checkpoint *step*.
 
-        Returns True if a save happened (orbax may skip when an equal step
+        The write is asynchronous: orbax snapshots the device buffers and
+        commits the directory in the background so the settlement loop keeps
+        running; the next ``save``/``restore``/``close`` (or an explicit
+        :meth:`wait`) joins the pending write before proceeding. Returns
+        True if a save was started (orbax may skip when an equal step
         already exists unless ``force``).
         """
         ocp = self._ocp
@@ -75,15 +79,20 @@ class CycleCheckpointer:
             ),
             force=force,
         )
-        self._manager.wait_until_finished()
         return bool(saved)
+
+    def wait(self) -> None:
+        """Block until any in-flight async save has fully committed."""
+        self._manager.wait_until_finished()
 
     # -- read ----------------------------------------------------------------
 
     def latest_step(self) -> Optional[int]:
+        self._manager.wait_until_finished()  # join any in-flight async save
         return self._manager.latest_step()
 
     def all_steps(self) -> list[int]:
+        self._manager.wait_until_finished()
         return sorted(self._manager.all_steps())
 
     def restore(
@@ -99,6 +108,7 @@ class CycleCheckpointer:
         come back host-resident with saved shapes/dtypes.
         """
         ocp = self._ocp
+        self._manager.wait_until_finished()  # join any in-flight async save
         if step is None:
             step = self._manager.latest_step()
         if step is None:

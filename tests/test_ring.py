@@ -1,0 +1,346 @@
+"""Ring / all-to-all source parallelism on the virtual 8-device CPU mesh.
+
+The ring cycle must agree with the psum cycle and the unsharded cycle; the
+explicit ppermute ring-allreduce must agree with psum; the ring tie-break
+must agree with the scalar ``DeterministicTieBreaker`` on every metric it
+reports (winner, density, max reliability, resolution label, group count,
+confidence variance).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from bayesian_consensus_engine_tpu.models.tiebreak import (
+    AgentSignal,
+    DeterministicTieBreaker,
+)
+from bayesian_consensus_engine_tpu.parallel import (
+    MarketBlockState,
+    build_cycle,
+    make_mesh,
+)
+from bayesian_consensus_engine_tpu.parallel.mesh import (
+    MARKETS_AXIS,
+    SOURCES_AXIS,
+    block_sharding,
+)
+from bayesian_consensus_engine_tpu.parallel.ring import (
+    REDUCE_SPEC,
+    UPDATE_SPEC,
+    build_ring_cycle,
+    build_ring_tiebreak,
+    reshard,
+    ring_allreduce,
+)
+
+M, K = 32, 16
+
+
+def _random_inputs(seed=0):
+    rng = np.random.default_rng(seed)
+    probs = jnp.asarray(rng.random((M, K)), dtype=jnp.float32)
+    mask = jnp.asarray(rng.random((M, K)) < 0.7)
+    outcome = jnp.asarray(rng.random(M) < 0.5)
+    state = MarketBlockState(
+        reliability=jnp.asarray(rng.uniform(0.1, 1.0, (M, K)), dtype=jnp.float32),
+        confidence=jnp.asarray(rng.uniform(0.0, 1.0, (M, K)), dtype=jnp.float32),
+        updated_days=jnp.asarray(
+            rng.choice([0.0, 5.0, 40.0, 400.0], (M, K)), dtype=jnp.float32
+        ),
+        exists=jnp.asarray(rng.random((M, K)) < 0.6),
+    )
+    now = jnp.float32(401.0)
+    return probs, mask, outcome, state, now
+
+
+class TestRingAllreduce:
+    @pytest.mark.parametrize("s_axis", [2, 4, 8])
+    def test_matches_psum(self, s_axis):
+        mesh = make_mesh((8 // s_axis, s_axis))
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.random((M, K)), dtype=jnp.float32)
+
+        def via_ring(x):
+            return ring_allreduce(jnp.sum(x, axis=-1), SOURCES_AXIS, s_axis)
+
+        def via_psum(x):
+            return jax.lax.psum(jnp.sum(x, axis=-1), SOURCES_AXIS)
+
+        spec = P(MARKETS_AXIS, SOURCES_AXIS)
+        out_spec = P(MARKETS_AXIS)
+        ring = shard_map(
+            via_ring, mesh=mesh, in_specs=spec, out_specs=out_spec, check_vma=False
+        )
+        psum = shard_map(via_psum, mesh=mesh, in_specs=spec, out_specs=out_spec)
+        np.testing.assert_allclose(
+            np.asarray(ring(x)), np.asarray(psum(x)), rtol=1e-6
+        )
+
+    def test_single_shard_identity(self):
+        mesh = make_mesh((8, 1))
+
+        def f(x):
+            return ring_allreduce(x, SOURCES_AXIS, 1)
+
+        fn = shard_map(
+            f,
+            mesh=mesh,
+            in_specs=P(MARKETS_AXIS, SOURCES_AXIS),
+            out_specs=P(MARKETS_AXIS, SOURCES_AXIS),
+            check_vma=False,
+        )
+        x = jnp.arange(M * K, dtype=jnp.float32).reshape(M, K)
+        np.testing.assert_array_equal(np.asarray(fn(x)), np.asarray(x))
+
+
+class TestRingCycle:
+    @pytest.mark.parametrize("shape", [(1, 8), (2, 4), (4, 2)])
+    @pytest.mark.parametrize("chunk_slots", [None, 3, 8])
+    def test_matches_psum_cycle(self, shape, chunk_slots):
+        mesh = make_mesh(shape)
+        inputs = _random_inputs()
+        baseline = build_cycle(make_mesh((8, 1)), donate=False)(*inputs)
+        ring = build_ring_cycle(mesh, chunk_slots=chunk_slots, donate=False)(*inputs)
+
+        np.testing.assert_allclose(
+            np.asarray(ring.consensus),
+            np.asarray(baseline.consensus),
+            rtol=2e-6,
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ring.confidence),
+            np.asarray(baseline.confidence),
+            rtol=2e-6,
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ring.total_weight),
+            np.asarray(baseline.total_weight),
+            rtol=2e-6,
+        )
+        # The update phase is elementwise and order-independent: exact.
+        for got, want in zip(ring.state, baseline.state):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_exists_none_reduced_carry(self):
+        # The cycle loop's reduced carry (exists=None, cold slots already at
+        # the defaults) must run through the ring cycle and match the psum
+        # cycle on the same state.
+        from bayesian_consensus_engine_tpu.utils.config import (
+            DEFAULT_CONFIDENCE,
+            DEFAULT_RELIABILITY,
+        )
+
+        mesh = make_mesh((2, 4))
+        probs, mask, outcome, state, now = _random_inputs()
+        reduced = MarketBlockState(
+            reliability=jnp.where(state.exists, state.reliability, DEFAULT_RELIABILITY),
+            confidence=jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE),
+            updated_days=jnp.where(state.exists, state.updated_days, 0.0),
+            exists=None,
+        )
+        baseline = build_cycle(make_mesh((8, 1)), donate=False)(
+            probs, mask, outcome, reduced, now
+        )
+        ring = build_ring_cycle(mesh, chunk_slots=4, donate=False)(
+            probs, mask, outcome, reduced, now
+        )
+        np.testing.assert_allclose(
+            np.asarray(ring.consensus),
+            np.asarray(baseline.consensus),
+            rtol=2e-6,
+            atol=1e-6,
+        )
+        assert ring.state.exists is None
+        for got, want in zip(ring.state[:3], baseline.state[:3]):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_no_signals_market(self):
+        mesh = make_mesh((2, 4))
+        probs, mask, outcome, state, now = _random_inputs()
+        mask = mask.at[0].set(False)
+        result = build_ring_cycle(mesh, donate=False)(
+            probs, mask, outcome, state, now
+        )
+        out = np.asarray(result.consensus)
+        assert np.isnan(out[0])
+        assert np.asarray(result.total_weight)[0] == 0.0
+
+
+class TestReshard:
+    def test_round_trip_and_layouts(self):
+        mesh = make_mesh((2, 4))
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.random((M, K)), dtype=jnp.float32)
+        x_reduce = reshard(x, mesh, REDUCE_SPEC)
+        x_update = reshard(x_reduce, mesh, UPDATE_SPEC)
+        assert x_update.sharding.spec == UPDATE_SPEC
+        back = reshard(x_update, mesh, REDUCE_SPEC)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+    def test_update_layout_fully_splits_markets(self):
+        mesh = make_mesh((2, 4))
+        x = jnp.zeros((M, K), dtype=jnp.float32)
+        x_update = reshard(x, mesh, UPDATE_SPEC)
+        shard_shapes = {s.data.shape for s in x_update.addressable_shards}
+        assert shard_shapes == {(M // 8, K)}
+
+
+def _scalar_resolve(agents):
+    pred, diag = DeterministicTieBreaker().resolve(agents)
+    return pred, diag
+
+
+_LABELS = {0: "unanimous", 1: "weight_density", 2: "prediction_value_smallest"}
+
+
+class TestRingTieBreak:
+    def _run_one(self, agents, mesh, a_total=16):
+        """One market row, padded to *a_total* agent lanes."""
+        n = len(agents)
+        pad = a_total - n
+        pred = jnp.asarray(
+            [[a.prediction for a in agents] + [0.0] * pad], dtype=jnp.float32
+        )
+        weight = jnp.asarray(
+            [[a.weight for a in agents] + [0.0] * pad], dtype=jnp.float32
+        )
+        conf = jnp.asarray(
+            [[a.confidence for a in agents] + [0.0] * pad], dtype=jnp.float32
+        )
+        rel = jnp.asarray(
+            [[a.reliability_score for a in agents] + [0.0] * pad],
+            dtype=jnp.float32,
+        )
+        valid = jnp.asarray([[True] * n + [False] * pad])
+        # markets axis of size 1 → mesh (1, 8): all devices on agents.
+        result = build_ring_tiebreak(mesh)(pred, weight, conf, rel, valid)
+        return jax.tree.map(lambda x: np.asarray(x)[0], result)
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        return make_mesh((1, 8))
+
+    def test_density_winner(self, mesh):
+        agents = [
+            AgentSignal("a", 0.7, 0.9, weight=2.0, reliability_score=0.8),
+            AgentSignal("b", 0.7, 0.8, weight=2.0, reliability_score=0.6),
+            AgentSignal("c", 0.3, 0.7, weight=1.0, reliability_score=0.9),
+        ]
+        want_pred, want_diag = _scalar_resolve(list(agents))
+        got = self._run_one(agents, mesh)
+        assert got.prediction == pytest.approx(want_pred, abs=1e-6)
+        assert _LABELS[int(got.resolved_by)] == want_diag.tie_resolved_by
+        assert int(got.num_groups) == len(want_diag.groups)
+        assert got.confidence_variance == pytest.approx(
+            want_diag.confidence_variance, abs=1e-5
+        )
+
+    def test_reliability_breaks_density_tie_labeled_density(self, mesh):
+        # Quirk #6: decision falls to max_reliability, label stays
+        # weight_density.
+        agents = [
+            AgentSignal("a", 0.6, 0.5, weight=1.0, reliability_score=0.9),
+            AgentSignal("b", 0.4, 0.5, weight=1.0, reliability_score=0.2),
+        ]
+        want_pred, want_diag = _scalar_resolve(list(agents))
+        got = self._run_one(agents, mesh)
+        assert got.prediction == pytest.approx(want_pred, abs=1e-6)
+        assert want_diag.tie_resolved_by == "weight_density"
+        assert _LABELS[int(got.resolved_by)] == "weight_density"
+
+    def test_full_tie_smallest_prediction(self, mesh):
+        agents = [
+            AgentSignal("a", 0.8, 0.5, weight=1.0, reliability_score=0.5),
+            AgentSignal("b", 0.2, 0.5, weight=1.0, reliability_score=0.5),
+        ]
+        want_pred, want_diag = _scalar_resolve(list(agents))
+        got = self._run_one(agents, mesh)
+        assert want_pred == 0.2
+        assert got.prediction == pytest.approx(0.2, abs=1e-6)
+        assert want_diag.tie_resolved_by == "prediction_value_smallest"
+        assert _LABELS[int(got.resolved_by)] == "prediction_value_smallest"
+
+    def test_unanimous(self, mesh):
+        agents = [
+            AgentSignal("a", 0.55, 0.5, weight=1.0, reliability_score=0.5),
+            AgentSignal("b", 0.55, 0.9, weight=3.0, reliability_score=0.7),
+        ]
+        _, want_diag = _scalar_resolve(list(agents))
+        got = self._run_one(agents, mesh)
+        assert want_diag.tie_resolved_by == "unanimous"
+        assert _LABELS[int(got.resolved_by)] == "unanimous"
+        assert int(got.num_groups) == 1
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_parity_with_scalar(self, mesh, seed):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(2, 16))
+        # Predictions on a coarse grid: decimal-exact at precision 6, and
+        # coarse enough to actually form groups.
+        agents = [
+            AgentSignal(
+                f"a{i}",
+                float(rng.choice([0.1, 0.25, 0.5, 0.75, 0.9])),
+                float(rng.uniform(0, 1)),
+                weight=float(rng.uniform(0.1, 3.0)),
+                reliability_score=float(rng.uniform(0, 1)),
+            )
+            for i in range(n)
+        ]
+        want_pred, want_diag = _scalar_resolve(list(agents))
+        got = self._run_one(agents, mesh)
+        assert got.prediction == pytest.approx(want_pred, abs=1e-6)
+        assert int(got.num_groups) == len(want_diag.groups)
+        want_group = want_diag.groups[round(want_pred, 6)]
+        assert got.weight_density == pytest.approx(
+            want_group["weight_density"], abs=1e-3
+        )
+        assert got.max_reliability == pytest.approx(
+            want_group["max_reliability"], abs=1e-3
+        )
+        assert got.confidence_variance == pytest.approx(
+            want_diag.confidence_variance, abs=1e-4
+        )
+
+    def test_big_batch_many_markets(self, mesh):
+        # (M markets × 64 agents) batched tie-break, agents ring-sharded.
+        rng = np.random.default_rng(42)
+        m, a = 16, 64
+        grid = np.array([0.1, 0.3, 0.5, 0.7, 0.9])
+        pred = jnp.asarray(rng.choice(grid, (m, a)), dtype=jnp.float32)
+        weight = jnp.asarray(rng.uniform(0.1, 2.0, (m, a)), dtype=jnp.float32)
+        conf = jnp.asarray(rng.uniform(0, 1, (m, a)), dtype=jnp.float32)
+        rel = jnp.asarray(rng.uniform(0, 1, (m, a)), dtype=jnp.float32)
+        valid = jnp.asarray(rng.random((m, a)) < 0.9)
+
+        result = build_ring_tiebreak(mesh)(pred, weight, conf, rel, valid)
+        breaker = DeterministicTieBreaker()
+        for row in range(m):
+            agents = [
+                AgentSignal(
+                    f"s{j}",
+                    float(pred[row, j]),
+                    float(conf[row, j]),
+                    weight=float(weight[row, j]),
+                    reliability_score=float(rel[row, j]),
+                )
+                for j in range(a)
+                if bool(valid[row, j])
+            ]
+            if not agents:
+                continue
+            want_pred, want_diag = breaker.resolve(agents)
+            assert np.asarray(result.prediction)[row] == pytest.approx(
+                want_pred, abs=1e-6
+            ), f"row {row}"
+            assert (
+                _LABELS[int(np.asarray(result.resolved_by)[row])]
+                == want_diag.tie_resolved_by
+            ), f"row {row}"
