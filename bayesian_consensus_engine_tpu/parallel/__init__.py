@@ -9,6 +9,14 @@ from bayesian_consensus_engine_tpu.parallel.mesh import (
     shard_block,
     shard_market,
 )
+from bayesian_consensus_engine_tpu.parallel.distributed import (
+    global_block,
+    global_market,
+    init_distributed,
+    local_view,
+    make_hybrid_mesh,
+    process_market_rows,
+)
 from bayesian_consensus_engine_tpu.parallel.ring import (
     REDUCE_SPEC,
     UPDATE_SPEC,
@@ -41,6 +49,12 @@ __all__ = [
     "build_cycle_loop",
     "init_block_state",
     "pad_markets",
+    "global_block",
+    "global_market",
+    "init_distributed",
+    "local_view",
+    "make_hybrid_mesh",
+    "process_market_rows",
     "REDUCE_SPEC",
     "UPDATE_SPEC",
     "RingTieBreakResult",

@@ -1,0 +1,219 @@
+"""Multi-host distributed backend — ICI × DCN meshes and global arrays.
+
+The reference is a single process with no communication backend of any kind
+(SURVEY §5: no NCCL/MPI/Gloo/UCX anywhere). The TPU-native equivalent is
+not a custom transport: JAX's runtime carries collectives over ICI within a
+slice and DCN across slices/hosts, and this module lays the workload out so
+the framework's one real collective — the sources-axis ``psum``/ring of the
+cycle (parallel/sharded.py, parallel/ring.py) — always rides ICI:
+
+  * **markets axis = DCN-outer.** Markets are pure data parallelism; the
+    cycle needs zero cross-market communication, so splitting markets
+    across hosts/slices puts exactly nothing on the slow wire.
+  * **sources axis = ICI-only.** The weight-sum reduction stays inside a
+    slice, on the fast interconnect.
+
+Multi-process bring-up is ``init_distributed()`` (a thin, idempotent wrapper
+over ``jax.distributed.initialize``), then ``make_hybrid_mesh()`` for the
+(markets, sources) mesh with DCN outermost, then ``global_block()`` /
+``global_market()`` to assemble globally-sharded arrays from each process's
+local rows — each host feeds only its own market rows (e.g. from its own
+ingest shard, native/fastpack.c) and no host ever materialises the full
+(M, K) block.
+
+Single-process (including the CPU test mesh) everything degrades to the
+plain local mesh, so the same program text runs from a laptop to a
+multi-slice pod — the driver's ``dryrun_multichip`` path and the unit tests
+exercise exactly this code with virtual devices.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS
+
+_BLOCK_SPEC = P(MARKETS_AXIS, SOURCES_AXIS)
+_MARKET_SPEC = P(MARKETS_AXIS)
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs: Any,
+) -> dict:
+    """Join (or no-op into) the multi-process JAX runtime; return a summary.
+
+    On managed TPU pods every argument auto-detects (the TPU metadata server
+    provides coordinator/process info); elsewhere pass
+    ``coordinator_address="host:port"``, ``num_processes`` and
+    ``process_id`` explicitly. Safe to call twice and safe to call in a
+    plain single-process run: an already-initialised or unneeded runtime is
+    reported, never an error.
+    """
+    # IMPORTANT: nothing here may touch the backend (jax.devices()/
+    # process_count()/...) before initialize() — backend queries initialise
+    # XLA, after which jax.distributed.initialize() unconditionally raises.
+    wants_cluster = coordinator_address is not None or (
+        num_processes is not None and num_processes > 1
+    )
+    if wants_cluster:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+        except RuntimeError as err:
+            # Tolerate ONLY repeat initialisation (idempotence contract);
+            # real bring-up failures (coordinator unreachable, barrier
+            # timeout, backend already initialised by an earlier JAX call)
+            # must surface — swallowing them would silently degrade a pod
+            # run to disconnected single-process runs.
+            if "should only be called once" not in str(err):
+                raise
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def make_hybrid_mesh(
+    ici_shape: Optional[tuple[int, int]] = None,
+    num_granules: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the (markets, sources) mesh with the DCN dimension outermost.
+
+    *ici_shape* — per-granule (markets, sources) layout; default puts every
+    in-granule device on markets (reductions stay device-local, the
+    mesh.py default policy). *num_granules* — DCN-connected groups
+    (slices/hosts); auto-detected from device ``slice_index`` (TPU) or
+    ``process_index`` when absent, matching mesh_utils' granule notion.
+
+    The returned mesh's markets axis is ``num_granules × ici_markets`` with
+    the granule dimension outermost, so a ``P(markets, sources)``-sharded
+    block never moves source-reduction traffic across DCN.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+
+    def granule_key(d: jax.Device):
+        slice_index = getattr(d, "slice_index", None)
+        return slice_index if slice_index is not None else d.process_index
+
+    if num_granules is None:
+        num_granules = len({granule_key(d) for d in devices})
+    if len(devices) % num_granules:
+        raise ValueError(
+            f"{len(devices)} devices do not split over {num_granules} granules"
+        )
+
+    per_granule = len(devices) // num_granules
+    if ici_shape is None:
+        ici_shape = (per_granule, 1)
+    m_ici, s_ici = ici_shape
+    if m_ici * s_ici != per_granule:
+        raise ValueError(
+            f"ici_shape {ici_shape} needs {m_ici * s_ici} devices per granule, "
+            f"have {per_granule} ({len(devices)} over {num_granules} granules)"
+        )
+
+    # Stable granule-major device order (sorted by slice/process, then id),
+    # ICI-topology-aware layout within each granule when mesh_utils can
+    # compute one, plain row-major otherwise (CPU test meshes).
+    ordered = sorted(devices, key=lambda d: (granule_key(d), d.id))
+    granule_grids = []
+    for g in range(num_granules):
+        members = ordered[g * per_granule : (g + 1) * per_granule]
+        try:
+            from jax.experimental import mesh_utils
+
+            grid = mesh_utils.create_device_mesh(
+                (m_ici, s_ici), devices=members, contiguous_submeshes=False
+            )
+        except (ValueError, AssertionError, NotImplementedError):
+            grid = np.asarray(members).reshape(m_ici, s_ici)
+        granule_grids.append(grid)
+    # (granules × ici_markets, sources): DCN outer on the markets axis.
+    grid = np.concatenate(granule_grids, axis=0)
+    return Mesh(grid, (MARKETS_AXIS, SOURCES_AXIS))
+
+
+def process_market_rows(num_markets: int, mesh: Mesh) -> tuple[int, int]:
+    """[start, stop) of the global markets axis owned by this process.
+
+    With the DCN-outer layout each process owns one contiguous band of
+    market rows; this is the slice its ingest pipeline should produce.
+    ``num_markets`` must divide evenly over the markets axis.
+    """
+    sharding = NamedSharding(mesh, _MARKET_SPEC)
+    shape = (num_markets,)
+    lo = None
+    hi = None
+    for d, index in sharding.devices_indices_map(shape).items():
+        if d.process_index != jax.process_index():
+            continue
+        sl = index[0]
+        start = sl.start or 0
+        stop = sl.stop if sl.stop is not None else num_markets
+        lo = start if lo is None else min(lo, start)
+        hi = stop if hi is None else max(hi, stop)
+    if lo is None:
+        raise ValueError("this process owns no devices in the mesh")
+    return lo, hi
+
+
+def global_block(local_rows: np.ndarray, mesh: Mesh, num_markets: int) -> jax.Array:
+    """Assemble a globally-(markets, sources)-sharded block from local rows.
+
+    *local_rows* is this process's band of the (num_markets, K) block (the
+    :func:`process_market_rows` slice, full K width). No process ever holds
+    the global array; JAX stitches the per-process shards into one global
+    ``jax.Array``. Single-process this is just a sharded ``device_put``.
+    """
+    sharding = NamedSharding(mesh, _BLOCK_SPEC)
+    global_shape = (num_markets,) + tuple(local_rows.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_rows), global_shape
+    )
+
+
+def global_market(local_rows: np.ndarray, mesh: Mesh, num_markets: int) -> jax.Array:
+    """Assemble a globally-(markets,)-sharded per-market vector."""
+    sharding = NamedSharding(mesh, _MARKET_SPEC)
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_rows), (num_markets,)
+    )
+
+
+def local_view(array: jax.Array) -> np.ndarray:
+    """This process's rows of a markets-sharded array, in global row order.
+
+    The inverse of :func:`global_block`/:func:`global_market` for reading
+    results back at the host boundary (e.g. flushing settled reliability to
+    this host's SQLite shard) without gathering the global array anywhere.
+    """
+    bands: dict[int, list[tuple[int, np.ndarray]]] = {}
+    for s in array.addressable_shards:
+        if s.replica_id != 0:
+            continue
+        idx = s.index
+        row0 = idx[0].start or 0
+        col0 = (idx[1].start or 0) if len(idx) > 1 else 0
+        bands.setdefault(row0, []).append((col0, np.asarray(s.data)))
+    if not bands:
+        raise ValueError("this process holds no shards of the array")
+    stitched = []
+    for row0 in sorted(bands):
+        cols = [data for _, data in sorted(bands[row0], key=lambda t: t[0])]
+        stitched.append(np.concatenate(cols, axis=1) if len(cols) > 1 else cols[0])
+    return np.concatenate(stitched, axis=0) if len(stitched) > 1 else stitched[0]

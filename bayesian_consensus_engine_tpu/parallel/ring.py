@@ -33,7 +33,6 @@ that axis:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -296,9 +295,20 @@ def build_ring_tiebreak(mesh: Mesh, precision: int = 6):
         # fixed origin order 0..n-1 AFTER the ring completes: two agents of
         # the same group on different devices then see bit-identical f32
         # group sums (rotation arrival order differs per device; summing in
-        # arrival order would make exact tie detection device-dependent).
-        # count (int) and max-reliability are order-invariant and accumulate
-        # directly.
+        # arrival order would make exact tie detection device-dependent —
+        # same-group members on different homes would disagree about their
+        # own group's total by an ulp and the equality masks in lex_winner
+        # would split the group). count (int) and max-reliability are
+        # order-invariant and accumulate directly.
+        #
+        # Memory tradeoff, made deliberately: the buffer is ring_size× one
+        # block shard (ring_size · M_loc · A_loc f32). Exactness requires
+        # it — any O(1)-memory schedule sums in device-dependent order
+        # (f32 addition commutes but does not associate). Tie-breaking is
+        # the diagnostics path, not the settlement hot loop; at the
+        # 10k-agent stress scale, shard markets too (M_loc shrinks with the
+        # markets axis) — the transient (M_loc, A_loc, A_visit) compare
+        # masks, not this buffer, are then the larger term.
         visiting0 = jnp.stack(
             [keys.astype(jnp.float32), weight, rel, valid.astype(jnp.float32)]
         )
@@ -312,9 +322,9 @@ def build_ring_tiebreak(mesh: Mesh, precision: int = 6):
             # (M, A_loc, A_visit) same-group mask — local agents × visitors.
             same = (keys[:, :, None] == v_key[:, None, :]) & v_valid[:, None, :]
             count = count + jnp.sum(same, axis=-1)
-            partial = jnp.sum(jnp.where(same, v_w[:, None, :], 0.0), axis=-1)
+            partial_tw = jnp.sum(jnp.where(same, v_w[:, None, :], 0.0), axis=-1)
             origin = jnp.mod(my_idx - t, n_agents_axis)
-            tw_by_origin = tw_by_origin.at[origin].set(partial)
+            tw_by_origin = tw_by_origin.at[origin].set(partial_tw)
             mr = jnp.maximum(
                 mr, jnp.max(jnp.where(same, v_rel[:, None, :], NEG), axis=-1)
             )
