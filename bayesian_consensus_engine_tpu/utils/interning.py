@@ -12,9 +12,19 @@ tuples through this class.
 
 from __future__ import annotations
 
-from typing import Dict, Hashable, Iterable, List, TypeVar
+from typing import Dict, Hashable, Iterable, List, Sequence, Tuple, TypeVar
+
+import numpy as np
 
 K = TypeVar("K", bound=Hashable)
+
+
+def _load_internmap():
+    try:
+        from bayesian_consensus_engine_tpu._native import internmap
+    except ImportError:
+        return None
+    return internmap
 
 
 class IdInterner:
@@ -61,3 +71,96 @@ class IdInterner:
     def items(self):
         """(key, row) pairs."""
         return self._to_row.items()
+
+    # Batch forms (array-returning) so callers can be backend-agnostic with
+    # PairInterner; keys here are (a, b) string pairs.
+    def intern_arrays(
+        self, sources: Sequence[str], markets: Sequence[str]
+    ) -> np.ndarray:
+        return np.asarray(
+            [self.intern((s, m)) for s, m in zip(sources, markets)],
+            dtype=np.int32,
+        )
+
+    def lookup_arrays(
+        self, sources: Sequence[str], markets: Sequence[str]
+    ) -> np.ndarray:
+        return np.asarray(
+            [self.get((s, m)) for s, m in zip(sources, markets)], dtype=np.int32
+        )
+
+
+class NativePairInterner:
+    """(source, market) → row map over the C ``internmap`` extension.
+
+    Same first-seen row contract and surface as :class:`IdInterner`
+    restricted to string-pair keys, plus batch array methods whose hot loop
+    runs in one C pass (native/internmap.c) and returns int32 buffers ready
+    for device upload. Construct via :func:`make_pair_interner`, which
+    falls back to IdInterner when the extension is not built.
+    """
+
+    __slots__ = ("_map",)
+
+    def __init__(self, _internmap_module=None) -> None:
+        module = _internmap_module or _load_internmap()
+        if module is None:
+            raise RuntimeError(
+                "native internmap extension not built; run python native/build.py"
+            )
+        self._map = module.InternMap()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __contains__(self, pair: Tuple[str, str]) -> bool:
+        return self._map.lookup_pair(pair[0], pair[1]) >= 0
+
+    def intern(self, pair: Tuple[str, str]) -> int:
+        return self._map.intern_pair(pair[0], pair[1])
+
+    def intern_all(self, pairs: Iterable[Tuple[str, str]]) -> List[int]:
+        return [self._map.intern_pair(a, b) for a, b in pairs]
+
+    def lookup(self, pair: Tuple[str, str]) -> int:
+        row = self._map.lookup_pair(pair[0], pair[1])
+        if row < 0:
+            raise KeyError(pair)
+        return row
+
+    def get(self, pair: Tuple[str, str], default: int = -1) -> int:
+        row = self._map.lookup_pair(pair[0], pair[1])
+        return row if row >= 0 else default
+
+    def id_of(self, row: int) -> Tuple[str, str]:
+        return self._map.id_of(row)
+
+    def ids(self) -> List[Tuple[str, str]]:
+        return self._map.ids()
+
+    def items(self):
+        return [(key, row) for row, key in enumerate(self._map.ids())]
+
+    def intern_arrays(
+        self, sources: Sequence[str], markets: Sequence[str]
+    ) -> np.ndarray:
+        buf = self._map.intern_pairs(list(sources), list(markets))
+        return np.frombuffer(buf, dtype=np.int32)
+
+    def lookup_arrays(
+        self, sources: Sequence[str], markets: Sequence[str]
+    ) -> np.ndarray:
+        # Lookups never insert; loop singles in C (no lookup batch needed —
+        # the allocating path dominates at ingest).
+        return np.asarray(
+            [self._map.lookup_pair(s, m) for s, m in zip(sources, markets)],
+            dtype=np.int32,
+        )
+
+
+def make_pair_interner():
+    """Native pair interner when the C extension is built, else IdInterner."""
+    module = _load_internmap()
+    if module is None:
+        return IdInterner()
+    return NativePairInterner(module)

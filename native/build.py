@@ -1,14 +1,19 @@
-"""Build the native fastpack extension in place.
+"""Build the native extensions in place.
 
 Usage:  python native/build.py
 
-Compiles native/fastpack.c into bayesian_consensus_engine_tpu/_native/
-(fastpack*.so). The framework works without it — core.batch falls back to
-the pure-Python packer. Measured gain on dict-shaped payloads is a modest
-~1.3x (the pass is PyObject-bound either way); the extension mainly keeps
-the ingest path off the GIL-heavy Python bytecode loop and is the template
-for columnar native ingest if payload shape ever allows it. No third-party
-build deps: the system compiler only.
+Compiles every ``native/*.c`` CPython extension into
+``bayesian_consensus_engine_tpu/_native/`` (``<name>*.so``):
+
+  * ``fastpack`` — signal-ingest packer (core/batch.py falls back to the
+    pure-Python packer without it). Measured gain on dict-shaped payloads
+    is a modest ~1.3x (the pass is PyObject-bound either way).
+  * ``internmap`` — batch id/pair interning for the host boundary
+    (utils/interning.py falls back to the dict-backed IdInterner). Batch
+    pair interning returns an int32 buffer ready for device upload.
+
+The framework works fully without any of them. No third-party build deps:
+the system compiler only.
 """
 
 import pathlib
@@ -19,24 +24,24 @@ import subprocess
 import tempfile
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
-SOURCE = ROOT / "native" / "fastpack.c"
+NATIVE_DIR = ROOT / "native"
 DEST_DIR = ROOT / "bayesian_consensus_engine_tpu" / "_native"
 
 
-def build() -> pathlib.Path:
+def build_one(source: pathlib.Path) -> pathlib.Path:
     DEST_DIR.mkdir(exist_ok=True)
     (DEST_DIR / "__init__.py").touch()
 
     suffix = sysconfig.get_config_var("EXT_SUFFIX")
-    dest = DEST_DIR / f"fastpack{suffix}"
+    dest = DEST_DIR / f"{source.stem}{suffix}"
     include = sysconfig.get_path("include")
     cc = sysconfig.get_config_var("CC") or "cc"
 
     with tempfile.TemporaryDirectory() as tmp:
-        obj = pathlib.Path(tmp) / "fastpack.o"
-        so = pathlib.Path(tmp) / "fastpack.so"
+        obj = pathlib.Path(tmp) / f"{source.stem}.o"
+        so = pathlib.Path(tmp) / f"{source.stem}.so"
         subprocess.run(
-            [*cc.split(), "-O2", "-fPIC", f"-I{include}", "-c", str(SOURCE), "-o", str(obj)],
+            [*cc.split(), "-O2", "-fPIC", f"-I{include}", "-c", str(source), "-o", str(obj)],
             check=True,
         )
         link = [*cc.split(), "-shared", str(obj), "-o", str(so)]
@@ -48,12 +53,30 @@ def build() -> pathlib.Path:
     return dest
 
 
+def build() -> list[pathlib.Path]:
+    return [build_one(src) for src in sorted(NATIVE_DIR.glob("*.c"))]
+
+
 if __name__ == "__main__":
-    path = build()
-    sys.path.insert(0, str(path.parent))
+    paths = build()
+    sys.path.insert(0, str(DEST_DIR))
+
     import fastpack  # smoke import
 
     out = fastpack.pack([("m", [{"sourceId": "b", "probability": 0.5},
                                 {"sourceId": "a", "probability": 0.25}])])
     assert out[1] == ["a", "b"], out
-    print(f"built + smoke-tested: {path}")
+
+    import internmap  # smoke import
+
+    m = internmap.InternMap()
+    assert m.intern("alpha") == 0 and m.intern("beta") == 1
+    assert m.intern("alpha") == 0 and len(m) == 2
+    assert m.intern_pair("s", "mkt") == 2
+    assert bytes(m.intern_pairs(["s", "t"], ["mkt", "mkt"])) == (
+        (2).to_bytes(4, sys.byteorder) + (3).to_bytes(4, sys.byteorder)
+    )
+    assert m.lookup("gone") == -1 and m.id_of(2) == ("s", "mkt")
+
+    for path in paths:
+        print(f"built + smoke-tested: {path}")

@@ -1,0 +1,452 @@
+/* internmap — native id-interning hash for bayesian_consensus_engine_tpu.
+ *
+ * The TPU state tensors are keyed by dense int32 rows; ids — source ids and
+ * (source_id, market_id) pairs — are interned to rows at the host boundary
+ * (utils/interning.py, state/tensor_store.py). At ingest scale (millions of
+ * pairs per settlement batch) the pure-Python dict loop pays per-item
+ * bytecode dispatch, tuple construction, and PyLong boxing; this module
+ * provides batch interning in one C pass over an open-addressing FNV-1a
+ * table, returning a ready-to-upload int32 buffer (wrap with
+ * numpy.frombuffer, no copies).
+ *
+ * Contract: row assignment is first-seen order, identical to the Python
+ * IdInterner (equivalence enforced by tests/test_internmap.py). Pair keys
+ * are the two UTF-8 strings joined by a NUL byte — NUL cannot occur inside
+ * either half (validated in the wrapper; the reference caps ids at 256
+ * chars and its validator rejects empty ids, reference: config.py:37-38).
+ *
+ * API (all methods on InternMap):
+ *   intern(str) -> int                      single string key
+ *   intern_pair(str, str) -> int           single pair key
+ *   intern_batch(seq[str]) -> bytearray            int32 rows, len*4 bytes
+ *   intern_pairs(seq[str], seq[str]) -> bytearray  elementwise pair keys
+ *   lookup(str) -> int        (-1 when absent; no insertion)
+ *   lookup_pair(str, str) -> int
+ *   __len__() -> unique keys; ids() -> list (row order; str or (str, str))
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef struct {
+    uint64_t hash;     /* 0 means empty (FNV-1a output is remapped off 0) */
+    int32_t row;
+    uint32_t key_len;
+    char *key;         /* owned copy of the key bytes */
+} slot_t;
+
+typedef struct {
+    PyObject_HEAD
+    slot_t *slots;
+    size_t capacity;   /* power of two */
+    size_t used;
+    PyObject *ids;     /* list of interned id objects, row order */
+} InternMap;
+
+static uint64_t
+fnv1a(const char *data, size_t len)
+{
+    uint64_t h = 1469598103934665603ULL;
+    for (size_t i = 0; i < len; i++) {
+        h ^= (unsigned char)data[i];
+        h *= 1099511628211ULL;
+    }
+    return h ? h : 1ULL;  /* reserve 0 for "empty slot" */
+}
+
+static int
+map_resize(InternMap *self, size_t new_capacity)
+{
+    slot_t *fresh = PyMem_Calloc(new_capacity, sizeof(slot_t));
+    if (!fresh) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    size_t mask = new_capacity - 1;
+    for (size_t i = 0; i < self->capacity; i++) {
+        slot_t *old = &self->slots[i];
+        if (!old->hash) continue;
+        size_t j = old->hash & mask;
+        while (fresh[j].hash) j = (j + 1) & mask;
+        fresh[j] = *old;
+    }
+    PyMem_Free(self->slots);
+    self->slots = fresh;
+    self->capacity = new_capacity;
+    return 0;
+}
+
+/* Find or insert the key; returns the row, or -1 on error. *id_factory* is
+ * called (with *factory_arg*) to build the Python object appended to ids
+ * only when the key is new. */
+typedef PyObject *(*id_factory_t)(void *arg);
+
+static int32_t
+map_intern(InternMap *self, const char *key, size_t len,
+           id_factory_t id_factory, void *factory_arg)
+{
+    if (self->used * 3 >= self->capacity * 2) {
+        if (map_resize(self, self->capacity * 2) < 0) return -1;
+    }
+    uint64_t h = fnv1a(key, len);
+    size_t mask = self->capacity - 1;
+    size_t i = h & mask;
+    while (self->slots[i].hash) {
+        slot_t *s = &self->slots[i];
+        if (s->hash == h && s->key_len == len && memcmp(s->key, key, len) == 0)
+            return s->row;
+        i = (i + 1) & mask;
+    }
+    if (PyList_GET_SIZE(self->ids) >= INT32_MAX) {
+        PyErr_SetString(PyExc_OverflowError, "more than 2^31-1 interned ids");
+        return -1;
+    }
+    char *copy = PyMem_Malloc(len ? len : 1);
+    if (!copy) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    memcpy(copy, key, len);
+    PyObject *id_obj = id_factory(factory_arg);
+    if (!id_obj) {
+        PyMem_Free(copy);
+        return -1;
+    }
+    if (PyList_Append(self->ids, id_obj) < 0) {
+        Py_DECREF(id_obj);
+        PyMem_Free(copy);
+        return -1;
+    }
+    Py_DECREF(id_obj);
+    int32_t row = (int32_t)(PyList_GET_SIZE(self->ids) - 1);
+    self->slots[i].hash = h;
+    self->slots[i].row = row;
+    self->slots[i].key_len = (uint32_t)len;
+    self->slots[i].key = copy;
+    self->used++;
+    return row;
+}
+
+static int32_t
+map_lookup(InternMap *self, const char *key, size_t len)
+{
+    uint64_t h = fnv1a(key, len);
+    size_t mask = self->capacity - 1;
+    size_t i = h & mask;
+    while (self->slots[i].hash) {
+        slot_t *s = &self->slots[i];
+        if (s->hash == h && s->key_len == len && memcmp(s->key, key, len) == 0)
+            return s->row;
+        i = (i + 1) & mask;
+    }
+    return -1;
+}
+
+/* ---- key building -------------------------------------------------------- */
+
+static PyObject *
+factory_incref(void *arg)
+{
+    PyObject *obj = (PyObject *)arg;
+    Py_INCREF(obj);
+    return obj;
+}
+
+static PyObject *
+factory_pair(void *arg)
+{
+    PyObject **pair = (PyObject **)arg;
+    return PyTuple_Pack(2, pair[0], pair[1]);
+}
+
+/* UTF-8 view of a str; sets error and returns NULL on non-str. */
+static const char *
+utf8_of(PyObject *obj, Py_ssize_t *len)
+{
+    if (!PyUnicode_Check(obj)) {
+        PyErr_Format(PyExc_TypeError, "expected str, got %.100s",
+                     Py_TYPE(obj)->tp_name);
+        return NULL;
+    }
+    return PyUnicode_AsUTF8AndSize(obj, len);
+}
+
+/* Joined "a\0b" key in *scratch (grown as needed). Returns length or -1. */
+static Py_ssize_t
+pair_key(PyObject *a, PyObject *b, char **scratch, Py_ssize_t *scratch_cap)
+{
+    Py_ssize_t alen, blen;
+    const char *abuf = utf8_of(a, &alen);
+    if (!abuf) return -1;
+    const char *bbuf = utf8_of(b, &blen);
+    if (!bbuf) return -1;
+    if (memchr(abuf, '\0', (size_t)alen) || memchr(bbuf, '\0', (size_t)blen)) {
+        PyErr_SetString(PyExc_ValueError, "ids must not contain NUL");
+        return -1;
+    }
+    Py_ssize_t need = alen + 1 + blen;
+    if (need > *scratch_cap) {
+        char *grown = PyMem_Realloc(*scratch, (size_t)(need * 2));
+        if (!grown) {
+            PyErr_NoMemory();
+            return -1;
+        }
+        *scratch = grown;
+        *scratch_cap = need * 2;
+    }
+    memcpy(*scratch, abuf, (size_t)alen);
+    (*scratch)[alen] = '\0';
+    memcpy(*scratch + alen + 1, bbuf, (size_t)blen);
+    return need;
+}
+
+/* ---- methods ------------------------------------------------------------- */
+
+static PyObject *
+InternMap_intern(InternMap *self, PyObject *arg)
+{
+    Py_ssize_t len;
+    const char *buf = utf8_of(arg, &len);
+    if (!buf) return NULL;
+    int32_t row = map_intern(self, buf, (size_t)len, factory_incref, arg);
+    if (row < 0) return NULL;
+    return PyLong_FromLong(row);
+}
+
+static PyObject *
+InternMap_intern_pair(InternMap *self, PyObject *args)
+{
+    PyObject *a, *b;
+    if (!PyArg_ParseTuple(args, "OO", &a, &b)) return NULL;
+    char *scratch = NULL;
+    Py_ssize_t cap = 0;
+    Py_ssize_t len = pair_key(a, b, &scratch, &cap);
+    if (len < 0) {
+        PyMem_Free(scratch);
+        return NULL;
+    }
+    PyObject *pair[2] = {a, b};
+    int32_t row = map_intern(self, scratch, (size_t)len, factory_pair, pair);
+    PyMem_Free(scratch);
+    if (row < 0) return NULL;
+    return PyLong_FromLong(row);
+}
+
+static PyObject *
+InternMap_intern_batch(InternMap *self, PyObject *arg)
+{
+    PyObject *fast = PySequence_Fast(arg, "expected a sequence of str");
+    if (!fast) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    PyObject *out = PyByteArray_FromStringAndSize(NULL, n * 4);
+    if (!out) {
+        Py_DECREF(fast);
+        return NULL;
+    }
+    int32_t *rows = (int32_t *)PyByteArray_AS_STRING(out);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+        Py_ssize_t len;
+        const char *buf = utf8_of(item, &len);
+        if (!buf) goto fail;
+        int32_t row = map_intern(self, buf, (size_t)len, factory_incref, item);
+        if (row < 0) goto fail;
+        rows[i] = row;
+    }
+    Py_DECREF(fast);
+    return out;
+fail:
+    Py_DECREF(fast);
+    Py_DECREF(out);
+    return NULL;
+}
+
+static PyObject *
+InternMap_intern_pairs(InternMap *self, PyObject *args)
+{
+    PyObject *seq_a, *seq_b;
+    if (!PyArg_ParseTuple(args, "OO", &seq_a, &seq_b)) return NULL;
+    PyObject *fast_a = PySequence_Fast(seq_a, "expected a sequence of str");
+    if (!fast_a) return NULL;
+    PyObject *fast_b = PySequence_Fast(seq_b, "expected a sequence of str");
+    if (!fast_b) {
+        Py_DECREF(fast_a);
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast_a);
+    if (PySequence_Fast_GET_SIZE(fast_b) != n) {
+        PyErr_SetString(PyExc_ValueError, "sequences must have equal length");
+        Py_DECREF(fast_a);
+        Py_DECREF(fast_b);
+        return NULL;
+    }
+    PyObject *out = PyByteArray_FromStringAndSize(NULL, n * 4);
+    char *scratch = NULL;
+    Py_ssize_t cap = 0;
+    if (!out) goto fail;
+    int32_t *rows = (int32_t *)PyByteArray_AS_STRING(out);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *a = PySequence_Fast_GET_ITEM(fast_a, i);
+        PyObject *b = PySequence_Fast_GET_ITEM(fast_b, i);
+        Py_ssize_t len = pair_key(a, b, &scratch, &cap);
+        if (len < 0) goto fail;
+        PyObject *pair[2] = {a, b};
+        int32_t row = map_intern(self, scratch, (size_t)len, factory_pair, pair);
+        if (row < 0) goto fail;
+        rows[i] = row;
+    }
+    PyMem_Free(scratch);
+    Py_DECREF(fast_a);
+    Py_DECREF(fast_b);
+    return out;
+fail:
+    PyMem_Free(scratch);
+    Py_XDECREF(out);
+    Py_DECREF(fast_a);
+    Py_DECREF(fast_b);
+    return NULL;
+}
+
+static PyObject *
+InternMap_lookup(InternMap *self, PyObject *arg)
+{
+    Py_ssize_t len;
+    const char *buf = utf8_of(arg, &len);
+    if (!buf) return NULL;
+    return PyLong_FromLong(map_lookup(self, buf, (size_t)len));
+}
+
+static PyObject *
+InternMap_lookup_pair(InternMap *self, PyObject *args)
+{
+    PyObject *a, *b;
+    if (!PyArg_ParseTuple(args, "OO", &a, &b)) return NULL;
+    char *scratch = NULL;
+    Py_ssize_t cap = 0;
+    Py_ssize_t len = pair_key(a, b, &scratch, &cap);
+    if (len < 0) {
+        PyMem_Free(scratch);
+        return NULL;
+    }
+    long row = map_lookup(self, scratch, (size_t)len);
+    PyMem_Free(scratch);
+    return PyLong_FromLong(row);
+}
+
+static PyObject *
+InternMap_ids(InternMap *self, PyObject *Py_UNUSED(ignored))
+{
+    return PyList_GetSlice(self->ids, 0, PyList_GET_SIZE(self->ids));
+}
+
+static PyObject *
+InternMap_id_of(InternMap *self, PyObject *arg)
+{
+    Py_ssize_t row = PyLong_AsSsize_t(arg);
+    if (row == -1 && PyErr_Occurred()) return NULL;
+    if (row < 0 || row >= PyList_GET_SIZE(self->ids)) {
+        PyErr_SetString(PyExc_IndexError, "row out of range");
+        return NULL;
+    }
+    PyObject *obj = PyList_GET_ITEM(self->ids, row);
+    Py_INCREF(obj);
+    return obj;
+}
+
+static Py_ssize_t
+InternMap_len(InternMap *self)
+{
+    return PyList_GET_SIZE(self->ids);
+}
+
+/* ---- type ---------------------------------------------------------------- */
+
+static PyObject *
+InternMap_new(PyTypeObject *type, PyObject *Py_UNUSED(args),
+              PyObject *Py_UNUSED(kwargs))
+{
+    InternMap *self = (InternMap *)type->tp_alloc(type, 0);
+    if (!self) return NULL;
+    self->capacity = 64;
+    self->used = 0;
+    self->slots = PyMem_Calloc(self->capacity, sizeof(slot_t));
+    self->ids = PyList_New(0);
+    if (!self->slots || !self->ids) {
+        Py_DECREF(self);
+        PyErr_NoMemory();
+        return NULL;
+    }
+    return (PyObject *)self;
+}
+
+static void
+InternMap_dealloc(InternMap *self)
+{
+    if (self->slots) {
+        for (size_t i = 0; i < self->capacity; i++)
+            if (self->slots[i].hash) PyMem_Free(self->slots[i].key);
+        PyMem_Free(self->slots);
+    }
+    Py_XDECREF(self->ids);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyMethodDef InternMap_methods[] = {
+    {"intern", (PyCFunction)InternMap_intern, METH_O,
+     "intern(id) -> row (assigning the next row if new)"},
+    {"intern_pair", (PyCFunction)InternMap_intern_pair, METH_VARARGS,
+     "intern_pair(a, b) -> row for the (a, b) pair key"},
+    {"intern_batch", (PyCFunction)InternMap_intern_batch, METH_O,
+     "intern_batch(seq) -> bytearray of int32 rows"},
+    {"intern_pairs", (PyCFunction)InternMap_intern_pairs, METH_VARARGS,
+     "intern_pairs(seq_a, seq_b) -> bytearray of int32 rows"},
+    {"lookup", (PyCFunction)InternMap_lookup, METH_O,
+     "lookup(id) -> row or -1 (no insertion)"},
+    {"lookup_pair", (PyCFunction)InternMap_lookup_pair, METH_VARARGS,
+     "lookup_pair(a, b) -> row or -1 (no insertion)"},
+    {"ids", (PyCFunction)InternMap_ids, METH_NOARGS,
+     "ids() -> all interned ids in row order"},
+    {"id_of", (PyCFunction)InternMap_id_of, METH_O,
+     "id_of(row) -> the id interned at row"},
+    {NULL, NULL, 0, NULL},
+};
+
+static PySequenceMethods InternMap_as_sequence = {
+    .sq_length = (lenfunc)InternMap_len,
+};
+
+static PyTypeObject InternMapType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "internmap.InternMap",
+    .tp_basicsize = sizeof(InternMap),
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "Open-addressing string/pair interning map with int32 rows",
+    .tp_new = InternMap_new,
+    .tp_dealloc = (destructor)InternMap_dealloc,
+    .tp_methods = InternMap_methods,
+    .tp_as_sequence = &InternMap_as_sequence,
+};
+
+static PyModuleDef internmap_module = {
+    PyModuleDef_HEAD_INIT,
+    .m_name = "internmap",
+    .m_doc = "Native id interning for the TPU host boundary",
+    .m_size = -1,
+};
+
+PyMODINIT_FUNC
+PyInit_internmap(void)
+{
+    if (PyType_Ready(&InternMapType) < 0) return NULL;
+    PyObject *module = PyModule_Create(&internmap_module);
+    if (!module) return NULL;
+    Py_INCREF(&InternMapType);
+    if (PyModule_AddObject(module, "InternMap", (PyObject *)&InternMapType) < 0) {
+        Py_DECREF(&InternMapType);
+        Py_DECREF(module);
+        return NULL;
+    }
+    return module;
+}
