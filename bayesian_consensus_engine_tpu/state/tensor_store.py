@@ -47,7 +47,7 @@ from bayesian_consensus_engine_tpu.state.update_math import (
     apply_outcome_batch,
     utc_now_iso,
 )
-from bayesian_consensus_engine_tpu.utils.interning import IdInterner
+from bayesian_consensus_engine_tpu.utils.interning import make_pair_interner
 from bayesian_consensus_engine_tpu.utils.timeconv import (
     NEVER,
     iso_to_days,
@@ -77,7 +77,9 @@ class TensorReliabilityStore:
 
     def __init__(self, capacity: int = _MIN_CAPACITY) -> None:
         capacity = max(capacity, _MIN_CAPACITY)
-        self._pairs = IdInterner()  # (source_id, market_id) → row
+        # (source_id, market_id) → row; native C hash when built (one C pass
+        # per ingest batch), dict-backed IdInterner otherwise — same contract.
+        self._pairs = make_pair_interner()
         self._rel = np.full(capacity, DEFAULT_RELIABILITY, dtype=np.float64)
         self._conf = np.full(capacity, DEFAULT_CONFIDENCE, dtype=np.float64)
         self._days = np.full(capacity, NEVER, dtype=np.float64)
@@ -227,12 +229,26 @@ class TensorReliabilityStore:
     def rows_for_pairs(
         self, pairs: Sequence[tuple[str, str]], allocate: bool = True
     ) -> np.ndarray:
-        """Intern pairs → int32 rows (−1 for unknown when not allocating)."""
-        if allocate:
-            return np.asarray([self._row_for(s, m) for s, m in pairs], dtype=np.int32)
-        return np.asarray(
-            [self._pairs.get((s, m)) for s, m in pairs], dtype=np.int32
-        )
+        """Intern pairs → int32 rows (−1 for unknown when not allocating).
+
+        Runs as one batch pass through the interner (a single C call with
+        the native extension); newly allocated rows get sidecar slots but
+        are NOT marked existing — same contract as :meth:`_row_for`.
+        """
+        sources = [p[0] for p in pairs]
+        markets = [p[1] for p in pairs]
+        if not allocate:
+            return self._pairs.lookup_arrays(sources, markets)
+        try:
+            return self._pairs.intern_arrays(sources, markets)
+        finally:
+            # Resync sidecars even when interning raises mid-batch (e.g. a
+            # NUL id): rows interned before the failure must get their
+            # sidecar slots or later record-API calls index out of range.
+            after = len(self._pairs)
+            if after > len(self._iso):
+                self._iso.extend([""] * (after - len(self._iso)))
+                self._ensure_capacity(after)
 
     def batch_get_reliability(
         self,

@@ -144,18 +144,14 @@ class NativePairInterner:
     def intern_arrays(
         self, sources: Sequence[str], markets: Sequence[str]
     ) -> np.ndarray:
-        buf = self._map.intern_pairs(list(sources), list(markets))
+        buf = self._map.intern_pairs(sources, markets)
         return np.frombuffer(buf, dtype=np.int32)
 
     def lookup_arrays(
         self, sources: Sequence[str], markets: Sequence[str]
     ) -> np.ndarray:
-        # Lookups never insert; loop singles in C (no lookup batch needed —
-        # the allocating path dominates at ingest).
-        return np.asarray(
-            [self._map.lookup_pair(s, m) for s, m in zip(sources, markets)],
-            dtype=np.int32,
-        )
+        buf = self._map.lookup_pairs(sources, markets)
+        return np.frombuffer(buf, dtype=np.int32)
 
 
 def make_pair_interner():

@@ -9,11 +9,22 @@
  * table, returning a ready-to-upload int32 buffer (wrap with
  * numpy.frombuffer, no copies).
  *
+ * Design notes (why inserts are pure C):
+ *   - key bytes live in one growable arena (offset-addressed, so realloc
+ *     is safe); no per-key malloc.
+ *   - no Python objects are created at insert time: the id for a row is
+ *     materialised lazily by ids()/id_of() from the arena bytes (a pair is
+ *     recognised by its embedded NUL separator — see key spaces below).
+ *   - batch calls pre-size the table for the incoming run, so a cold 1M-pair
+ *     batch does not pay incremental rehashes.
+ *
  * Contract: row assignment is first-seen order, identical to the Python
  * IdInterner (equivalence enforced by tests/test_internmap.py). Pair keys
- * are the two UTF-8 strings joined by a NUL byte — NUL cannot occur inside
- * either half (validated in the wrapper; the reference caps ids at 256
- * chars and its validator rejects empty ids, reference: config.py:37-38).
+ * are the two UTF-8 strings joined by a NUL byte; to keep the single-key
+ * and pair-key spaces disjoint, NUL is rejected in EVERY key half AND in
+ * single-string keys (so intern("a\0b") cannot alias intern_pair("a","b")).
+ * The reference caps ids at 256 chars and its validator rejects empty ids
+ * (reference: config.py:37-38), so real ids never hit the restriction.
  *
  * API (all methods on InternMap):
  *   intern(str) -> int                      single string key
@@ -22,6 +33,7 @@
  *   intern_pairs(seq[str], seq[str]) -> bytearray  elementwise pair keys
  *   lookup(str) -> int        (-1 when absent; no insertion)
  *   lookup_pair(str, str) -> int
+ *   lookup_pairs(seq[str], seq[str]) -> bytearray  (-1 rows when absent)
  *   __len__() -> unique keys; ids() -> list (row order; str or (str, str))
  */
 
@@ -34,15 +46,24 @@ typedef struct {
     uint64_t hash;     /* 0 means empty (FNV-1a output is remapped off 0) */
     int32_t row;
     uint32_t key_len;
-    char *key;         /* owned copy of the key bytes */
+    size_t key_off;    /* offset of the key bytes in the arena */
 } slot_t;
+
+typedef struct {
+    size_t off;
+    uint32_t len;
+} rowref_t;
 
 typedef struct {
     PyObject_HEAD
     slot_t *slots;
     size_t capacity;   /* power of two */
     size_t used;
-    PyObject *ids;     /* list of interned id objects, row order */
+    char *arena;       /* all key bytes, back to back */
+    size_t arena_used;
+    size_t arena_cap;
+    rowref_t *rows;    /* row -> key bytes, for lazy id materialisation */
+    size_t rows_cap;
 } InternMap;
 
 static uint64_t
@@ -78,14 +99,24 @@ map_resize(InternMap *self, size_t new_capacity)
     return 0;
 }
 
-/* Find or insert the key; returns the row, or -1 on error. *id_factory* is
- * called (with *factory_arg*) to build the Python object appended to ids
- * only when the key is new. */
-typedef PyObject *(*id_factory_t)(void *arg);
+/* Pre-size the table for an incoming batch of *n* keys, but only when the
+ * map holds far fewer keys than the batch — i.e. most of the batch is
+ * probably new (a cold load). A warm batch (most keys already present)
+ * must NOT trigger this: treating its n as all-new would resize the table
+ * 2x past need on every call, paying a full rehash and colder probes. */
+static int
+map_reserve_cold(InternMap *self, size_t n)
+{
+    if (self->used * 8 >= n) return 0;
+    size_t cap = self->capacity;
+    while (n * 3 >= cap * 2) cap *= 2;
+    if (cap == self->capacity) return 0;
+    return map_resize(self, cap);
+}
 
+/* Find or insert the key; returns the row, or -1 on error. */
 static int32_t
-map_intern(InternMap *self, const char *key, size_t len,
-           id_factory_t id_factory, void *factory_arg)
+map_intern(InternMap *self, const char *key, size_t len)
 {
     if (self->used * 3 >= self->capacity * 2) {
         if (map_resize(self, self->capacity * 2) < 0) return -1;
@@ -95,36 +126,47 @@ map_intern(InternMap *self, const char *key, size_t len,
     size_t i = h & mask;
     while (self->slots[i].hash) {
         slot_t *s = &self->slots[i];
-        if (s->hash == h && s->key_len == len && memcmp(s->key, key, len) == 0)
+        if (s->hash == h && s->key_len == len &&
+            memcmp(self->arena + s->key_off, key, len) == 0)
             return s->row;
         i = (i + 1) & mask;
     }
-    if (PyList_GET_SIZE(self->ids) >= INT32_MAX) {
+    if (self->used >= (size_t)INT32_MAX) {
         PyErr_SetString(PyExc_OverflowError, "more than 2^31-1 interned ids");
         return -1;
     }
-    char *copy = PyMem_Malloc(len ? len : 1);
-    if (!copy) {
-        PyErr_NoMemory();
-        return -1;
+    if (self->arena_used + len > self->arena_cap) {
+        size_t cap = self->arena_cap * 2;
+        while (self->arena_used + len > cap) cap *= 2;
+        char *grown = PyMem_Realloc(self->arena, cap);
+        if (!grown) {
+            PyErr_NoMemory();
+            return -1;
+        }
+        self->arena = grown;
+        self->arena_cap = cap;
     }
-    memcpy(copy, key, len);
-    PyObject *id_obj = id_factory(factory_arg);
-    if (!id_obj) {
-        PyMem_Free(copy);
-        return -1;
+    if (self->used >= self->rows_cap) {
+        size_t cap = self->rows_cap * 2;
+        rowref_t *grown = PyMem_Realloc(self->rows, cap * sizeof(rowref_t));
+        if (!grown) {
+            PyErr_NoMemory();
+            return -1;
+        }
+        self->rows = grown;
+        self->rows_cap = cap;
     }
-    if (PyList_Append(self->ids, id_obj) < 0) {
-        Py_DECREF(id_obj);
-        PyMem_Free(copy);
-        return -1;
-    }
-    Py_DECREF(id_obj);
-    int32_t row = (int32_t)(PyList_GET_SIZE(self->ids) - 1);
+    size_t off = self->arena_used;
+    memcpy(self->arena + off, key, len);
+    self->arena_used += len;
+
+    int32_t row = (int32_t)self->used;
+    self->rows[row].off = off;
+    self->rows[row].len = (uint32_t)len;
     self->slots[i].hash = h;
     self->slots[i].row = row;
     self->slots[i].key_len = (uint32_t)len;
-    self->slots[i].key = copy;
+    self->slots[i].key_off = off;
     self->used++;
     return row;
 }
@@ -137,29 +179,39 @@ map_lookup(InternMap *self, const char *key, size_t len)
     size_t i = h & mask;
     while (self->slots[i].hash) {
         slot_t *s = &self->slots[i];
-        if (s->hash == h && s->key_len == len && memcmp(s->key, key, len) == 0)
+        if (s->hash == h && s->key_len == len &&
+            memcmp(self->arena + s->key_off, key, len) == 0)
             return s->row;
         i = (i + 1) & mask;
     }
     return -1;
 }
 
+/* Build the Python id object for a row from its arena bytes: a NUL byte
+ * marks a pair key (singles reject NUL), so "a\0b" -> ("a", "b"). */
+static PyObject *
+row_to_id(InternMap *self, size_t row)
+{
+    const char *key = self->arena + self->rows[row].off;
+    size_t len = self->rows[row].len;
+    const char *sep = memchr(key, '\0', len);
+    if (!sep)
+        return PyUnicode_DecodeUTF8(key, (Py_ssize_t)len, NULL);
+    Py_ssize_t alen = sep - key;
+    PyObject *a = PyUnicode_DecodeUTF8(key, alen, NULL);
+    if (!a) return NULL;
+    PyObject *b = PyUnicode_DecodeUTF8(sep + 1, (Py_ssize_t)len - alen - 1, NULL);
+    if (!b) {
+        Py_DECREF(a);
+        return NULL;
+    }
+    PyObject *pair = PyTuple_Pack(2, a, b);
+    Py_DECREF(a);
+    Py_DECREF(b);
+    return pair;
+}
+
 /* ---- key building -------------------------------------------------------- */
-
-static PyObject *
-factory_incref(void *arg)
-{
-    PyObject *obj = (PyObject *)arg;
-    Py_INCREF(obj);
-    return obj;
-}
-
-static PyObject *
-factory_pair(void *arg)
-{
-    PyObject **pair = (PyObject **)arg;
-    return PyTuple_Pack(2, pair[0], pair[1]);
-}
 
 /* UTF-8 view of a str; sets error and returns NULL on non-str. */
 static const char *
@@ -173,6 +225,18 @@ utf8_of(PyObject *obj, Py_ssize_t *len)
     return PyUnicode_AsUTF8AndSize(obj, len);
 }
 
+/* NUL would let a single-string key alias a NUL-joined pair key; reject it
+ * in both key kinds so the two key spaces cannot collide. */
+static int
+reject_nul(const char *buf, Py_ssize_t len)
+{
+    if (memchr(buf, '\0', (size_t)len)) {
+        PyErr_SetString(PyExc_ValueError, "ids must not contain NUL");
+        return -1;
+    }
+    return 0;
+}
+
 /* Joined "a\0b" key in *scratch (grown as needed). Returns length or -1. */
 static Py_ssize_t
 pair_key(PyObject *a, PyObject *b, char **scratch, Py_ssize_t *scratch_cap)
@@ -182,10 +246,7 @@ pair_key(PyObject *a, PyObject *b, char **scratch, Py_ssize_t *scratch_cap)
     if (!abuf) return -1;
     const char *bbuf = utf8_of(b, &blen);
     if (!bbuf) return -1;
-    if (memchr(abuf, '\0', (size_t)alen) || memchr(bbuf, '\0', (size_t)blen)) {
-        PyErr_SetString(PyExc_ValueError, "ids must not contain NUL");
-        return -1;
-    }
+    if (reject_nul(abuf, alen) < 0 || reject_nul(bbuf, blen) < 0) return -1;
     Py_ssize_t need = alen + 1 + blen;
     if (need > *scratch_cap) {
         char *grown = PyMem_Realloc(*scratch, (size_t)(need * 2));
@@ -202,6 +263,29 @@ pair_key(PyObject *a, PyObject *b, char **scratch, Py_ssize_t *scratch_cap)
     return need;
 }
 
+/* Validated fast views of two equal-length sequences. Returns 0 or -1. */
+static int
+two_seqs(PyObject *args, PyObject **fast_a, PyObject **fast_b, Py_ssize_t *n)
+{
+    PyObject *seq_a, *seq_b;
+    if (!PyArg_ParseTuple(args, "OO", &seq_a, &seq_b)) return -1;
+    *fast_a = PySequence_Fast(seq_a, "expected a sequence of str");
+    if (!*fast_a) return -1;
+    *fast_b = PySequence_Fast(seq_b, "expected a sequence of str");
+    if (!*fast_b) {
+        Py_DECREF(*fast_a);
+        return -1;
+    }
+    *n = PySequence_Fast_GET_SIZE(*fast_a);
+    if (PySequence_Fast_GET_SIZE(*fast_b) != *n) {
+        PyErr_SetString(PyExc_ValueError, "sequences must have equal length");
+        Py_DECREF(*fast_a);
+        Py_DECREF(*fast_b);
+        return -1;
+    }
+    return 0;
+}
+
 /* ---- methods ------------------------------------------------------------- */
 
 static PyObject *
@@ -210,7 +294,8 @@ InternMap_intern(InternMap *self, PyObject *arg)
     Py_ssize_t len;
     const char *buf = utf8_of(arg, &len);
     if (!buf) return NULL;
-    int32_t row = map_intern(self, buf, (size_t)len, factory_incref, arg);
+    if (reject_nul(buf, len) < 0) return NULL;
+    int32_t row = map_intern(self, buf, (size_t)len);
     if (row < 0) return NULL;
     return PyLong_FromLong(row);
 }
@@ -227,8 +312,7 @@ InternMap_intern_pair(InternMap *self, PyObject *args)
         PyMem_Free(scratch);
         return NULL;
     }
-    PyObject *pair[2] = {a, b};
-    int32_t row = map_intern(self, scratch, (size_t)len, factory_pair, pair);
+    int32_t row = map_intern(self, scratch, (size_t)len);
     PyMem_Free(scratch);
     if (row < 0) return NULL;
     return PyLong_FromLong(row);
@@ -241,7 +325,8 @@ InternMap_intern_batch(InternMap *self, PyObject *arg)
     if (!fast) return NULL;
     Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
     PyObject *out = PyByteArray_FromStringAndSize(NULL, n * 4);
-    if (!out) {
+    if (!out || map_reserve_cold(self, (size_t)n) < 0) {
+        Py_XDECREF(out);
         Py_DECREF(fast);
         return NULL;
     }
@@ -251,7 +336,8 @@ InternMap_intern_batch(InternMap *self, PyObject *arg)
         Py_ssize_t len;
         const char *buf = utf8_of(item, &len);
         if (!buf) goto fail;
-        int32_t row = map_intern(self, buf, (size_t)len, factory_incref, item);
+        if (reject_nul(buf, len) < 0) goto fail;
+        int32_t row = map_intern(self, buf, (size_t)len);
         if (row < 0) goto fail;
         rows[i] = row;
     }
@@ -266,34 +352,20 @@ fail:
 static PyObject *
 InternMap_intern_pairs(InternMap *self, PyObject *args)
 {
-    PyObject *seq_a, *seq_b;
-    if (!PyArg_ParseTuple(args, "OO", &seq_a, &seq_b)) return NULL;
-    PyObject *fast_a = PySequence_Fast(seq_a, "expected a sequence of str");
-    if (!fast_a) return NULL;
-    PyObject *fast_b = PySequence_Fast(seq_b, "expected a sequence of str");
-    if (!fast_b) {
-        Py_DECREF(fast_a);
-        return NULL;
-    }
-    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast_a);
-    if (PySequence_Fast_GET_SIZE(fast_b) != n) {
-        PyErr_SetString(PyExc_ValueError, "sequences must have equal length");
-        Py_DECREF(fast_a);
-        Py_DECREF(fast_b);
-        return NULL;
-    }
+    PyObject *fast_a, *fast_b;
+    Py_ssize_t n;
+    if (two_seqs(args, &fast_a, &fast_b, &n) < 0) return NULL;
     PyObject *out = PyByteArray_FromStringAndSize(NULL, n * 4);
     char *scratch = NULL;
     Py_ssize_t cap = 0;
-    if (!out) goto fail;
+    if (!out || map_reserve_cold(self, (size_t)n) < 0) goto fail;
     int32_t *rows = (int32_t *)PyByteArray_AS_STRING(out);
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *a = PySequence_Fast_GET_ITEM(fast_a, i);
         PyObject *b = PySequence_Fast_GET_ITEM(fast_b, i);
         Py_ssize_t len = pair_key(a, b, &scratch, &cap);
         if (len < 0) goto fail;
-        PyObject *pair[2] = {a, b};
-        int32_t row = map_intern(self, scratch, (size_t)len, factory_pair, pair);
+        int32_t row = map_intern(self, scratch, (size_t)len);
         if (row < 0) goto fail;
         rows[i] = row;
     }
@@ -336,9 +408,49 @@ InternMap_lookup_pair(InternMap *self, PyObject *args)
 }
 
 static PyObject *
+InternMap_lookup_pairs(InternMap *self, PyObject *args)
+{
+    PyObject *fast_a, *fast_b;
+    Py_ssize_t n;
+    if (two_seqs(args, &fast_a, &fast_b, &n) < 0) return NULL;
+    PyObject *out = PyByteArray_FromStringAndSize(NULL, n * 4);
+    char *scratch = NULL;
+    Py_ssize_t cap = 0;
+    if (!out) goto fail;
+    int32_t *rows = (int32_t *)PyByteArray_AS_STRING(out);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *a = PySequence_Fast_GET_ITEM(fast_a, i);
+        PyObject *b = PySequence_Fast_GET_ITEM(fast_b, i);
+        Py_ssize_t len = pair_key(a, b, &scratch, &cap);
+        if (len < 0) goto fail;
+        rows[i] = map_lookup(self, scratch, (size_t)len);
+    }
+    PyMem_Free(scratch);
+    Py_DECREF(fast_a);
+    Py_DECREF(fast_b);
+    return out;
+fail:
+    PyMem_Free(scratch);
+    Py_XDECREF(out);
+    Py_DECREF(fast_a);
+    Py_DECREF(fast_b);
+    return NULL;
+}
+
+static PyObject *
 InternMap_ids(InternMap *self, PyObject *Py_UNUSED(ignored))
 {
-    return PyList_GetSlice(self->ids, 0, PyList_GET_SIZE(self->ids));
+    PyObject *out = PyList_New((Py_ssize_t)self->used);
+    if (!out) return NULL;
+    for (size_t row = 0; row < self->used; row++) {
+        PyObject *id_obj = row_to_id(self, row);
+        if (!id_obj) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, (Py_ssize_t)row, id_obj);
+    }
+    return out;
 }
 
 static PyObject *
@@ -346,19 +458,17 @@ InternMap_id_of(InternMap *self, PyObject *arg)
 {
     Py_ssize_t row = PyLong_AsSsize_t(arg);
     if (row == -1 && PyErr_Occurred()) return NULL;
-    if (row < 0 || row >= PyList_GET_SIZE(self->ids)) {
+    if (row < 0 || (size_t)row >= self->used) {
         PyErr_SetString(PyExc_IndexError, "row out of range");
         return NULL;
     }
-    PyObject *obj = PyList_GET_ITEM(self->ids, row);
-    Py_INCREF(obj);
-    return obj;
+    return row_to_id(self, (size_t)row);
 }
 
 static Py_ssize_t
 InternMap_len(InternMap *self)
 {
-    return PyList_GET_SIZE(self->ids);
+    return (Py_ssize_t)self->used;
 }
 
 /* ---- type ---------------------------------------------------------------- */
@@ -372,8 +482,12 @@ InternMap_new(PyTypeObject *type, PyObject *Py_UNUSED(args),
     self->capacity = 64;
     self->used = 0;
     self->slots = PyMem_Calloc(self->capacity, sizeof(slot_t));
-    self->ids = PyList_New(0);
-    if (!self->slots || !self->ids) {
+    self->arena_cap = 1024;
+    self->arena_used = 0;
+    self->arena = PyMem_Malloc(self->arena_cap);
+    self->rows_cap = 64;
+    self->rows = PyMem_Malloc(self->rows_cap * sizeof(rowref_t));
+    if (!self->slots || !self->arena || !self->rows) {
         Py_DECREF(self);
         PyErr_NoMemory();
         return NULL;
@@ -384,12 +498,9 @@ InternMap_new(PyTypeObject *type, PyObject *Py_UNUSED(args),
 static void
 InternMap_dealloc(InternMap *self)
 {
-    if (self->slots) {
-        for (size_t i = 0; i < self->capacity; i++)
-            if (self->slots[i].hash) PyMem_Free(self->slots[i].key);
-        PyMem_Free(self->slots);
-    }
-    Py_XDECREF(self->ids);
+    PyMem_Free(self->slots);
+    PyMem_Free(self->arena);
+    PyMem_Free(self->rows);
     Py_TYPE(self)->tp_free((PyObject *)self);
 }
 
@@ -406,6 +517,8 @@ static PyMethodDef InternMap_methods[] = {
      "lookup(id) -> row or -1 (no insertion)"},
     {"lookup_pair", (PyCFunction)InternMap_lookup_pair, METH_VARARGS,
      "lookup_pair(a, b) -> row or -1 (no insertion)"},
+    {"lookup_pairs", (PyCFunction)InternMap_lookup_pairs, METH_VARARGS,
+     "lookup_pairs(seq_a, seq_b) -> bytearray of int32 rows (-1 when absent)"},
     {"ids", (PyCFunction)InternMap_ids, METH_NOARGS,
      "ids() -> all interned ids in row order"},
     {"id_of", (PyCFunction)InternMap_id_of, METH_O,

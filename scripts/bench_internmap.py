@@ -1,0 +1,77 @@
+"""Micro-bench: native internmap vs dict-backed IdInterner at 1M pairs.
+
+Measures the ingest-boundary cost the C extension exists to kill: interning
+1M (source_id, market_id) pairs into int32 rows (the allocating cold pass +
+a warm re-intern pass), and the non-allocating batch lookup.
+
+Each backend runs in its own subprocess: a 1M-entry interner is hundreds of
+MB of GC-tracked objects, and whichever backend runs second would otherwise
+pay generational-GC traversals of the first one's heap.
+
+Usage: python scripts/bench_internmap.py [N]
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+
+WORKER = r"""
+import json, random, sys, time
+
+sys.path.insert(0, ".")
+from bayesian_consensus_engine_tpu.utils.interning import (
+    IdInterner, NativePairInterner,
+)
+
+backend, n = sys.argv[1], int(sys.argv[2])
+rng = random.Random(7)
+sources = [f"source-{rng.randrange(10_000):05d}" for _ in range(n)]
+markets = [f"market-{rng.randrange(100_000):06d}" for _ in range(n)]
+
+interner = IdInterner() if backend == "pure" else NativePairInterner()
+
+out = {}
+for label, fn in [
+    ("cold", lambda: interner.intern_arrays(sources, markets)),
+    ("warm", lambda: interner.intern_arrays(sources, markets)),
+    ("lookup", lambda: interner.lookup_arrays(sources, markets)),
+]:
+    start = time.perf_counter()
+    rows = fn()
+    out[label] = time.perf_counter() - start
+out["rows_head"] = [int(x) for x in rows[:16]]
+out["unique"] = len(interner)
+print(json.dumps(out))
+"""
+
+
+def run_backend(backend: str) -> dict:
+    proc = subprocess.run(
+        [sys.executable, "-c", WORKER, backend, str(N)],
+        capture_output=True, text=True, check=True, cwd=".",
+    )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> None:
+    pure = run_backend("pure")
+    native = run_backend("native")
+    assert pure["rows_head"] == native["rows_head"], "row parity violated"
+    assert pure["unique"] == native["unique"]
+
+    print(f"interning {N:,} pairs ({pure['unique']:,} unique):")
+    print(f"  {'':<8s} {'IdInterner':>12s} {'native':>12s} {'speedup':>9s}")
+    for label in ("cold", "warm", "lookup"):
+        p, n_ = pure[label], native[label]
+        print(
+            f"  {label:<8s} {p * 1e3:10.1f} ms {n_ * 1e3:10.1f} ms "
+            f"{p / n_:8.2f}x"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,160 @@
+"""Equivalence suite for the native internmap extension.
+
+The C hash (native/internmap.c) must assign rows in first-seen order,
+identical to the dict-backed :class:`IdInterner` — these tests drive both
+through the same key streams and assert row-for-row parity, plus the
+NUL-rejection rule that keeps single-string and pair key spaces disjoint.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from bayesian_consensus_engine_tpu.utils.interning import (
+    IdInterner,
+    NativePairInterner,
+    _load_internmap,
+    make_pair_interner,
+)
+
+internmap = _load_internmap()
+
+pytestmark = pytest.mark.skipif(
+    internmap is None,
+    reason="native internmap not built (python native/build.py)",
+)
+
+
+def random_pairs(n: int, n_sources: int, n_markets: int, seed: int = 0):
+    rng = random.Random(seed)
+    return [
+        (f"src-{rng.randrange(n_sources)}", f"mkt-{rng.randrange(n_markets)}")
+        for _ in range(n)
+    ]
+
+
+class TestFirstSeenParity:
+    def test_single_pairs_match_idinterner(self):
+        native = NativePairInterner()
+        pure = IdInterner()
+        for pair in random_pairs(2000, 40, 30):
+            assert native.intern(pair) == pure.intern(pair)
+        assert len(native) == len(pure)
+        assert native.ids() == pure.ids()
+
+    def test_batch_matches_singles_and_idinterner(self):
+        pairs = random_pairs(5000, 60, 50, seed=1)
+        sources = [p[0] for p in pairs]
+        markets = [p[1] for p in pairs]
+
+        native = NativePairInterner()
+        rows_batch = native.intern_arrays(sources, markets)
+
+        pure = IdInterner()
+        rows_pure = pure.intern_arrays(sources, markets)
+
+        np.testing.assert_array_equal(rows_batch, rows_pure)
+        assert native.ids() == pure.ids()
+
+        # Re-interning the same stream must be pure lookup: identical rows,
+        # no growth.
+        before = len(native)
+        np.testing.assert_array_equal(
+            native.intern_arrays(sources, markets), rows_batch
+        )
+        assert len(native) == before
+
+    def test_growth_past_initial_capacity(self):
+        # Initial table capacity is 64 slots; cross several resizes.
+        native = NativePairInterner()
+        pure = IdInterner()
+        pairs = [(f"s{i}", f"m{i}") for i in range(10_000)]
+        for pair in pairs:
+            assert native.intern(pair) == pure.intern(pair)
+        assert len(native) == 10_000
+        assert native.id_of(9_999) == ("s9999", "m9999")
+
+
+class TestLookups:
+    def test_lookup_arrays_matches_singletons(self):
+        native = NativePairInterner()
+        known = random_pairs(500, 20, 20, seed=2)
+        native.intern_all(known)
+        probe = known[:100] + [("ghost", "mkt"), ("src-0", "nowhere")]
+        rows = native.lookup_arrays([p[0] for p in probe], [p[1] for p in probe])
+        expected = np.asarray(
+            [native.get(p) for p in probe], dtype=np.int32
+        )
+        np.testing.assert_array_equal(rows, expected)
+        assert rows[-1] == -1 and rows[-2] == -1
+
+    def test_lookup_never_inserts(self):
+        native = NativePairInterner()
+        native.intern(("a", "b"))
+        native.lookup_arrays(["x", "y"], ["m", "m"])
+        assert native.get(("x", "m")) == -1
+        assert len(native) == 1
+
+    def test_lookup_raises_for_unknown(self):
+        native = NativePairInterner()
+        with pytest.raises(KeyError):
+            native.lookup(("never", "seen"))
+
+    def test_contains(self):
+        native = NativePairInterner()
+        native.intern(("a", "m"))
+        assert ("a", "m") in native
+        assert ("a", "n") not in native
+
+
+class TestKeySpaceSeparation:
+    """intern("a\\0b") must NOT alias intern_pair("a", "b")."""
+
+    def test_single_key_rejects_nul(self):
+        raw = internmap.InternMap()
+        with pytest.raises(ValueError, match="NUL"):
+            raw.intern("a\0b")
+        with pytest.raises(ValueError, match="NUL"):
+            raw.intern_batch(["ok", "bad\0key"])
+
+    def test_pair_halves_reject_nul(self):
+        native = NativePairInterner()
+        with pytest.raises(ValueError, match="NUL"):
+            native.intern(("a\0b", "m"))
+        with pytest.raises(ValueError, match="NUL"):
+            native.intern(("a", "m\0x"))
+        with pytest.raises(ValueError, match="NUL"):
+            native.intern_arrays(["a", "b\0c"], ["m", "m"])
+
+    def test_mixed_key_kinds_coexist(self):
+        # One raw map can hold both str and pair keys without collision.
+        raw = internmap.InternMap()
+        assert raw.intern("alpha") == 0
+        assert raw.intern_pair("alpha", "beta") == 1
+        assert raw.intern("alphabeta") == 2  # concatenation is a distinct key
+        assert raw.id_of(0) == "alpha"
+        assert raw.id_of(1) == ("alpha", "beta")
+
+    def test_type_errors(self):
+        raw = internmap.InternMap()
+        with pytest.raises(TypeError):
+            raw.intern(42)
+        with pytest.raises(TypeError):
+            raw.intern_pairs(["a", 3], ["m", "m"])
+        with pytest.raises(ValueError):
+            raw.intern_pairs(["a"], ["m", "m"])  # length mismatch
+
+
+class TestFactory:
+    def test_make_pair_interner_prefers_native(self):
+        interner = make_pair_interner()
+        assert isinstance(interner, NativePairInterner)
+
+    def test_items_row_order(self):
+        native = NativePairInterner()
+        native.intern(("s1", "m"))
+        native.intern(("s0", "m"))
+        assert native.items() == [(("s1", "m"), 0), (("s0", "m"), 1)]

@@ -178,3 +178,20 @@ class TestCrossBackendEquivalence:
             (r.source_id, r.market_id, r.reliability, r.confidence) for r in b
         ]
         sqlite_store.close()
+
+
+class TestBatchFailureConsistency:
+    def test_mid_batch_intern_failure_keeps_sidecars_synced(self):
+        """A NUL id mid-batch must not desync interner rows from sidecars."""
+        store = TensorReliabilityStore()
+        try:
+            store.batch_update_reliability(
+                [("a", "m"), ("b\0bad", "m")], [True, True]
+            )
+        except ValueError:
+            pass  # native interner rejects NUL ids mid-batch
+        # Rows interned before the failure must be fully usable afterwards.
+        record = store.update_reliability("a", "m", True)
+        assert record.updated_at != ""
+        assert store.get_reliability("a", "m").reliability == record.reliability
+        assert len(store.list_sources()) == 1
