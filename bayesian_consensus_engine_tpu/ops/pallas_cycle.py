@@ -18,8 +18,18 @@ written via ``input_output_aliases`` so the cycle is in-place in HBM.
 
 Semantics are identical to ``parallel.sharded._cycle_math`` (itself parity-
 tested against the scalar reference path); ``tests/test_pallas_cycle.py``
-checks equivalence element-wise in interpret mode on CPU and the driver
-exercises the compiled path on real TPU via bench.
+checks equivalence element-wise in interpret mode on CPU.
+
+Hardware verdict (v5e, 2026-07-29, ``bench.py`` / ``scripts/
+perf_experiments3.py``): the kernel compiles and runs on TPU, peaking at
+~684 cycles/sec at 1M×16 with ``tile_markets=2048`` (tiles ≥4096 exceed
+the 16 MB scoped-VMEM budget), but **loses to XLA's own fusion of the
+``build_cycle_loop`` path (~860 cycles/sec)** — the cycle is elementwise +
+a short sublane reduction, exactly the shape XLA fuses optimally, and both
+paths are bound by the chip's measured ~400 GB/s streaming bandwidth. The
+XLA path is therefore the production default; this kernel is kept as the
+measured Pallas reference point and as the scaffold for any future op that
+XLA fusion handles badly.
 """
 
 from __future__ import annotations

@@ -22,6 +22,7 @@ from bayesian_consensus_engine_tpu.parallel.ring import (
     UPDATE_SPEC,
     RingTieBreakResult,
     build_ring_cycle,
+    build_ring_cycle_loop,
     build_ring_tiebreak,
     reshard,
     ring_allreduce,
@@ -59,6 +60,7 @@ __all__ = [
     "UPDATE_SPEC",
     "RingTieBreakResult",
     "build_ring_cycle",
+    "build_ring_cycle_loop",
     "build_ring_tiebreak",
     "reshard",
     "ring_allreduce",

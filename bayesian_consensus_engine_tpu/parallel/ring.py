@@ -33,6 +33,7 @@ that axis:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -40,11 +41,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+
 from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS
 from bayesian_consensus_engine_tpu.parallel.sharded import (
     CycleResult,
     MarketBlockState,
     consensus_epilogue,
+    make_loop_math,
     read_phase,
     update_phase,
 )
@@ -71,6 +74,94 @@ def ring_allreduce(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
     return acc
 
 
+def _ring_cycle_math(
+    probs: jax.Array,
+    mask: jax.Array,
+    outcome: jax.Array,
+    state: MarketBlockState,
+    now_days: jax.Array,
+    chunk_slots: int | None,
+    n_sources: int,
+) -> CycleResult:
+    """One cycle on one (M_loc, K_loc) shard with a chunked local pass.
+
+    Each ``chunk_slots``-wide slot chunk is read from HBM once and does BOTH
+    phases — the decayed-read partial sums and the post-outcome state
+    update — so no full-block intermediate (decayed reads, masked weights)
+    ever materialises. The per-market partial triples then ride the ring.
+    """
+    k_loc = probs.shape[1]
+    chunk = chunk_slots or k_loc
+    n_full, tail = divmod(k_loc, chunk)
+
+    def chunk_pass(offset, width, carry):
+        """Both phases over slots [offset, offset+width); static width."""
+        tw, wp, wc, new_state = carry
+
+        def slice_chunk(x):
+            return jax.lax.dynamic_slice_in_dim(x, offset, width, axis=1)
+
+        sub = MarketBlockState(
+            reliability=slice_chunk(state.reliability),
+            confidence=slice_chunk(state.confidence),
+            updated_days=slice_chunk(state.updated_days),
+            exists=None if state.exists is None
+            else slice_chunk(state.exists),
+        )
+        p = slice_chunk(probs)
+        m = slice_chunk(mask)
+
+        read_rel, read_conf = read_phase(sub, now_days)
+        w = jnp.where(m, read_rel, 0.0)
+        tw = tw + jnp.sum(w, axis=-1)
+        wp = wp + jnp.sum(jnp.where(m, p, 0.0) * w, axis=-1)
+        wc = wc + jnp.sum(jnp.where(m, read_conf, 0.0) * w, axis=-1)
+
+        upd = update_phase(
+            p, m, outcome, sub, read_conf, now_days, slots_axis=-1
+        )
+
+        def place(buf, part):
+            return jax.lax.dynamic_update_slice_in_dim(
+                buf, part, offset, axis=1
+            )
+
+        new_state = MarketBlockState(
+            reliability=place(new_state.reliability, upd.reliability),
+            confidence=place(new_state.confidence, upd.confidence),
+            updated_days=place(new_state.updated_days, upd.updated_days),
+            exists=None if new_state.exists is None
+            else place(new_state.exists, upd.exists),
+        )
+        return tw, wp, wc, new_state
+
+    zeros = jnp.zeros(probs.shape[0], probs.dtype)
+    # Every chunk is written exactly once, so seeding the output buffers
+    # with the input state only matters for aliasing: XLA can donate the
+    # state into the carry and update it in place. A ragged tail runs as
+    # one extra static-width pass after the loop.
+    carry = (zeros, zeros, zeros, state)
+    if n_full:  # guard: fori_loop traces its body even for 0 trips
+        carry = jax.lax.fori_loop(
+            0,
+            n_full,
+            lambda i, c: chunk_pass(i * chunk, chunk, c),
+            carry,
+        )
+    if tail:
+        carry = chunk_pass(n_full * chunk, tail, carry)
+    tw, wp, wc, new_state = carry
+
+    # Partial triples ride the ring; one stacked buffer per hop.
+    triple = ring_allreduce(jnp.stack([tw, wp, wc]), SOURCES_AXIS, n_sources)
+    total_weight, weighted_prob, weighted_conf = triple
+
+    consensus, confidence_out = consensus_epilogue(
+        total_weight, weighted_prob, weighted_conf
+    )
+    return CycleResult(new_state, consensus, confidence_out, total_weight)
+
+
 def build_ring_cycle(
     mesh: Mesh,
     chunk_slots: int | None = None,
@@ -81,13 +172,14 @@ def build_ring_cycle(
     Same contract as :func:`parallel.sharded.build_cycle` with a (M, K)
     layout: blocked inputs shard as ``(markets, sources)``, per-market
     outputs as ``(markets,)``. Differences, for the regime where the local
-    slot shard itself is long:
+    slot shard itself is long: the local pass is chunked (bounded VMEM
+    working set, blocks move through HBM once each way — see
+    :func:`_ring_cycle_math`) and the cross-device reduction is an explicit
+    :func:`ring_allreduce` instead of one fused psum.
 
-    * the local reduction runs as a ``lax.scan`` over ``chunk_slots``-wide
-      chunks, bounding the live working set instead of materialising the
-      full masked/weighted (M_loc, K_loc) intermediates at once;
-    * the cross-device reduction is an explicit :func:`ring_allreduce`
-      instead of one fused psum.
+    A ragged tail (``chunk_slots`` not dividing the local slot width) runs
+    as one extra static-shape pass after the full-chunk loop; ``None``
+    means one full-width chunk.
 
     Floating-point note: chunked+ring summation order differs from the
     single-``jnp.sum`` path, so results match :func:`build_cycle` to fp
@@ -97,54 +189,6 @@ def build_ring_cycle(
     n_sources = mesh.shape[SOURCES_AXIS]
     block = P(MARKETS_AXIS, SOURCES_AXIS)
     market = P(MARKETS_AXIS)
-
-    def cycle_math(probs, mask, outcome, state, now_days):
-        read_rel, read_conf = read_phase(state, now_days)
-
-        k_loc = probs.shape[1]
-        chunk = chunk_slots or k_loc
-        n_chunks = -(-k_loc // chunk)
-        pad = n_chunks * chunk - k_loc
-
-        def pad_slots(x, fill):
-            return jnp.pad(x, ((0, 0), (0, pad)), constant_values=fill)
-
-        # (K_loc → n_chunks × chunk) so the scan streams chunk-sized slabs.
-        def chunked(x, fill):
-            padded = pad_slots(x, fill) if pad else x
-            return padded.reshape(x.shape[0], n_chunks, chunk).swapaxes(0, 1)
-
-        c_probs = chunked(probs, 0.0)
-        c_mask = chunked(mask, False)
-        c_rel = chunked(read_rel, 0.0)
-        c_conf = chunked(read_conf, 0.0)
-
-        def local_chunk(carry, slab):
-            tw, wp, wc = carry
-            p, m, r, c = slab
-            w = jnp.where(m, r, 0.0)
-            tw = tw + jnp.sum(w, axis=-1)
-            wp = wp + jnp.sum(jnp.where(m, p, 0.0) * w, axis=-1)
-            wc = wc + jnp.sum(jnp.where(m, c, 0.0) * w, axis=-1)
-            return (tw, wp, wc), None
-
-        zeros = jnp.zeros(probs.shape[0], probs.dtype)
-        (tw, wp, wc), _ = jax.lax.scan(
-            local_chunk, (zeros, zeros, zeros), (c_probs, c_mask, c_rel, c_conf)
-        )
-
-        # Partial triples ride the ring; one stacked buffer per hop.
-        triple = ring_allreduce(jnp.stack([tw, wp, wc]), SOURCES_AXIS, n_sources)
-        total_weight, weighted_prob, weighted_conf = triple
-
-        consensus, confidence_out = consensus_epilogue(
-            total_weight, weighted_prob, weighted_conf
-        )
-        # Update phase: elementwise, communication-free.
-        new_state = update_phase(
-            probs, mask, outcome, state, read_conf, now_days, slots_axis=-1
-        )
-        return CycleResult(new_state, consensus, confidence_out, total_weight)
 
     # shard_map specs must mirror the state's pytree structure, which differs
     # between exists-carrying and exists=None states — compile per structure
@@ -159,7 +203,9 @@ def build_ring_cycle(
             block, block, block, block if has_exists else None
         )
         fn = shard_map(
-            cycle_math,
+            partial(
+                _ring_cycle_math, chunk_slots=chunk_slots, n_sources=n_sources
+            ),
             mesh=mesh,
             in_specs=(block, block, market, state_spec, P()),
             out_specs=CycleResult(state_spec, market, market, market),
@@ -175,6 +221,62 @@ def build_ring_cycle(
         return fn(probs, mask, outcome, state, now_days)
 
     return cycle
+
+
+def build_ring_cycle_loop(
+    mesh: Mesh,
+    chunk_slots: int | None = None,
+    donate: bool = True,
+):
+    """N ring cycles inside one jit dispatch — the production loop shape.
+
+    ``loop(probs, mask, outcome, state, now0, steps) -> (state', consensus)``
+    is :func:`build_ring_cycle`'s analogue of
+    :func:`parallel.sharded.build_cycle_loop`: ``steps`` consecutive cycles
+    (day ``now0 + i`` each) with the blocked state carried on device, which
+    is the only dispatch shape whose timing reflects the kernel rather than
+    per-call overhead (~4 ms through the axon TPU tunnel, and worse for
+    large operand sets). Same ``exists``-carry optimisation as the flat
+    loop: the mask is monotone under a fixed per-loop signal set, so
+    ``exists`` is reconstructed after the loop instead of being re-read and
+    re-written every cycle. ``steps`` is static per compilation.
+    """
+    n_sources = mesh.shape[SOURCES_AXIS]
+    block = P(MARKETS_AXIS, SOURCES_AXIS)
+    market = P(MARKETS_AXIS)
+    compiled: dict[tuple[int, bool], object] = {}
+
+    def compile_for(steps: int, has_exists: bool):
+        # The loop scaffold (exists-carry optimisation, sanitise, restore)
+        # is shared with the flat loop; only the per-cycle math differs.
+        # No consensus cast needed: check_vma=False below.
+        loop_math = make_loop_math(
+            partial(
+                _ring_cycle_math, chunk_slots=chunk_slots, n_sources=n_sources
+            ),
+            steps,
+        )
+
+        state_spec = MarketBlockState(
+            block, block, block, block if has_exists else None
+        )
+        fn = shard_map(
+            loop_math,
+            mesh=mesh,
+            in_specs=(block, block, market, state_spec, P()),
+            out_specs=(state_spec, market),
+            check_vma=False,
+        )
+        return jax.jit(fn, donate_argnums=(3,) if donate else ())
+
+    def loop(probs, mask, outcome, state, now0, steps: int):
+        key = (steps, state.exists is not None)
+        fn = compiled.get(key)
+        if fn is None:
+            fn = compiled[key] = compile_for(*key)
+        return fn(probs, mask, outcome, state, now0)
+
+    return loop
 
 
 def reshard(

@@ -224,6 +224,83 @@ def build_cycle(
     return cycle
 
 
+def make_loop_math(cycle_fn, steps: int, cast_consensus=None):
+    """The N-cycle loop scaffold shared by the flat and ring loops.
+
+    Returns ``loop_math(probs, mask, outcome, state, now0) ->
+    (state', consensus)`` running ``steps`` cycles of
+    ``cycle_fn(probs, mask, outcome, state, now_days) -> CycleResult``
+    with the state carried on device. ``cast_consensus`` (optional)
+    adjusts the initial consensus carry's type (e.g. ``pcast`` to varying
+    under shard_map with vma checking on).
+
+    The scaffold owns the ``exists``-carry optimisation: ``exists`` is
+    monotone under the fixed per-loop mask (``exists | mask`` every step),
+    so carrying it would re-read and re-write a full HBM tensor every cycle
+    for a value reconstructible at the end (measured ~64 MiB/cycle at
+    1M×16). Cold slots are sanitised to the cold-start defaults once on
+    entry, and slots that never existed and never signalled are restored
+    bit-identical on exit — exactly as a chain of single cycles leaves them.
+    An ``exists=None`` input already promises defaulted cold slots.
+    """
+
+    def loop_math(probs, mask, outcome, state, now0):
+        if state.exists is None:
+            sanitised = state
+        else:
+            sanitised = MarketBlockState(
+                reliability=jnp.where(
+                    state.exists, state.reliability, DEFAULT_RELIABILITY
+                ),
+                confidence=jnp.where(
+                    state.exists, state.confidence, DEFAULT_CONFIDENCE
+                ),
+                updated_days=jnp.where(state.exists, state.updated_days, 0.0),
+                exists=None,
+            )
+
+        def body(i, carry):
+            rel, conf, upd, _ = carry
+            result = cycle_fn(
+                probs, mask, outcome,
+                MarketBlockState(rel, conf, upd, None),
+                now0 + i,
+            )
+            st = result.state
+            return st.reliability, st.confidence, st.updated_days, result.consensus
+
+        init_consensus = jnp.zeros(outcome.shape[0], probs.dtype)
+        if cast_consensus is not None:
+            init_consensus = cast_consensus(init_consensus)
+        rel, conf, upd, consensus = jax.lax.fori_loop(
+            0,
+            steps,
+            body,
+            (
+                sanitised.reliability,
+                sanitised.confidence,
+                sanitised.updated_days,
+                init_consensus,
+            ),
+        )
+        if steps == 0:
+            return state, init_consensus
+        if state.exists is None:
+            return MarketBlockState(rel, conf, upd, None), consensus
+        keep = state.exists | mask
+        return (
+            MarketBlockState(
+                reliability=jnp.where(keep, rel, state.reliability),
+                confidence=jnp.where(keep, conf, state.confidence),
+                updated_days=jnp.where(keep, upd, state.updated_days),
+                exists=keep,
+            ),
+            consensus,
+        )
+
+    return loop_math
+
+
 def build_cycle_loop(
     mesh: Mesh | None = None,
     slot_major: bool = True,
@@ -242,74 +319,19 @@ def build_cycle_loop(
     compiled: dict[tuple[int, bool], object] = {}
 
     def compile_for(steps: int, has_exists: bool):
-        def loop_math(probs, mask, outcome, state, now0):
-            num_markets = outcome.shape[0]
-
-            # One-time sanitise, then drop `exists` from the carry: it is
-            # monotone under the fixed per-loop mask, so carrying it would
-            # re-read and re-write a full HBM tensor every cycle for a value
-            # reconstructible at the end (measured ~64 MiB/cycle at 1M×16).
-            # An exists=None input already promises defaulted cold slots.
-            if state.exists is None:
-                sanitised = state
-            else:
-                sanitised = MarketBlockState(
-                    reliability=jnp.where(
-                        state.exists, state.reliability, DEFAULT_RELIABILITY
-                    ),
-                    confidence=jnp.where(
-                        state.exists, state.confidence, DEFAULT_CONFIDENCE
-                    ),
-                    updated_days=jnp.where(state.exists, state.updated_days, 0.0),
-                    exists=None,
-                )
-
-            def body(i, carry):
-                rel, conf, upd, _ = carry
-                result = _cycle_math(
-                    probs, mask, outcome,
-                    MarketBlockState(rel, conf, upd, None),
-                    now0 + i,
-                    axis_name=SOURCES_AXIS if mesh is not None else None,
-                    slots_axis=slots_axis,
-                )
-                st = result.state
-                return st.reliability, st.confidence, st.updated_days, result.consensus
-
-            init_consensus = jnp.zeros(num_markets, probs.dtype)
-            if mesh is not None:
-                # Match the loop output's varying-axis type: consensus varies
-                # over the markets mesh axis inside shard_map.
-                init_consensus = jax.lax.pcast(
-                    init_consensus, (MARKETS_AXIS,), to="varying"
-                )
-            rel, conf, upd, consensus = jax.lax.fori_loop(
-                0,
-                steps,
-                body,
-                (
-                    sanitised.reliability,
-                    sanitised.confidence,
-                    sanitised.updated_days,
-                    init_consensus,
-                ),
-            )
-            if steps == 0:
-                return state, init_consensus
-            if state.exists is None:
-                return MarketBlockState(rel, conf, upd, None), consensus
-            # Slots that never existed and never signalled pass through
-            # bit-identical, exactly as a chain of single cycles leaves them.
-            keep = state.exists | mask
-            return (
-                MarketBlockState(
-                    reliability=jnp.where(keep, rel, state.reliability),
-                    confidence=jnp.where(keep, conf, state.confidence),
-                    updated_days=jnp.where(keep, upd, state.updated_days),
-                    exists=keep,
-                ),
-                consensus,
-            )
+        cycle_fn = partial(
+            _cycle_math,
+            axis_name=SOURCES_AXIS if mesh is not None else None,
+            slots_axis=slots_axis,
+        )
+        # Under shard_map the consensus carry must match the loop output's
+        # varying-axis type: consensus varies over the markets mesh axis.
+        cast = (
+            None
+            if mesh is None
+            else lambda x: jax.lax.pcast(x, (MARKETS_AXIS,), to="varying")
+        )
+        loop_math = make_loop_math(cycle_fn, steps, cast_consensus=cast)
 
         if mesh is None:
             fn = loop_math

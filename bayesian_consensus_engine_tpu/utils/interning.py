@@ -114,7 +114,7 @@ class NativePairInterner:
         return len(self._map)
 
     def __contains__(self, pair: Tuple[str, str]) -> bool:
-        return self._map.lookup_pair(pair[0], pair[1]) >= 0
+        return self.get(pair) >= 0
 
     def intern(self, pair: Tuple[str, str]) -> int:
         return self._map.intern_pair(pair[0], pair[1])
@@ -123,13 +123,19 @@ class NativePairInterner:
         return [self._map.intern_pair(a, b) for a, b in pairs]
 
     def lookup(self, pair: Tuple[str, str]) -> int:
-        row = self._map.lookup_pair(pair[0], pair[1])
+        row = self.get(pair)
         if row < 0:
             raise KeyError(pair)
         return row
 
     def get(self, pair: Tuple[str, str], default: int = -1) -> int:
-        row = self._map.lookup_pair(pair[0], pair[1])
+        # The C pass rejects NUL-containing halves with ValueError on reads
+        # too; such a key can never have been interned, so for the *read*
+        # surface it is simply absent — matching the IdInterner fallback.
+        try:
+            row = self._map.lookup_pair(pair[0], pair[1])
+        except ValueError:
+            return default
         return row if row >= 0 else default
 
     def id_of(self, row: int) -> Tuple[str, str]:
@@ -150,7 +156,16 @@ class NativePairInterner:
     def lookup_arrays(
         self, sources: Sequence[str], markets: Sequence[str]
     ) -> np.ndarray:
-        buf = self._map.lookup_pairs(sources, markets)
+        try:
+            buf = self._map.lookup_pairs(sources, markets)
+        except ValueError:
+            # One NUL-containing id poisons the whole C pass; resolve the
+            # batch per item so that key reads as absent (-1), matching the
+            # IdInterner fallback, instead of raising.
+            return np.asarray(
+                [self.get((s, m)) for s, m in zip(sources, markets)],
+                dtype=np.int32,
+            )
         return np.frombuffer(buf, dtype=np.int32)
 
 

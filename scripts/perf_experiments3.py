@@ -1,0 +1,170 @@
+"""Perf experiments round 3: Pallas-on-hardware + the large-K (10k-source) regime.
+
+Answers two VERDICT questions:
+  1. Does the hand-fused Pallas cycle beat the XLA-fused loop at 1M x 16 on a
+     real v5e chip? (round 1 never compiled it on hardware)
+  2. What does the K=10k regime (BASELINE config #5's source scale) run at on
+     one chip — slot-major XLA loop vs the chunked ring cycle?
+
+Run: python scripts/perf_experiments3.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+
+from bench import build_workload
+
+STEPS = 100
+
+
+def time_loop(fn, *args, trials=3):
+    out = fn(*args)
+    float(jax.tree_util.tree_leaves(out)[-1].reshape(-1)[0])
+    best = float("inf")
+    for _ in range(trials):
+        start = time.perf_counter()
+        out = fn(*args)
+        float(jax.tree_util.tree_leaves(out)[-1].reshape(-1)[0])
+        best = min(best, time.perf_counter() - start)
+    return best
+
+
+def report(name, secs, nbytes, steps=STEPS):
+    per = secs / steps
+    print(
+        f"{name:28s}: {steps / secs:10.1f} cycles/sec  ({per * 1e3:.3f} ms/cycle, "
+        f"{nbytes / per / 1e9:.0f} GB/s effective)",
+        flush=True,
+    )
+
+
+def pallas_1m16():
+    """Pallas fused cycle vs XLA loop at 1M x 16, both as in-jit loops."""
+    from bayesian_consensus_engine_tpu.ops.pallas_cycle import (
+        SlotMajorState,
+        build_pallas_cycle,
+    )
+    from bayesian_consensus_engine_tpu.parallel import (
+        MarketBlockState,
+        build_cycle_loop,
+        init_block_state,
+    )
+
+    M, K = 1_048_576, 16
+    dtype = jnp.float32
+    probs, mask, outcome, _ = build_workload(jax.random.PRNGKey(0), M, K, dtype)
+    probs, mask = probs.T, mask.T
+    mib = 1024 * 1024
+    # Per cycle: read probs+mask+4 state (6*64 MiB) + outcome (4 MiB) +
+    # write 4 state + 3 outputs (~4*64 + 12 MiB) -> ~652 MiB.
+    cycle_bytes = (64 * 6 + 4 + 64 * 4 + 12) * mib
+
+    # XLA loop (the current bench path).
+    loop = build_cycle_loop(mesh=None, slot_major=True, donate=False)
+    state = MarketBlockState(*(x.T for x in init_block_state(M, K, dtype=dtype)))
+    secs = time_loop(
+        lambda: loop(probs, mask, outcome, state, jnp.asarray(1.0, dtype), STEPS)
+    )
+    report("xla loop 1Mx16", secs, cycle_bytes)
+
+    # Pallas fused cycle, wrapped in an in-jit fori_loop for the same
+    # dispatch-amortised shape.
+    for tile in (256, 512, 1024, 2048):
+        call = build_pallas_cycle(M, K, tile_markets=tile)
+        f32 = lambda x: jnp.asarray(x, jnp.float32)
+        pprobs, pmask = f32(probs), f32(mask)
+        poutcome = f32(outcome)[None, :]
+        pstate = SlotMajorState(
+            jnp.full((K, M), 0.5, jnp.float32),
+            jnp.full((K, M), 0.25, jnp.float32),
+            jnp.zeros((K, M), jnp.float32),
+            jnp.zeros((K, M), jnp.float32),
+        )
+
+        def ploop_fn(probs, mask, outcome, state):
+            def body(i, carry):
+                state, _ = carry
+                state, consensus, _, _ = call(
+                    probs, mask, outcome, state, 1.0 + i
+                )
+                return state, consensus
+
+            init = jnp.zeros((1, M), jnp.float32)
+            return jax.lax.fori_loop(0, STEPS, body, (state, init))
+
+        ploop = jax.jit(ploop_fn)
+        try:
+            secs = time_loop(lambda: ploop(pprobs, pmask, poutcome, pstate))
+        except Exception as e:  # noqa: BLE001
+            print(f"pallas tile={tile}: FAILED {type(e).__name__}: {e}")
+            continue
+        report(f"pallas loop 1Mx16 t={tile}", secs, cycle_bytes)
+
+
+def large_k():
+    """K=10k regime on one chip: XLA loop vs ring cycle (1-device mesh)."""
+    from bayesian_consensus_engine_tpu.parallel import (
+        MarketBlockState,
+        build_cycle_loop,
+        init_block_state,
+    )
+    from bayesian_consensus_engine_tpu.parallel.ring import build_ring_cycle
+    from jax.sharding import Mesh
+    import numpy as np
+
+    M, K = 16_384, 10_000
+    steps = 20
+    dtype = jnp.float32
+    probs, mask, outcome, _ = build_workload(jax.random.PRNGKey(1), M, K, dtype)
+    # Per cycle bytes: 6 block reads + 4 block writes + small per-market IO.
+    blk = M * K * 4
+    cycle_bytes = 10 * blk
+
+    # Slot-major XLA loop (K on sublanes, M on lanes).
+    loop = build_cycle_loop(mesh=None, slot_major=True, donate=False)
+    state = MarketBlockState(*(x.T for x in init_block_state(M, K, dtype=dtype)))
+    tp, tm = probs.T, mask.T
+    secs = time_loop(
+        lambda: loop(tp, tm, outcome, state, jnp.asarray(1.0, dtype), steps)
+    )
+    report("xla loop 16kx10k slotmaj", secs, cycle_bytes, steps)
+
+    # Market-major XLA loop (K on lanes — at K=10k the reduction axis is wide).
+    loop_mm = build_cycle_loop(mesh=None, slot_major=False, donate=False)
+    state = init_block_state(M, K, dtype=dtype)
+    secs = time_loop(
+        lambda: loop_mm(probs, mask, outcome, state, jnp.asarray(1.0, dtype), steps)
+    )
+    report("xla loop 16kx10k mktmaj", secs, cycle_bytes, steps)
+
+    # Ring cycle (single-device mesh; chunked local reduction).
+    devs = np.array(jax.devices()[:1]).reshape(1, 1)
+    mesh = Mesh(devs, ("markets", "sources"))
+    for chunk in (512, 2048, None):
+        ring = build_ring_cycle(mesh, chunk_slots=chunk, donate=False)
+        state = init_block_state(M, K, dtype=dtype)
+        try:
+            secs = time_loop(
+                lambda: ring(probs, mask, outcome, state, jnp.asarray(1.0, dtype)),
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"ring chunk={chunk}: FAILED {type(e).__name__}: {e}")
+            continue
+        # Single dispatch per cycle here (no loop wrapper) — report per call.
+        report(f"ring 16kx10k chunk={chunk}", secs, cycle_bytes, 1)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "pallas"):
+        pallas_1m16()
+    if which in ("all", "largek"):
+        large_k()

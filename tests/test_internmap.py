@@ -129,6 +129,23 @@ class TestKeySpaceSeparation:
         with pytest.raises(ValueError, match="NUL"):
             native.intern_arrays(["a", "b\0c"], ["m", "m"])
 
+    def test_nul_reads_are_absent_not_errors(self):
+        # Writes reject NUL, but READS must treat a NUL key as simply
+        # unknown — matching the IdInterner fallback, so the tensor store's
+        # read behaviour does not depend on which backend is built.
+        native = NativePairInterner()
+        pure = IdInterner()
+        native.intern(("a", "m"))
+        pure.intern(("a", "m"))
+        assert native.get(("a\0b", "m")) == pure.get(("a\0b", "m")) == -1
+        assert (("a\0b", "m") in native) == (("a\0b", "m") in pure) is False
+        with pytest.raises(KeyError):
+            native.lookup(("a\0b", "m"))
+        np.testing.assert_array_equal(
+            native.lookup_arrays(["a", "a\0b"], ["m", "m"]),
+            pure.lookup_arrays(["a", "a\0b"], ["m", "m"]),
+        )
+
     def test_mixed_key_kinds_coexist(self):
         # One raw map can hold both str and pair keys without collision.
         raw = internmap.InternMap()

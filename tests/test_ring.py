@@ -33,6 +33,7 @@ from bayesian_consensus_engine_tpu.parallel.ring import (
     REDUCE_SPEC,
     UPDATE_SPEC,
     build_ring_cycle,
+    build_ring_cycle_loop,
     build_ring_tiebreak,
     reshard,
     ring_allreduce,
@@ -171,6 +172,77 @@ class TestRingCycle:
         out = np.asarray(result.consensus)
         assert np.isnan(out[0])
         assert np.asarray(result.total_weight)[0] == 0.0
+
+
+class TestRingCycleLoop:
+    @pytest.mark.parametrize("shape", [(1, 8), (2, 4)])
+    @pytest.mark.parametrize("chunk_slots", [None, 5])
+    def test_matches_chained_single_cycles(self, shape, chunk_slots):
+        mesh = make_mesh(shape)
+        probs, mask, outcome, state, _ = _random_inputs(seed=7)
+        now0 = jnp.float32(401.0)
+        steps = 3
+
+        single = build_cycle(make_mesh((8, 1)), donate=False)
+        want_state = state
+        for i in range(steps):
+            result = single(probs, mask, outcome, want_state, now0 + i)
+            want_state, want_consensus = result.state, result.consensus
+
+        loop = build_ring_cycle_loop(mesh, chunk_slots=chunk_slots, donate=False)
+        got_state, got_consensus = loop(probs, mask, outcome, state, now0, steps)
+
+        np.testing.assert_allclose(
+            np.asarray(got_consensus),
+            np.asarray(want_consensus),
+            rtol=2e-6,
+            atol=1e-6,
+        )
+        # Reductions feed nothing back into the state: updates stay exact.
+        for got, want in zip(got_state, want_state):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_exists_none_carry(self):
+        from bayesian_consensus_engine_tpu.utils.config import (
+            DEFAULT_CONFIDENCE,
+            DEFAULT_RELIABILITY,
+        )
+
+        mesh = make_mesh((2, 4))
+        probs, mask, outcome, state, _ = _random_inputs(seed=8)
+        reduced = MarketBlockState(
+            reliability=jnp.where(state.exists, state.reliability, DEFAULT_RELIABILITY),
+            confidence=jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE),
+            updated_days=jnp.where(state.exists, state.updated_days, 0.0),
+            exists=None,
+        )
+        now0 = jnp.float32(401.0)
+        single = build_cycle(make_mesh((8, 1)), donate=False)
+        want_state = reduced
+        for i in range(2):
+            result = single(probs, mask, outcome, want_state, now0 + i)
+            want_state, want_consensus = result.state, result.consensus
+
+        loop = build_ring_cycle_loop(mesh, chunk_slots=6, donate=False)
+        got_state, got_consensus = loop(probs, mask, outcome, reduced, now0, 2)
+        assert got_state.exists is None
+        np.testing.assert_allclose(
+            np.asarray(got_consensus),
+            np.asarray(want_consensus),
+            rtol=2e-6,
+            atol=1e-6,
+        )
+        for got, want in zip(got_state[:3], want_state[:3]):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_zero_steps_identity(self):
+        mesh = make_mesh((2, 4))
+        probs, mask, outcome, state, now = _random_inputs(seed=9)
+        loop = build_ring_cycle_loop(mesh, donate=False)
+        got_state, consensus = loop(probs, mask, outcome, state, now, 0)
+        for got, want in zip(got_state, state):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert not np.any(np.asarray(consensus))
 
 
 class TestReshard:
@@ -321,6 +393,27 @@ class TestRingTieBreak:
         valid = jnp.asarray(rng.random((m, a)) < 0.9)
 
         result = build_ring_tiebreak(mesh)(pred, weight, conf, rel, valid)
+        self._assert_rows_match_scalar(result, pred, weight, conf, rel, valid, m, a)
+
+    def test_markets_axis_sharded_too(self):
+        # (2, 4) mesh: the markets axis of the tie-break shard_map is
+        # actually sharded — the configuration the 10k-agent scale docstring
+        # recommends (origin buffer shrinks with M_loc).
+        mesh24 = make_mesh((2, 4))
+        rng = np.random.default_rng(43)
+        m, a = 16, 32
+        grid = np.array([0.2, 0.4, 0.6, 0.8])
+        pred = jnp.asarray(rng.choice(grid, (m, a)), dtype=jnp.float32)
+        weight = jnp.asarray(rng.uniform(0.1, 2.0, (m, a)), dtype=jnp.float32)
+        conf = jnp.asarray(rng.uniform(0, 1, (m, a)), dtype=jnp.float32)
+        rel = jnp.asarray(rng.uniform(0, 1, (m, a)), dtype=jnp.float32)
+        valid = jnp.asarray(rng.random((m, a)) < 0.9)
+
+        result = build_ring_tiebreak(mesh24)(pred, weight, conf, rel, valid)
+        self._assert_rows_match_scalar(result, pred, weight, conf, rel, valid, m, a)
+
+    @staticmethod
+    def _assert_rows_match_scalar(result, pred, weight, conf, rel, valid, m, a):
         breaker = DeterministicTieBreaker()
         for row in range(m):
             agents = [
