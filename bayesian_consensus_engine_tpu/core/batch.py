@@ -173,14 +173,23 @@ def pack_markets(
      signals_per_market, pair_offsets) = grouping
 
     market_keys = [market_id for market_id, _signals in markets]
-    pair_rel: list[float] = []
-    pair_conf: list[float] = []
-    pair_known: list[bool] = []
-    for sid, market_row in zip(pair_source_ids, pair_market):
-        reliability, confidence, known = lookup(sid, market_keys[market_row])
-        pair_rel.append(reliability)
-        pair_conf.append(confidence)
-        pair_known.append(known)
+    num_pairs = len(pair_source_ids)
+    if lookup is cold_start_lookup:
+        # Constant output — skip the per-pair Python call loop (the
+        # settlement pipeline packs hundreds of thousands of pairs and reads
+        # state from device rows instead, never from these arrays).
+        pair_rel = np.full(num_pairs, DEFAULT_RELIABILITY)
+        pair_conf = np.full(num_pairs, DEFAULT_CONFIDENCE)
+        pair_known = [False] * num_pairs
+    else:
+        pair_rel = []
+        pair_conf = []
+        pair_known = []
+        for sid, market_row in zip(pair_source_ids, pair_market):
+            reliability, confidence, known = lookup(sid, market_keys[market_row])
+            pair_rel.append(reliability)
+            pair_conf.append(confidence)
+            pair_known.append(known)
 
     dtype = np.float64  # host packing always f64; cast on device transfer
     return PackedBatch(

@@ -1,0 +1,314 @@
+"""End-to-end settlement pipeline: payloads → device cycle → SQLite.
+
+The one flow the subsystems exist for, as a single tested API:
+
+    raw (market_id, signals) payloads
+      → native ingest packer                    (core.batch.pack_markets)
+      → interned (source, market) rows          (TensorReliabilityStore)
+      → gather flat rows into an (K, M) block   (this module)
+      → N consensus+update cycles in one jit    (parallel.sharded loop)
+      → scatter the block back into flat rows   (this module)
+      → host-authoritative absorb               (TensorReliabilityStore.absorb)
+      → reference-format SQLite checkpoint      (flush_to_sqlite)
+
+The reference's contract is per-(source_id, market_id) state updated after
+outcomes (reference: reliability.py:36-45, market.py:200-221); here that
+state lives in the store's flat HBM tensors and the cycle runs on a dense
+slot-major block, so the bridge is one gather at entry and one scatter at
+exit — both inside the same jit dispatch as the cycle loop itself.
+
+Two-phase API so the (host-side) packing/interning cost is paid once per
+signal topology, then any number of settlement cycles run device-only:
+
+    plan = build_settlement_plan(store, payloads)
+    result = settle(store, plan, outcomes, steps=5)
+    store.flush_to_sqlite("checkpoint.db")
+
+`settle_payloads` wraps the three steps for the one-shot case.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Mapping, Optional, Sequence
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu.core.batch import pack_markets
+from bayesian_consensus_engine_tpu.utils.config import (
+    CONFIDENCE_GROWTH_RATE,
+    DEFAULT_CONFIDENCE,
+    DEFAULT_RELIABILITY,
+)
+from bayesian_consensus_engine_tpu.utils.timeconv import now_days as _now_days
+
+Payload = Sequence[tuple[str, Sequence[Mapping[str, Any]]]]
+
+
+@dataclass(frozen=True)
+class SettlementPlan:
+    """Static device layout for one signal topology (reusable across cycles).
+
+    Block arrays are slot-major (K, M): markets ride the 128-wide lane
+    dimension (the measured-fastest layout — see parallel.sharded). Padding
+    slots carry ``row = -1`` and ``mask = False``; at kernel time row −1
+    resolves to a sink row appended past the store's flat state, so the plan
+    stays valid even if the store interns more pairs after it was built.
+    """
+
+    market_keys: list[str]        # row → market id (payload order)
+    slot_rows: np.ndarray         # i32[K, M] flat store row per slot (−1 pad)
+    probs: np.ndarray             # f64[K, M] per-pair mean probability
+    mask: np.ndarray              # bool[K, M] slot carries a signal
+    signals_per_market: np.ndarray  # i32[M] raw signal counts (diagnostics)
+
+    @property
+    def num_markets(self) -> int:
+        return len(self.market_keys)
+
+    @property
+    def num_slots(self) -> int:
+        return int(self.slot_rows.shape[0])
+
+
+def build_settlement_plan(
+    store,
+    payloads: Payload,
+    native: Optional[bool] = None,
+) -> SettlementPlan:
+    """Pack, intern, and lay out payloads as a dense settlement block.
+
+    One native packing pass groups/sorts/flattens the raw signals
+    (duplicate signals from one source collapse to their mean, in scalar
+    accumulation order), one native interning pass maps every
+    (source, market) pair to its flat store row, and the ragged per-market
+    pair lists become a dense slot-major block.
+
+    Market ids must be unique within one plan: two slots mapping to the
+    same flat row would race in the scatter.
+    """
+    payloads = list(payloads)
+    keys = [market_id for market_id, _ in payloads]
+    if len(set(keys)) != len(keys):
+        raise ValueError("duplicate market ids in one settlement plan")
+
+    packed = pack_markets(payloads, native=native)
+    pairs = [
+        (sid, keys[market_row])
+        for sid, market_row in zip(packed.pair_source_ids, packed.pair_market)
+    ]
+    rows = store.rows_for_pairs(pairs, allocate=True)
+
+    counts = np.diff(packed.pair_offsets)
+    num_markets = len(keys)
+    num_slots = int(counts.max()) if num_markets else 0
+    pair_mean = _pair_means(packed)
+
+    # Ragged pair lists → dense (M, K): slot k of market m is its k-th pair
+    # (source-id-sorted within the market, the scalar engine's float order).
+    slot_rows = np.full((num_markets, num_slots), -1, dtype=np.int32)
+    probs = np.zeros((num_markets, num_slots), dtype=np.float64)
+    mask = np.zeros((num_markets, num_slots), dtype=bool)
+    market_of_pair = packed.pair_market
+    slot_of_pair = (
+        np.arange(len(rows), dtype=np.int64)
+        - packed.pair_offsets[:-1][market_of_pair]
+    )
+    slot_rows[market_of_pair, slot_of_pair] = rows
+    probs[market_of_pair, slot_of_pair] = pair_mean
+    mask[market_of_pair, slot_of_pair] = True
+
+    return SettlementPlan(
+        market_keys=keys,
+        slot_rows=np.ascontiguousarray(slot_rows.T),
+        probs=np.ascontiguousarray(probs.T),
+        mask=np.ascontiguousarray(mask.T),
+        signals_per_market=packed.signals_per_market,
+    )
+
+
+def _pair_means(packed) -> np.ndarray:
+    """Per-pair duplicate-signal means, host-side in scalar float order.
+
+    Duplicate averaging must match the scalar engine bit-for-bit
+    (left-to-right sum per pair, reference: core.py:115-116); the flat
+    signal list is already in original order, so a stable per-pair
+    accumulation reproduces it exactly.
+    """
+    num_pairs = len(packed.pair_source_ids)
+    sums = np.zeros(num_pairs, dtype=np.float64)
+    counts = np.zeros(num_pairs, dtype=np.int64)
+    flat_pair = packed.flat_pair
+    flat_probs = packed.flat_probs
+    # np.add.at is an ordered sequential accumulate — scalar-sum order.
+    np.add.at(sums, flat_pair, flat_probs)
+    np.add.at(counts, flat_pair, 1)
+    return sums / np.maximum(counts, 1)
+
+
+@dataclass(frozen=True)
+class SettlementResult:
+    """Per-market outputs of the final cycle, payload order."""
+
+    market_keys: list[str]
+    consensus: np.ndarray  # f[M] final-cycle consensus (NaN: zero weight)
+
+    def by_market(self) -> dict[str, float]:
+        return {
+            key: float(value)
+            for key, value in zip(self.market_keys, self.consensus)
+        }
+
+
+def _settle_math(
+    flat_rel, flat_conf, flat_days, flat_exists,
+    slot_rows, probs, mask, outcome, now0, steps: int,
+):
+    """gather → N-cycle loop → scatter, traced as one jit dispatch.
+
+    Flat state buffers are donated (the caller re-materialises from host
+    state via ``device_state()`` afterwards — ``settle`` absorbs + drops the
+    cache immediately). Padding slots carry row −1, which indexes the sink
+    row appended at the end; sink writes are sliced off before returning.
+    """
+    import jax.numpy as jnp
+
+    from bayesian_consensus_engine_tpu.parallel.sharded import (
+        MarketBlockState,
+        _cycle_math,
+        make_loop_math,
+    )
+
+    def ext(x, fill):
+        return jnp.concatenate([x, jnp.full((1,), fill, x.dtype)])
+
+    rel = ext(flat_rel, DEFAULT_RELIABILITY)
+    conf = ext(flat_conf, DEFAULT_CONFIDENCE)
+    days = ext(flat_days, 0.0)
+    exists = ext(flat_exists, False)
+
+    block = MarketBlockState(
+        reliability=rel[slot_rows],
+        confidence=conf[slot_rows],
+        updated_days=days[slot_rows],
+        exists=exists[slot_rows],
+    )
+    cycle_fn = partial(_cycle_math, axis_name=None, slots_axis=0)
+    loop_math = make_loop_math(cycle_fn, steps)
+    new_block, consensus = loop_math(probs, mask, outcome, block, now0)
+
+    # Every real (mask=True) slot maps to a distinct flat row, so the
+    # scatter is a permutation write; pad slots all land on the sink row.
+    new_rel = rel.at[slot_rows].set(new_block.reliability)[:-1]
+    new_conf = conf.at[slot_rows].set(new_block.confidence)[:-1]
+    new_days = days.at[slot_rows].set(new_block.updated_days)[:-1]
+    new_exists = exists.at[slot_rows].set(new_block.exists)[:-1]
+    return new_rel, new_conf, new_days, new_exists, consensus
+
+
+_settle_kernel = None
+
+
+def _get_settle_kernel():
+    global _settle_kernel
+    if _settle_kernel is None:
+        import jax
+
+        _settle_kernel = jax.jit(
+            _settle_math, static_argnames=("steps",), donate_argnums=(0, 1, 2, 3)
+        )
+    return _settle_kernel
+
+
+def settle(
+    store,
+    plan: SettlementPlan,
+    outcomes: Sequence[bool],
+    steps: int = 1,
+    now: Optional[float] = None,
+    dtype=None,
+) -> SettlementResult:
+    """Run *steps* settlement cycles for the planned markets on device.
+
+    Each cycle: decay-on-read → weighted consensus → outcome correctness at
+    p ≥ 0.5 → capped update of the undecayed state (day ``now + i``).
+    The mutated state is absorbed back into *store* before returning, so
+    a follow-up ``flush_to_sqlite`` checkpoints exactly what settled.
+
+    ``now`` is absolute epoch-days (defaults to the current time); pass it
+    explicitly for reproducible parity runs.
+    """
+    import jax.numpy as jnp
+
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        DeviceReliabilityState,
+    )
+
+    if len(outcomes) != plan.num_markets:
+        raise ValueError(
+            f"{len(outcomes)} outcomes for {plan.num_markets} planned markets"
+        )
+    if plan.mask.any() and int(plan.slot_rows.max()) >= len(store):
+        # A plan built against a different (or rebuilt) store: the gather
+        # would clamp onto the sink row and silently corrupt results.
+        raise ValueError(
+            f"plan references row {int(plan.slot_rows.max())} but the store "
+            f"holds {len(store)} pairs — was the plan built for this store?"
+        )
+
+    # Capture pre-settle confidences: the post-settle values are replayed
+    # host-side in exact scalar arithmetic (see overwrite_confidences — XLA
+    # fuses the growth multiply-add into an FMA, one rounding short of the
+    # scalar contract; the trajectory is data-independent, so the host can
+    # reproduce it bit-exactly no matter what precision the device ran at).
+    touched_rows = plan.slot_rows[plan.mask]
+    conf_exact = store.host_confidences(touched_rows)
+
+    (flat, epoch0) = store.device_state(dtype)
+    now_abs = _now_days() if now is None else now
+    cdtype = flat.reliability.dtype
+
+    rel, conf, days, exists, consensus = _get_settle_kernel()(
+        flat.reliability,
+        flat.confidence,
+        flat.updated_days,
+        flat.exists,
+        jnp.asarray(plan.slot_rows),
+        jnp.asarray(plan.probs, dtype=cdtype),
+        jnp.asarray(plan.mask),
+        jnp.asarray(np.asarray(outcomes, dtype=bool)),
+        jnp.asarray(now_abs - epoch0, dtype=cdtype),
+        steps,
+    )
+    # The kernel donated the cached device buffers; drop the stale cache
+    # before anything else can touch it, then absorb the new state.
+    store._invalidate()
+    store.absorb(
+        DeviceReliabilityState(rel, conf, days, exists), epoch0
+    )
+    for _ in range(steps):
+        conf_exact = np.minimum(
+            1.0, conf_exact + (1.0 - conf_exact) * CONFIDENCE_GROWTH_RATE
+        )
+    store.overwrite_confidences(touched_rows, conf_exact)
+    return SettlementResult(
+        market_keys=plan.market_keys,
+        consensus=np.asarray(consensus),
+    )
+
+
+def settle_payloads(
+    store,
+    payloads: Payload,
+    outcomes: Sequence[bool],
+    steps: int = 1,
+    now: Optional[float] = None,
+    db_path=None,
+) -> SettlementResult:
+    """One-shot pipeline: plan, settle, and optionally checkpoint to SQLite."""
+    plan = build_settlement_plan(store, payloads)
+    result = settle(store, plan, outcomes, steps=steps, now=now)
+    if db_path is not None:
+        store.flush_to_sqlite(db_path)
+    return result

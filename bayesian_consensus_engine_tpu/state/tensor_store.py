@@ -309,6 +309,23 @@ class TensorReliabilityStore:
             self._iso[row] = stamp_iso
         self._invalidate()
 
+    def host_confidences(self, rows: np.ndarray) -> np.ndarray:
+        """Exact f64 host confidences for *rows* (a copy; defaults when cold)."""
+        return self._conf[rows].copy()
+
+    def overwrite_confidences(self, rows: np.ndarray, values: np.ndarray) -> None:
+        """Replace confidences for *rows* with exact host-computed values.
+
+        The settlement pipeline uses this to keep stored confidences
+        bit-identical to the scalar chain: XLA contracts the confidence
+        growth's multiply-add into an FMA (one rounding where the scalar
+        path has two), so the device value can drift 1 ulp per step. The
+        trajectory is data-independent — one growth step per settled cycle —
+        so the host replays it exactly and overwrites.
+        """
+        self._conf[rows] = values
+        self._invalidate()
+
     # -- device tier ---------------------------------------------------------
 
     def device_state(self, dtype=None):
@@ -412,8 +429,7 @@ class TensorReliabilityStore:
 
         records = self.list_sources()
         with SQLiteReliabilityStore(db_path) as sqlite_store:
-            for record in records:
-                sqlite_store.put_record(record)
+            sqlite_store.put_records(records)
         return len(records)
 
     # -- durability (orbax checkpoint format) --------------------------------

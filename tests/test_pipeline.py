@@ -1,0 +1,261 @@
+"""End-to-end settlement pipeline vs the scalar reference path.
+
+The pipeline under test is the full flow: payloads → native packer →
+interned rows → device block state → cycle loop → absorb → SQLite flush.
+The oracle is the scalar path the reference defines: per-market consensus
+via the scalar engine plus one ``update_reliability`` per (source, market)
+pair against the reference-schema SQLite store (reference:
+market.py:200-221, reliability.py:185-231). Records must match the scalar
+settlement bit-for-bit under x64; the flushed DB must be readable by the
+reference-format store at 100k-market scale.
+"""
+
+import dataclasses
+import math
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+enable_x64 = jax.enable_x64
+
+from bayesian_consensus_engine_tpu.core import compute_consensus
+from bayesian_consensus_engine_tpu.pipeline import (
+    build_settlement_plan,
+    settle,
+    settle_payloads,
+)
+from bayesian_consensus_engine_tpu.state.sqlite_store import SQLiteReliabilityStore
+from bayesian_consensus_engine_tpu.state.tensor_store import TensorReliabilityStore
+from bayesian_consensus_engine_tpu.utils.timeconv import now_days
+
+
+def random_payloads(rng: random.Random, num_markets: int, universe: int,
+                    max_signals: int = 6, dup_rate: float = 0.2):
+    """(market_id, signals) payloads with duplicate-source signals mixed in."""
+    payloads = []
+    for m in range(num_markets):
+        n = rng.randint(1, max_signals)
+        sources = [f"src-{rng.randrange(universe)}" for _ in range(n)]
+        # Duplicate some sources so the dedupe-mean path is exercised.
+        for i in range(1, n):
+            if rng.random() < dup_rate:
+                sources[i] = sources[i - 1]
+        signals = [
+            {"sourceId": sid, "probability": round(rng.random(), 6)}
+            for sid in sources
+        ]
+        payloads.append((f"market-{m}", signals))
+    return payloads
+
+
+def scalar_settle(store, payloads, outcomes, steps=1):
+    """The reference-semantics settlement loop against any record store.
+
+    Per market: decayed per-source reliability → scalar consensus, then one
+    capped update per unique source with correctness judged at mean-p >= 0.5.
+    """
+    documents = {}
+    for step in range(steps):
+        for (market_id, signals), outcome in zip(payloads, outcomes):
+            table = {}
+            for sig in signals:
+                sid = sig["sourceId"]
+                if sid not in table:
+                    record = store.get_reliability(sid, market_id, apply_decay=True)
+                    table[sid] = {
+                        "reliability": record.reliability,
+                        "confidence": record.confidence,
+                    }
+            documents[market_id] = compute_consensus(signals, table or None)
+            by_source = {}
+            for sig in signals:
+                by_source.setdefault(sig["sourceId"], []).append(sig["probability"])
+            for sid in sorted(by_source):
+                probs = by_source[sid]
+                mean_p = sum(probs) / len(probs)
+                store.update_reliability(sid, market_id, (mean_p >= 0.5) == outcome)
+    return documents
+
+
+def assert_records_match(tensor_records, sqlite_records):
+    """Exact value parity between two record lists (timestamps excluded)."""
+    assert len(tensor_records) == len(sqlite_records)
+    for ours, theirs in zip(tensor_records, sqlite_records):
+        assert (ours.source_id, ours.market_id) == (
+            theirs.source_id, theirs.market_id)
+        assert ours.reliability == theirs.reliability, (
+            ours.source_id, ours.market_id)
+        assert ours.confidence == theirs.confidence
+        assert bool(ours.updated_at) == bool(theirs.updated_at)
+
+
+class TestSettlementParity:
+    def test_records_match_scalar_settlement(self):
+        rng = random.Random(7)
+        payloads = random_payloads(rng, num_markets=60, universe=25)
+        payloads[10] = ("market-10", [])  # empty market: no updates, no weight
+        outcomes = [rng.random() < 0.5 for _ in payloads]
+
+        with enable_x64():
+            store = TensorReliabilityStore()
+            result = settle_payloads(store, payloads, outcomes, now=now_days())
+
+        oracle = SQLiteReliabilityStore(":memory:")
+        docs = scalar_settle(oracle, payloads, outcomes)
+
+        assert_records_match(store.list_sources(), oracle.list_sources())
+        # Cold-start consensus is pure weighted math — compare per market.
+        for market_id, consensus in zip(result.market_keys, result.consensus):
+            expected = docs[market_id]["consensus"]
+            if expected is None:
+                assert math.isnan(consensus)
+            else:
+                assert math.isclose(consensus, expected, rel_tol=1e-12)
+
+    def test_multi_step_settlement_matches_repeated_scalar(self):
+        rng = random.Random(11)
+        payloads = random_payloads(rng, num_markets=40, universe=15)
+        outcomes = [rng.random() < 0.5 for _ in payloads]
+
+        with enable_x64():
+            store = TensorReliabilityStore()
+            settle_payloads(store, payloads, outcomes, steps=4, now=now_days())
+
+        oracle = SQLiteReliabilityStore(":memory:")
+        scalar_settle(oracle, payloads, outcomes, steps=4)
+        assert_records_match(store.list_sources(), oracle.list_sources())
+
+    def test_seeded_state_updates_exact_consensus_close(self):
+        """Pre-existing (decay-eligible) state: updates stay bit-exact."""
+        rng = random.Random(13)
+        payloads = random_payloads(rng, num_markets=30, universe=10)
+        outcomes = [rng.random() < 0.5 for _ in payloads]
+        seed_stamp = "2026-07-15T00:00:00+00:00"  # weeks old → decays on read
+
+        with enable_x64():
+            store = TensorReliabilityStore()
+            oracle = SQLiteReliabilityStore(":memory:")
+            for market_id, signals in payloads[:20]:
+                for sig in signals[:2]:
+                    rel = round(rng.random(), 6)
+                    conf = round(rng.random(), 6)
+                    for target in (store, oracle):
+                        record = target.get_reliability(sig["sourceId"], market_id)
+                        target.put_record(dataclasses.replace(
+                            record, reliability=rel, confidence=conf,
+                            updated_at=seed_stamp))
+            result = settle_payloads(store, payloads, outcomes, now=now_days())
+
+        docs = scalar_settle(oracle, payloads, outcomes)
+        assert_records_match(store.list_sources(), oracle.list_sources())
+        # Consensus reads decayed values; the scalar oracle decays against
+        # its own wall clock, which runs seconds later than the pipeline's
+        # ``now`` (jit compile time sits in between) → close, not bitwise.
+        for market_id, consensus in zip(result.market_keys, result.consensus):
+            expected = docs[market_id]["consensus"]
+            assert math.isclose(consensus, expected, rel_tol=1e-6)
+
+    def test_flush_roundtrip_preserves_untouched_rows(self):
+        """Rows the settlement never touched survive flush byte-identical."""
+        with enable_x64():
+            store = TensorReliabilityStore()
+            untouched = dataclasses.replace(
+                store.get_reliability("a", "other"),
+                reliability=0.123456789012345, confidence=0.3,
+                updated_at="2026-01-02T03:04:05.000006+00:00")
+            store.put_record(untouched)
+            settle_payloads(
+                store,
+                [("m", [{"sourceId": "a", "probability": 0.9}])],
+                [True],
+                now=now_days(),
+            )
+        records = {
+            (r.source_id, r.market_id): r for r in store.list_sources()
+        }
+        assert records[("a", "other")] == untouched
+        assert records[("a", "m")].reliability == 0.6  # 0.5 + capped step
+
+
+class TestPipelineScale:
+    def test_flushed_db_matches_scalar_settlement_100k_markets(self, tmp_path):
+        """The VERDICT gate: ≥100k markets end-to-end, flushed DB readable
+        by the reference-format store with scalar-settlement-identical rows."""
+        rng = random.Random(100)
+        num_markets = 100_000
+        payloads = random_payloads(
+            rng, num_markets=num_markets, universe=800, max_signals=4)
+        outcomes = [rng.random() < 0.5 for _ in payloads]
+
+        with enable_x64():
+            store = TensorReliabilityStore()
+            result = settle_payloads(
+                store, payloads, outcomes, now=now_days(),
+                db_path=tmp_path / "settled.db")
+
+        assert len(result.consensus) == num_markets
+
+        oracle = SQLiteReliabilityStore(":memory:")
+        scalar_settle(oracle, payloads, outcomes)
+
+        with SQLiteReliabilityStore(tmp_path / "settled.db") as flushed:
+            flushed_records = flushed.list_sources()
+        assert_records_match(flushed_records, oracle.list_sources())
+
+
+class TestPipelineApi:
+    def test_duplicate_market_ids_rejected(self):
+        store = TensorReliabilityStore()
+        payload = [("m", [{"sourceId": "a", "probability": 0.5}])] * 2
+        with pytest.raises(ValueError, match="duplicate market ids"):
+            build_settlement_plan(store, payload)
+
+    def test_outcome_count_mismatch_rejected(self):
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(
+            store, [("m", [{"sourceId": "a", "probability": 0.5}])])
+        with pytest.raises(ValueError, match="outcomes"):
+            settle(store, plan, [True, False])
+
+    def test_plan_reuse_across_cycles(self):
+        """One plan, many settle calls — state advances like chained steps."""
+        with enable_x64():
+            store = TensorReliabilityStore()
+            plan = build_settlement_plan(
+                store, [("m", [{"sourceId": "a", "probability": 0.9}])])
+            settle(store, plan, [True], now=now_days())
+            settle(store, plan, [True], now=now_days())
+
+            chained = TensorReliabilityStore()
+            settle_payloads(
+                chained, [("m", [{"sourceId": "a", "probability": 0.9}])],
+                [True], steps=2, now=now_days())
+        ours = store.get_reliability("a", "m")
+        theirs = chained.get_reliability("a", "m")
+        assert (ours.reliability, ours.confidence) == (
+            theirs.reliability, theirs.confidence)
+
+    def test_empty_payloads(self):
+        store = TensorReliabilityStore()
+        result = settle_payloads(store, [], [])
+        assert result.market_keys == []
+        assert len(store.list_sources()) == 0
+
+    def test_plan_block_layout(self):
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, [
+            ("m1", [{"sourceId": "b", "probability": 0.2},
+                    {"sourceId": "a", "probability": 0.4},
+                    {"sourceId": "b", "probability": 0.6}]),
+            ("m2", [{"sourceId": "a", "probability": 0.8}]),
+        ])
+        assert plan.num_slots == 2          # m1 has two unique sources
+        assert plan.mask.T.tolist() == [[True, True], [True, False]]
+        # Slot order is source-sorted within each market: m1 → (a, b).
+        assert plan.probs.T[0].tolist() == [0.4, 0.4]  # a=0.4, b=mean(0.2,0.6)
+        rows_m1 = plan.slot_rows.T[0]
+        assert store._pairs.id_of(int(rows_m1[0])) == ("a", "m1")
+        assert store._pairs.id_of(int(rows_m1[1])) == ("b", "m1")
