@@ -152,36 +152,69 @@ def _run_list_sources(args: argparse.Namespace) -> None:
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="bce-tpu",
-        description="TPU-native Bayesian-weighted consensus engine with reliability tracking",
+        description=(
+            "Reliability-weighted consensus over probability signals, "
+            "with persistent per-(source, market) track records — "
+            "TPU-native engine, reference-compatible surface"
+        ),
     )
-    parser.add_argument("--db", type=str, help="Path to SQLite database file (default: in-memory)")
+    parser.add_argument(
+        "--db",
+        type=str,
+        help="SQLite file holding reliability state (omit for an ephemeral run)",
+    )
     parser.add_argument(
         "--dry-run",
         action="store_true",
-        help="Compute without persisting changes (zero DB writes)",
+        help="show what would change while guaranteeing the DB is never written",
     )
-    parser.add_argument("--input", type=str, help="Path to JSON input file (for consensus command)")
+    parser.add_argument(
+        "--input",
+        type=str,
+        help="read the consensus payload from this JSON file instead of stdin",
+    )
     parser.add_argument(
         "--backend",
         choices=("python", "jax", "tpu"),
         default="python",
-        help="Consensus engine implementation (default: python, bit-exact)",
+        help="which consensus engine runs the math (default: python, bit-exact)",
     )
 
-    sub = parser.add_subparsers(dest="command", help="Available commands")
+    sub = parser.add_subparsers(dest="command", help="subcommands")
 
-    consensus = sub.add_parser("consensus", help="Compute consensus from signals")
-    consensus.add_argument("--input", help="Path to JSON input file")
+    consensus = sub.add_parser(
+        "consensus",
+        help="weigh a payload's signals into a consensus document",
+    )
+    consensus.add_argument(
+        "--input", help="JSON payload file (stdin when omitted)"
+    )
     consensus.set_defaults(handler=_run_consensus)
 
-    outcome = sub.add_parser("report-outcome", help="Report outcome and update reliability")
-    outcome.add_argument("--source-id", required=True, help="Source identifier")
-    outcome.add_argument("--market-id", required=True, help="Market identifier")
-    outcome.add_argument("--correct", action="store_true", help="Outcome was correct")
+    outcome = sub.add_parser(
+        "report-outcome",
+        help="settle one source's prediction and adjust its reliability",
+    )
+    outcome.add_argument(
+        "--source-id", required=True, help="which source to settle"
+    )
+    outcome.add_argument(
+        "--market-id", required=True, help="the market the prediction was for"
+    )
+    outcome.add_argument(
+        "--correct",
+        action="store_true",
+        help="the source called it right (omit for a wrong call)",
+    )
     outcome.set_defaults(handler=_run_report_outcome)
 
-    listing = sub.add_parser("list-sources", help="List sources with reliability data")
-    listing.add_argument("--market-id", help="Filter by market ID")
+    listing = sub.add_parser(
+        "list-sources",
+        help="dump every stored reliability record as JSON",
+    )
+    listing.add_argument(
+        "--market-id", help="restrict the listing to one market"
+    )
     listing.set_defaults(handler=_run_list_sources)
 
     return parser

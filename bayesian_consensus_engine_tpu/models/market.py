@@ -16,11 +16,15 @@ a source is "correct" iff (probability >= 0.5) == outcome.
 from __future__ import annotations
 
 import fnmatch
+from collections import Counter
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from bayesian_consensus_engine_tpu.core.engine import compute_consensus
+from bayesian_consensus_engine_tpu.utils.interning import IdInterner
 from bayesian_consensus_engine_tpu.state.sqlite_store import ReliabilityStore
 from bayesian_consensus_engine_tpu.state.update_math import utc_now_iso
 from bayesian_consensus_engine_tpu.utils.config import SCHEMA_VERSION
@@ -156,27 +160,46 @@ class MarketStore:
     ) -> Dict[str, Dict[str, Any]]:
         """Consensus for every OPEN market (decayed reliability per source).
 
-        This is the loop the TPU path replaces wholesale — see
-        ``core.batch.compute_batch_consensus`` for the vmapped (M×S) kernel
-        over a packed signal tensor.
+        Any non-scalar backend routes the whole sweep through the batched
+        engine — one device pass over a packed signal tensor — instead of
+        market-by-market scalar calls; results land in the same
+        ``{market_id: document}`` mapping and are cached on each market
+        either way.
         """
+        if backend != "python":
+            from bayesian_consensus_engine_tpu.core.batch import (
+                compute_all_consensus_batched,
+            )
+
+            return compute_all_consensus_batched(self, reliability_store)
+
         results: Dict[str, Dict[str, Any]] = {}
         for market in self.list_markets(status=MarketStatus.OPEN):
-            source_rel: Optional[Dict[str, Dict[str, float]]] = None
-            if reliability_store is not None:
-                source_rel = {}
-                for signal in market.signals:
-                    sid = signal["sourceId"]
-                    if sid not in source_rel:
-                        record = reliability_store.get_reliability(
-                            sid, str(market.id), apply_decay=True
-                        )
-                        source_rel[sid] = {
-                            "reliability": record.reliability,
-                            "confidence": record.confidence,
-                        }
-            results[str(market.id)] = market.compute_consensus(source_rel, backend=backend)
+            table = (
+                None
+                if reliability_store is None
+                else _decayed_reliability_table(
+                    reliability_store, market.signals, str(market.id)
+                )
+            )
+            results[str(market.id)] = market.compute_consensus(table)
         return results
+
+
+def _decayed_reliability_table(
+    store: ReliabilityStore, signals: List[Dict[str, Any]], market_id: str
+) -> Dict[str, Dict[str, float]]:
+    """Per-source decayed reliability for one market's signalling sources."""
+    table: Dict[str, Dict[str, float]] = {}
+    for signal in signals:
+        sid = signal["sourceId"]
+        if sid not in table:
+            record = store.get_reliability(sid, market_id, apply_decay=True)
+            table[sid] = {
+                "reliability": record.reliability,
+                "confidence": record.confidence,
+            }
+    return table
 
 
 @dataclass
@@ -207,55 +230,95 @@ class CrossMarketAggregator:
         self,
         patterns: Optional[List[str]] = None,
     ) -> Dict[str, SourcePerformance]:
-        """Per-source accuracy over RESOLVED markets (optionally filtered)."""
-        markets = self._store.list_markets(status=MarketStatus.RESOLVED)
-        if patterns:
-            markets = [
-                m for m in markets if any(m.id.matches(p) for p in patterns)
-            ]
+        """Per-source accuracy over RESOLVED markets (optionally filtered).
 
-        tallies: Dict[str, Dict[str, Any]] = {}
-        for market in markets:
-            if market.outcome is None:
-                continue
-            for signal in market.signals:
-                sid = signal["sourceId"]
-                stats = tallies.setdefault(
-                    sid, {"total": 0, "correct": 0, "wrong": 0, "markets": []}
-                )
-                stats["total"] += 1
-                stats["markets"].append(str(market.id))
-                # Binary correctness: predicted-true iff probability >= 0.5.
-                predicted_true = signal.get("probability", 0.5) >= 0.5
-                if predicted_true == market.outcome:
-                    stats["correct"] += 1
-                else:
-                    stats["wrong"] += 1
+        Columnar: all signals across all resolved markets flatten into
+        (source row, probability, outcome) arrays once, correctness is one
+        vectorised compare (predicted-true iff p ≥ 0.5, reference:
+        market.py:296-303), and per-source tallies are bincounts — no
+        per-signal dict churn. Scorecards come out in first-seen source
+        order, exactly like the sequential walk.
+        """
+        markets = [
+            m
+            for m in self._store.list_markets(status=MarketStatus.RESOLVED)
+            if m.outcome is not None
+            and (not patterns or any(m.id.matches(p) for p in patterns))
+        ]
+        market_ids = [str(m.id) for m in markets]
+
+        sources = IdInterner()
+        columns = [
+            (sources.intern(sig["sourceId"]), sig.get("probability", 0.5), mi)
+            for mi, market in enumerate(markets)
+            for sig in market.signals
+        ]
+        if not columns:
+            return {}
+        src, prob, market_of = (np.asarray(c) for c in zip(*columns))
+        outcome_of = np.asarray([m.outcome for m in markets], dtype=bool)
+        correct = (prob.astype(np.float64) >= 0.5) == outcome_of[market_of]
+
+        n = len(sources)
+        total = np.bincount(src, minlength=n)
+        n_correct = np.bincount(src, weights=correct, minlength=n).astype(np.int64)
+        # Group signal rows by source, preserving original signal order
+        # within each group (stable sort), for the per-source market lists.
+        grouped = np.argsort(src, kind="stable")
+        group_end = np.cumsum(total)
 
         summary: Dict[str, SourcePerformance] = {}
-        for sid, stats in tallies.items():
-            judged = stats["correct"] + stats["wrong"]
-            summary[sid] = SourcePerformance(
-                source_id=sid,
-                total_markets=stats["total"],
-                correct_predictions=stats["correct"],
-                wrong_predictions=stats["wrong"],
-                reliability=stats["correct"] / judged if judged else 0.5,
-                markets=stats["markets"],
+        for row in range(n):
+            rows = grouped[group_end[row] - total[row]: group_end[row]]
+            summary[sources.id_of(row)] = SourcePerformance(
+                source_id=sources.id_of(row),
+                total_markets=int(total[row]),
+                correct_predictions=int(n_correct[row]),
+                wrong_predictions=int(total[row] - n_correct[row]),
+                reliability=(
+                    float(n_correct[row] / total[row]) if total[row] else 0.5
+                ),
+                markets=[market_ids[mi] for mi in market_of[rows]],
             )
         return summary
 
     def summarize_category(self, category: str) -> Dict[str, Any]:
+        """Status census of one ``category:*`` id prefix."""
         markets = self._store.list_markets(pattern=f"{category}:*")
-        resolved = [m for m in markets if m.status == MarketStatus.RESOLVED]
-        open_markets = [m for m in markets if m.status == MarketStatus.OPEN]
+        by_status = Counter(m.status for m in markets)
         return {
             "category": category,
             "total_markets": len(markets),
-            "resolved": len(resolved),
-            "open": len(open_markets),
+            "resolved": by_status[MarketStatus.RESOLVED],
+            "open": by_status[MarketStatus.OPEN],
             "markets": [str(m.id) for m in markets],
         }
+
+    def consensus_columns(
+        self, patterns: List[str]
+    ) -> tuple[int, np.ndarray, np.ndarray]:
+        """Cached consensus documents for matching markets, as columns.
+
+        Returns ``(matched, values, confidences)`` where *matched* counts
+        every pattern match (a market matching two patterns counts twice,
+        as the sequential walk did) and the arrays hold the non-null cached
+        consensus/confidence pairs — the columnar feed for any aggregation,
+        and exactly the arrays a ``compute_all_consensus`` sweep (batched or
+        scalar) just cached onto the markets.
+        """
+        docs = [
+            m.consensus_result
+            for pattern in patterns
+            for m in self._store.list_markets(pattern=pattern)
+        ]
+        live = [
+            d for d in docs if d and d.get("consensus") is not None
+        ]
+        values = np.asarray([d["consensus"] for d in live], dtype=np.float64)
+        confs = np.asarray(
+            [d.get("confidence", 0.5) for d in live], dtype=np.float64
+        )
+        return len(docs), values, confs
 
     def aggregate_consensus(
         self,
@@ -264,59 +327,43 @@ class CrossMarketAggregator:
     ) -> Dict[str, Any]:
         """Combine cached per-market consensus across matching markets.
 
-        Methods: confidence-weighted average, upper median, binary majority.
+        Methods: confidence-weighted average, upper median, binary majority
+        — each one vectorised over the consensus columns.
         """
-        markets: List[Market] = []
-        for pattern in patterns:
-            markets.extend(self._store.list_markets(pattern=pattern))
-
-        if not markets:
+        matched, values, confs = self.consensus_columns(patterns)
+        if values.size == 0:
             return {
                 "schemaVersion": SCHEMA_VERSION,
                 "consensus": None,
                 "confidence": 0.0,
-                "marketsIncluded": 0,
+                "marketsIncluded": matched,
             }
 
-        entries = [
-            {
-                "marketId": str(m.id),
-                "consensus": m.consensus_result["consensus"],
-                "confidence": m.consensus_result.get("confidence", 0.5),
-            }
-            for m in markets
-            if m.consensus_result and m.consensus_result.get("consensus") is not None
-        ]
-
-        if not entries:
-            return {
-                "schemaVersion": SCHEMA_VERSION,
-                "consensus": None,
-                "confidence": 0.0,
-                "marketsIncluded": len(markets),
-            }
-
+        # Reductions run over Python floats via builtin sum(): CPython ≥3.12
+        # compensates float sums, and the scalar reference inherits exactly
+        # that — numpy pairwise/BLAS accumulation differs in the last ulp,
+        # which would break parity with reference outputs. The elementwise
+        # product is IEEE-exact either way, so only the sums need care.
+        conf_list = confs.tolist()
         if method == "weighted_average":
-            total_conf = sum(e["confidence"] for e in entries)
-            if total_conf == 0:
-                aggregated = sum(e["consensus"] for e in entries) / len(entries)
-            else:
-                aggregated = (
-                    sum(e["consensus"] * e["confidence"] for e in entries) / total_conf
-                )
+            total_conf = sum(conf_list)
+            aggregated = (
+                sum((values * confs).tolist()) / total_conf
+                if total_conf
+                else sum(values.tolist()) / values.size
+            )
         elif method == "median":
-            ordered = sorted(e["consensus"] for e in entries)
-            aggregated = ordered[len(ordered) // 2]  # upper median, like reference
+            # Upper median: the reference indexes the sorted list at n//2.
+            aggregated = float(np.sort(values)[values.size // 2])
         elif method == "majority":
-            votes = [1 if e["consensus"] >= 0.5 else 0 for e in entries]
-            aggregated = sum(votes) / len(votes)
+            aggregated = float((values >= 0.5).mean())
         else:
             raise ValueError(f"Unknown aggregation method: {method}")
 
         return {
             "schemaVersion": SCHEMA_VERSION,
             "consensus": aggregated,
-            "confidence": sum(e["confidence"] for e in entries) / len(entries),
-            "marketsIncluded": len(entries),
+            "confidence": sum(conf_list) / len(conf_list),
+            "marketsIncluded": int(values.size),
             "method": method,
         }

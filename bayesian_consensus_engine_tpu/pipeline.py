@@ -62,6 +62,7 @@ class SettlementPlan:
     probs: np.ndarray             # f64[K, M] per-pair mean probability
     mask: np.ndarray              # bool[K, M] slot carries a signal
     signals_per_market: np.ndarray  # i32[M] raw signal counts (diagnostics)
+    binding: tuple[tuple[int, str, str], ...]  # (row, source, market) probes
 
     @property
     def num_markets(self) -> int:
@@ -119,12 +120,26 @@ def build_settlement_plan(
     probs[market_of_pair, slot_of_pair] = pair_mean
     mask[market_of_pair, slot_of_pair] = True
 
+    # Binding probes: a spread of (row, pair) samples (always including the
+    # highest row) lets settle() verify the plan still matches the store's
+    # interner — a checkpoint-restored store with identical row assignment
+    # passes; an unrelated store of coincidentally sufficient size does not.
+    if len(rows):
+        probe_idx = {0, len(rows) - 1, int(np.argmax(rows))}
+        probe_idx.update(range(0, len(rows), max(1, len(rows) // 8)))
+        binding = tuple(
+            (int(rows[i]), pairs[i][0], pairs[i][1]) for i in sorted(probe_idx)
+        )
+    else:
+        binding = ()
+
     return SettlementPlan(
         market_keys=keys,
         slot_rows=np.ascontiguousarray(slot_rows.T),
         probs=np.ascontiguousarray(probs.T),
         mask=np.ascontiguousarray(mask.T),
         signals_per_market=packed.signals_per_market,
+        binding=binding,
     )
 
 
@@ -256,6 +271,12 @@ def settle(
             f"plan references row {int(plan.slot_rows.max())} but the store "
             f"holds {len(store)} pairs — was the plan built for this store?"
         )
+    for row, source_id, market_id in plan.binding:
+        if store._pairs.get((source_id, market_id)) != row:
+            raise ValueError(
+                f"plan is bound to a different store: ({source_id!r}, "
+                f"{market_id!r}) does not intern to row {row} here"
+            )
 
     # Capture pre-settle confidences: the post-settle values are replayed
     # host-side in exact scalar arithmetic (see overwrite_confidences — XLA
@@ -265,7 +286,7 @@ def settle(
     touched_rows = plan.slot_rows[plan.mask]
     conf_exact = store.host_confidences(touched_rows)
 
-    (flat, epoch0) = store.device_state(dtype)
+    (flat, epoch0) = store.device_state(dtype, donate=True)
     now_abs = _now_days() if now is None else now
     cdtype = flat.reliability.dtype
 
@@ -281,9 +302,6 @@ def settle(
         jnp.asarray(now_abs - epoch0, dtype=cdtype),
         steps,
     )
-    # The kernel donated the cached device buffers; drop the stale cache
-    # before anything else can touch it, then absorb the new state.
-    store._invalidate()
     store.absorb(
         DeviceReliabilityState(rel, conf, days, exists), epoch0
     )

@@ -328,19 +328,26 @@ class TensorReliabilityStore:
 
     # -- device tier ---------------------------------------------------------
 
-    def device_state(self, dtype=None):
+    def device_state(self, dtype=None, donate=False):
         """Materialise the HBM pytree (cached until the next host write).
 
         Returns ``(DeviceReliabilityState, epoch0)`` where ``updated_days``
         is relative to ``epoch0`` so float32 elapsed-time subtraction keeps
         ~seconds resolution.
+
+        ``donate=True`` hands ownership of the buffers to the caller (for a
+        donating jit): the store forgets its cache immediately, so it never
+        holds references to buffers the compiler may invalidate.
         """
         import jax.numpy as jnp
 
         from bayesian_consensus_engine_tpu.utils.dtypes import default_float_dtype
 
         if self._device_cache is not None:
-            return self._device_cache
+            cached = self._device_cache
+            if donate:
+                self._device_cache = None
+            return cached
 
         dtype = dtype or default_float_dtype()
         used = len(self._pairs)
@@ -355,6 +362,8 @@ class TensorReliabilityStore:
             updated_days=jnp.asarray(relative, dtype=dtype),
             exists=jnp.asarray(self._exists[:used]),
         )
+        if donate:
+            return (state, epoch0)
         self._device_cache = (state, epoch0)
         return self._device_cache
 

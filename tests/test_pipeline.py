@@ -238,6 +238,30 @@ class TestPipelineApi:
         assert (ours.reliability, ours.confidence) == (
             theirs.reliability, theirs.confidence)
 
+    def test_plan_bound_to_wrong_store_rejected(self):
+        store_a = TensorReliabilityStore()
+        store_b = TensorReliabilityStore()
+        # store_b is big enough for the plan's rows, but its interner maps
+        # those rows to different pairs — the binding probes must catch it.
+        build_settlement_plan(
+            store_b, [("other", [{"sourceId": "x", "probability": 0.5}])])
+        plan = build_settlement_plan(
+            store_a, [("m", [{"sourceId": "a", "probability": 0.5}])])
+        with pytest.raises(ValueError, match="different store"):
+            settle(store_b, plan, [True])
+
+    def test_plan_valid_against_checkpoint_restored_store(self, tmp_path):
+        """Row assignment survives checkpoint round-trips; plans stay valid."""
+        with enable_x64():
+            store = TensorReliabilityStore()
+            payload = [("m", [{"sourceId": "a", "probability": 0.9}])]
+            plan = build_settlement_plan(store, payload)
+            settle(store, plan, [True], now=now_days())
+            store.save_checkpoint(tmp_path / "ckpt")
+            restored = TensorReliabilityStore.load_checkpoint(tmp_path / "ckpt")
+            settle(restored, plan, [True], now=now_days())
+        assert restored.get_reliability("a", "m").reliability == 0.7
+
     def test_empty_payloads(self):
         store = TensorReliabilityStore()
         result = settle_payloads(store, [], [])
