@@ -166,6 +166,8 @@ class MarketStore:
         ``{market_id: document}`` mapping and are cached on each market
         either way.
         """
+        if backend not in ("python", "jax", "tpu"):
+            raise ValueError(f"unknown backend: {backend!r}")
         if backend != "python":
             from bayesian_consensus_engine_tpu.core.batch import (
                 compute_all_consensus_batched,

@@ -41,6 +41,24 @@ from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AX
 _BLOCK_SPEC = P(MARKETS_AXIS, SOURCES_AXIS)
 _MARKET_SPEC = P(MARKETS_AXIS)
 
+# Cluster bring-up is once-per-process; tracked here so repeat
+# init_distributed() calls are no-ops by construction rather than by
+# parsing jax's "should only be called once" error text (which a JAX
+# upgrade could reword out from under us).
+_cluster_initialized = False
+
+
+def _runtime_already_initialized() -> bool:
+    """True when this process has already joined a multi-process runtime."""
+    if _cluster_initialized:
+        return True
+    try:  # official flag where the private module still exposes it
+        from jax._src import distributed as _jax_distributed
+
+        return _jax_distributed.global_state.client is not None
+    except Exception:  # API moved: fall back to our own flag only
+        return False
+
 
 def init_distributed(
     coordinator_address: Optional[str] = None,
@@ -60,25 +78,23 @@ def init_distributed(
     # IMPORTANT: nothing here may touch the backend (jax.devices()/
     # process_count()/...) before initialize() — backend queries initialise
     # XLA, after which jax.distributed.initialize() unconditionally raises.
+    global _cluster_initialized
     wants_cluster = coordinator_address is not None or (
         num_processes is not None and num_processes > 1
     )
-    if wants_cluster:
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-                **kwargs,
-            )
-        except RuntimeError as err:
-            # Tolerate ONLY repeat initialisation (idempotence contract);
-            # real bring-up failures (coordinator unreachable, barrier
-            # timeout, backend already initialised by an earlier JAX call)
-            # must surface — swallowing them would silently degrade a pod
-            # run to disconnected single-process runs.
-            if "should only be called once" not in str(err):
-                raise
+    if wants_cluster and not _runtime_already_initialized():
+        # Real bring-up failures (coordinator unreachable, barrier timeout,
+        # backend already initialised by an earlier JAX call) surface as-is —
+        # swallowing them would silently degrade a pod run to disconnected
+        # single-process runs. Repeat calls never reach initialize(): the
+        # guard above makes idempotence structural.
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+        _cluster_initialized = True
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
@@ -157,19 +173,37 @@ def process_market_rows(num_markets: int, mesh: Mesh) -> tuple[int, int]:
     """
     sharding = NamedSharding(mesh, _MARKET_SPEC)
     shape = (num_markets,)
-    lo = None
-    hi = None
+    intervals = set()
     for d, index in sharding.devices_indices_map(shape).items():
         if d.process_index != jax.process_index():
             continue
         sl = index[0]
         start = sl.start or 0
         stop = sl.stop if sl.stop is not None else num_markets
-        lo = start if lo is None else min(lo, start)
-        hi = stop if hi is None else max(hi, stop)
-    if lo is None:
+        intervals.add((start, stop))
+    return _band_from_intervals(intervals)
+
+
+def _band_from_intervals(intervals: set[tuple[int, int]]) -> tuple[int, int]:
+    """Collapse a process's row intervals to [lo, hi), proving they tile it.
+
+    The band is only meaningful if the intervals exactly tile it: within a
+    granule, mesh construction may reorder devices, and on a real multi-host
+    slice that can interleave one process's rows with another's — a min/max
+    hull would then silently claim rows owned elsewhere and global_block
+    would be fed wrong data.
+    """
+    if not intervals:
         raise ValueError("this process owns no devices in the mesh")
-    return lo, hi
+    ordered = sorted(intervals)
+    for (_, prev_stop), (start, _) in zip(ordered, ordered[1:]):
+        if start != prev_stop:
+            raise ValueError(
+                f"this process's market rows are not contiguous (intervals "
+                f"{ordered}); rebuild the mesh with make_hybrid_mesh so "
+                "each process owns one band"
+            )
+    return ordered[0][0], ordered[-1][1]
 
 
 def global_block(local_rows: np.ndarray, mesh: Mesh, num_markets: int) -> jax.Array:

@@ -271,12 +271,17 @@ def settle(
             f"plan references row {int(plan.slot_rows.max())} but the store "
             f"holds {len(store)} pairs — was the plan built for this store?"
         )
-    for row, source_id, market_id in plan.binding:
-        if store._pairs.get((source_id, market_id)) != row:
-            raise ValueError(
-                f"plan is bound to a different store: ({source_id!r}, "
-                f"{market_id!r}) does not intern to row {row} here"
-            )
+    if plan.binding:
+        probe_rows = store.rows_for_pairs(
+            [(source_id, market_id) for _, source_id, market_id in plan.binding],
+            allocate=False,
+        )
+        for (row, source_id, market_id), got in zip(plan.binding, probe_rows):
+            if int(got) != row:
+                raise ValueError(
+                    f"plan is bound to a different store: ({source_id!r}, "
+                    f"{market_id!r}) does not intern to row {row} here"
+                )
 
     # Capture pre-settle confidences: the post-settle values are replayed
     # host-side in exact scalar arithmetic (see overwrite_confidences — XLA

@@ -19,6 +19,7 @@ from bayesian_consensus_engine_tpu.parallel import (
     make_mesh,
 )
 from bayesian_consensus_engine_tpu.parallel.distributed import (
+    _band_from_intervals,
     global_block,
     global_market,
     init_distributed,
@@ -52,6 +53,32 @@ class TestInitDistributed:
                 num_processes=2,
                 process_id=0,
             )
+
+
+class TestBandFromIntervals:
+    def test_contiguous_tiling_collapses(self):
+        assert _band_from_intervals({(0, 4), (4, 8), (8, 12)}) == (0, 12)
+
+    def test_duplicate_intervals_ok(self):
+        # Replicas along the sources axis present identical row slices.
+        assert _band_from_intervals({(4, 8), (4, 8)}) == (4, 8)
+
+    def test_single_interval(self):
+        assert _band_from_intervals({(16, 32)}) == (16, 32)
+
+    def test_gap_raises(self):
+        # Interleaved ownership (another process holds (4, 8)) must never
+        # collapse to the hull (0, 12).
+        with pytest.raises(ValueError, match="not contiguous"):
+            _band_from_intervals({(0, 4), (8, 12)})
+
+    def test_overlap_raises(self):
+        with pytest.raises(ValueError, match="not contiguous"):
+            _band_from_intervals({(0, 6), (4, 8)})
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError, match="owns no devices"):
+            _band_from_intervals(set())
 
 
 class TestHybridMesh:

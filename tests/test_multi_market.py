@@ -155,6 +155,13 @@ class TestMarketStore:
         store.get_market(MarketId("m1")).resolve(True)
         assert store.compute_all_consensus() == {}
 
+    def test_unknown_backend_rejected(self):
+        # A typo'd backend must raise, not silently route to the array path.
+        store = MarketStore()
+        store.add_signal(MarketId("m1"), {"sourceId": "a", "probability": 0.6})
+        with pytest.raises(ValueError, match="unknown backend"):
+            store.compute_all_consensus(backend="pyton")
+
 
 def _resolved_store() -> MarketStore:
     """agent-a right twice; agent-b right once, wrong once."""
