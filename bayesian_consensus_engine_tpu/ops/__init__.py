@@ -14,6 +14,11 @@ from bayesian_consensus_engine_tpu.ops.decay import (
     decayed_reliability,
     decayed_reliability_at,
 )
+from bayesian_consensus_engine_tpu.ops.tiebreak import (
+    BatchTieBreakResult,
+    batched_tiebreak,
+    build_batched_tiebreak,
+)
 from bayesian_consensus_engine_tpu.ops.update import (
     masked_outcome_update,
     outcome_update,
@@ -28,4 +33,7 @@ __all__ = [
     "decayed_reliability_at",
     "masked_outcome_update",
     "outcome_update",
+    "BatchTieBreakResult",
+    "batched_tiebreak",
+    "build_batched_tiebreak",
 ]

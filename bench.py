@@ -268,6 +268,63 @@ def bench_pallas(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
     )
 
 
+def bench_tiebreak_stress(markets=2048, agents=10_000, reps=3):
+    """BASELINE config #4: deterministic tie-break at 10k agents per market.
+
+    Runs BOTH at-scale groupings on the chip — the ring/pairwise path
+    (parallel/ring.py, O(A²) compares that XLA fuses) and the sort-based
+    path (ops/tiebreak.py, O(A log A) but bottlenecked by XLA's TPU sort)
+    — and reports markets-resolved/sec plus the compiled memory footprint
+    of the ring call (its origin buffer is the documented at-scale risk;
+    single-chip ring_size=1 keeps it one block — shard markets too when
+    multi-device, tests/test_ring.py::test_markets_axis_sharded_too).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from bayesian_consensus_engine_tpu.ops.tiebreak import build_batched_tiebreak
+    from bayesian_consensus_engine_tpu.parallel.ring import build_ring_tiebreak
+
+    rng = np.random.default_rng(11)
+    grid = np.round(np.linspace(0.05, 0.95, 37), 6)
+    args = (
+        jnp.asarray(rng.choice(grid, (markets, agents)), jnp.float32),
+        jnp.asarray(rng.uniform(0.1, 2.0, (markets, agents)), jnp.float32),
+        jnp.asarray(rng.uniform(0, 1, (markets, agents)), jnp.float32),
+        jnp.asarray(rng.uniform(0, 1, (markets, agents)), jnp.float32),
+        jnp.asarray(rng.random((markets, agents)) < 0.9),
+    )
+
+    def best_of(fn):
+        out = fn(*args)
+        _fence(out.prediction)
+        best = float("inf")
+        for _ in range(reps):
+            start = time.perf_counter()
+            out = fn(*args)
+            _fence(out.prediction)
+            best = min(best, time.perf_counter() - start)
+        return markets / best
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("markets", "sources"))
+    # AOT-compile once and time the executable itself — .lower().compile()
+    # does not seed the jit call cache, so timing `ring` would recompile.
+    compiled = build_ring_tiebreak(mesh).lower(*args).compile()
+    mem = compiled.memory_analysis()
+    ring_rate = best_of(lambda *a: compiled(*a))
+    sorted_rate = best_of(build_batched_tiebreak())
+
+    return {
+        "workload": f"{markets} markets x {agents} agents",
+        "ring_markets_per_sec": round(ring_rate, 1),
+        "sorted_markets_per_sec": round(sorted_rate, 1),
+        "ring_compiled_temp_mb": round(mem.temp_size_in_bytes / 1e6, 1),
+        "ring_compiled_args_mb": round(mem.argument_size_in_bytes / 1e6, 1),
+    }
+
+
 def bench_e2e(markets=100_000, mean_slots=5, steps=20):
     """The whole pipeline, ingest and flush included (amortised per cycle).
 
@@ -350,6 +407,10 @@ def run():
         e2e = {"cycles_per_sec_amortised": round(e2e_cps, 1), **e2e_parts}
     except Exception as exc:  # noqa: BLE001
         e2e = f"failed: {type(exc).__name__}"
+    try:
+        tiebreak = bench_tiebreak_stress()
+    except Exception as exc:  # noqa: BLE001
+        tiebreak = f"failed: {type(exc).__name__}"
 
     slot_updates = {
         "headline_gslots_per_sec": round(
@@ -383,6 +444,7 @@ def run():
             },
             "pallas_1m16_cycles_per_sec": pallas,
             "e2e_pipeline": e2e,
+            "tiebreak_10k_agents": tiebreak,
             "per_slot_throughput": slot_updates,
             "notes": (
                 "headline and large-K both run at the chip's measured "

@@ -1,0 +1,51 @@
+"""Importing the package must not initialise the JAX backend.
+
+Multi-process bring-up requires ``jax.distributed.initialize()`` to run
+before ANY backend-touching call (jax.devices, device_put, or creating a
+jnp array at module import). A stray module-level ``jnp.something(...)``
+constant anywhere in the package breaks every cluster user — this is the
+regression test for exactly that (it happened: a module-level
+``jnp.int32`` sentinel in ops/tiebreak.py broke the two-process suite).
+
+Runs in a subprocess because the test session itself has long since
+initialised the CPU backend.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+_PROBE = """
+import sys
+sys.path.insert(0, {root!r})
+
+import bayesian_consensus_engine_tpu
+import bayesian_consensus_engine_tpu.core
+import bayesian_consensus_engine_tpu.models
+import bayesian_consensus_engine_tpu.ops
+import bayesian_consensus_engine_tpu.parallel
+import bayesian_consensus_engine_tpu.pipeline
+import bayesian_consensus_engine_tpu.state
+import bayesian_consensus_engine_tpu.utils
+
+from jax._src import xla_bridge
+
+assert not xla_bridge.backends_are_initialized(), (
+    "importing the package initialised a JAX backend — "
+    "jax.distributed.initialize() can no longer be called by users"
+)
+print("IMPORT_CLEAN")
+"""
+
+
+def test_package_import_leaves_backend_uninitialised():
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE.format(root=str(_ROOT))],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "IMPORT_CLEAN" in proc.stdout

@@ -1,0 +1,226 @@
+"""Sort-based batched tie-break (ops/tiebreak.py) vs the scalar contract.
+
+Same parity methodology as the ring suite (tests/test_ring.py): constructed
+hierarchy cases including the reference quirks, then randomized rows checked
+row-by-row against DeterministicTieBreaker, then a batch-level cross-check
+against the ring path — two independent groupings (sorted segments vs
+pairwise ring accumulation) that must agree.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.models.tiebreak import (
+    AgentSignal,
+    DeterministicTieBreaker,
+)
+from bayesian_consensus_engine_tpu.ops.tiebreak import batched_tiebreak
+from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+from bayesian_consensus_engine_tpu.parallel.ring import build_ring_tiebreak
+
+_LABELS = {0: "unanimous", 1: "weight_density", 2: "prediction_value_smallest"}
+
+
+def _rows_from_agents(rows, a_total):
+    """Pack lists of AgentSignal into padded (M, A) arrays."""
+    m = len(rows)
+    pred = np.zeros((m, a_total), np.float32)
+    weight = np.zeros((m, a_total), np.float32)
+    conf = np.zeros((m, a_total), np.float32)
+    rel = np.zeros((m, a_total), np.float32)
+    valid = np.zeros((m, a_total), bool)
+    for i, agents in enumerate(rows):
+        for j, agent in enumerate(agents):
+            pred[i, j] = agent.prediction
+            weight[i, j] = agent.weight
+            conf[i, j] = agent.confidence
+            rel[i, j] = agent.reliability_score
+            valid[i, j] = True
+    return tuple(jnp.asarray(x) for x in (pred, weight, conf, rel, valid))
+
+
+def _run_one(agents, a_total=16):
+    result = batched_tiebreak(*_rows_from_agents([agents], a_total))
+    return jax.tree.map(lambda x: np.asarray(x)[0], result)
+
+
+class TestHierarchy:
+    def test_density_winner(self):
+        agents = [
+            AgentSignal("a", 0.7, 0.9, weight=2.0, reliability_score=0.8),
+            AgentSignal("b", 0.7, 0.8, weight=2.0, reliability_score=0.6),
+            AgentSignal("c", 0.3, 0.7, weight=1.0, reliability_score=0.9),
+        ]
+        want_pred, want_diag = DeterministicTieBreaker().resolve(list(agents))
+        got = _run_one(agents)
+        assert got.prediction == pytest.approx(want_pred, abs=1e-6)
+        assert _LABELS[int(got.resolved_by)] == want_diag.tie_resolved_by
+        assert int(got.num_groups) == len(want_diag.groups)
+        assert got.confidence_variance == pytest.approx(
+            want_diag.confidence_variance, abs=1e-5
+        )
+
+    def test_reliability_breaks_density_tie_labeled_density(self):
+        # Quirk #6: the decision falls to max_reliability but the label
+        # stays weight_density (reference: tiebreak.py:126-131).
+        agents = [
+            AgentSignal("a", 0.6, 0.5, weight=1.0, reliability_score=0.9),
+            AgentSignal("b", 0.4, 0.5, weight=1.0, reliability_score=0.2),
+        ]
+        want_pred, want_diag = DeterministicTieBreaker().resolve(list(agents))
+        got = _run_one(agents)
+        assert got.prediction == pytest.approx(want_pred, abs=1e-6)
+        assert want_diag.tie_resolved_by == "weight_density"
+        assert _LABELS[int(got.resolved_by)] == "weight_density"
+
+    def test_full_tie_smallest_prediction(self):
+        agents = [
+            AgentSignal("a", 0.8, 0.5, weight=1.0, reliability_score=0.5),
+            AgentSignal("b", 0.2, 0.5, weight=1.0, reliability_score=0.5),
+        ]
+        got = _run_one(agents)
+        assert got.prediction == pytest.approx(0.2, abs=1e-6)
+        assert _LABELS[int(got.resolved_by)] == "prediction_value_smallest"
+
+    def test_unanimous(self):
+        agents = [
+            AgentSignal("a", 0.55, 0.5, weight=1.0, reliability_score=0.5),
+            AgentSignal("b", 0.55, 0.9, weight=3.0, reliability_score=0.7),
+        ]
+        got = _run_one(agents)
+        assert _LABELS[int(got.resolved_by)] == "unanimous"
+        assert int(got.num_groups) == 1
+
+    def test_empty_row_is_nan_padding(self):
+        pred, weight, conf, rel, valid = _rows_from_agents(
+            [[AgentSignal("a", 0.5, 0.5)], []], a_total=4
+        )
+        result = batched_tiebreak(pred, weight, conf, rel, valid)
+        assert np.asarray(result.prediction)[0] == pytest.approx(0.5)
+        assert np.isnan(np.asarray(result.prediction)[1])
+        assert int(np.asarray(result.num_groups)[1]) == 0
+        assert int(np.asarray(result.resolved_by)[1]) == 0
+        assert np.asarray(result.confidence_variance)[1] == 0.0
+
+    def test_duplicate_group_spread_across_lanes(self):
+        # Same key in non-adjacent lanes must still be one group after the
+        # sort (the dict-grouping semantics the reference has).
+        agents = [
+            AgentSignal("a", 0.3, 0.5, weight=1.0, reliability_score=0.1),
+            AgentSignal("b", 0.9, 0.5, weight=5.0, reliability_score=0.2),
+            AgentSignal("c", 0.3, 0.5, weight=3.0, reliability_score=0.9),
+        ]
+        want_pred, want_diag = DeterministicTieBreaker().resolve(list(agents))
+        got = _run_one(agents)
+        assert got.prediction == pytest.approx(want_pred, abs=1e-6)
+        assert int(got.num_groups) == 2
+        want_group = want_diag.groups[round(want_pred, 6)]
+        assert got.weight_density == pytest.approx(
+            want_group["weight_density"], abs=1e-4
+        )
+        assert got.max_reliability == pytest.approx(
+            want_group["max_reliability"], abs=1e-4
+        )
+
+
+class TestRandomizedParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_rows_match_scalar(self, seed):
+        rng = np.random.default_rng(seed)
+        m, a = 12, 24
+        grid = np.array([0.1, 0.25, 0.5, 0.75, 0.9])
+        rows = [
+            [
+                AgentSignal(
+                    f"a{i}-{j}",
+                    float(rng.choice(grid)),
+                    float(rng.uniform(0, 1)),
+                    weight=float(rng.uniform(0.1, 3.0)),
+                    reliability_score=float(rng.uniform(0, 1)),
+                )
+                for j in range(int(rng.integers(1, a)))
+            ]
+            for i in range(m)
+        ]
+        result = batched_tiebreak(*_rows_from_agents(rows, a))
+        breaker = DeterministicTieBreaker()
+        for i, agents in enumerate(rows):
+            want_pred, want_diag = breaker.resolve(list(agents))
+            assert np.asarray(result.prediction)[i] == pytest.approx(
+                want_pred, abs=1e-6
+            ), f"row {i}"
+            if len(agents) > 1:
+                assert (
+                    _LABELS[int(np.asarray(result.resolved_by)[i])]
+                    == want_diag.tie_resolved_by
+                ), f"row {i}"
+                assert int(np.asarray(result.num_groups)[i]) == len(
+                    want_diag.groups
+                ), f"row {i}"
+            assert np.asarray(result.confidence_variance)[i] == pytest.approx(
+                want_diag.confidence_variance, abs=1e-5
+            ), f"row {i}"
+
+
+class TestAgainstRingPath:
+    def test_batch_cross_check(self):
+        # Two independent groupings (sorted segments here, pairwise ring
+        # accumulation there) over the same batch must agree field-for-field.
+        rng = np.random.default_rng(99)
+        m, a = 16, 64
+        grid = np.array([0.1, 0.3, 0.5, 0.7, 0.9], dtype=np.float32)
+        pred = jnp.asarray(rng.choice(grid, (m, a)), jnp.float32)
+        weight = jnp.asarray(rng.uniform(0.1, 2.0, (m, a)), jnp.float32)
+        conf = jnp.asarray(rng.uniform(0, 1, (m, a)), jnp.float32)
+        rel = jnp.asarray(rng.uniform(0, 1, (m, a)), jnp.float32)
+        valid = jnp.asarray(rng.random((m, a)) < 0.9)
+
+        sorted_r = batched_tiebreak(pred, weight, conf, rel, valid)
+        ring_r = build_ring_tiebreak(make_mesh((2, 4)))(
+            pred, weight, conf, rel, valid
+        )
+        np.testing.assert_allclose(
+            np.asarray(sorted_r.prediction), np.asarray(ring_r.prediction),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sorted_r.weight_density),
+            np.asarray(ring_r.weight_density),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sorted_r.max_reliability),
+            np.asarray(ring_r.max_reliability),
+            rtol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sorted_r.resolved_by), np.asarray(ring_r.resolved_by)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sorted_r.num_groups), np.asarray(ring_r.num_groups)
+        )
+
+    def test_markets_sharded_input_propagates(self):
+        # Row-local ops: a markets-sharded input stays sharded, no gather.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = make_mesh((8, 1))
+        rng = np.random.default_rng(5)
+        m, a = 32, 16
+        sharding = NamedSharding(mesh, P("markets", None))
+        grid = np.array([0.2, 0.5, 0.8], dtype=np.float32)
+        args = (
+            jax.device_put(rng.choice(grid, (m, a)).astype(np.float32), sharding),
+            jax.device_put(rng.uniform(0.1, 2, (m, a)).astype(np.float32), sharding),
+            jax.device_put(rng.uniform(0, 1, (m, a)).astype(np.float32), sharding),
+            jax.device_put(rng.uniform(0, 1, (m, a)).astype(np.float32), sharding),
+            jax.device_put(rng.random((m, a)) < 0.9, sharding),
+        )
+        result = jax.jit(batched_tiebreak)(*args)
+        out_sharding = result.prediction.sharding
+        assert out_sharding.is_equivalent_to(
+            NamedSharding(mesh, P("markets")), ndim=1
+        )
