@@ -451,7 +451,7 @@ def build_ring_tiebreak(mesh: Mesh, precision: int = 6):
 
         # Runner-up: winner's group masked out, same hierarchy again.
         others = member & ~in_win
-        ru_p, ru_d, ru_r, _ = lex_winner(keys, density, mr, pred_r, others)
+        _, ru_d, ru_r, _ = lex_winner(keys, density, mr, pred_r, others)
         any_other = jax.lax.psum(
             jnp.sum(others, axis=-1), SOURCES_AXIS
         ) > 0

@@ -1,0 +1,175 @@
+"""Stdlib approximation of the CI lint gates for this offline environment.
+
+CI runs real ``ruff check .`` and ``mypy`` (see .github/workflows/ci.yml);
+neither tool is installed in the baked TPU image, so this script covers the
+highest-signal subset of the gated rules with ``ast`` only:
+
+  F401  module-level imports never referenced
+  F811  redefinition of an imported name by a later import
+  F841  local assigned and never used (simple ``x = ...`` targets only,
+        matching ruff: loop variables and unpacking are not flagged)
+  E711  ``== None`` / ``!= None`` comparisons
+  E712  ``== True`` / ``== False`` comparisons
+  E722  bare ``except:``
+
+``# noqa`` on the offending line suppresses, as with ruff.
+
+Usage: ``python scripts/devlint.py [paths...]`` (defaults to the package,
+tests, and repo-root scripts). Exits 1 on findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+DEFAULT_PATHS = [
+    "bayesian_consensus_engine_tpu",
+    "tests",
+    "scripts",
+    "examples",
+    "native",
+    "bench.py",
+    "__graft_entry__.py",
+]
+
+
+def _names_loaded(tree: ast.AST) -> set[str]:
+    loaded: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            loaded.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                loaded.add(root.id)
+    return loaded
+
+
+def check_file(path: pathlib.Path) -> list[str]:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: E999 syntax error: {exc.msg}"]
+    lines = src.splitlines()
+
+    def noqa(lineno: int) -> bool:
+        return 0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]
+
+    problems: list[str] = []
+    loaded = _names_loaded(tree)
+    exported = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__" for t in node.targets
+            )
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            exported |= {
+                c.value for c in node.value.elts if isinstance(c, ast.Constant)
+            }
+
+    # F401 / F811 over module-level imports.
+    seen_imports: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+                continue
+            for alias in node.names:
+                name = (alias.asname or alias.name).split(".")[0]
+                if alias.name == "*":
+                    continue
+                if name in seen_imports:
+                    problems.append(
+                        f"{path}:{node.lineno}: F811 redefinition of "
+                        f"{name!r} (first import line {seen_imports[name]})"
+                    )
+                seen_imports[name] = node.lineno
+                if (
+                    name not in loaded
+                    and name not in exported
+                    and (alias.name or "") not in exported
+                    and not (alias.asname is None and "." in alias.name)
+                ):
+                    problems.append(
+                        f"{path}:{node.lineno}: F401 {name!r} imported but unused"
+                    )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.Eq, ast.NotEq)) and isinstance(
+                    comp, ast.Constant
+                ):
+                    if comp.value is None:
+                        problems.append(
+                            f"{path}:{node.lineno}: E711 comparison to None "
+                            "(use `is`/`is not`)"
+                        )
+                    elif comp.value is True or comp.value is False:
+                        problems.append(
+                            f"{path}:{node.lineno}: E712 comparison to "
+                            f"{comp.value} (use `is` or truthiness)"
+                        )
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append(f"{path}:{node.lineno}: E722 bare except")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Own scope only: nested defs report themselves. A name used by
+            # a nested def still counts as used (closures), so collect uses
+            # from the full subtree but assignments from this scope alone.
+            assigned: dict[str, int] = {}
+            used: set[str] = set()
+            stack = list(ast.iter_child_nodes(node))
+            while stack:
+                inner = stack.pop()
+                if (
+                    isinstance(inner, ast.Assign)
+                    and len(inner.targets) == 1
+                    and isinstance(inner.targets[0], ast.Name)
+                ):
+                    assigned.setdefault(inner.targets[0].id, inner.lineno)
+                if not isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    stack.extend(ast.iter_child_nodes(inner))
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Name) and not isinstance(
+                    inner.ctx, ast.Store
+                ):
+                    used.add(inner.id)
+            for name, lineno in assigned.items():
+                if name not in used and not name.startswith("_"):
+                    problems.append(
+                        f"{path}:{lineno}: F841 local {name!r} assigned but "
+                        f"never used (in {node.name})"
+                    )
+    return [
+        msg for msg in problems if not noqa(int(msg.split(":", 2)[1] or 0))
+    ]
+
+
+def main(argv: list[str]) -> int:
+    root = pathlib.Path(__file__).resolve().parents[1]
+    targets = argv or DEFAULT_PATHS
+    files: list[pathlib.Path] = []
+    for t in targets:
+        p = root / t
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    problems: list[str] = []
+    for f in files:
+        problems.extend(dict.fromkeys(check_file(f)))  # dedupe nested-walk repeats
+    for line in problems:
+        print(line)
+    print(f"devlint: {len(files)} files, {len(problems)} findings")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

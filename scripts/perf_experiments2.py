@@ -95,7 +95,8 @@ def main():
         w = jnp.where(mask, read_rel, 0.0)
         total_weight = jnp.sum(w, axis=0)
         weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=0)
-        weighted_conf = jnp.sum(jnp.where(mask, conf, 0.0) * w, axis=0)
+        # (confidence output dropped from this probe: unused → XLA DCE'd it,
+        # so it was never part of the measured traffic anyway)
         has_weight = total_weight != 0
         safe_total = jnp.where(has_weight, total_weight, 1.0)
         consensus = jnp.where(has_weight, weighted_prob / safe_total, jnp.nan)
@@ -141,7 +142,7 @@ def main():
         w = jnp.where(mask, read_rel, 0.0)
         total_weight = jnp.sum(w, axis=0)
         weighted_prob = jnp.sum(probs_m * w, axis=0)
-        weighted_conf = jnp.sum(jnp.where(mask, conf, 0.0) * w, axis=0)
+        # (confidence output dropped: unused → DCE'd, never measured)
         has_weight = total_weight != 0
         safe_total = jnp.where(has_weight, total_weight, 1.0)
         consensus = jnp.where(has_weight, weighted_prob / safe_total, jnp.nan)

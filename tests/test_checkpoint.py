@@ -11,7 +11,6 @@ import pytest
 
 pytest.importorskip("orbax.checkpoint")
 
-import jax
 import jax.numpy as jnp
 
 from bayesian_consensus_engine_tpu.parallel import (

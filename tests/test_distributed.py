@@ -9,7 +9,6 @@ assembly path as through plain device_put.
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from bayesian_consensus_engine_tpu.parallel import (

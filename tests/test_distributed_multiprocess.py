@@ -22,11 +22,9 @@ import sys
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from bayesian_consensus_engine_tpu.parallel import (
-    MarketBlockState,
     build_cycle,
     init_block_state,
     make_mesh,

@@ -3,11 +3,9 @@
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from bayesian_consensus_engine_tpu.ops.pallas_cycle import (
-    SlotMajorState,
     build_pallas_cycle,
     to_slot_major,
 )
@@ -79,7 +77,7 @@ class TestFusedKernelEquivalence:
         )
 
     def test_composes_over_steps(self):
-        probs, mask, outcome, state, now = _inputs(7)
+        probs, mask, outcome, state, _now = _inputs(7)
         pallas_cycle = build_pallas_cycle(M, K, tile_markets=TILE, interpret=True)
         xla_cycle = build_cycle(mesh=None, donate=False)
 

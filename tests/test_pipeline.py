@@ -14,7 +14,6 @@ import dataclasses
 import math
 import random
 
-import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")

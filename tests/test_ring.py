@@ -27,7 +27,6 @@ from bayesian_consensus_engine_tpu.parallel import (
 from bayesian_consensus_engine_tpu.parallel.mesh import (
     MARKETS_AXIS,
     SOURCES_AXIS,
-    block_sharding,
 )
 from bayesian_consensus_engine_tpu.parallel.ring import (
     REDUCE_SPEC,
