@@ -152,24 +152,32 @@ def _cycle_math(
     128-wide lane dimension, which measures ~25% faster on TPU than (M, K)
     with small K (the reduction becomes a K-deep sublane sum).
     """
-    read_rel, read_conf = read_phase(state, now_days)
+    # named_scope: phase labels land in the HLO → profiler attribution
+    # (utils/profiling.trace / auto_trace show per-phase time, not one
+    # opaque fused blob). Zero runtime cost — names only.
+    with jax.named_scope("bce.read_decay"):
+        read_rel, read_conf = read_phase(state, now_days)
 
     # Weighted sums along the (possibly sharded) sources axis.
-    w = jnp.where(mask, read_rel, 0.0)
-    total_weight = jnp.sum(w, axis=slots_axis)
-    weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=slots_axis)
-    weighted_conf = jnp.sum(jnp.where(mask, read_conf, 0.0) * w, axis=slots_axis)
-    if axis_name is not None:
-        total_weight = jax.lax.psum(total_weight, axis_name)
-        weighted_prob = jax.lax.psum(weighted_prob, axis_name)
-        weighted_conf = jax.lax.psum(weighted_conf, axis_name)
+    with jax.named_scope("bce.consensus_reduce"):
+        w = jnp.where(mask, read_rel, 0.0)
+        total_weight = jnp.sum(w, axis=slots_axis)
+        weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=slots_axis)
+        weighted_conf = jnp.sum(
+            jnp.where(mask, read_conf, 0.0) * w, axis=slots_axis
+        )
+        if axis_name is not None:
+            total_weight = jax.lax.psum(total_weight, axis_name)
+            weighted_prob = jax.lax.psum(weighted_prob, axis_name)
+            weighted_conf = jax.lax.psum(weighted_conf, axis_name)
 
-    consensus, confidence_out = consensus_epilogue(
-        total_weight, weighted_prob, weighted_conf
-    )
-    new_state = update_phase(
-        probs, mask, outcome, state, read_conf, now_days, slots_axis
-    )
+        consensus, confidence_out = consensus_epilogue(
+            total_weight, weighted_prob, weighted_conf
+        )
+    with jax.named_scope("bce.outcome_update"):
+        new_state = update_phase(
+            probs, mask, outcome, state, read_conf, now_days, slots_axis
+        )
     return CycleResult(new_state, consensus, confidence_out, total_weight)
 
 

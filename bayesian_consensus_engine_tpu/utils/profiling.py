@@ -48,3 +48,35 @@ def annotate(label: str):
         return wrapper
 
     return deco
+
+
+def auto_trace(fn, log_dir: str, every_n: int = 100, label: str = "settlement"):
+    """Capture every *every_n*-th call of *fn* as an XLA profile.
+
+    Production-loop integration: wrap the compiled cycle/loop callable once
+    and run as normal — the wrapper counts invocations and snapshots the
+    Nth into *log_dir* (TensorBoard/Perfetto-readable), blocking on the
+    result inside the capture window so device execution lands in the
+    trace. The cycle phases show up under the ``bce.*`` named scopes
+    (parallel/sharded.py). All other calls pass through untouched.
+
+        loop = auto_trace(build_cycle_loop(mesh), "/tmp/bce-trace", 500)
+        for batch in feed:
+            state, consensus = loop(*batch, state, now, steps)
+    """
+    import functools
+    import itertools
+
+    counter = itertools.count(1)
+
+    def wrapper(*args, **kwargs):
+        import jax
+
+        if next(counter) % every_n == 0:
+            with trace(label, log_dir):
+                result = fn(*args, **kwargs)
+                jax.block_until_ready(result)
+                return result
+        return fn(*args, **kwargs)
+
+    return functools.update_wrapper(wrapper, fn, updated=())
