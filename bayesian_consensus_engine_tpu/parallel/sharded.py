@@ -88,6 +88,34 @@ def read_phase(
     return read_rel, read_conf
 
 
+def consensus_reduce(
+    probs: jax.Array,
+    mask: jax.Array,
+    read_rel: jax.Array,
+    read_conf: jax.Array,
+    axis_name: str | None,
+    slots_axis: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Masked weighted sums over the (possibly sharded) sources axis.
+
+    THE consensus reduction — shared by the slow, fast, and compact cycle
+    paths so the reduction semantics (masking, psum axis, epilogue) exist
+    exactly once. Returns (consensus, confidence_out, total_weight).
+    """
+    w = jnp.where(mask, read_rel, 0.0)
+    total_weight = jnp.sum(w, axis=slots_axis)
+    weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=slots_axis)
+    weighted_conf = jnp.sum(jnp.where(mask, read_conf, 0.0) * w, axis=slots_axis)
+    if axis_name is not None:
+        total_weight = jax.lax.psum(total_weight, axis_name)
+        weighted_prob = jax.lax.psum(weighted_prob, axis_name)
+        weighted_conf = jax.lax.psum(weighted_conf, axis_name)
+    consensus, confidence_out = consensus_epilogue(
+        total_weight, weighted_prob, weighted_conf
+    )
+    return consensus, confidence_out, total_weight
+
+
 def consensus_epilogue(
     total_weight: jax.Array,
     weighted_prob: jax.Array,
@@ -158,27 +186,72 @@ def _cycle_math(
     with jax.named_scope("bce.read_decay"):
         read_rel, read_conf = read_phase(state, now_days)
 
-    # Weighted sums along the (possibly sharded) sources axis.
     with jax.named_scope("bce.consensus_reduce"):
-        w = jnp.where(mask, read_rel, 0.0)
-        total_weight = jnp.sum(w, axis=slots_axis)
-        weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=slots_axis)
-        weighted_conf = jnp.sum(
-            jnp.where(mask, read_conf, 0.0) * w, axis=slots_axis
-        )
-        if axis_name is not None:
-            total_weight = jax.lax.psum(total_weight, axis_name)
-            weighted_prob = jax.lax.psum(weighted_prob, axis_name)
-            weighted_conf = jax.lax.psum(weighted_conf, axis_name)
-
-        consensus, confidence_out = consensus_epilogue(
-            total_weight, weighted_prob, weighted_conf
+        consensus, confidence_out, total_weight = consensus_reduce(
+            probs, mask, read_rel, read_conf, axis_name, slots_axis
         )
     with jax.named_scope("bce.outcome_update"):
         new_state = update_phase(
             probs, mask, outcome, state, read_conf, now_days, slots_axis
         )
     return CycleResult(new_state, consensus, confidence_out, total_weight)
+
+
+def _fast_cycle_math(
+    probs: jax.Array,
+    mask: jax.Array,
+    outcome: jax.Array,
+    reliability: jax.Array,
+    confidence: jax.Array,
+    now_days: jax.Array,     # scalar: this step's day
+    prev_now: jax.Array,     # scalar: the previous step's day
+    axis_name: str | None,
+    slots_axis: int = -1,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One mid-loop cycle with the decay read driven by SCALAR time.
+
+    Valid only inside the N-step loop after step 0: every masked slot was
+    stamped ``prev_now`` by the previous step, so its elapsed time and
+    decay eligibility are the same scalars for the whole block — the
+    per-slot ``updated_days`` tensor (a full HBM read+write per cycle,
+    ~8 of the flat loop's ~29 bytes/slot/step at 1M×16) drops out of the
+    loop carry entirely and is reconstructed once on exit. Unmasked slots
+    see a wrong scalar elapsed, but their weights are zeroed before every
+    reduction and their state passes through untouched, exactly as in
+    :func:`_cycle_math`.
+
+    Bit-compatibility with chained single cycles: elapsed and eligibility
+    are computed with the same f32 arithmetic on the same values the
+    chained path reads back from the stamped tensor
+    (``(now0+i) − (now0+i−1)``, gate ``prev_now > 0``), and the decay/
+    update elementwise ops are shared (ops/decay.py, ops/update.py), so
+    results are equal bit-for-bit (asserted by tests/test_sharding.py).
+
+    Returns ``(reliability', confidence', consensus)``.
+    """
+    with jax.named_scope("bce.read_decay"):
+        # Broadcast the scalar stamp through the SAME ops the per-slot path
+        # runs (decayed_reliability_at on a full-shape tensor): XLA then
+        # makes identical fusion/FMA-contraction choices and the read is
+        # bit-identical to the slow path — a scalar-elapsed shortcut
+        # compiles to different roundings (caught by the checkpoint-resume
+        # bit-identity tests). The broadcast costs no HBM traffic.
+        stamps = jnp.broadcast_to(prev_now, reliability.shape)
+        read_rel = decayed_reliability_at(
+            reliability, stamps, now_days, jnp.asarray(True)
+        )
+
+    with jax.named_scope("bce.consensus_reduce"):
+        consensus, _, _ = consensus_reduce(
+            probs, mask, read_rel, confidence, axis_name, slots_axis
+        )
+
+    with jax.named_scope("bce.outcome_update"):
+        correct = (probs >= 0.5) == jnp.expand_dims(outcome, slots_axis)
+        new_rel, new_conf = outcome_update(reliability, confidence, correct)
+        reliability = jnp.where(mask, new_rel, reliability)
+        confidence = jnp.where(mask, new_conf, confidence)
+    return reliability, confidence, consensus
 
 
 def _specs(slot_major: bool):
@@ -232,7 +305,35 @@ def build_cycle(
     return cycle
 
 
-def make_loop_math(cycle_fn, steps: int, cast_consensus=None):
+def run_fast_loop(state_carry, consensus0, fast_step, steps: int, now0):
+    """The fast N-step scaffold: fori over middle steps, LAST step outside.
+
+    ``fast_step(state_carry, now_i, prev_now) -> (state_carry, consensus)``.
+    Shared by the f32 and compact loops so the two structural invariants
+    live exactly once:
+
+      * mid-loop consensus is unobservable and NOT carried — the fori body
+        discards it, so XLA dead-code-eliminates the whole consensus
+        reduction from the loop;
+      * the last step runs OUTSIDE the fori, keeping the final consensus
+        in straight-line code for every step count: a single-trip fori
+        gets inlined and re-fused by XLA, which contracts FMAs differently
+        and wobbles consensus one ulp between programs of different step
+        counts — breaking checkpoint-resume bit-identity
+        (tests/test_checkpoint.py).
+    """
+    if steps == 1:
+        return state_carry, consensus0
+
+    def body(i, carry):
+        new_carry, _ = fast_step(carry, now0 + i, now0 + (i - 1))
+        return new_carry
+
+    carry = jax.lax.fori_loop(1, steps - 1, body, state_carry)
+    return fast_step(carry, now0 + (steps - 1), now0 + (steps - 2))
+
+
+def make_loop_math(cycle_fn, steps: int, cast_consensus=None, fast_cycle_fn=None):
     """The N-cycle loop scaffold shared by the flat and ring loops.
 
     Returns ``loop_math(probs, mask, outcome, state, now0) ->
@@ -250,6 +351,14 @@ def make_loop_math(cycle_fn, steps: int, cast_consensus=None):
     entry, and slots that never existed and never signalled are restored
     bit-identical on exit — exactly as a chain of single cycles leaves them.
     An ``exists=None`` input already promises defaulted cold slots.
+
+    ``fast_cycle_fn`` (optional,
+    ``(probs, mask, outcome, rel, conf, now, prev_now) -> (rel', conf',
+    consensus)``) additionally drops ``updated_days`` from the carry: step 0
+    runs ``cycle_fn`` against the real per-slot stamps, every later step
+    decays by scalar time (see :func:`_fast_cycle_math`), and the stamp
+    tensor is reconstructed once on exit — bit-identical to the chained
+    result, one less HBM tensor of read+write per cycle.
     """
 
     def loop_math(probs, mask, outcome, state, now0):
@@ -267,32 +376,63 @@ def make_loop_math(cycle_fn, steps: int, cast_consensus=None):
                 exists=None,
             )
 
-        def body(i, carry):
-            rel, conf, upd, _ = carry
-            result = cycle_fn(
-                probs, mask, outcome,
-                MarketBlockState(rel, conf, upd, None),
-                now0 + i,
-            )
-            st = result.state
-            return st.reliability, st.confidence, st.updated_days, result.consensus
-
         init_consensus = jnp.zeros(outcome.shape[0], probs.dtype)
         if cast_consensus is not None:
             init_consensus = cast_consensus(init_consensus)
-        rel, conf, upd, consensus = jax.lax.fori_loop(
-            0,
-            steps,
-            body,
-            (
-                sanitised.reliability,
-                sanitised.confidence,
-                sanitised.updated_days,
-                init_consensus,
-            ),
-        )
+
         if steps == 0:
             return state, init_consensus
+
+        if fast_cycle_fn is not None:
+            first = cycle_fn(probs, mask, outcome, sanitised, now0 + 0)
+
+            def fast_step(carry, now_i, prev_now):
+                rel, conf, consensus = fast_cycle_fn(
+                    probs, mask, outcome, carry[0], carry[1], now_i, prev_now
+                )
+                return (rel, conf), consensus
+
+            (rel, conf), consensus = run_fast_loop(
+                (first.state.reliability, first.state.confidence),
+                first.consensus,
+                fast_step,
+                steps,
+                now0,
+            )
+            # Chained cycles stamp masked slots with now0+i every step; the
+            # final tensor is the last stamp, reconstructed in one pass.
+            upd = jnp.where(
+                mask,
+                jnp.asarray(now0 + (steps - 1), sanitised.updated_days.dtype),
+                sanitised.updated_days,
+            )
+        else:
+            def body(i, carry):
+                rel, conf, upd, _ = carry
+                result = cycle_fn(
+                    probs, mask, outcome,
+                    MarketBlockState(rel, conf, upd, None),
+                    now0 + i,
+                )
+                st = result.state
+                return (
+                    st.reliability,
+                    st.confidence,
+                    st.updated_days,
+                    result.consensus,
+                )
+
+            rel, conf, upd, consensus = jax.lax.fori_loop(
+                0,
+                steps,
+                body,
+                (
+                    sanitised.reliability,
+                    sanitised.confidence,
+                    sanitised.updated_days,
+                    init_consensus,
+                ),
+            )
         if state.exists is None:
             return MarketBlockState(rel, conf, upd, None), consensus
         keep = state.exists | mask
@@ -332,6 +472,11 @@ def build_cycle_loop(
             axis_name=SOURCES_AXIS if mesh is not None else None,
             slots_axis=slots_axis,
         )
+        fast_fn = partial(
+            _fast_cycle_math,
+            axis_name=SOURCES_AXIS if mesh is not None else None,
+            slots_axis=slots_axis,
+        )
         # Under shard_map the consensus carry must match the loop output's
         # varying-axis type: consensus varies over the markets mesh axis.
         cast = (
@@ -339,7 +484,9 @@ def build_cycle_loop(
             if mesh is None
             else lambda x: jax.lax.pcast(x, (MARKETS_AXIS,), to="varying")
         )
-        loop_math = make_loop_math(cycle_fn, steps, cast_consensus=cast)
+        loop_math = make_loop_math(
+            cycle_fn, steps, cast_consensus=cast, fast_cycle_fn=fast_fn
+        )
 
         if mesh is None:
             fn = loop_math
