@@ -27,6 +27,12 @@ from bayesian_consensus_engine_tpu.parallel.ring import (
     reshard,
     ring_allreduce,
 )
+from bayesian_consensus_engine_tpu.parallel.compact import (
+    CompactBlockState,
+    build_compact_cycle_loop,
+    compact_to_block,
+    init_compact_state,
+)
 from bayesian_consensus_engine_tpu.parallel.sharded import (
     CycleResult,
     MarketBlockState,
@@ -50,6 +56,10 @@ __all__ = [
     "build_cycle_loop",
     "init_block_state",
     "pad_markets",
+    "CompactBlockState",
+    "build_compact_cycle_loop",
+    "compact_to_block",
+    "init_compact_state",
     "global_block",
     "global_market",
     "init_distributed",

@@ -1,0 +1,257 @@
+"""Counter-compact settlement state — int8 counters instead of f32 tensors.
+
+The reference's update math makes the stored state far more compressible
+than three f32 tensors (reference: reliability.py:163-175):
+
+  * the capped reliability delta is ALWAYS exactly ±0.10
+    (``clip(0.15·±1, ±0.10)``), and decay never touches the stored value
+    (read-only transform, reference quirk #9) — so an undecayed stored
+    reliability that started at the 0.50 cold-start prior lives on the
+    11-point lattice ``0.5 + 0.1·c`` with ``c`` a ±5-saturating counter;
+  * confidence growth ``c' = c + (1−c)·0.10`` is data-independent — the
+    stored confidence is a pure function of the UPDATE COUNT
+    (``1 − 0.75·0.9ⁿ`` from the 0.25 prior), saturating in u8 range.
+
+So the loop state compresses to one int8 + one uint8 per slot (plus the
+f32 day stamps, which the fast loop already reads once and reconstructs —
+parallel/sharded.py). Per step the carried traffic drops from ~21 to
+~9 bytes/slot; on a bandwidth-bound cycle that is the whole game
+(same-process A/B on v5e: see bench.py extras).
+
+Numeric contract: decode computes ``0.5 + 0.1·c`` and ``1 − 0.75·2^(n·log₂0.9)``
+in f32 — equal to the f32 sequential-add path within a few ulp (the f32
+path itself drifts ulp-level from the f64 scalar contract; both are
+tolerance-equivalent, tests/test_compact.py pins the bound). The scalar
+engine remains the bit-exact parity surface; this state is for the
+at-scale settlement loop, where cold-start ⇒ zero counters by
+construction.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from bayesian_consensus_engine_tpu.ops.decay import decayed_reliability_at
+from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS
+from bayesian_consensus_engine_tpu.parallel.sharded import (
+    MarketBlockState,
+    consensus_reduce,
+    run_fast_loop,
+)
+from bayesian_consensus_engine_tpu.utils.config import (
+    BASE_LEARNING_RATE,
+    CONFIDENCE_GROWTH_RATE,
+    DEFAULT_CONFIDENCE,
+    DEFAULT_RELIABILITY,
+    MAX_UPDATE_STEP,
+)
+
+# The counter encoding is only valid while the configured update math
+# keeps every applied delta exactly ±MAX_UPDATE_STEP and the priors on the
+# step lattice; derive the lattice from config and assert the premises so
+# a tunable change fails HERE, not as a distant equivalence-test diff.
+_STEP = MAX_UPDATE_STEP
+assert BASE_LEARNING_RATE >= MAX_UPDATE_STEP, (
+    "compact counters assume the learning-rate cap always saturates: "
+    "delta must be exactly ±MAX_UPDATE_STEP"
+)
+_STEPS_UP = round((1.0 - DEFAULT_RELIABILITY) / _STEP)      # counter → 1.0
+_STEPS_DOWN = round(DEFAULT_RELIABILITY / _STEP)            # counter → 0.0
+assert math.isclose(DEFAULT_RELIABILITY + _STEPS_UP * _STEP, 1.0), (
+    "DEFAULT_RELIABILITY must sit on the MAX_UPDATE_STEP lattice"
+)
+assert math.isclose(DEFAULT_RELIABILITY - _STEPS_DOWN * _STEP, 0.0, abs_tol=1e-12)
+# Confidence saturates to f32 1.0 long before the u8 cap (~175 updates).
+_CONF_STEPS_MAX = 255
+_CONF_COEFF = 1.0 - DEFAULT_CONFIDENCE
+_LOG2_CONF_BASE = math.log2(1.0 - CONFIDENCE_GROWTH_RATE)
+
+
+class CompactBlockState(NamedTuple):
+    """Per-(slot, market) settlement state as saturating counters.
+
+    Zero counters ARE the cold-start priors (0.50 / 0.25), so
+    ``init_compact_state`` is just zeros and "exists" is ``conf_steps > 0``
+    — no separate mask tensor.
+    """
+
+    rel_steps: jax.Array     # i8[...] net (correct − incorrect), clamped ±5
+    conf_steps: jax.Array    # u8[...] total updates, saturating at 255
+    updated_days: jax.Array  # f32[...] day of last update (0 ⇒ never)
+
+
+def init_compact_state(
+    num_markets: int, slots: int, slot_major: bool = True
+) -> CompactBlockState:
+    shape = (slots, num_markets) if slot_major else (num_markets, slots)
+    return CompactBlockState(
+        rel_steps=jnp.zeros(shape, jnp.int8),
+        conf_steps=jnp.zeros(shape, jnp.uint8),
+        updated_days=jnp.zeros(shape, jnp.float32),
+    )
+
+
+def decode_reliability(rel_steps: jax.Array) -> jax.Array:
+    """Counter → stored (undecayed) f32 reliability on the update lattice."""
+    return jnp.clip(
+        DEFAULT_RELIABILITY + _STEP * rel_steps.astype(jnp.float32), 0.0, 1.0
+    )
+
+
+def decode_confidence(conf_steps: jax.Array) -> jax.Array:
+    """Update count → stored f32 confidence:
+    ``1 − (1−prior)·(1−growth)ⁿ`` (the closed form of the capped
+    recurrence ``c' = c + (1−c)·growth``)."""
+    n = conf_steps.astype(jnp.float32)
+    return 1.0 - _CONF_COEFF * jnp.exp2(n * _LOG2_CONF_BASE)
+
+
+def compact_to_block(state: CompactBlockState) -> MarketBlockState:
+    """Decode to the f32 block state (interop: checkpoint, absorb, tests)."""
+    exists = state.conf_steps > 0
+    return MarketBlockState(
+        reliability=decode_reliability(state.rel_steps),
+        confidence=jnp.where(
+            exists, decode_confidence(state.conf_steps), DEFAULT_CONFIDENCE
+        ),
+        updated_days=state.updated_days,
+        exists=exists,
+    )
+
+
+def _counter_update(rel_steps, conf_steps, correct, mask):
+    """Masked saturating counter bump — the whole outcome update."""
+    bump = jnp.where(correct, jnp.int8(1), jnp.int8(-1))
+    new_rel = jnp.clip(
+        rel_steps + bump, -_STEPS_DOWN, _STEPS_UP
+    ).astype(jnp.int8)
+    new_conf = jnp.where(
+        conf_steps < _CONF_STEPS_MAX, conf_steps + jnp.uint8(1), conf_steps
+    )
+    return (
+        jnp.where(mask, new_rel, rel_steps),
+        jnp.where(mask, new_conf, conf_steps),
+    )
+
+
+def _compact_cycle_math(
+    probs, mask, outcome, rel_steps, conf_steps, read_rel,
+    axis_name, slots_axis,
+):
+    """Consensus from pre-decayed reads + counter update; shared by both
+    the step-0 and fast-step paths (they differ only in how ``read_rel``
+    is produced)."""
+    with jax.named_scope("bce.consensus_reduce"):
+        consensus, _, _ = consensus_reduce(
+            probs, mask, read_rel, decode_confidence(conf_steps),
+            axis_name, slots_axis,
+        )
+    with jax.named_scope("bce.outcome_update"):
+        correct = (probs >= 0.5) == jnp.expand_dims(outcome, slots_axis)
+        rel_steps, conf_steps = _counter_update(
+            rel_steps, conf_steps, correct, mask
+        )
+    return rel_steps, conf_steps, consensus
+
+
+def _compact_loop_math(probs, mask, outcome, state, now0, steps, axis_name,
+                       slots_axis):
+    init_consensus = jnp.zeros(outcome.shape[0], probs.dtype)
+    if axis_name is not None:
+        init_consensus = jax.lax.pcast(
+            init_consensus, (MARKETS_AXIS,), to="varying"
+        )
+    if steps == 0:
+        return state, init_consensus
+
+    # Step 0: decay against the real per-slot stamps (one amortised read).
+    with jax.named_scope("bce.read_decay"):
+        read_rel0 = decayed_reliability_at(
+            decode_reliability(state.rel_steps),
+            state.updated_days,
+            now0 + 0,
+            state.conf_steps > 0,
+        )
+    rel_steps, conf_steps, consensus0 = _compact_cycle_math(
+        probs, mask, outcome, state.rel_steps, state.conf_steps, read_rel0,
+        axis_name, slots_axis,
+    )
+
+    def fast_step(carry, now_i, prev_now):
+        rs, cs = carry
+        with jax.named_scope("bce.read_decay"):
+            # Every masked slot was stamped prev_now by the previous step;
+            # broadcast the scalar through the same ops as the per-slot
+            # path (see parallel/sharded.py::_fast_cycle_math on why).
+            read_rel = decayed_reliability_at(
+                decode_reliability(rs),
+                jnp.broadcast_to(prev_now, rs.shape),
+                now_i,
+                jnp.asarray(True),
+            )
+        rs, cs, consensus = _compact_cycle_math(
+            probs, mask, outcome, rs, cs, read_rel, axis_name, slots_axis
+        )
+        return (rs, cs), consensus
+
+    (rel_steps, conf_steps), consensus = run_fast_loop(
+        (rel_steps, conf_steps), consensus0, fast_step, steps, now0
+    )
+    upd = jnp.where(
+        mask,
+        jnp.asarray(now0 + (steps - 1), state.updated_days.dtype),
+        state.updated_days,
+    )
+    return CompactBlockState(rel_steps, conf_steps, upd), consensus
+
+
+def build_compact_cycle_loop(
+    mesh: Mesh | None = None,
+    slot_major: bool = True,
+    donate: bool = True,
+):
+    """Compile the N-cycle settlement loop over counter-compact state.
+
+    ``loop(probs, mask, outcome, state, now0, steps) ->
+    (CompactBlockState, consensus)`` — same contract as
+    ``build_cycle_loop`` with the state type swapped; ~9 carried
+    bytes/slot/step instead of ~21. ``steps`` is static per compile.
+    """
+    if slot_major:
+        block, market, slots_axis = P(SOURCES_AXIS, MARKETS_AXIS), P(MARKETS_AXIS), 0
+    else:
+        block, market, slots_axis = P(MARKETS_AXIS, SOURCES_AXIS), P(MARKETS_AXIS), -1
+    axis_name = SOURCES_AXIS if mesh is not None else None
+    compiled: dict[int, object] = {}
+
+    def compile_for(steps: int):
+        fn = partial(
+            _compact_loop_math,
+            steps=steps,
+            axis_name=axis_name,
+            slots_axis=slots_axis,
+        )
+        if mesh is not None:
+            state_spec = CompactBlockState(block, block, block)
+            fn = shard_map(
+                fn,
+                mesh=mesh,
+                in_specs=(block, block, market, state_spec, P()),
+                out_specs=(state_spec, market),
+            )
+        return jax.jit(fn, donate_argnums=(3,) if donate else ())
+
+    def loop(probs, mask, outcome, state, now0, steps: int):
+        fn = compiled.get(steps)
+        if fn is None:
+            fn = compiled[steps] = compile_for(steps)
+        return fn(probs, mask, outcome, state, now0)
+
+    return loop

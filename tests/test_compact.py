@@ -1,0 +1,201 @@
+"""Counter-compact state (parallel/compact.py) vs the f32 loop.
+
+The compact loop must be tolerance-equivalent to build_cycle_loop — the
+f32 path itself drifts ulp-level from the f64 scalar contract, and the
+counter decode replaces sequential f32 adds with closed forms, so the
+bound here is a few f32 ulp (1e-6 relative), pinned by these tests over
+random workloads, saturation drives, and the sharded mesh path.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.parallel import (
+    MarketBlockState,
+    build_compact_cycle_loop,
+    build_cycle_loop,
+    compact_to_block,
+    init_block_state,
+    init_compact_state,
+    make_mesh,
+)
+from bayesian_consensus_engine_tpu.parallel.compact import (
+    decode_confidence,
+    decode_reliability,
+)
+
+M, K = 96, 8
+
+
+def _workload(seed, m=M, k=K, occupancy=0.9):
+    rng = np.random.default_rng(seed)
+    probs = jnp.asarray(rng.random((k, m)), jnp.float32)
+    mask = jnp.asarray(rng.random((k, m)) < occupancy)
+    outcome = jnp.asarray(rng.random(m) < 0.5)
+    return probs, mask, outcome
+
+
+def _f32_state(m=M, k=K):
+    return MarketBlockState(*(x.T for x in init_block_state(m, k)))
+
+
+class TestDecode:
+    def test_zero_counters_are_cold_start(self):
+        state = init_compact_state(4, 2)
+        assert np.all(np.asarray(decode_reliability(state.rel_steps)) == 0.5)
+        assert np.all(np.asarray(decode_confidence(state.conf_steps)) == 0.25)
+
+    def test_reliability_lattice(self):
+        steps = jnp.arange(-5, 6, dtype=jnp.int8)
+        vals = np.asarray(decode_reliability(steps))
+        np.testing.assert_allclose(vals, np.arange(0.0, 1.01, 0.1), atol=1e-7)
+
+    def test_confidence_matches_sequential_growth(self):
+        # Closed form vs the scalar recurrence c' = c + (1-c)*0.1.
+        c = 0.25
+        for n in range(1, 60):
+            c = min(1.0, c + (1.0 - c) * 0.1)
+            got = float(decode_confidence(jnp.uint8(n)))
+            assert got == pytest.approx(c, abs=2e-6), n
+
+
+class TestLoopEquivalence:
+    @pytest.mark.parametrize("steps", [1, 2, 7])
+    def test_matches_f32_loop(self, steps):
+        probs, mask, outcome = _workload(steps)
+        f32_loop = build_cycle_loop(mesh=None, slot_major=True, donate=False)
+        want_state, want_consensus = f32_loop(
+            probs, mask, outcome, _f32_state(), jnp.float32(1.0), steps
+        )
+        compact_loop = build_compact_cycle_loop(mesh=None, donate=False)
+        got_state, got_consensus = compact_loop(
+            probs, mask, outcome, init_compact_state(M, K), jnp.float32(1.0), steps
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_consensus), np.asarray(want_consensus),
+            rtol=1e-6, atol=1e-6,
+        )
+        decoded = compact_to_block(got_state)
+        np.testing.assert_allclose(
+            np.asarray(decoded.reliability), np.asarray(want_state.reliability),
+            rtol=1e-6, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(decoded.confidence), np.asarray(want_state.confidence),
+            rtol=1e-6, atol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(decoded.updated_days), np.asarray(want_state.updated_days)
+        )
+
+    def test_saturation_drive(self):
+        # All-correct signals for 12 steps: reliability clamps at 1.0 and
+        # stays there, exactly as the f32 clip does.
+        k, m = 4, 8
+        probs = jnp.full((k, m), 0.9, jnp.float32)
+        mask = jnp.ones((k, m), bool)
+        outcome = jnp.ones((m,), bool)
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        state, _ = loop(
+            probs, mask, outcome, init_compact_state(m, k), jnp.float32(1.0), 12
+        )
+        assert np.all(np.asarray(state.rel_steps) == 5)
+        np.testing.assert_allclose(
+            np.asarray(decode_reliability(state.rel_steps)), 1.0
+        )
+        # and back down: 3 wrong steps from saturation → 0.7
+        state2, _ = loop(
+            probs, mask, ~outcome, state, jnp.float32(20.0), 3
+        )
+        np.testing.assert_allclose(
+            np.asarray(decode_reliability(state2.rel_steps)), 0.7, atol=1e-7
+        )
+
+    def test_unmasked_slots_pass_through_exactly(self):
+        probs, _, outcome = _workload(3)
+        mask = jnp.zeros((K, M), bool).at[: K // 2].set(True)
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        state, _ = loop(
+            probs, mask, outcome, init_compact_state(M, K), jnp.float32(5.0), 4
+        )
+        untouched = np.asarray(state.rel_steps)[K // 2 :]
+        assert np.all(untouched == 0)
+        assert np.all(np.asarray(state.conf_steps)[K // 2 :] == 0)
+        assert np.all(np.asarray(state.updated_days)[K // 2 :] == 0.0)
+
+    def test_warm_state_decays_on_step_zero(self):
+        # A warm compact state entering a later loop must decay from its
+        # per-slot stamps on step 0 (the amortised tensor read).
+        probs, mask, outcome = _workload(9)
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        warm, _ = loop(
+            probs, mask, outcome, init_compact_state(M, K), jnp.float32(1.0), 2
+        )
+        f32_loop = build_cycle_loop(mesh=None, slot_major=True, donate=False)
+        warm_f32, _ = f32_loop(
+            probs, mask, outcome, _f32_state(), jnp.float32(1.0), 2
+        )
+        # 90 days later: reads are decayed identically in both paths.
+        got_state, got_cons = loop(
+            probs, mask, outcome, warm, jnp.float32(92.0), 1
+        )
+        want_state, want_cons = f32_loop(
+            probs, mask, outcome, warm_f32, jnp.float32(92.0), 1
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_cons), np.asarray(want_cons), rtol=1e-6, atol=1e-6
+        )
+
+    def test_zero_steps_identity(self):
+        probs, mask, outcome = _workload(4)
+        state = init_compact_state(M, K)
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        got_state, consensus = loop(
+            probs, mask, outcome, state, jnp.float32(1.0), 0
+        )
+        for got, want in zip(got_state, state):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert not np.any(np.asarray(consensus))
+
+
+class TestSharded:
+    @pytest.mark.parametrize("shape", [(8, 1), (2, 4)])
+    def test_mesh_parity(self, shape):
+        from bayesian_consensus_engine_tpu.parallel.mesh import (
+            MARKETS_AXIS,
+            SOURCES_AXIS,
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        import jax
+
+        mesh = make_mesh(shape)
+        probs, mask, outcome = _workload(11)
+        block = NamedSharding(mesh, P(SOURCES_AXIS, MARKETS_AXIS))
+        market = NamedSharding(mesh, P(MARKETS_AXIS))
+        state = jax.tree.map(
+            lambda x: jax.device_put(x, block), init_compact_state(M, K)
+        )
+        sharded_loop = build_compact_cycle_loop(mesh, donate=False)
+        got_state, got_cons = sharded_loop(
+            jax.device_put(probs, block),
+            jax.device_put(mask, block),
+            jax.device_put(outcome, market),
+            state,
+            jnp.float32(1.0),
+            3,
+        )
+        plain_loop = build_compact_cycle_loop(mesh=None, donate=False)
+        want_state, want_cons = plain_loop(
+            probs, mask, outcome, init_compact_state(M, K), jnp.float32(1.0), 3
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_cons), np.asarray(want_cons), rtol=2e-6, atol=1e-6
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_state.rel_steps), np.asarray(want_state.rel_steps)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_state.conf_steps), np.asarray(want_state.conf_steps)
+        )
