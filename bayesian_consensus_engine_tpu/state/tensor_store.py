@@ -431,15 +431,37 @@ class TensorReliabilityStore:
 
         Returns the number of rows written. The file is readable by the
         reference CLI/store unchanged (checkpoint save).
+
+        Columnar fast path: pulls the numeric columns as vectorised array
+        slices instead of building one ``ReliabilityRecord`` per row (the
+        per-element ``float(self._rel[row])`` walk dominated large flushes
+        — ~6.5 s for a 500k-pair flush, most of the e2e pipeline's wall
+        time). Rows are written in (source_id, market_id) order like
+        ``list_sources`` so repeated flushes of the same state produce
+        identical DB bytes.
         """
         from bayesian_consensus_engine_tpu.state.sqlite_store import (
             SQLiteReliabilityStore,
         )
 
-        records = self.list_sources()
+        used = len(self._pairs)
+        rows = np.nonzero(self._exists[:used])[0]
+        ids = self._pairs.ids()
+        sources = np.array([ids[r][0] for r in rows])
+        markets = np.array([ids[r][1] for r in rows])
+        order = np.lexsort((markets, sources))  # primary source, then market
+        params = list(
+            zip(
+                sources[order].tolist(),
+                markets[order].tolist(),
+                self._rel[rows][order].tolist(),
+                self._conf[rows][order].tolist(),
+                [self._iso[r] for r in rows[order]],
+            )
+        )
         with SQLiteReliabilityStore(db_path) as sqlite_store:
-            sqlite_store.put_records(records)
-        return len(records)
+            sqlite_store.put_rows(params)
+        return len(params)
 
     # -- durability (orbax checkpoint format) --------------------------------
     #
