@@ -100,30 +100,26 @@ def timed_best_of(loop_call, make_state, steps, trials=3):
     return 1.0 / best
 
 
-def bench_headline(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
-                   timed_steps=TIMED_STEPS):
-    """The 1M-market slot-packed cycle loop (driver metric)."""
+def headline_inputs(num_markets, slots):
+    """Shared headline workload setup: mesh, padded slot-major inputs.
+
+    ONE place decides mesh selection, lane padding, and shardings for every
+    bench that claims the headline shape (bench_headline, bench_compact) —
+    they must stay apples-to-apples. Returns
+    ``(mesh, probs, mask, outcome, padded_total, block_sharding)``.
+    """
     import jax
     import jax.numpy as jnp
 
-    from bayesian_consensus_engine_tpu.parallel import (
-        MarketBlockState,
-        build_cycle_loop,
-        init_block_state,
-        make_mesh,
-        pad_markets,
-    )
+    from bayesian_consensus_engine_tpu.parallel import make_mesh, pad_markets
     from bayesian_consensus_engine_tpu.parallel.mesh import (
         MARKETS_AXIS,
         SOURCES_AXIS,
     )
 
-    devices = jax.devices()
     # All devices on the markets axis: the reductions stay device-local and
     # the cycle needs zero communication (mesh.py default policy).
-    mesh = make_mesh() if len(devices) > 1 else None
-    dtype = jnp.float32
-
+    mesh = make_mesh() if len(jax.devices()) > 1 else None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -133,7 +129,7 @@ def bench_headline(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
         block_sharding = market_sharding = None
 
     probs, mask, outcome, _src_idx = build_workload(
-        jax.random.PRNGKey(0), num_markets, slots, dtype
+        jax.random.PRNGKey(0), num_markets, slots, jnp.float32
     )
     # Slot-major layout: (K, M), markets on lanes — padded to a lane multiple
     # (pads carry mask=0: zero weight, NaN consensus, cold state).
@@ -146,6 +142,25 @@ def bench_headline(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
         probs = jax.device_put(probs, block_sharding)
         mask = jax.device_put(mask, block_sharding)
         outcome = jax.device_put(outcome, market_sharding)
+    return mesh, probs, mask, outcome, padded_total, block_sharding
+
+
+def bench_headline(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
+                   timed_steps=TIMED_STEPS):
+    """The 1M-market slot-packed cycle loop (driver metric)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bayesian_consensus_engine_tpu.parallel import (
+        MarketBlockState,
+        build_cycle_loop,
+        init_block_state,
+    )
+
+    mesh, probs, mask, outcome, padded_total, block_sharding = headline_inputs(
+        num_markets, slots
+    )
+    dtype = jnp.float32
 
     def fresh_state():
         """Slot-major state, pre-sharded, fully materialised (fenced)."""
@@ -311,9 +326,8 @@ def bench_compact(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
                   timed_steps=TIMED_STEPS):
     """The counter-compact loop (parallel/compact.py) at the headline shape.
 
-    Mirrors bench_headline's mesh selection (all devices on the markets
-    axis when more than one is present) so the compact-vs-headline numbers
-    in the JSON stay apples-to-apples on multi-chip hosts.
+    Inputs come from the same ``headline_inputs`` as bench_headline, so the
+    compact-vs-headline numbers stay apples-to-apples by construction.
     """
     import jax
     import jax.numpy as jnp
@@ -321,32 +335,11 @@ def bench_compact(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
     from bayesian_consensus_engine_tpu.parallel import (
         build_compact_cycle_loop,
         init_compact_state,
-        make_mesh,
-        pad_markets,
-    )
-    from bayesian_consensus_engine_tpu.parallel.mesh import (
-        MARKETS_AXIS,
-        SOURCES_AXIS,
     )
 
-    mesh = make_mesh() if len(jax.devices()) > 1 else None
-    probs, mask, outcome, _ = build_workload(
-        jax.random.PRNGKey(0), num_markets, slots, jnp.float32
+    mesh, probs, mask, outcome, padded_total, block_sharding = headline_inputs(
+        num_markets, slots
     )
-    probs, mask = probs.T, mask.T
-    lane_multiple = 128 * (mesh.shape[MARKETS_AXIS] if mesh is not None else 1)
-    probs, mask, outcome, _, padded_total = pad_markets(
-        probs, mask, outcome, state=None, multiple=lane_multiple
-    )
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        block_sharding = NamedSharding(mesh, P(SOURCES_AXIS, MARKETS_AXIS))
-        probs = jax.device_put(probs, block_sharding)
-        mask = jax.device_put(mask, block_sharding)
-        outcome = jax.device_put(
-            outcome, NamedSharding(mesh, P(MARKETS_AXIS))
-        )
     loop = build_compact_cycle_loop(mesh, donate=True)
 
     def fresh_state():

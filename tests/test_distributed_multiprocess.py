@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from bayesian_consensus_engine_tpu.parallel import (
     build_cycle,
+    build_cycle_loop,
     init_block_state,
     make_mesh,
 )
@@ -50,6 +51,7 @@ import numpy as np
 from bayesian_consensus_engine_tpu.parallel import (
     MarketBlockState,
     build_cycle,
+    build_cycle_loop,
     init_block_state,
 )
 from bayesian_consensus_engine_tpu.parallel.distributed import (
@@ -104,12 +106,24 @@ result = build_cycle(mesh, donate=False)(
 )
 jax.block_until_ready(result)
 
+# The PRODUCTION loop shape (in-jit fori, fast scalar-stamp steps) must
+# also run across the 2-process cluster; its cross-shard psum rides gloo.
+loop_state = MarketBlockState(
+    *(global_block(np.asarray(x)[lo:hi], mesh, M) for x in init_block_state(M, K))
+)
+loop_state, loop_consensus = build_cycle_loop(mesh, slot_major=False, donate=False)(
+    probs, mask, outcome, loop_state, np.float32(1.0), 3
+)
+jax.block_until_ready(loop_consensus)
+
 band = {{
     "pid": pid,
     "lo": lo,
     "hi": hi,
     "consensus": np.asarray(local_view(result.consensus)).tolist(),
     "reliability": np.asarray(local_view(result.state.reliability)).tolist(),
+    "loop_consensus": np.asarray(local_view(loop_consensus)).tolist(),
+    "loop_reliability": np.asarray(local_view(loop_state.reliability)).tolist(),
 }}
 pathlib.Path(outdir, f"band_{{pid}}.json").write_text(json.dumps(band))
 print("WORKER_OK", pid)
@@ -190,6 +204,39 @@ class TestTwoProcessCluster:
             )
             np.testing.assert_allclose(
                 np.asarray(band["reliability"], np.float32),
+                expected_rel[lo:hi],
+                rtol=2e-6,
+                atol=1e-6,
+            )
+
+    def test_production_loop_matches_single_process(self, worker_bands):
+        """build_cycle_loop (fast fori shape) across 2 processes == local."""
+        rng = np.random.default_rng(SEED)
+        probs = rng.random((M, K)).astype(np.float32)
+        mask = rng.random((M, K)) < 0.8
+        outcome = rng.random(M) < 0.5
+        state, consensus = build_cycle_loop(
+            make_mesh((8, 1)), slot_major=False, donate=False
+        )(
+            jnp.asarray(probs),
+            jnp.asarray(mask),
+            jnp.asarray(outcome),
+            init_block_state(M, K),
+            jnp.float32(1.0),
+            3,
+        )
+        expected_consensus = np.asarray(consensus)
+        expected_rel = np.asarray(state.reliability)
+        for band in worker_bands:
+            lo, hi = band["lo"], band["hi"]
+            np.testing.assert_allclose(
+                np.asarray(band["loop_consensus"], np.float32),
+                expected_consensus[lo:hi],
+                rtol=2e-6,
+                atol=1e-6,
+            )
+            np.testing.assert_allclose(
+                np.asarray(band["loop_reliability"], np.float32),
                 expected_rel[lo:hi],
                 rtol=2e-6,
                 atol=1e-6,
