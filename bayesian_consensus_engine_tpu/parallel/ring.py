@@ -162,6 +162,37 @@ def _ring_cycle_math(
     return CycleResult(new_state, consensus, confidence_out, total_weight)
 
 
+def _fast_ring_cycle_math(
+    probs, mask, outcome, reliability, confidence, now_days, prev_now,
+    chunk_slots, n_sources,
+):
+    """Mid-loop ring cycle with the decay read driven by SCALAR time.
+
+    The ring analogue of ``sharded._fast_cycle_math``: after step 0 every
+    masked slot's stamp is the scalar ``prev_now``, so the per-slot
+    ``updated_days`` tensor drops out of the loop carry. Implemented by
+    feeding :func:`_ring_cycle_math` a broadcast-scalar stamp tensor and
+    discarding its days output — the broadcast read is free and XLA
+    dead-code-eliminates the unused days writes, so the chunked pass
+    carries exactly (reliability, confidence). Returns
+    ``(reliability', confidence', consensus)``.
+    """
+    state = MarketBlockState(
+        reliability=reliability,
+        confidence=confidence,
+        updated_days=jnp.broadcast_to(prev_now, reliability.shape),
+        exists=None,
+    )
+    result = _ring_cycle_math(
+        probs, mask, outcome, state, now_days, chunk_slots, n_sources
+    )
+    return (
+        result.state.reliability,
+        result.state.confidence,
+        result.consensus,
+    )
+
+
 def build_ring_cycle(
     mesh: Mesh,
     chunk_slots: int | None = None,
@@ -236,10 +267,15 @@ def build_ring_cycle_loop(
     (day ``now0 + i`` each) with the blocked state carried on device, which
     is the only dispatch shape whose timing reflects the kernel rather than
     per-call overhead (~4 ms through the axon TPU tunnel, and worse for
-    large operand sets). Same ``exists``-carry optimisation as the flat
-    loop: the mask is monotone under a fixed per-loop signal set, so
-    ``exists`` is reconstructed after the loop instead of being re-read and
-    re-written every cycle. ``steps`` is static per compilation.
+    large operand sets). Same carry optimisations as the flat loop (the
+    shared ``make_loop_math``/``run_fast_loop`` scaffold): ``exists`` is
+    monotone under a fixed per-loop signal set and ``updated_days`` is the
+    scalar ``now0+i−1`` for every masked slot after step 0, so BOTH are
+    reconstructed after the loop instead of being re-read and re-written
+    every cycle — mid-loop steps run :func:`_fast_ring_cycle_math` with
+    broadcast-scalar stamps, bit-identical to chained cycles including
+    checkpoint resume (tests/test_ring.py::test_resume_matches_uninterrupted).
+    ``steps`` is static per compilation.
     """
     n_sources = mesh.shape[SOURCES_AXIS]
     block = P(MARKETS_AXIS, SOURCES_AXIS)
@@ -247,14 +283,20 @@ def build_ring_cycle_loop(
     compiled: dict[tuple[int, bool], object] = {}
 
     def compile_for(steps: int, has_exists: bool):
-        # The loop scaffold (exists-carry optimisation, sanitise, restore)
-        # is shared with the flat loop; only the per-cycle math differs.
+        # The loop scaffold (exists/days-carry optimisations, sanitise,
+        # restore, last-step-outside-the-fori) is shared with the flat
+        # loop; only the per-cycle math differs.
         # No consensus cast needed: check_vma=False below.
         loop_math = make_loop_math(
             partial(
                 _ring_cycle_math, chunk_slots=chunk_slots, n_sources=n_sources
             ),
             steps,
+            fast_cycle_fn=partial(
+                _fast_ring_cycle_math,
+                chunk_slots=chunk_slots,
+                n_sources=n_sources,
+            ),
         )
 
         state_spec = MarketBlockState(

@@ -243,6 +243,27 @@ class TestRingCycleLoop:
             np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
         assert not np.any(np.asarray(consensus))
 
+    def test_resume_matches_uninterrupted(self):
+        # The shared fast-loop scaffold's bit-identity contract holds for
+        # the ring loop too: 3+2 resumed == 5 uninterrupted, bit-for-bit
+        # (the single-trip-fori hazard the scaffold guards against —
+        # see run_fast_loop in parallel/sharded.py).
+        mesh = make_mesh((2, 4))
+        probs, mask, outcome, state, _ = _random_inputs(seed=10)
+        loop = build_ring_cycle_loop(mesh, chunk_slots=6, donate=False)
+        full_state, full_cons = loop(
+            probs, mask, outcome, state, jnp.float32(10.0), 5
+        )
+        mid_state, _ = loop(probs, mask, outcome, state, jnp.float32(10.0), 3)
+        res_state, res_cons = loop(
+            probs, mask, outcome, mid_state, jnp.float32(13.0), 2
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_cons), np.asarray(full_cons)
+        )
+        for got, want in zip(res_state, full_state):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
 
 class TestReshard:
     def test_round_trip_and_layouts(self):
