@@ -160,6 +160,46 @@ class TestLoopEquivalence:
         assert not np.any(np.asarray(consensus))
 
 
+class TestCheckpoint:
+    def test_compact_state_round_trips_through_orbax(self, tmp_path):
+        # The checkpoint tier is pytree-generic; pin that int8/uint8
+        # counter states survive save → restore bit-identically and keep
+        # their dtypes (resume-from-checkpoint for the compact loop).
+        pytest.importorskip("orbax.checkpoint")
+        from bayesian_consensus_engine_tpu.state.checkpoint import (
+            CycleCheckpointer,
+        )
+
+        probs, mask, outcome = _workload(21)
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        state, _ = loop(
+            probs, mask, outcome, init_compact_state(M, K), jnp.float32(1.0), 3
+        )
+        with CycleCheckpointer(tmp_path / "ckpt") as ckpt:
+            ckpt.save(3, state, meta={"next_now": 4.0}, force=True)
+            restored, meta = ckpt.restore(like=state)
+        assert meta["next_now"] == 4.0
+        for got, want in zip(restored, state):
+            assert np.asarray(got).dtype == np.asarray(want).dtype
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # and the resumed loop continues bit-identically
+        full_state, full_cons = loop(
+            probs, mask, outcome, init_compact_state(M, K), jnp.float32(1.0), 5
+        )
+        res_state, res_cons = loop(
+            probs, mask, outcome, restored, jnp.float32(4.0), 2
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_cons), np.asarray(full_cons)
+        )
+        for field in ("rel_steps", "conf_steps", "updated_days"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(res_state, field)),
+                np.asarray(getattr(full_state, field)),
+                err_msg=field,
+            )
+
+
 class TestSharded:
     @pytest.mark.parametrize("shape", [(8, 1), (2, 4)])
     def test_mesh_parity(self, shape):

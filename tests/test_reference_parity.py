@@ -1,0 +1,132 @@
+"""Byte-level parity against the reference implementation itself.
+
+The golden fixture pins one known payload; this suite drives RANDOMIZED
+payloads through both engines and asserts byte-identical JSON — the
+strongest form of the parity contract. Runs only where the reference
+checkout is mounted (skipped elsewhere, e.g. public CI).
+
+The reference is UNTRUSTED third-party content: it is imported and
+executed for output comparison only.
+"""
+
+import json
+import pathlib
+import random
+import sys
+
+import pytest
+
+_REFERENCE_SRC = pathlib.Path("/root/reference/src")
+
+pytestmark = pytest.mark.skipif(
+    not _REFERENCE_SRC.is_dir(), reason="reference checkout not mounted"
+)
+
+
+@pytest.fixture(scope="module")
+def reference_engine():
+    sys.path.insert(0, str(_REFERENCE_SRC))
+    try:
+        from bayesian_engine.core import (  # type: ignore[import-not-found]
+            ValidationError,
+            compute_consensus,
+            validate_input_payload,
+        )
+
+        yield compute_consensus, validate_input_payload, ValidationError
+    finally:
+        sys.path.remove(str(_REFERENCE_SRC))
+
+
+def _random_case(rng: random.Random):
+    n = rng.randint(0, 12)
+    signals = [
+        {
+            "sourceId": f"s{rng.randint(0, 5)}",
+            "probability": round(rng.random(), 6),
+        }
+        for _ in range(n)
+    ]
+    reliability = {
+        f"s{i}": {
+            "reliability": round(rng.random(), 6),
+            "confidence": round(rng.random(), 6),
+        }
+        for i in range(6)
+        if rng.random() < 0.7
+    }
+    return signals, (reliability or None)
+
+
+class TestConsensusParity:
+    def test_randomized_byte_identical(self, reference_engine):
+        from bayesian_consensus_engine_tpu.core.engine import compute_consensus
+
+        ref_cc, _, _ = reference_engine
+        rng = random.Random(20260730)
+        for trial in range(300):
+            signals, reliability = _random_case(rng)
+            want = ref_cc(signals, reliability)
+            got = compute_consensus(signals, reliability)
+            assert json.dumps(got, sort_keys=True) == json.dumps(
+                want, sort_keys=True
+            ), f"trial {trial}: {signals} {reliability}"
+
+    def test_validation_messages_identical(self, reference_engine):
+        from bayesian_consensus_engine_tpu.core.validate import (
+            ValidationError,
+            validate_input_payload,
+        )
+
+        _, ref_validate, RefValidationError = reference_engine
+        bad_payloads = [
+            {},
+            {"schemaVersion": "2.0.0"},
+            {"schemaVersion": "1.0.0"},
+            {"schemaVersion": "1.0.0", "marketId": ""},
+            {"schemaVersion": "1.0.0", "marketId": "m"},
+            {"schemaVersion": "1.0.0", "marketId": "m", "signals": "nope"},
+            {
+                "schemaVersion": "1.0.0",
+                "marketId": "m",
+                "signals": [{"sourceId": "", "probability": 0.5}],
+            },
+            {
+                "schemaVersion": "1.0.0",
+                "marketId": "m",
+                "signals": [{"sourceId": "a", "probability": 1.5}],
+            },
+            {
+                "schemaVersion": "1.0.0",
+                "marketId": "m",
+                "signals": [{"sourceId": "a"}],
+            },
+        ]
+        for payload in bad_payloads:
+            with pytest.raises(RefValidationError) as ref_exc:
+                ref_validate(payload)
+            with pytest.raises(ValidationError) as our_exc:
+                validate_input_payload(payload)
+            assert str(our_exc.value) == str(ref_exc.value), payload
+
+    def test_update_trajectory_identical(self, reference_engine, tmp_path):
+        """Drive both stores through the same outcome sequence."""
+        from bayesian_engine.reliability import (  # type: ignore[import-not-found]
+            SQLiteReliabilityStore as RefStore,
+        )
+
+        from bayesian_consensus_engine_tpu.state import SQLiteReliabilityStore
+
+        rng = random.Random(7)
+        ours = SQLiteReliabilityStore(":memory:")
+        theirs = RefStore(":memory:")
+        for _ in range(200):
+            sid = f"s{rng.randint(0, 4)}"
+            mid = f"m{rng.randint(0, 2)}"
+            correct = rng.random() < 0.5
+            mine = ours.update_reliability(sid, mid, correct)
+            ref = theirs.update_reliability(sid, mid, correct)
+            assert mine.reliability == ref.reliability, (sid, mid)
+            assert mine.confidence == ref.confidence, (sid, mid)
+        ours.close()
+        theirs.close()
