@@ -50,6 +50,30 @@ def annotate(label: str):
     return deco
 
 
+def device_memory_stats(device=None) -> dict:
+    """Live accelerator memory counters for the observability surface.
+
+    Returns ``{bytes_in_use, bytes_limit, peak_bytes_in_use, utilisation}``
+    (zeros/None where the backend exposes no stats — CPU devices don't).
+    Pairs with the compiled-footprint numbers from AOT
+    ``memory_analysis()`` (see bench.bench_tiebreak_stress): this is the
+    runtime view, that is the per-program static view.
+    """
+    import jax
+
+    device = device or jax.devices()[0]
+    stats = device.memory_stats() or {}
+    in_use = stats.get("bytes_in_use", 0)
+    limit = stats.get("bytes_limit")
+    return {
+        "device": str(device),
+        "bytes_in_use": in_use,
+        "bytes_limit": limit,
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+        "utilisation": (in_use / limit) if limit else None,
+    }
+
+
 def auto_trace(fn, log_dir: str, every_n: int = 100, label: str = "settlement"):
     """Capture every *every_n*-th call of *fn* as an XLA profile.
 

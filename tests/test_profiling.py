@@ -9,7 +9,12 @@ from bayesian_consensus_engine_tpu.parallel import (
     build_cycle,
     init_block_state,
 )
-from bayesian_consensus_engine_tpu.utils.profiling import annotate, auto_trace, trace
+from bayesian_consensus_engine_tpu.utils.profiling import (
+    annotate,
+    auto_trace,
+    device_memory_stats,
+    trace,
+)
 
 
 def _cycle_args(m=8, k=4, seed=0):
@@ -35,6 +40,22 @@ class TestTrace:
             return x * 2
 
         assert float(double(jnp.float32(3.0))) == 6.0
+
+
+class TestDeviceMemoryStats:
+    def test_shape_and_graceful_absence(self):
+        # CPU devices expose no stats; the helper must still return the
+        # full shape with zero/None placeholders, never raise.
+        stats = device_memory_stats()
+        assert set(stats) == {
+            "device",
+            "bytes_in_use",
+            "bytes_limit",
+            "peak_bytes_in_use",
+            "utilisation",
+        }
+        assert stats["bytes_in_use"] >= 0
+        assert stats["utilisation"] is None or 0 <= stats["utilisation"] <= 1
 
 
 class TestAutoTrace:
