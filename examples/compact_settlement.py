@@ -1,0 +1,87 @@
+"""Counter-compact settlement: the cheapest way to run many cycles.
+
+The stored state is two saturating counters per (market, slot) — see
+parallel/compact.py for why the reference's update math makes that exact —
+so a million-market settlement loop carries ~9 bytes/slot/step instead of
+~21. This demo runs a small batch, checkpoints mid-run with orbax, resumes,
+and shows the decoded state equals an uninterrupted run.
+
+Run: python examples/compact_settlement.py
+"""
+
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from bayesian_consensus_engine_tpu.parallel import (  # noqa: E402
+    build_compact_cycle_loop,
+    compact_to_block,
+    init_compact_state,
+)
+
+
+def main() -> None:
+    markets, slots, steps = 1000, 8, 6
+    rng = np.random.default_rng(0)
+    probs = jnp.asarray(rng.random((slots, markets)), jnp.float32)
+    mask = jnp.asarray(rng.random((slots, markets)) < 0.9)
+    outcome = jnp.asarray(rng.random(markets) < 0.5)
+
+    loop = build_compact_cycle_loop(mesh=None, donate=False)
+
+    # Uninterrupted run.
+    full_state, full_consensus = loop(
+        probs, mask, outcome, init_compact_state(markets, slots),
+        jnp.float32(1.0), steps,
+    )
+
+    # Interrupted: 4 cycles, checkpoint, resume for 2 more.
+    mid_state, _ = loop(
+        probs, mask, outcome, init_compact_state(markets, slots),
+        jnp.float32(1.0), 4,
+    )
+    try:
+        from bayesian_consensus_engine_tpu.state.checkpoint import (
+            CycleCheckpointer,
+        )
+    except ImportError:  # orbax not installed: resume in-memory
+        restored = mid_state
+    else:
+        with tempfile.TemporaryDirectory() as tmp:
+            with CycleCheckpointer(tmp) as ckpt:
+                ckpt.save(4, mid_state, meta={"next_now": 5.0}, force=True)
+                restored, meta = ckpt.restore(like=mid_state)
+            assert meta["next_now"] == 5.0
+    resumed_state, resumed_consensus = loop(
+        probs, mask, outcome, restored, jnp.float32(5.0), 2
+    )
+
+    assert np.array_equal(
+        np.asarray(resumed_consensus), np.asarray(full_consensus)
+    ), "resume must be bit-identical"
+    for field in resumed_state._fields:
+        assert np.array_equal(
+            np.asarray(getattr(resumed_state, field)),
+            np.asarray(getattr(full_state, field)),
+        ), f"resumed state field {field} differs"
+    decoded = compact_to_block(resumed_state)
+    print(f"{markets} markets x {slots} slots, {steps} cycles")
+    print("  state bytes/slot: 2 counters + 4 stamp = 6 (vs 12 f32)")
+    print(f"  consensus[:4]   = {np.asarray(resumed_consensus)[:4].round(4)}")
+    print(f"  reliability lattice values in state: "
+          f"{sorted(set(np.asarray(decoded.reliability).ravel().round(6)))[:6]} ...")
+    print("  checkpoint resume: bit-identical to the uninterrupted run")
+
+
+if __name__ == "__main__":
+    main()

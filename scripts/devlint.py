@@ -5,6 +5,7 @@ neither tool is installed in the baked TPU image, so this script covers the
 highest-signal subset of the gated rules with ``ast`` only:
 
   F401  module-level imports never referenced
+  F541  f-string without any placeholders
   F811  redefinition of an imported name by a later import
   F841  local assigned and never used (simple ``x = ...`` targets only,
         matching ruff: loop variables and unpacking are not flagged)
@@ -62,6 +63,12 @@ def check_file(path: pathlib.Path) -> list[str]:
 
     problems: list[str] = []
     loaded = _names_loaded(tree)
+    # format_spec of f"{x:,}" is itself a JoinedStr; exclude those from F541.
+    format_specs = {
+        id(node.format_spec)
+        for node in ast.walk(tree)
+        if isinstance(node, ast.FormattedValue) and node.format_spec is not None
+    }
     exported = set()
     for node in ast.walk(tree):
         if (
@@ -119,6 +126,14 @@ def check_file(path: pathlib.Path) -> list[str]:
                         )
         elif isinstance(node, ast.ExceptHandler) and node.type is None:
             problems.append(f"{path}:{node.lineno}: E722 bare except")
+        elif (
+            isinstance(node, ast.JoinedStr)
+            and id(node) not in format_specs
+            and not any(isinstance(v, ast.FormattedValue) for v in node.values)
+        ):
+            problems.append(
+                f"{path}:{node.lineno}: F541 f-string without placeholders"
+            )
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             # Own scope only: nested defs report themselves. A name used by
             # a nested def still counts as used (closures), so collect uses
