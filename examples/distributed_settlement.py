@@ -62,10 +62,12 @@ def main() -> None:
     probs = global_block(probs_band, mesh, markets)
     mask = global_block(mask_band, mesh, markets)
     outcome = global_market(outcome_band, mesh, markets)
+    # Band-sized cold state built directly — no process ever allocates the
+    # global block (cold-start rows are the same constants everywhere).
     state = MarketBlockState(
         *(
-            global_block(np.asarray(x)[lo:hi], mesh, markets)
-            for x in init_block_state(markets, slots)
+            global_block(np.asarray(x), mesh, markets)
+            for x in init_block_state(hi - lo, slots)
         )
     )
 
