@@ -29,6 +29,7 @@ from bayesian_consensus_engine_tpu.parallel.ring import (
 )
 from bayesian_consensus_engine_tpu.parallel.compact import (
     CompactBlockState,
+    advance_counters,
     build_compact_cycle_loop,
     compact_to_block,
     init_compact_state,
@@ -57,6 +58,7 @@ __all__ = [
     "init_block_state",
     "pad_markets",
     "CompactBlockState",
+    "advance_counters",
     "build_compact_cycle_loop",
     "compact_to_block",
     "init_compact_state",

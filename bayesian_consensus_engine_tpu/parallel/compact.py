@@ -212,6 +212,53 @@ def _compact_loop_math(probs, mask, outcome, state, now0, steps, axis_name,
     return CompactBlockState(rel_steps, conf_steps, upd), consensus
 
 
+def advance_counters(
+    state: CompactBlockState,
+    mask: jax.Array,
+    correct: jax.Array,
+    steps: int,
+    now0,
+) -> CompactBlockState:
+    """N identical settlement cycles on counter state, in O(1) compute.
+
+    Counters make the fixed-input case CLOSED-FORM: applying the same
+    saturating ±1 bump N times equals one clamped jump of ±N, and the
+    update count saturates at the cap — so re-settling the same signal
+    batch against the same outcomes for N days needs one elementwise pass,
+    not N. Exactly equal to running :func:`build_compact_cycle_loop` for
+    *steps* (integer state; no float accumulation to diverge) —
+    tests/test_compact.py pins it.
+
+    The general loop remains the benchmarked path: the closed form answers
+    "same signals, N settlement days" (the reference's re-settlement
+    semantic), while the loop's per-step cost is what a stream of DISTINCT
+    daily batches would pay. ``correct`` is the per-slot outcome-agreement
+    bool (``(probs >= 0.5) == outcome``, broadcast over slots).
+
+    Consensus is not returned: it is a per-day READ (decay-dependent),
+    not part of the advanced state — compute it with one loop step at the
+    day you need it.
+    """
+    if steps <= 0:
+        return state
+    jump = jnp.where(correct, steps, -steps).astype(jnp.int32)
+    new_rel = jnp.clip(
+        state.rel_steps.astype(jnp.int32) + jump, -_STEPS_DOWN, _STEPS_UP
+    ).astype(jnp.int8)
+    new_conf = jnp.minimum(
+        state.conf_steps.astype(jnp.int32) + steps, _CONF_STEPS_MAX
+    ).astype(jnp.uint8)
+    return CompactBlockState(
+        rel_steps=jnp.where(mask, new_rel, state.rel_steps),
+        conf_steps=jnp.where(mask, new_conf, state.conf_steps),
+        updated_days=jnp.where(
+            mask,
+            jnp.asarray(now0 + (steps - 1), state.updated_days.dtype),
+            state.updated_days,
+        ),
+    )
+
+
 def build_compact_cycle_loop(
     mesh: Mesh | None = None,
     slot_major: bool = True,

@@ -160,6 +160,58 @@ class TestLoopEquivalence:
         assert not np.any(np.asarray(consensus))
 
 
+class TestClosedForm:
+    @pytest.mark.parametrize("steps", [1, 3, 8, 20])
+    def test_advance_counters_equals_loop(self, steps):
+        from bayesian_consensus_engine_tpu.parallel import advance_counters
+
+        probs, mask, outcome = _workload(31)
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        want_state, _ = loop(
+            probs, mask, outcome, init_compact_state(M, K),
+            jnp.float32(2.0), steps,
+        )
+        correct = (probs >= 0.5) == outcome[None, :]
+        got = advance_counters(
+            init_compact_state(M, K), mask, correct, steps, jnp.float32(2.0)
+        )
+        for field in got._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got, field)),
+                np.asarray(getattr(want_state, field)),
+                err_msg=field,
+            )
+
+    def test_advance_from_warm_state_with_saturation(self):
+        from bayesian_consensus_engine_tpu.parallel import advance_counters
+
+        probs, mask, outcome = _workload(32)
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        warm, _ = loop(
+            probs, mask, outcome, init_compact_state(M, K), jnp.float32(1.0), 4
+        )
+        # 12 more identical days: many counters saturate at the clamp.
+        want, _ = loop(probs, mask, outcome, warm, jnp.float32(5.0), 12)
+        correct = (probs >= 0.5) == outcome[None, :]
+        got = advance_counters(warm, mask, correct, 12, jnp.float32(5.0))
+        for field in got._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got, field)),
+                np.asarray(getattr(want, field)),
+                err_msg=field,
+            )
+
+    def test_zero_steps_is_identity(self):
+        from bayesian_consensus_engine_tpu.parallel import advance_counters
+
+        _, mask, outcome = _workload(33)
+        state = init_compact_state(M, K)
+        got = advance_counters(
+            state, mask, jnp.zeros_like(mask), 0, jnp.float32(1.0)
+        )
+        assert got is state
+
+
 class TestCheckpoint:
     def test_compact_state_round_trips_through_orbax(self, tmp_path):
         # The checkpoint tier is pytree-generic; pin that int8/uint8
