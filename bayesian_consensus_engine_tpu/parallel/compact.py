@@ -204,12 +204,19 @@ def _compact_loop_math(probs, mask, outcome, state, now0, steps, axis_name,
     (rel_steps, conf_steps), consensus = run_fast_loop(
         (rel_steps, conf_steps), consensus0, fast_step, steps, now0
     )
-    upd = jnp.where(
-        mask,
-        jnp.asarray(now0 + (steps - 1), state.updated_days.dtype),
-        state.updated_days,
-    )
+    upd = _stamp_updated_days(mask, now0, steps, state.updated_days)
     return CompactBlockState(rel_steps, conf_steps, upd), consensus
+
+
+def _stamp_updated_days(mask, now0, steps, updated_days):
+    """Masked day stamp after N cycles — SHARED by the loop and the closed
+    form; both must stamp the identical value or their documented exact
+    equality breaks."""
+    return jnp.where(
+        mask,
+        jnp.asarray(now0 + (steps - 1), updated_days.dtype),
+        updated_days,
+    )
 
 
 def advance_counters(
@@ -251,11 +258,7 @@ def advance_counters(
     return CompactBlockState(
         rel_steps=jnp.where(mask, new_rel, state.rel_steps),
         conf_steps=jnp.where(mask, new_conf, state.conf_steps),
-        updated_days=jnp.where(
-            mask,
-            jnp.asarray(now0 + (steps - 1), state.updated_days.dtype),
-            state.updated_days,
-        ),
+        updated_days=_stamp_updated_days(mask, now0, steps, state.updated_days),
     )
 
 

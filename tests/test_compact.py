@@ -211,6 +211,33 @@ class TestClosedForm:
         )
         assert got is state
 
+    def test_conf_cap_saturation_matches_loop(self):
+        # Counters hand-built just below the uint8 cap: the loop's guarded
+        # +1 and the closed form's min(c+N, 255) must agree ACROSS the cap
+        # (a wraparound in either would pass the shallower tests).
+        from bayesian_consensus_engine_tpu.parallel import (
+            CompactBlockState,
+            advance_counters,
+        )
+
+        probs, mask, outcome = _workload(34)
+        near_cap = CompactBlockState(
+            rel_steps=jnp.zeros((K, M), jnp.int8),
+            conf_steps=jnp.full((K, M), 250, jnp.uint8),
+            updated_days=jnp.full((K, M), 3.0, jnp.float32),
+        )
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        want, _ = loop(probs, mask, outcome, near_cap, jnp.float32(4.0), 10)
+        correct = (probs >= 0.5) == outcome[None, :]
+        got = advance_counters(near_cap, mask, correct, 10, jnp.float32(4.0))
+        assert int(np.asarray(want.conf_steps).max()) == 255  # cap reached
+        for field in got._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got, field)),
+                np.asarray(getattr(want, field)),
+                err_msg=field,
+            )
+
 
 class TestCheckpoint:
     def test_compact_state_round_trips_through_orbax(self, tmp_path):

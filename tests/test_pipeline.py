@@ -282,3 +282,64 @@ class TestPipelineApi:
         rows_m1 = plan.slot_rows.T[0]
         assert store._pairs.id_of(int(rows_m1[0])) == ("a", "m1")
         assert store._pairs.id_of(int(rows_m1[1])) == ("b", "m1")
+
+
+class TestMarketShardedStores:
+    """The multi-host deployment shape: markets are independent, so hosts
+    settle disjoint market bands in separate stores and flush separate
+    SQLite shards — the union must equal one combined settlement."""
+
+    def test_two_shards_union_equals_combined(self, tmp_path):
+        import sqlite3
+
+        import numpy as np
+
+        rng = np.random.default_rng(44)
+        payloads = [
+            (
+                f"mkt-{m}",
+                [
+                    {
+                        "sourceId": f"s-{rng.integers(0, 12)}",
+                        "probability": float(rng.random()),
+                    }
+                    for _ in range(rng.integers(1, 4))
+                ],
+            )
+            for m in range(40)
+        ]
+        outcomes = rng.random(40) < 0.5
+        now = 77.0
+
+        # Combined: one store settles everything.
+        combined = TensorReliabilityStore()
+        plan = build_settlement_plan(combined, payloads)
+        settle(combined, plan, outcomes, steps=3, now=now)
+        combined_db = tmp_path / "combined.db"
+        combined.flush_to_sqlite(combined_db)
+
+        # Sharded: two stores settle disjoint market bands, flush shards.
+        shard_dbs = []
+        for band, (lo, hi) in enumerate([(0, 20), (20, 40)]):
+            store = TensorReliabilityStore()
+            band_plan = build_settlement_plan(store, payloads[lo:hi])
+            settle(store, band_plan, outcomes[lo:hi], steps=3, now=now)
+            db = tmp_path / f"shard{band}.db"
+            store.flush_to_sqlite(db)
+            shard_dbs.append(db)
+
+        def rows(db):
+            conn = sqlite3.connect(db)
+            try:
+                return set(
+                    conn.execute(
+                        "SELECT source_id, market_id, reliability, confidence,"
+                        " updated_at FROM sources"
+                    ).fetchall()
+                )
+            finally:
+                conn.close()
+
+        union = rows(shard_dbs[0]) | rows(shard_dbs[1])
+        assert rows(shard_dbs[0]).isdisjoint(rows(shard_dbs[1]))
+        assert union == rows(combined_db)
