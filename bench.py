@@ -188,7 +188,12 @@ def bench_headline(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
 
 def bench_large_k(markets=LARGE_K_MARKETS, slots=LARGE_K_SLOTS,
                   steps=LARGE_K_STEPS):
-    """The 10k-source regime on one chip: flat slot-major loop + ring loop."""
+    """The 10k-source regime on one chip: flat, ring, and compact loops.
+
+    Returns ``(flat_cps, ring_cps, compact_cps)``. The compact state at
+    this shape is ~0.9 GB vs ~2 GB of f32 — the counter encoding is also
+    a capacity lever for the long-sources regime.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -196,8 +201,10 @@ def bench_large_k(markets=LARGE_K_MARKETS, slots=LARGE_K_SLOTS,
 
     from bayesian_consensus_engine_tpu.parallel import (
         MarketBlockState,
+        build_compact_cycle_loop,
         build_cycle_loop,
         init_block_state,
+        init_compact_state,
     )
     from bayesian_consensus_engine_tpu.parallel.ring import build_ring_cycle_loop
 
@@ -238,7 +245,20 @@ def bench_large_k(markets=LARGE_K_MARKETS, slots=LARGE_K_SLOTS,
         ring_state,
         steps,
     )
-    return flat_cps, ring_cps
+
+    compact = build_compact_cycle_loop(mesh=None, donate=True)
+
+    def compact_state():
+        state = init_compact_state(markets, slots)
+        _fence(state.updated_days)
+        return state
+
+    compact_cps = timed_best_of(
+        lambda s: compact(tp, tm, outcome, s, jnp.asarray(1.0, dtype), steps),
+        compact_state,
+        steps,
+    )
+    return flat_cps, ring_cps, compact_cps
 
 
 def bench_pallas(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
@@ -505,9 +525,9 @@ def run():
     else:
         headline, headline_source = f32_fast, "f32_fast_loop"
     try:
-        large_flat, large_ring = bench_large_k()
+        large_flat, large_ring, large_compact = bench_large_k()
     except Exception as exc:  # noqa: BLE001
-        large_flat = large_ring = f"failed: {type(exc).__name__}"
+        large_flat = large_ring = large_compact = f"failed: {type(exc).__name__}"
     try:
         pallas = round(bench_pallas(), 1)
     except Exception as exc:  # noqa: BLE001
@@ -556,6 +576,10 @@ def run():
                 "ring_loop_cycles_per_sec": (
                     round(large_ring, 1)
                     if isinstance(large_ring, float) else large_ring
+                ),
+                "compact_loop_cycles_per_sec": (
+                    round(large_compact, 1)
+                    if isinstance(large_compact, float) else large_compact
                 ),
             },
             "pallas_1m16_cycles_per_sec": pallas,

@@ -148,6 +148,31 @@ class TestLoopEquivalence:
             np.asarray(got_cons), np.asarray(want_cons), rtol=1e-6, atol=1e-6
         )
 
+    def test_market_major_layout_matches_slot_major(self):
+        # slot_major=False carries (M, K) blocks; same numbers, same
+        # counters — only the layout differs.
+        probs, mask, outcome = _workload(14)
+        sm_loop = build_compact_cycle_loop(mesh=None, donate=False)
+        sm_state, sm_cons = sm_loop(
+            probs, mask, outcome, init_compact_state(M, K), jnp.float32(1.0), 3
+        )
+        mm_loop = build_compact_cycle_loop(
+            mesh=None, slot_major=False, donate=False
+        )
+        mm_state, mm_cons = mm_loop(
+            probs.T, mask.T, outcome,
+            init_compact_state(M, K, slot_major=False), jnp.float32(1.0), 3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(mm_cons), np.asarray(sm_cons), rtol=1e-6, atol=1e-6
+        )
+        np.testing.assert_array_equal(
+            np.asarray(mm_state.rel_steps).T, np.asarray(sm_state.rel_steps)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(mm_state.conf_steps).T, np.asarray(sm_state.conf_steps)
+        )
+
     def test_zero_steps_identity(self):
         probs, mask, outcome = _workload(4)
         state = init_compact_state(M, K)
