@@ -109,6 +109,114 @@ class TestConsensusParity:
                 validate_input_payload(payload)
             assert str(our_exc.value) == str(ref_exc.value), payload
 
+    def test_tiebreak_resolution_identical(self, reference_engine):
+        """Randomized agent panels through both tie-breakers."""
+        from bayesian_engine.tiebreak import (  # type: ignore[import-not-found]
+            AgentSignal as RefAgent,
+            DeterministicTieBreaker as RefBreaker,
+        )
+
+        from bayesian_consensus_engine_tpu.models.tiebreak import (
+            AgentSignal,
+            DeterministicTieBreaker,
+        )
+
+        rng = random.Random(99)
+        ours, theirs = DeterministicTieBreaker(), RefBreaker()
+        for trial in range(150):
+            n = rng.randint(1, 10)
+            spec = [
+                (
+                    f"a{i}",
+                    rng.choice([0.1, 0.25, 0.5, 0.75, 0.9]),
+                    round(rng.random(), 6),
+                    round(rng.uniform(0.1, 3.0), 6),
+                    round(rng.random(), 6),
+                )
+                for i in range(n)
+            ]
+            my_pred, my_diag = ours.resolve(
+                [
+                    AgentSignal(a, p, c, weight=w, reliability_score=r)
+                    for a, p, c, w, r in spec
+                ]
+            )
+            ref_pred, ref_diag = theirs.resolve(
+                [
+                    RefAgent(a, p, c, weight=w, reliability_score=r)
+                    for a, p, c, w, r in spec
+                ]
+            )
+            assert my_pred == ref_pred, trial
+            assert my_diag.tie_resolved_by == ref_diag.tie_resolved_by, trial
+            assert my_diag.method == ref_diag.method, trial
+            assert my_diag.groups == ref_diag.groups, trial
+            assert (
+                my_diag.confidence_variance == ref_diag.confidence_variance
+            ), trial
+
+    def test_decay_math_identical(self, reference_engine):
+        """Randomized decay inputs through both decay modules."""
+        from bayesian_engine import decay as ref_decay  # type: ignore[import-not-found]
+
+        from bayesian_consensus_engine_tpu.state import decay as our_decay
+
+        rng = random.Random(5)
+        for _ in range(300):
+            elapsed = rng.uniform(-5, 400)
+            rel = round(rng.random(), 6)
+            assert our_decay.compute_decay_factor(
+                elapsed
+            ) == ref_decay.compute_decay_factor(elapsed)
+            assert our_decay.apply_reliability_decay(
+                rel, elapsed
+            ) == ref_decay.apply_reliability_decay(rel, elapsed)
+
+    def test_namespaced_fallback_chain_identical(self, reference_engine):
+        """market → domain → global → cold-start walks match step for step."""
+        from bayesian_engine.reliability import (  # type: ignore[import-not-found]
+            SQLiteReliabilityStore as RefStore,
+        )
+        from bayesian_engine.reliability_abstraction import (  # type: ignore[import-not-found]
+            NamespacedReliabilityStore as RefNamespaced,
+        )
+
+        from bayesian_consensus_engine_tpu.state.namespaced import (
+            NamespacedReliabilityStore,
+        )
+
+        rng = random.Random(21)
+        ours = NamespacedReliabilityStore(":memory:")
+        theirs = RefNamespaced(":memory:")
+        # Mixed writes across namespaces, then chain walks.
+        for _ in range(120):
+            sid = f"s{rng.randint(0, 3)}"
+            mid = f"m{rng.randint(0, 2)}"
+            domain = rng.choice([None, "crypto", "sports"])
+            if rng.random() < 0.5:
+                correct = rng.random() < 0.5
+                also_global = rng.random() < 0.3
+                for target in (ours, theirs):
+                    target.update_reliability(
+                        sid,
+                        outcome_correct=correct,
+                        market_id=mid,
+                        domain=domain,
+                        update_global=also_global,
+                    )
+            mine = ours.get_reliability(sid, market_id=mid, domain=domain)
+            ref = theirs.get_reliability(sid, market_id=mid, domain=domain)
+            # Decay-on-read runs at each store's own wall-clock instant;
+            # the microseconds between the two calls skew the factor ~1e-10.
+            assert mine.reliability == pytest.approx(
+                ref.reliability, abs=1e-6
+            ), (sid, mid, domain)
+            assert mine.confidence == ref.confidence
+            assert mine.namespace_value == ref.namespace_value
+            assert mine.is_fallback == ref.is_fallback
+        ours.close()
+        theirs.close()
+
     def test_update_trajectory_identical(self, reference_engine, tmp_path):
         """Drive both stores through the same outcome sequence."""
         from bayesian_engine.reliability import (  # type: ignore[import-not-found]
