@@ -5,9 +5,8 @@ gates); no host state, no string ids — everything indexed int32.
 """
 
 from bayesian_consensus_engine_tpu.ops.consensus import (
-    consensus_from_block,
-    consensus_from_pairs,
     pair_mean_from_flat,
+    weighted_sums_from_pairs,
 )
 from bayesian_consensus_engine_tpu.ops.decay import (
     decay_factor,
@@ -25,9 +24,8 @@ from bayesian_consensus_engine_tpu.ops.update import (
 )
 
 __all__ = [
-    "consensus_from_block",
-    "consensus_from_pairs",
     "pair_mean_from_flat",
+    "weighted_sums_from_pairs",
     "decay_factor",
     "decayed_reliability",
     "decayed_reliability_at",

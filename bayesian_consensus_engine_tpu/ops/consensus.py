@@ -8,15 +8,12 @@ is three masked segment/block reductions over the whole batch at once:
   per (market, source) pair:  p̄  = mean of that pair's signals
   per market:                 Σw, Σ p̄·w, Σ c·w   →  consensus, confidence
 
-Two layouts, one semantics:
-
-  * **flat/segment** (`pair_mean_from_flat`, `consensus_from_pairs`) —
-    CSR-style arrays over the real (ragged) signal multiset. Exact-size,
-    no padding waste; scatter-adds compile fine on TPU. Used by the host
-    packing layer for arbitrary inputs.
-  * **blocked** (`consensus_from_block`) — dense (M, K) tiles (K = padded
-    max sources per market). Shape-static, MXU/VPU-friendly, the layout the
-    shard_map/Pallas paths consume; padding is masked out.
+This module holds the **flat/segment** layout kernels: CSR-style arrays over
+the real (ragged) signal multiset. Exact-size, no padding waste;
+scatter-adds compile fine on TPU. Used by the host packing layer
+(``core.batch``) for arbitrary inputs. The **blocked** dense (M, K) layout —
+shape-static, VPU-friendly, what the shard_map/compact/ring paths consume —
+lives with its consumers as ``parallel.sharded.consensus_reduce``.
 
 All kernels are dtype-polymorphic: float32 for throughput, float64 (under
 ``jax.experimental.enable_x64``) for the bit-parity gate against the scalar
@@ -59,60 +56,13 @@ def weighted_sums_from_pairs(
     """Per-market reductions ``(Σw, Σ p̄·w, Σ c·w)``, each ``f[M]``.
 
     The three sums are the whole device-side cost; the two normalization
-    divides are left to the caller — device consumers use
-    :func:`consensus_from_pairs`, while the document formatter divides on the
-    host (XLA may rewrite divides as reciprocal-multiplies, which costs a few
-    ulp and would break golden byte-parity).
+    divides are left to the caller — the blocked cycle paths normalise via
+    ``parallel.sharded.consensus_epilogue``, while the document formatter
+    divides on the host (XLA may rewrite divides as reciprocal-multiplies,
+    which costs a few ulp and would break golden byte-parity).
     """
     seg = lambda v: jax.ops.segment_sum(v, pair_market, num_segments=num_markets)
     total_weight = seg(pair_reliability)
     weighted_prob = seg(pair_mean * pair_reliability)
     weighted_conf = seg(pair_confidence * pair_reliability)
     return total_weight, weighted_prob, weighted_conf
-
-
-def consensus_from_pairs(
-    pair_mean: Array,
-    pair_reliability: Array,
-    pair_confidence: Array,
-    pair_market: Array,
-    num_markets: int,
-) -> tuple[Array, Array, Array]:
-    """Reliability-weighted consensus per market from per-pair values.
-
-    Returns ``(consensus, confidence, total_weight)``, each ``f[M]``.
-    Markets with zero total weight get consensus NaN (host formats it as
-    ``null``, matching the reference's ``None`` — core.py:131-133) and
-    confidence 0.
-    """
-    total_weight, weighted_prob, weighted_conf = weighted_sums_from_pairs(
-        pair_mean, pair_reliability, pair_confidence, pair_market, num_markets
-    )
-    has_weight = total_weight != 0  # scalar parity: reference tests == 0 (core.py:131)
-    safe_total = jnp.where(has_weight, total_weight, 1.0)
-    consensus = jnp.where(has_weight, weighted_prob / safe_total, jnp.nan)
-    confidence = jnp.where(has_weight, weighted_conf / safe_total, 0.0)
-    return consensus, confidence, total_weight
-
-
-def consensus_from_block(
-    probs: Array,             # f[M, K]  per-slot mean probability
-    reliability: Array,       # f[M, K]
-    confidence: Array,        # f[M, K]
-    mask: Array,              # bool[M, K]  valid-slot mask (padding = False)
-) -> tuple[Array, Array, Array]:
-    """Blocked variant of :func:`consensus_from_pairs` over dense (M, K) tiles.
-
-    One fused pass: three masked reductions along K, then the normalization
-    divides. XLA fuses this into a single VPU sweep per tile.
-    """
-    w = jnp.where(mask, reliability, 0.0)
-    total_weight = jnp.sum(w, axis=-1)
-    weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=-1)
-    weighted_conf = jnp.sum(jnp.where(mask, confidence, 0.0) * w, axis=-1)
-
-    has_weight = total_weight != 0  # scalar parity: reference tests == 0 (core.py:131)
-    safe_total = jnp.where(has_weight, total_weight, 1.0)
-    consensus = jnp.where(has_weight, weighted_prob / safe_total, jnp.nan)
-    confidence_out = jnp.where(has_weight, weighted_conf / safe_total, 0.0)
-    return consensus, confidence_out, total_weight

@@ -72,6 +72,15 @@ class BatchTieBreakResult(NamedTuple):
     Rows with no valid agent yield ``prediction = NaN`` and zeroed stats
     (the scalar engine raises on empty input instead; batched rows are
     padding, not errors).
+
+    ``prediction`` is always the quantised winning key rescaled
+    (``round(pred·10^precision)/10^precision``, in f32) — including for a
+    single-agent row, where the reference's shortcut returns the *raw*
+    unrounded prediction (reference: tiebreak.py:89-96). Multi-agent rows
+    genuinely resolve on rounded keys in both engines; the single-agent
+    divergence only shows for predictions with more than ``precision``
+    decimals. The scalar engine (models/tiebreak.py) keeps the shortcut and
+    remains the bit-exact contract.
     """
 
     prediction: Array           # f[M] winning (rounded) prediction

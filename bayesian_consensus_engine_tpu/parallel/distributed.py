@@ -52,10 +52,8 @@ def _runtime_already_initialized() -> bool:
     """True when this process has already joined a multi-process runtime."""
     if _cluster_initialized:
         return True
-    try:  # official flag where the private module still exposes it
-        from jax._src import distributed as _jax_distributed
-
-        return _jax_distributed.global_state.client is not None
+    try:  # public API (jax ≥ 0.4.35); pinned by tests/test_distributed.py
+        return bool(jax.distributed.is_initialized())
     except Exception:  # API moved: fall back to our own flag only
         return False
 
@@ -88,12 +86,21 @@ def init_distributed(
         # swallowing them would silently degrade a pod run to disconnected
         # single-process runs. Repeat calls never reach initialize(): the
         # guard above makes idempotence structural.
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            **kwargs,
-        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+        except RuntimeError as err:
+            # Belt-and-suspenders idempotence: if the runtime was brought up
+            # outside this module AND the is_initialized probe has moved
+            # (both guards above missed it), jax itself still knows — treat
+            # its double-init complaint as success, re-raise the rest.
+            # (jax 0.9.0 wording: "should only be called once".)
+            if "called once" not in str(err):
+                raise
         _cluster_initialized = True
     return {
         "process_index": jax.process_index(),

@@ -55,6 +55,12 @@ class SettlementPlan:
     slots carry ``row = -1`` and ``mask = False``; at kernel time row −1
     resolves to a sink row appended past the store's flat state, so the plan
     stays valid even if the store interns more pairs after it was built.
+
+    The plan is **immutable after build** — ``build_settlement_plan`` marks
+    every array read-only, because ``settle`` caches device copies of
+    ``slot_rows``/``probs``/``mask`` on the plan (keyed by dtype) to skip
+    the host→device re-upload on repeat settlements; a mutated host array
+    would silently diverge from its cached device twin.
     """
 
     market_keys: list[str]        # row → market id (payload order)
@@ -133,7 +139,7 @@ def build_settlement_plan(
     else:
         binding = ()
 
-    return SettlementPlan(
+    plan = SettlementPlan(
         market_keys=keys,
         slot_rows=np.ascontiguousarray(slot_rows.T),
         probs=np.ascontiguousarray(probs.T),
@@ -141,6 +147,12 @@ def build_settlement_plan(
         signals_per_market=packed.signals_per_market,
         binding=binding,
     )
+    # Freeze the arrays: settle() caches device copies keyed by the plan
+    # object (see SettlementPlan docstring), so host-side mutation after
+    # build must fail loudly rather than desync host and device views.
+    for array in (plan.slot_rows, plan.probs, plan.mask, plan.signals_per_market):
+        array.setflags(write=False)
+    return plan
 
 
 def _pair_means(packed) -> np.ndarray:

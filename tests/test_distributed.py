@@ -42,6 +42,14 @@ class TestInitDistributed:
         info = init_distributed(num_processes=1)
         assert info["process_count"] == 1
 
+    def test_runtime_probe_api_still_public(self):
+        # _runtime_already_initialized leans on jax.distributed.is_initialized;
+        # fail loudly here if a JAX upgrade moves it (the except-fallback
+        # would otherwise silently degrade idempotence detection).
+        import jax
+
+        assert jax.distributed.is_initialized() is False
+
     def test_cluster_bringup_failure_surfaces(self):
         # The test backend is already initialised (conftest touched JAX), so
         # a genuine multi-process bring-up must FAIL LOUDLY here — silently

@@ -174,9 +174,6 @@ class TestConsensusParity:
 
     def test_namespaced_fallback_chain_identical(self, reference_engine):
         """market → domain → global → cold-start walks match step for step."""
-        from bayesian_engine.reliability import (  # type: ignore[import-not-found]
-            SQLiteReliabilityStore as RefStore,
-        )
         from bayesian_engine.reliability_abstraction import (  # type: ignore[import-not-found]
             NamespacedReliabilityStore as RefNamespaced,
         )
