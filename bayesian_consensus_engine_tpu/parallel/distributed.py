@@ -41,10 +41,13 @@ from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AX
 _BLOCK_SPEC = P(MARKETS_AXIS, SOURCES_AXIS)
 _MARKET_SPEC = P(MARKETS_AXIS)
 
-# Cluster bring-up is once-per-process; tracked here so repeat
-# init_distributed() calls are no-ops by construction rather than by
-# parsing jax's "should only be called once" error text (which a JAX
-# upgrade could reword out from under us).
+# Cluster bring-up is once-per-process. This flag plus the public
+# is_initialized() probe are the primary idempotence guards — repeat
+# init_distributed() calls are no-ops by construction. A last-resort
+# fallback in init_distributed() additionally recognises jax's double-init
+# error text ("should only be called once"); it exists only for the case
+# where BOTH guards miss (runtime brought up externally AND the probe API
+# moved), and must be re-checked when bumping JAX in case of rewording.
 _cluster_initialized = False
 
 

@@ -142,6 +142,32 @@ class TestSingleMarketParity:
         # empty-signals stays on the scalar path regardless of backend
         assert compute_consensus([], backend="tpu")["diagnostics"]["status"] == "no_signals"
 
+    def test_golden_fixture_exact_via_backend_dispatch_x64(self):
+        # The dispatch line itself (engine.py backend= kwarg), not a direct
+        # compute_consensus_jax call: under x64 the batched path reproduces
+        # the golden bytes for BOTH backend aliases.
+        fixture = json.loads((FIXTURES / "golden_regression.json").read_text())
+        signals = fixture["input"]["signals"]
+        with enable_x64():
+            for backend in ("jax", "tpu"):
+                assert (
+                    compute_consensus(signals, backend=backend)
+                    == fixture["expectedOutput"]
+                ), backend
+
+    def test_backend_unavailable_raises_not_implemented(self, monkeypatch):
+        # The dispatch's ImportError → NotImplementedError fallback: a build
+        # without the batched path must fail loudly, not fall back silently.
+        import sys as _sys
+
+        monkeypatch.setitem(
+            _sys.modules, "bayesian_consensus_engine_tpu.core.batch", None
+        )
+        with pytest.raises(NotImplementedError, match="backend 'jax' requires"):
+            compute_consensus(
+                [{"sourceId": "a", "probability": 0.6}], backend="jax"
+            )
+
 
 class TestBatchedMarkets:
     def test_many_markets_one_pass(self):

@@ -11,6 +11,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO_ROOT = str(Path(__file__).resolve().parents[1])
 
 
@@ -91,6 +93,68 @@ class TestConsensusSubcommand:
         proc = run_cli(["consensus"], stdin_payload=fixture["input"])
         assert proc.returncode == 0
         assert proc.stdout == json.dumps(fixture["expectedOutput"], indent=2) + "\n"
+
+    def test_backend_jax_golden_byte_exact_x64(self, tmp_path: Path):
+        # End-to-end --backend jax through a real CLI process. Env-var JAX
+        # overrides are dead on this host (sitecustomize pins the platform at
+        # interpreter startup), so the subprocess pins CPU + x64 via
+        # jax.config before main() — argv, stdin, stdout, and exit code are
+        # the real surface. Under x64 the batched path must reproduce the
+        # golden fixture byte-for-byte through the dispatch.
+        fixture = json.loads(
+            (Path(REPO_ROOT) / "tests/fixtures/golden_regression.json").read_text()
+        )
+        launcher = tmp_path / "cli_jax_launcher.py"
+        launcher.write_text(
+            "import sys\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.config.update('jax_enable_x64', True)\n"
+            f"sys.path.insert(0, {REPO_ROOT!r})\n"
+            "from bayesian_consensus_engine_tpu.cli import main\n"
+            "main()\n",
+            encoding="utf-8",
+        )
+        proc = subprocess.run(
+            [sys.executable, str(launcher), "--backend", "jax", "consensus"],
+            capture_output=True,
+            text=True,
+            input=json.dumps(fixture["input"]),
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert proc.stdout == json.dumps(fixture["expectedOutput"], indent=2) + "\n"
+
+    def test_backend_jax_default_f32_close(self, tmp_path: Path):
+        # Without x64 the jax backend runs f32: same document shape, floats
+        # within f32 resolution of the scalar answer.
+        launcher = tmp_path / "cli_jax_f32.py"
+        launcher.write_text(
+            "import sys\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            f"sys.path.insert(0, {REPO_ROOT!r})\n"
+            "from bayesian_consensus_engine_tpu.cli import main\n"
+            "main()\n",
+            encoding="utf-8",
+        )
+        payload = _payload(
+            [
+                {"sourceId": "a", "probability": 0.61},
+                {"sourceId": "b", "probability": 0.34},
+            ]
+        )
+        proc = subprocess.run(
+            [sys.executable, str(launcher), "--backend", "jax"],
+            capture_output=True,
+            text=True,
+            input=json.dumps(payload),
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["consensus"] == pytest.approx(0.475, rel=1e-6)
+        assert out["diagnostics"]["uniqueSources"] == 2
 
     def test_db_reliability_lookup(self, tmp_path: Path):
         db = tmp_path / "rel.db"

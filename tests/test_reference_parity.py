@@ -10,13 +10,17 @@ executed for output comparison only.
 """
 
 import json
+import os
 import pathlib
 import random
+import shutil
+import subprocess
 import sys
 
 import pytest
 
 _REFERENCE_SRC = pathlib.Path("/root/reference/src")
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
 
 pytestmark = pytest.mark.skipif(
     not _REFERENCE_SRC.is_dir(), reason="reference checkout not mounted"
@@ -36,6 +40,55 @@ def reference_engine():
         yield compute_consensus, validate_input_payload, ValidationError
     finally:
         sys.path.remove(str(_REFERENCE_SRC))
+
+
+def _seed_reliability_db(path, rng: random.Random, sources: int, markets: int):
+    """Seed a reference-format DB with decay-inert rows (byte-stable reads).
+
+    Stamps are in the future (or empty), so decay-on-read is a no-op for
+    both engines no matter when each process runs — the one source of
+    cross-process float skew in CLI comparisons. Live-stamp decay parity is
+    covered separately with tolerance (namespaced-chain test).
+    """
+    from bayesian_consensus_engine_tpu.state import SQLiteReliabilityStore
+
+    rows = []
+    for s in range(sources):
+        for m in range(markets):
+            if rng.random() < 0.35:
+                continue  # cold pair: CLI must fall back to defaults
+            stamp = rng.choice(["2100-01-01T00:00:00+00:00", ""])
+            rows.append(
+                (
+                    f"s{s}",
+                    f"market-{m}",
+                    round(rng.random(), 6),
+                    round(rng.random(), 6),
+                    stamp,
+                )
+            )
+    with SQLiteReliabilityStore(path) as store:
+        store.put_rows(rows)
+
+
+def _assert_json_ulp_close(got, want, path="$"):
+    """Structural equality with floats allowed to differ by ~1 ulp."""
+    import math
+
+    if isinstance(want, float) and isinstance(got, float):
+        assert math.isclose(got, want, rel_tol=5e-16, abs_tol=1e-15), (
+            path, got, want,
+        )
+    elif isinstance(want, dict):
+        assert isinstance(got, dict) and got.keys() == want.keys(), path
+        for key in want:
+            _assert_json_ulp_close(got[key], want[key], f"{path}.{key}")
+    elif isinstance(want, list):
+        assert isinstance(got, list) and len(got) == len(want), path
+        for i, (g, w) in enumerate(zip(got, want)):
+            _assert_json_ulp_close(g, w, f"{path}[{i}]")
+    else:
+        assert got == want, (path, got, want)
 
 
 def _random_case(rng: random.Random):
@@ -214,6 +267,69 @@ class TestConsensusParity:
         ours.close()
         theirs.close()
 
+    def test_market_sweep_identical(self, reference_engine, tmp_path):
+        """``compute_all_consensus`` over identical stores and markets.
+
+        Reliability rows are stamped in the future so decay-on-read is a
+        no-op on both sides — the scalar sweep outputs must then be
+        byte-identical (live-stamp decay skew is covered with tolerance
+        elsewhere). The batched (jax, x64) sweep runs the same markets in
+        one device pass and must agree to 1 ulp: CPython ≥3.12's builtin
+        ``sum()`` is Neumaier-compensated while device segment-sums
+        accumulate naively, so byte-equality is not the contract there
+        (golden-fixture byte-parity through the dispatch is pinned in
+        test_batch_parity.py). Match: reference market.py:200-221.
+        """
+        from bayesian_engine.market import (  # type: ignore[import-not-found]
+            MarketId as RefMarketId,
+            MarketStore as RefMarketStore,
+        )
+        from bayesian_engine.reliability import (  # type: ignore[import-not-found]
+            SQLiteReliabilityStore as RefStore,
+        )
+
+        from bayesian_consensus_engine_tpu.models.market import (
+            MarketId,
+            MarketStore,
+        )
+        from bayesian_consensus_engine_tpu.state import SQLiteReliabilityStore
+
+        db = tmp_path / "sweep.db"
+        _seed_reliability_db(db, random.Random(17), sources=8, markets=6)
+
+        rng = random.Random(23)
+        ours, theirs = MarketStore(), RefMarketStore()
+        for m in range(6):
+            mid = f"market-{m}"
+            signals = [
+                {
+                    "sourceId": f"s{rng.randint(0, 7)}",
+                    "probability": round(rng.random(), 6),
+                }
+                for _ in range(rng.randint(0, 5))
+            ]
+            ours.create_market(MarketId(mid))
+            theirs.create_market(RefMarketId(mid))
+            for signal in signals:
+                ours.add_signal(MarketId(mid), dict(signal))
+                theirs.add_signal(RefMarketId(mid), dict(signal))
+        # One resolved market on each side: the sweep must skip it.
+        ours.create_market(MarketId("done")).resolve(True)
+        theirs.create_market(RefMarketId("done")).resolve(True)
+
+        with SQLiteReliabilityStore(db) as mine, RefStore(db) as ref:
+            want = theirs.compute_all_consensus(ref)
+            got = ours.compute_all_consensus(mine)
+            assert json.dumps(got, sort_keys=True) == json.dumps(
+                want, sort_keys=True
+            )
+
+            import jax
+
+            with jax.enable_x64():
+                batched = ours.compute_all_consensus(mine, backend="jax")
+            _assert_json_ulp_close(batched, want)
+
     def test_update_trajectory_identical(self, reference_engine, tmp_path):
         """Drive both stores through the same outcome sequence."""
         from bayesian_engine.reliability import (  # type: ignore[import-not-found]
@@ -235,3 +351,168 @@ class TestConsensusParity:
             assert mine.confidence == ref.confidence, (sid, mid)
         ours.close()
         theirs.close()
+
+
+def _run_reference_cli(args, stdin_text=None):
+    """Run the reference CLI as a subprocess (PYTHONPATH prepended, never
+    replaced — the harness's site path must survive)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        str(_REFERENCE_SRC) + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    return subprocess.run(
+        [sys.executable, "-m", "bayesian_engine.cli", *args],
+        capture_output=True,
+        text=True,
+        input=stdin_text,
+        env=env,
+        cwd=str(_REPO_ROOT),
+    )
+
+
+def _run_our_cli(args, stdin_text=None):
+    return subprocess.run(
+        [sys.executable, "-m", "bayesian_consensus_engine_tpu.cli", *args],
+        capture_output=True,
+        text=True,
+        input=stdin_text,
+        cwd=str(_REPO_ROOT),
+    )
+
+
+def _random_cli_payload(rng: random.Random, markets: int = 6):
+    n = rng.randint(0, 8)
+    return {
+        "schemaVersion": "1.0.0",
+        "marketId": f"market-{rng.randint(0, markets - 1)}",
+        "signals": [
+            {
+                "sourceId": f"s{rng.randint(0, 7)}",
+                "probability": round(rng.random(), 6),
+            }
+            for _ in range(n)
+        ],
+    }
+
+
+class TestCliByteParity:
+    """Both CLIs as subprocesses: identical stdout bytes and exit codes.
+
+    The process boundary is the strongest parity gate — it covers argument
+    parsing, stdin/file loading, DB lookup, consensus math, JSON formatting,
+    and error routing in one assertion. Match: reference cli.py:113-174.
+    """
+
+    def test_consensus_no_db_randomized(self, reference_engine):
+        # Trial counts are deliberately small: each trial costs two full
+        # interpreter launches, and coverage across trials is the same code
+        # path with different floats (the in-process randomized gate above
+        # runs 300 cases).
+        rng = random.Random(31)
+        for trial in range(4):
+            payload = _random_cli_payload(rng)
+            stdin_text = json.dumps(payload)
+            for args in ([], ["consensus"], ["--dry-run"]):
+                ref = _run_reference_cli(args, stdin_text)
+                got = _run_our_cli(args, stdin_text)
+                assert got.returncode == ref.returncode, (trial, args)
+                assert got.stdout == ref.stdout, (trial, args)
+
+    def test_consensus_with_db_randomized(self, reference_engine, tmp_path):
+        rng = random.Random(37)
+        db_ours = tmp_path / "ours.db"
+        _seed_reliability_db(db_ours, random.Random(41), sources=8, markets=6)
+        db_ref = tmp_path / "ref.db"
+        shutil.copy(db_ours, db_ref)
+        for trial in range(4):
+            payload = _random_cli_payload(rng)
+            stdin_text = json.dumps(payload)
+            ref = _run_reference_cli(
+                ["--db", str(db_ref), "consensus"], stdin_text
+            )
+            got = _run_our_cli(["--db", str(db_ours), "consensus"], stdin_text)
+            assert got.returncode == ref.returncode == 0, (trial, ref.stderr)
+            assert got.stdout == ref.stdout, trial
+
+    def test_input_file_and_legacy_flag_position(self, reference_engine, tmp_path):
+        payload = _random_cli_payload(random.Random(43))
+        f = tmp_path / "payload.json"
+        f.write_text(json.dumps(payload), encoding="utf-8")
+        for args in (["--input", str(f)], ["consensus", "--input", str(f)]):
+            ref = _run_reference_cli(args)
+            got = _run_our_cli(args)
+            assert got.returncode == ref.returncode == 0, args
+            assert got.stdout == ref.stdout, args
+
+    def test_validation_errors_byte_identical(self, reference_engine):
+        bad_inputs = [
+            "{not json",
+            json.dumps({}),
+            json.dumps({"schemaVersion": "2.0.0"}),
+            json.dumps({"schemaVersion": "1.0.0", "marketId": "m"}),
+            json.dumps(
+                {
+                    "schemaVersion": "1.0.0",
+                    "marketId": "m",
+                    "signals": [{"sourceId": "a", "probability": 7}],
+                }
+            ),
+        ]
+        for stdin_text in bad_inputs:
+            ref = _run_reference_cli([], stdin_text)
+            got = _run_our_cli([], stdin_text)
+            assert got.returncode == ref.returncode == 1, stdin_text
+            assert got.stdout == ref.stdout, stdin_text
+            assert got.stderr == ref.stderr, stdin_text
+
+    def test_list_sources_byte_identical(self, reference_engine, tmp_path):
+        db_ours = tmp_path / "ours.db"
+        _seed_reliability_db(db_ours, random.Random(47), sources=6, markets=4)
+        db_ref = tmp_path / "ref.db"
+        shutil.copy(db_ours, db_ref)
+        for args in ([], ["--market-id", "market-2"], ["--market-id", "nope"]):
+            ref = _run_reference_cli(["--db", str(db_ref), "list-sources", *args])
+            got = _run_our_cli(["--db", str(db_ours), "list-sources", *args])
+            assert got.returncode == ref.returncode == 0, args
+            assert got.stdout == ref.stdout, args
+
+    def test_report_outcome_identical_modulo_timestamp(
+        self, reference_engine, tmp_path
+    ):
+        """Outcome reporting stamps each store's own utcnow — the one field
+        that legitimately differs between processes. Everything else must
+        match bytewise, across updates, dry-runs, and the follow-up
+        list-sources readback."""
+        db_ours = tmp_path / "ours.db"
+        _seed_reliability_db(db_ours, random.Random(53), sources=4, markets=3)
+        db_ref = tmp_path / "ref.db"
+        shutil.copy(db_ours, db_ref)
+
+        def scrub(document):
+            for key in ("updatedAt",):
+                if key in document:
+                    document[key] = "<stamp>"
+            for entry in document.get("sources", []):
+                entry["updatedAt"] = "<stamp>"
+            return document
+
+        rng = random.Random(59)
+        for trial in range(6):
+            args = [
+                "report-outcome",
+                "--source-id", f"s{rng.randint(0, 3)}",
+                "--market-id", f"market-{rng.randint(0, 2)}",
+            ]
+            if rng.random() < 0.5:
+                args.append("--correct")
+            prefix = ["--dry-run"] if rng.random() < 0.3 else []
+            ref = _run_reference_cli(["--db", str(db_ref), *prefix, *args])
+            got = _run_our_cli(["--db", str(db_ours), *prefix, *args])
+            assert got.returncode == ref.returncode == 0, (trial, ref.stderr)
+            assert scrub(json.loads(got.stdout)) == scrub(
+                json.loads(ref.stdout)
+            ), trial
+
+        ref = _run_reference_cli(["--db", str(db_ref), "list-sources"])
+        got = _run_our_cli(["--db", str(db_ours), "list-sources"])
+        assert scrub(json.loads(got.stdout)) == scrub(json.loads(ref.stdout))
