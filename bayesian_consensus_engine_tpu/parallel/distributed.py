@@ -239,6 +239,22 @@ def global_market(local_rows: np.ndarray, mesh: Mesh, num_markets: int) -> jax.A
     )
 
 
+def global_slot_block(
+    local_cols: np.ndarray, mesh: Mesh, num_markets: int
+) -> jax.Array:
+    """Assemble a globally-sharded SLOT-MAJOR (K, M) block from local columns.
+
+    The transpose-layout twin of :func:`global_block` for the production
+    loop's (K, M) layout (markets on lanes): *local_cols* is this process's
+    band of market COLUMNS at full K height, sharded ``P(sources, markets)``.
+    """
+    sharding = NamedSharding(mesh, P(SOURCES_AXIS, MARKETS_AXIS))
+    global_shape = (local_cols.shape[0], num_markets)
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_cols), global_shape
+    )
+
+
 def local_view(array: jax.Array) -> np.ndarray:
     """This process's rows of a markets-sharded array, in global row order.
 

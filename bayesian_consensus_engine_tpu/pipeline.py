@@ -58,9 +58,11 @@ class SettlementPlan:
 
     The plan is **immutable after build** — ``build_settlement_plan`` marks
     every array read-only, because ``settle`` caches device copies of
-    ``slot_rows``/``probs``/``mask`` on the plan (keyed by dtype) to skip
-    the host→device re-upload on repeat settlements; a mutated host array
-    would silently diverge from its cached device twin.
+    ``slot_rows``/``probs``/``mask`` on the plan (keyed by dtype) and
+    ``settle_sharded`` caches its padded band + sharded device arrays
+    (keyed by mesh and dtype) to skip the host→device re-upload on repeat
+    settlements; a mutated host array would silently diverge from its
+    cached device twin.
     """
 
     market_keys: list[str]        # row → market id (payload order)
@@ -236,6 +238,47 @@ def _settle_math(
     return new_rel, new_conf, new_days, new_exists, consensus
 
 
+def _check_plan(store, plan: SettlementPlan, outcomes: Sequence[bool]) -> None:
+    """Shared settle-entry validation: outcome count + plan↔store binding."""
+    if len(outcomes) != plan.num_markets:
+        raise ValueError(
+            f"{len(outcomes)} outcomes for {plan.num_markets} planned markets"
+        )
+    if plan.mask.any() and int(plan.slot_rows.max()) >= len(store):
+        # A plan built against a different (or rebuilt) store: the gather
+        # would clamp onto the sink row and silently corrupt results.
+        raise ValueError(
+            f"plan references row {int(plan.slot_rows.max())} but the store "
+            f"holds {len(store)} pairs — was the plan built for this store?"
+        )
+    if plan.binding:
+        probe_rows = store.rows_for_pairs(
+            [(source_id, market_id) for _, source_id, market_id in plan.binding],
+            allocate=False,
+        )
+        for (row, source_id, market_id), got in zip(plan.binding, probe_rows):
+            if int(got) != row:
+                raise ValueError(
+                    f"plan is bound to a different store: ({source_id!r}, "
+                    f"{market_id!r}) does not intern to row {row} here"
+                )
+
+
+def _replay_confidences(store, touched_rows, conf_exact, steps: int) -> None:
+    """Overwrite settled confidences with the exact host-replayed trajectory.
+
+    XLA fuses the confidence growth's multiply-add into an FMA (one rounding
+    where the scalar contract has two); the trajectory is data-independent —
+    one growth step per settled cycle — so the host reproduces it bit-exactly
+    regardless of device precision and overwrites.
+    """
+    for _ in range(steps):
+        conf_exact = np.minimum(
+            1.0, conf_exact + (1.0 - conf_exact) * CONFIDENCE_GROWTH_RATE
+        )
+    store.overwrite_confidences(touched_rows, conf_exact)
+
+
 _settle_kernel = None
 
 
@@ -274,28 +317,7 @@ def settle(
         DeviceReliabilityState,
     )
 
-    if len(outcomes) != plan.num_markets:
-        raise ValueError(
-            f"{len(outcomes)} outcomes for {plan.num_markets} planned markets"
-        )
-    if plan.mask.any() and int(plan.slot_rows.max()) >= len(store):
-        # A plan built against a different (or rebuilt) store: the gather
-        # would clamp onto the sink row and silently corrupt results.
-        raise ValueError(
-            f"plan references row {int(plan.slot_rows.max())} but the store "
-            f"holds {len(store)} pairs — was the plan built for this store?"
-        )
-    if plan.binding:
-        probe_rows = store.rows_for_pairs(
-            [(source_id, market_id) for _, source_id, market_id in plan.binding],
-            allocate=False,
-        )
-        for (row, source_id, market_id), got in zip(plan.binding, probe_rows):
-            if int(got) != row:
-                raise ValueError(
-                    f"plan is bound to a different store: ({source_id!r}, "
-                    f"{market_id!r}) does not intern to row {row} here"
-                )
+    _check_plan(store, plan, outcomes)
 
     # Capture pre-settle confidences: the post-settle values are replayed
     # host-side in exact scalar arithmetic (see overwrite_confidences — XLA
@@ -339,14 +361,172 @@ def settle(
     store.absorb(
         DeviceReliabilityState(rel, conf, days, exists), epoch0
     )
-    for _ in range(steps):
-        conf_exact = np.minimum(
-            1.0, conf_exact + (1.0 - conf_exact) * CONFIDENCE_GROWTH_RATE
-        )
-    store.overwrite_confidences(touched_rows, conf_exact)
+    _replay_confidences(store, touched_rows, conf_exact, steps)
     return SettlementResult(
         market_keys=plan.market_keys,
         consensus=np.asarray(consensus),
+    )
+
+
+def settle_sharded(
+    store,
+    plan: SettlementPlan,
+    outcomes: Sequence[bool],
+    mesh,
+    steps: int = 1,
+    now: Optional[float] = None,
+    dtype=None,
+) -> SettlementResult:
+    """Markets-sharded end-to-end settlement over a device *mesh*.
+
+    The distributed twin of :func:`settle` for one LOGICAL store: because a
+    (source, market) pair belongs to exactly one market, the slot-major
+    (K, M) settlement block sharded over the markets axis is a *complete*
+    partition of every row the settlement touches — so the gather/scatter
+    happens at the host boundary, per process band, and the device runs the
+    production sharded loop (``parallel.sharded.build_cycle_loop``) with
+    zero cross-market communication. This replaces the reference's
+    whole-store sweep + per-pair update (reference: market.py:200-221,
+    reliability.py:185-231) sharded across a TPU mesh.
+
+    Multi-process: each process feeds only its own band of market columns
+    (``process_market_rows``) via ``global_slot_block`` — no host ever
+    materialises the full block — and absorbs back exactly its band's rows
+    (its shard of the store). The returned result covers THIS process's
+    band of markets (single-process: all of them). ``plan``/``outcomes``
+    are indexed globally on every process.
+
+    Numerics: identical elementwise ops and per-market reduction order as
+    :func:`settle`, so results and post-settle store state are bit-identical
+    to the single-device path at the same dtype — on a markets-only mesh.
+    A sources-sharded (2-D) mesh splits each market's slot reduction into a
+    ``psum`` of per-shard partial sums, a different (deterministic) float
+    association: equal to ~1 ulp, not bitwise.
+    """
+    import jax.numpy as jnp
+
+    from bayesian_consensus_engine_tpu.parallel.distributed import (
+        global_market,
+        global_slot_block,
+        local_view,
+        process_market_rows,
+    )
+    from bayesian_consensus_engine_tpu.parallel.mesh import (
+        MARKETS_AXIS,
+        SOURCES_AXIS,
+    )
+    from bayesian_consensus_engine_tpu.parallel.sharded import (
+        MarketBlockState,
+        build_cycle_loop,
+    )
+    from bayesian_consensus_engine_tpu.utils.config import (
+        DEFAULT_RELIABILITY as _REL0,
+        DEFAULT_CONFIDENCE as _CONF0,
+    )
+    from bayesian_consensus_engine_tpu.utils.dtypes import default_float_dtype
+    from bayesian_consensus_engine_tpu.utils.timeconv import NEVER
+
+    _check_plan(store, plan, outcomes)
+    cdtype = dtype or default_float_dtype()
+    num_markets = plan.num_markets
+
+    # Pad + band + upload of the static plan arrays is deterministic per
+    # (mesh, dtype): cached on the frozen plan like settle()'s device cache,
+    # so repeat settlements re-upload only the outcomes vector.
+    cache = getattr(plan, "_sharded_cache", None)
+    cache_key = (mesh, str(cdtype))
+    if cache is None or cache[0] != cache_key:
+        markets_extent = mesh.shape[MARKETS_AXIS]
+        sources_extent = mesh.shape[SOURCES_AXIS]
+        padded_total = (
+            -(-max(num_markets, 1) // markets_extent) * markets_extent
+        )
+        pad = padded_total - num_markets
+        num_slots = plan.num_slots
+        pad_k = (
+            -(-max(num_slots, 1) // sources_extent) * sources_extent
+            - num_slots
+        )
+
+        def pad_cols(array, fill):
+            return np.pad(
+                array, ((0, pad_k), (0, pad)), constant_values=fill
+            )
+
+        # This process's band of market columns — its shard of the work AND
+        # of the store's touched rows.
+        lo, hi = process_market_rows(padded_total, mesh)
+        band_rows = pad_cols(plan.slot_rows, -1)[:, lo:hi]
+        band_mask = pad_cols(plan.mask, False)[:, lo:hi]
+        probs_g = global_slot_block(
+            pad_cols(plan.probs, 0.0)[:, lo:hi].astype(cdtype),
+            mesh, padded_total,
+        )
+        mask_g = global_slot_block(band_mask, mesh, padded_total)
+        cache = (
+            cache_key, padded_total, pad, lo, hi,
+            band_rows, band_mask, probs_g, mask_g,
+        )
+        object.__setattr__(plan, "_sharded_cache", cache)
+    (_, padded_total, pad, lo, hi,
+     band_rows, band_mask, probs_g, mask_g) = cache
+    safe = np.where(band_rows >= 0, band_rows, 0)
+
+    touched_rows = band_rows[band_mask]
+    conf_exact = store.host_confidences(touched_rows)
+    epoch0 = store.epoch_origin()
+
+    host_rel, host_conf, host_days, host_exists = store.host_rows(safe)
+    state = MarketBlockState(
+        reliability=global_slot_block(
+            np.where(band_mask, host_rel, _REL0).astype(cdtype),
+            mesh, padded_total,
+        ),
+        confidence=global_slot_block(
+            np.where(band_mask, host_conf, _CONF0).astype(cdtype),
+            mesh, padded_total,
+        ),
+        updated_days=global_slot_block(
+            np.where(
+                band_mask & (host_days > NEVER), host_days - epoch0, 0.0
+            ).astype(cdtype),
+            mesh, padded_total,
+        ),
+        exists=global_slot_block(
+            band_mask & host_exists, mesh, padded_total
+        ),
+    )
+    outcome_p = np.pad(
+        np.asarray(outcomes, dtype=bool), (0, pad), constant_values=False
+    )
+    outcome_g = global_market(outcome_p[lo:hi], mesh, padded_total)
+
+    now_abs = _now_days() if now is None else now
+    loop = build_cycle_loop(mesh, slot_major=True, donate=True)
+    new_state, consensus = loop(
+        probs_g, mask_g, outcome_g, state,
+        jnp.asarray(now_abs - epoch0, dtype=cdtype), steps,
+    )
+
+    # Host boundary out: this band's columns only, scattered back into the
+    # store's flat rows (a permutation write — one slot per pair).
+    store.absorb_rows(
+        touched_rows,
+        local_view(new_state.reliability)[band_mask],
+        local_view(new_state.confidence)[band_mask],
+        local_view(new_state.updated_days)[band_mask],
+        local_view(new_state.exists)[band_mask],
+        epoch0,
+    )
+    _replay_confidences(store, touched_rows, conf_exact, steps)
+
+    # A band can lie entirely in padding (more band capacity than markets):
+    # clamp so keys and consensus stay aligned (and possibly empty).
+    band_stop = min(hi, num_markets)
+    live = max(0, band_stop - lo)
+    return SettlementResult(
+        market_keys=plan.market_keys[lo:band_stop],
+        consensus=np.asarray(local_view(consensus))[:live],
     )
 
 

@@ -313,6 +313,21 @@ class TensorReliabilityStore:
         """Exact f64 host confidences for *rows* (a copy; defaults when cold)."""
         return self._conf[rows].copy()
 
+    def host_rows(
+        self, rows: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Raw exact host state for flat *rows*: (rel, conf, days, exists).
+
+        Fancy-indexed copies, no cold-start defaulting — the sharded settle
+        path's gather (it applies its own masking/defaults per slot).
+        """
+        return (
+            self._rel[rows],
+            self._conf[rows],
+            self._days[rows],
+            self._exists[rows],
+        )
+
     def overwrite_confidences(self, rows: np.ndarray, values: np.ndarray) -> None:
         """Replace confidences for *rows* with exact host-computed values.
 
@@ -352,8 +367,7 @@ class TensorReliabilityStore:
         dtype = dtype or default_float_dtype()
         used = len(self._pairs)
         stamps = self._days[:used]
-        live = stamps[stamps > NEVER]
-        epoch0 = float(live.min()) - 1.0 if live.size else 0.0
+        epoch0 = self.epoch_origin()
         relative = np.where(stamps > NEVER, stamps - epoch0, 0.0)
 
         state = DeviceReliabilityState(
@@ -367,6 +381,13 @@ class TensorReliabilityStore:
         self._device_cache = (state, epoch0)
         return self._device_cache
 
+    def epoch_origin(self) -> float:
+        """The epoch-days origin for relative device stamps (min live −1)."""
+        used = len(self._pairs)
+        stamps = self._days[:used]
+        live = stamps[stamps > NEVER]
+        return float(live.min()) - 1.0 if live.size else 0.0
+
     def absorb(self, state: DeviceReliabilityState, epoch0: float) -> None:
         """Write a mutated device pytree back into host-authoritative state.
 
@@ -374,23 +395,61 @@ class TensorReliabilityStore:
         device stamp; all other sidecar strings are preserved exactly (so an
         import→export round trip without updates is byte-identical).
         """
-        from bayesian_consensus_engine_tpu.utils.timeconv import days_to_iso
-
         used = len(self._pairs)
-        new_rel = np.asarray(state.reliability, dtype=np.float64)
-        new_conf = np.asarray(state.confidence, dtype=np.float64)
-        new_days_rel = np.asarray(state.updated_days, dtype=np.float64)
-        new_exists = np.asarray(state.exists, dtype=bool)
+        new_rel = np.asarray(state.reliability)
         if len(new_rel) != used:
             raise ValueError(
                 f"device state has {len(new_rel)} rows, store has {used}"
             )
-        new_days = np.where(new_days_rel > 0, new_days_rel + epoch0, NEVER)
+        self._merge_device_rows(
+            slice(0, used),
+            new_rel,
+            np.asarray(state.confidence),
+            np.asarray(state.updated_days),
+            np.asarray(state.exists, dtype=bool),
+            epoch0,
+        )
+
+    def absorb_rows(
+        self,
+        rows: np.ndarray,
+        reliability: np.ndarray,
+        confidence: np.ndarray,
+        updated_days: np.ndarray,
+        exists: np.ndarray,
+        epoch0: float,
+    ) -> None:
+        """Absorb device results for a subset of flat rows (sharded settle).
+
+        Same merge semantics as :meth:`absorb`, but touching only *rows* —
+        the host boundary of the markets-sharded settlement path, where each
+        process reads back exactly its band's (market, source) rows. *rows*
+        must be unique (the settlement plan guarantees one slot per pair).
+        """
+        self._merge_device_rows(
+            np.asarray(rows),
+            np.asarray(reliability),
+            np.asarray(confidence),
+            np.asarray(updated_days),
+            np.asarray(exists, dtype=bool),
+            epoch0,
+        )
+
+    def _merge_device_rows(
+        self, idx, new_rel, new_conf, new_days_rel, new_exists, epoch0
+    ) -> None:
+        """Shared device→host merge. ``idx`` selects host rows: a ZERO-BASED
+        slice (whose positions are then the row numbers) or a unique row
+        array."""
+        from bayesian_consensus_engine_tpu.utils.timeconv import days_to_iso
 
         # The device may run float32; an untouched row's value round-trips
         # through f32 and must NOT clobber the exact f64 host value. Overwrite
         # only where the value changed *in device precision*.
-        device_dtype = np.asarray(state.reliability).dtype
+        device_dtype = new_rel.dtype
+        new_days = np.where(
+            new_days_rel > 0, new_days_rel.astype(np.float64) + epoch0, NEVER
+        )
 
         def merge(host: np.ndarray, new: np.ndarray) -> np.ndarray:
             changed = new != host.astype(device_dtype)
@@ -398,19 +457,23 @@ class TensorReliabilityStore:
 
         # A row's stamp changed iff its relative device stamp differs from the
         # host stamp re-expressed relative to epoch0 (in device precision).
+        host_days = self._days[idx]
         host_relative = np.where(
-            self._days[:used] > NEVER, self._days[:used] - epoch0, 0.0
+            host_days > NEVER, host_days - epoch0, 0.0
         ).astype(device_dtype)
-        stamps_changed = np.asarray(state.updated_days) != host_relative
+        stamps_changed = new_days_rel != host_relative
 
-        self._rel[:used] = merge(self._rel[:used], new_rel)
-        self._conf[:used] = merge(self._conf[:used], new_conf)
-        self._days[:used] = np.where(stamps_changed, new_days, self._days[:used])
-        self._exists[:used] = new_exists
+        self._rel[idx] = merge(self._rel[idx], new_rel)
+        self._conf[idx] = merge(self._conf[idx], new_conf)
+        self._days[idx] = np.where(stamps_changed, new_days, host_days)
+        self._exists[idx] = new_exists
         # A settlement stamps every touched row with the same handful of day
         # values, so format each UNIQUE stamp once instead of running the
         # datetime formatter per row (it dominated absorb at 500k rows).
-        changed_rows = np.nonzero(stamps_changed)[0]
+        changed_rows = (
+            np.nonzero(stamps_changed)[0] if isinstance(idx, slice)
+            else idx[stamps_changed]
+        )
         if changed_rows.size:
             uniq, inverse = np.unique(
                 self._days[changed_rows], return_inverse=True

@@ -14,6 +14,7 @@ import dataclasses
 import math
 import random
 
+import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
@@ -25,6 +26,7 @@ from bayesian_consensus_engine_tpu.pipeline import (
     build_settlement_plan,
     settle,
     settle_payloads,
+    settle_sharded,
 )
 from bayesian_consensus_engine_tpu.state.sqlite_store import SQLiteReliabilityStore
 from bayesian_consensus_engine_tpu.state.tensor_store import TensorReliabilityStore
@@ -177,6 +179,136 @@ class TestSettlementParity:
         }
         assert records[("a", "other")] == untouched
         assert records[("a", "m")].reliability == 0.6  # 0.5 + capped step
+
+
+class TestShardedSettle:
+    """The markets-sharded end-to-end settlement path (settle_sharded).
+
+    One logical store, block sharded over the mesh's markets axis, gather/
+    scatter at the host boundary per band. On a markets-only mesh results
+    and post-settle state must equal the single-device path BIT-FOR-BIT
+    (same elementwise ops, same per-market reduction order); a 2-D
+    (sources-sharded) mesh psums per-shard partials — a different float
+    association — so that layout is compared at 1-ulp tolerance.
+    Match: reference market.py:200-221 + reliability.py:185-231 (the
+    whole-store sweep this replaces, here over 8 virtual devices).
+    """
+
+    NOW = 20300.0
+
+    def _payloads(self, num_markets=21):
+        rng = random.Random(5)
+        payloads = random_payloads(rng, num_markets=num_markets, universe=10)
+        payloads[3] = ("market-3", [])  # empty market: NaN consensus, no rows
+        outcomes = [rng.random() < 0.5 for _ in payloads]
+        return payloads, outcomes
+
+    def _seeded_store(self, payloads):
+        """Store with pre-existing (decay-eligible) rows for half the pairs."""
+        store = TensorReliabilityStore()
+        rng = random.Random(99)
+        for market_id, signals in payloads[:10]:
+            for sig in signals[:2]:
+                record = store.get_reliability(sig["sourceId"], market_id)
+                store.put_record(dataclasses.replace(
+                    record,
+                    reliability=round(rng.random(), 6),
+                    confidence=round(rng.random(), 6),
+                    updated_at="2026-07-15T00:00:00+00:00",
+                ))
+        return store
+
+    def _settle_both(self, mesh_shape, steps=3):
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+
+        payloads, outcomes = self._payloads()
+        single = self._seeded_store(payloads)
+        sharded = self._seeded_store(payloads)
+        ref = settle(
+            single, build_settlement_plan(single, payloads), outcomes,
+            steps=steps, now=self.NOW,
+        )
+        got = settle_sharded(
+            sharded, build_settlement_plan(sharded, payloads), outcomes,
+            make_mesh(mesh_shape), steps=steps, now=self.NOW,
+        )
+        return single, sharded, ref, got
+
+    def test_markets_mesh_bit_identical(self):
+        single, sharded, ref, got = self._settle_both((8, 1))
+        assert got.market_keys == ref.market_keys
+        assert np.array_equal(got.consensus, ref.consensus, equal_nan=True)
+        assert sharded.list_sources() == single.list_sources()
+
+    def test_two_axis_mesh_ulp_close(self):
+        single, sharded, ref, got = self._settle_both((4, 2))
+        assert got.market_keys == ref.market_keys
+        np.testing.assert_allclose(
+            got.consensus, ref.consensus, rtol=2e-6, atol=1e-7
+        )
+        for mine, theirs in zip(sharded.list_sources(), single.list_sources()):
+            assert (mine.source_id, mine.market_id) == (
+                theirs.source_id, theirs.market_id)
+            assert mine.reliability == pytest.approx(theirs.reliability, abs=1e-6)
+            # Confidence is host-replayed exactly on both paths.
+            assert mine.confidence == theirs.confidence
+            assert mine.updated_at == theirs.updated_at
+
+    def test_markets_mesh_bit_identical_x64(self):
+        with enable_x64():
+            single, sharded, ref, got = self._settle_both((8, 1), steps=2)
+            assert np.array_equal(got.consensus, ref.consensus, equal_nan=True)
+            assert sharded.list_sources() == single.list_sources()
+
+    def test_matches_scalar_settlement_x64(self):
+        """Full chain: sharded device path vs the reference-semantics oracle."""
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+
+        payloads, outcomes = self._payloads()
+        with enable_x64():
+            store = TensorReliabilityStore()
+            plan = build_settlement_plan(store, payloads)
+            settle_sharded(
+                store, plan, outcomes, make_mesh(), steps=2, now=now_days()
+            )
+        oracle = SQLiteReliabilityStore(":memory:")
+        scalar_settle(oracle, payloads, outcomes, steps=2)
+        assert_records_match(store.list_sources(), oracle.list_sources())
+
+    def test_plan_reuse_hits_sharded_cache(self):
+        """Repeat settlements reuse the plan's padded/sharded device arrays
+        (only outcomes re-upload) and keep matching the chained single path."""
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+
+        payloads, outcomes = self._payloads()
+        single = self._seeded_store(payloads)
+        sharded = self._seeded_store(payloads)
+        plan_s = build_settlement_plan(single, payloads)
+        plan_m = build_settlement_plan(sharded, payloads)
+        mesh = make_mesh()
+        settle(single, plan_s, outcomes, steps=1, now=self.NOW)
+        settle_sharded(sharded, plan_m, outcomes, mesh, steps=1, now=self.NOW)
+        cache = plan_m._sharded_cache
+        flipped = [not o for o in outcomes]
+        ref = settle(single, plan_s, flipped, steps=1, now=self.NOW + 1)
+        got = settle_sharded(
+            sharded, plan_m, flipped, mesh, steps=1, now=self.NOW + 1
+        )
+        assert plan_m._sharded_cache is cache  # reused, not rebuilt
+        assert len(got.market_keys) == len(got.consensus)
+        assert np.array_equal(got.consensus, ref.consensus, equal_nan=True)
+        assert sharded.list_sources() == single.list_sources()
+
+    def test_plan_binding_still_enforced(self):
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+
+        payloads, outcomes = self._payloads()
+        store = self._seeded_store(payloads)
+        plan = build_settlement_plan(store, payloads)
+        other = TensorReliabilityStore()
+        build_settlement_plan(other, list(reversed(payloads)))
+        with pytest.raises(ValueError, match="bound to a different store"):
+            settle_sharded(other, plan, outcomes, make_mesh())
 
 
 class TestPipelineScale:
