@@ -14,7 +14,10 @@ from bayesian_consensus_engine_tpu.state import (
     ReliabilityRecord,
     SQLiteReliabilityStore,
 )
-from bayesian_consensus_engine_tpu.state.tensor_store import TensorReliabilityStore
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    DeviceReliabilityState,
+    TensorReliabilityStore,
+)
 from bayesian_consensus_engine_tpu.utils.timeconv import iso_to_days
 
 
@@ -178,6 +181,97 @@ class TestCrossBackendEquivalence:
             (r.source_id, r.market_id, r.reliability, r.confidence) for r in b
         ]
         sqlite_store.close()
+
+
+class TestIncrementalFlush:
+    """Dirty-row checkpointing: flush cost scales with touched rows.
+
+    Reference semantics: each update UPSERTs only the row it changed
+    (reference: reliability.py:221-231); a full-store rewrite per checkpoint
+    was the round-2 e2e bottleneck.
+    """
+
+    def _seeded(self, n=50):
+        store = TensorReliabilityStore()
+        store.batch_update_reliability(
+            [(f"s{i}", f"m{i % 7}") for i in range(n)], [True] * n
+        )
+        return store
+
+    def test_second_flush_writes_only_dirty_rows(self, tmp_path):
+        db = tmp_path / "ckpt.db"
+        store = self._seeded()
+        assert store.flush_to_sqlite(db) == 50  # first flush: full
+        store.update_reliability("s3", "m3", False)
+        store.update_reliability("s9", "m2", True)
+        assert store.flush_to_sqlite(db) == 2  # same target: dirty only
+        # The file equals a full flush of the same state.
+        reloaded = TensorReliabilityStore.from_sqlite(db)
+        assert reloaded.list_sources() == store.list_sources()
+
+    def test_new_target_falls_back_to_full(self, tmp_path):
+        store = self._seeded()
+        store.flush_to_sqlite(tmp_path / "a.db")
+        store.update_reliability("s1", "m1", True)
+        # Different file: auto mode must write the complete store.
+        assert store.flush_to_sqlite(tmp_path / "b.db") == 50
+        reloaded = TensorReliabilityStore.from_sqlite(tmp_path / "b.db")
+        assert reloaded.list_sources() == store.list_sources()
+
+    def test_forced_incremental_to_wrong_target_raises(self, tmp_path):
+        store = self._seeded()
+        store.flush_to_sqlite(tmp_path / "a.db")
+        with pytest.raises(ValueError, match="incomplete checkpoint"):
+            store.flush_to_sqlite(tmp_path / "b.db", incremental=True)
+
+    def test_resume_from_sqlite_flushes_incrementally(self, tmp_path):
+        """Load → settle-ish update → flush back: only the delta is written."""
+        db = tmp_path / "ckpt.db"
+        self._seeded().flush_to_sqlite(db)
+        resumed = TensorReliabilityStore.from_sqlite(db)
+        resumed.update_reliability("s11", "m4", True)
+        assert resumed.flush_to_sqlite(db) == 1
+        assert (
+            TensorReliabilityStore.from_sqlite(db).list_sources()
+            == resumed.list_sources()
+        )
+
+    def test_absorb_marks_only_changed_rows_dirty(self, tmp_path):
+        db = tmp_path / "ckpt.db"
+        store = self._seeded()
+        store.flush_to_sqlite(db)
+        state, epoch0 = store.device_state()
+        # Mutate exactly one row on the "device"; absorb back.
+        import numpy as np
+
+        rel = np.asarray(state.reliability).copy()
+        days = np.asarray(state.updated_days).copy()
+        rel[7] = 0.123
+        days[7] = days[7] + 1.0
+        store.absorb(
+            DeviceReliabilityState(
+                rel, np.asarray(state.confidence), days, np.asarray(state.exists)
+            ),
+            epoch0,
+        )
+        assert store.flush_to_sqlite(db) == 1
+
+    def test_deleted_target_falls_back_to_full(self, tmp_path):
+        """A rotated/removed checkpoint file must get a full rewrite, not a
+        silently-truncated delta."""
+        db = tmp_path / "ckpt.db"
+        store = self._seeded()
+        store.flush_to_sqlite(db)
+        db.unlink()
+        store.update_reliability("s1", "m1", True)
+        assert store.flush_to_sqlite(db) == 50  # full, despite same path
+        reloaded = TensorReliabilityStore.from_sqlite(db)
+        assert reloaded.list_sources() == store.list_sources()
+
+    def test_memory_db_never_incremental(self):
+        store = self._seeded()
+        assert store.flush_to_sqlite(":memory:") == 50
+        assert store.flush_to_sqlite(":memory:") == 50  # still full
 
 
 class TestBatchFailureConsistency:
