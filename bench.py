@@ -56,11 +56,18 @@ REFERENCE_BASELINE_CYCLES_PER_SEC = 0.0027102
 NUM_MARKETS = 1_000_000
 SLOTS_PER_MARKET = 16
 SOURCE_UNIVERSE = 10_000
-TIMED_STEPS = 100
+# Step count amortises the axon tunnel's ~96 ms dispatch+fence round trip
+# (measured: a jitted 8-element add costs 95.7 ms end-to-end; see
+# scripts/perf_floor2.py + docs/tpu-architecture.md). At 100 steps the
+# dispatch dominated (~1 ms/step of pure RTT — round 2's misattributed
+# "1.1 ms/step floor"); at 1600 it is ~6% of the total. The marginal
+# kernel rate is reported separately in extras via a two-point fit.
+TIMED_STEPS = 1600
+FIT_STEPS = 400  # second point for the fixed-vs-marginal decomposition
 
 LARGE_K_MARKETS = 16_384
 LARGE_K_SLOTS = 10_000
-LARGE_K_STEPS = 20
+LARGE_K_STEPS = 50
 
 
 def build_workload(key, num_markets, slots, dtype):
@@ -309,7 +316,28 @@ def bench_pallas(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
     )
 
 
-def bench_stream_probe(steps=100):
+def bench_dispatch_rtt(trials=5):
+    """Pure tunnel dispatch+fence round trip: a jitted 8-element add.
+
+    This is the fixed cost every dispatch pays through the axon tunnel —
+    the denominator correction for every other number here (measured
+    ~96 ms on this host, i.e. 1600 amortising steps put it at ~6%).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: x + 1.0)
+    a = jnp.zeros((8,), jnp.float32)
+    _fence(tiny(a))
+    best = float("inf")
+    for _ in range(trials):
+        start = time.perf_counter()
+        _fence(tiny(a))
+        best = min(best, time.perf_counter() - start)
+    return best * 1e3
+
+
+def bench_stream_probe(steps=400):
     """Live streaming roofline: read+write two f32 blocks per step (GB/s).
 
     The axon tunnel's delivered bandwidth varies run to run (measured
@@ -527,6 +555,10 @@ def run():
     # Side measurements must never sink the bench (or the headline metric):
     # report a failure string instead.
     try:
+        dispatch_rtt = round(bench_dispatch_rtt(), 2)
+    except Exception as exc:  # noqa: BLE001
+        dispatch_rtt = f"failed: {type(exc).__name__}"
+    try:
         stream_gbs = round(bench_stream_probe(), 1)
     except Exception as exc:  # noqa: BLE001
         stream_gbs = f"failed: {type(exc).__name__}"
@@ -534,6 +566,32 @@ def run():
         compact = bench_compact()
     except Exception as exc:  # noqa: BLE001
         compact = f"failed: {type(exc).__name__}"
+    # Two-point decomposition: total(steps) = fixed_dispatch + steps·marginal.
+    # The sustained (dispatch-free) kernel rate is the number a long-running
+    # settlement service sees — chained dispatches pipeline to ~one RTT
+    # (measured, scripts/perf_floor2.py).
+    try:
+        compact_small = bench_compact(timed_steps=FIT_STEPS)
+        t_big = TIMED_STEPS / compact
+        t_small = FIT_STEPS / compact_small
+        marginal_s = (t_big - t_small) / (TIMED_STEPS - FIT_STEPS)
+        if marginal_s <= 0:
+            # Tunnel variance between the two runs swamped the kernel term;
+            # publish the degeneracy, not a negative rate.
+            compact_fit = (
+                f"fit degenerate (t_{FIT_STEPS}={t_small * 1e3:.1f}ms, "
+                f"t_{TIMED_STEPS}={t_big * 1e3:.1f}ms)"
+            )
+        else:
+            compact_fit = {
+                "fixed_dispatch_ms": round(
+                    (t_small - FIT_STEPS * marginal_s) * 1e3, 1
+                ),
+                "marginal_ms_per_step": round(marginal_s * 1e3, 4),
+                "sustained_cycles_per_sec": round(1.0 / marginal_s, 1),
+            }
+    except Exception as exc:  # noqa: BLE001
+        compact_fit = f"failed: {type(exc).__name__}"
     # The metric is the cycle, not one implementation of it: report the
     # fastest valid path (compact int8 counters vs bit-exact f32 fast
     # loop), with both numbers and the winner recorded in extras.
@@ -584,6 +642,8 @@ def run():
         "vs_baseline": round(headline / REFERENCE_BASELINE_CYCLES_PER_SEC, 1),
         "extras": {
             "stream_probe_gbs": stream_gbs,
+            "dispatch_rtt_ms": dispatch_rtt,
+            "compact_dispatch_fit": compact_fit,
             "headline_source": headline_source,
             "headline_numeric_contract": headline_contract,
             "f32_fast_loop_cycles_per_sec": round(f32_fast, 1),
@@ -610,13 +670,17 @@ def run():
             "tiebreak_10k_agents": tiebreak,
             "per_slot_throughput": slot_updates,
             "notes": (
-                "the axon tunnel's delivered bandwidth varies run to run "
-                "(~140-410 GB/s measured); stream_probe_gbs is the live "
-                "roofline for normalising across rounds. The headline loop "
-                "drops the updated_days carry (21 B/slot/step, bit-exact); "
-                "compact_state carries int8 counters (9 B/slot/step, "
-                "f32-tolerance-equivalent). XLA fusion beats the "
-                "hand-fused Pallas kernel at 1M x 16"
+                "every dispatch through the axon tunnel pays ~dispatch_rtt_ms "
+                "of fixed round-trip cost (round 2's '1.1 ms/step floor' was "
+                "this RTT divided by 100 steps — resolved, see "
+                "docs/tpu-architecture.md); headline numbers amortise it over "
+                f"{TIMED_STEPS} in-jit steps and compact_dispatch_fit reports "
+                "the dispatch-free sustained kernel rate. stream_probe_gbs "
+                "is the live bandwidth denominator (tunnel-varying). The "
+                "headline loop drops the updated_days carry (21 B/slot/step, "
+                "bit-exact); compact_state carries int8 counters "
+                "(9 B/slot/step, f32-tolerance-equivalent). XLA fusion beats "
+                "the hand-fused Pallas kernel at 1M x 16"
             ),
         },
     }

@@ -1,0 +1,196 @@
+"""Characterize the compact loop's ~1.1 ms/step floor at 1M x 16 (VERDICT r2).
+
+Differential attribution, all in ONE process so the axon tunnel's run-to-run
+bandwidth swing cancels:
+
+  1. stream probe            — the live bandwidth denominator
+  2. baseline                — compact loop per-step at (1M, 16)
+  3. markets scaling         — M in {125k..4M}: throughput-bound would scale
+                               linearly, a fixed floor would not
+  4. slots scaling           — K in {1, 4, 16}: 16x fewer bytes at K=1
+  5. steps scaling           — per-step time must be step-count independent
+  6. fori unroll             — lax.fori_loop(unroll=k): if the floor is
+                               per-iteration sequencing overhead (scalar-core
+                               loop bookkeeping / kernel launch latency),
+                               unrolling amortises it; if bandwidth, it won't
+  7. counter-only body       — the mid-loop body after DCE is just the
+                               masked counter bump (consensus+decay feed only
+                               the discarded mid-loop consensus); measuring
+                               it standalone bounds the compute
+
+Run on the real TPU:  python scripts/perf_floor.py
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.parallel.compact import (
+    _compact_cycle_math,
+    init_compact_state,
+)
+from bayesian_consensus_engine_tpu.ops.decay import decayed_reliability_at
+from bayesian_consensus_engine_tpu.parallel.compact import decode_reliability
+
+
+def fence(x):
+    return float(jax.numpy.ravel(x)[0])
+
+
+def build_workload(m, k, seed=0):
+    kp, km, ko = jax.random.split(jax.random.PRNGKey(seed), 3)
+    probs = jax.random.uniform(kp, (k, m), dtype=jnp.float32)
+    mask = jax.random.uniform(km, (k, m)) < 0.9
+    outcome = jax.random.uniform(ko, (m,)) < 0.5
+    return probs, mask, outcome
+
+
+def compact_loop_unrolled(steps: int, unroll: int):
+    """The production compact loop body with a configurable fori unroll."""
+
+    def loop_math(probs, mask, outcome, state, now0):
+        read0 = decayed_reliability_at(
+            decode_reliability(state.rel_steps),
+            state.updated_days,
+            now0 + 0,
+            state.conf_steps > 0,
+        )
+        rs, cs, consensus0 = _compact_cycle_math(
+            probs, mask, outcome, state.rel_steps, state.conf_steps, read0,
+            None, 0,
+        )
+
+        def fast_step(carry, now_i, prev_now):
+            rs, cs = carry
+            read_rel = decayed_reliability_at(
+                decode_reliability(rs),
+                jnp.broadcast_to(prev_now, rs.shape),
+                now_i,
+                jnp.asarray(True),
+            )
+            rs, cs, consensus = _compact_cycle_math(
+                probs, mask, outcome, rs, cs, read_rel, None, 0
+            )
+            return (rs, cs), consensus
+
+        if steps == 1:
+            return (rs, cs), consensus0
+
+        def body(i, carry):
+            new_carry, _ = fast_step(carry, now0 + i, now0 + (i - 1))
+            return new_carry
+
+        carry = jax.lax.fori_loop(1, steps - 1, body, (rs, cs), unroll=unroll)
+        return fast_step(carry, now0 + (steps - 1), now0 + (steps - 2))
+
+    return jax.jit(loop_math, donate_argnums=(3,))
+
+
+def counter_only_loop(steps: int, unroll: int = 1):
+    """Only the masked counter bump per step (the DCE'd-loop lower bound)."""
+
+    def loop_math(correct, mask, rs, cs):
+        def body(_i, carry):
+            rs, cs = carry
+            bump = jnp.where(correct, jnp.int8(1), jnp.int8(-1))
+            new_rs = jnp.clip(rs + bump, -5, 5).astype(jnp.int8)
+            new_cs = jnp.where(cs < 255, cs + jnp.uint8(1), cs)
+            return jnp.where(mask, new_rs, rs), jnp.where(mask, new_cs, cs)
+
+        return jax.lax.fori_loop(0, steps, body, (rs, cs), unroll=unroll)
+
+    return jax.jit(loop_math, donate_argnums=(2, 3))
+
+
+def time_loop(call, make_args, steps, trials=3):
+    out = call(*make_args())
+    fence(out[0][0] if isinstance(out[0], tuple) else out[0])
+    best = float("inf")
+    for _ in range(trials):
+        args = make_args()
+        t0 = time.perf_counter()
+        out = call(*args)
+        fence(out[0][0] if isinstance(out[0], tuple) else out[0])
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best
+
+
+def stream_probe(steps=100):
+    k, m = 16, 1_000_448
+
+    def loop(a, b):
+        return jax.lax.fori_loop(
+            0, steps, lambda i, c: (c[1] + 1.0, c[0] * 0.5), (a, b)
+        )
+
+    sl = jax.jit(loop, donate_argnums=(0, 1))
+
+    def fresh():
+        a = jnp.ones((k, m), jnp.float32)
+        b = jnp.ones((k, m), jnp.float32)
+        fence(a)
+        return a, b
+
+    best = time_loop(sl, fresh, steps)
+    return 4 * k * m * 4 / best / 1e9
+
+
+def main():
+    results = {"backend": jax.default_backend(),
+               "device": str(jax.devices()[0])}
+    results["stream_probe_gbs"] = round(stream_probe(), 1)
+
+    def run_shape(m, k, steps=100, unroll=1, seed=0):
+        probs, mask, outcome = build_workload(m, k, seed)
+        loop = compact_loop_unrolled(steps, unroll)
+
+        def make():
+            state = init_compact_state(m, k)
+            fence(state.updated_days)
+            return (probs, mask, outcome, state, jnp.float32(1.0))
+
+        return time_loop(loop, make, steps) * 1e3  # ms/step
+
+    # 2-4. shape scaling
+    results["markets_scaling_ms_per_step_k16"] = {
+        str(m): round(run_shape(m, 16), 4)
+        for m in (125_000, 250_000, 500_000, 1_000_448, 2_000_896)
+    }
+    results["slots_scaling_ms_per_step_1m"] = {
+        str(k): round(run_shape(1_000_448, k), 4) for k in (1, 4, 16)
+    }
+    # 5. steps scaling
+    results["steps_scaling_ms_per_step_1m_k16"] = {
+        str(s): round(run_shape(1_000_448, 16, steps=s), 4)
+        for s in (25, 100, 400)
+    }
+    # 6. unroll
+    results["unroll_ms_per_step_1m_k16"] = {
+        str(u): round(run_shape(1_000_448, 16, unroll=u), 4)
+        for u in (1, 2, 4, 8)
+    }
+    # 7. counter-only lower bound
+    probs, mask, outcome = build_workload(1_000_448, 16)
+    correct = (probs >= 0.5) == outcome[None, :]
+    for u in (1, 4):
+        loop = counter_only_loop(100, u)
+
+        def make():
+            state = init_compact_state(1_000_448, 16)
+            fence(state.updated_days)
+            return (correct, mask, state.rel_steps, state.conf_steps)
+
+        results[f"counter_only_ms_per_step_unroll{u}"] = round(
+            time_loop(loop, make, 100) * 1e3, 4
+        )
+
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Floor follow-up: isolate the ~100 ms fixed per-dispatch cost.
+
+perf_floor.py showed TOTAL wall time for the compact loop is ~constant
+(~102-132 ms) for steps in {25, 100, 400} at 1M x 16 — i.e. the "1.1 ms/step
+floor" is fixed dispatch+fence overhead divided by the step count, and the
+marginal kernel cost is ~0.08 ms/step. This script pins:
+
+  1. tunnel RTT            — a 1-element add, fenced: pure dispatch+fetch
+  2. steps=1 loop total    — fixed cost including our operand set
+  3. single-dispatch curve — steps in {100, 400, 1600}: linear fit gives
+                             (fixed, per-step) directly
+  4. chained dispatches    — D back-to-back loop calls threading the donated
+                             state, ONE fence at the end: if the tunnel
+                             queues asynchronously, D dispatches pay ~one
+                             RTT, and the production amortised rate is the
+                             kernel rate
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.parallel import (
+    build_compact_cycle_loop,
+    init_compact_state,
+)
+
+
+def fence(x):
+    return float(jnp.ravel(x)[0])
+
+
+M, K = 1_000_448, 16
+
+
+def workload():
+    kp, km, ko = jax.random.split(jax.random.PRNGKey(0), 3)
+    probs = jax.random.uniform(kp, (K, M), dtype=jnp.float32)
+    mask = jax.random.uniform(km, (K, M)) < 0.9
+    outcome = jax.random.uniform(ko, (M,)) < 0.5
+    return probs, mask, outcome
+
+
+def main():
+    results = {}
+
+    # 1. pure tunnel RTT
+    tiny = jax.jit(lambda x: x + 1.0)
+    a = jnp.zeros((8,), jnp.float32)
+    fence(tiny(a))
+    best = min(
+        (lambda t0: (fence(tiny(a)), time.perf_counter() - t0)[1])(
+            time.perf_counter()
+        )
+        for _ in range(5)
+    )
+    results["tiny_dispatch_rtt_ms"] = round(best * 1e3, 2)
+
+    probs, mask, outcome = workload()
+    loop = build_compact_cycle_loop(mesh=None, donate=True)
+
+    def fresh():
+        state = init_compact_state(M, K)
+        fence(state.updated_days)
+        return state
+
+    # 2-3. single-dispatch totals
+    totals = {}
+    for steps in (1, 100, 400, 1600):
+        _s, c = loop(probs, mask, outcome, fresh(), jnp.float32(1.0), steps)
+        fence(c)
+        best = float("inf")
+        for _ in range(3):
+            st = fresh()
+            t0 = time.perf_counter()
+            _s, c = loop(probs, mask, outcome, st, jnp.float32(1.0), steps)
+            fence(c)
+            best = min(best, time.perf_counter() - t0)
+        totals[str(steps)] = round(best * 1e3, 2)
+    results["single_dispatch_total_ms"] = totals
+    # linear fit on (400, 1600)
+    per_step = (totals["1600"] - totals["400"]) / (1600 - 400)
+    results["marginal_kernel_ms_per_step"] = round(per_step, 4)
+    results["implied_fixed_overhead_ms"] = round(
+        totals["400"] - 400 * per_step, 2
+    )
+
+    # 4. chained dispatches, one fence
+    for dispatches, steps in ((10, 100), (4, 400)):
+        state = fresh()
+        _s, c = loop(probs, mask, outcome, state, jnp.float32(1.0), steps)
+        fence(c)  # warm
+        best = float("inf")
+        for _ in range(3):
+            state = fresh()
+            t0 = time.perf_counter()
+            for d in range(dispatches):
+                state, c = loop(
+                    probs, mask, outcome, state,
+                    jnp.float32(1.0 + d * steps), steps,
+                )
+            fence(c)
+            best = min(best, time.perf_counter() - t0)
+        key = f"chained_{dispatches}x{steps}_ms_per_step"
+        results[key] = round(best / (dispatches * steps) * 1e3, 4)
+
+    results["implied_cycles_per_sec_at_1600"] = round(
+        1600 / (totals["1600"] / 1e3), 1
+    )
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()
