@@ -255,6 +255,26 @@ class SQLiteReliabilityStore:
             raise
         self._conn.execute("COMMIT")
 
+    def delete_rows(self, pairs: Iterable[tuple]) -> None:
+        """Delete ``(source_id, market_id)`` rows in one transaction.
+
+        The checkpoint-maintenance twin of :meth:`put_rows`: incremental
+        flushes use it to drop rows whose device state transitioned to
+        non-existing, so the file never resurrects rows the store has
+        retired. (The reference never deletes — its store has no
+        exists-flip — so this is additive surface, not a parity one.)
+        """
+        self._conn.execute("BEGIN")
+        try:
+            self._conn.executemany(
+                "DELETE FROM sources WHERE source_id = ? AND market_id = ?",
+                pairs,
+            )
+        except BaseException:
+            self._conn.execute("ROLLBACK")
+            raise
+        self._conn.execute("COMMIT")
+
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:

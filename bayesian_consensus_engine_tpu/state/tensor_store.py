@@ -582,6 +582,15 @@ class TensorReliabilityStore:
         select = self._exists[:used]
         if incremental:
             select = select & self._dirty[:used]
+        # Rows whose exists flag flipped False since the last flush (only
+        # reachable through absorb() of a mutated device state — no kernel
+        # does it, but the API allows it) must be DELETED from the file, or
+        # an incremental flush would strand the stale record forever.
+        dead = (
+            np.nonzero(self._dirty[:used] & ~self._exists[:used])[0].tolist()
+            if same_target
+            else []
+        )
         rows = np.nonzero(select)[0].tolist()
         # Everything below touches only the selected rows — an incremental
         # flush of a handful of settled rows must not pay O(store) anywhere,
@@ -604,6 +613,9 @@ class TensorReliabilityStore:
         )
         with SQLiteReliabilityStore(db_path) as sqlite_store:
             sqlite_store.put_rows(params)
+            if dead:
+                id_of = self._pairs.id_of
+                sqlite_store.delete_rows(id_of(r) for r in dead)
         if target is not None:
             self._dirty[:used] = False
             self._last_flush_path = target

@@ -2,11 +2,13 @@
 
 CI runs real ``ruff check .`` and ``mypy`` (see .github/workflows/ci.yml);
 neither tool is installed in the baked TPU image, so this script covers the
-highest-signal subset of the gated rules with ``ast`` only:
+highest-signal subset of the gated rules with ``ast`` + ``symtable`` only:
 
-  F401  module-level imports never referenced
+  F401  imports never referenced — module level AND function scope
   F541  f-string without any placeholders
   F811  redefinition of an imported name by a later import
+  F821  undefined name (referenced, bound in no enclosing scope, not a
+        builtin; skipped for files with wildcard imports)
   F841  local assigned and never used (simple ``x = ...`` targets only,
         matching ruff: loop variables and unpacking are not flagged)
   E711  ``== None`` / ``!= None`` comparisons
@@ -22,8 +24,16 @@ tests, and repo-root scripts). Exits 1 on findings.
 from __future__ import annotations
 
 import ast
+import builtins
 import pathlib
+import symtable
 import sys
+
+_BUILTIN_NAMES = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__annotations__",
+    "__path__", "__cached__", "__class__",
+}
 
 DEFAULT_PATHS = [
     "bayesian_consensus_engine_tpu",
@@ -47,7 +57,136 @@ def _names_loaded(tree: ast.AST) -> set[str]:
                 root = root.value
             if isinstance(root, ast.Name):
                 loaded.add(root.id)
+        elif isinstance(node, (ast.AnnAssign, ast.arg)):
+            # Quoted annotations ('decimal.Decimal') reference names too —
+            # ruff resolves them; parse the string as an expression.
+            loaded |= _annotation_names(node.annotation)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            loaded |= _annotation_names(node.returns)
     return loaded
+
+
+def _annotation_names(annotation) -> set[str]:
+    if not (
+        isinstance(annotation, ast.Constant)
+        and isinstance(annotation.value, str)
+    ):
+        return set()
+    try:
+        parsed = ast.parse(annotation.value, mode="eval")
+    except SyntaxError:
+        return set()
+    return _names_loaded(parsed)
+
+
+def _function_scope_unused_imports(
+    tree: ast.AST, path: pathlib.Path
+) -> list[str]:
+    """F401 inside function bodies (ruff flags these; module pass misses
+    them — the exact class the round-2 advisor caught in a test)."""
+    problems: list[str] = []
+
+    def visit(node: ast.AST, owner) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, child)
+                continue
+            if owner is not None and isinstance(
+                child, (ast.Import, ast.ImportFrom)
+            ):
+                if not (
+                    isinstance(child, ast.ImportFrom)
+                    and child.module == "__future__"
+                ):
+                    loaded = _names_loaded(owner)
+                    for alias in child.names:
+                        if alias.name == "*":
+                            continue
+                        name = (alias.asname or alias.name).split(".")[0]
+                        if name not in loaded and not (
+                            alias.asname is None and "." in alias.name
+                        ):
+                            problems.append(
+                                f"{path}:{child.lineno}: F401 {name!r} "
+                                f"imported but unused (in {owner.name})"
+                            )
+            visit(child, owner)
+
+    visit(tree, None)
+    return problems
+
+
+def _undefined_names(
+    src: str, tree: ast.AST, path: pathlib.Path
+) -> list[str]:
+    """F821: names referenced but bound in no enclosing scope.
+
+    ``symtable`` resolves scoping (locals, closures, globals, class
+    bodies, comprehensions); a GLOBAL_IMPLICIT reference with no module
+    binding and no builtin is a NameError waiting to run. Files with
+    wildcard imports are skipped (bindings unknowable statically).
+    """
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and any(
+            alias.name == "*" for alias in node.names
+        ):
+            return []
+    try:
+        table = symtable.symtable(src, str(path), "exec")
+    except SyntaxError:
+        return []
+
+    module_bound = {
+        s.get_name()
+        for s in table.get_symbols()
+        if s.is_assigned() or s.is_imported() or s.is_namespace()
+    }
+    # `global x` inside a function binds x at module scope at runtime.
+    declared_global: set[str] = set()
+
+    def collect_globals(t) -> None:
+        for s in t.get_symbols():
+            if s.is_declared_global() and s.is_assigned():
+                declared_global.add(s.get_name())
+        for child in t.get_children():
+            collect_globals(child)
+
+    collect_globals(table)
+    module_bound |= declared_global
+
+    undefined: set[str] = set()
+
+    def walk(t) -> None:
+        for s in t.get_symbols():
+            name = s.get_name()
+            if not s.is_referenced() or name in _BUILTIN_NAMES:
+                continue
+            if (
+                s.is_assigned() or s.is_imported() or s.is_parameter()
+                or s.is_free() or s.is_namespace()
+            ):
+                continue
+            if name not in module_bound:
+                undefined.add(name)
+        for child in t.get_children():
+            walk(child)
+
+    walk(table)
+    if not undefined:
+        return []
+    # Attach line numbers from the first Load of each name.
+    first_load: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in undefined
+        ):
+            first_load.setdefault(node.id, node.lineno)
+    return [
+        f"{path}:{first_load.get(name, 1)}: F821 undefined name {name!r}"
+        for name in sorted(undefined)
+    ]
 
 
 def check_file(path: pathlib.Path) -> list[str]:
@@ -62,6 +201,8 @@ def check_file(path: pathlib.Path) -> list[str]:
         return 0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]
 
     problems: list[str] = []
+    problems += _function_scope_unused_imports(tree, path)
+    problems += _undefined_names(src, tree, path)
     loaded = _names_loaded(tree)
     # format_spec of f"{x:,}" is itself a JoinedStr; exclude those from F541.
     format_specs = {

@@ -1,0 +1,119 @@
+"""The executed lint gate itself: scripts/devlint.py rule coverage.
+
+devlint is the lint gate that actually RUNS in this offline environment
+(ruff/mypy execute only in hosted CI — they are not installed in the
+image), so its rules need the same kind of pinning as any other executed
+contract. Each case writes a small file and asserts on the findings.
+"""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "devlint", pathlib.Path(__file__).resolve().parents[1] / "scripts" / "devlint.py"
+)
+devlint = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(devlint)
+
+
+def findings(tmp_path, source: str) -> list[str]:
+    f = tmp_path / "case.py"
+    f.write_text(source, encoding="utf-8")
+    return devlint.check_file(f)
+
+
+def codes(tmp_path, source: str) -> list[str]:
+    return [msg.split()[1] for msg in findings(tmp_path, source)]
+
+
+class TestFunctionScopeImports:
+    def test_unused_function_scope_import_flagged(self, tmp_path):
+        src = (
+            "def f():\n"
+            "    from os.path import join, split\n"
+            "    return join('a', 'b')\n"
+        )
+        msgs = findings(tmp_path, src)
+        assert any("F401" in m and "'split'" in m for m in msgs)
+        assert not any("'join'" in m for m in msgs)
+
+    def test_alias_used_by_nested_def_not_flagged(self, tmp_path):
+        src = (
+            "def f():\n"
+            "    import json\n"
+            "    def g():\n"
+            "        return json.dumps({})\n"
+            "    return g\n"
+        )
+        assert "F401" not in codes(tmp_path, src)
+
+    def test_quoted_annotation_counts_as_use(self, tmp_path):
+        # ruff resolves string annotations; the gate must not be stricter.
+        src = (
+            "def f():\n"
+            "    import decimal\n"
+            "    val: \"decimal.Decimal\" = None\n"
+            "    return val\n"
+        )
+        assert "F401" not in codes(tmp_path, src)
+
+    def test_noqa_suppresses(self, tmp_path):
+        src = (
+            "def f():\n"
+            "    import json  # noqa: F401\n"
+            "    return 1\n"
+        )
+        assert "F401" not in codes(tmp_path, src)
+
+
+class TestUndefinedNames:
+    def test_genuine_undefined_name_flagged(self, tmp_path):
+        # The exact bug class an executed F821 gate catches pre-run: a
+        # name used in a test/function that nothing ever binds.
+        src = (
+            "def f():\n"
+            "    return DeviceReliabilityState(1, 2)\n"
+        )
+        msgs = findings(tmp_path, src)
+        assert any(
+            "F821" in m and "DeviceReliabilityState" in m for m in msgs
+        )
+
+    @pytest.mark.parametrize(
+        "src",
+        [
+            # builtins
+            "def f(xs):\n    return sorted(len(x) for x in xs)\n",
+            # closure over an enclosing local
+            "def f():\n    y = 1\n    def g():\n        return y\n    return g\n",
+            # module-level name defined AFTER the function (runtime-bound)
+            "def f():\n    return HELPER\nHELPER = 3\n",
+            # global statement binding
+            "def set_it():\n    global COUNT\n    COUNT = 1\n"
+            "def get_it():\n    return COUNT\n",
+            # class attribute access through self + method cross-calls
+            "class C:\n    def a(self):\n        return self.b()\n"
+            "    def b(self):\n        return 1\n",
+            # comprehension scope reading module binding
+            "N = 4\nsquares = [i * i for i in range(N)]\n",
+            # conditional import fallback pattern
+            "try:\n    import json as codec\nexcept ImportError:\n"
+            "    codec = None\nprint(codec)\n",
+            # dunder module attributes
+            "print(__name__, __file__)\n",
+        ],
+    )
+    def test_bound_or_builtin_names_not_flagged(self, tmp_path, src):
+        assert "F821" not in codes(tmp_path, src)
+
+    def test_wildcard_import_skips_file(self, tmp_path):
+        src = "from os.path import *\nprint(join('a', 'b'))\n"
+        assert "F821" not in codes(tmp_path, src)
+
+
+class TestWholeRepoClean:
+    def test_repo_passes_devlint(self):
+        # The gate the CI fallback step runs; keep it green.
+        assert devlint.main([]) == 0

@@ -415,6 +415,43 @@ class TestRingTieBreak:
         result = build_ring_tiebreak(mesh)(pred, weight, conf, rel, valid)
         self._assert_rows_match_scalar(result, pred, weight, conf, rel, valid, m, a)
 
+    def test_origin_buffer_shrinks_with_markets_sharding(self):
+        """Pin the documented at-scale memory mitigation (ring.py origin
+        buffer): per shard the buffer is f32[ring, M_loc, A_loc], so moving
+        devices from the agents axis to the markets axis shrinks it — (2,4)
+        carries HALF the per-device origin bytes of (1,8) at the same global
+        shape. Checked against the actual lowered program, not the docstring.
+
+        (CPU ``memory_analysis`` is deliberately NOT used here: the CPU
+        lowering materialises the pairwise compare as an O(M·A²) temp that
+        TPU fuses away — bench.py's on-chip ``ring_compiled_temp_mb`` is the
+        hardware number — so its totals say nothing about the TPU buffer.)
+        """
+        m, a = 1024, 4096
+        rng = np.random.default_rng(47)
+        grid = np.array([0.2, 0.4, 0.6, 0.8])
+        args = (
+            jnp.asarray(rng.choice(grid, (m, a)), dtype=jnp.float32),
+            jnp.asarray(rng.uniform(0.1, 2.0, (m, a)), dtype=jnp.float32),
+            jnp.asarray(rng.uniform(0, 1, (m, a)), dtype=jnp.float32),
+            jnp.asarray(rng.uniform(0, 1, (m, a)), dtype=jnp.float32),
+            jnp.asarray(rng.random((m, a)) < 0.9),
+        )
+
+        def assert_origin_buffer(mesh_shape):
+            # The pin IS the token-presence check: the per-shard buffer of
+            # shape (ring, M_loc, A_loc) must appear in the lowered program.
+            # 8×1024×512 at (1,8) vs 4×512×1024 at (2,4): the byte halving
+            # follows arithmetically from the pinned shapes.
+            ring = mesh_shape[1]
+            m_loc, a_loc = m // mesh_shape[0], a // mesh_shape[1]
+            text = build_ring_tiebreak(make_mesh(mesh_shape)).lower(*args).as_text()
+            token = f"{ring}x{m_loc}x{a_loc}xf32"
+            assert token in text, token
+
+        assert_origin_buffer((1, 8))
+        assert_origin_buffer((2, 4))
+
     def test_markets_axis_sharded_too(self):
         # (2, 4) mesh: the markets axis of the tie-break shard_map is
         # actually sharded — the configuration the 10k-agent scale docstring

@@ -268,6 +268,30 @@ class TestIncrementalFlush:
         reloaded = TensorReliabilityStore.from_sqlite(db)
         assert reloaded.list_sources() == store.list_sources()
 
+    def test_retired_row_deleted_from_checkpoint(self, tmp_path):
+        """A row whose device exists flag flipped False (absorb of a
+        mutated device state — no kernel does it, but the API allows it)
+        must be DELETED by the next incremental flush, not stranded."""
+        db = tmp_path / "ckpt.db"
+        store = self._seeded(10)
+        store.flush_to_sqlite(db)
+        state, epoch0 = store.device_state()
+        exists = np.asarray(state.exists).copy()
+        exists[4] = False
+        store.absorb(
+            DeviceReliabilityState(
+                np.asarray(state.reliability),
+                np.asarray(state.confidence),
+                np.asarray(state.updated_days),
+                exists,
+            ),
+            epoch0,
+        )
+        store.flush_to_sqlite(db)  # incremental
+        reloaded = TensorReliabilityStore.from_sqlite(db)
+        assert reloaded.list_sources() == store.list_sources()
+        assert len(reloaded.list_sources()) == 9
+
     def test_memory_db_never_incremental(self):
         store = self._seeded()
         assert store.flush_to_sqlite(":memory:") == 50
