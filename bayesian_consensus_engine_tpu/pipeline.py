@@ -264,6 +264,30 @@ def _check_plan(store, plan: SettlementPlan, outcomes: Sequence[bool]) -> None:
                 )
 
 
+def _rebase_epoch(flat, epoch0: float, now_abs: float):
+    """Ensure the settlement time lands strictly after the stamp epoch.
+
+    Stamps are stored relative to *epoch0* with "> 0" meaning "ever
+    updated", so a settlement at ``now_abs <= epoch0`` would write
+    non-positive stamps that absorb reads back as NEVER — silently losing
+    timestamps. Backdated settlements are legitimate (the reference stamps
+    whatever ``now`` the caller supplies, reliability.py:175), so instead
+    of rejecting, shift the epoch below ``now_abs`` and re-express the
+    live stamps (never-updated rows keep exactly 0). Rare path: one cheap
+    elementwise op, only when time runs backwards.
+    """
+    if now_abs - epoch0 > 0:
+        return flat, epoch0
+    import jax.numpy as jnp
+
+    new_epoch0 = now_abs - 1.0
+    delta = jnp.asarray(epoch0 - new_epoch0, flat.updated_days.dtype)
+    shifted = jnp.where(
+        flat.updated_days > 0, flat.updated_days + delta, flat.updated_days
+    )
+    return flat._replace(updated_days=shifted), new_epoch0
+
+
 def _replay_confidences(store, touched_rows, conf_exact, steps: int) -> None:
     """Overwrite settled confidences with the exact host-replayed trajectory.
 
@@ -327,8 +351,13 @@ def settle(
     touched_rows = plan.slot_rows[plan.mask]
     conf_exact = store.host_confidences(touched_rows)
 
-    (flat, epoch0) = store.device_state(dtype, donate=True)
+    # take_device_state hands forward a pending (unsynced) predecessor
+    # settlement if one exists — the chained-settle fast path: no host→
+    # device re-upload and no per-settle absorb; this call's settled state
+    # subsumes the predecessor's changes and replaces it as pending below.
+    (flat, epoch0) = store.take_device_state(dtype)
     now_abs = _now_days() if now is None else now
+    flat, epoch0 = _rebase_epoch(flat, epoch0, now_abs)
     cdtype = flat.reliability.dtype
 
     # The plan is static across settle calls; keep its device copies so a
@@ -358,7 +387,11 @@ def settle(
         jnp.asarray(now_abs - epoch0, dtype=cdtype),
         steps,
     )
-    store.absorb(
+    # Deferred absorb: the settled state becomes the store's pending device
+    # truth (merged into the host lazily, on the first host read that needs
+    # it); the exact confidence trajectory is maintained host-side NOW so
+    # host confidences stay authoritative throughout.
+    store.defer_absorb(
         DeviceReliabilityState(rel, conf, days, exists), epoch0
     )
     _replay_confidences(store, touched_rows, conf_exact, steps)
@@ -474,7 +507,11 @@ def settle_sharded(
 
     touched_rows = band_rows[band_mask]
     conf_exact = store.host_confidences(touched_rows)
-    epoch0 = store.epoch_origin()
+    now_abs = _now_days() if now is None else now
+    # Host-side twin of settle()'s _rebase_epoch: keep the settlement time
+    # strictly after the stamp epoch so written stamps stay positive
+    # (backdated settlements re-base instead of silently dropping stamps).
+    epoch0 = min(store.epoch_origin(), now_abs - 1.0)
 
     host_rel, host_conf, host_days, host_exists = store.host_rows(safe)
     state = MarketBlockState(
@@ -501,7 +538,6 @@ def settle_sharded(
     )
     outcome_g = global_market(outcome_p[lo:hi], mesh, padded_total)
 
-    now_abs = _now_days() if now is None else now
     loop = build_cycle_loop(mesh, slot_major=True, donate=True)
     new_state, consensus = loop(
         probs_g, mask_g, outcome_g, state,

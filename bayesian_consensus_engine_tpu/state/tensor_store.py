@@ -86,6 +86,13 @@ class TensorReliabilityStore:
         self._exists = np.zeros(capacity, dtype=bool)
         self._iso: List[str] = []
         self._device_cache = None  # (DeviceReliabilityState, epoch0)
+        # Deferred-absorb pending state: a settled device pytree whose
+        # rel/days/exists the host has NOT yet merged (confidences are
+        # host-authoritative throughout — the settle path replays them
+        # exactly). Synced lazily on the first host read/write that needs
+        # it; chained settles hand it forward device-resident instead
+        # (see take_device_state / defer_absorb).
+        self._pending = None  # (DeviceReliabilityState, epoch0)
         # Dirty-row tracking for incremental SQLite flushes: rows whose
         # values changed since the last flush to ``_last_flush_path``
         # (reference semantics: UPSERT only what changed, reliability.py:221-231).
@@ -125,6 +132,34 @@ class TensorReliabilityStore:
         return row
 
     def _invalidate(self) -> None:
+        # Pending state survives cache invalidation: it holds un-merged
+        # settlement results and is dropped only by sync or hand-forward.
+        self._device_cache = None
+
+    def _sync_pending(self) -> None:
+        """Merge any deferred settlement results into the host arrays.
+
+        Confidences are NOT merged — the host's are authoritative (the
+        settle path replays the exact trajectory eagerly); rel/days/exists
+        come from the device. Idempotent and cheap when nothing is pending.
+        """
+        if self._pending is None:
+            return
+        state, epoch0 = self._pending
+        self._pending = None
+        # Merge at the PENDING state's length: pairs interned after the
+        # settle (e.g. a new plan) have host-only (cold) rows — correct.
+        used = int(state.reliability.shape[0])
+        self._merge_device_rows(
+            slice(0, used),
+            np.asarray(state.reliability),
+            None,  # confidences: host-authoritative
+            np.asarray(state.updated_days),
+            np.asarray(state.exists, dtype=bool),
+            epoch0,
+        )
+        # Drop the cache: its confidences are the device's (ulp-drifted)
+        # values, while the host's replayed ones are now authoritative.
         self._device_cache = None
 
     # -- record API (ReliabilityStore protocol) ------------------------------
@@ -136,6 +171,7 @@ class TensorReliabilityStore:
         apply_decay: bool = False,
     ) -> ReliabilityRecord:
         """Scalar read; cold-start defaults (never allocating) when absent."""
+        self._sync_pending()
         row = self._pairs.get((source_id, market_id))
         if row < 0 or not self._exists[row]:
             return ReliabilityRecord(
@@ -195,6 +231,7 @@ class TensorReliabilityStore:
 
     def put_record(self, record: ReliabilityRecord) -> None:
         """Upsert a fully-specified record (import/seed/flush-back path)."""
+        self._sync_pending()
         row = self._row_for(record.source_id, record.market_id)
         self._rel[row] = record.reliability
         self._conf[row] = record.confidence
@@ -205,6 +242,7 @@ class TensorReliabilityStore:
         self._invalidate()
 
     def list_sources(self, market_id: Optional[str] = None) -> List[ReliabilityRecord]:
+        self._sync_pending()
         selected = [
             (key, row)
             for key, row in self._pairs.items()
@@ -269,6 +307,7 @@ class TensorReliabilityStore:
         (epoch-days; defaults to current time) for every pair — unlike the
         per-query wall clock of the SQLite path, a batch is self-consistent.
         """
+        self._sync_pending()
         rows = self.rows_for_pairs(pairs, allocate=False)
         valid = rows >= 0
         safe = np.where(valid, rows, 0)
@@ -300,6 +339,7 @@ class TensorReliabilityStore:
         direction wins), unlike sequential scalar calls — split the call if
         sequential semantics are needed.
         """
+        self._sync_pending()
         rows = self.rows_for_pairs(pairs, allocate=True)
         correct_arr = np.asarray(correct, dtype=bool)
         stamp_iso = utc_now_iso()
@@ -318,7 +358,12 @@ class TensorReliabilityStore:
         self._invalidate()
 
     def host_confidences(self, rows: np.ndarray) -> np.ndarray:
-        """Exact f64 host confidences for *rows* (a copy; defaults when cold)."""
+        """Exact f64 host confidences for *rows* (a copy; defaults when cold).
+
+        Deliberately does NOT sync pending state: host confidences are
+        authoritative at all times (the settle replay maintains them), and
+        skipping the sync is what lets chained settles stay device-resident.
+        """
         return self._conf[rows].copy()
 
     def host_rows(
@@ -329,6 +374,7 @@ class TensorReliabilityStore:
         Fancy-indexed copies, no cold-start defaulting — the sharded settle
         path's gather (it applies its own masking/defaults per slot).
         """
+        self._sync_pending()
         return (
             self._rel[rows],
             self._conf[rows],
@@ -348,7 +394,13 @@ class TensorReliabilityStore:
         """
         self._conf[rows] = values
         self._dirty[rows] = True
-        self._invalidate()
+        if self._pending is None:
+            self._invalidate()
+        # With a pending settled state the cache stays: host confidences
+        # are authoritative by contract (this method IS how the settle
+        # replay maintains them), and the cache's device confidences may
+        # drift a few ulp between syncs without consequence — stored
+        # confidences are always restored from the host side.
 
     # -- device tier ---------------------------------------------------------
 
@@ -361,9 +413,13 @@ class TensorReliabilityStore:
 
         ``donate=True`` hands ownership of the buffers to the caller (for a
         donating jit): the store forgets its cache immediately, so it never
-        holds references to buffers the compiler may invalidate.
+        holds references to buffers the compiler may invalidate. Pending
+        settlement state is synced first — consumers other than the settle
+        chain (which uses :meth:`take_device_state`) get host-exact values.
         """
         import jax.numpy as jnp
+
+        self._sync_pending()
 
         from bayesian_consensus_engine_tpu.utils.dtypes import default_float_dtype
 
@@ -392,10 +448,68 @@ class TensorReliabilityStore:
 
     def epoch_origin(self) -> float:
         """The epoch-days origin for relative device stamps (min live −1)."""
+        self._sync_pending()
         used = len(self._pairs)
         stamps = self._days[:used]
         live = stamps[stamps > NEVER]
         return float(live.min()) - 1.0 if live.size else 0.0
+
+    def take_device_state(self, dtype=None):
+        """Pop the device state for a consumer that WILL ``defer_absorb`` a
+        successor (the settle path's private entry).
+
+        With a pending settled state, hand it forward WITHOUT syncing: the
+        successor state the caller later defers subsumes every change in
+        this one (the kernel carries state forward), so the skipped merge
+        loses nothing — this is what makes chained settles device-resident
+        (no per-settle host→device re-upload and no per-settle absorb).
+        Callers that cannot promise a successor must use ``device_state``.
+        """
+        if self._pending is not None:
+            from bayesian_consensus_engine_tpu.utils.dtypes import (
+                default_float_dtype,
+            )
+
+            state, epoch0 = self._pending
+            import jax.numpy as jnp
+
+            wanted = jnp.dtype(dtype or default_float_dtype())
+            if (
+                state.reliability.shape[0] == len(self._pairs)
+                and state.reliability.dtype == wanted
+            ):
+                self._pending = None
+                self._device_cache = None
+                return state, epoch0
+            # Pairs were interned since the settle (new plan), or the
+            # caller wants a different precision: the pending arrays don't
+            # fit — merge and rebuild from the host.
+            self._sync_pending()
+        return self.device_state(dtype, donate=True)
+
+    def defer_absorb(
+        self, state: DeviceReliabilityState, epoch0: float
+    ) -> None:
+        """Adopt a settled device pytree as the pending (unsynced) state.
+
+        rel/days/exists merge into the host lazily, on the first host
+        read/write that needs them (``_sync_pending``); confidences must be
+        kept host-exact by the caller via ``overwrite_confidences`` (the
+        settle path's replay). *state* also serves as the device cache for
+        a chained settle.
+
+        A chained settle consumes this state's DEVICE confidences, which
+        may sit a few ulp from the host-exact replay (XLA fuses the growth
+        multiply-add). That drift is unobservable by contract: consensus
+        weights are reliabilities (confidence feeds only the discarded
+        weighted-confidence output), and STORED confidences are always the
+        host replay — so results and stored state still match the
+        sync-every-time path (pinned by the chained-settle tests).
+        """
+        if state.reliability.shape[0] != len(self._pairs):
+            raise ValueError("pending state size does not match the store")
+        self._pending = (state, epoch0)
+        self._device_cache = (state, epoch0)
 
     def absorb(self, state: DeviceReliabilityState, epoch0: float) -> None:
         """Write a mutated device pytree back into host-authoritative state.
@@ -404,6 +518,7 @@ class TensorReliabilityStore:
         device stamp; all other sidecar strings are preserved exactly (so an
         import→export round trip without updates is byte-identical).
         """
+        self._sync_pending()
         used = len(self._pairs)
         new_rel = np.asarray(state.reliability)
         if len(new_rel) != used:
@@ -435,6 +550,7 @@ class TensorReliabilityStore:
         process reads back exactly its band's (market, source) rows. *rows*
         must be unique (the settlement plan guarantees one slot per pair).
         """
+        self._sync_pending()
         self._merge_device_rows(
             np.asarray(rows),
             np.asarray(reliability),
@@ -449,7 +565,8 @@ class TensorReliabilityStore:
     ) -> None:
         """Shared device→host merge. ``idx`` selects host rows: a ZERO-BASED
         slice (whose positions are then the row numbers) or a unique row
-        array."""
+        array. ``new_conf=None`` skips the confidence merge (deferred-sync
+        path: host confidences are authoritative)."""
         from bayesian_consensus_engine_tpu.utils.timeconv import days_to_iso
 
         # The device may run float32; an untouched row's value round-trips
@@ -469,15 +586,18 @@ class TensorReliabilityStore:
         stamps_changed = new_days_rel != host_relative
 
         host_rel = self._rel[idx]
-        host_conf = self._conf[idx]
         rel_changed = new_rel != host_rel.astype(device_dtype)
-        conf_changed = new_conf != host_conf.astype(device_dtype)
         self._rel[idx] = np.where(
             rel_changed, new_rel.astype(np.float64), host_rel
         )
-        self._conf[idx] = np.where(
-            conf_changed, new_conf.astype(np.float64), host_conf
-        )
+        if new_conf is None:
+            conf_changed = False
+        else:
+            host_conf = self._conf[idx]
+            conf_changed = new_conf != host_conf.astype(device_dtype)
+            self._conf[idx] = np.where(
+                conf_changed, new_conf.astype(np.float64), host_conf
+            )
         self._days[idx] = np.where(stamps_changed, new_days, host_days)
         touched = (
             rel_changed | conf_changed | stamps_changed
@@ -560,6 +680,7 @@ class TensorReliabilityStore:
         # ":memory:" is a fresh empty DB on every open — never a valid
         # incremental target.
         in_memory = str(db_path) == ":memory:"
+        self._sync_pending()
         target = None if in_memory else str(Path(db_path).resolve())
         # Path identity alone is not enough: a deleted/rotated target would
         # make an incremental write silently truncate the checkpoint to the
@@ -633,6 +754,8 @@ class TensorReliabilityStore:
     def save_checkpoint(self, directory: Union[str, Path], step: int = 0) -> None:
         """Snapshot the full store (arrays + id/timestamp sidecars)."""
         from bayesian_consensus_engine_tpu.state.checkpoint import CycleCheckpointer
+
+        self._sync_pending()
 
         used = len(self._pairs)
         state = {
