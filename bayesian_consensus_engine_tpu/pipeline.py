@@ -17,6 +17,14 @@ state lives in the store's flat HBM tensors and the cycle runs on a dense
 slot-major block, so the bridge is one gather at entry and one scatter at
 exit — both inside the same jit dispatch as the cycle loop itself.
 
+Performance note for million-market callers: ingest is allocation-heavy,
+and CPython's generational GC re-scans every long-lived container the
+caller holds on each full collection — a process holding 1M dict payloads
+pays ~3x on every host-side pass here until it calls ``gc.freeze()`` on
+that long-lived state (the standard service pattern). Callers with columnar
+signals should prefer :func:`build_settlement_plan_columnar`, which never
+materialises per-signal Python objects in the first place.
+
 Two-phase API so the (host-side) packing/interning cost is paid once per
 signal topology, then any number of settlement cycles run device-only:
 
@@ -103,30 +111,165 @@ def build_settlement_plan(
         raise ValueError("duplicate market ids in one settlement plan")
 
     packed = pack_markets(payloads, native=native)
-    pairs = [
-        (sid, keys[market_row])
-        for sid, market_row in zip(packed.pair_source_ids, packed.pair_market)
-    ]
-    rows = store.rows_for_pairs(pairs, allocate=True)
+    market_of_pair = packed.pair_market
+    pair_markets = [keys[row] for row in market_of_pair.tolist()]
+    rows = store.rows_for_arrays(
+        packed.pair_source_ids, pair_markets, allocate=True
+    )
+    return _assemble_plan(
+        keys,
+        rows,
+        market_of_pair,
+        packed.pair_offsets,
+        _pair_means(packed),
+        packed.pair_source_ids,
+        pair_markets,
+        packed.signals_per_market,
+    )
 
-    counts = np.diff(packed.pair_offsets)
+
+def build_settlement_plan_columnar(
+    store,
+    market_keys: Sequence[str],
+    source_ids: Sequence[str],
+    probabilities,
+    offsets,
+) -> SettlementPlan:
+    """Vectorised twin of :func:`build_settlement_plan` for columnar input.
+
+    Callers that already hold their signals as flat columns — *source_ids*
+    (one string per signal, markets back to back), *probabilities*
+    (float64[N]) and CSR *offsets* (int32[M+1]; market ``m``'s signals are
+    ``[offsets[m], offsets[m+1])``) — skip the per-signal Python dict walk
+    entirely: grouping, per-market source-id ordering, duplicate averaging
+    and the dense block fill all run as whole-column numpy passes, with one
+    C interning pass for the source-id strings. Produces a plan identical
+    (bit-for-bit, including binding probes and row assignment order) to the
+    dict-payload path on equivalent input.
+
+    Semantics notes pinned to the reference engine:
+
+    * pairs within a market are ordered by source id (code-point order, the
+      scalar engine's float-summation order, reference: core.py:103);
+    * duplicate signals from one (source, market) average in original
+      signal order (reference: core.py:115-116).
+    """
+    market_keys = list(market_keys)
+    if len(set(market_keys)) != len(market_keys):
+        raise ValueError("duplicate market ids in one settlement plan")
+    num_markets = len(market_keys)
+    probabilities = np.ascontiguousarray(probabilities, dtype=np.float64)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    if offsets.shape != (num_markets + 1,):
+        raise ValueError(
+            f"offsets must have shape ({num_markets + 1},); got {offsets.shape}"
+        )
+    if num_markets and (offsets[0] != 0 or np.any(np.diff(offsets) < 0)):
+        raise ValueError("offsets must start at 0 and be non-decreasing")
+    num_signals = int(offsets[-1]) if num_markets else 0
+    if len(source_ids) != num_signals or len(probabilities) != num_signals:
+        raise ValueError(
+            f"offsets cover {num_signals} signals but got "
+            f"{len(source_ids)} source ids / {len(probabilities)} probabilities"
+        )
+
+    signals_per_market = np.diff(offsets).astype(np.int32)
+    market_of_signal = np.repeat(
+        np.arange(num_markets, dtype=np.int64), signals_per_market
+    )
+
+    # Source id strings → dense codes (one C pass), then code → rank in
+    # code-point order by sorting the unique table (small: one entry per
+    # distinct source id, not per signal).
+    codes, uniq = _intern_source_codes(source_ids)
+    order = sorted(range(len(uniq)), key=uniq.__getitem__)
+    rank_of_code = np.empty(max(len(uniq), 1), dtype=np.int64)
+    rank_of_code[np.asarray(order, dtype=np.int64)] = np.arange(len(order))
+    sid_of_rank = [uniq[code] for code in order]
+
+    # Composite (market, source-rank) key: its sorted-unique sequence IS the
+    # pair list in the scalar engine's order (market-major, source ids
+    # ascending within each market).
+    stride = max(len(uniq), 1)
+    key = market_of_signal * stride + rank_of_code[codes]
+    uniq_keys, pair_of_signal = np.unique(key, return_inverse=True)
+    pair_market = (uniq_keys // stride).astype(np.int32)
+    pair_rank = uniq_keys % stride
+    pair_sources = [sid_of_rank[rank] for rank in pair_rank.tolist()]
+    pair_markets = [market_keys[row] for row in pair_market.tolist()]
+    pair_offsets = np.searchsorted(
+        pair_market, np.arange(num_markets + 1)
+    ).astype(np.int64)
+
+    # Duplicate averaging: np.add.at accumulates in signal order — the
+    # scalar path's left-to-right sum per pair (see _pair_means).
+    num_pairs = len(uniq_keys)
+    sums = np.zeros(num_pairs, dtype=np.float64)
+    np.add.at(sums, pair_of_signal, probabilities)
+    counts = np.bincount(pair_of_signal, minlength=num_pairs)
+    pair_mean = sums / np.maximum(counts, 1)
+
+    rows = store.rows_for_arrays(pair_sources, pair_markets, allocate=True)
+    return _assemble_plan(
+        market_keys,
+        rows,
+        pair_market,
+        pair_offsets,
+        pair_mean,
+        pair_sources,
+        pair_markets,
+        signals_per_market,
+    )
+
+
+def _intern_source_codes(source_ids):
+    """Strings → first-seen int32 codes + unique table, C pass when built."""
+    from bayesian_consensus_engine_tpu.utils.interning import (
+        IdInterner,
+        _load_internmap,
+    )
+
+    module = _load_internmap()
+    if module is not None:
+        table = module.InternMap()
+        codes = np.frombuffer(
+            table.intern_batch(list(source_ids)), dtype=np.int32
+        )
+        return codes, table.ids()
+    interner = IdInterner()
+    codes = np.asarray(interner.intern_all(source_ids), dtype=np.int32)
+    return codes, interner.ids()
+
+
+def _assemble_plan(
+    keys,
+    rows,
+    market_of_pair,
+    pair_offsets,
+    pair_mean,
+    pair_sources,
+    pair_markets,
+    signals_per_market,
+) -> SettlementPlan:
+    """Shared plan tail: dense block fill + binding probes + freeze."""
+    counts = np.diff(pair_offsets)
     num_markets = len(keys)
     num_slots = int(counts.max()) if num_markets else 0
-    pair_mean = _pair_means(packed)
 
-    # Ragged pair lists → dense (M, K): slot k of market m is its k-th pair
-    # (source-id-sorted within the market, the scalar engine's float order).
-    slot_rows = np.full((num_markets, num_slots), -1, dtype=np.int32)
-    probs = np.zeros((num_markets, num_slots), dtype=np.float64)
-    mask = np.zeros((num_markets, num_slots), dtype=bool)
-    market_of_pair = packed.pair_market
+    # Ragged pair lists → dense slot-major (K, M), written in place: slot k
+    # of market m is its k-th pair (source-id-sorted within the market, the
+    # scalar engine's float order). Allocating (K, M) directly avoids a
+    # strided transpose copy of every block (~1 s per 4M pairs).
+    slot_rows = np.full((num_slots, num_markets), -1, dtype=np.int32)
+    probs = np.zeros((num_slots, num_markets), dtype=np.float64)
+    mask = np.zeros((num_slots, num_markets), dtype=bool)
     slot_of_pair = (
         np.arange(len(rows), dtype=np.int64)
-        - packed.pair_offsets[:-1][market_of_pair]
+        - pair_offsets[:-1][market_of_pair]
     )
-    slot_rows[market_of_pair, slot_of_pair] = rows
-    probs[market_of_pair, slot_of_pair] = pair_mean
-    mask[market_of_pair, slot_of_pair] = True
+    slot_rows[slot_of_pair, market_of_pair] = rows
+    probs[slot_of_pair, market_of_pair] = pair_mean
+    mask[slot_of_pair, market_of_pair] = True
 
     # Binding probes: a spread of (row, pair) samples (always including the
     # highest row) lets settle() verify the plan still matches the store's
@@ -136,17 +279,18 @@ def build_settlement_plan(
         probe_idx = {0, len(rows) - 1, int(np.argmax(rows))}
         probe_idx.update(range(0, len(rows), max(1, len(rows) // 8)))
         binding = tuple(
-            (int(rows[i]), pairs[i][0], pairs[i][1]) for i in sorted(probe_idx)
+            (int(rows[i]), pair_sources[i], pair_markets[i])
+            for i in sorted(probe_idx)
         )
     else:
         binding = ()
 
     plan = SettlementPlan(
         market_keys=keys,
-        slot_rows=np.ascontiguousarray(slot_rows.T),
-        probs=np.ascontiguousarray(probs.T),
-        mask=np.ascontiguousarray(mask.T),
-        signals_per_market=packed.signals_per_market,
+        slot_rows=slot_rows,
+        probs=probs,
+        mask=mask,
+        signals_per_market=np.asarray(signals_per_market, dtype=np.int32),
         binding=binding,
     )
     # Freeze the arrays: settle() caches device copies keyed by the plan

@@ -153,6 +153,39 @@ class NativePairInterner:
         buf = self._map.intern_pairs(sources, markets)
         return np.frombuffer(buf, dtype=np.int32)
 
+    def sorted_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Rows reordered by (source_id, market_id) — C memcmp over the key
+        arena, which equals Python's tuple sort (see internmap.c notes)."""
+        buf = self._map.sorted_rows(np.ascontiguousarray(rows, dtype=np.int32))
+        return np.frombuffer(buf, dtype=np.int32)
+
+    def sqlite_writer_available(self) -> bool:
+        """Whether :meth:`flush_sqlite` can run (libsqlite3 dlopen()able).
+
+        Callers choose their fallback on this, up front — so a genuine
+        write error (locked file, full disk) from the C writer propagates
+        instead of being mistaken for "no native path here".
+        """
+        module = _load_internmap()
+        return bool(module and module.sqlite_writer_available())
+
+    def flush_sqlite(self, db_path, rows, rel, conf, iso) -> int:
+        """Write rows straight to a reference-format SQLite file in C.
+
+        ``rows`` gives the write order (pre-sort with :meth:`sorted_rows`);
+        ``rel``/``conf`` are full float64 store columns indexed by row;
+        ``iso`` is the full timestamp sidecar list. Raises ``RuntimeError``
+        when libsqlite3 cannot be dlopen()ed (check
+        :meth:`sqlite_writer_available` first) or on a real write error.
+        """
+        return self._map.flush_sqlite(
+            str(db_path),
+            np.ascontiguousarray(rows, dtype=np.int32),
+            np.ascontiguousarray(rel, dtype=np.float64),
+            np.ascontiguousarray(conf, dtype=np.float64),
+            iso,
+        )
+
     def lookup_arrays(
         self, sources: Sequence[str], markets: Sequence[str]
     ) -> np.ndarray:

@@ -510,63 +510,93 @@ def bench_e2e(markets=NUM_MARKETS, mean_slots=4, steps=20,
     ]
     outcomes = rng.random(markets) < 0.5
 
-    store = TensorReliabilityStore()
-    start = time.perf_counter()
-    plan = build_settlement_plan(store, payloads)
-    t_ingest = time.perf_counter() - start
+    # The 1M-dict payload fixture is long-lived caller data: without
+    # gc.freeze() every generational collection re-scans its ~9M containers,
+    # tripling every host-side pass below (measured 14 s -> 4 s for one
+    # ingest). Freezing long-lived state is the standard CPython service
+    # pattern; the framework's own cost is what remains. Paired with the
+    # unfreeze below so callers get normal GC back.
+    import gc
 
-    settle(store, plan, outcomes, steps=steps)  # compile + warm
-    store.epoch_origin()  # sync the warm-up's deferred state off the clock
-    start = time.perf_counter()
-    settle(store, plan, outcomes, steps=steps)  # cold: upload + kernel
-    t_settle = time.perf_counter() - start
-    # The settle deferred its host merge; time the sync explicitly so the
-    # breakdown stays honest (epoch_origin is the cheapest forcing read).
-    start = time.perf_counter()
-    store.epoch_origin()
-    t_sync = time.perf_counter() - start
-
-    with tempfile.TemporaryDirectory() as tmp:
-        db = os.path.join(tmp, "settled.db")
+    gc.freeze()
+    try:
+        store = TensorReliabilityStore()
         start = time.perf_counter()
-        rows = store.flush_to_sqlite(db)
-        t_flush = time.perf_counter() - start
+        plan = build_settlement_plan(store, payloads)
+        t_ingest = time.perf_counter() - start
 
-        # Incremental checkpoint: settle a small slice, flush the delta
-        # (the flush syncs the deferred state first — all-in cost shown).
-        sub_plan = build_settlement_plan(store, payloads[:resettle_markets])
-        settle(store, sub_plan, outcomes[:resettle_markets], steps=1)
+        # Columnar twin: callers holding signals as flat columns skip the
+        # per-dict Python walk entirely (vectorised grouping + one C interning
+        # pass). Measured on its own store so interner state is comparable.
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan_columnar,
+        )
+
+        source_ids = [f"src-{s}" for s in src.tolist()]
+        market_keys = [market_id for market_id, _signals in payloads]
         start = time.perf_counter()
-        dirty_rows = store.flush_to_sqlite(db)
-        t_flush_incr = time.perf_counter() - start
+        build_settlement_plan_columnar(
+            TensorReliabilityStore(), market_keys, source_ids, prob,
+            offsets.astype(np.int64),
+        )
+        t_ingest_columnar = time.perf_counter() - start
 
-        # Steady state: chained settles stay device-resident (deferred
-        # absorb — no per-settle re-upload or host merge). The first settle
-        # below re-primes the device after the flush's sync; the second is
-        # the sustained per-batch cost a long-running service pays.
-        settle(store, plan, outcomes, steps=steps)
+        settle(store, plan, outcomes, steps=steps)  # compile + warm
+        store.epoch_origin()  # sync the warm-up's deferred state off the clock
         start = time.perf_counter()
-        settle(store, plan, outcomes, steps=steps)
-        t_settle_chained = time.perf_counter() - start
+        settle(store, plan, outcomes, steps=steps)  # cold: upload + kernel
+        t_settle = time.perf_counter() - start
+        # The settle deferred its host merge; time the sync explicitly so the
+        # breakdown stays honest (epoch_origin is the cheapest forcing read).
+        start = time.perf_counter()
+        store.epoch_origin()
+        t_sync = time.perf_counter() - start
 
-    total = t_ingest + t_settle + t_sync + t_flush
-    return steps / total, {
-        "workload": (
-            f"{markets} markets, {int(counts.sum())} signals, "
-            f"{rows} pairs, {steps} cycles"
-        ),
-        "ingest_s": round(t_ingest, 3),
-        "settle_s": round(t_settle, 3),
-        "host_sync_s": round(t_sync, 3),
-        "settle_chained_s": round(t_settle_chained, 3),
-        "steady_state_cycles_per_sec": round(steps / t_settle_chained, 1),
-        "flush_s": round(t_flush, 3),
-        "incremental_flush": {
-            "resettled_markets": resettle_markets,
-            "rows_written": dirty_rows,
-            "flush_s": round(t_flush_incr, 3),
-        },
-    }
+        with tempfile.TemporaryDirectory() as tmp:
+            db = os.path.join(tmp, "settled.db")
+            start = time.perf_counter()
+            rows = store.flush_to_sqlite(db)
+            t_flush = time.perf_counter() - start
+
+            # Incremental checkpoint: settle a small slice, flush the delta
+            # (the flush syncs the deferred state first — all-in cost shown).
+            sub_plan = build_settlement_plan(store, payloads[:resettle_markets])
+            settle(store, sub_plan, outcomes[:resettle_markets], steps=1)
+            start = time.perf_counter()
+            dirty_rows = store.flush_to_sqlite(db)
+            t_flush_incr = time.perf_counter() - start
+
+            # Steady state: chained settles stay device-resident (deferred
+            # absorb — no per-settle re-upload or host merge). The first settle
+            # below re-primes the device after the flush's sync; the second is
+            # the sustained per-batch cost a long-running service pays.
+            settle(store, plan, outcomes, steps=steps)
+            start = time.perf_counter()
+            settle(store, plan, outcomes, steps=steps)
+            t_settle_chained = time.perf_counter() - start
+
+        total = t_ingest + t_settle + t_sync + t_flush
+        return steps / total, {
+            "workload": (
+                f"{markets} markets, {int(counts.sum())} signals, "
+                f"{rows} pairs, {steps} cycles"
+            ),
+            "ingest_s": round(t_ingest, 3),
+            "ingest_columnar_s": round(t_ingest_columnar, 3),
+            "settle_s": round(t_settle, 3),
+            "host_sync_s": round(t_sync, 3),
+            "settle_chained_s": round(t_settle_chained, 3),
+            "steady_state_cycles_per_sec": round(steps / t_settle_chained, 1),
+            "flush_s": round(t_flush, 3),
+            "incremental_flush": {
+                "resettled_markets": resettle_markets,
+                "rows_written": dirty_rows,
+                "flush_s": round(t_flush_incr, 3),
+            },
+        }
+    finally:
+        # Pairing is local: any caller of bench_e2e gets normal GC back.
+        gc.unfreeze()
 
 
 def run():

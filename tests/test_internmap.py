@@ -175,3 +175,100 @@ class TestFactory:
         native.intern(("s1", "m"))
         native.intern(("s0", "m"))
         assert native.items() == [(("s1", "m"), 0), (("s0", "m"), 1)]
+
+
+class TestSortedRows:
+    """C memcmp key sort == Python (source, market) tuple sort."""
+
+    def test_randomized_matches_python_sorted(self):
+        native = NativePairInterner()
+        pairs = list(dict.fromkeys(random_pairs(3000, 80, 60, seed=9)))
+        for pair in pairs:
+            native.intern(pair)
+        rows = np.arange(len(pairs), dtype=np.int32)
+        rng = random.Random(1)
+        shuffled = rows.copy()
+        rng.shuffle(shuffled)
+        got = native.sorted_rows(shuffled)
+        expect = sorted(range(len(pairs)), key=pairs.__getitem__)
+        assert got.tolist() == expect
+
+    def test_unicode_and_prefix_order(self):
+        # UTF-8 byte order equals code-point order; the NUL joiner sorts a
+        # shorter source before any longer source sharing its prefix.
+        native = NativePairInterner()
+        pairs = [
+            ("ab", "z"), ("a", "é"), ("a", "b"), ("abc", "a"),
+            ("é", "a"), ("ζ", "m"), ("a", "bb"), ("aé", "x"),
+        ]
+        for pair in pairs:
+            native.intern(pair)
+        got = native.sorted_rows(np.arange(len(pairs), dtype=np.int32))
+        assert [pairs[r] for r in got.tolist()] == sorted(pairs)
+
+    def test_out_of_range_row_rejected(self):
+        raw = internmap.InternMap()
+        raw.intern_pair("a", "b")
+        with pytest.raises(IndexError):
+            raw.sorted_rows(np.array([0, 5], dtype=np.int32))
+
+    def test_empty(self):
+        raw = internmap.InternMap()
+        assert bytes(raw.sorted_rows(np.zeros(0, dtype=np.int32))) == b""
+
+
+@pytest.mark.skipif(
+    internmap is None or not internmap.sqlite_writer_available(),
+    reason="libsqlite3 runtime not dlopen()able here",
+)
+class TestFlushSqlite:
+    """Direct error-path coverage of the C checkpoint writer (the happy
+    paths are pinned against the sqlite3-module implementation in
+    tests/test_tensor_store.py::TestNativeFlushParity). Skipped where the
+    extension builds but libsqlite3 is absent: flush_sqlite checks runtime
+    availability before argument validation."""
+
+    def _map_with_pairs(self):
+        raw = internmap.InternMap()
+        raw.intern_pair("s", "m")
+        raw.intern_pair("t", "m")
+        return raw
+
+    def test_single_string_key_rejected(self, tmp_path):
+        raw = internmap.InternMap()
+        raw.intern("not-a-pair")
+        with pytest.raises(ValueError, match="single-string"):
+            raw.flush_sqlite(
+                str(tmp_path / "x.db"),
+                np.array([0], dtype=np.int32),
+                np.array([0.5]), np.array([0.25]), [""],
+            )
+
+    def test_row_out_of_columns_rejected(self, tmp_path):
+        raw = self._map_with_pairs()
+        with pytest.raises(IndexError):
+            raw.flush_sqlite(
+                str(tmp_path / "x.db"),
+                np.array([1], dtype=np.int32),
+                np.array([0.5]),  # only one column row for row id 1
+                np.array([0.25]), ["", ""],
+            )
+
+    def test_iso_must_be_list(self, tmp_path):
+        raw = self._map_with_pairs()
+        with pytest.raises(TypeError, match="list"):
+            raw.flush_sqlite(
+                str(tmp_path / "x.db"),
+                np.array([0], dtype=np.int32),
+                np.array([0.5, 0.5]), np.array([0.25, 0.25]),
+                ("", ""),
+            )
+
+    def test_unwritable_path_raises(self):
+        raw = self._map_with_pairs()
+        with pytest.raises(RuntimeError, match="sqlite checkpoint"):
+            raw.flush_sqlite(
+                "/nonexistent-dir/x.db",
+                np.array([0], dtype=np.int32),
+                np.array([0.5, 0.5]), np.array([0.25, 0.25]), ["", ""],
+            )

@@ -24,6 +24,7 @@ enable_x64 = jax.enable_x64
 from bayesian_consensus_engine_tpu.core import compute_consensus
 from bayesian_consensus_engine_tpu.pipeline import (
     build_settlement_plan,
+    build_settlement_plan_columnar,
     settle,
     settle_payloads,
     settle_sharded,
@@ -377,6 +378,123 @@ class TestPipelineScale:
         with SQLiteReliabilityStore(tmp_path / "settled.db") as flushed:
             flushed_records = flushed.list_sources()
         assert_records_match(flushed_records, oracle.list_sources())
+
+
+def payloads_to_columns(payloads):
+    """Dict payloads → (market_keys, source_ids, probabilities, offsets)."""
+    market_keys = [market_id for market_id, _ in payloads]
+    source_ids = []
+    probabilities = []
+    offsets = [0]
+    for _market_id, signals in payloads:
+        for signal in signals:
+            source_ids.append(signal["sourceId"])
+            probabilities.append(signal["probability"])
+        offsets.append(len(source_ids))
+    return (
+        market_keys,
+        source_ids,
+        np.asarray(probabilities, dtype=np.float64),
+        np.asarray(offsets, dtype=np.int64),
+    )
+
+
+class TestColumnarPlan:
+    """build_settlement_plan_columnar must be indistinguishable from the
+    dict-payload path: same blocks, same row assignment, same binding."""
+
+    def assert_plans_equal(self, a, b):
+        assert a.market_keys == b.market_keys
+        np.testing.assert_array_equal(a.slot_rows, b.slot_rows)
+        np.testing.assert_array_equal(a.probs, b.probs)
+        np.testing.assert_array_equal(a.mask, b.mask)
+        np.testing.assert_array_equal(
+            a.signals_per_market, b.signals_per_market)
+        assert a.binding == b.binding
+
+    def test_matches_dict_path_randomized(self):
+        rng = random.Random(97)
+        payloads = random_payloads(
+            rng, num_markets=200, universe=40, dup_rate=0.35)
+        dict_plan = build_settlement_plan(TensorReliabilityStore(), payloads)
+        columnar_plan = build_settlement_plan_columnar(
+            TensorReliabilityStore(), *payloads_to_columns(payloads))
+        self.assert_plans_equal(dict_plan, columnar_plan)
+
+    def test_matches_dict_path_with_empty_markets(self):
+        payloads = [
+            ("m-2", [{"sourceId": "zz", "probability": 0.25},
+                     {"sourceId": "aa", "probability": 0.75}]),
+            ("m-0", []),
+            ("m-1", [{"sourceId": "aa", "probability": 0.5},
+                     {"sourceId": "aa", "probability": 0.9},
+                     {"sourceId": "mm", "probability": 0.125}]),
+        ]
+        dict_plan = build_settlement_plan(TensorReliabilityStore(), payloads)
+        columnar_plan = build_settlement_plan_columnar(
+            TensorReliabilityStore(), *payloads_to_columns(payloads))
+        self.assert_plans_equal(dict_plan, columnar_plan)
+
+    def test_python_interning_fallback_identical(self, monkeypatch):
+        """Without the C internmap, the pure-Python source-id interning
+        must produce the exact same plan (first-seen codes either way)."""
+        from bayesian_consensus_engine_tpu.utils import interning
+
+        rng = random.Random(3)
+        payloads = random_payloads(rng, num_markets=60, universe=15)
+        columns = payloads_to_columns(payloads)
+        native_plan = build_settlement_plan_columnar(
+            TensorReliabilityStore(), *columns)
+        monkeypatch.setattr(interning, "_load_internmap", lambda: None)
+        fallback_plan = build_settlement_plan_columnar(
+            TensorReliabilityStore(), *columns)
+        self.assert_plans_equal(native_plan, fallback_plan)
+
+    def test_settles_identically_to_dict_plan(self):
+        rng = random.Random(11)
+        payloads = random_payloads(rng, num_markets=50, universe=10)
+        outcomes = [rng.random() < 0.5 for _ in payloads]
+        with enable_x64():
+            dict_store = TensorReliabilityStore()
+            dict_result = settle(
+                dict_store, build_settlement_plan(dict_store, payloads),
+                outcomes, steps=2, now=20400.0)
+            col_store = TensorReliabilityStore()
+            col_result = settle(
+                col_store,
+                build_settlement_plan_columnar(
+                    col_store, *payloads_to_columns(payloads)),
+                outcomes, steps=2, now=20400.0)
+        np.testing.assert_array_equal(
+            dict_result.consensus, col_result.consensus)
+        assert_records_match(col_store.list_sources(),
+                             dict_store.list_sources())
+
+    def test_duplicate_market_ids_rejected(self):
+        with pytest.raises(ValueError, match="duplicate market ids"):
+            build_settlement_plan_columnar(
+                TensorReliabilityStore(), ["m", "m"], ["a", "b"],
+                np.array([0.5, 0.5]), np.array([0, 1, 2]))
+
+    def test_bad_offsets_rejected(self):
+        store = TensorReliabilityStore()
+        with pytest.raises(ValueError, match="shape"):
+            build_settlement_plan_columnar(
+                store, ["m"], ["a"], np.array([0.5]), np.array([0, 1, 1]))
+        with pytest.raises(ValueError, match="non-decreasing"):
+            build_settlement_plan_columnar(
+                store, ["m", "n"], ["a"], np.array([0.5]),
+                np.array([0, 1, 0]))
+        with pytest.raises(ValueError, match="cover"):
+            build_settlement_plan_columnar(
+                store, ["m"], ["a", "b"], np.array([0.5, 0.6]),
+                np.array([0, 1]))
+
+    def test_empty_input(self):
+        plan = build_settlement_plan_columnar(
+            TensorReliabilityStore(), [], [], np.zeros(0), np.zeros(1))
+        assert plan.num_markets == 0
+        assert plan.num_slots == 0
 
 
 class TestPipelineApi:

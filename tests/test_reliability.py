@@ -197,6 +197,45 @@ class TestDurability:
         assert len(file_store.list_sources()) == 1
 
 
+class TestPutRows:
+    ROWS = [
+        ("a", "m", 0.61, 0.31, "2026-01-02T00:00:00+00:00"),
+        ("b", "m", 0.42, 0.27, "2026-01-03T00:00:00+00:00"),
+        ("a", "n", 0.55, 0.25, "2026-01-04T00:00:00+00:00"),
+    ]
+
+    def test_fresh_table_and_upsert_paths_agree(self, tmp_path: Path):
+        """The empty-table INSERT fast path and the UPSERT path must leave
+        byte-identical logical state: write fresh vs write-then-rewrite."""
+        with SQLiteReliabilityStore(tmp_path / "fresh.db") as fresh:
+            fresh.put_rows(self.ROWS)
+            once = fresh.list_sources()
+        with SQLiteReliabilityStore(tmp_path / "twice.db") as twice:
+            twice.put_rows(self.ROWS)  # INSERT path (empty)
+            twice.put_rows(self.ROWS)  # UPSERT path (populated)
+            again = twice.list_sources()
+        assert once == again
+        assert [r.source_id for r in once] == ["a", "a", "b"]
+
+    def test_duplicate_keys_in_one_batch_last_wins(self):
+        """Intra-batch duplicates keep UPSERT's last-wins semantics on the
+        empty-table fast path too."""
+        dupes = self.ROWS + [("a", "m", 0.99, 0.5, "2026-02-01T00:00:00+00:00")]
+        with SQLiteReliabilityStore(":memory:") as store:
+            store.put_rows(dupes)
+            rec = store.get_reliability("a", "m")
+        assert rec.reliability == 0.99
+        assert rec.updated_at == "2026-02-01T00:00:00+00:00"
+
+    def test_upsert_overwrites_existing_rows(self):
+        with SQLiteReliabilityStore(":memory:") as store:
+            store.put_rows(self.ROWS)
+            store.put_rows([("b", "m", 0.8, 0.4, "2026-03-01T00:00:00+00:00")])
+            rec = store.get_reliability("b", "m")
+            assert rec.reliability == 0.8
+            assert len(store.list_sources()) == 3
+
+
 class TestRecord:
     def test_frozen(self):
         rec = ReliabilityRecord("a", "m", 0.5, 0.25, "")

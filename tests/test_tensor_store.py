@@ -298,6 +298,102 @@ class TestIncrementalFlush:
         assert store.flush_to_sqlite(":memory:") == 50  # still full
 
 
+class TestNativeFlushParity:
+    """The C checkpoint writer (internmap.flush_sqlite over dlopen()ed
+    libsqlite3) against the sqlite3-module path: identical records, identical
+    key order, deterministic bytes. The native path is what flush_to_sqlite
+    auto-selects when the C interner is built, so forcing the fallback pins
+    the two implementations against each other."""
+
+    def _randomized(self, n=400, seed=13):
+        rng = random.Random(seed)
+        store = TensorReliabilityStore()
+        # Unicode + prefix-colliding ids probe the memcmp-order claim
+        # (UTF-8 byte order == code-point order; NUL sorts below all).
+        alphabet = ["a", "ab", "abc", "src-é", "src-éx", "zz", "ζeta"]
+        for _ in range(n):
+            sid = f"{rng.choice(alphabet)}{rng.randrange(40)}"
+            mid = f"m{rng.choice(alphabet)}{rng.randrange(25)}"
+            store.update_reliability(sid, mid, rng.random() < 0.5)
+        return store
+
+    def _force_python_flush(self, monkeypatch):
+        from bayesian_consensus_engine_tpu.utils import interning
+
+        monkeypatch.setattr(
+            interning.NativePairInterner,
+            "sqlite_writer_available",
+            lambda self: False,
+        )
+
+    def test_native_matches_python_path(self, tmp_path, monkeypatch):
+        store = self._randomized()
+        native_db = tmp_path / "native.db"
+        store.flush_to_sqlite(native_db)
+        python_db = tmp_path / "python.db"
+        self._force_python_flush(monkeypatch)
+        store.flush_to_sqlite(python_db)
+
+        native_records = TensorReliabilityStore.from_sqlite(native_db).list_sources()
+        python_records = TensorReliabilityStore.from_sqlite(python_db).list_sources()
+        assert native_records == python_records
+        import sqlite3
+
+        schemas = []
+        for db in (native_db, python_db):
+            with sqlite3.connect(db) as conn:
+                # Key order inside the files matches (same physical row walk).
+                walk = conn.execute(
+                    "SELECT source_id, market_id FROM sources"
+                ).fetchall()
+                assert walk == sorted(walk)
+                schemas.append(
+                    conn.execute(
+                        "SELECT type, name, sql FROM sqlite_master ORDER BY name"
+                    ).fetchall()
+                )
+        # The C writer's embedded schema must track sqlite_store.py's: a
+        # column/default/constraint drift between the duplicated SQL
+        # literals shows up here as differing CREATE statements.
+        def normalize(rows):
+            # sql is None for the PK's auto-index row.
+            return [(t, n, " ".join(s.split()) if s else s) for t, n, s in rows]
+
+        assert normalize(schemas[0]) == normalize(schemas[1])
+
+    def test_incremental_native_matches_python(self, tmp_path, monkeypatch):
+        def run(tmp, forced):
+            store = self._randomized(seed=29)
+            db = tmp / ("py.db" if forced else "nat.db")
+            store.flush_to_sqlite(db)
+            store.update_reliability("aa", "m1", True)
+            store.update_reliability("zz9", "mab3", False)
+            wrote = store.flush_to_sqlite(db)
+            return wrote, TensorReliabilityStore.from_sqlite(db).list_sources()
+
+        n_wrote, n_records = run(tmp_path, forced=False)
+        self._force_python_flush(monkeypatch)
+        p_wrote, p_records = run(tmp_path, forced=True)
+        assert n_wrote == p_wrote == 2
+        assert [
+            (r.source_id, r.market_id, r.reliability, r.confidence)
+            for r in n_records
+        ] == [
+            (r.source_id, r.market_id, r.reliability, r.confidence)
+            for r in p_records
+        ]
+
+    def test_repeated_full_flush_bytes_identical(self, tmp_path):
+        store = self._randomized(seed=7)
+        a, b = tmp_path / "a.db", tmp_path / "b.db"
+        store.flush_to_sqlite(a)
+        # Reset flush bookkeeping so the second flush is full again.
+        store._last_flush_path = None
+        store._dirty[: len(store)] = True
+        store.flush_to_sqlite(b)
+        assert a.read_bytes() == b.read_bytes()
+
+
 class TestBatchFailureConsistency:
     def test_mid_batch_intern_failure_keeps_sidecars_synced(self):
         """A NUL id mid-batch must not desync interner rows from sidecars."""
