@@ -336,7 +336,7 @@ class SettlementResult:
 
 def _settle_math(
     flat_rel, flat_conf, flat_days, flat_exists,
-    slot_rows, probs, mask, outcome, now0, steps: int,
+    slot_rows, probs, mask, outcome, now0, touched_rows, steps: int,
 ):
     """gather → N-cycle loop → scatter, traced as one jit dispatch.
 
@@ -344,6 +344,12 @@ def _settle_math(
     state via ``device_state()`` afterwards — ``settle`` absorbs + drops the
     cache immediately). Padding slots carry row −1, which indexes the sink
     row appended at the end; sink writes are sliced off before returning.
+
+    ``touched_rows`` (the flat rows the scatter writes, precomputed on the
+    host) drives an extra gather of the settled reliabilities: the store's
+    deferred sync fetches only that vector — stamps and existence are
+    closed-form host-side — so the device→host merge cost scales with
+    touched rows, not store size.
     """
     import jax.numpy as jnp
 
@@ -379,7 +385,8 @@ def _settle_math(
     new_conf = conf.at[slot_rows].set(new_block.confidence)[:-1]
     new_days = days.at[slot_rows].set(new_block.updated_days)[:-1]
     new_exists = exists.at[slot_rows].set(new_block.exists)[:-1]
-    return new_rel, new_conf, new_days, new_exists, consensus
+    rel_touched = new_rel[touched_rows]
+    return new_rel, new_conf, new_days, new_exists, consensus, rel_touched
 
 
 def _check_plan(store, plan: SettlementPlan, outcomes: Sequence[bool]) -> None:
@@ -492,7 +499,13 @@ def settle(
     # fuses the growth multiply-add into an FMA, one rounding short of the
     # scalar contract; the trajectory is data-independent, so the host can
     # reproduce it bit-exactly no matter what precision the device ran at).
-    touched_rows = plan.slot_rows[plan.mask]
+    # Cached on the plan: the same array object chains through defer_absorb
+    # recipes (same-plan links replace rather than accumulate).
+    touched_rows = getattr(plan, "_touched_rows", None)
+    if touched_rows is None:
+        touched_rows = plan.slot_rows[plan.mask]
+        touched_rows.setflags(write=False)
+        object.__setattr__(plan, "_touched_rows", touched_rows)
     conf_exact = store.host_confidences(touched_rows)
 
     # take_device_state hands forward a pending (unsynced) predecessor
@@ -515,11 +528,12 @@ def settle(
             jnp.asarray(plan.slot_rows),
             jnp.asarray(plan.probs, dtype=cdtype),
             jnp.asarray(plan.mask),
+            jnp.asarray(touched_rows),
         )
         object.__setattr__(plan, "_device_arrays", device_plan)
-    _, slot_rows_d, probs_d, mask_d = device_plan
+    _, slot_rows_d, probs_d, mask_d, touched_d = device_plan
 
-    rel, conf, days, exists, consensus = _get_settle_kernel()(
+    rel, conf, days, exists, consensus, rel_touched = _get_settle_kernel()(
         flat.reliability,
         flat.confidence,
         flat.updated_days,
@@ -529,14 +543,30 @@ def settle(
         mask_d,
         jnp.asarray(np.asarray(outcomes, dtype=bool)),
         jnp.asarray(now_abs - epoch0, dtype=cdtype),
+        touched_d,
         steps,
     )
     # Deferred absorb: the settled state becomes the store's pending device
     # truth (merged into the host lazily, on the first host read that needs
     # it); the exact confidence trajectory is maintained host-side NOW so
-    # host confidences stay authoritative throughout.
+    # host confidences stay authoritative throughout. The sync recipe lets
+    # that merge fetch only the touched reliabilities: the final stamp is
+    # the closed form the loop itself uses (now0 + steps − 1, in device
+    # precision — make_loop_math's exit reconstruction), and existence is
+    # monotone. steps == 0 settles nothing: an empty recipe keeps the sync
+    # a no-op rather than inventing stamps.
+    np_dtype = np.dtype(cdtype).type
+    stamp_rel = np_dtype(np_dtype(now_abs - epoch0) + np_dtype(steps - 1))
+    recipe = (
+        (touched_rows, rel_touched, stamp_rel)
+        if steps > 0
+        # Empty on BOTH sides: rel_touched[:0] frees the full-size gather
+        # and keeps the eventual sync from fetching bytes it will discard.
+        else (touched_rows[:0], rel_touched[:0], stamp_rel)
+    )
     store.defer_absorb(
-        DeviceReliabilityState(rel, conf, days, exists), epoch0
+        DeviceReliabilityState(rel, conf, days, exists), epoch0,
+        sync_recipe=recipe,
     )
     _replay_confidences(store, touched_rows, conf_exact, steps)
     return SettlementResult(

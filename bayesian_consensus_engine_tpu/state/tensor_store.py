@@ -93,6 +93,10 @@ class TensorReliabilityStore:
         # it; chained settles hand it forward device-resident instead
         # (see take_device_state / defer_absorb).
         self._pending = None  # (DeviceReliabilityState, epoch0)
+        # Settle sync recipes: [(touched_rows, rel_touched_dev, epoch0,
+        # stamp_rel)] — the cheap path _sync_pending takes when set (fetch
+        # only touched reliabilities; stamps/existence are closed-form).
+        self._pending_sync = None
         # Dirty-row tracking for incremental SQLite flushes: rows whose
         # values changed since the last flush to ``_last_flush_path``
         # (reference semantics: UPSERT only what changed, reliability.py:221-231).
@@ -142,8 +146,31 @@ class TensorReliabilityStore:
         Confidences are NOT merged — the host's are authoritative (the
         settle path replays the exact trajectory eagerly); rel/days/exists
         come from the device. Idempotent and cheap when nothing is pending.
+
+        When the pending state carries settle sync recipes (see
+        :meth:`defer_absorb`), the merge fetches ONLY the touched
+        reliabilities from device — stamps and existence are closed-form
+        on the host (every settled slot carries the final cycle's stamp;
+        existence is monotone) — instead of pulling three full columns
+        through the device→host path, whose bandwidth dominates the merge
+        at million-row scale.
         """
-        if self._pending is None:
+        if self._pending is None and self._pending_sync is None:
+            return
+        recipes = self._pending_sync
+        self._pending_sync = None
+        if recipes is not None:
+            # Covers the orphan case too (_pending popped by
+            # take_device_state, successor never deferred — e.g. its kernel
+            # raised): the gathered recipe arrays are not donated, so the
+            # predecessor settle's results are still recoverable here.
+            self._pending = None
+            for touched, rel_touched_dev, recipe_epoch0, stamp_rel in recipes:
+                self._apply_settle_recipe(
+                    touched, np.asarray(rel_touched_dev), recipe_epoch0,
+                    stamp_rel,
+                )
+            self._device_cache = None
             return
         state, epoch0 = self._pending
         self._pending = None
@@ -161,6 +188,49 @@ class TensorReliabilityStore:
         # Drop the cache: its confidences are the device's (ulp-drifted)
         # values, while the host's replayed ones are now authoritative.
         self._device_cache = None
+
+    def _apply_settle_recipe(
+        self, touched: np.ndarray, rel_new, epoch0: float, stamp_rel
+    ) -> None:
+        """Merge one settle's results: device reliabilities for *touched*
+        rows plus closed-form stamps/existence.
+
+        Equivalent, row for row, to :meth:`_merge_device_rows` over the full
+        state (pinned by tests): overwrite-only-if-changed-in-device-
+        precision for reliabilities, stamp comparison in device precision
+        with the same re-expression around *epoch0*, existence monotone
+        True, one shared ISO string for every row the settle stamped.
+        """
+        from bayesian_consensus_engine_tpu.utils.timeconv import days_to_iso
+
+        if touched.size == 0:
+            return
+        device_dtype = rel_new.dtype
+        host_rel = self._rel[touched]
+        rel_changed = rel_new != host_rel.astype(device_dtype)
+        self._rel[touched] = np.where(
+            rel_changed, rel_new.astype(np.float64), host_rel
+        )
+
+        host_days = self._days[touched]
+        host_relative = np.where(
+            host_days > NEVER, host_days - epoch0, 0.0
+        ).astype(device_dtype)
+        stamps_changed = host_relative != stamp_rel
+        stamp_abs = float(np.float64(stamp_rel) + epoch0)
+        self._days[touched] = np.where(stamps_changed, stamp_abs, host_days)
+
+        newly_existing = ~self._exists[touched]
+        self._exists[touched] = True
+        self._dirty[
+            touched[rel_changed | stamps_changed | newly_existing]
+        ] = True
+        changed_rows = touched[stamps_changed]
+        if changed_rows.size:
+            iso_value = days_to_iso(stamp_abs)
+            iso = self._iso
+            for row in changed_rows.tolist():
+                iso[row] = iso_value
 
     # -- record API (ReliabilityStore protocol) ------------------------------
 
@@ -503,7 +573,10 @@ class TensorReliabilityStore:
         return self.device_state(dtype, donate=True)
 
     def defer_absorb(
-        self, state: DeviceReliabilityState, epoch0: float
+        self,
+        state: DeviceReliabilityState,
+        epoch0: float,
+        sync_recipe=None,
     ) -> None:
         """Adopt a settled device pytree as the pending (unsynced) state.
 
@@ -512,6 +585,21 @@ class TensorReliabilityStore:
         kept host-exact by the caller via ``overwrite_confidences`` (the
         settle path's replay). *state* also serves as the device cache for
         a chained settle.
+
+        ``sync_recipe`` — ``(touched_rows, rel_touched_dev, stamp_rel)``,
+        where ``touched_rows`` are the flat rows the settle scattered to,
+        ``rel_touched_dev`` their settled device reliabilities (gathered
+        inside the settle's own jit), and ``stamp_rel`` the closed-form
+        final stamp relative to *epoch0* in device precision — lets the
+        eventual sync fetch only the touched values instead of three full
+        columns (the device→host path is the cost at million-row scale).
+        Recipes ACCUMULATE across chained settles (take_device_state keeps
+        them; each chain link appends its own), applied in order at sync;
+        a link whose ``touched_rows`` is the same array object as an
+        earlier link's (same cached plan) replaces it — the later gather
+        covers every row of the earlier one. Without a recipe, any
+        accumulated recipes are discarded and the sync falls back to the
+        full-state merge (which subsumes them).
 
         A chained settle consumes this state's DEVICE confidences, which
         may sit a few ulp from the host-exact replay (XLA fuses the growth
@@ -523,6 +611,37 @@ class TensorReliabilityStore:
         """
         if state.reliability.shape[0] != len(self._pairs):
             raise ValueError("pending state size does not match the store")
+        if self._pending is not None:
+            # Not chained through take_device_state: the predecessor's
+            # changes are not in *state* — merge them first.
+            self._sync_pending()
+        if sync_recipe is None:
+            self._pending_sync = None
+        else:
+            touched_rows, rel_touched_dev, stamp_rel = sync_recipe
+            # A link covering the same rows as an earlier one replaces it
+            # (the later gather post-dates it): same array object for the
+            # cached-plan chain, content equality for rebuilt plans.
+            recipes = [
+                r for r in (self._pending_sync or [])
+                if r[0] is not touched_rows
+                and not (
+                    len(r[0]) == len(touched_rows)
+                    and np.array_equal(r[0], touched_rows)
+                )
+            ]
+            recipes.append((touched_rows, rel_touched_dev, epoch0, stamp_rel))
+            # Bound the chain: each entry pins a touched-size device array,
+            # so a long chain of DISTINCT plans would grow HBM linearly.
+            # Applying the oldest links early is always safe (they describe
+            # values that were final when gathered; later links overwrite
+            # any overlap in order).
+            while len(recipes) > 8:
+                touched, rel_dev, r_epoch0, r_stamp = recipes.pop(0)
+                self._apply_settle_recipe(
+                    touched, np.asarray(rel_dev), r_epoch0, r_stamp
+                )
+            self._pending_sync = recipes
         self._pending = (state, epoch0)
         self._device_cache = (state, epoch0)
 

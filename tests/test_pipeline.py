@@ -497,6 +497,110 @@ class TestColumnarPlan:
         assert plan.num_slots == 0
 
 
+class TestSyncRecipe:
+    """The deferred-sync fast path (fetch only touched reliabilities;
+    stamps/existence closed-form) against the full-state device merge."""
+
+    def _settle_twice(self, recipe: bool):
+        rng = random.Random(71)
+        payloads = random_payloads(rng, num_markets=80, universe=20)
+        outcomes = [rng.random() < 0.5 for _ in payloads]
+        store = TensorReliabilityStore()
+        # Seed some rows (fixed stamps: the epoch origin must be identical
+        # between the two runs) so the settle mixes existing and cold pairs.
+        from bayesian_consensus_engine_tpu.state.records import (
+            ReliabilityRecord,
+        )
+
+        for market_id, signals in payloads[:20]:
+            store.put_record(ReliabilityRecord(
+                source_id=signals[0]["sourceId"], market_id=market_id,
+                reliability=0.61, confidence=0.31,
+                updated_at="2026-07-01T00:00:00+00:00",
+            ))
+        plan = build_settlement_plan(store, payloads)
+        settle(store, plan, outcomes, steps=2, now=20800.0)
+        # Chain a second settle over a SUBSET plan (different touched set).
+        sub_plan = build_settlement_plan(store, payloads[:30])
+        settle(store, sub_plan, outcomes[:30], steps=1, now=20801.0)
+        if not recipe:
+            # Force the full-state merge path for the oracle run.
+            store._pending_sync = None
+        return store
+
+    def test_matches_full_state_merge_bitwise(self):
+        fast = self._settle_twice(recipe=True)
+        oracle = self._settle_twice(recipe=False)
+        assert fast.list_sources() == oracle.list_sources()
+        used = len(fast)
+        np.testing.assert_array_equal(fast._rel[:used], oracle._rel[:used])
+        np.testing.assert_array_equal(fast._days[:used], oracle._days[:used])
+        np.testing.assert_array_equal(
+            fast._exists[:used], oracle._exists[:used])
+        assert fast._iso == oracle._iso
+
+    def test_recipe_survives_failed_chain_link(self):
+        """take_device_state pops the pending state; if the successor's
+        kernel never defers (failure), the recipes still carry the
+        predecessor's results — a host read must recover them."""
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(
+            store, [("m", [{"sourceId": "a", "probability": 0.9}])])
+        result = settle(store, plan, [True], now=20900.0)
+        # Simulate a failed chain link: pop the pending state and lose it.
+        state, epoch0 = store.take_device_state(None)
+        del state
+        rec = store.get_reliability("a", "m")  # syncs via orphaned recipe
+        assert rec.reliability > 0.5
+        assert rec.updated_at != ""
+        assert not math.isnan(result.consensus[0])
+
+    def test_incremental_flush_after_chained_settles(self, tmp_path):
+        store = self._settle_twice(recipe=True)
+        db = tmp_path / "ckpt.db"
+        store.flush_to_sqlite(db)
+        reloaded = TensorReliabilityStore.from_sqlite(db)
+        assert reloaded.list_sources() == store.list_sources()
+
+    def test_rebuilt_identical_plans_dedup_by_content(self):
+        """A service that rebuilds its (identical) plan every round must not
+        grow the recipe chain — content-equal touched sets replace."""
+        rng = random.Random(5)
+        payloads = random_payloads(rng, num_markets=20, universe=8)
+        outcomes = [True] * len(payloads)
+        store = TensorReliabilityStore()
+        for day in range(12):
+            plan = build_settlement_plan(store, payloads)  # fresh object
+            settle(store, plan, outcomes, steps=1, now=21000.0 + day)
+        assert len(store._pending_sync) == 1
+
+    def test_distinct_plan_chain_bounded_and_correct(self):
+        """Chaining many DISTINCT plans keeps the recipe list bounded (old
+        links applied early) and the final state identical to syncing
+        between every settle."""
+        rng = random.Random(9)
+        payloads = random_payloads(rng, num_markets=40, universe=12)
+
+        def run(sync_each):
+            store = TensorReliabilityStore()
+            full_plan = build_settlement_plan(store, payloads)
+            for day in range(12):
+                lo = day % 5
+                sub = build_settlement_plan(
+                    store, payloads[lo: lo + 20])
+                settle(store, sub, [True] * sub.num_markets,
+                       steps=1, now=21100.0 + day)
+                if sync_each:
+                    store.epoch_origin()  # force a sync per link
+            assert full_plan.num_markets == len(payloads)
+            return store
+
+        chained = run(sync_each=False)
+        assert len(chained._pending_sync) <= 8
+        stepwise = run(sync_each=True)
+        assert chained.list_sources() == stepwise.list_sources()
+
+
 class TestPipelineApi:
     def test_duplicate_market_ids_rejected(self):
         store = TensorReliabilityStore()
