@@ -97,6 +97,10 @@ class TensorReliabilityStore:
         # stamp_rel)] — the cheap path _sync_pending takes when set (fetch
         # only touched reliabilities; stamps/existence are closed-form).
         self._pending_sync = None
+        # True when _device_cache's confidences are the device trajectory
+        # (ulp-drifted from the authoritative host replay): acceptable for
+        # the settle chain, refreshed from host for device_state consumers.
+        self._cache_conf_drifted = False
         # Dirty-row tracking for incremental SQLite flushes: rows whose
         # values changed since the last flush to ``_last_flush_path``
         # (reference semantics: UPSERT only what changed, reliability.py:221-231).
@@ -139,6 +143,7 @@ class TensorReliabilityStore:
         # Pending state survives cache invalidation: it holds un-merged
         # settlement results and is dropped only by sync or hand-forward.
         self._device_cache = None
+        self._cache_conf_drifted = False
 
     def _sync_pending(self) -> None:
         """Merge any deferred settlement results into the host arrays.
@@ -164,13 +169,24 @@ class TensorReliabilityStore:
             # take_device_state, successor never deferred — e.g. its kernel
             # raised): the gathered recipe arrays are not donated, so the
             # predecessor settle's results are still recoverable here.
+            pend = self._pending
             self._pending = None
             for touched, rel_touched_dev, recipe_epoch0, stamp_rel in recipes:
                 self._apply_settle_recipe(
                     touched, np.asarray(rel_touched_dev), recipe_epoch0,
                     stamp_rel,
                 )
-            self._device_cache = None
+            # The flat device state is still EXACTLY the host's truth for
+            # rel/days/exists (the recipes just made the host match it), so
+            # keep it as the cache: a settle after a flush/read chains with
+            # zero re-upload. Only its confidences carry the documented ulp
+            # drift — flagged, and refreshed from host for device_state
+            # consumers (the settle chain tolerates the drift by contract).
+            if pend is not None:
+                self._device_cache = pend
+                self._cache_conf_drifted = True
+            else:
+                self._device_cache = None
             return
         state, epoch0 = self._pending
         self._pending = None
@@ -188,6 +204,7 @@ class TensorReliabilityStore:
         # Drop the cache: its confidences are the device's (ulp-drifted)
         # values, while the host's replayed ones are now authoritative.
         self._device_cache = None
+        self._cache_conf_drifted = False
 
     def _apply_settle_recipe(
         self, touched: np.ndarray, rel_new, epoch0: float, stamp_rel
@@ -379,6 +396,11 @@ class TensorReliabilityStore:
             if after > len(self._iso):
                 self._iso.extend([""] * (after - len(self._iso)))
                 self._ensure_capacity(after)
+                # A grown store makes any cached device state the wrong
+                # SHAPE (its values are still right): drop it so no
+                # consumer gathers against a short flat state. Pending
+                # state is unaffected — take_device_state shape-checks it.
+                self._invalidate()
 
     def batch_get_reliability(
         self,
@@ -509,10 +531,33 @@ class TensorReliabilityStore:
         from bayesian_consensus_engine_tpu.utils.dtypes import default_float_dtype
 
         if self._device_cache is not None:
-            cached = self._device_cache
-            if donate:
+            state, cached_epoch0 = self._device_cache
+            wanted = jnp.dtype(dtype or default_float_dtype())
+            if (
+                state.reliability.shape[0] != len(self._pairs)
+                or state.reliability.dtype != wanted
+            ):
+                # Stale shape (pairs interned since) or a different
+                # precision was requested: rebuild below.
                 self._device_cache = None
-            return cached
+                self._cache_conf_drifted = False
+            else:
+                if self._cache_conf_drifted:
+                    # Restore the host-exact confidences (one column
+                    # upload) before handing the cache to a host-exact
+                    # consumer.
+                    used = int(state.confidence.shape[0])
+                    state = state._replace(
+                        confidence=jnp.asarray(
+                            self._conf[:used], dtype=state.confidence.dtype
+                        )
+                    )
+                    self._device_cache = (state, cached_epoch0)
+                    self._cache_conf_drifted = False
+                cached = self._device_cache
+                if donate:
+                    self._device_cache = None
+                return cached
 
         dtype = dtype or default_float_dtype()
         used = len(self._pairs)
@@ -529,6 +574,7 @@ class TensorReliabilityStore:
         if donate:
             return (state, epoch0)
         self._device_cache = (state, epoch0)
+        self._cache_conf_drifted = False  # freshly host-built: exact
         return self._device_cache
 
     def epoch_origin(self) -> float:
@@ -549,27 +595,42 @@ class TensorReliabilityStore:
         loses nothing — this is what makes chained settles device-resident
         (no per-settle host→device re-upload and no per-settle absorb).
         Callers that cannot promise a successor must use ``device_state``.
+
+        A retained post-sync cache (see ``_sync_pending``) is consumed
+        as-is, drifted confidences included: the settle contract tolerates
+        that drift (stored confidences are always the host replay), so a
+        settle following a flush or host read also pays zero re-upload.
         """
+        from bayesian_consensus_engine_tpu.utils.dtypes import (
+            default_float_dtype,
+        )
+        import jax.numpy as jnp
+
+        wanted = jnp.dtype(dtype or default_float_dtype())
+
         if self._pending is not None:
-            from bayesian_consensus_engine_tpu.utils.dtypes import (
-                default_float_dtype,
-            )
-
             state, epoch0 = self._pending
-            import jax.numpy as jnp
-
-            wanted = jnp.dtype(dtype or default_float_dtype())
             if (
                 state.reliability.shape[0] == len(self._pairs)
                 and state.reliability.dtype == wanted
             ):
                 self._pending = None
                 self._device_cache = None
+                self._cache_conf_drifted = False
                 return state, epoch0
             # Pairs were interned since the settle (new plan), or the
             # caller wants a different precision: the pending arrays don't
             # fit — merge and rebuild from the host.
             self._sync_pending()
+        if self._device_cache is not None:
+            state, epoch0 = self._device_cache
+            if (
+                state.reliability.shape[0] == len(self._pairs)
+                and state.reliability.dtype == wanted
+            ):
+                self._device_cache = None
+                self._cache_conf_drifted = False
+                return state, epoch0
         return self.device_state(dtype, donate=True)
 
     def defer_absorb(

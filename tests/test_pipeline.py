@@ -562,6 +562,47 @@ class TestSyncRecipe:
         reloaded = TensorReliabilityStore.from_sqlite(db)
         assert reloaded.list_sources() == store.list_sources()
 
+    def test_cache_retained_after_sync_and_reused(self, tmp_path):
+        """After a sync (e.g. a flush), the flat device state is still the
+        exact truth — the next settle must chain from it (no re-upload)
+        and still produce state identical to an eager-sync store."""
+        rng = random.Random(83)
+        payloads = random_payloads(rng, num_markets=40, universe=10)
+        outcomes = [rng.random() < 0.5 for _ in payloads]
+
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads)
+        settle(store, plan, outcomes, steps=2, now=20810.0)
+        store.flush_to_sqlite(tmp_path / "mid.db")  # forces the sync
+        assert store._device_cache is not None  # retained, drift-flagged
+        assert store._cache_conf_drifted
+        settle(store, plan, outcomes, steps=1, now=20811.0)
+
+        eager = TensorReliabilityStore()
+        eager_plan = build_settlement_plan(eager, payloads)
+        settle(eager, eager_plan, outcomes, steps=2, now=20810.0)
+        eager.list_sources()
+        eager._invalidate()  # force a full host re-upload for the oracle
+        settle(eager, eager_plan, outcomes, steps=1, now=20811.0)
+        assert store.list_sources() == eager.list_sources()
+
+    def test_device_state_refreshes_drifted_confidences(self, tmp_path):
+        """device_state's host-exact contract: a drift-flagged cache hands
+        out HOST confidences, not the device trajectory."""
+        rng = random.Random(89)
+        payloads = random_payloads(rng, num_markets=25, universe=9)
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads)
+        settle(store, plan, [True] * len(payloads), steps=3, now=20820.0)
+        store.epoch_origin()  # sync; cache retained with drifted conf
+        state, _epoch0 = store.device_state()
+        used = len(store)
+        np.testing.assert_array_equal(
+            np.asarray(state.confidence),
+            store._conf[:used].astype(np.asarray(state.confidence).dtype),
+        )
+        assert not store._cache_conf_drifted
+
     def test_rebuilt_identical_plans_dedup_by_content(self):
         """A service that rebuilds its (identical) plan every round must not
         grow the recipe chain — content-equal touched sets replace."""
