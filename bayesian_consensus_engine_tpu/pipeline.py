@@ -320,12 +320,40 @@ def _pair_means(packed) -> np.ndarray:
     return sums / np.maximum(counts, 1)
 
 
-@dataclass(frozen=True)
 class SettlementResult:
-    """Per-market outputs of the final cycle, payload order."""
+    """Per-market outputs of the final cycle, payload order.
 
-    market_keys: list[str]
-    consensus: np.ndarray  # f[M] final-cycle consensus (NaN: zero weight)
+    ``consensus`` (f[M]; NaN means zero weight) materialises LAZILY: the
+    settle path hands the device array straight through, and the
+    device→host fetch happens on first access. A caller that only
+    settles-and-checkpoints never pays the transfer (through a tunneled
+    remote device the full-vector fetch can dwarf the kernel itself);
+    :meth:`fence` waits for completion with a scalar-sized fetch when a
+    timing boundary is needed without the vector.
+    """
+
+    __slots__ = ("market_keys", "_consensus_raw", "_consensus_np")
+
+    def __init__(self, market_keys: list[str], consensus) -> None:
+        self.market_keys = market_keys
+        self._consensus_raw = consensus
+        self._consensus_np: Optional[np.ndarray] = None
+
+    @property
+    def consensus(self) -> np.ndarray:
+        if self._consensus_np is None:
+            self._consensus_np = np.asarray(self._consensus_raw)
+            self._consensus_raw = None  # free the device buffer
+        return self._consensus_np
+
+    def fence(self) -> None:
+        """Block until the settlement's outputs exist on device, fetching
+        only one scalar (remote tunnels do not reliably force execution on
+        ``block_until_ready``; a value fetch does)."""
+        if self._consensus_np is None and getattr(
+            self._consensus_raw, "size", 0
+        ):
+            float(self._consensus_raw[0])
 
     def by_market(self) -> dict[str, float]:
         return {
@@ -571,7 +599,7 @@ def settle(
     _replay_confidences(store, touched_rows, conf_exact, steps)
     return SettlementResult(
         market_keys=plan.market_keys,
-        consensus=np.asarray(consensus),
+        consensus=consensus,  # device array; fetched lazily (see class doc)
     )
 
 

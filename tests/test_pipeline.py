@@ -642,6 +642,30 @@ class TestSyncRecipe:
         assert chained.list_sources() == stepwise.list_sources()
 
 
+class TestLazyConsensus:
+    def test_consensus_materialises_on_access(self):
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(
+            store, [("m", [{"sourceId": "a", "probability": 0.9}])])
+        result = settle(store, plan, [True], now=21200.0)
+        assert result._consensus_np is None  # not fetched yet
+        result.fence()  # completion only — still not materialised
+        assert result._consensus_np is None
+        values = result.consensus
+        assert isinstance(values, np.ndarray)
+        assert result._consensus_np is values
+        assert result.consensus is values  # cached
+        assert result.by_market()["m"] == pytest.approx(0.9)
+
+    def test_empty_result_fence_and_access(self):
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, [])
+        result = settle(store, plan, [])
+        result.fence()
+        assert result.consensus.size == 0
+        assert result.by_market() == {}
+
+
 class TestPipelineApi:
     def test_duplicate_market_ids_rejected(self):
         store = TensorReliabilityStore()
