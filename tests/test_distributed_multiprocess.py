@@ -116,6 +116,40 @@ loop_state, loop_consensus = build_cycle_loop(mesh, slot_major=False, donate=Fal
 )
 jax.block_until_ready(loop_consensus)
 
+# The END-TO-END sharded settlement across the cluster: every process
+# builds the same global plan (identical interning), feeds only its band,
+# and absorbs back exactly its band's store rows — the one logical store,
+# partitioned by market ownership.
+from bayesian_consensus_engine_tpu.pipeline import (
+    build_settlement_plan,
+    settle_sharded,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+rng2 = np.random.default_rng(SEED + 1)
+payloads = []
+for m in range(M):
+    n = int(rng2.integers(1, 5))
+    payloads.append((
+        f"market-{{m}}",
+        [
+            {{
+                "sourceId": f"s{{int(rng2.integers(0, 6))}}",
+                "probability": round(float(rng2.random()), 6),
+            }}
+            for _ in range(n)
+        ],
+    ))
+settle_outcomes = (rng2.random(M) < 0.5).tolist()
+
+settle_store = TensorReliabilityStore()
+settle_plan = build_settlement_plan(settle_store, payloads)
+settle_result = settle_sharded(
+    settle_store, settle_plan, settle_outcomes, mesh, steps=2, now=20750.0
+)
+
 band = {{
     "pid": pid,
     "lo": lo,
@@ -124,6 +158,12 @@ band = {{
     "reliability": np.asarray(local_view(result.state.reliability)).tolist(),
     "loop_consensus": np.asarray(local_view(loop_consensus)).tolist(),
     "loop_reliability": np.asarray(local_view(loop_state.reliability)).tolist(),
+    "settle_market_keys": settle_result.market_keys,
+    "settle_consensus": np.asarray(settle_result.consensus).tolist(),
+    "settle_records": [
+        [r.source_id, r.market_id, r.reliability, r.confidence, r.updated_at]
+        for r in settle_store.list_sources()
+    ],
 }}
 pathlib.Path(outdir, f"band_{{pid}}.json").write_text(json.dumps(band))
 print("WORKER_OK", pid)
@@ -155,7 +195,7 @@ def worker_bands(tmp_path_factory):
     outputs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=300)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -208,6 +248,67 @@ class TestTwoProcessCluster:
                 rtol=2e-6,
                 atol=1e-6,
             )
+
+    def test_sharded_settle_matches_single_device(self, worker_bands):
+        """settle_sharded across the REAL 2-process cluster: the union of
+        the two band stores equals one single-device settle — same records
+        (conf/timestamps exact, rel to psum tolerance), same consensus."""
+        import math
+
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan,
+            settle,
+        )
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        rng2 = np.random.default_rng(SEED + 1)
+        payloads = []
+        for m in range(M):
+            n = int(rng2.integers(1, 5))
+            payloads.append((
+                f"market-{m}",
+                [
+                    {
+                        "sourceId": f"s{int(rng2.integers(0, 6))}",
+                        "probability": round(float(rng2.random()), 6),
+                    }
+                    for _ in range(n)
+                ],
+            ))
+        outcomes = (rng2.random(M) < 0.5).tolist()
+
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads)
+        ref = settle(store, plan, outcomes, steps=2, now=20750.0)
+        ref_records = {
+            (r.source_id, r.market_id): r for r in store.list_sources()
+        }
+        expected = dict(zip(ref.market_keys, np.asarray(ref.consensus)))
+
+        union = {}
+        keys_seen = []
+        for band in worker_bands:
+            for sid, mid, rel, conf, iso in band["settle_records"]:
+                assert (sid, mid) not in union, "bands overlap in the store"
+                union[(sid, mid)] = (rel, conf, iso)
+            keys_seen.extend(band["settle_market_keys"])
+            for key, value in zip(
+                band["settle_market_keys"], band["settle_consensus"]
+            ):
+                want = expected[key]
+                if math.isnan(want):
+                    assert value is None or math.isnan(value)
+                else:
+                    assert abs(value - want) < 2e-6, key
+        assert sorted(keys_seen) == sorted(ref.market_keys)
+        assert set(union) == set(ref_records)
+        for key, (rel, conf, iso) in union.items():
+            reference = ref_records[key]
+            assert abs(rel - reference.reliability) < 2e-6, key
+            assert conf == reference.confidence, key  # host-replayed exactly
+            assert iso == reference.updated_at, key
 
     def test_production_loop_matches_single_process(self, worker_bands):
         """build_cycle_loop (fast fori shape) across 2 processes == local."""
