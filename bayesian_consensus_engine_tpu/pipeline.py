@@ -603,6 +603,274 @@ def settle(
     )
 
 
+def _sharded_plan_cache(plan: SettlementPlan, mesh, cdtype):
+    """Pad + band + upload of the static plan arrays, cached on the plan.
+
+    Deterministic per (mesh, dtype) — repeat settlements re-upload only the
+    outcomes vector. Returns ``(padded_total, pad, lo, hi, band_rows,
+    band_mask, probs_g, mask_g)`` for THIS process's band.
+    """
+    from bayesian_consensus_engine_tpu.parallel.distributed import (
+        global_slot_block,
+        process_market_rows,
+    )
+    from bayesian_consensus_engine_tpu.parallel.mesh import (
+        MARKETS_AXIS,
+        SOURCES_AXIS,
+    )
+
+    cache = getattr(plan, "_sharded_cache", None)
+    cache_key = (mesh, str(cdtype))
+    if cache is None or cache[0] != cache_key:
+        num_markets = plan.num_markets
+        markets_extent = mesh.shape[MARKETS_AXIS]
+        sources_extent = mesh.shape[SOURCES_AXIS]
+        padded_total = (
+            -(-max(num_markets, 1) // markets_extent) * markets_extent
+        )
+        pad = padded_total - num_markets
+        num_slots = plan.num_slots
+        pad_k = (
+            -(-max(num_slots, 1) // sources_extent) * sources_extent
+            - num_slots
+        )
+
+        def pad_cols(array, fill):
+            return np.pad(
+                array, ((0, pad_k), (0, pad)), constant_values=fill
+            )
+
+        # This process's band of market columns — its shard of the work AND
+        # of the store's touched rows.
+        lo, hi = process_market_rows(padded_total, mesh)
+        band_rows = pad_cols(plan.slot_rows, -1)[:, lo:hi]
+        band_mask = pad_cols(plan.mask, False)[:, lo:hi]
+        probs_g = global_slot_block(
+            pad_cols(plan.probs, 0.0)[:, lo:hi].astype(cdtype),
+            mesh, padded_total,
+        )
+        mask_g = global_slot_block(band_mask, mesh, padded_total)
+        cache = (
+            cache_key, padded_total, pad, lo, hi,
+            band_rows, band_mask, probs_g, mask_g,
+        )
+        object.__setattr__(plan, "_sharded_cache", cache)
+    return cache[1:]
+
+
+class _BandGather:
+    """Lazy ``np.asarray``-able view of a sharded block's masked band.
+
+    Resolves to ``local_view(block)[band_mask]`` — this process's touched
+    values, in ``band_rows[band_mask]`` order — only when the store's sync
+    actually needs the bytes. Keeping the block on device until then is
+    what makes chained sharded settles free of per-settle transfers.
+    """
+
+    __slots__ = ("_block", "_mask")
+
+    def __init__(self, block, mask: np.ndarray) -> None:
+        self._block = block
+        self._mask = mask
+
+    def __len__(self) -> int:
+        return int(self._mask.sum())
+
+    def __array__(self, dtype=None, copy=None):
+        from bayesian_consensus_engine_tpu.parallel.distributed import (
+            local_view,
+        )
+
+        values = local_view(self._block)[self._mask]
+        return values.astype(dtype) if dtype is not None else values
+
+
+class _BandView:
+    """Lazy band slice of a markets-sharded per-market vector.
+
+    ``__array__`` resolves to this process's band rows (via ``local_view``);
+    scalar ``[i]`` indexes the GLOBAL vector at ``lo + i`` — an address this
+    process owns — so ``SettlementResult.fence`` stays a one-scalar fetch.
+    """
+
+    __slots__ = ("_vector", "_lo", "_live")
+
+    def __init__(self, vector, lo: int, live: int) -> None:
+        self._vector = vector
+        self._lo = lo
+        self._live = live
+
+    @property
+    def size(self) -> int:
+        return self._live
+
+    def __getitem__(self, index: int):
+        return self._vector[self._lo + index]
+
+    def __array__(self, dtype=None, copy=None):
+        from bayesian_consensus_engine_tpu.parallel.distributed import (
+            local_view,
+        )
+
+        values = np.asarray(local_view(self._vector))[: self._live]
+        return values.astype(dtype) if dtype is not None else values
+
+
+class ShardedSettlementSession:
+    """Chained, device-resident sharded settlements for one plan.
+
+    The mesh twin of :func:`settle`'s deferred chain: the sharded block
+    state is built (per process band) ONCE, every :meth:`settle` runs the
+    production loop on the retained state — the only per-settle
+    host→device traffic is the outcomes vector — and the store merge is a
+    registered sync recipe (closed-form stamps/existence + a lazy band
+    gather of reliabilities) that any host read resolves transparently.
+    Host confidences stay exact throughout via the eager replay.
+
+    Contract: one live session per store for any given set of rows — a
+    flat :func:`settle` or direct host write to rows this session covers,
+    while it is open, is not observed by the retained block state (the
+    store's single-writer contract, made explicit). ``plan``/``outcomes``
+    are indexed globally on every process; results cover this process's
+    band. Use as a context manager, or call :meth:`close`.
+    """
+
+    def __init__(self, store, plan: SettlementPlan, mesh, dtype=None):
+        from bayesian_consensus_engine_tpu.utils.dtypes import (
+            default_float_dtype,
+        )
+
+        self._store = store
+        self._plan = plan
+        self._mesh = mesh
+        self._cdtype = dtype or default_float_dtype()
+        (self._padded_total, self._pad, self._lo, self._hi,
+         self._band_rows, self._band_mask, self._probs_g,
+         self._mask_g) = _sharded_plan_cache(plan, mesh, self._cdtype)
+        self._touched = self._band_rows[self._band_mask]
+        self._state = None  # built lazily: epoch depends on the first now
+        self._epoch0 = None
+        self._loop = None
+
+    # -- state lifecycle -----------------------------------------------------
+
+    def _build_state(self, epoch0: float):
+        from bayesian_consensus_engine_tpu.parallel.distributed import (
+            global_slot_block,
+        )
+        from bayesian_consensus_engine_tpu.parallel.sharded import (
+            MarketBlockState,
+            build_cycle_loop,
+        )
+        from bayesian_consensus_engine_tpu.utils.config import (
+            DEFAULT_CONFIDENCE as _CONF0,
+            DEFAULT_RELIABILITY as _REL0,
+        )
+        from bayesian_consensus_engine_tpu.utils.timeconv import NEVER
+
+        store, mesh, cdtype = self._store, self._mesh, self._cdtype
+        band_mask = self._band_mask
+        safe = np.where(self._band_rows >= 0, self._band_rows, 0)
+        host_rel, host_conf, host_days, host_exists = store.host_rows(safe)
+        self._state = MarketBlockState(
+            reliability=global_slot_block(
+                np.where(band_mask, host_rel, _REL0).astype(cdtype),
+                mesh, self._padded_total,
+            ),
+            confidence=global_slot_block(
+                np.where(band_mask, host_conf, _CONF0).astype(cdtype),
+                mesh, self._padded_total,
+            ),
+            updated_days=global_slot_block(
+                np.where(
+                    band_mask & (host_days > NEVER), host_days - epoch0, 0.0
+                ).astype(cdtype),
+                mesh, self._padded_total,
+            ),
+            exists=global_slot_block(
+                band_mask & host_exists, mesh, self._padded_total
+            ),
+        )
+        self._epoch0 = epoch0
+        if self._loop is None:
+            self._loop = build_cycle_loop(mesh, slot_major=True, donate=True)
+
+    def settle(
+        self,
+        outcomes: Sequence[bool],
+        steps: int = 1,
+        now: Optional[float] = None,
+    ) -> SettlementResult:
+        """Run *steps* cycles on the retained sharded state."""
+        import jax.numpy as jnp
+
+        from bayesian_consensus_engine_tpu.parallel.distributed import (
+            global_market,
+        )
+
+        store, plan = self._store, self._plan
+        _check_plan(store, plan, outcomes)
+        now_abs = _now_days() if now is None else now
+        if self._state is None or now_abs <= self._epoch0:
+            # First settle, or time ran backwards past the epoch (stamps
+            # would go non-positive): (re)build from host at an epoch below
+            # now. The rebuild path keeps the rare backdated case bit-equal
+            # to the one-shot settle_sharded (no stamp re-expression drift).
+            store.sync()
+            self._build_state(min(store.epoch_origin(), now_abs - 1.0))
+
+        conf_exact = store.host_confidences(self._touched)
+        outcome_p = np.pad(
+            np.asarray(outcomes, dtype=bool), (0, self._pad),
+            constant_values=False,
+        )
+        outcome_g = global_market(
+            outcome_p[self._lo:self._hi], self._mesh, self._padded_total
+        )
+        new_state, consensus = self._loop(
+            self._probs_g, self._mask_g, outcome_g, self._state,
+            jnp.asarray(now_abs - self._epoch0, dtype=self._cdtype), steps,
+        )
+        self._state = new_state
+
+        # Merge recipe: closed-form stamps/existence; reliabilities stay on
+        # device behind a lazy band gather until a host read needs them.
+        np_dtype = np.dtype(self._cdtype).type
+        stamp_rel = np_dtype(
+            np_dtype(now_abs - self._epoch0) + np_dtype(steps - 1)
+        )
+        store.defer_settle_recipe(
+            self._touched,
+            _BandGather(new_state.reliability, self._band_mask),
+            self._epoch0,
+            stamp_rel,
+        )
+        _replay_confidences(store, self._touched, conf_exact, steps)
+
+        # A band can lie entirely in padding (more band capacity than
+        # markets): clamp so keys and consensus stay aligned (maybe empty).
+        band_stop = min(self._hi, plan.num_markets)
+        live = max(0, band_stop - self._lo)
+        return SettlementResult(
+            market_keys=plan.market_keys[self._lo:band_stop],
+            consensus=_BandView(consensus, self._lo, live),
+        )
+
+    def sync(self) -> None:
+        """Merge every deferred settlement into the host store now."""
+        self._store.sync()
+
+    def close(self) -> None:
+        self.sync()
+        self._state = None
+
+    def __enter__(self) -> "ShardedSettlementSession":
+        return self
+
+    def __exit__(self, *exc_info: object) -> None:
+        self.close()
+
+
 def settle_sharded(
     store,
     plan: SettlementPlan,
@@ -637,135 +905,16 @@ def settle_sharded(
     A sources-sharded (2-D) mesh splits each market's slot reduction into a
     ``psum`` of per-shard partial sums, a different (deterministic) float
     association: equal to ~1 ulp, not bitwise.
+
+    One-shot wrapper around :class:`ShardedSettlementSession` (state built,
+    one settle, synced into the store before returning); chain settlements
+    through a session directly to keep the block device-resident across
+    calls.
     """
-    import jax.numpy as jnp
-
-    from bayesian_consensus_engine_tpu.parallel.distributed import (
-        global_market,
-        global_slot_block,
-        local_view,
-        process_market_rows,
-    )
-    from bayesian_consensus_engine_tpu.parallel.mesh import (
-        MARKETS_AXIS,
-        SOURCES_AXIS,
-    )
-    from bayesian_consensus_engine_tpu.parallel.sharded import (
-        MarketBlockState,
-        build_cycle_loop,
-    )
-    from bayesian_consensus_engine_tpu.utils.config import (
-        DEFAULT_RELIABILITY as _REL0,
-        DEFAULT_CONFIDENCE as _CONF0,
-    )
-    from bayesian_consensus_engine_tpu.utils.dtypes import default_float_dtype
-    from bayesian_consensus_engine_tpu.utils.timeconv import NEVER
-
-    _check_plan(store, plan, outcomes)
-    cdtype = dtype or default_float_dtype()
-    num_markets = plan.num_markets
-
-    # Pad + band + upload of the static plan arrays is deterministic per
-    # (mesh, dtype): cached on the frozen plan like settle()'s device cache,
-    # so repeat settlements re-upload only the outcomes vector.
-    cache = getattr(plan, "_sharded_cache", None)
-    cache_key = (mesh, str(cdtype))
-    if cache is None or cache[0] != cache_key:
-        markets_extent = mesh.shape[MARKETS_AXIS]
-        sources_extent = mesh.shape[SOURCES_AXIS]
-        padded_total = (
-            -(-max(num_markets, 1) // markets_extent) * markets_extent
-        )
-        pad = padded_total - num_markets
-        num_slots = plan.num_slots
-        pad_k = (
-            -(-max(num_slots, 1) // sources_extent) * sources_extent
-            - num_slots
-        )
-
-        def pad_cols(array, fill):
-            return np.pad(
-                array, ((0, pad_k), (0, pad)), constant_values=fill
-            )
-
-        # This process's band of market columns — its shard of the work AND
-        # of the store's touched rows.
-        lo, hi = process_market_rows(padded_total, mesh)
-        band_rows = pad_cols(plan.slot_rows, -1)[:, lo:hi]
-        band_mask = pad_cols(plan.mask, False)[:, lo:hi]
-        probs_g = global_slot_block(
-            pad_cols(plan.probs, 0.0)[:, lo:hi].astype(cdtype),
-            mesh, padded_total,
-        )
-        mask_g = global_slot_block(band_mask, mesh, padded_total)
-        cache = (
-            cache_key, padded_total, pad, lo, hi,
-            band_rows, band_mask, probs_g, mask_g,
-        )
-        object.__setattr__(plan, "_sharded_cache", cache)
-    (_, padded_total, pad, lo, hi,
-     band_rows, band_mask, probs_g, mask_g) = cache
-    safe = np.where(band_rows >= 0, band_rows, 0)
-
-    touched_rows = band_rows[band_mask]
-    conf_exact = store.host_confidences(touched_rows)
-    now_abs = _now_days() if now is None else now
-    # Host-side twin of settle()'s _rebase_epoch: keep the settlement time
-    # strictly after the stamp epoch so written stamps stay positive
-    # (backdated settlements re-base instead of silently dropping stamps).
-    epoch0 = min(store.epoch_origin(), now_abs - 1.0)
-
-    host_rel, host_conf, host_days, host_exists = store.host_rows(safe)
-    state = MarketBlockState(
-        reliability=global_slot_block(
-            np.where(band_mask, host_rel, _REL0).astype(cdtype),
-            mesh, padded_total,
-        ),
-        confidence=global_slot_block(
-            np.where(band_mask, host_conf, _CONF0).astype(cdtype),
-            mesh, padded_total,
-        ),
-        updated_days=global_slot_block(
-            np.where(
-                band_mask & (host_days > NEVER), host_days - epoch0, 0.0
-            ).astype(cdtype),
-            mesh, padded_total,
-        ),
-        exists=global_slot_block(
-            band_mask & host_exists, mesh, padded_total
-        ),
-    )
-    outcome_p = np.pad(
-        np.asarray(outcomes, dtype=bool), (0, pad), constant_values=False
-    )
-    outcome_g = global_market(outcome_p[lo:hi], mesh, padded_total)
-
-    loop = build_cycle_loop(mesh, slot_major=True, donate=True)
-    new_state, consensus = loop(
-        probs_g, mask_g, outcome_g, state,
-        jnp.asarray(now_abs - epoch0, dtype=cdtype), steps,
-    )
-
-    # Host boundary out: this band's columns only, scattered back into the
-    # store's flat rows (a permutation write — one slot per pair).
-    store.absorb_rows(
-        touched_rows,
-        local_view(new_state.reliability)[band_mask],
-        local_view(new_state.confidence)[band_mask],
-        local_view(new_state.updated_days)[band_mask],
-        local_view(new_state.exists)[band_mask],
-        epoch0,
-    )
-    _replay_confidences(store, touched_rows, conf_exact, steps)
-
-    # A band can lie entirely in padding (more band capacity than markets):
-    # clamp so keys and consensus stay aligned (and possibly empty).
-    band_stop = min(hi, num_markets)
-    live = max(0, band_stop - lo)
-    return SettlementResult(
-        market_keys=plan.market_keys[lo:band_stop],
-        consensus=np.asarray(local_view(consensus))[:live],
-    )
+    session = ShardedSettlementSession(store, plan, mesh, dtype=dtype)
+    result = session.settle(outcomes, steps=steps, now=now)
+    session.close()
+    return result
 
 
 def settle_payloads(

@@ -206,6 +206,35 @@ class TensorReliabilityStore:
         self._device_cache = None
         self._cache_conf_drifted = False
 
+    def _append_sync_recipe(
+        self, recipes, touched_rows, rel_touched, epoch0: float, stamp_rel
+    ):
+        """Shared recipe-chain maintenance for both deferral entry points.
+
+        A link covering the same rows as an earlier one replaces it (the
+        later gather post-dates it): same array object for the cached-plan
+        chain, content equality for rebuilt plans. The chain is bounded —
+        each entry pins a touched-size device array, so a long chain of
+        DISTINCT plans would grow HBM linearly; applying the oldest links
+        early is always safe (they describe values that were final when
+        gathered; later links overwrite any overlap in order).
+        """
+        kept = [
+            r for r in (recipes or [])
+            if r[0] is not touched_rows
+            and not (
+                len(r[0]) == len(touched_rows)
+                and np.array_equal(r[0], touched_rows)
+            )
+        ]
+        kept.append((touched_rows, rel_touched, epoch0, stamp_rel))
+        while len(kept) > 8:
+            touched, rel_dev, r_epoch0, r_stamp = kept.pop(0)
+            self._apply_settle_recipe(
+                touched, np.asarray(rel_dev), r_epoch0, r_stamp
+            )
+        return kept
+
     def _apply_settle_recipe(
         self, touched: np.ndarray, rel_new, epoch0: float, stamp_rel
     ) -> None:
@@ -680,31 +709,47 @@ class TensorReliabilityStore:
             self._pending_sync = None
         else:
             touched_rows, rel_touched_dev, stamp_rel = sync_recipe
-            # A link covering the same rows as an earlier one replaces it
-            # (the later gather post-dates it): same array object for the
-            # cached-plan chain, content equality for rebuilt plans.
-            recipes = [
-                r for r in (self._pending_sync or [])
-                if r[0] is not touched_rows
-                and not (
-                    len(r[0]) == len(touched_rows)
-                    and np.array_equal(r[0], touched_rows)
-                )
-            ]
-            recipes.append((touched_rows, rel_touched_dev, epoch0, stamp_rel))
-            # Bound the chain: each entry pins a touched-size device array,
-            # so a long chain of DISTINCT plans would grow HBM linearly.
-            # Applying the oldest links early is always safe (they describe
-            # values that were final when gathered; later links overwrite
-            # any overlap in order).
-            while len(recipes) > 8:
-                touched, rel_dev, r_epoch0, r_stamp = recipes.pop(0)
-                self._apply_settle_recipe(
-                    touched, np.asarray(rel_dev), r_epoch0, r_stamp
-                )
-            self._pending_sync = recipes
+            self._pending_sync = self._append_sync_recipe(
+                self._pending_sync, touched_rows, rel_touched_dev, epoch0,
+                stamp_rel,
+            )
         self._pending = (state, epoch0)
         self._device_cache = (state, epoch0)
+
+    def defer_settle_recipe(
+        self, touched_rows: np.ndarray, rel_touched, epoch0: float, stamp_rel
+    ) -> None:
+        """Register a settle's host-merge recipe WITHOUT a flat device state.
+
+        The sharded settlement session's deferral: its state lives as a
+        plan-shaped sharded block (not the store's flat layout), so only the
+        merge recipe is registered — ``rel_touched`` may be any
+        ``np.asarray``-able (e.g. a lazy band-gather view); it is resolved at
+        sync time. Same accumulation rules as :meth:`defer_absorb`'s
+        recipes: content-duplicate touched sets replace, the chain is
+        bounded by early application, and orphaned recipes still sync.
+        """
+        if self._pending is not None:
+            # A flat pending state exists (recipe-less: its changes live
+            # only in that state; recipe-carrying: retaining it as the
+            # post-sync cache would hand later flat settles values that
+            # predate THIS recipe). Merge it now — mixed flat/session
+            # flows pay one sync; pure session chains never hit this.
+            self._sync_pending()
+        self._pending_sync = self._append_sync_recipe(
+            self._pending_sync, touched_rows, rel_touched, epoch0, stamp_rel
+        )
+        # The flat device cache no longer reflects these rows.
+        self._device_cache = None
+        self._cache_conf_drifted = False
+
+    def sync(self) -> None:
+        """Force any deferred settlement state into the host arrays now.
+
+        Reads and writes do this transparently; an explicit sync is for
+        timing boundaries and session teardown.
+        """
+        self._sync_pending()
 
     def absorb(self, state: DeviceReliabilityState, epoch0: float) -> None:
         """Write a mutated device pytree back into host-authoritative state.

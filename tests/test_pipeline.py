@@ -23,6 +23,7 @@ enable_x64 = jax.enable_x64
 
 from bayesian_consensus_engine_tpu.core import compute_consensus
 from bayesian_consensus_engine_tpu.pipeline import (
+    ShardedSettlementSession,
     build_settlement_plan,
     build_settlement_plan_columnar,
     settle,
@@ -640,6 +641,111 @@ class TestSyncRecipe:
         assert len(chained._pending_sync) <= 8
         stepwise = run(sync_each=True)
         assert chained.list_sources() == stepwise.list_sources()
+
+
+class TestShardedSession:
+    """Chained sharded settles must equal one-shot settle_sharded chains,
+    with the block state retained on device between calls."""
+
+    def _mesh(self):
+        from bayesian_consensus_engine_tpu.parallel import make_mesh
+
+        return make_mesh((4, 2))
+
+    def _payloads(self, seed=53, markets=24):
+        rng = random.Random(seed)
+        return random_payloads(rng, num_markets=markets, universe=9), [
+            rng.random() < 0.5 for _ in range(markets)
+        ]
+
+    def test_chained_session_equals_one_shot_chain(self):
+        payloads, outcomes = self._payloads()
+        mesh = self._mesh()
+
+        session_store = TensorReliabilityStore()
+        plan_s = build_settlement_plan(session_store, payloads)
+        with ShardedSettlementSession(session_store, plan_s, mesh) as sess:
+            results = [
+                sess.settle(outcomes, steps=2, now=20830.0 + day)
+                for day in range(3)
+            ]
+            # One recipe outstanding (same touched set replaces), state
+            # device-resident between calls.
+            assert len(session_store._pending_sync) == 1
+
+        oneshot_store = TensorReliabilityStore()
+        plan_o = build_settlement_plan(oneshot_store, payloads)
+        for day in range(3):
+            expected = settle_sharded(
+                oneshot_store, plan_o, outcomes, mesh, steps=2,
+                now=20830.0 + day,
+            )
+        np.testing.assert_array_equal(
+            results[-1].consensus, expected.consensus
+        )
+        assert session_store.list_sources() == oneshot_store.list_sources()
+
+    def test_mid_session_host_read_syncs(self):
+        payloads, outcomes = self._payloads(seed=59)
+        mesh = self._mesh()
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads)
+        eager = TensorReliabilityStore()
+        eager_plan = build_settlement_plan(eager, payloads)
+        with ShardedSettlementSession(store, plan, mesh) as sess:
+            sess.settle(outcomes, steps=1, now=20840.0)
+            settle_sharded(eager, eager_plan, outcomes, mesh, now=20840.0)
+            assert store.list_sources() == eager.list_sources()  # mid-chain
+            sess.settle(outcomes, steps=1, now=20841.0)
+        settle_sharded(eager, eager_plan, outcomes, mesh, now=20841.0)
+        assert store.list_sources() == eager.list_sources()
+
+    def test_mixed_flat_and_session_settles_stay_exact(self):
+        """A flat settle's pending state must not survive as the device
+        cache once a session recipe postdates it: the next flat settle has
+        to chain from fresh values (regression: stale-cache repro where 15
+        rows diverged)."""
+        mesh = self._mesh()
+        payloads_a, outcomes_a = self._payloads(seed=67, markets=16)
+        payloads_b, outcomes_b = self._payloads(seed=71, markets=16)
+        payloads_b = [(f"b-{k}", sigs) for k, (_, sigs) in
+                      zip(range(16), payloads_b)]
+
+        def run(mixed):
+            store = TensorReliabilityStore()
+            plan_a = build_settlement_plan(store, payloads_a)
+            plan_b = build_settlement_plan(store, payloads_b)
+            settle(store, plan_a, outcomes_a, steps=1, now=20860.0)
+            if mixed:
+                # Session recipe lands while plan_a's flat pending exists.
+                with ShardedSettlementSession(store, plan_b, mesh) as sess:
+                    sess.settle(outcomes_b, steps=1, now=20860.5)
+            else:
+                store.sync()
+                settle_sharded(
+                    store, plan_b, outcomes_b, mesh, steps=1, now=20860.5)
+                store.sync()
+            settle(store, plan_a, outcomes_a, steps=1, now=20861.0)
+            store.sync()
+            return store.list_sources()
+
+        assert run(mixed=True) == run(mixed=False)
+
+    def test_backdated_settle_rebuilds_exactly(self):
+        """now earlier than the session epoch forces the exact rebuild
+        path; the result must still match one-shot settle_sharded."""
+        payloads, outcomes = self._payloads(seed=61, markets=8)
+        mesh = self._mesh()
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads)
+        eager = TensorReliabilityStore()
+        eager_plan = build_settlement_plan(eager, payloads)
+        with ShardedSettlementSession(store, plan, mesh) as sess:
+            sess.settle(outcomes, steps=1, now=20850.0)
+            sess.settle(outcomes, steps=1, now=20700.0)  # time runs backwards
+        settle_sharded(eager, eager_plan, outcomes, mesh, now=20850.0)
+        settle_sharded(eager, eager_plan, outcomes, mesh, now=20700.0)
+        assert store.list_sources() == eager.list_sources()
 
 
 class TestLazyConsensus:
