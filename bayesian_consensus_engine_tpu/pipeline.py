@@ -93,6 +93,7 @@ def build_settlement_plan(
     store,
     payloads: Payload,
     native: Optional[bool] = None,
+    num_slots: Optional[int] = None,
 ) -> SettlementPlan:
     """Pack, intern, and lay out payloads as a dense settlement block.
 
@@ -104,6 +105,15 @@ def build_settlement_plan(
 
     Market ids must be unique within one plan: two slots mapping to the
     same flat row would race in the scatter.
+
+    ``num_slots`` pins the block's slot height K instead of deriving it
+    from the data's max pairs-per-market (error if the data needs more):
+    plans built across batches/processes then share one compiled shape —
+    and per-process band plans (see :class:`ShardedSettlementSession`)
+    MUST pass the globally-agreed K, since no process can see the others'
+    maxima. Note a different K compiles a different slot-reduction tree,
+    so consensus values can move ≤1 ulp vs the natural-K plan (state
+    updates are quantised ±0.1 steps and typically identical).
     """
     payloads = list(payloads)
     keys = [market_id for market_id, _ in payloads]
@@ -125,6 +135,7 @@ def build_settlement_plan(
         packed.pair_source_ids,
         pair_markets,
         packed.signals_per_market,
+        num_slots=num_slots,
     )
 
 
@@ -134,6 +145,7 @@ def build_settlement_plan_columnar(
     source_ids: Sequence[str],
     probabilities,
     offsets,
+    num_slots: Optional[int] = None,
 ) -> SettlementPlan:
     """Vectorised twin of :func:`build_settlement_plan` for columnar input.
 
@@ -153,6 +165,9 @@ def build_settlement_plan_columnar(
       scalar engine's float-summation order, reference: core.py:103);
     * duplicate signals from one (source, market) average in original
       signal order (reference: core.py:115-116).
+
+    ``num_slots`` pins the block's slot height K (see
+    :func:`build_settlement_plan`).
     """
     market_keys = list(market_keys)
     if len(set(market_keys)) != len(market_keys):
@@ -219,6 +234,7 @@ def build_settlement_plan_columnar(
         pair_sources,
         pair_markets,
         signals_per_market,
+        num_slots=num_slots,
     )
 
 
@@ -250,11 +266,19 @@ def _assemble_plan(
     pair_sources,
     pair_markets,
     signals_per_market,
+    num_slots: Optional[int] = None,
 ) -> SettlementPlan:
     """Shared plan tail: dense block fill + binding probes + freeze."""
     counts = np.diff(pair_offsets)
     num_markets = len(keys)
-    num_slots = int(counts.max()) if num_markets else 0
+    needed_slots = int(counts.max()) if num_markets else 0
+    if num_slots is None:
+        num_slots = needed_slots
+    elif needed_slots > num_slots:
+        raise ValueError(
+            f"num_slots={num_slots} but a market carries {needed_slots} "
+            "distinct sources"
+        )
 
     # Ragged pair lists → dense slot-major (K, M), written in place: slot k
     # of market m is its k-th pair (source-id-sorted within the market, the
@@ -603,12 +627,20 @@ def settle(
     )
 
 
-def _sharded_plan_cache(plan: SettlementPlan, mesh, cdtype):
+def _sharded_plan_cache(plan: SettlementPlan, mesh, cdtype, band=None):
     """Pad + band + upload of the static plan arrays, cached on the plan.
 
-    Deterministic per (mesh, dtype) — repeat settlements re-upload only the
-    outcomes vector. Returns ``(padded_total, pad, lo, hi, band_rows,
+    Deterministic per (mesh, dtype, band) — repeat settlements re-upload
+    only the outcomes vector. Returns ``(padded_total, lo, hi, band_rows,
     band_mask, probs_g, mask_g)`` for THIS process's band.
+
+    ``band=None``: the plan is GLOBAL (all markets on every process); this
+    process's columns are sliced out of it. ``band=(lo, global_markets)``:
+    the plan covers ONLY this process's markets (rows ``[lo, lo+M_plan)``
+    of a ``global_markets``-wide axis) — the multi-host ingest shape where
+    no process ever packs another's payloads; it must tile the process
+    band exactly, and the plan must be built with the globally-agreed
+    ``num_slots``.
     """
     from bayesian_consensus_engine_tpu.parallel.distributed import (
         global_slot_block,
@@ -620,38 +652,57 @@ def _sharded_plan_cache(plan: SettlementPlan, mesh, cdtype):
     )
 
     cache = getattr(plan, "_sharded_cache", None)
-    cache_key = (mesh, str(cdtype))
+    cache_key = (mesh, str(cdtype), band)
     if cache is None or cache[0] != cache_key:
-        num_markets = plan.num_markets
+        num_markets_global = plan.num_markets if band is None else band[1]
         markets_extent = mesh.shape[MARKETS_AXIS]
         sources_extent = mesh.shape[SOURCES_AXIS]
         padded_total = (
-            -(-max(num_markets, 1) // markets_extent) * markets_extent
+            -(-max(num_markets_global, 1) // markets_extent) * markets_extent
         )
-        pad = padded_total - num_markets
         num_slots = plan.num_slots
         pad_k = (
             -(-max(num_slots, 1) // sources_extent) * sources_extent
             - num_slots
         )
+        lo, hi = process_market_rows(padded_total, mesh)
+
+        if band is None:
+            # Global plan: pad columns to the full axis, slice this
+            # process's band of market columns — its shard of the work AND
+            # of the store's touched rows.
+            col_pad = padded_total - plan.num_markets
+            cols = slice(lo, hi)
+        else:
+            lo_plan = band[0]
+            live = max(0, min(hi, num_markets_global) - lo)
+            if lo_plan != lo or plan.num_markets != live:
+                raise ValueError(
+                    f"band plan covers rows [{lo_plan}, "
+                    f"{lo_plan + plan.num_markets}) but this process owns "
+                    f"[{lo}, {min(hi, num_markets_global)}) of the "
+                    f"{num_markets_global}-market axis"
+                )
+            # Band plan: the columns ARE the band; pad to its full width.
+            col_pad = (hi - lo) - plan.num_markets
+            cols = slice(None)
 
         def pad_cols(array, fill):
-            return np.pad(
-                array, ((0, pad_k), (0, pad)), constant_values=fill
+            padded = np.pad(
+                array, ((0, pad_k), (0, col_pad)), constant_values=fill
             )
+            return padded[:, cols]
 
-        # This process's band of market columns — its shard of the work AND
-        # of the store's touched rows.
-        lo, hi = process_market_rows(padded_total, mesh)
-        band_rows = pad_cols(plan.slot_rows, -1)[:, lo:hi]
-        band_mask = pad_cols(plan.mask, False)[:, lo:hi]
+        band_rows = pad_cols(plan.slot_rows, -1)
+        band_mask = pad_cols(plan.mask, False)
+        band_probs = pad_cols(plan.probs, 0.0)
+
         probs_g = global_slot_block(
-            pad_cols(plan.probs, 0.0)[:, lo:hi].astype(cdtype),
-            mesh, padded_total,
+            band_probs.astype(cdtype), mesh, padded_total
         )
         mask_g = global_slot_block(band_mask, mesh, padded_total)
         cache = (
-            cache_key, padded_total, pad, lo, hi,
+            cache_key, padded_total, lo, hi,
             band_rows, band_mask, probs_g, mask_g,
         )
         object.__setattr__(plan, "_sharded_cache", cache)
@@ -730,12 +781,18 @@ class ShardedSettlementSession:
     Contract: one live session per store for any given set of rows — a
     flat :func:`settle` or direct host write to rows this session covers,
     while it is open, is not observed by the retained block state (the
-    store's single-writer contract, made explicit). ``plan``/``outcomes``
-    are indexed globally on every process; results cover this process's
-    band. Use as a context manager, or call :meth:`close`.
+    store's single-writer contract, made explicit). With ``band=None``,
+    ``plan``/``outcomes`` are indexed globally on every process; with
+    ``band=(lo, global_markets)`` the plan and outcomes cover ONLY this
+    process's markets (the multi-host ingest shape: each process packs
+    its own payload shard, with a globally-agreed plan ``num_slots``).
+    Results cover this process's band either way. Use as a context
+    manager, or call :meth:`close`.
     """
 
-    def __init__(self, store, plan: SettlementPlan, mesh, dtype=None):
+    def __init__(
+        self, store, plan: SettlementPlan, mesh, dtype=None, band=None
+    ):
         from bayesian_consensus_engine_tpu.utils.dtypes import (
             default_float_dtype,
         )
@@ -743,10 +800,11 @@ class ShardedSettlementSession:
         self._store = store
         self._plan = plan
         self._mesh = mesh
+        self._band = band
         self._cdtype = dtype or default_float_dtype()
-        (self._padded_total, self._pad, self._lo, self._hi,
+        (self._padded_total, self._lo, self._hi,
          self._band_rows, self._band_mask, self._probs_g,
-         self._mask_g) = _sharded_plan_cache(plan, mesh, self._cdtype)
+         self._mask_g) = _sharded_plan_cache(plan, mesh, self._cdtype, band)
         self._touched = self._band_rows[self._band_mask]
         self._state = None  # built lazily: epoch depends on the first now
         self._epoch0 = None
@@ -820,12 +878,24 @@ class ShardedSettlementSession:
             self._build_state(min(store.epoch_origin(), now_abs - 1.0))
 
         conf_exact = store.host_confidences(self._touched)
-        outcome_p = np.pad(
-            np.asarray(outcomes, dtype=bool), (0, self._pad),
-            constant_values=False,
-        )
+        # Band-local outcome columns, padded to the band width (band mode:
+        # outcomes ARE band-local; global mode: pad globally then slice).
+        band_width = self._hi - self._lo
+        outcome_arr = np.asarray(outcomes, dtype=bool)
+        if self._band is None:
+            outcome_band = np.pad(
+                outcome_arr,
+                (0, self._padded_total - len(outcome_arr)),
+                constant_values=False,
+            )[self._lo:self._hi]
+        else:
+            outcome_band = np.pad(
+                outcome_arr,
+                (0, band_width - len(outcome_arr)),
+                constant_values=False,
+            )
         outcome_g = global_market(
-            outcome_p[self._lo:self._hi], self._mesh, self._padded_total
+            outcome_band, self._mesh, self._padded_total
         )
         new_state, consensus = self._loop(
             self._probs_g, self._mask_g, outcome_g, self._state,
@@ -849,10 +919,15 @@ class ShardedSettlementSession:
 
         # A band can lie entirely in padding (more band capacity than
         # markets): clamp so keys and consensus stay aligned (maybe empty).
-        band_stop = min(self._hi, plan.num_markets)
-        live = max(0, band_stop - self._lo)
+        if self._band is None:
+            band_stop = min(self._hi, plan.num_markets)
+            live = max(0, band_stop - self._lo)
+            keys = plan.market_keys[self._lo:band_stop]
+        else:
+            live = plan.num_markets  # the plan IS this process's band
+            keys = plan.market_keys
         return SettlementResult(
-            market_keys=plan.market_keys[self._lo:band_stop],
+            market_keys=keys,
             consensus=_BandView(consensus, self._lo, live),
         )
 

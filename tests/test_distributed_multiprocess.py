@@ -150,6 +150,26 @@ settle_result = settle_sharded(
     settle_store, settle_plan, settle_outcomes, mesh, steps=2, now=20750.0
 )
 
+# Band-ingest leg: this process packs ONLY its own markets' payloads
+# (globally-agreed num_slots) — the true multi-host ingest shape where no
+# process ever sees another's signals.
+from bayesian_consensus_engine_tpu.pipeline import ShardedSettlementSession
+
+blo, bhi = process_market_rows(M, mesh)
+band_payloads = payloads[blo:min(bhi, M)]
+band_outcomes = settle_outcomes[blo:min(bhi, M)]
+band_store = TensorReliabilityStore()
+band_plan = build_settlement_plan(band_store, band_payloads, num_slots=4)
+with ShardedSettlementSession(
+    band_store, band_plan, mesh, band=(blo, M)
+) as session:
+    band_result = session.settle(band_outcomes, steps=2, now=20750.0)
+band_consensus = np.asarray(band_result.consensus).tolist()
+band_records = [
+    [r.source_id, r.market_id, r.reliability, r.confidence, r.updated_at]
+    for r in band_store.list_sources()
+]
+
 band = {{
     "pid": pid,
     "lo": lo,
@@ -164,6 +184,9 @@ band = {{
         [r.source_id, r.market_id, r.reliability, r.confidence, r.updated_at]
         for r in settle_store.list_sources()
     ],
+    "bandplan_market_keys": band_result.market_keys,
+    "bandplan_consensus": band_consensus,
+    "bandplan_records": band_records,
 }}
 pathlib.Path(outdir, f"band_{{pid}}.json").write_text(json.dumps(band))
 print("WORKER_OK", pid)
@@ -308,6 +331,67 @@ class TestTwoProcessCluster:
             reference = ref_records[key]
             assert abs(rel - reference.reliability) < 2e-6, key
             assert conf == reference.confidence, key  # host-replayed exactly
+            assert iso == reference.updated_at, key
+
+    def test_band_ingest_settle_matches_single_device(self, worker_bands):
+        """The per-process band-plan path (each process packs ONLY its own
+        payload shard; plan built with the globally-agreed num_slots) must
+        reproduce the single-device settle across the real cluster."""
+        import math
+
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan,
+            settle,
+        )
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        rng2 = np.random.default_rng(SEED + 1)
+        payloads = []
+        for m in range(M):
+            n = int(rng2.integers(1, 5))
+            payloads.append((
+                f"market-{m}",
+                [
+                    {
+                        "sourceId": f"s{int(rng2.integers(0, 6))}",
+                        "probability": round(float(rng2.random()), 6),
+                    }
+                    for _ in range(n)
+                ],
+            ))
+        outcomes = (rng2.random(M) < 0.5).tolist()
+
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads)
+        ref = settle(store, plan, outcomes, steps=2, now=20750.0)
+        ref_records = {
+            (r.source_id, r.market_id): r for r in store.list_sources()
+        }
+        expected = dict(zip(ref.market_keys, np.asarray(ref.consensus)))
+
+        union = {}
+        keys_seen = []
+        for band in worker_bands:
+            for sid, mid, rel, conf, iso in band["bandplan_records"]:
+                assert (sid, mid) not in union, "band stores overlap"
+                union[(sid, mid)] = (rel, conf, iso)
+            keys_seen.extend(band["bandplan_market_keys"])
+            for key, value in zip(
+                band["bandplan_market_keys"], band["bandplan_consensus"]
+            ):
+                want = expected[key]
+                if math.isnan(want):
+                    assert value is None or math.isnan(value)
+                else:
+                    assert abs(value - want) < 2e-6, key
+        assert sorted(keys_seen) == sorted(ref.market_keys)
+        assert set(union) == set(ref_records)
+        for key, (rel, conf, iso) in union.items():
+            reference = ref_records[key]
+            assert abs(rel - reference.reliability) < 2e-6, key
+            assert conf == reference.confidence, key
             assert iso == reference.updated_at, key
 
     def test_production_loop_matches_single_process(self, worker_bands):

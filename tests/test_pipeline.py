@@ -731,6 +731,72 @@ class TestShardedSession:
 
         assert run(mixed=True) == run(mixed=False)
 
+    def test_band_plan_equals_global_plan(self):
+        """A per-process band plan (multi-host ingest shape) must settle
+        identically to the global plan. Single-process the band is the
+        whole axis, so the comparison is exact and the band bookkeeping
+        (validation, padding, result alignment) is fully exercised."""
+        payloads, outcomes = self._payloads(seed=73, markets=24)
+        mesh = self._mesh()
+
+        global_store = TensorReliabilityStore()
+        global_plan = build_settlement_plan(global_store, payloads)
+        with ShardedSettlementSession(global_store, global_plan, mesh) as s:
+            expected = s.settle(outcomes, steps=2, now=20870.0)
+
+        band_store = TensorReliabilityStore()
+        band_plan = build_settlement_plan(
+            band_store, payloads, num_slots=global_plan.num_slots)
+        with ShardedSettlementSession(
+            band_store, band_plan, mesh, band=(0, len(payloads))
+        ) as s:
+            got = s.settle(outcomes, steps=2, now=20870.0)
+
+        assert got.market_keys == expected.market_keys
+        np.testing.assert_array_equal(got.consensus, expected.consensus)
+        assert band_store.list_sources() == global_store.list_sources()
+
+    def test_band_plan_wrong_offset_rejected(self):
+        payloads, _ = self._payloads(seed=79, markets=24)
+        mesh = self._mesh()
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads[:12])
+        with pytest.raises(ValueError, match="band plan covers rows"):
+            ShardedSettlementSession(store, plan, mesh, band=(4, 24))
+
+    def test_num_slots_pins_block_height(self):
+        store = TensorReliabilityStore()
+        payloads = [("m", [{"sourceId": "a", "probability": 0.5},
+                           {"sourceId": "b", "probability": 0.75}])]
+        plan = build_settlement_plan(store, payloads, num_slots=5)
+        assert plan.num_slots == 5
+        assert int(plan.mask.sum()) == 2
+        with pytest.raises(ValueError, match="num_slots=1"):
+            build_settlement_plan(
+                TensorReliabilityStore(), payloads, num_slots=1)
+        col_plan = build_settlement_plan_columnar(
+            TensorReliabilityStore(), ["m"], ["a", "b"],
+            np.array([0.5, 0.75]), np.array([0, 2]), num_slots=5)
+        np.testing.assert_array_equal(col_plan.mask, plan.mask)
+        np.testing.assert_array_equal(col_plan.probs, plan.probs)
+
+    def test_pinned_num_slots_settles_like_natural(self):
+        payloads, outcomes = self._payloads(seed=81, markets=12)
+        natural_store = TensorReliabilityStore()
+        natural = settle(
+            natural_store, build_settlement_plan(natural_store, payloads),
+            outcomes, steps=2, now=20880.0)
+        pinned_store = TensorReliabilityStore()
+        pinned = settle(
+            pinned_store,
+            build_settlement_plan(pinned_store, payloads, num_slots=16),
+            outcomes, steps=2, now=20880.0)
+        # A different K compiles a different slot-reduction tree: consensus
+        # may move <= 1 ulp; the quantised state updates stay identical.
+        np.testing.assert_allclose(
+            natural.consensus, pinned.consensus, rtol=2e-7, atol=1e-7)
+        assert natural_store.list_sources() == pinned_store.list_sources()
+
     def test_backdated_settle_rebuilds_exactly(self):
         """now earlier than the session epoch forces the exact rebuild
         path; the result must still match one-shot settle_sharded."""
