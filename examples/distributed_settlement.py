@@ -82,6 +82,43 @@ def main() -> None:
     print(f"  my reliability band shape = {my_reliability.shape} "
           f"(flush this to the host-local SQLite shard)")
 
+    # ---- the same topology through the pipeline layer -------------------
+    # Raw payloads → per-band plan (this process packs ONLY its own
+    # payload shard, with the globally-agreed slot height) → chained
+    # device-resident settles → a band-local store any host read syncs.
+    from bayesian_consensus_engine_tpu.pipeline import (
+        ShardedSettlementSession,
+        build_settlement_plan,
+    )
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    band_payloads = [
+        (
+            f"market-{m}",
+            [
+                {
+                    "sourceId": f"s{int(rng.integers(0, 12))}",
+                    "probability": float(rng.random()),
+                }
+                for _ in range(int(rng.integers(1, 5)))
+            ],
+        )
+        for m in range(lo, min(hi, markets))
+    ]
+    store = TensorReliabilityStore()
+    plan = build_settlement_plan(store, band_payloads, num_slots=4)
+    outcomes = [bool(o) for o in outcome_band]
+    with ShardedSettlementSession(
+        store, plan, mesh, band=(lo, markets)
+    ) as session:
+        session.settle(outcomes, steps=2, now=20_900.0)
+        final = session.settle(outcomes, steps=1, now=20_901.0)  # chained
+    print(f"  session: {len(final.market_keys)} band markets settled twice "
+          f"device-resident; {len(store.list_sources())} records in this "
+          "host's store shard")
+
 
 if __name__ == "__main__":
     main()
