@@ -132,8 +132,8 @@ def build_settlement_plan(
         market_of_pair,
         packed.pair_offsets,
         _pair_means(packed),
-        packed.pair_source_ids,
-        pair_markets,
+        packed.pair_source_ids.__getitem__,
+        pair_markets.__getitem__,
         packed.signals_per_market,
         num_slots=num_slots,
     )
@@ -209,9 +209,7 @@ def build_settlement_plan_columnar(
     key = market_of_signal * stride + rank_of_code[codes]
     uniq_keys, pair_of_signal = np.unique(key, return_inverse=True)
     pair_market = (uniq_keys // stride).astype(np.int32)
-    pair_rank = uniq_keys % stride
-    pair_sources = [sid_of_rank[rank] for rank in pair_rank.tolist()]
-    pair_markets = [market_keys[row] for row in pair_market.tolist()]
+    pair_rank = (uniq_keys % stride).astype(np.int32)
     pair_offsets = np.searchsorted(
         pair_market, np.arange(num_markets + 1)
     ).astype(np.int64)
@@ -224,15 +222,19 @@ def build_settlement_plan_columnar(
     counts = np.bincount(pair_of_signal, minlength=num_pairs)
     pair_mean = sums / np.maximum(counts, 1)
 
-    rows = store.rows_for_arrays(pair_sources, pair_markets, allocate=True)
+    # Interning by (table, code): no per-pair string list is ever built —
+    # the binding probes below rehydrate the handful they sample.
+    rows = store.rows_for_indexed(
+        sid_of_rank, pair_rank, market_keys, pair_market
+    )
     return _assemble_plan(
         market_keys,
         rows,
         pair_market,
         pair_offsets,
         pair_mean,
-        pair_sources,
-        pair_markets,
+        lambda i: sid_of_rank[pair_rank[i]],
+        lambda i: market_keys[pair_market[i]],
         signals_per_market,
         num_slots=num_slots,
     )
@@ -263,8 +265,8 @@ def _assemble_plan(
     market_of_pair,
     pair_offsets,
     pair_mean,
-    pair_sources,
-    pair_markets,
+    source_of,
+    market_of,
     signals_per_market,
     num_slots: Optional[int] = None,
 ) -> SettlementPlan:
@@ -303,7 +305,7 @@ def _assemble_plan(
         probe_idx = {0, len(rows) - 1, int(np.argmax(rows))}
         probe_idx.update(range(0, len(rows), max(1, len(rows) // 8)))
         binding = tuple(
-            (int(rows[i]), pair_sources[i], pair_markets[i])
+            (int(rows[i]), source_of(i), market_of(i))
             for i in sorted(probe_idx)
         )
     else:

@@ -418,18 +418,50 @@ class TensorReliabilityStore:
         try:
             return self._pairs.intern_arrays(sources, markets)
         finally:
-            # Resync sidecars even when interning raises mid-batch (e.g. a
-            # NUL id): rows interned before the failure must get their
-            # sidecar slots or later record-API calls index out of range.
-            after = len(self._pairs)
-            if after > len(self._iso):
-                self._iso.extend([""] * (after - len(self._iso)))
-                self._ensure_capacity(after)
-                # A grown store makes any cached device state the wrong
-                # SHAPE (its values are still right): drop it so no
-                # consumer gathers against a short flat state. Pending
-                # state is unaffected — take_device_state shape-checks it.
-                self._invalidate()
+            self._resync_sidecars()
+
+    def _resync_sidecars(self) -> None:
+        """Grow sidecars/columns to the interner's row count.
+
+        Called in batch-interning ``finally`` blocks: even when interning
+        raises mid-batch (e.g. a NUL id), rows interned before the failure
+        must get their sidecar slots or later record-API calls index out of
+        range. A grown store also makes any cached device state the wrong
+        SHAPE (its values are still right), so the cache is dropped; pending
+        state is unaffected — take_device_state shape-checks it.
+        """
+        after = len(self._pairs)
+        if after > len(self._iso):
+            self._iso.extend([""] * (after - len(self._iso)))
+            self._ensure_capacity(after)
+            self._invalidate()
+
+    def rows_for_indexed(
+        self,
+        source_table: Sequence[str],
+        source_codes: np.ndarray,
+        market_table: Sequence[str],
+        market_codes: np.ndarray,
+    ) -> np.ndarray:
+        """Interning twin of :meth:`rows_for_arrays` for tabled ids.
+
+        Pairs arrive as (unique string table, int32 codes) per half; the
+        native interner resolves each TABLE entry once instead of paying
+        per-pair string traffic. Falls back to materialising the columns
+        when the C extension is absent. Always allocates.
+        """
+        interner = self._pairs
+        try:
+            if hasattr(interner, "intern_arrays_indexed"):
+                return interner.intern_arrays_indexed(
+                    source_table, source_codes, market_table, market_codes
+                )
+            return interner.intern_arrays(
+                [source_table[c] for c in source_codes.tolist()],
+                [market_table[c] for c in market_codes.tolist()],
+            )
+        finally:
+            self._resync_sidecars()
 
     def batch_get_reliability(
         self,

@@ -153,6 +153,24 @@ class NativePairInterner:
         buf = self._map.intern_pairs(sources, markets)
         return np.frombuffer(buf, dtype=np.int32)
 
+    def intern_arrays_indexed(
+        self,
+        source_table: Sequence[str],
+        source_codes: np.ndarray,
+        market_table: Sequence[str],
+        market_codes: np.ndarray,
+    ) -> np.ndarray:
+        """Pair interning from (unique table, code) halves — one C pass
+        that resolves each table string's UTF-8 once, not once per pair.
+        The columnar planner's shape: ids repeat heavily across pairs."""
+        buf = self._map.intern_pairs_indexed(
+            source_table,
+            np.ascontiguousarray(source_codes, dtype=np.int32),
+            market_table,
+            np.ascontiguousarray(market_codes, dtype=np.int32),
+        )
+        return np.frombuffer(buf, dtype=np.int32)
+
     def sorted_rows(self, rows: np.ndarray) -> np.ndarray:
         """Rows reordered by (source_id, market_id) — C memcmp over the key
         arena, which equals Python's tuple sort (see internmap.c notes)."""

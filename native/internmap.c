@@ -395,6 +395,132 @@ fail:
     return NULL;
 }
 
+/* intern_pairs_indexed(a_table, a_codes, b_table, b_codes) -> bytearray.
+ *
+ * Elementwise pair interning where each half is given as (unique string
+ * table, int32 code buffer) instead of one Python string per element: the
+ * UTF-8 views are resolved once per TABLE entry, so a million-pair batch
+ * whose ids repeat heavily (the settlement planner's shape — ~10k sources,
+ * unique-but-tabled markets) skips per-element PyUnicode traffic entirely.
+ * Code buffers must be contiguous int32 of equal element count; codes
+ * index their table (range-checked).
+ */
+static PyObject *
+InternMap_intern_pairs_indexed(InternMap *self, PyObject *args)
+{
+    PyObject *a_table_obj, *b_table_obj, *a_codes_obj, *b_codes_obj;
+    if (!PyArg_ParseTuple(args, "OOOO", &a_table_obj, &a_codes_obj,
+                          &b_table_obj, &b_codes_obj))
+        return NULL;
+
+    typedef struct { const char *buf; Py_ssize_t len; } strview_t;
+    PyObject *fast_a = NULL, *fast_b = NULL, *out = NULL;
+    strview_t *views_a = NULL, *views_b = NULL;
+    char *scratch = NULL;
+    Py_buffer codes_a, codes_b;
+    codes_a.obj = NULL;
+    codes_b.obj = NULL;
+
+    fast_a = PySequence_Fast(a_table_obj, "expected a sequence of str");
+    if (!fast_a) goto fail;
+    fast_b = PySequence_Fast(b_table_obj, "expected a sequence of str");
+    if (!fast_b) goto fail;
+    if (PyObject_GetBuffer(a_codes_obj, &codes_a, PyBUF_CONTIG_RO) < 0)
+        goto fail;
+    if (PyObject_GetBuffer(b_codes_obj, &codes_b, PyBUF_CONTIG_RO) < 0)
+        goto fail;
+    if (codes_a.len != codes_b.len || codes_a.len % 4 != 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "code buffers must be equal-length int32");
+        goto fail;
+    }
+    Py_ssize_t n = codes_a.len / 4;
+    Py_ssize_t na = PySequence_Fast_GET_SIZE(fast_a);
+    Py_ssize_t nb = PySequence_Fast_GET_SIZE(fast_b);
+
+    /* UTF-8 views resolve LAZILY, on a table entry's first use (cached on
+     * the str objects, which the caller's tables keep alive for the whole
+     * call): an entry no code references — e.g. a zero-signal market's
+     * id — is never validated, exactly like the per-pair paths it
+     * replaces. buf == NULL marks "not yet resolved". */
+    views_a = PyMem_Calloc((size_t)(na ? na : 1), sizeof(strview_t));
+    views_b = PyMem_Calloc((size_t)(nb ? nb : 1), sizeof(strview_t));
+    if (!views_a || !views_b) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+
+    out = PyByteArray_FromStringAndSize(NULL, n * 4);
+    if (!out || map_reserve_cold(self, (size_t)n) < 0) goto fail;
+    Py_ssize_t scratch_cap = 64;
+    scratch = PyMem_Malloc((size_t)scratch_cap);
+    if (!scratch) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+    const int32_t *ca = (const int32_t *)codes_a.buf;
+    const int32_t *cb = (const int32_t *)codes_b.buf;
+    int32_t *rows = (int32_t *)PyByteArray_AS_STRING(out);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int32_t ia = ca[i], ib = cb[i];
+        if (ia < 0 || ia >= na || ib < 0 || ib >= nb) {
+            PyErr_Format(PyExc_IndexError,
+                         "pair %zd: code (%d, %d) out of table range", i,
+                         ia, ib);
+            goto fail;
+        }
+        if (!views_a[ia].buf) {
+            views_a[ia].buf = utf8_of(PySequence_Fast_GET_ITEM(fast_a, ia),
+                                      &views_a[ia].len);
+            if (!views_a[ia].buf ||
+                reject_nul(views_a[ia].buf, views_a[ia].len) < 0)
+                goto fail;
+        }
+        if (!views_b[ib].buf) {
+            views_b[ib].buf = utf8_of(PySequence_Fast_GET_ITEM(fast_b, ib),
+                                      &views_b[ib].len);
+            if (!views_b[ib].buf ||
+                reject_nul(views_b[ib].buf, views_b[ib].len) < 0)
+                goto fail;
+        }
+        Py_ssize_t alen = views_a[ia].len, blen = views_b[ib].len;
+        if (alen + 1 + blen > scratch_cap) {
+            scratch_cap = (alen + 1 + blen) * 2;
+            char *grown = PyMem_Realloc(scratch, (size_t)scratch_cap);
+            if (!grown) {
+                PyErr_NoMemory();
+                goto fail;
+            }
+            scratch = grown;
+        }
+        memcpy(scratch, views_a[ia].buf, (size_t)alen);
+        scratch[alen] = '\0';
+        memcpy(scratch + alen + 1, views_b[ib].buf, (size_t)blen);
+        int32_t row = map_intern(self, scratch, (size_t)(alen + 1 + blen));
+        if (row < 0) goto fail;
+        rows[i] = row;
+    }
+    PyMem_Free(scratch);
+    PyMem_Free(views_a);
+    PyMem_Free(views_b);
+    PyBuffer_Release(&codes_a);
+    PyBuffer_Release(&codes_b);
+    Py_DECREF(fast_a);
+    Py_DECREF(fast_b);
+    return out;
+
+fail:
+    PyMem_Free(scratch);
+    PyMem_Free(views_a);
+    PyMem_Free(views_b);
+    if (codes_a.obj) PyBuffer_Release(&codes_a);
+    if (codes_b.obj) PyBuffer_Release(&codes_b);
+    Py_XDECREF(fast_a);
+    Py_XDECREF(fast_b);
+    Py_XDECREF(out);
+    return NULL;
+}
+
 static PyObject *
 InternMap_lookup(InternMap *self, PyObject *arg)
 {
@@ -877,6 +1003,9 @@ static PyMethodDef InternMap_methods[] = {
      "intern_batch(seq) -> bytearray of int32 rows"},
     {"intern_pairs", (PyCFunction)InternMap_intern_pairs, METH_VARARGS,
      "intern_pairs(seq_a, seq_b) -> bytearray of int32 rows"},
+    {"intern_pairs_indexed",
+     (PyCFunction)InternMap_intern_pairs_indexed, METH_VARARGS,
+     "intern_pairs_indexed(a_table, a_codes, b_table, b_codes) -> rows"},
     {"lookup", (PyCFunction)InternMap_lookup, METH_O,
      "lookup(id) -> row or -1 (no insertion)"},
     {"lookup_pair", (PyCFunction)InternMap_lookup_pair, METH_VARARGS,

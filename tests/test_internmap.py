@@ -272,3 +272,69 @@ class TestFlushSqlite:
                 np.array([0], dtype=np.int32),
                 np.array([0.5, 0.5]), np.array([0.25, 0.25]), ["", ""],
             )
+
+
+class TestIndexedPairs:
+    """intern_pairs_indexed == intern_pairs on the materialised columns."""
+
+    def test_matches_materialised_pairs(self):
+        rng = random.Random(17)
+        table_a = [f"src-é{i}" for i in range(40)]
+        table_b = [f"mkt-{i}" for i in range(25)]
+        codes_a = np.array(
+            [rng.randrange(40) for _ in range(3000)], dtype=np.int32)
+        codes_b = np.array(
+            [rng.randrange(25) for _ in range(3000)], dtype=np.int32)
+
+        indexed = internmap.InternMap()
+        got = np.frombuffer(
+            indexed.intern_pairs_indexed(table_a, codes_a, table_b, codes_b),
+            dtype=np.int32,
+        )
+        plain = internmap.InternMap()
+        want = np.frombuffer(
+            plain.intern_pairs(
+                [table_a[c] for c in codes_a.tolist()],
+                [table_b[c] for c in codes_b.tolist()],
+            ),
+            dtype=np.int32,
+        )
+        np.testing.assert_array_equal(got, want)
+        assert indexed.ids() == plain.ids()
+
+    def test_out_of_range_code_rejected(self):
+        raw = internmap.InternMap()
+        with pytest.raises(IndexError, match="out of table range"):
+            raw.intern_pairs_indexed(
+                ["a"], np.array([1], dtype=np.int32),
+                ["m"], np.array([0], dtype=np.int32))
+
+    def test_nul_in_table_rejected(self):
+        raw = internmap.InternMap()
+        with pytest.raises(ValueError, match="NUL"):
+            raw.intern_pairs_indexed(
+                ["a\0b"], np.array([0], dtype=np.int32),
+                ["m"], np.array([0], dtype=np.int32))
+
+    def test_mismatched_code_lengths_rejected(self):
+        raw = internmap.InternMap()
+        with pytest.raises(ValueError, match="equal-length"):
+            raw.intern_pairs_indexed(
+                ["a"], np.array([0, 0], dtype=np.int32),
+                ["m"], np.array([0], dtype=np.int32))
+
+    def test_empty(self):
+        raw = internmap.InternMap()
+        out = raw.intern_pairs_indexed(
+            [], np.zeros(0, dtype=np.int32), [], np.zeros(0, dtype=np.int32))
+        assert bytes(out) == b""
+
+    def test_unreferenced_table_entry_never_validated(self):
+        """A table entry no code references (e.g. a zero-signal market's
+        NUL-carrying id) must not raise — matching the per-pair paths."""
+        raw = internmap.InternMap()
+        rows = raw.intern_pairs_indexed(
+            ["ok", "bad\0sid"], np.array([0], dtype=np.int32),
+            ["m", 42], np.array([0], dtype=np.int32))
+        assert np.frombuffer(rows, dtype=np.int32).tolist() == [0]
+        assert raw.id_of(0) == ("ok", "m")
