@@ -886,10 +886,16 @@ InternMap_flush_sqlite(InternMap *self, PyObject *args)
      * reader holding the lock briefly delays the flush instead of
      * failing it. */
     ff_sql.busy_timeout(db, 5000);
-    /* 256 MB page cache for the bulk transaction: the default ~2 MB cache
-     * thrashes on a multi-million-row B-tree (measured 1.5x slower at 4M
-     * rows). Connection-local, not persisted in the file. */
-    if (ff_sql.exec(db, "PRAGMA journal_mode=WAL", NULL, NULL, NULL) !=
+    /* 16 KB pages for FRESH checkpoint files (fewer B-tree nodes for long
+     * text rows; measured ~13% on the bulk insert). Must precede WAL and
+     * the first write; a no-op on existing files, whose page size is
+     * fixed — any sqlite >= 3.12 reads either. 256 MB page cache for the
+     * bulk transaction: the default ~2 MB cache thrashes on a
+     * multi-million-row B-tree (measured 1.5x slower at 4M rows);
+     * connection-local, not persisted in the file. */
+    if (ff_sql.exec(db, "PRAGMA page_size=16384", NULL, NULL, NULL) !=
+            FF_SQLITE_OK ||
+        ff_sql.exec(db, "PRAGMA journal_mode=WAL", NULL, NULL, NULL) !=
             FF_SQLITE_OK ||
         ff_sql.exec(db, "PRAGMA foreign_keys=ON", NULL, NULL, NULL) !=
             FF_SQLITE_OK ||
