@@ -1,0 +1,32 @@
+"""Smoke the scalar examples as subprocesses (executable docs must run).
+
+Mirrors the reference's examples-as-documentation role (reference:
+examples/*.py); only the fast scalar examples run here — the device-loop
+examples (settlement_cycle, compact_settlement, distributed_settlement,
+settlement_service, batched_consensus) each pay tens of seconds of XLA
+compilation and are exercised through the library tests instead.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["basic_consensus.py", "reliability_tracking.py", "tie_breaking.py"],
+)
+def test_scalar_example_runs(name):
+    proc = subprocess.run(
+        [sys.executable, str(_ROOT / "examples" / name)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip(), "example produced no output"
