@@ -66,9 +66,10 @@ class SettlementPlan:
 
     The plan is **immutable after build** — ``build_settlement_plan`` marks
     every array read-only, because ``settle`` caches device copies of
-    ``slot_rows``/``probs``/``mask`` on the plan (keyed by dtype) and
+    ``slot_rows``/``probs``/``mask``/``touched_rows`` on the plan (keyed by
+    dtype) and
     ``settle_sharded`` caches its padded band + sharded device arrays
-    (keyed by mesh and dtype) to skip the host→device re-upload on repeat
+    (keyed by mesh, dtype, and band) to skip the host→device re-upload on repeat
     settlements; a mutated host array would silently diverge from its
     cached device twin.
     """
