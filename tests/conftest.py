@@ -15,10 +15,24 @@ inexact; the f64 parity gates REQUIRE the real CPU backend.
 import sys
 import pathlib
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+# Persist XLA compiles across pytest runs: the suite compiles hundreds of
+# small programs and host-CPU XLA time dominates its wall clock. The CPU
+# backend's executable serialization is well-supported (unlike the tunneled
+# TPU plugin, where this stays off — see bench.py). Best-effort.
+try:
+    _cache_dir = os.path.expanduser("~/.cache/bce_jax_test_cache")
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+except Exception:
+    pass
 
 # Make the repo root importable when tests run without an installed package.
 _ROOT = pathlib.Path(__file__).resolve().parents[1]
