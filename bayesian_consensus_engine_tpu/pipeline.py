@@ -37,6 +37,8 @@ signal topology, then any number of settlement cycles run device-only:
 
 from __future__ import annotations
 
+import queue as _queue
+import threading
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Mapping, Optional, Sequence
@@ -993,6 +995,129 @@ def settle_sharded(
     result = session.settle(outcomes, steps=steps, now=now)
     session.close()
     return result
+
+
+class PlanPrefetcher:
+    """Build settlement plans one batch ahead on a worker thread.
+
+    Ingest (pack → intern → block fill) is pure host-CPU work that round-3
+    measurements put at ~9-13 s per 1M-market batch, while the settle it
+    feeds is device work the host merely dispatches — so a stream of
+    batches settled serially leaves the chip idle during every ingest and
+    the host idle during every settle. This iterator overlaps them: ONE
+    worker thread builds plan N+1 (and, with ``depth`` > 1, N+1+k) while
+    the caller settles plan N.
+
+        with PlanPrefetcher(store, batches, num_slots=K) as plans:
+            for plan, outcomes in zip(plans, outcome_batches):
+                settle(store, plan, outcomes, steps=steps)
+
+    Equivalence: builds run sequentially on the single worker in batch
+    order, so interning order — and therefore row assignment, block
+    content, and settlement results — is identical to the serial loop
+    (tests/test_pipeline.py pins it). The store's host tier is
+    thread-safe (``TensorReliabilityStore._locked``), which is what makes
+    the worker's interning safe against the caller's settle-side host
+    reads.
+
+    ``columnar=False``: *batches* yields dict payloads
+    (:func:`build_settlement_plan`). ``columnar=True``: *batches* yields
+    ``(market_keys, source_ids, probabilities, offsets)`` tuples
+    (:func:`build_settlement_plan_columnar`). Pass ``num_slots`` pinned
+    across batches — otherwise each batch's natural K compiles its own
+    settle program. A build error surfaces on the ``next()`` that would
+    have yielded that plan; later batches are not attempted.
+    """
+
+    def __init__(
+        self,
+        store,
+        batches,
+        columnar: bool = False,
+        num_slots: Optional[int] = None,
+        native: Optional[bool] = None,
+        depth: int = 1,
+    ) -> None:
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._queue: "_queue.Queue" = _queue.Queue(maxsize=depth)
+        self._cancelled = threading.Event()
+        self._sentinel = object()
+
+        def build(batch):
+            if columnar:
+                keys, source_ids, probabilities, offsets = batch
+                return build_settlement_plan_columnar(
+                    store, keys, source_ids, probabilities, offsets,
+                    num_slots=num_slots,
+                )
+            return build_settlement_plan(
+                store, batch, native=native, num_slots=num_slots
+            )
+
+        def work():
+            # The iterator itself may raise (a generator streaming payloads
+            # from disk/network): that failure must surface on next() like
+            # a build failure, never collapse into a clean StopIteration.
+            try:
+                iterator = iter(batches)
+                while not self._cancelled.is_set():
+                    try:
+                        batch = next(iterator)
+                    except StopIteration:
+                        break
+                    plan = build(batch)
+                    self._put(("ok", plan))
+            except BaseException as exc:  # noqa: BLE001 — re-raised on next()
+                self._put(("err", exc))
+            finally:
+                self._put((self._sentinel, None))
+
+        self._worker = threading.Thread(
+            target=work, name="bce-plan-prefetch", daemon=True
+        )
+        self._worker.start()
+
+    def _put(self, item) -> None:
+        # A bounded put would deadlock against a consumer that stopped
+        # consuming (close() mid-stream): poll with the cancel flag.
+        while True:
+            try:
+                self._queue.put(item, timeout=0.1)
+                return
+            except _queue.Full:
+                if self._cancelled.is_set():
+                    return
+
+    def __iter__(self) -> "PlanPrefetcher":
+        return self
+
+    def __next__(self) -> SettlementPlan:
+        kind, value = self._queue.get()
+        if kind is self._sentinel:
+            self._queue.put((self._sentinel, None))  # stay terminated
+            raise StopIteration
+        if kind == "err":
+            raise value
+        return value
+
+    def close(self) -> None:
+        """Stop building; pending batches are dropped, the worker joined."""
+        self._cancelled.set()
+        while True:  # drain so a blocked worker put() can finish
+            try:
+                item = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            if item[0] is self._sentinel:
+                break
+        self._worker.join(timeout=60)
+
+    def __enter__(self) -> "PlanPrefetcher":
+        return self
+
+    def __exit__(self, *exc_info: object) -> None:
+        self.close()
 
 
 def settle_payloads(

@@ -26,6 +26,8 @@ the SQLite file *is* the checkpoint format (SURVEY §5).
 
 from __future__ import annotations
 
+import threading
+from functools import wraps
 from typing import List, NamedTuple, Optional, Sequence, Union
 from pathlib import Path
 
@@ -56,6 +58,93 @@ from bayesian_consensus_engine_tpu.utils.timeconv import (
 
 _GROW = 2
 _MIN_CAPACITY = 64
+
+
+def _locked(method):
+    """Serialise a host-tier method on the store's reentrant lock.
+
+    The host tier is thread-safe so ingest (plan building on a prefetch
+    thread — pipeline.PlanPrefetcher) can overlap with settle-side host
+    reads and background checkpoints: interning may GROW the flat arrays
+    (replacing them), and an unlocked concurrent ``_dirty[rows] = True``
+    against the pre-grow array would be lost. Device compute is unaffected
+    — dispatches hold the lock only for their host-side microseconds.
+    """
+
+    @wraps(method)
+    def wrapper(self, *args, **kwargs):
+        with self._host_lock:
+            return method(self, *args, **kwargs)
+
+    return wrapper
+
+
+class FlushHandle:
+    """An in-flight background SQLite checkpoint (``flush_to_sqlite_async``).
+
+    ``result()`` joins the writer thread and returns the written row count,
+    re-raising the writer's failure after ROLLING BACK the store's flush
+    bookkeeping (the snapshotted rows are re-marked dirty and the last-
+    flush target is restored, so the next flush re-covers everything this
+    one claimed — the on-disk file itself is untouched by a failed write:
+    the writer is one SQLite transaction). The store joins any in-flight
+    handle before starting another flush, so writes to a target never
+    interleave.
+    """
+
+    __slots__ = ("_store", "_thread", "_writer", "_rows", "_exc",
+                 "_restore", "_finished")
+
+    def __init__(self, store, writer, restore) -> None:
+        self._store = store
+        self._writer = writer
+        self._restore = restore  # (selected, dead, prev_path) | None
+        self._rows: Optional[int] = None
+        self._exc: Optional[BaseException] = None
+        self._finished = False
+        self._thread = threading.Thread(
+            target=self._run, name="bce-flush", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        # No store lock in here: the writer touches only snapshot data, and
+        # taking the lock from this thread could deadlock with a joiner
+        # that already holds it (result() is called under the store lock by
+        # the flush entry points).
+        try:
+            self._rows = self._writer()
+        except BaseException as exc:  # noqa: BLE001 — re-raised in result()
+            self._exc = exc
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def result(self, timeout: Optional[float] = None) -> int:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("background flush still running")
+        if self._finished:
+            if self._exc is not None:
+                raise self._exc
+            return self._rows
+        self._finished = True
+        if self._exc is not None:
+            store = self._store
+            with store._host_lock:
+                if store._flush_inflight is self:
+                    store._flush_inflight = None
+                if self._restore is not None:
+                    selected, dead, prev_path = self._restore
+                    store._dirty[selected] = True
+                    if dead:
+                        store._dirty[dead] = True
+                    store._last_flush_path = prev_path
+            raise self._exc
+        with self._store._host_lock:
+            if self._store._flush_inflight is self:
+                self._store._flush_inflight = None
+        return self._rows
 
 
 class DeviceReliabilityState(NamedTuple):
@@ -106,6 +195,12 @@ class TensorReliabilityStore:
         # (reference semantics: UPSERT only what changed, reliability.py:221-231).
         self._dirty = np.zeros(capacity, dtype=bool)
         self._last_flush_path: Optional[str] = None
+        # Host-tier thread safety (see _locked): one reentrant lock over
+        # every public host-side method, so plan-building ingest threads,
+        # settle-side host reads, and checkpoint bookkeeping can interleave
+        # safely. Device compute never waits on it.
+        self._host_lock = threading.RLock()
+        self._flush_inflight: Optional[FlushHandle] = None
 
     # -- row management ------------------------------------------------------
 
@@ -280,6 +375,7 @@ class TensorReliabilityStore:
 
     # -- record API (ReliabilityStore protocol) ------------------------------
 
+    @_locked
     def get_reliability(
         self,
         source_id: str,
@@ -313,6 +409,7 @@ class TensorReliabilityStore:
             updated_at=updated_at,
         )
 
+    @_locked
     def compute_update(
         self,
         source_id: str,
@@ -332,6 +429,7 @@ class TensorReliabilityStore:
             updated_at=utc_now_iso(),
         )
 
+    @_locked
     def update_reliability(
         self,
         source_id: str,
@@ -345,6 +443,7 @@ class TensorReliabilityStore:
         self.put_record(record)
         return record
 
+    @_locked
     def put_record(self, record: ReliabilityRecord) -> None:
         """Upsert a fully-specified record (import/seed/flush-back path)."""
         self._sync_pending()
@@ -357,6 +456,7 @@ class TensorReliabilityStore:
         self._dirty[row] = True
         self._invalidate()
 
+    @_locked
     def list_sources(self, market_id: Optional[str] = None) -> List[ReliabilityRecord]:
         self._sync_pending()
         selected = [
@@ -376,6 +476,7 @@ class TensorReliabilityStore:
             for key, row in selected
         ]
 
+    @_locked
     def close(self) -> None:
         """No external resources; present for store-API parity."""
 
@@ -387,6 +488,7 @@ class TensorReliabilityStore:
 
     # -- batch API -----------------------------------------------------------
 
+    @_locked
     def rows_for_pairs(
         self, pairs: Sequence[tuple[str, str]], allocate: bool = True
     ) -> np.ndarray:
@@ -400,6 +502,7 @@ class TensorReliabilityStore:
             [p[0] for p in pairs], [p[1] for p in pairs], allocate=allocate
         )
 
+    @_locked
     def rows_for_arrays(
         self,
         sources: Sequence[str],
@@ -436,6 +539,7 @@ class TensorReliabilityStore:
             self._ensure_capacity(after)
             self._invalidate()
 
+    @_locked
     def rows_for_indexed(
         self,
         source_table: Sequence[str],
@@ -463,6 +567,7 @@ class TensorReliabilityStore:
         finally:
             self._resync_sidecars()
 
+    @_locked
     def batch_get_reliability(
         self,
         pairs: Sequence[tuple[str, str]],
@@ -494,6 +599,7 @@ class TensorReliabilityStore:
             rel = np.where(eligible, decayed, rel)
         return rel, conf, exists
 
+    @_locked
     def batch_update_reliability(
         self,
         pairs: Sequence[tuple[str, str]],
@@ -525,6 +631,7 @@ class TensorReliabilityStore:
             self._iso[row] = stamp_iso
         self._invalidate()
 
+    @_locked
     def host_confidences(self, rows: np.ndarray) -> np.ndarray:
         """Exact f64 host confidences for *rows* (a copy; defaults when cold).
 
@@ -534,6 +641,7 @@ class TensorReliabilityStore:
         """
         return self._conf[rows].copy()
 
+    @_locked
     def host_rows(
         self, rows: np.ndarray
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -550,6 +658,7 @@ class TensorReliabilityStore:
             self._exists[rows],
         )
 
+    @_locked
     def overwrite_confidences(self, rows: np.ndarray, values: np.ndarray) -> None:
         """Replace confidences for *rows* with exact host-computed values.
 
@@ -572,6 +681,7 @@ class TensorReliabilityStore:
 
     # -- device tier ---------------------------------------------------------
 
+    @_locked
     def device_state(self, dtype=None, donate=False):
         """Materialise the HBM pytree (cached until the next host write).
 
@@ -620,17 +730,8 @@ class TensorReliabilityStore:
                     self._device_cache = None
                 return cached
 
-        dtype = dtype or default_float_dtype()
-        used = len(self._pairs)
-        stamps = self._days[:used]
-        epoch0 = self.epoch_origin()
-        relative = np.where(stamps > NEVER, stamps - epoch0, 0.0)
-
-        state = DeviceReliabilityState(
-            reliability=jnp.asarray(self._rel[:used], dtype=dtype),
-            confidence=jnp.asarray(self._conf[:used], dtype=dtype),
-            updated_days=jnp.asarray(relative, dtype=dtype),
-            exists=jnp.asarray(self._exists[:used]),
+        state, epoch0 = self._build_device_export(
+            len(self._pairs), dtype or default_float_dtype()
         )
         if donate:
             return (state, epoch0)
@@ -638,6 +739,27 @@ class TensorReliabilityStore:
         self._cache_conf_drifted = False  # freshly host-built: exact
         return self._device_cache
 
+    def _build_device_export(self, length: int, dtype):
+        """Host→device build of the first *length* rows (relative stamps).
+
+        ONE home for the stamp-relativization and dtype handling shared by
+        ``device_state`` (``len(store)`` rows, the public contract) and
+        ``take_device_state`` (capacity rows, the settle chain's stable
+        compiled shape)."""
+        import jax.numpy as jnp
+
+        stamps = self._days[:length]
+        epoch0 = self.epoch_origin()
+        relative = np.where(stamps > NEVER, stamps - epoch0, 0.0)
+        state = DeviceReliabilityState(
+            reliability=jnp.asarray(self._rel[:length], dtype=dtype),
+            confidence=jnp.asarray(self._conf[:length], dtype=dtype),
+            updated_days=jnp.asarray(relative, dtype=dtype),
+            exists=jnp.asarray(self._exists[:length]),
+        )
+        return state, epoch0
+
+    @_locked
     def epoch_origin(self) -> float:
         """The epoch-days origin for relative device stamps (min live −1)."""
         self._sync_pending()
@@ -646,6 +768,7 @@ class TensorReliabilityStore:
         live = stamps[stamps > NEVER]
         return float(live.min()) - 1.0 if live.size else 0.0
 
+    @_locked
     def take_device_state(self, dtype=None):
         """Pop the device state for a consumer that WILL ``defer_absorb`` a
         successor (the settle path's private entry).
@@ -661,6 +784,17 @@ class TensorReliabilityStore:
         as-is, drifted confidences included: the settle contract tolerates
         that drift (stored confidences are always the host replay), so a
         settle following a flush or host read also pays zero re-upload.
+
+        Unlike ``device_state`` (public, exactly ``len(store)`` rows), the
+        arrays here are CAPACITY-length: rows beyond ``len(store)`` are
+        cold-start pads (they read as never-updated defaults, exactly what
+        a newly interned pair must read as). Two wins, both load-bearing
+        for the streamed-batch service (pipeline.PlanPrefetcher): the
+        settle kernel's compiled shape follows the ×2 capacity ladder
+        instead of changing on every interned batch, and a pending chain
+        survives new interning — a handed-forward state whose length still
+        covers ``len(store)`` serves the next plan's new rows as the cold
+        pads they are, instead of forcing a sync + full re-upload.
         """
         from bayesian_consensus_engine_tpu.utils.dtypes import (
             default_float_dtype,
@@ -672,28 +806,32 @@ class TensorReliabilityStore:
         if self._pending is not None:
             state, epoch0 = self._pending
             if (
-                state.reliability.shape[0] == len(self._pairs)
+                state.reliability.shape[0] >= len(self._pairs)
                 and state.reliability.dtype == wanted
             ):
                 self._pending = None
                 self._device_cache = None
                 self._cache_conf_drifted = False
                 return state, epoch0
-            # Pairs were interned since the settle (new plan), or the
-            # caller wants a different precision: the pending arrays don't
-            # fit — merge and rebuild from the host.
+            # The store outgrew the pending arrays (interning passed the
+            # capacity they were exported at), or the caller wants a
+            # different precision: merge and rebuild from the host.
             self._sync_pending()
         if self._device_cache is not None:
             state, epoch0 = self._device_cache
             if (
-                state.reliability.shape[0] == len(self._pairs)
+                state.reliability.shape[0] >= len(self._pairs)
                 and state.reliability.dtype == wanted
             ):
                 self._device_cache = None
                 self._cache_conf_drifted = False
                 return state, epoch0
-        return self.device_state(dtype, donate=True)
+        self._sync_pending()
+        return self._build_device_export(
+            self._rel.shape[0], dtype or default_float_dtype()
+        )
 
+    @_locked
     def defer_absorb(
         self,
         state: DeviceReliabilityState,
@@ -731,8 +869,17 @@ class TensorReliabilityStore:
         host replay — so results and stored state still match the
         sync-every-time path (pinned by the chained-settle tests).
         """
-        if state.reliability.shape[0] != len(self._pairs):
-            raise ValueError("pending state size does not match the store")
+        # Any length in [0, capacity] is legitimate: a state may cover a
+        # PREFIX of the store (pairs interned after the settle dispatched —
+        # a prefetched next plan; _sync_pending merges at the state's own
+        # length) or EXCEED len(store) up to the capacity it was exported
+        # at (take_device_state pads to the capacity ladder; pad rows are
+        # cold defaults, and merging defaults over never-written host rows
+        # is a no-op — every host write syncs first, so no real value can
+        # sit beyond the export length). Beyond capacity is impossible for
+        # an honest settle and always an error.
+        if state.reliability.shape[0] > self._rel.shape[0]:
+            raise ValueError("pending state size exceeds the store capacity")
         if self._pending is not None:
             # Not chained through take_device_state: the predecessor's
             # changes are not in *state* — merge them first.
@@ -748,6 +895,7 @@ class TensorReliabilityStore:
         self._pending = (state, epoch0)
         self._device_cache = (state, epoch0)
 
+    @_locked
     def defer_settle_recipe(
         self, touched_rows: np.ndarray, rel_touched, epoch0: float, stamp_rel
     ) -> None:
@@ -775,6 +923,7 @@ class TensorReliabilityStore:
         self._device_cache = None
         self._cache_conf_drifted = False
 
+    @_locked
     def sync(self) -> None:
         """Force any deferred settlement state into the host arrays now.
 
@@ -783,6 +932,7 @@ class TensorReliabilityStore:
         """
         self._sync_pending()
 
+    @_locked
     def absorb(self, state: DeviceReliabilityState, epoch0: float) -> None:
         """Write a mutated device pytree back into host-authoritative state.
 
@@ -806,6 +956,7 @@ class TensorReliabilityStore:
             epoch0,
         )
 
+    @_locked
     def absorb_rows(
         self,
         rows: np.ndarray,
@@ -917,6 +1068,7 @@ class TensorReliabilityStore:
             store._last_flush_path = str(Path(db_path).resolve())
         return store
 
+    @_locked
     def flush_to_sqlite(
         self, db_path: Union[str, Path], incremental: Optional[bool] = None
     ) -> int:
@@ -949,6 +1101,28 @@ class TensorReliabilityStore:
             SQLiteReliabilityStore,
         )
 
+        target, incremental, selected, dead, used = self._plan_flush(
+            db_path, incremental
+        )
+        written = self._write_sqlite_rows(db_path, selected, incremental, used)
+        if dead:
+            with SQLiteReliabilityStore(db_path) as sqlite_store:
+                id_of = self._pairs.id_of
+                sqlite_store.delete_rows(id_of(r) for r in dead)
+        if target is not None:
+            self._dirty[:used] = False
+            self._last_flush_path = target
+        return written
+
+    def _plan_flush(self, db_path, incremental: Optional[bool]):
+        """Shared flush-entry bookkeeping: join any in-flight background
+        flush, sync pending device state, resolve the incremental mode,
+        and select the rows to write / delete. Returns
+        ``(target, incremental, selected, dead, used)``."""
+        if self._flush_inflight is not None:
+            # Serialise checkpoints: a second flush may not interleave with
+            # (or outrun) an in-flight one; a prior failure surfaces here.
+            self._flush_inflight.result()
         # ":memory:" is a fresh empty DB on every open — never a valid
         # incremental target.
         in_memory = str(db_path) == ":memory:"
@@ -985,15 +1159,129 @@ class TensorReliabilityStore:
             else []
         )
         selected = np.nonzero(select)[0]
-        written = self._write_sqlite_rows(db_path, selected, incremental, used)
-        if dead:
-            with SQLiteReliabilityStore(db_path) as sqlite_store:
-                id_of = self._pairs.id_of
-                sqlite_store.delete_rows(id_of(r) for r in dead)
+        return target, incremental, selected, dead, used
+
+    @_locked
+    def flush_to_sqlite_async(
+        self, db_path: Union[str, Path], incremental: Optional[bool] = None
+    ) -> FlushHandle:
+        """Checkpoint like :meth:`flush_to_sqlite`, writing on a background
+        thread so the caller overlaps the SQLite transaction with further
+        ingest/settle work.
+
+        The expensive write is split from a cheap synchronous SNAPSHOT: row
+        selection, key/value/timestamp capture, and dirty-flag bookkeeping
+        all happen before this returns (the checkpoint's content is exactly
+        the store's state as of this call); only the SQLite transaction runs
+        on the thread — through the native writer with the GIL RELEASED
+        (internmap.flush_snapshot), so the overlap is real, not
+        GIL-interleaved. Mutating the store after this call is safe and
+        does not affect the in-flight checkpoint.
+
+        Returns a :class:`FlushHandle`; call ``result()`` to join and get
+        the written row count (a failed write rolls the bookkeeping back —
+        see FlushHandle). Any subsequent flush joins the in-flight one
+        first, so checkpoints never interleave. A ``:memory:`` target also
+        runs on the thread — harmless (each connection opens a fresh
+        transient DB, exactly like the synchronous path) — so always join
+        via ``result()``, never assume completion.
+        """
+        target, incremental, selected, dead, used = self._plan_flush(
+            db_path, incremental
+        )
+        dead_ids = [self._pairs.id_of(r) for r in dead]
+        writer = self._build_snapshot_writer(db_path, selected, incremental,
+                                             used, dead_ids)
+        prev_path = self._last_flush_path
         if target is not None:
             self._dirty[:used] = False
             self._last_flush_path = target
-        return written
+            restore = (selected, dead, prev_path)
+        else:
+            restore = None
+        handle = FlushHandle(self, writer, restore)
+        self._flush_inflight = handle
+        return handle
+
+    def _ordered_flush_rows(self, selected, incremental, used):
+        """Selected rows in (source_id, market_id) key order + a row→key
+        accessor — ONE home for the checkpoint write order, shared by the
+        synchronous fallback and the async snapshot (their files must be
+        byte-identical). Touches only the selected rows: an incremental
+        flush of a handful of settled rows must not pay O(store) anywhere,
+        including id rehydration (per-row ``id_of`` beats the bulk
+        ``ids()`` list exactly when few rows are selected; bulk wins for a
+        full flush)."""
+        rows = selected.tolist()
+        if incremental and len(rows) * 8 < used:
+            id_of = self._pairs.id_of
+            keys = {r: id_of(r) for r in rows}
+        else:
+            keys = self._pairs.ids()
+        rows.sort(key=keys.__getitem__)
+        return rows, keys
+
+    def _build_snapshot_writer(self, db_path, selected, incremental, used,
+                               dead_ids):
+        """A zero-argument callable that writes the snapshotted rows.
+
+        Native path: one C ``snapshot_rows`` blob (key halves + stamps +
+        values copied out of the live arena) written by ``flush_snapshot``
+        with the GIL released. Fallback: the sqlite3-module parameter rows
+        are materialised NOW (snapshot semantics) and executed on the
+        thread — sqlite3 releases the GIL during its own C work, so the
+        overlap degrades gracefully rather than disappearing.
+        """
+        from bayesian_consensus_engine_tpu.state.sqlite_store import (
+            SQLiteReliabilityStore,
+        )
+
+        def delete_dead(path):
+            if dead_ids:
+                with SQLiteReliabilityStore(path) as sqlite_store:
+                    sqlite_store.delete_rows(iter(dead_ids))
+
+        if (
+            str(db_path) != ":memory:"
+            and getattr(self._pairs, "sqlite_writer_available", bool)()
+        ):
+            order = self._pairs.sorted_rows(
+                np.ascontiguousarray(selected, dtype=np.int32)
+            )
+            blob = self._pairs.snapshot_rows(
+                order, self._rel, self._conf, self._iso
+            )
+            flush_snapshot = self._pairs.flush_snapshot
+            path = str(db_path)
+
+            def writer():
+                written = flush_snapshot(path, blob)
+                delete_dead(path)
+                return written
+
+            return writer
+
+        # Fallback: snapshot as Python lists in the same key order the
+        # synchronous path writes (shared ordering helper — the two paths
+        # must produce identical DB bytes).
+        rows, keys = self._ordered_flush_rows(selected, incremental, used)
+        order = np.asarray(rows, dtype=np.int64)
+        rel = self._rel[order].tolist()
+        conf = self._conf[order].tolist()
+        iso = self._iso
+        key_sel = [keys[r] for r in rows]
+        sources = [k[0] for k in key_sel]
+        markets = [k[1] for k in key_sel]
+        stamps = [iso[r] for r in rows]
+
+        def writer():
+            params = zip(sources, markets, rel, conf, stamps)
+            with SQLiteReliabilityStore(db_path) as sqlite_store:
+                sqlite_store.put_rows(params)
+            delete_dead(db_path)
+            return len(rows)
+
+        return writer
 
     def _write_sqlite_rows(
         self, db_path, selected: np.ndarray, incremental: bool, used: int
@@ -1027,18 +1315,7 @@ class TensorReliabilityStore:
                 str(db_path), order, self._rel, self._conf, self._iso
             )
 
-        rows = selected.tolist()
-        # Everything below touches only the selected rows — an incremental
-        # flush of a handful of settled rows must not pay O(store) anywhere,
-        # including id rehydration (per-row id_of beats the bulk ids() list
-        # exactly when few rows are selected; bulk wins for a full flush).
-        if incremental and len(rows) * 8 < used:
-            id_of = self._pairs.id_of
-            keys = {r: id_of(r) for r in rows}
-            rows.sort(key=keys.__getitem__)
-        else:
-            keys = self._pairs.ids()
-            rows.sort(key=keys.__getitem__)
+        rows, keys = self._ordered_flush_rows(selected, incremental, used)
         order = np.asarray(rows, dtype=np.int64)
         rel = self._rel[order].tolist()
         conf = self._conf[order].tolist()
@@ -1066,6 +1343,7 @@ class TensorReliabilityStore:
     # cheaper than SQLite's per-row execute. Exact f64 host values
     # round-trip bit-identically.
 
+    @_locked
     def save_checkpoint(self, directory: Union[str, Path], step: int = 0) -> None:
         """Snapshot the full store (arrays + id/timestamp sidecars)."""
         from bayesian_consensus_engine_tpu.state.checkpoint import CycleCheckpointer

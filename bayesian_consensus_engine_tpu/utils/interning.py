@@ -204,6 +204,29 @@ class NativePairInterner:
             iso,
         )
 
+    def snapshot_rows(self, rows, rel, conf, iso) -> bytes:
+        """Self-contained flush blob for *rows* (key halves + iso + values).
+
+        The async-checkpoint half of :meth:`flush_sqlite`: the blob owns a
+        copy of everything the write needs, so :meth:`flush_snapshot` can
+        run it on a background thread with the GIL released while the
+        interner keeps growing (state/tensor_store.flush_to_sqlite_async).
+        """
+        return self._map.snapshot_rows(
+            np.ascontiguousarray(rows, dtype=np.int32),
+            np.ascontiguousarray(rel, dtype=np.float64),
+            np.ascontiguousarray(conf, dtype=np.float64),
+            iso,
+        )
+
+    @staticmethod
+    def flush_snapshot(db_path, blob: bytes) -> int:
+        """Write a :meth:`snapshot_rows` blob to SQLite, GIL released."""
+        module = _load_internmap()
+        if module is None:  # pragma: no cover — snapshot required the module
+            raise RuntimeError("native internmap extension not built")
+        return module.flush_snapshot(str(db_path), blob)
+
     def lookup_arrays(
         self, sources: Sequence[str], markets: Sequence[str]
     ) -> np.ndarray:

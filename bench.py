@@ -637,6 +637,122 @@ def bench_tiebreak_stress(markets=2048, agents=10_000, reps=3):
     }
 
 
+def _e2e_payloads(markets, mean_slots, seed=7):
+    """The e2e legs' shared synthetic payload shape (dict payloads)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    counts = rng.poisson(mean_slots - 1, markets) + 1
+    src = rng.integers(0, SOURCE_UNIVERSE, counts.sum())
+    prob = rng.random(counts.sum())
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    payloads = [
+        (
+            f"market-{m}",
+            [
+                {"sourceId": f"src-{src[i]}", "probability": float(prob[i])}
+                for i in range(offsets[m], offsets[m + 1])
+            ],
+        )
+        for m in range(markets)
+    ]
+    outcomes = rng.random(markets) < 0.5
+    return payloads, outcomes, counts, src, prob, offsets
+
+
+def bench_e2e_overlap(markets=NUM_MARKETS, mean_slots=4, steps=20):
+    """Serial vs overlapped two-batch settlement service at headline scale.
+
+    The same work, twice: batch A then batch B, each ingest → settle →
+    checkpoint to one DB file. The serial flow runs the legs back to back
+    (round-3 shape: chip idle during ingest/flush, host idle during
+    settle). The overlapped flow uses the round-4 machinery — a
+    PlanPrefetcher builds B's plan while A settles, and A's checkpoint
+    writes on a background thread (GIL-released native writer) while B
+    ingests/settles. Identical results by construction (pinned by
+    tests/test_overlap.py); the measured delta is pure wall-clock.
+    """
+    import gc
+    import tempfile as _tf
+
+    from bayesian_consensus_engine_tpu.pipeline import (
+        PlanPrefetcher,
+        build_settlement_plan,
+        settle,
+    )
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    half = markets // 2
+    payloads, outcomes, counts, _, _, _ = _e2e_payloads(markets, mean_slots)
+    pay_a, pay_b = payloads[:half], payloads[half:]
+    out_a, out_b = outcomes[:half], outcomes[half:]
+    # One pinned slot height: both flows compile ONE settle program.
+    num_slots = int(counts.max())
+
+    def run_serial(db):
+        store = TensorReliabilityStore()
+        start = time.perf_counter()
+        plan_a = build_settlement_plan(store, pay_a, num_slots=num_slots)
+        settle(store, plan_a, out_a, steps=steps).fence()
+        store.flush_to_sqlite(db)
+        plan_b = build_settlement_plan(store, pay_b, num_slots=num_slots)
+        settle(store, plan_b, out_b, steps=steps).fence()
+        store.flush_to_sqlite(db)
+        return time.perf_counter() - start
+
+    def run_overlapped(db):
+        store = TensorReliabilityStore()
+        start = time.perf_counter()
+        with PlanPrefetcher(
+            store, [pay_a, pay_b], num_slots=num_slots
+        ) as plans:
+            settle(store, next(plans), out_a, steps=steps).fence()
+            # A's checkpoint writes in the background while B's plan (built
+            # during A's settle) settles in the foreground.
+            handle = store.flush_to_sqlite_async(db)
+            settle(store, next(plans), out_b, steps=steps).fence()
+            handle.result()
+            store.flush_to_sqlite(db)
+        return time.perf_counter() - start
+
+    gc.freeze()
+    try:
+        with _tf.TemporaryDirectory() as tmp:
+            # Warm by running the serial flow once, untimed, at the REAL
+            # shapes (jit caches per exact store/block size, so a small
+            # warm-up shape would leave both of serial's first-compiles on
+            # its clock while the overlapped flow reused the cache; the
+            # capacity-ladder export keeps the overlapped flow's dispatch
+            # shapes on the same rungs). Flows then run ALTERNATING,
+            # min-of-2 each — this box's external load bursts can swing a
+            # host-bound pass several-fold, and alternation keeps a burst
+            # from landing wholly on one flow.
+            run_serial(os.path.join(tmp, "warm.db"))
+            t_serial = t_overlap = float("inf")
+            for trial in range(2):
+                t_serial = min(
+                    t_serial, run_serial(os.path.join(tmp, f"s{trial}.db"))
+                )
+                t_overlap = min(
+                    t_overlap,
+                    run_overlapped(os.path.join(tmp, f"o{trial}.db")),
+                )
+        return {
+            "workload": (
+                f"2 batches x {half} markets, {steps} cycles each, "
+                f"checkpoint per batch, min of 2 alternating trials"
+            ),
+            "serial_s": round(t_serial, 2),
+            "overlapped_s": round(t_overlap, 2),
+            "saved_s": round(t_serial - t_overlap, 2),
+            "speedup": round(t_serial / t_overlap, 3),
+        }
+    finally:
+        gc.unfreeze()
+
+
 def bench_e2e(markets=NUM_MARKETS, mean_slots=4, steps=20,
               resettle_markets=10_000):
     """The whole pipeline at headline scale, ingest and flush included.
@@ -659,22 +775,9 @@ def bench_e2e(markets=NUM_MARKETS, mean_slots=4, steps=20,
         TensorReliabilityStore,
     )
 
-    rng = np.random.default_rng(7)
-    counts = rng.poisson(mean_slots - 1, markets) + 1
-    src = rng.integers(0, SOURCE_UNIVERSE, counts.sum())
-    prob = rng.random(counts.sum())
-    offsets = np.concatenate([[0], np.cumsum(counts)])
-    payloads = [
-        (
-            f"market-{m}",
-            [
-                {"sourceId": f"src-{src[i]}", "probability": float(prob[i])}
-                for i in range(offsets[m], offsets[m + 1])
-            ],
-        )
-        for m in range(markets)
-    ]
-    outcomes = rng.random(markets) < 0.5
+    payloads, outcomes, counts, src, prob, offsets = _e2e_payloads(
+        markets, mean_slots
+    )
 
     # The 1M-dict payload fixture is long-lived caller data: without
     # gc.freeze() every generational collection re-scans its ~9M containers,
@@ -835,6 +938,9 @@ LEGS = {
     "e2e_pipeline": (
         bench_e2e, {}, dict(markets=2000, resettle_markets=200), 1500,
     ),
+    "e2e_overlap": (
+        bench_e2e_overlap, {}, dict(markets=2000, steps=3), 900,
+    ),
     "tiebreak_10k_agents": (
         bench_tiebreak_stress, {}, dict(markets=64, agents=128, reps=1), 900,
     ),
@@ -867,6 +973,7 @@ DEVICE_LEG_ORDER = [
     "north_star_band",
     "large_k",
     "e2e_pipeline",
+    "e2e_overlap",
     "tiebreak_10k_agents",
     "pallas_1m16",
 ]
@@ -1089,7 +1196,12 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
             "consensus+reliability-update cycles/sec at 1M markets x "
             "10k sources (dense; BASELINE.json shape)"
         ),
-        "measured_per_chip_band": band_value,
+        # The full band dict lives once, under extras.north_star_band.
+        "measured_per_chip_band": (
+            "see extras.north_star_band"
+            if isinstance(band_value, dict)
+            else band_value
+        ),
     }
     if band and band.get("ok") and isinstance(band["value"], dict):
         projected = band["value"].get("projected_v5e8_1m_x_10k_cycles_per_sec")
@@ -1135,6 +1247,7 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         "large_k": _show(results, "large_k"),
         "pallas_1m16_cycles_per_sec": _show(results, "pallas_1m16", round_to=1),
         "e2e_pipeline": _show(results, "e2e_pipeline"),
+        "e2e_overlap": _show(results, "e2e_overlap"),
         "tiebreak_10k_agents": _show(results, "tiebreak_10k_agents"),
         "per_slot_throughput": slot_updates,
         "harness": harness,

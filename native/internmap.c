@@ -967,6 +967,281 @@ fail_views:
     return NULL;
 }
 
+/* snapshot_rows(rows, rel, conf, iso) -> bytes
+ *
+ * A self-contained copy of everything a checkpoint flush needs for the
+ * given rows, in write order. Layout (native endianness, 8-aligned):
+ *
+ *   [0]        int64  n
+ *   [8]        int64  heap_off        (string heap's offset in the blob)
+ *   [16]       double vals[2n]        (reliability, confidence) per row
+ *   [16+16n]   int32  meta[6n]        (src_off, src_len, mkt_off, mkt_len,
+ *                                      iso_off, iso_len), heap-relative
+ *   [heap_off] string heap bytes
+ *
+ * The point of the copy: flush_sqlite must hold the GIL for its whole
+ * write (its SQLITE_STATIC bindings alias the live arena, which a
+ * concurrent intern may realloc). A snapshot owns its bytes, so
+ * flush_snapshot() below can release the GIL for the entire SQLite
+ * transaction and a background checkpoint thread truly overlaps with
+ * ingest/settle host work (state/tensor_store.flush_to_sqlite_async).
+ */
+static PyObject *
+InternMap_snapshot_rows(InternMap *self, PyObject *args)
+{
+    PyObject *rows_obj, *rel_obj, *conf_obj, *iso_obj;
+    if (!PyArg_ParseTuple(args, "OOOO", &rows_obj, &rel_obj, &conf_obj,
+                          &iso_obj))
+        return NULL;
+    if (!PyList_Check(iso_obj)) {
+        PyErr_SetString(PyExc_TypeError, "iso must be a list of str");
+        return NULL;
+    }
+
+    Py_buffer rows_view, rel_view, conf_view;
+    if (PyObject_GetBuffer(rows_obj, &rows_view, PyBUF_CONTIG_RO) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(rel_obj, &rel_view, PyBUF_CONTIG_RO) < 0) {
+        PyBuffer_Release(&rows_view);
+        return NULL;
+    }
+    if (PyObject_GetBuffer(conf_obj, &conf_view, PyBUF_CONTIG_RO) < 0) {
+        PyBuffer_Release(&rows_view);
+        PyBuffer_Release(&rel_view);
+        return NULL;
+    }
+    const int32_t *rows = (const int32_t *)rows_view.buf;
+    const double *rel = (const double *)rel_view.buf;
+    const double *conf = (const double *)conf_view.buf;
+    Py_ssize_t n = rows_view.len / 4;
+    Py_ssize_t value_rows = rel_view.len / 8;
+    PyObject *blob = NULL;
+    typedef struct { const char *buf; Py_ssize_t len; } strview_t;
+    strview_t *iso_views = NULL;
+    if (conf_view.len != rel_view.len || rows_view.len % 4 != 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "rows must be int32; rel/conf must be equal-length "
+                        "float64 columns");
+        goto out;
+    }
+    Py_ssize_t iso_len = PyList_GET_SIZE(iso_obj);
+
+    iso_views = PyMem_Malloc((size_t)(n ? n : 1) * sizeof(strview_t));
+    if (!iso_views) {
+        PyErr_NoMemory();
+        goto out;
+    }
+    /* Validate + measure the heap in one pass (utf8 views cache on the
+     * str objects the iso list keeps alive for this call). */
+    int64_t heap_len = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int32_t row = rows[i];
+        if (row < 0 || (size_t)row >= self->used || row >= value_rows ||
+            row >= iso_len) {
+            PyErr_Format(PyExc_IndexError,
+                         "row %d out of range of the map/columns", row);
+            goto out;
+        }
+        if (!memchr(self->arena + self->rows[row].off, '\0',
+                    self->rows[row].len)) {
+            PyErr_Format(PyExc_ValueError,
+                         "row %d is a single-string key, not a pair", row);
+            goto out;
+        }
+        PyObject *iso_item = PyList_GET_ITEM(iso_obj, row);
+        iso_views[i].buf = utf8_of(iso_item, &iso_views[i].len);
+        if (!iso_views[i].buf) goto out;
+        /* Key bytes minus the NUL separator + the iso stamp. */
+        heap_len += (int64_t)self->rows[row].len - 1 + iso_views[i].len;
+    }
+
+    int64_t heap_off = 16 + 16 * (int64_t)n + 24 * (int64_t)n;
+    if (heap_len > INT32_MAX) {
+        PyErr_SetString(PyExc_OverflowError,
+                        "snapshot heap exceeds int32 offsets");
+        goto out;
+    }
+    blob = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)(heap_off + heap_len));
+    if (!blob) goto out;
+    char *base = PyBytes_AS_STRING(blob);
+    ((int64_t *)base)[0] = n;
+    ((int64_t *)base)[1] = heap_off;
+    double *vals = (double *)(base + 16);
+    int32_t *meta = (int32_t *)(base + 16 + 16 * n);
+    char *heap = base + heap_off;
+    int32_t cursor = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int32_t row = rows[i];
+        const char *key = self->arena + self->rows[row].off;
+        size_t key_len = self->rows[row].len;
+        const char *sep = memchr(key, '\0', key_len);
+        int32_t src_len = (int32_t)(sep - key);
+        int32_t mkt_len = (int32_t)(key_len - (size_t)src_len - 1);
+        vals[2 * i] = rel[row];
+        vals[2 * i + 1] = conf[row];
+        meta[6 * i] = cursor;
+        meta[6 * i + 1] = src_len;
+        memcpy(heap + cursor, key, (size_t)src_len);
+        cursor += src_len;
+        meta[6 * i + 2] = cursor;
+        meta[6 * i + 3] = mkt_len;
+        memcpy(heap + cursor, sep + 1, (size_t)mkt_len);
+        cursor += mkt_len;
+        meta[6 * i + 4] = cursor;
+        meta[6 * i + 5] = (int32_t)iso_views[i].len;
+        memcpy(heap + cursor, iso_views[i].buf, (size_t)iso_views[i].len);
+        cursor += (int32_t)iso_views[i].len;
+    }
+
+out:
+    PyMem_Free(iso_views);
+    PyBuffer_Release(&rows_view);
+    PyBuffer_Release(&rel_view);
+    PyBuffer_Release(&conf_view);
+    return blob;
+}
+
+/* flush_snapshot(path, blob) -> written row count.
+ *
+ * The GIL-free twin of flush_sqlite over a snapshot_rows() blob: every
+ * binding points into the blob (owned bytes — nothing can move under it),
+ * so the whole SQLite transaction runs with the GIL RELEASED and a
+ * background flush thread overlaps with foreground Python. Identical
+ * observable file semantics to flush_sqlite (same pragmas, schema,
+ * empty-table INSERT fast path, UPSERT otherwise, one transaction).
+ */
+static PyObject *
+internmap_flush_snapshot(PyObject *Py_UNUSED(module), PyObject *args)
+{
+    const char *path;
+    Py_buffer blob;
+    if (!PyArg_ParseTuple(args, "sy*", &path, &blob))
+        return NULL;
+    if (sqlite_runtime_load() < 0) {
+        PyBuffer_Release(&blob);
+        PyErr_SetString(PyExc_RuntimeError,
+                        "libsqlite3 runtime library not available");
+        return NULL;
+    }
+    const char *base = (const char *)blob.buf;
+    if (blob.len < 16) {
+        PyBuffer_Release(&blob);
+        PyErr_SetString(PyExc_ValueError, "snapshot blob truncated");
+        return NULL;
+    }
+    int64_t n = ((const int64_t *)base)[0];
+    int64_t heap_off = ((const int64_t *)base)[1];
+    int64_t heap_len = (int64_t)blob.len - heap_off;
+    /* Bound n BEFORE the 16 + 40*n multiply: a corrupt blob with a huge n
+     * would overflow the int64 (UB) and could wrap past this check. */
+    if (n < 0 || n > ((int64_t)blob.len - 16) / 40 ||
+        heap_off != 16 + 40 * n || heap_off > (int64_t)blob.len) {
+        PyBuffer_Release(&blob);
+        PyErr_SetString(PyExc_ValueError, "malformed snapshot blob header");
+        return NULL;
+    }
+    const double *vals = (const double *)(base + 16);
+    const int32_t *meta = (const int32_t *)(base + 16 + 16 * n);
+    const char *heap = base + heap_off;
+    /* Bounds-check every span with the GIL still held (errors can raise
+     * here; the no-GIL region below must be exception-free). */
+    for (int64_t i = 0; i < n; i++) {
+        const int32_t *m = meta + 6 * i;
+        for (int half = 0; half < 3; half++) {
+            int64_t off = m[2 * half], len = m[2 * half + 1];
+            if (off < 0 || len < 0 || off + len > heap_len) {
+                PyBuffer_Release(&blob);
+                PyErr_SetString(PyExc_ValueError,
+                                "snapshot span out of bounds");
+                return NULL;
+            }
+        }
+    }
+
+    const char *fail_step = NULL;
+    char fail_msg[256] = "";
+    sqlite3 *db = NULL;
+    sqlite3_stmt *stmt = NULL;
+
+    Py_BEGIN_ALLOW_THREADS
+#define FF_NOGIL_FAIL(step)                                              \
+    do {                                                                 \
+        fail_step = (step);                                              \
+        snprintf(fail_msg, sizeof(fail_msg), "%s",                       \
+                 db ? ff_sql.errmsg(db) : "library unavailable");        \
+        goto nogil_done;                                                 \
+    } while (0)
+
+    if (ff_sql.open_v2(path, &db,
+                       FF_SQLITE_OPEN_READWRITE | FF_SQLITE_OPEN_CREATE,
+                       NULL) != FF_SQLITE_OK)
+        FF_NOGIL_FAIL("open");
+    ff_sql.busy_timeout(db, 5000);
+    if (ff_sql.exec(db, "PRAGMA page_size=16384", NULL, NULL, NULL) !=
+            FF_SQLITE_OK ||
+        ff_sql.exec(db, "PRAGMA journal_mode=WAL", NULL, NULL, NULL) !=
+            FF_SQLITE_OK ||
+        ff_sql.exec(db, "PRAGMA foreign_keys=ON", NULL, NULL, NULL) !=
+            FF_SQLITE_OK ||
+        ff_sql.exec(db, "PRAGMA cache_size=-262144", NULL, NULL, NULL) !=
+            FF_SQLITE_OK ||
+        ff_sql.exec(db, FF_SCHEMA_SQL, NULL, NULL, NULL) != FF_SQLITE_OK)
+        FF_NOGIL_FAIL("schema");
+
+    int empty = 0;
+    if (ff_sql.prepare_v2(db, "SELECT NOT EXISTS (SELECT 1 FROM sources)",
+                          -1, &stmt, NULL) != FF_SQLITE_OK ||
+        ff_sql.step(stmt) != FF_SQLITE_ROW)
+        FF_NOGIL_FAIL("empty probe");
+    empty = ff_sql.column_int(stmt, 0);
+    ff_sql.finalize(stmt);
+    stmt = NULL;
+
+    if (ff_sql.exec(db, "BEGIN", NULL, NULL, NULL) != FF_SQLITE_OK ||
+        ff_sql.prepare_v2(db, empty ? FF_INSERT_SQL : FF_UPSERT_SQL, -1,
+                          &stmt, NULL) != FF_SQLITE_OK)
+        FF_NOGIL_FAIL("begin");
+    for (int64_t i = 0; i < n; i++) {
+        const int32_t *m = meta + 6 * i;
+        if (ff_sql.bind_text(stmt, 1, heap + m[0], m[1],
+                             FF_SQLITE_STATIC) != FF_SQLITE_OK ||
+            ff_sql.bind_text(stmt, 2, heap + m[2], m[3],
+                             FF_SQLITE_STATIC) != FF_SQLITE_OK ||
+            ff_sql.bind_double(stmt, 3, vals[2 * i]) != FF_SQLITE_OK ||
+            ff_sql.bind_double(stmt, 4, vals[2 * i + 1]) != FF_SQLITE_OK ||
+            ff_sql.bind_text(stmt, 5, heap + m[4], m[5],
+                             FF_SQLITE_STATIC) != FF_SQLITE_OK ||
+            ff_sql.step(stmt) != FF_SQLITE_DONE ||
+            ff_sql.reset(stmt) != FF_SQLITE_OK)
+            FF_NOGIL_FAIL("insert");
+    }
+    ff_sql.finalize(stmt);
+    stmt = NULL;
+    if (ff_sql.exec(db, "COMMIT", NULL, NULL, NULL) != FF_SQLITE_OK)
+        FF_NOGIL_FAIL("commit");
+    ff_sql.close(db);
+    db = NULL;
+
+nogil_done:
+    if (fail_step) {
+        if (stmt) ff_sql.finalize(stmt);
+        if (db) {
+            ff_sql.exec(db, "ROLLBACK", NULL, NULL, NULL);
+            ff_sql.close(db);
+        }
+    }
+#undef FF_NOGIL_FAIL
+    Py_END_ALLOW_THREADS
+
+    PyBuffer_Release(&blob);
+    if (fail_step) {
+        PyErr_Format(PyExc_RuntimeError, "sqlite checkpoint (%s): %s",
+                     fail_step, fail_msg);
+        return NULL;
+    }
+    return PyLong_FromSsize_t((Py_ssize_t)n);
+}
+
 /* ---- type ---------------------------------------------------------------- */
 
 static PyObject *
@@ -1026,6 +1301,8 @@ static PyMethodDef InternMap_methods[] = {
      "sorted_rows(int32 buffer) -> bytearray of the rows in key order"},
     {"flush_sqlite", (PyCFunction)InternMap_flush_sqlite, METH_VARARGS,
      "flush_sqlite(path, rows, rel, conf, iso) -> written row count"},
+    {"snapshot_rows", (PyCFunction)InternMap_snapshot_rows, METH_VARARGS,
+     "snapshot_rows(rows, rel, conf, iso) -> self-contained flush blob"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -1058,6 +1335,8 @@ static PyTypeObject InternMapType = {
 static PyMethodDef internmap_functions[] = {
     {"sqlite_writer_available", internmap_sqlite_writer_available,
      METH_NOARGS, "whether flush_sqlite's libsqlite3 runtime is loadable"},
+    {"flush_snapshot", internmap_flush_snapshot, METH_VARARGS,
+     "flush_snapshot(path, blob) -> row count (GIL released during write)"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -193,6 +193,154 @@ print("WORKER_OK", pid)
 """
 
 
+M4, K4 = 17, 5  # 17 markets over 8 device columns: pads to 24, bands 6/6/5/0
+
+_WORKER4 = """
+import json, pathlib, sys
+
+sys.path.insert(0, {root!r})
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu.parallel import (
+    MarketBlockState,
+    build_cycle_loop,
+    init_block_state,
+)
+from bayesian_consensus_engine_tpu.parallel.distributed import (
+    global_block,
+    global_market,
+    init_distributed,
+    local_view,
+    make_hybrid_mesh,
+    process_market_rows,
+)
+from bayesian_consensus_engine_tpu.pipeline import (
+    ShardedSettlementSession,
+    build_settlement_plan,
+    settle_sharded,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+port, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+M, K, SEED = {m}, {k}, {seed}
+NUM_SLOTS = {num_slots}
+
+info = init_distributed(
+    coordinator_address=f"127.0.0.1:{{port}}", num_processes=4, process_id=pid
+)
+assert info["process_count"] == 4, info
+assert info["global_devices"] == 8, info
+
+# 4 granules x (2,1) ICI: markets extent 8, sources 1 — an off-multiple,
+# >2-process tiling (VERDICT r3 #6). M=17 pads to 24; the four process
+# bands cover 6/6/5/0 LIVE markets — uneven, including one process whose
+# band is pure padding.
+mesh = make_hybrid_mesh(ici_shape=(2, 1), num_granules=4)
+assert mesh.shape == {{"markets": 8, "sources": 1}}, dict(mesh.shape)
+
+padded = -(-M // 8) * 8
+lo, hi = process_market_rows(padded, mesh)
+assert hi - lo == padded // 4, (lo, hi)
+live = max(0, min(hi, M) - lo)
+
+rng = np.random.default_rng(SEED)
+full_probs = rng.random((M, K)).astype(np.float32)
+full_mask = rng.random((M, K)) < 0.8
+full_outcome = rng.random(M) < 0.5
+
+def band_rows(full, fill):
+    padded_full = np.pad(
+        full,
+        ((0, padded - M),) + ((0, 0),) * (full.ndim - 1),
+        constant_values=fill,
+    )
+    return padded_full[lo:hi]
+
+probs = global_block(band_rows(full_probs, 0.0), mesh, padded)
+mask = global_block(band_rows(full_mask, False), mesh, padded)
+outcome = global_market(band_rows(full_outcome, False), mesh, padded)
+state = MarketBlockState(
+    *(
+        global_block(np.asarray(x)[lo:hi], mesh, padded)
+        for x in init_block_state(padded, K)
+    )
+)
+loop_state, loop_consensus = build_cycle_loop(
+    mesh, slot_major=False, donate=False
+)(probs, mask, outcome, state, np.float32(1.0), 3)
+jax.block_until_ready(loop_consensus)
+
+rng2 = np.random.default_rng(SEED + 1)
+payloads = []
+for m in range(M):
+    n = int(rng2.integers(1, 5))
+    payloads.append((
+        f"market-{{m}}",
+        [
+            {{
+                "sourceId": f"s{{int(rng2.integers(0, 6))}}",
+                "probability": round(float(rng2.random()), 6),
+            }}
+            for _ in range(n)
+        ],
+    ))
+settle_outcomes = (rng2.random(M) < 0.5).tolist()
+
+# Global-plan sharded settle: every process builds the same plan, feeds
+# only its band, absorbs only its band's store rows.
+settle_store = TensorReliabilityStore()
+settle_plan = build_settlement_plan(settle_store, payloads)
+settle_result = settle_sharded(
+    settle_store, settle_plan, settle_outcomes, mesh, steps=2, now=20760.0
+)
+
+# Band-ingest leg: each process packs ONLY its own (possibly empty)
+# payload shard with the globally-agreed slot height.
+band_payloads = payloads[lo:min(hi, M)]
+band_outcomes = settle_outcomes[lo:min(hi, M)]
+band_store = TensorReliabilityStore()
+band_plan = build_settlement_plan(
+    band_store, band_payloads, num_slots=NUM_SLOTS
+)
+with ShardedSettlementSession(
+    band_store, band_plan, mesh, band=(lo, M)
+) as session:
+    band_result = session.settle(band_outcomes, steps=2, now=20760.0)
+assert len(band_result.market_keys) == live, (live, band_result.market_keys)
+
+band = {{
+    "pid": pid,
+    "lo": lo,
+    "hi": hi,
+    "live": live,
+    "loop_consensus": np.asarray(local_view(loop_consensus)).tolist(),
+    "loop_reliability": np.asarray(local_view(loop_state.reliability)).tolist(),
+    "settle_market_keys": settle_result.market_keys,
+    "settle_consensus": np.asarray(settle_result.consensus).tolist(),
+    "settle_records": [
+        [r.source_id, r.market_id, r.reliability, r.confidence, r.updated_at]
+        for r in settle_store.list_sources()
+    ],
+    "bandplan_market_keys": band_result.market_keys,
+    "bandplan_consensus": np.asarray(band_result.consensus).tolist(),
+    "bandplan_records": [
+        [r.source_id, r.market_id, r.reliability, r.confidence, r.updated_at]
+        for r in band_store.list_sources()
+    ],
+}}
+pathlib.Path(outdir, f"band4_{{pid}}.json").write_text(json.dumps(band))
+print("WORKER_OK", pid)
+"""
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -426,3 +574,166 @@ class TestTwoProcessCluster:
                 rtol=2e-6,
                 atol=1e-6,
             )
+
+
+@pytest.fixture(scope="module")
+def worker_bands4(tmp_path_factory):
+    """Run the four uneven-band workers to completion once."""
+    tmp = tmp_path_factory.mktemp("fourproc")
+    script = tmp / "worker4.py"
+    script.write_text(
+        _WORKER4.format(root=str(_ROOT), m=M4, k=K4, seed=SEED, num_slots=4)
+    )
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(pid), str(tmp)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(4)
+    ]
+    outputs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outputs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER_OK {pid}" in out
+    return [
+        json.loads((tmp / f"band4_{pid}.json").read_text())
+        for pid in range(4)
+    ]
+
+
+class TestFourProcessUnevenCluster:
+    """Off-multiple, >2-process tiling (VERDICT r3 #6): 17 markets pad to
+    24 over an 8-column markets axis; the four processes own 6/6/5/0 LIVE
+    markets — the general band math, a ragged final band, and a process
+    whose band is pure padding, all across a real gloo cluster."""
+
+    def test_bands_tile_contiguously_with_uneven_tail(self, worker_bands4):
+        padded = -(-M4 // 8) * 8
+        spans = sorted((b["lo"], b["hi"]) for b in worker_bands4)
+        assert spans == [(0, 6), (6, 12), (12, 18), (18, 24)]
+        assert spans[-1][1] == padded
+        assert sorted(b["live"] for b in worker_bands4) == [0, 5, 6, 6]
+
+    def test_production_loop_matches_single_process(self, worker_bands4):
+        rng = np.random.default_rng(SEED)
+        probs = rng.random((M4, K4)).astype(np.float32)
+        mask = rng.random((M4, K4)) < 0.8
+        outcome = rng.random(M4) < 0.5
+        padded = -(-M4 // 8) * 8
+        state, consensus = build_cycle_loop(
+            make_mesh((8, 1)), slot_major=False, donate=False
+        )(
+            jnp.asarray(np.pad(probs, ((0, padded - M4), (0, 0)))),
+            jnp.asarray(np.pad(mask, ((0, padded - M4), (0, 0)))),
+            jnp.asarray(np.pad(outcome, (0, padded - M4))),
+            init_block_state(padded, K4),
+            jnp.float32(1.0),
+            3,
+        )
+        expected_consensus = np.asarray(consensus)
+        expected_rel = np.asarray(state.reliability)
+        for band in worker_bands4:
+            lo, hi = band["lo"], band["hi"]
+            np.testing.assert_allclose(
+                np.asarray(band["loop_consensus"], np.float32),
+                expected_consensus[lo:hi],
+                rtol=2e-6,
+                atol=1e-6,
+            )
+            np.testing.assert_allclose(
+                np.asarray(band["loop_reliability"], np.float32),
+                expected_rel[lo:hi],
+                rtol=2e-6,
+                atol=1e-6,
+            )
+
+    def _union_parity(self, worker_bands4, keys_field, consensus_field,
+                      records_field):
+        import math
+
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan,
+            settle,
+        )
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        rng2 = np.random.default_rng(SEED + 1)
+        payloads = []
+        for m in range(M4):
+            n = int(rng2.integers(1, 5))
+            payloads.append((
+                f"market-{m}",
+                [
+                    {
+                        "sourceId": f"s{int(rng2.integers(0, 6))}",
+                        "probability": round(float(rng2.random()), 6),
+                    }
+                    for _ in range(n)
+                ],
+            ))
+        outcomes = (rng2.random(M4) < 0.5).tolist()
+
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads)
+        ref = settle(store, plan, outcomes, steps=2, now=20760.0)
+        ref_records = {
+            (r.source_id, r.market_id): r for r in store.list_sources()
+        }
+        expected = dict(zip(ref.market_keys, np.asarray(ref.consensus)))
+
+        union = {}
+        keys_seen = []
+        for band in worker_bands4:
+            for sid, mid, rel, conf, iso in band[records_field]:
+                assert (sid, mid) not in union, "bands overlap in the store"
+                union[(sid, mid)] = (rel, conf, iso)
+            keys_seen.extend(band[keys_field])
+            for key, value in zip(band[keys_field], band[consensus_field]):
+                want = expected[key]
+                if math.isnan(want):
+                    assert value is None or math.isnan(value)
+                else:
+                    assert abs(value - want) < 2e-6, key
+        assert sorted(keys_seen) == sorted(ref.market_keys)
+        assert set(union) == set(ref_records)
+        for key, (rel, conf, iso) in union.items():
+            reference = ref_records[key]
+            assert abs(rel - reference.reliability) < 2e-6, key
+            assert conf == reference.confidence, key
+            assert iso == reference.updated_at, key
+
+    def test_sharded_settle_union_matches_single_device(self, worker_bands4):
+        self._union_parity(
+            worker_bands4,
+            "settle_market_keys",
+            "settle_consensus",
+            "settle_records",
+        )
+
+    def test_band_ingest_union_matches_single_device(self, worker_bands4):
+        """Per-process band plans (one of them EMPTY) reproduce the
+        single-device settle; the padding-only process contributes zero
+        markets and zero records but still participates in the cluster."""
+        self._union_parity(
+            worker_bands4,
+            "bandplan_market_keys",
+            "bandplan_consensus",
+            "bandplan_records",
+        )
+        empty = [b for b in worker_bands4 if b["live"] == 0]
+        assert len(empty) == 1
+        assert empty[0]["bandplan_market_keys"] == []
+        assert empty[0]["bandplan_records"] == []

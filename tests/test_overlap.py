@@ -1,0 +1,382 @@
+"""Host-boundary overlap: background checkpoints + prefetched plan builds.
+
+Round 3 measured the e2e pipeline's legs running strictly serially —
+ingest, settle, and flush each leaving either the chip or the host idle.
+Round 4 overlaps them: ``flush_to_sqlite_async`` snapshots synchronously
+and writes the SQLite transaction on a background thread (GIL released in
+the native writer), and ``PlanPrefetcher`` builds plan N+1 on a worker
+thread while plan N settles. These tests pin the non-negotiable part:
+overlap must change WALL CLOCK ONLY — results, store state, and
+checkpoint files must be exactly what the serial path produces.
+"""
+
+import random
+import sqlite3
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from bayesian_consensus_engine_tpu.pipeline import (
+    PlanPrefetcher,
+    build_settlement_plan,
+    settle,
+)
+from bayesian_consensus_engine_tpu.state.records import ReliabilityRecord
+from bayesian_consensus_engine_tpu.state.tensor_store import TensorReliabilityStore
+
+
+def random_payloads(rng, num_markets, universe=40, max_signals=5, tag=""):
+    payloads = []
+    for m in range(num_markets):
+        n = rng.randint(1, max_signals)
+        signals = [
+            {
+                "sourceId": f"src-{rng.randrange(universe)}",
+                "probability": round(rng.random(), 6),
+            }
+            for _ in range(n)
+        ]
+        payloads.append((f"market{tag}-{m}", signals))
+    return payloads
+
+
+def seeded_store(n=25):
+    store = TensorReliabilityStore()
+    for i in range(n):
+        store.put_record(
+            ReliabilityRecord(
+                source_id=f"src-{i}",
+                market_id=f"mkt-{i % 4}",
+                reliability=0.5 + 0.01 * (i % 9),
+                confidence=0.25 + 0.01 * (i % 7),
+                updated_at=f"2026-07-{10 + i % 19:02d}T12:00:00+00:00",
+            )
+        )
+    return store
+
+
+def bump(store, source_id, market_id, rel=0.77):
+    """A deterministic dirty-making mutation (update_reliability stamps
+    wall-clock now, which can't be compared across two stores)."""
+    store.put_record(
+        ReliabilityRecord(
+            source_id=source_id,
+            market_id=market_id,
+            reliability=rel,
+            confidence=0.4,
+            updated_at="2026-07-29T09:00:00+00:00",
+        )
+    )
+
+
+def db_records(path):
+    with sqlite3.connect(path) as conn:
+        return conn.execute(
+            "SELECT source_id, market_id, reliability, confidence, updated_at"
+            " FROM sources ORDER BY source_id, market_id"
+        ).fetchall()
+
+
+class TestAsyncFlush:
+    def test_matches_sync_flush(self, tmp_path):
+        sync_db = tmp_path / "sync.db"
+        async_db = tmp_path / "async.db"
+        seeded_store().flush_to_sqlite(sync_db)
+        handle = seeded_store().flush_to_sqlite_async(async_db)
+        assert handle.result() == 25
+        assert handle.done()
+        assert db_records(async_db) == db_records(sync_db)
+
+    def test_incremental_async_writes_only_dirty(self, tmp_path):
+        db = tmp_path / "ckpt.db"
+        store = seeded_store()
+        store.flush_to_sqlite_async(db).result()
+        bump(store, "src-3", "mkt-3")
+        bump(store, "src-7", "mkt-3", rel=0.11)
+        handle = store.flush_to_sqlite_async(db)
+        assert handle.result() == 2
+        # The file reflects the updates and still holds every row.
+        twin = seeded_store()
+        bump(twin, "src-3", "mkt-3")
+        bump(twin, "src-7", "mkt-3", rel=0.11)
+        expect = tmp_path / "expect.db"
+        twin.flush_to_sqlite(expect)
+        assert db_records(db) == db_records(expect)
+
+    def test_failed_write_rolls_back_bookkeeping(self, tmp_path, monkeypatch):
+        db = tmp_path / "ckpt.db"
+        store = seeded_store()
+        store.flush_to_sqlite(db)
+        bump(store, "src-5", "mkt-1")
+
+        def broken_writer(*args, **kwargs):
+            def writer():
+                raise RuntimeError("disk on fire")
+
+            return writer
+
+        monkeypatch.setattr(store, "_build_snapshot_writer", broken_writer)
+        handle = store.flush_to_sqlite_async(db)
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            handle.result()
+        monkeypatch.undo()
+        # The failed flush re-marked its rows dirty and restored the
+        # target, so the retry still covers the update incrementally.
+        assert store.flush_to_sqlite(db) == 1
+        twin = seeded_store()
+        bump(twin, "src-5", "mkt-1")
+        expect = tmp_path / "expect.db"
+        twin.flush_to_sqlite(expect)
+        assert db_records(db) == db_records(expect)
+
+    def test_prior_failure_surfaces_on_next_flush(self, tmp_path, monkeypatch):
+        db = tmp_path / "ckpt.db"
+        store = seeded_store()
+
+        def broken_writer(*args, **kwargs):
+            def writer():
+                raise RuntimeError("transient outage")
+
+            return writer
+
+        monkeypatch.setattr(store, "_build_snapshot_writer", broken_writer)
+        store.flush_to_sqlite_async(db)  # handle dropped: service crashed
+        monkeypatch.undo()
+        with pytest.raises(RuntimeError, match="transient outage"):
+            store.flush_to_sqlite(db)
+        # The retry after the surfaced failure writes the full checkpoint.
+        assert store.flush_to_sqlite(db) == 25
+        expect = tmp_path / "expect.db"
+        seeded_store().flush_to_sqlite(expect)
+        assert db_records(db) == db_records(expect)
+
+    def test_flushes_serialise_never_interleave(self, tmp_path):
+        db = tmp_path / "ckpt.db"
+        store = seeded_store()
+        first = store.flush_to_sqlite_async(db)
+        bump(store, "src-1", "mkt-1")
+        # Starting the second flush joins the first — by the time it
+        # snapshots, the file holds the full checkpoint to delta against.
+        second = store.flush_to_sqlite_async(db)
+        assert first.done()
+        assert second.result() == 1
+        twin = seeded_store()
+        bump(twin, "src-1", "mkt-1")
+        expect = tmp_path / "expect.db"
+        twin.flush_to_sqlite(expect)
+        assert db_records(db) == db_records(expect)
+
+    def test_mutations_after_snapshot_do_not_leak_into_checkpoint(
+        self, tmp_path, monkeypatch
+    ):
+        """The checkpoint is the state AS OF the call, not of the join."""
+        db = tmp_path / "ckpt.db"
+        store = seeded_store()
+        gate = threading.Event()
+        real_builder = store._build_snapshot_writer
+
+        def gated_builder(*args, **kwargs):
+            writer = real_builder(*args, **kwargs)
+
+            def slow_writer():
+                gate.wait(timeout=30)
+                return writer()
+
+            return slow_writer
+
+        monkeypatch.setattr(store, "_build_snapshot_writer", gated_builder)
+        handle = store.flush_to_sqlite_async(db)
+        # Mutate AFTER the snapshot, while the write is still gated.
+        bump(store, "src-2", "mkt-2")
+        gate.set()
+        assert handle.result() == 25
+        expect = tmp_path / "expect.db"
+        seeded_store().flush_to_sqlite(expect)
+        assert db_records(db) == db_records(expect)
+        # ...and the mutation is still pending for the NEXT checkpoint.
+        assert store.flush_to_sqlite(db) == 1
+
+    def test_memory_target(self):
+        handle = seeded_store().flush_to_sqlite_async(":memory:")
+        assert handle.result() == 25
+
+
+def serial_plans_and_settle(payload_batches, outcome_batches, steps=2):
+    store = TensorReliabilityStore()
+    plans, results = [], []
+    for payloads, outcomes in zip(payload_batches, outcome_batches):
+        plan = build_settlement_plan(store, payloads)
+        plans.append(plan)
+        results.append(
+            settle(store, plan, outcomes, steps=steps, now=20_300.0)
+        )
+    store.sync()
+    return store, plans, results
+
+
+class TestPlanPrefetcher:
+    def _batches(self, num_batches=4, markets=17):
+        rng = random.Random(99)
+        payload_batches = [
+            random_payloads(rng, markets, tag=f"-b{b}")
+            for b in range(num_batches)
+        ]
+        outcome_batches = [
+            [rng.random() < 0.5 for _ in range(markets)]
+            for _ in range(num_batches)
+        ]
+        return payload_batches, outcome_batches
+
+    def test_prefetched_settles_match_serial(self):
+        payload_batches, outcome_batches = self._batches()
+        serial_store, serial_plans, serial_results = serial_plans_and_settle(
+            payload_batches, outcome_batches
+        )
+
+        store = TensorReliabilityStore()
+        results = []
+        with PlanPrefetcher(store, payload_batches) as plans:
+            for plan, serial_plan, outcomes in zip(
+                plans, serial_plans, outcome_batches
+            ):
+                # Identical row assignment, block content, and probes.
+                assert np.array_equal(plan.slot_rows, serial_plan.slot_rows)
+                assert np.array_equal(plan.probs, serial_plan.probs)
+                assert np.array_equal(plan.mask, serial_plan.mask)
+                assert plan.binding == serial_plan.binding
+                results.append(
+                    settle(store, plan, outcomes, steps=2, now=20_300.0)
+                )
+        store.sync()
+        for mine, serial in zip(results, serial_results):
+            assert np.array_equal(
+                mine.consensus, serial.consensus, equal_nan=True
+            )
+        assert np.array_equal(
+            store._rel[: len(store)], serial_store._rel[: len(serial_store)]
+        )
+        assert np.array_equal(
+            store._days[: len(store)], serial_store._days[: len(serial_store)]
+        )
+
+    def test_columnar_mode_matches_dict_mode(self):
+        payload_batches, _ = self._batches(num_batches=2)
+
+        def to_columns(payloads):
+            keys = [market_id for market_id, _ in payloads]
+            source_ids, probs, offsets = [], [], [0]
+            for _, signals in payloads:
+                for signal in signals:
+                    source_ids.append(signal["sourceId"])
+                    probs.append(signal["probability"])
+                offsets.append(len(source_ids))
+            return (
+                keys,
+                source_ids,
+                np.asarray(probs, dtype=np.float64),
+                np.asarray(offsets, dtype=np.int64),
+            )
+
+        dict_store = TensorReliabilityStore()
+        dict_plans = [
+            build_settlement_plan(dict_store, payloads)
+            for payloads in payload_batches
+        ]
+        col_store = TensorReliabilityStore()
+        with PlanPrefetcher(
+            col_store,
+            [to_columns(p) for p in payload_batches],
+            columnar=True,
+        ) as plans:
+            for plan, expect in zip(plans, dict_plans):
+                assert np.array_equal(plan.slot_rows, expect.slot_rows)
+                assert np.array_equal(plan.probs, expect.probs)
+
+    def test_build_error_raises_on_next(self):
+        store = TensorReliabilityStore()
+        good = [("m-1", [{"sourceId": "s", "probability": 0.5}])]
+        bad = [
+            ("dup", [{"sourceId": "s", "probability": 0.5}]),
+            ("dup", [{"sourceId": "t", "probability": 0.5}]),
+        ]
+        with PlanPrefetcher(store, [good, bad, good]) as plans:
+            assert next(plans).market_keys == ["m-1"]
+            with pytest.raises(ValueError, match="duplicate market ids"):
+                next(plans)
+            # The stream terminates after an error; later batches dropped.
+            with pytest.raises(StopIteration):
+                next(plans)
+
+    def test_close_mid_stream_joins_worker(self):
+        store = TensorReliabilityStore()
+        rng = random.Random(1)
+        batches = [random_payloads(rng, 5, tag=f"-c{b}") for b in range(50)]
+        prefetcher = PlanPrefetcher(store, batches, depth=1)
+        next(prefetcher)
+        prefetcher.close()
+        assert not prefetcher._worker.is_alive()
+
+    def test_worker_overlaps_with_consumer(self):
+        """The worker genuinely builds ahead: with depth=2, by the time the
+        consumer finishes a slow pass over plan N, plan N+1 is already
+        waiting (queue non-empty) — the build ran DURING the slow pass."""
+        store = TensorReliabilityStore()
+        rng = random.Random(2)
+        batches = [random_payloads(rng, 40, tag=f"-o{b}") for b in range(3)]
+        with PlanPrefetcher(store, batches, depth=2) as plans:
+            next(plans)
+            deadline = time.monotonic() + 30.0
+            while plans._queue.empty() and time.monotonic() < deadline:
+                time.sleep(0.005)  # the "slow consumer" leg
+            assert not plans._queue.empty()
+
+
+class TestStableSettleShapes:
+    """take_device_state pads to the capacity ladder so streamed batches
+    neither recompile the settle kernel per batch nor break the
+    device-resident chain when a prefetched plan interns new pairs."""
+
+    def test_exported_shape_is_capacity_not_used(self):
+        store = seeded_store(n=10)
+        state, _epoch0 = store.take_device_state(None)
+        capacity = store._rel.shape[0]
+        assert len(store) == 10
+        assert state.reliability.shape[0] == capacity
+        assert capacity > len(store)
+        # Pad rows read as cold defaults — exactly what a newly interned
+        # pair must read as.
+        pads = np.asarray(state.exists)[len(store):]
+        assert not pads.any()
+
+    def test_chain_survives_interning_within_capacity(self):
+        rng = random.Random(7)
+        batch_a = random_payloads(rng, 8, universe=10, tag="-a")
+        batch_b = random_payloads(rng, 8, universe=10, tag="-b")
+        out_a = [rng.random() < 0.5 for _ in range(8)]
+        out_b = [rng.random() < 0.5 for _ in range(8)]
+
+        store = TensorReliabilityStore()
+        plan_a = build_settlement_plan(store, batch_a)
+        settle(store, plan_a, out_a, steps=2, now=20_900.0)
+        assert store._pending is not None
+        # Plan B interns NEW pairs (within the initial 64-row capacity):
+        # the pending chain must hand forward, not sync + rebuild.
+        plan_b = build_settlement_plan(store, batch_b)
+        assert len(store) <= store._rel.shape[0]
+        settle(store, plan_b, out_b, steps=2, now=20_901.0)
+        assert len(store._pending_sync) == 2  # A's recipe still deferred
+
+        # Equivalence with the sync-every-time path.
+        eager = TensorReliabilityStore()
+        plan = build_settlement_plan(eager, batch_a)
+        settle(eager, plan, out_a, steps=2, now=20_900.0)
+        eager.sync()
+        plan = build_settlement_plan(eager, batch_b)
+        settle(eager, plan, out_b, steps=2, now=20_901.0)
+        eager.sync()
+        store.sync()
+        assert store.list_sources() == eager.list_sources()
