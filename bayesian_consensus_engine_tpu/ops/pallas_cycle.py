@@ -20,8 +20,9 @@ Semantics are identical to ``parallel.sharded._cycle_math`` (itself parity-
 tested against the scalar reference path); ``tests/test_pallas_cycle.py``
 checks equivalence element-wise in interpret mode on CPU.
 
-Hardware verdict (v5e, 2026-07-29, ``bench.py`` / ``scripts/
-perf_experiments3.py``): the kernel compiles and runs on TPU, peaking at
+Hardware verdict (v5e, 2026-07-29, tile sweep over 256-2048 in the
+retired ``perf_experiments3.py``; ``scripts/perf_lab.py ab`` re-runs the
+winning tile A/B): the kernel compiles and runs on TPU, peaking at
 ~684 cycles/sec at 1M×16 with ``tile_markets=2048`` (tiles ≥4096 exceed
 the 16 MB scoped-VMEM budget), but **loses to XLA's own fusion of the
 ``build_cycle_loop`` path (~860 cycles/sec)** — the cycle is elementwise +

@@ -95,7 +95,7 @@ SLOTS_PER_MARKET = 16
 SOURCE_UNIVERSE = 10_000
 # Step count amortises the axon tunnel's ~96 ms dispatch+fence round trip
 # (measured: a jitted 8-element add costs 95.7 ms end-to-end; see
-# scripts/perf_floor2.py + docs/tpu-architecture.md). At 100 steps the
+# scripts/perf_lab.py rtt + docs/tpu-architecture.md). At 100 steps the
 # dispatch dominated (~1 ms/step of pure RTT — round 2's misattributed
 # "1.1 ms/step floor"); at 1600 it is ~6% of the total. The marginal
 # kernel rate is reported separately in extras via a two-point fit.

@@ -1,0 +1,227 @@
+"""Perf lab — the consolidated on-chip measurement fixtures.
+
+One entry point for the experiments whose adjudications back the numbers
+in docs/tpu-architecture.md (consolidating the retired round-2/3 one-offs
+``perf_floor.py``, ``perf_floor2.py``, ``perf_experiments{,2,3}.py``):
+
+    python scripts/perf_lab.py rtt        # dispatch RTT, fixed/marginal fit,
+                                          # chained-dispatch pipelining
+    python scripts/perf_lab.py stream     # delivered HBM bandwidth probe
+    python scripts/perf_lab.py scaling    # compact loop vs markets/slots/steps
+    python scripts/perf_lab.py ab         # XLA cycle loop vs fused Pallas
+    python scripts/perf_lab.py large-k    # the 10k-source regime
+    python scripts/perf_lab.py all
+
+Each subcommand prints one JSON line. Run on the real TPU; every timing
+fences with a scalar value fetch (``block_until_ready`` does not force
+remote execution through the axon tunnel) and reuses bench.py's workload
+builders so lab numbers and driver numbers stay apples-to-apples.
+
+Retired variants whose conclusions are already recorded (and whose
+fixtures this file deliberately does NOT carry): the fori-unroll and
+counter-only-body attribution runs (perf_floor.py — verdict: the
+"1.1 ms/step floor" was dispatch RTT ÷ steps, kernel marginal
+0.078 ms/step) and the reduced-state cycle ladder (perf_experiments2.py —
+verdict: the int8 counter encoding won and became parallel/compact.py).
+See docs/tpu-architecture.md "dispatch, adjudicated".
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import bench  # noqa: E402 — repo-root import after path setup
+
+
+def _slot_major_workload(markets, slots):
+    """Slot-major (K, M) inputs from bench's OWN workload builder — the
+    module's apples-to-apples promise is literal: same generator, same
+    key, same occupancy as the driver's headline path."""
+    import jax
+    import jax.numpy as jnp
+
+    probs, mask, outcome, _src = bench.build_workload(
+        jax.random.PRNGKey(0), markets, slots, jnp.float32
+    )
+    probs, mask = probs.T, mask.T
+    bench._fence(probs)
+    return probs, mask, outcome
+
+
+def _compact_rate(markets, slots, steps, trials=3, workload=None):
+    """Compact-loop cycles/sec at (markets, slots), *steps* in one jit.
+
+    Pass *workload* (a ``_slot_major_workload`` result) to reuse one
+    device-resident input set across calls at the same shape."""
+    import jax.numpy as jnp
+
+    from bayesian_consensus_engine_tpu.parallel import (
+        build_compact_cycle_loop,
+        init_compact_state,
+    )
+
+    probs, mask, outcome = workload or _slot_major_workload(markets, slots)
+    loop = build_compact_cycle_loop(mesh=None, donate=True)
+
+    def fresh():
+        state = init_compact_state(markets, slots)
+        bench._fence(state.updated_days)
+        return state
+
+    day = jnp.asarray(1.0, jnp.float32)
+    return bench.timed_best_of(
+        lambda s: loop(probs, mask, outcome, s, day, steps),
+        fresh,
+        steps,
+        trials=trials,
+    )
+
+
+def cmd_rtt(args):
+    """Fixed-vs-marginal dispatch decomposition + chained pipelining.
+
+    (perf_floor2.py's question): every dispatch through the tunnel pays a
+    fixed RTT; D chained dispatches with ONE fence pipeline to ~one RTT,
+    so a long-running service sees the marginal kernel rate.
+    """
+    import jax.numpy as jnp
+
+    from bayesian_consensus_engine_tpu.parallel import (
+        build_compact_cycle_loop,
+        init_compact_state,
+    )
+
+    rtt_ms = bench.bench_dispatch_rtt()
+
+    markets, slots = 1_000_448, bench.SLOTS_PER_MARKET
+    workload = _slot_major_workload(markets, slots)  # one build, all legs
+    big_steps, small_steps = 1600, 400
+    big = _compact_rate(markets, slots, big_steps, workload=workload)
+    small = _compact_rate(markets, slots, small_steps, workload=workload)
+    t_big, t_small = big_steps / big, small_steps / small
+    marginal_s = (t_big - t_small) / (big_steps - small_steps)
+    fit = (
+        {
+            "fixed_dispatch_ms": round(
+                (t_small - small_steps * marginal_s) * 1e3, 1
+            ),
+            "marginal_ms_per_step": round(marginal_s * 1e3, 4),
+            "sustained_cycles_per_sec": round(1.0 / marginal_s, 1),
+        }
+        if marginal_s > 0
+        else "degenerate (tunnel variance swamped the kernel term)"
+    )
+
+    # Chained dispatches, one fence: D loop calls threading donated state.
+    # Best-of-3: this host's external load bursts can land inside a lone
+    # timed window and fake a "dispatches do not pipeline" verdict.
+    probs, mask, outcome = workload
+    loop = build_compact_cycle_loop(mesh=None, donate=True)
+    day = jnp.asarray(1.0, jnp.float32)
+    chain_depth, chain_steps = 8, 100
+    state = init_compact_state(markets, slots)
+    bench._fence(state.updated_days)
+    state, consensus = loop(probs, mask, outcome, state, day, chain_steps)
+    bench._fence(consensus)  # warm
+    chained_s = float("inf")
+    for _ in range(3):
+        start = time.perf_counter()
+        for _ in range(chain_depth):
+            state, consensus = loop(
+                probs, mask, outcome, state, day, chain_steps
+            )
+        bench._fence(consensus)
+        chained_s = min(chained_s, time.perf_counter() - start)
+
+    return {
+        "dispatch_rtt_ms": round(rtt_ms, 2),
+        "compact_fit_1m16": fit,
+        "chained_dispatches": {
+            "depth": chain_depth,
+            "steps_per_dispatch": chain_steps,
+            "total_s": round(chained_s, 3),
+            "ms_per_dispatch": round(chained_s / chain_depth * 1e3, 1),
+            "note": (
+                "one fence at the end: if dispatches pipeline, "
+                "ms_per_dispatch ~ steps x marginal, not + RTT each"
+            ),
+        },
+    }
+
+
+def cmd_stream(args):
+    return {"stream_probe_gbs": round(bench.bench_stream_probe(), 1)}
+
+
+def cmd_scaling(args):
+    """Compact-loop scaling (perf_floor.py's attribution grids).
+
+    Bandwidth-bound behaviour: per-step ms scales ~linearly with markets
+    and with slots, and is independent of the in-jit step count.
+    """
+    steps = 100
+    markets_grid = {}
+    for markets in (125_056, 500_224, 1_000_448, 2_000_896):
+        rate = _compact_rate(markets, 16, steps)
+        markets_grid[str(markets)] = round(1e3 / rate, 3)
+    slots_grid = {}
+    for slots in (1, 4, 16):
+        rate = _compact_rate(1_000_448, slots, steps)
+        slots_grid[str(slots)] = round(1e3 / rate, 3)
+    steps_grid = {}
+    for in_jit_steps in (100, 400, 1600):
+        rate = _compact_rate(1_000_448, 16, in_jit_steps, trials=2)
+        steps_grid[str(in_jit_steps)] = round(1e3 / rate, 3)
+    return {
+        "per_step_ms_vs_markets@K16": markets_grid,
+        "per_step_ms_vs_slots@1M": slots_grid,
+        "per_step_ms_vs_in_jit_steps@1Mx16": steps_grid,
+    }
+
+
+def cmd_ab(args):
+    """XLA-fused cycle loop vs the hand-fused Pallas kernel at 1M x 16."""
+    xla = bench.bench_headline()
+    try:
+        pallas = bench.bench_pallas()
+    except Exception as exc:  # noqa: BLE001 — Pallas needs the TPU backend
+        pallas = f"failed: {type(exc).__name__}: {exc}"
+    return {
+        "xla_loop_cycles_per_sec": round(xla, 1),
+        "pallas_cycles_per_sec": (
+            round(pallas, 1) if isinstance(pallas, float) else pallas
+        ),
+    }
+
+
+def cmd_large_k(args):
+    return bench.bench_large_k()
+
+
+COMMANDS = {
+    "rtt": cmd_rtt,
+    "stream": cmd_stream,
+    "scaling": cmd_scaling,
+    "ab": cmd_ab,
+    "large-k": cmd_large_k,
+}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("command", choices=[*COMMANDS, "all"])
+    args = parser.parse_args(argv)
+    if args.command == "all":
+        out = {name: fn(args) for name, fn in COMMANDS.items()}
+    else:
+        out = COMMANDS[args.command](args)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
