@@ -140,10 +140,54 @@ def _fused_cycle_kernel(
     new_ex_ref[:] = jnp.maximum(exists, mask)
 
 
+def _tuned_tile(num_markets: int, num_slots: int) -> int:
+    """Measured-once tile pick for this (M, K) — utils.autotune contract.
+
+    Candidates are the VMEM-plausible tiles dividing M (≥4096 blew the
+    16 MB scoped budget at K=16 in the recorded sweep); when none of the
+    standard tiles divides M, "auto" still resolves (to M itself — one
+    tile) rather than erroring, since the caller asked auto precisely to
+    not pick a tile. With autotune disabled (the default), ``tune``
+    returns the fallback without measuring anything.
+    """
+    import time
+
+    from bayesian_consensus_engine_tpu.utils.autotune import default_tuner
+
+    candidates = [t for t in (512, 1024, 2048) if num_markets % t == 0]
+    fallback = (
+        DEFAULT_TILE_M if num_markets % DEFAULT_TILE_M == 0 else num_markets
+    )
+    if not candidates:
+        candidates = [fallback]
+
+    def measure(tile: int) -> float:
+        call = build_pallas_cycle(num_markets, num_slots, tile_markets=tile)
+        km = jnp.zeros((num_slots, num_markets), jnp.float32)
+        m1 = jnp.zeros((1, num_markets), jnp.float32)
+        state = SlotMajorState(km + 0.5, km + 0.25, km * 0.0, km * 0.0)
+        out = call(km + 0.5, km + 1.0, m1, state, 1.0)
+        float(out[1].reshape(-1)[0])  # warm + fence (compile off the clock)
+        # Best-of-3: a single sample would be persisted forever, so one
+        # host-load spike could lock in the wrong tile for this shape.
+        best = float("inf")
+        for _ in range(3):
+            start = time.perf_counter()
+            out = call(km + 0.5, km + 1.0, m1, state, 1.0)
+            float(out[1].reshape(-1)[0])
+            best = min(best, time.perf_counter() - start)
+        return best
+
+    return default_tuner().tune(
+        "pallas_tile", (num_markets, num_slots), candidates, measure,
+        fallback,
+    )
+
+
 def build_pallas_cycle(
     num_markets: int,
     num_slots: int,
-    tile_markets: int = DEFAULT_TILE_M,
+    tile_markets=DEFAULT_TILE_M,
     interpret: bool = False,
 ):
     """Compile the fused cycle for fixed (K=num_slots, M=num_markets).
@@ -153,8 +197,12 @@ def build_pallas_cycle(
     slot-major float32; ``outcome``/``consensus`` etc. are shape (1, M).
     ``num_markets`` must be a multiple of ``tile_markets`` (pad with
     mask=0 columns — padded markets produce NaN consensus and are sliced
-    off by the caller).
+    off by the caller). ``tile_markets="auto"`` asks the shape tuner
+    (utils/autotune.py — measured once per shape, persisted; requires
+    ``BCE_AUTOTUNE=1``, otherwise resolves to the recorded default).
     """
+    if tile_markets == "auto":
+        tile_markets = _tuned_tile(num_markets, num_slots)
     if num_markets % tile_markets:
         raise ValueError(
             f"num_markets={num_markets} not a multiple of tile_markets={tile_markets}"

@@ -96,7 +96,7 @@ def build_settlement_plan(
     store,
     payloads: Payload,
     native: Optional[bool] = None,
-    num_slots: Optional[int] = None,
+    num_slots: "int | str | None" = None,
 ) -> SettlementPlan:
     """Pack, intern, and lay out payloads as a dense settlement block.
 
@@ -114,9 +114,12 @@ def build_settlement_plan(
     plans built across batches/processes then share one compiled shape —
     and per-process band plans (see :class:`ShardedSettlementSession`)
     MUST pass the globally-agreed K, since no process can see the others'
-    maxima. Note a different K compiles a different slot-reduction tree,
-    so consensus values can move ≤1 ulp vs the natural-K plan (state
-    updates are quantised ±0.1 steps and typically identical).
+    maxima. ``num_slots="bucket"`` pads the natural K to the next sublane
+    multiple (8) — streamed batches with wobbling K then share one
+    compiled settle program per bucket without any globally-agreed
+    constant. Note a different K compiles a different slot-reduction
+    tree, so consensus values can move ≤1 ulp vs the natural-K plan
+    (state updates are quantised ±0.1 steps and typically identical).
     """
     payloads = list(payloads)
     keys = [market_id for market_id, _ in payloads]
@@ -148,7 +151,7 @@ def build_settlement_plan_columnar(
     source_ids: Sequence[str],
     probabilities,
     offsets,
-    num_slots: Optional[int] = None,
+    num_slots: "int | str | None" = None,
 ) -> SettlementPlan:
     """Vectorised twin of :func:`build_settlement_plan` for columnar input.
 
@@ -271,12 +274,22 @@ def _assemble_plan(
     source_of,
     market_of,
     signals_per_market,
-    num_slots: Optional[int] = None,
+    num_slots: "int | str | None" = None,
 ) -> SettlementPlan:
     """Shared plan tail: dense block fill + binding probes + freeze."""
     counts = np.diff(pair_offsets)
     num_markets = len(keys)
     needed_slots = int(counts.max()) if num_markets else 0
+    if num_slots == "bucket":
+        # Slot height padded to the next sublane multiple: streamed batches
+        # whose natural K wobbles (e.g. Poisson signal counts) then share
+        # one compiled settle program per 8-wide bucket instead of one per
+        # distinct K. Same ≤1-ulp note as any pinned num_slots (docstring).
+        num_slots = max(8, -(-needed_slots // 8) * 8)
+    elif isinstance(num_slots, str):
+        raise ValueError(
+            f"num_slots={num_slots!r}: the only supported string is 'bucket'"
+        )
     if num_slots is None:
         num_slots = needed_slots
     elif needed_slots > num_slots:
@@ -1034,7 +1047,7 @@ class PlanPrefetcher:
         store,
         batches,
         columnar: bool = False,
-        num_slots: Optional[int] = None,
+        num_slots: "int | str | None" = None,
         native: Optional[bool] = None,
         depth: int = 1,
     ) -> None:

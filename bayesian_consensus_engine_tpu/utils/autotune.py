@@ -1,0 +1,148 @@
+"""Measured-once-per-shape knob tuning, persisted like the plan cache.
+
+Hand-picked constants defend today's headline shapes — the Pallas cycle's
+tile (default 512; the recorded 1M×16 sweep peaked at 2048) and
+per-session plan slot heights were chosen by measurement
+(docs/tpu-architecture.md) — but a new K or M regime
+can silently move the optimum. :class:`ShapeTuner` measures each candidate
+ONCE per (knob, shape, device-kind) key, persists the winner to a small
+JSON cache, and thereafter answers for free.
+
+OFF BY DEFAULT: with ``BCE_AUTOTUNE`` unset/``0``, :meth:`ShapeTuner.tune`
+returns the caller's default untouched, so production numbers are
+byte-for-byte what they were before this module existed. Opt in with
+``BCE_AUTOTUNE=1``; ``BCE_AUTOTUNE_CACHE`` overrides the cache path
+(default ``~/.cache/bce_autotune.json``). The cache key includes the
+device kind, so a cache written on one accelerator never answers for
+another.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Callable, Optional, Sequence
+
+
+def _default_enabled() -> bool:
+    return os.environ.get("BCE_AUTOTUNE", "").lower() in ("1", "true", "on")
+
+
+def _default_cache_path() -> str:
+    return os.environ.get(
+        "BCE_AUTOTUNE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "bce_autotune.json"),
+    )
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — no backend: still a usable key
+        return "unknown"
+
+
+class ShapeTuner:
+    """Pick a knob value by measuring once per shape; remember forever.
+
+    ``tune(knob, shape_key, candidates, measure, default)``:
+
+    * disabled → *default*, ``measure`` never called;
+    * cached (same knob + shape + device kind, cached value still among
+      *candidates*) → the cached winner, ``measure`` never called;
+    * otherwise → ``measure(candidate)`` once each (seconds; raising or
+      non-finite means "ineligible here", e.g. a tile over the VMEM
+      budget), persist and return the argmin — or *default* if nothing
+      measured successfully.
+    """
+
+    def __init__(
+        self,
+        cache_path: Optional[str] = None,
+        enabled: Optional[bool] = None,
+        device_kind: Optional[str] = None,
+    ) -> None:
+        self._cache_path = cache_path or _default_cache_path()
+        self._enabled = _default_enabled() if enabled is None else enabled
+        self._device_kind = device_kind
+        self._lock = threading.Lock()
+        self._cache: Optional[dict] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def _key(self, knob: str, shape_key: tuple) -> str:
+        if self._device_kind is None:
+            self._device_kind = _device_kind()
+        return json.dumps([knob, list(shape_key), self._device_kind])
+
+    def _load(self) -> dict:
+        if self._cache is None:
+            try:
+                with open(self._cache_path) as fh:
+                    self._cache = json.load(fh)
+            except (OSError, ValueError):
+                self._cache = {}
+        return self._cache
+
+    def _store(self) -> None:
+        path = Path(self._cache_path)
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(".tmp")
+            tmp.write_text(json.dumps(self._cache, indent=0, sort_keys=True))
+            tmp.replace(path)
+        except OSError:  # pragma: no cover — cache is an optimisation only
+            pass
+
+    def tune(
+        self,
+        knob: str,
+        shape_key: tuple,
+        candidates: Sequence,
+        measure: Callable[[object], float],
+        default,
+    ):
+        if not self._enabled or not candidates:
+            return default
+        with self._lock:
+            key = self._key(knob, shape_key)
+            cache = self._load()
+            entry = cache.get(key)
+            if entry is not None and entry["choice"] in list(candidates):
+                return entry["choice"]
+            timings = {}
+            for candidate in candidates:
+                try:
+                    seconds = float(measure(candidate))
+                except Exception:  # noqa: BLE001 — ineligible candidate
+                    continue
+                if seconds == seconds and seconds != float("inf"):
+                    timings[candidate] = seconds
+            if not timings:
+                return default
+            choice = min(timings, key=timings.__getitem__)
+            cache[key] = {
+                "choice": choice,
+                "timings_s": {str(c): round(t, 6) for c, t in timings.items()},
+            }
+            self._store()
+            return choice
+
+
+_default_tuner: Optional[ShapeTuner] = None
+_default_tuner_lock = threading.Lock()
+
+
+def default_tuner() -> ShapeTuner:
+    """The process-wide tuner (env-configured; see module docstring)."""
+    global _default_tuner
+    with _default_tuner_lock:
+        if _default_tuner is None:
+            _default_tuner = ShapeTuner()
+        return _default_tuner
