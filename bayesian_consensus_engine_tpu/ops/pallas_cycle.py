@@ -187,7 +187,7 @@ def _tuned_tile(num_markets: int, num_slots: int) -> int:
 def build_pallas_cycle(
     num_markets: int,
     num_slots: int,
-    tile_markets=DEFAULT_TILE_M,
+    tile_markets: "int | str" = DEFAULT_TILE_M,
     interpret: bool = False,
 ):
     """Compile the fused cycle for fixed (K=num_slots, M=num_markets).
@@ -203,6 +203,11 @@ def build_pallas_cycle(
     """
     if tile_markets == "auto":
         tile_markets = _tuned_tile(num_markets, num_slots)
+    elif isinstance(tile_markets, str):
+        raise ValueError(
+            f"tile_markets={tile_markets!r}: the only supported string is "
+            "'auto'"
+        )
     if num_markets % tile_markets:
         raise ValueError(
             f"num_markets={num_markets} not a multiple of tile_markets={tile_markets}"

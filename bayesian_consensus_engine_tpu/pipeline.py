@@ -37,6 +37,7 @@ signal topology, then any number of settlement cycles run device-only:
 
 from __future__ import annotations
 
+import collections as _collections
 import queue as _queue
 import threading
 from dataclasses import dataclass
@@ -1147,3 +1148,85 @@ def settle_payloads(
     if db_path is not None:
         store.flush_to_sqlite(db_path)
     return result
+
+
+def settle_stream(
+    store,
+    batches,
+    steps: int = 1,
+    now: Optional[float] = None,
+    db_path=None,
+    checkpoint_every: int = 1,
+    num_slots: "int | str | None" = "bucket",
+    columnar: bool = False,
+    native: Optional[bool] = None,
+):
+    """The streamed settle-and-checkpoint service loop, fully overlapped.
+
+    One generator wires the round-4 machinery together the way a
+    long-running settlement service should run it:
+
+    * plan N+1 builds on a prefetch thread while plan N settles
+      (:class:`PlanPrefetcher`; ``num_slots`` defaults to ``"bucket"`` so
+      wobbling batch widths share compiled settle programs);
+    * settles chain device-resident (deferred absorb; the capacity-ladder
+      state survives each batch's new interning);
+    * every *checkpoint_every* batches the store checkpoints to *db_path*
+      with the SQLite transaction on a background thread
+      (:meth:`~.tensor_store.TensorReliabilityStore.flush_to_sqlite_async`),
+      overlapping the write with the next batch's ingest + settle; a tail
+      flush on exit makes the final file complete. A failed background
+      write surfaces at the NEXT flush (bookkeeping rolled back — see
+      FlushHandle); the final join re-raises any last-write failure.
+
+    *batches* yields ``(payloads, outcomes)`` pairs — with
+    ``columnar=True``, ``((market_keys, source_ids, probabilities,
+    offsets), outcomes)``. ``now=None`` stamps wall clock per settle; a
+    float is the first batch's settlement day, advancing one day per
+    batch (the reference's daily re-settlement shape). Yields one
+    :class:`SettlementResult` per batch, in order. Results, store state,
+    and checkpoint files equal the serial build → settle → flush loop
+    (pinned by tests/test_overlap.py).
+    """
+    if checkpoint_every < 1:
+        raise ValueError("checkpoint_every must be >= 1")
+    outcome_queue: "deque" = _collections.deque()
+
+    def payload_stream():
+        for payloads, outcomes in batches:
+            outcome_queue.append(outcomes)
+            yield payloads
+
+    handle = None
+    flushed_through = -1
+    index = -1
+    try:
+        with PlanPrefetcher(
+            store,
+            payload_stream(),
+            columnar=columnar,
+            num_slots=num_slots,
+            native=native,
+        ) as plans:
+            for index, plan in enumerate(plans):
+                outcomes = outcome_queue.popleft()
+                batch_now = None if now is None else now + index
+                result = settle(
+                    store, plan, outcomes, steps=steps, now=batch_now
+                )
+                if db_path is not None and (index + 1) % checkpoint_every == 0:
+                    # Joins any in-flight write first (flushes serialise), so
+                    # a prior background failure surfaces here, not silently.
+                    handle = store.flush_to_sqlite_async(db_path)
+                    flushed_through = index
+                yield result
+    finally:
+        # Runs on EVERY exit — exhaustion, a consumer break/close
+        # (GeneratorExit), or a batch error: the in-flight write is always
+        # joined (a background failure must never be dropped) and every
+        # fully settled batch reaches the checkpoint file.
+        if db_path is not None and index >= 0:
+            if handle is not None:
+                handle.result()
+            if flushed_through != index:
+                store.flush_to_sqlite(db_path)  # batches since last flush

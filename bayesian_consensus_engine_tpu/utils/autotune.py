@@ -114,7 +114,12 @@ class ShapeTuner:
             key = self._key(knob, shape_key)
             cache = self._load()
             entry = cache.get(key)
-            if entry is not None and entry["choice"] in list(candidates):
+            # .get twice: a malformed entry (hand-edited / other-schema
+            # cache file) falls through to re-measurement — the cache is an
+            # optimisation only, never a crash.
+            if entry is not None and isinstance(entry, dict) and (
+                entry.get("choice") in list(candidates)
+            ):
                 return entry["choice"]
             timings = {}
             for candidate in candidates:

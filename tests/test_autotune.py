@@ -148,6 +148,27 @@ class TestPallasTileWiring:
         assert tile == pallas_cycle.DEFAULT_TILE_M
         assert not (tmp_path / "never.json").exists()
 
+    def test_auto_total_when_no_standard_tile_divides(self, monkeypatch):
+        """"auto" must resolve for ANY M (review finding): when no standard
+        tile divides M, the fallback is M itself — one tile."""
+        from bayesian_consensus_engine_tpu.ops import pallas_cycle
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        monkeypatch.setattr(
+            autotune, "_default_tuner",
+            autotune.ShapeTuner(enabled=False, device_kind="t"),
+        )
+        call = pallas_cycle.build_pallas_cycle(
+            384, 8, tile_markets="auto", interpret=True
+        )
+        km = np.zeros((8, 384), np.float32)
+        m1 = np.zeros((1, 384), np.float32)
+        state = pallas_cycle.SlotMajorState(
+            km + 0.5, km + 0.25, km * 0.0, km * 0.0
+        )
+        _state, consensus, _c, _w = call(km + 0.5, km + 1.0, m1, state, 1.0)
+        assert consensus.shape == (1, 384)
+
 
 class TestSlotBucket:
     def test_bucket_pads_to_sublane_multiple(self):
@@ -230,27 +251,6 @@ class TestSlotBucket:
         # consensus may move ≤1 ulp (documented), checked via allclose.
         assert bucketed.list_sources() == natural.list_sources()
 
-    def test_auto_total_when_no_standard_tile_divides(self, monkeypatch):
-        """"auto" must resolve for ANY M (review finding): when no standard
-        tile divides M, the fallback is M itself — one tile."""
-        from bayesian_consensus_engine_tpu.ops import pallas_cycle
-        from bayesian_consensus_engine_tpu.utils import autotune
-
-        monkeypatch.setattr(
-            autotune, "_default_tuner",
-            autotune.ShapeTuner(enabled=False, device_kind="t"),
-        )
-        call = pallas_cycle.build_pallas_cycle(
-            384, 8, tile_markets="auto", interpret=True
-        )
-        km = np.zeros((8, 384), np.float32)
-        m1 = np.zeros((1, 384), np.float32)
-        state = pallas_cycle.SlotMajorState(
-            km + 0.5, km + 0.25, km * 0.0, km * 0.0
-        )
-        _state, consensus, _c, _w = call(km + 0.5, km + 1.0, m1, state, 1.0)
-        assert consensus.shape == (1, 384)
-
 
 class TestSlotValidation:
     def test_unknown_num_slots_string_rejected_clearly(self):
@@ -267,3 +267,26 @@ class TestSlotValidation:
                 [("m", [{"sourceId": "s", "probability": 0.5}])],
                 num_slots="buckets",
             )
+
+
+    def test_unknown_tile_string_rejected_clearly(self):
+        from bayesian_consensus_engine_tpu.ops import pallas_cycle
+
+        with pytest.raises(ValueError, match="only supported string"):
+            pallas_cycle.build_pallas_cycle(1024, 8, tile_markets="Auto")
+
+
+class TestMalformedCache:
+    def test_malformed_cache_entry_remeasures(self, tmp_path):
+        """A valid-JSON but wrong-schema cache entry must re-measure, not
+        crash (cache is an optimisation only)."""
+        path = tmp_path / "tune.json"
+        tuner = ShapeTuner(
+            cache_path=str(path), enabled=True, device_kind="k"
+        )
+        key = tuner._key("knob", (1,))
+        path.write_text(json.dumps({key: {}}))
+        choice = tuner.tune(
+            "knob", (1,), [1, 2], {1: 2.0, 2: 1.0}.__getitem__, 1
+        )
+        assert choice == 2

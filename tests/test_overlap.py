@@ -380,3 +380,166 @@ class TestStableSettleShapes:
         eager.sync()
         store.sync()
         assert store.list_sources() == eager.list_sources()
+
+
+class TestSettleStream:
+    """settle_stream: the one-API streamed service loop must equal the
+    serial build → settle → flush loop in results, store state, and
+    checkpoint file — overlap changes wall clock only."""
+
+    def _batches(self, num_batches=4, markets=9, seed=31):
+        rng = random.Random(seed)
+        out = []
+        for b in range(num_batches):
+            payloads = random_payloads(rng, markets, universe=15, tag=f"-s{b}")
+            outcomes = [rng.random() < 0.5 for _ in range(markets)]
+            out.append((payloads, outcomes))
+        return out
+
+    def _serial(self, batches, db, steps=2, now=21_000.0,
+                checkpoint_every=1):
+        from bayesian_consensus_engine_tpu.pipeline import settle
+
+        store = TensorReliabilityStore()
+        results = []
+        for i, (payloads, outcomes) in enumerate(batches):
+            plan = build_settlement_plan(store, payloads, num_slots="bucket")
+            results.append(
+                settle(store, plan, outcomes, steps=steps, now=now + i)
+            )
+            if (i + 1) % checkpoint_every == 0:
+                store.flush_to_sqlite(db)
+        store.flush_to_sqlite(db)
+        return store, results
+
+    def test_matches_serial_loop(self, tmp_path):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        batches = self._batches()
+        serial_store, serial_results = self._serial(
+            batches, tmp_path / "serial.db"
+        )
+
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store,
+                batches,
+                steps=2,
+                now=21_000.0,
+                db_path=tmp_path / "stream.db",
+            )
+        )
+        assert len(results) == len(serial_results)
+        for mine, ref in zip(results, serial_results):
+            assert mine.market_keys == ref.market_keys
+            np.testing.assert_array_equal(
+                mine.consensus, ref.consensus, err_msg="consensus"
+            )
+        store.sync()
+        assert store.list_sources() == serial_store.list_sources()
+        assert db_records(tmp_path / "stream.db") == db_records(
+            tmp_path / "serial.db"
+        )
+
+    def test_checkpoint_every_with_tail_flush(self, tmp_path):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        batches = self._batches(num_batches=3)
+        store = TensorReliabilityStore()
+        list(
+            settle_stream(
+                store,
+                batches,
+                steps=1,
+                now=21_010.0,
+                db_path=tmp_path / "stream.db",
+                checkpoint_every=2,
+            )
+        )
+        # Batch 3 landed after the last periodic flush: the tail flush
+        # must still have made the file complete.
+        serial_store, _ = self._serial(
+            batches, tmp_path / "serial.db", steps=1, now=21_010.0,
+            checkpoint_every=2,
+        )
+        assert db_records(tmp_path / "stream.db") == db_records(
+            tmp_path / "serial.db"
+        )
+
+    def test_no_db_means_no_flush(self):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(store, self._batches(num_batches=2), now=21_020.0)
+        )
+        assert len(results) == 2
+        assert store._last_flush_path is None
+
+    def test_batch_error_propagates(self, tmp_path):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        good = self._batches(num_batches=1)[0]
+        bad = (
+            [
+                ("dup", [{"sourceId": "s", "probability": 0.5}]),
+                ("dup", [{"sourceId": "t", "probability": 0.5}]),
+            ],
+            [True, False],
+        )
+        store = TensorReliabilityStore()
+        stream = settle_stream(
+            store, [good, bad], now=21_030.0, db_path=tmp_path / "x.db"
+        )
+        assert next(stream).market_keys == [k for k, _ in good[0]]
+        with pytest.raises(ValueError, match="duplicate market ids"):
+            next(stream)
+
+    def test_failed_background_flush_surfaces(self, tmp_path, monkeypatch):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        store = TensorReliabilityStore()
+        real_builder = store._build_snapshot_writer
+        fail_once = {"armed": True}
+
+        def sometimes_broken(*args, **kwargs):
+            if fail_once.pop("armed", False):
+                def writer():
+                    raise RuntimeError("checkpoint disk gone")
+
+                return writer
+            return real_builder(*args, **kwargs)
+
+        monkeypatch.setattr(store, "_build_snapshot_writer", sometimes_broken)
+        stream = settle_stream(
+            store,
+            self._batches(num_batches=2),
+            now=21_040.0,
+            db_path=tmp_path / "x.db",
+        )
+        next(stream)  # batch 1 settles; its flush is the broken one
+        with pytest.raises(RuntimeError, match="checkpoint disk gone"):
+            # Batch 2's flush joins the broken one first and re-raises.
+            next(stream)
+
+    def test_early_break_still_tail_flushes_and_joins(self, tmp_path):
+        """A consumer break (GeneratorExit) must not lose checkpoints: the
+        in-flight write is joined and settled batches reach the file."""
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        batches = self._batches(num_batches=4)
+        db = tmp_path / "stream.db"
+        store = TensorReliabilityStore()
+        for i, _result in enumerate(
+            settle_stream(
+                store, batches, steps=1, now=21_050.0, db_path=db,
+                checkpoint_every=3,
+            )
+        ):
+            if i == 1:
+                break  # two batches settled; no periodic flush happened yet
+        serial_store, _ = self._serial(
+            batches[:2], tmp_path / "serial.db", steps=1, now=21_050.0
+        )
+        assert db_records(db) == db_records(tmp_path / "serial.db")
