@@ -87,6 +87,40 @@ class CompactBlockState(NamedTuple):
     updated_days: jax.Array  # f32[...] day of last update (0 ⇒ never)
 
 
+def encode_probs_u16(probs: jax.Array) -> jax.Array:
+    """Probabilities in [0, 1] → u16 fixed point (nearest of 65,535 steps).
+
+    The compact cycle is HBM-bandwidth-bound and the f32 probability block
+    is its largest per-step read (4 of ~12 B/slot/step at large K; 5 GB of
+    the ~13.8 GB north-star working set). u16 halves both at a
+    quantization error ≤ 0.5/65535 ≈ 7.6e-6 — two decimal digits FINER
+    than bf16's ~2e-2 at the same two bytes (bf16 spends bits on exponent
+    range a probability never uses). The decode is one multiply fused
+    into the cycle ("free" on a bandwidth-bound loop).
+
+    Reduced-precision contract: the loop on encoded probs equals the f32
+    loop on ``decode(encode(probs))`` BITWISE (the decode is exact f32
+    math); vs the unencoded f32 loop, consensus moves by the quantization
+    error and a signal within ~7.6e-6 of the 0.5 correctness threshold
+    can flip sides. Opt-in by encoding — the loop auto-decodes u16 input
+    INSIDE each step, so the fori operand stays two bytes
+    (tests/test_compact.py pins all three claims plus the loop-operand
+    dtype in the compiled HLO). Out-of-range inputs clip to [0, 1] (a
+    negative drifted signal must never wrap to a near-one encoding).
+    """
+    return jnp.round(
+        jnp.clip(probs.astype(jnp.float32), 0.0, 1.0) * jnp.float32(65535.0)
+    ).astype(jnp.uint16)
+
+
+def _decode_probs(probs: jax.Array) -> jax.Array:
+    """u16 fixed point → f32 in [0, 1]; float inputs pass through (bf16
+    promotes exactly inside the cycle math)."""
+    if probs.dtype == jnp.uint16:
+        return probs.astype(jnp.float32) * jnp.float32(1.0 / 65535.0)
+    return probs
+
+
 def init_compact_state(
     num_markets: int, slots: int, slot_major: bool = True
 ) -> CompactBlockState:
@@ -147,7 +181,12 @@ def _compact_cycle_math(
 ):
     """Consensus from pre-decayed reads + counter update; shared by both
     the step-0 and fast-step paths (they differ only in how ``read_rel``
-    is produced)."""
+    is produced). u16 probability inputs decode HERE — inside the step —
+    so the fori body's operand stays the 2-byte block and the
+    convert-multiply fuses into the step's consumers (decoding once
+    outside the loop would materialise the f32 block as the while-loop
+    operand, paying f32 bandwidth AND holding both copies in HBM)."""
+    probs = _decode_probs(probs)
     with jax.named_scope("bce.consensus_reduce"):
         consensus, _, _ = consensus_reduce(
             probs, mask, read_rel, decode_confidence(conf_steps),
@@ -163,7 +202,10 @@ def _compact_cycle_math(
 
 def _compact_loop_math(probs, mask, outcome, state, now0, steps, axis_name,
                        slots_axis):
-    init_consensus = jnp.zeros(outcome.shape[0], probs.dtype)
+    consensus_dtype = (
+        jnp.float32 if probs.dtype == jnp.uint16 else probs.dtype
+    )
+    init_consensus = jnp.zeros(outcome.shape[0], consensus_dtype)
     if axis_name is not None:
         init_consensus = jax.lax.pcast(
             init_consensus, (MARKETS_AXIS,), to="varying"

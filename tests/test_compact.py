@@ -343,3 +343,135 @@ class TestSharded:
         np.testing.assert_array_equal(
             np.asarray(got_state.conf_steps), np.asarray(want_state.conf_steps)
         )
+
+
+class TestReducedPrecisionProbs:
+    """Opt-in reduced-precision probability inputs for the compact loop:
+    u16 fixed point (2 bytes, ~7.6e-6 quantization) auto-decodes in the
+    kernel; bf16 promotes exactly. Both equal the f32 loop run on the
+    rounded inputs BITWISE — the encoding never changes the math, only
+    the input resolution."""
+
+    def _workload(self, M=512, K=8):
+        import jax
+
+        key = jax.random.PRNGKey(3)
+        kp, km, ko = jax.random.split(key, 3)
+        probs = jax.random.uniform(kp, (K, M), dtype=jnp.float32)
+        mask = jax.random.uniform(km, (K, M)) < 0.9
+        outcome = jax.random.uniform(ko, (M,)) < 0.5
+        return probs, mask, outcome
+
+    def test_u16_equals_f32_on_decoded_inputs_bitwise(self):
+        from bayesian_consensus_engine_tpu.parallel.compact import (
+            _decode_probs,
+            encode_probs_u16,
+        )
+
+        probs, mask, outcome = self._workload()
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        encoded = encode_probs_u16(probs)
+        assert encoded.dtype == jnp.uint16
+        s_enc, c_enc = loop(
+            encoded, mask, outcome, init_compact_state(512, 8),
+            jnp.float32(1.0), 3,
+        )
+        s_ref, c_ref = loop(
+            _decode_probs(encoded), mask, outcome, init_compact_state(512, 8),
+            jnp.float32(1.0), 3,
+        )
+        assert c_enc.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(c_enc), np.asarray(c_ref))
+        for a, b in zip(s_enc, s_ref):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_u16_quantization_bound_vs_f32(self):
+        probs, mask, outcome = self._workload()
+        from bayesian_consensus_engine_tpu.parallel.compact import (
+            encode_probs_u16,
+        )
+
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        _, c_u16 = loop(
+            encode_probs_u16(probs), mask, outcome,
+            init_compact_state(512, 8), jnp.float32(1.0), 1,
+        )
+        _, c_f32 = loop(
+            probs, mask, outcome, init_compact_state(512, 8),
+            jnp.float32(1.0), 1,
+        )
+        err = np.abs(np.asarray(c_u16, np.float64) - np.asarray(c_f32, np.float64))
+        # Consensus is a weighted mean of probabilities: its error is
+        # bounded by the per-input quantization step (plus f32 noise).
+        assert np.nanmax(err) < 2e-5, np.nanmax(err)
+
+    def test_bf16_passthrough_promotes_exactly(self):
+        probs, mask, outcome = self._workload()
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        bf16 = probs.astype(jnp.bfloat16)
+        _, c_bf16 = loop(
+            bf16, mask, outcome, init_compact_state(512, 8),
+            jnp.float32(1.0), 2,
+        )
+        _, c_ref = loop(
+            bf16.astype(jnp.float32), mask, outcome,
+            init_compact_state(512, 8), jnp.float32(1.0), 2,
+        )
+        np.testing.assert_array_equal(np.asarray(c_bf16), np.asarray(c_ref))
+
+    def test_u16_round_trips_reference_precision_grid(self):
+        """Signals quoted to ~4 decimal places survive u16 encoding with
+        their correctness side (p >= 0.5) intact."""
+        from bayesian_consensus_engine_tpu.parallel.compact import (
+            _decode_probs,
+            encode_probs_u16,
+        )
+
+        grid = jnp.asarray(
+            np.round(np.linspace(0.0, 1.0, 10_001), 4), jnp.float32
+        )
+        decoded = np.asarray(_decode_probs(encode_probs_u16(grid)))
+        assert np.max(np.abs(decoded - np.asarray(grid))) <= 0.5 / 65535 + 1e-7
+        np.testing.assert_array_equal(
+            decoded >= 0.5, np.asarray(grid) >= 0.5
+        )
+
+    def test_u16_decode_is_not_hoisted_out_of_the_loop(self):
+        """The whole point of u16 input is that the fori operand stays two
+        bytes: the compiled program must not materialise a full-size f32
+        decode at entry (feeding the while), and no f32 probs block may
+        ride the while carry. (CPU pipeline; the TPU bench reports the
+        measured effect — north_star_band.u16_probs.)"""
+        import re
+        from functools import partial
+
+        import jax
+
+        from bayesian_consensus_engine_tpu.parallel.compact import (
+            _compact_loop_math,
+            encode_probs_u16,
+        )
+
+        M, K, steps = 512, 8, 4
+        probs, mask, outcome = self._workload(M, K)
+        fn = partial(
+            _compact_loop_math, steps=steps, axis_name=None, slots_axis=0
+        )
+        hlo = (
+            jax.jit(fn)
+            .lower(
+                encode_probs_u16(probs), mask, outcome,
+                init_compact_state(M, K), jnp.float32(1.0),
+            )
+            .compile()
+            .as_text()
+        )
+        entry = hlo[hlo.index("ENTRY"):]
+        # No entry-level convert may produce the f32 probs-shaped block.
+        assert not re.search(
+            rf"= f32\[{K},{M}\][^\n]*convert", entry
+        ), "u16 decode was hoisted to entry"
+        # The while carry must not include an f32 probs-shaped block.
+        for line in entry.splitlines():
+            if "while(" in line:
+                assert f"f32[{K},{M}]" not in line.split("while(")[0], line
