@@ -298,3 +298,107 @@ class TestEndToEndFast:
         # A forced-CPU run must self-identify (review finding, round 4).
         assert "--cpu" in payload["metric"]
         json.dumps(payload)
+
+
+class TestCircuitBreaker:
+    def test_two_consecutive_timeouts_break_remaining_device_legs(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("BCE_BENCH_BUDGET_S", "4800")
+        monkeypatch.setenv("BCE_BENCH_PROBE_BUDGET_S", "10")
+        canned = {
+            "probe": {"ok": True, "value": {"platform": "tpu", "devices": 1}},
+            "headline_f32": {"ok": False, "error": "timeout after 900s (killed)"},
+            "compact": {"ok": False, "error": "timeout after 700s (killed)"},
+            "headline_f32_cpu": {"ok": True, "value": 3.5},
+            "compact_cpu": {"ok": True, "value": 5.0},
+        }
+        log = []
+
+        def run_leg(name, timeout=None, fast=False, cpu=False):
+            log.append(name)
+            return canned.get(name, {"ok": False, "error": "unexpected"})
+
+        payload, rc = bench.orchestrate(
+            run_leg=run_leg, sleeper=lambda s: None
+        )
+        # Only the first two device legs actually ran; the rest were
+        # circuit-broken without burning their timeouts, and the CPU
+        # fallback still secured the headline.
+        assert log == ["probe", "headline_f32", "compact",
+                       "headline_f32_cpu", "compact_cpu"]
+        assert rc == 0
+        assert payload["value"] == 5.0
+        legs = payload["extras"]["harness"]["legs"]
+        assert "circuit-broken" in legs["north_star_band"]
+        assert any(
+            "circuit-broken" in d for d in payload["extras"]["degraded"]
+        )
+
+    def test_success_resets_the_breaker(self, monkeypatch):
+        monkeypatch.setenv("BCE_BENCH_BUDGET_S", "4800")
+        monkeypatch.setenv("BCE_BENCH_PROBE_BUDGET_S", "10")
+        canned = {"probe": {"ok": True, "value": {"platform": "tpu"}}}
+        canned.update(_full_results())
+        # One timeout between successes must not accumulate.
+        canned["compact_fit"] = {"ok": False, "error": "timeout after 500s"}
+        canned["stream_probe"] = {"ok": False, "error": "timeout after 400s"}
+        log = []
+
+        def run_leg(name, timeout=None, fast=False, cpu=False):
+            log.append(name)
+            return canned.get(name, {"ok": False, "error": "unexpected"})
+
+        payload, rc = bench.orchestrate(
+            run_leg=run_leg, sleeper=lambda s: None
+        )
+        assert rc == 0
+        # dispatch_rtt succeeded between the two timeouts: breaker reset,
+        # every device leg was attempted.
+        assert log.count("pallas_1m16") == 1
+        assert "degraded" not in payload["extras"]
+
+    def test_fast_crash_mentioning_timeout_does_not_trip(self, monkeypatch):
+        """Only the harness's own kill message counts: a quick crash whose
+        stderr tail mentions 'timeout' burned no budget."""
+        monkeypatch.setenv("BCE_BENCH_BUDGET_S", "4800")
+        monkeypatch.setenv("BCE_BENCH_PROBE_BUDGET_S", "10")
+        canned = {"probe": _ok({"platform": "tpu"})}
+        canned.update(_full_results())
+        canned["headline_f32"] = _fail(
+            "leg process died rc=1: RPC timeout watchdog fired"
+        )
+        canned["compact_fit"] = _fail(
+            "leg process died rc=1: RPC timeout watchdog fired"
+        )
+        log = []
+
+        def run_leg(name, timeout=None, fast=False, cpu=False):
+            log.append(name)
+            return canned.get(name, _fail("unexpected"))
+
+        payload, rc = bench.orchestrate(
+            run_leg=run_leg, sleeper=lambda s: None
+        )
+        assert rc == 0
+        assert log.count("pallas_1m16") == 1  # nothing was circuit-broken
+        assert "degraded" not in payload["extras"]
+
+    def test_trailing_timeouts_do_not_claim_a_trip(self, monkeypatch):
+        """Timeouts on the LAST two legs reach the threshold after the
+        loop: nothing was skipped, so degraded must not say it was."""
+        monkeypatch.setenv("BCE_BENCH_BUDGET_S", "4800")
+        monkeypatch.setenv("BCE_BENCH_PROBE_BUDGET_S", "10")
+        canned = {"probe": _ok({"platform": "tpu"})}
+        canned.update(_full_results())
+        canned["tiebreak_10k_agents"] = _fail("timeout after 900s (killed)")
+        canned["pallas_1m16"] = _fail("timeout after 700s (killed)")
+
+        def run_leg(name, timeout=None, fast=False, cpu=False):
+            return canned.get(name, _fail("unexpected"))
+
+        payload, rc = bench.orchestrate(
+            run_leg=run_leg, sleeper=lambda s: None
+        )
+        assert rc == 0
+        assert "degraded" not in payload["extras"]
