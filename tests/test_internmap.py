@@ -338,3 +338,36 @@ class TestIndexedPairs:
             ["m", 42], np.array([0], dtype=np.int32))
         assert np.frombuffer(rows, dtype=np.int32).tolist() == [0]
         assert raw.id_of(0) == ("ok", "m")
+
+
+class TestIndexedErrorRecoveryParity:
+    def test_pairs_before_a_bad_code_are_interned(self):
+        """Chunked batching may not change observable error-recovery state:
+        like the per-pair paths, everything BEFORE the bad pair interns."""
+        internmap = pytest.importorskip(
+            "bayesian_consensus_engine_tpu._native.internmap"
+        )
+        m = internmap.InternMap()
+        a_table = ["s0", "s1", "s2"]
+        b_table = ["m0", "m1"]
+        a_codes = np.asarray([0, 1, 2, 99], dtype=np.int32)  # 99: bad
+        b_codes = np.asarray([0, 1, 0, 1], dtype=np.int32)
+        with pytest.raises(IndexError, match="pair 3"):
+            m.intern_pairs_indexed(a_table, a_codes, b_table, b_codes)
+        assert len(m) == 3
+        assert m.ids() == [("s0", "m0"), ("s1", "m1"), ("s2", "m0")]
+
+    def test_bad_pair_in_a_later_chunk(self):
+        internmap = pytest.importorskip(
+            "bayesian_consensus_engine_tpu._native.internmap"
+        )
+        m = internmap.InternMap()
+        n = 1024 + 7  # crosses the chunk boundary
+        a_table = [f"s{i}" for i in range(n)]
+        b_table = ["mkt"]
+        a_codes = np.arange(n, dtype=np.int32)
+        a_codes[-1] = n + 50  # bad code in the second chunk
+        b_codes = np.zeros(n, dtype=np.int32)
+        with pytest.raises(IndexError):
+            m.intern_pairs_indexed(a_table, a_codes, b_table, b_codes)
+        assert len(m) == n - 1  # everything before the bad pair interned
