@@ -383,17 +383,68 @@ InternMap_intern_pairs(InternMap *self, PyObject *args)
     if (two_seqs(args, &fast_a, &fast_b, &n) < 0) return NULL;
     PyObject *out = PyByteArray_FromStringAndSize(NULL, n * 4);
     char *scratch = NULL;
-    Py_ssize_t cap = 0;
-    if (!out || map_reserve_cold(self, (size_t)n) < 0) goto fail;
+    /* Same chunked assemble→hash→prefetch→insert pipeline as the indexed
+     * path (see intern_pairs_indexed): the inserts are random-miss-bound
+     * on the slots table at scale, and prefetching each key's home slot
+     * while the rest of the chunk assembles hides part of the latency.
+     * Error-recovery parity preserved: pairs before a failing pair still
+     * intern before the error raises. */
+    enum { FF_PCHUNK = 1024 };
+    size_t offs[FF_PCHUNK];
+    uint32_t lens[FF_PCHUNK];
+    uint64_t hashes[FF_PCHUNK];
+    Py_ssize_t cap = 64 * FF_PCHUNK;
+    scratch = PyMem_Malloc((size_t)cap);
+    if (!out || !scratch || map_reserve_cold(self, (size_t)n) < 0) {
+        if (!scratch) PyErr_NoMemory();
+        goto fail;
+    }
     int32_t *rows = (int32_t *)PyByteArray_AS_STRING(out);
-    for (Py_ssize_t i = 0; i < n; i++) {
-        PyObject *a = PySequence_Fast_GET_ITEM(fast_a, i);
-        PyObject *b = PySequence_Fast_GET_ITEM(fast_b, i);
-        Py_ssize_t len = pair_key(a, b, &scratch, &cap);
-        if (len < 0) goto fail;
-        int32_t row = map_intern(self, scratch, (size_t)len);
-        if (row < 0) goto fail;
-        rows[i] = row;
+    for (Py_ssize_t start = 0; start < n; start += FF_PCHUNK) {
+        Py_ssize_t m = n - start < FF_PCHUNK ? n - start : FF_PCHUNK;
+        size_t kused = 0;
+        int chunk_failed = 0;
+        Py_ssize_t assembled = m;
+        for (Py_ssize_t j = 0; j < m; j++) {
+            PyObject *a = PySequence_Fast_GET_ITEM(fast_a, start + j);
+            PyObject *b = PySequence_Fast_GET_ITEM(fast_b, start + j);
+            Py_ssize_t alen, blen;
+            const char *abuf = utf8_of(a, &alen);
+            const char *bbuf = abuf ? utf8_of(b, &blen) : NULL;
+            if (!abuf || !bbuf || reject_nul(abuf, alen) < 0 ||
+                reject_nul(bbuf, blen) < 0) {
+                chunk_failed = 1;
+                assembled = j;
+                break;
+            }
+            Py_ssize_t need = alen + 1 + blen;
+            if ((Py_ssize_t)kused + need > cap) {
+                cap = ((Py_ssize_t)kused + need) * 2;
+                char *grown = PyMem_Realloc(scratch, (size_t)cap);
+                if (!grown) {
+                    PyErr_NoMemory();
+                    chunk_failed = 1;
+                    assembled = j;
+                    break;
+                }
+                scratch = grown;
+            }
+            memcpy(scratch + kused, abuf, (size_t)alen);
+            scratch[kused + (size_t)alen] = '\0';
+            memcpy(scratch + kused + (size_t)alen + 1, bbuf, (size_t)blen);
+            offs[j] = kused;
+            lens[j] = (uint32_t)need;
+            hashes[j] = fnv1a(scratch + kused, (size_t)need);
+            FF_PREFETCH(&self->slots[hashes[j] & (self->capacity - 1)]);
+            kused += (size_t)need;
+        }
+        for (Py_ssize_t j = 0; j < assembled; j++) {
+            int32_t row = map_intern_hashed(
+                self, scratch + offs[j], lens[j], hashes[j]);
+            if (row < 0) goto fail;
+            rows[start + j] = row;
+        }
+        if (chunk_failed) goto fail;
     }
     PyMem_Free(scratch);
     Py_DECREF(fast_a);

@@ -371,3 +371,14 @@ class TestIndexedErrorRecoveryParity:
         with pytest.raises(IndexError):
             m.intern_pairs_indexed(a_table, a_codes, b_table, b_codes)
         assert len(m) == n - 1  # everything before the bad pair interned
+
+    def test_intern_pairs_partial_state_on_error(self):
+        internmap = pytest.importorskip(
+            "bayesian_consensus_engine_tpu._native.internmap"
+        )
+        m = internmap.InternMap()
+        sources = ["a", "b", "bad\x00id", "c"]
+        markets = ["m", "m", "m", "m"]
+        with pytest.raises(ValueError):
+            m.intern_pairs(sources, markets)
+        assert m.ids() == [("a", "m"), ("b", "m")]
