@@ -478,7 +478,13 @@ class TensorReliabilityStore:
 
     @_locked
     def close(self) -> None:
-        """No external resources; present for store-API parity."""
+        """Join any in-flight background checkpoint (the writer thread is
+        a daemon — dropped at interpreter exit, which would silently lose
+        the checkpoint; its transaction rolls back, but the caller asked
+        for durability). A prior write failure re-raises here with the
+        flush bookkeeping rolled back, like any flush entry point."""
+        if self._flush_inflight is not None:
+            self._flush_inflight.result()
 
     def __enter__(self) -> "TensorReliabilityStore":
         return self

@@ -543,3 +543,48 @@ class TestSettleStream:
             batches[:2], tmp_path / "serial.db", steps=1, now=21_050.0
         )
         assert db_records(db) == db_records(tmp_path / "serial.db")
+
+
+class TestCloseJoinsFlush:
+    def test_close_joins_inflight_checkpoint(self, tmp_path, monkeypatch):
+        store = seeded_store()
+        gate = threading.Event()
+        real_builder = store._build_snapshot_writer
+
+        def gated_builder(*args, **kwargs):
+            writer = real_builder(*args, **kwargs)
+
+            def slow_writer():
+                gate.wait(timeout=30)
+                return writer()
+
+            return slow_writer
+
+        monkeypatch.setattr(store, "_build_snapshot_writer", gated_builder)
+        handle = store.flush_to_sqlite_async(tmp_path / "ckpt.db")
+        # Prove close() BLOCKS on the in-flight write by construction:
+        # run it on a helper thread while the writer is still gated.
+        closer = threading.Thread(target=store.close)
+        closer.start()
+        closer.join(timeout=0.3)
+        assert closer.is_alive(), "close() returned before the write landed"
+        gate.set()
+        closer.join(timeout=30)
+        assert not closer.is_alive()
+        assert handle.done()
+        assert len(db_records(tmp_path / "ckpt.db")) == 25
+
+    def test_close_surfaces_background_failure(self, tmp_path, monkeypatch):
+        store = seeded_store()
+
+        def broken_builder(*args, **kwargs):
+            def writer():
+                raise RuntimeError("checkpoint disk gone")
+
+            return writer
+
+        monkeypatch.setattr(store, "_build_snapshot_writer", broken_builder)
+        store.flush_to_sqlite_async(tmp_path / "ckpt.db")
+        with pytest.raises(RuntimeError, match="checkpoint disk gone"):
+            store.close()
+        store.close()  # idempotent after the failure surfaced
