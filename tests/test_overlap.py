@@ -588,3 +588,55 @@ class TestCloseJoinsFlush:
         with pytest.raises(RuntimeError, match="checkpoint disk gone"):
             store.close()
         store.close()  # idempotent after the failure surfaced
+
+
+class TestSettleStreamColumnar:
+    def test_columnar_batches_match_dict_batches(self, tmp_path):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        rng = random.Random(41)
+        dict_batches = []
+        for b in range(3):
+            payloads = random_payloads(rng, 8, universe=12, tag=f"-c{b}")
+            outcomes = [rng.random() < 0.5 for _ in range(8)]
+            dict_batches.append((payloads, outcomes))
+
+        def to_columns(payloads):
+            keys = [market_id for market_id, _ in payloads]
+            source_ids, probs, offsets = [], [], [0]
+            for _, signals in payloads:
+                for signal in signals:
+                    source_ids.append(signal["sourceId"])
+                    probs.append(signal["probability"])
+                offsets.append(len(source_ids))
+            return (
+                keys,
+                source_ids,
+                np.asarray(probs, dtype=np.float64),
+                np.asarray(offsets, dtype=np.int64),
+            )
+
+        dict_store = TensorReliabilityStore()
+        dict_results = list(
+            settle_stream(
+                dict_store, dict_batches, steps=2, now=21_060.0,
+                db_path=tmp_path / "dict.db",
+            )
+        )
+        col_store = TensorReliabilityStore()
+        col_results = list(
+            settle_stream(
+                col_store,
+                [(to_columns(p), o) for p, o in dict_batches],
+                steps=2,
+                now=21_060.0,
+                db_path=tmp_path / "col.db",
+                columnar=True,
+            )
+        )
+        for mine, ref in zip(col_results, dict_results):
+            assert mine.market_keys == ref.market_keys
+            np.testing.assert_array_equal(mine.consensus, ref.consensus)
+        assert db_records(tmp_path / "col.db") == db_records(
+            tmp_path / "dict.db"
+        )
