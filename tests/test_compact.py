@@ -475,3 +475,55 @@ class TestReducedPrecisionProbs:
         for line in entry.splitlines():
             if "while(" in line:
                 assert f"f32[{K},{M}]" not in line.split("while(")[0], line
+
+    def test_u16_probs_on_the_mesh_loop(self):
+        """The sharded compact loop (shard_map over a 2-D mesh) must accept
+        u16 probability blocks too — the north-star multi-chip shape."""
+        import jax
+
+        from bayesian_consensus_engine_tpu.parallel import make_mesh
+        from bayesian_consensus_engine_tpu.parallel.compact import (
+            _decode_probs,
+            encode_probs_u16,
+        )
+        from bayesian_consensus_engine_tpu.parallel.mesh import (
+            MARKETS_AXIS,
+            SOURCES_AXIS,
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = make_mesh((4, 2))
+        M, K, steps = 64, 8, 3
+        probs, mask, outcome = self._workload(M, K)
+        block = NamedSharding(mesh, P(SOURCES_AXIS, MARKETS_AXIS))
+        market = NamedSharding(mesh, P(MARKETS_AXIS))
+        encoded = jax.device_put(encode_probs_u16(probs), block)
+        mask_s = jax.device_put(mask, block)
+        outcome_s = jax.device_put(outcome, market)
+
+        def sharded_state():
+            return jax.tree.map(
+                lambda x: jax.device_put(x, block),
+                init_compact_state(M, K),
+            )
+
+        loop = build_compact_cycle_loop(mesh, donate=False)
+        s_enc, c_enc = loop(
+            encoded, mask_s, outcome_s, sharded_state(), jnp.float32(1.0),
+            steps,
+        )
+        # Equals the single-device loop on the decoded inputs (2-D mesh:
+        # psum partial sums re-associate — ulp tolerance, like the f32
+        # sharded-vs-flat contract).
+        flat = build_compact_cycle_loop(mesh=None, donate=False)
+        s_ref, c_ref = flat(
+            _decode_probs(encode_probs_u16(probs)), mask, outcome,
+            init_compact_state(M, K), jnp.float32(1.0), steps,
+        )
+        np.testing.assert_allclose(
+            np.asarray(c_enc, np.float32), np.asarray(c_ref, np.float32),
+            rtol=2e-6, atol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s_enc.rel_steps), np.asarray(s_ref.rel_steps)
+        )
