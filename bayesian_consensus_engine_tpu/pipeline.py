@@ -1160,6 +1160,7 @@ def settle_stream(
     num_slots: "int | str | None" = "bucket",
     columnar: bool = False,
     native: Optional[bool] = None,
+    stats: Optional[list] = None,
 ):
     """The streamed settle-and-checkpoint service loop, fully overlapped.
 
@@ -1187,7 +1188,17 @@ def settle_stream(
     :class:`SettlementResult` per batch, in order. Results, store state,
     and checkpoint files equal the serial build → settle → flush loop
     (pinned by tests/test_overlap.py).
+
+    *stats*, if given, is a mutable list the service appends one dict per
+    batch to: ``{"batch", "markets", "plan_wait_s", "settle_s",
+    "checkpoint_dispatched"}`` — ``plan_wait_s`` is how long the consumer
+    waited on the prefetch thread (near zero once the pipeline fills;
+    large values mean ingest, not the device, is the bottleneck), and the
+    checkpoint flag marks batches that kicked off a background flush. The
+    dict for a batch is appended BEFORE its result is yielded.
     """
+    import time as _time
+
     if checkpoint_every < 1:
         raise ValueError("checkpoint_every must be >= 1")
     outcome_queue: "deque" = _collections.deque()
@@ -1208,17 +1219,42 @@ def settle_stream(
             num_slots=num_slots,
             native=native,
         ) as plans:
-            for index, plan in enumerate(plans):
+            plan_iter = iter(plans)
+            index = -1
+            while True:
+                wait_start = _time.perf_counter()
+                try:
+                    plan = next(plan_iter)
+                except StopIteration:
+                    break
+                plan_wait_s = _time.perf_counter() - wait_start
+                index += 1
                 outcomes = outcome_queue.popleft()
                 batch_now = None if now is None else now + index
+                settle_start = _time.perf_counter()
                 result = settle(
                     store, plan, outcomes, steps=steps, now=batch_now
                 )
-                if db_path is not None and (index + 1) % checkpoint_every == 0:
+                settle_s = _time.perf_counter() - settle_start
+                checkpointed = (
+                    db_path is not None
+                    and (index + 1) % checkpoint_every == 0
+                )
+                if checkpointed:
                     # Joins any in-flight write first (flushes serialise), so
                     # a prior background failure surfaces here, not silently.
                     handle = store.flush_to_sqlite_async(db_path)
                     flushed_through = index
+                if stats is not None:
+                    stats.append(
+                        {
+                            "batch": index,
+                            "markets": plan.num_markets,
+                            "plan_wait_s": round(plan_wait_s, 4),
+                            "settle_s": round(settle_s, 4),
+                            "checkpoint_dispatched": checkpointed,
+                        }
+                    )
                 yield result
     finally:
         # Runs on EVERY exit — exhaustion, a consumer break/close

@@ -640,3 +640,32 @@ class TestSettleStreamColumnar:
         assert db_records(tmp_path / "col.db") == db_records(
             tmp_path / "dict.db"
         )
+
+    def test_stats_reports_per_batch_timings(self, tmp_path):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        rng = random.Random(43)
+        batches = [
+            (
+                random_payloads(rng, 9, universe=12, tag=f"-st{b}"),
+                [rng.random() < 0.5 for _ in range(9)],
+            )
+            for b in range(3)
+        ]
+        stats = []
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store, batches, steps=1, now=21_070.0,
+                db_path=tmp_path / "s.db", checkpoint_every=2, stats=stats,
+            )
+        )
+        assert len(results) == 3
+        assert [s["batch"] for s in stats] == [0, 1, 2]
+        assert [s["checkpoint_dispatched"] for s in stats] == [
+            False, True, False,
+        ]
+        for s in stats:
+            assert s["markets"] == 9
+            assert s["plan_wait_s"] >= 0
+            assert s["settle_s"] > 0
