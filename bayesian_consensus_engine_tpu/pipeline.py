@@ -1190,12 +1190,17 @@ def settle_stream(
     (pinned by tests/test_overlap.py).
 
     *stats*, if given, is a mutable list the service appends one dict per
-    batch to: ``{"batch", "markets", "plan_wait_s", "settle_s",
-    "checkpoint_dispatched"}`` — ``plan_wait_s`` is how long the consumer
-    waited on the prefetch thread (near zero once the pipeline fills;
-    large values mean ingest, not the device, is the bottleneck), and the
-    checkpoint flag marks batches that kicked off a background flush. The
-    dict for a batch is appended BEFORE its result is yielded.
+    batch to: ``{"batch", "markets", "plan_wait_s", "settle_dispatch_s",
+    "checkpoint_s"}``. ``plan_wait_s`` is how long the consumer waited on
+    the prefetch thread (near zero once the pipeline fills; large values
+    mean ingest is the bottleneck). ``settle_dispatch_s`` is the HOST
+    cost of dispatching the settle — deliberately unfenced: the kernel
+    runs asynchronously (fencing here would serialise away the overlap),
+    so device time is NOT in it; device backpressure surfaces instead in
+    ``checkpoint_s`` (the flush call drains the pending device results
+    before snapshotting) — ``None`` on batches that didn't checkpoint.
+    Raw floats, un-rounded. The dict for a batch is appended BEFORE its
+    result is yielded.
     """
     import time as _time
 
@@ -1220,7 +1225,6 @@ def settle_stream(
             native=native,
         ) as plans:
             plan_iter = iter(plans)
-            index = -1
             while True:
                 wait_start = _time.perf_counter()
                 try:
@@ -1235,24 +1239,23 @@ def settle_stream(
                 result = settle(
                     store, plan, outcomes, steps=steps, now=batch_now
                 )
-                settle_s = _time.perf_counter() - settle_start
-                checkpointed = (
-                    db_path is not None
-                    and (index + 1) % checkpoint_every == 0
-                )
-                if checkpointed:
+                settle_dispatch_s = _time.perf_counter() - settle_start
+                checkpoint_s = None
+                if db_path is not None and (index + 1) % checkpoint_every == 0:
                     # Joins any in-flight write first (flushes serialise), so
                     # a prior background failure surfaces here, not silently.
+                    checkpoint_start = _time.perf_counter()
                     handle = store.flush_to_sqlite_async(db_path)
+                    checkpoint_s = _time.perf_counter() - checkpoint_start
                     flushed_through = index
                 if stats is not None:
                     stats.append(
                         {
                             "batch": index,
                             "markets": plan.num_markets,
-                            "plan_wait_s": round(plan_wait_s, 4),
-                            "settle_s": round(settle_s, 4),
-                            "checkpoint_dispatched": checkpointed,
+                            "plan_wait_s": plan_wait_s,
+                            "settle_dispatch_s": settle_dispatch_s,
+                            "checkpoint_s": checkpoint_s,
                         }
                     )
                 yield result

@@ -662,10 +662,10 @@ class TestSettleStreamColumnar:
         )
         assert len(results) == 3
         assert [s["batch"] for s in stats] == [0, 1, 2]
-        assert [s["checkpoint_dispatched"] for s in stats] == [
+        assert [s["checkpoint_s"] is not None for s in stats] == [
             False, True, False,
         ]
         for s in stats:
             assert s["markets"] == 9
             assert s["plan_wait_s"] >= 0
-            assert s["settle_s"] > 0
+            assert s["settle_dispatch_s"] >= 0
