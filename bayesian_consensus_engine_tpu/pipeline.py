@@ -257,8 +257,12 @@ def _intern_source_codes(source_ids):
     module = _load_internmap()
     if module is not None:
         table = module.InternMap()
+        # The C pass accepts any sequence — don't copy 4M refs when the
+        # caller already holds a list/tuple.
+        if not isinstance(source_ids, (list, tuple)):
+            source_ids = list(source_ids)
         codes = np.frombuffer(
-            table.intern_batch(list(source_ids)), dtype=np.int32
+            table.intern_batch(source_ids), dtype=np.int32
         )
         return codes, table.ids()
     interner = IdInterner()
