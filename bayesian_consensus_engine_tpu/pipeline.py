@@ -790,6 +790,22 @@ class _BandView:
         return values.astype(dtype) if dtype is not None else values
 
 
+_cycle_loop_cache: dict = {}
+
+
+def _cached_cycle_loop(mesh):
+    """One slot-major donating cycle loop per mesh (sessions share it)."""
+    loop = _cycle_loop_cache.get(mesh)
+    if loop is None:
+        from bayesian_consensus_engine_tpu.parallel.sharded import (
+            build_cycle_loop,
+        )
+
+        loop = build_cycle_loop(mesh, slot_major=True, donate=True)
+        _cycle_loop_cache[mesh] = loop
+    return loop
+
+
 class ShardedSettlementSession:
     """Chained, device-resident sharded settlements for one plan.
 
@@ -841,7 +857,6 @@ class ShardedSettlementSession:
         )
         from bayesian_consensus_engine_tpu.parallel.sharded import (
             MarketBlockState,
-            build_cycle_loop,
         )
         from bayesian_consensus_engine_tpu.utils.config import (
             DEFAULT_CONFIDENCE as _CONF0,
@@ -874,7 +889,11 @@ class ShardedSettlementSession:
         )
         self._epoch0 = epoch0
         if self._loop is None:
-            self._loop = build_cycle_loop(mesh, slot_major=True, donate=True)
+            # Shared per mesh, not per session: the jit tracing cache lives
+            # on the wrapper instance, so a fresh build_cycle_loop() here
+            # would retrace (and re-compile) every per-batch session of a
+            # sharded settle_stream even at identical shapes.
+            self._loop = _cached_cycle_loop(mesh)
 
     def settle(
         self,
@@ -1165,6 +1184,9 @@ def settle_stream(
     columnar: bool = False,
     native: Optional[bool] = None,
     stats: Optional[list] = None,
+    mesh=None,
+    band=None,
+    dtype=None,
 ):
     """The streamed settle-and-checkpoint service loop, fully overlapped.
 
@@ -1204,12 +1226,48 @@ def settle_stream(
     ``checkpoint_s`` (the flush call drains the pending device results
     before snapshotting) — ``None`` on batches that didn't checkpoint.
     Raw floats, un-rounded. The dict for a batch is appended BEFORE its
-    result is yielded.
+    result is yielded. Under ``mesh=`` the dispatch-only reading of
+    ``settle_dispatch_s`` does NOT hold: each batch's session build first
+    drains the PREVIOUS batch's device→host band gather and re-uploads
+    host state, so device backpressure surfaces here (not in
+    ``checkpoint_s``) — read it as the full per-batch settle window.
+
+    *mesh*, if given, runs every settle sharded over the device mesh:
+    each batch settles through a :class:`ShardedSettlementSession`
+    (markets on the lane axis, source slots optionally split with a
+    ``psum`` reduction), abandoned without an eager close — the
+    session's host-merge recipe is registered at settle, and the NEXT
+    batch's state build (or the next checkpoint) resolves it, so the
+    device→host gather of batch N overlaps nothing worse than batch
+    N+1's plan prefetch. Results, store state, and checkpoint files are
+    bit-identical to the flat stream on a markets-only mesh (a 2-D mesh
+    re-associates each market's slot sum into psum partials: ≤1 ulp on
+    consensus, state updates quantised identically — see
+    :func:`settle_sharded`). ``num_slots="bucket"`` remains the default;
+    the mesh path additionally pads K to the sources-axis extent, so
+    wobbling batch widths still share compiled settle programs.
+
+    *band*, multi-process only: ``(lo, global_markets)`` marks each
+    batch's plan as covering ONLY this process's markets — rows
+    ``[lo, lo+M_plan)`` of a ``global_markets``-wide axis — or a
+    callable ``band(batch_index) -> (lo, global_markets)`` when the
+    global width wobbles per batch. Band mode needs a globally-agreed
+    integer *num_slots* (``"bucket"`` pads per-process maxima, which
+    processes disagree on). *dtype* overrides the mesh path's compute
+    dtype (:func:`~.utils.dtypes.default_float_dtype` otherwise).
     """
     import time as _time
 
     if checkpoint_every < 1:
         raise ValueError("checkpoint_every must be >= 1")
+    if band is not None and mesh is None:
+        raise ValueError("band= requires mesh=")
+    if band is not None and not isinstance(num_slots, int):
+        raise ValueError(
+            "band mode needs a globally-agreed integer num_slots; "
+            f"{num_slots!r} derives K from per-process maxima, which "
+            "processes disagree on"
+        )
     outcome_queue: "deque" = _collections.deque()
 
     def payload_stream():
@@ -1240,9 +1298,25 @@ def settle_stream(
                 outcomes = outcome_queue.popleft()
                 batch_now = None if now is None else now + index
                 settle_start = _time.perf_counter()
-                result = settle(
-                    store, plan, outcomes, steps=steps, now=batch_now
-                )
+                if mesh is None:
+                    result = settle(
+                        store, plan, outcomes, steps=steps, now=batch_now,
+                        dtype=dtype,
+                    )
+                else:
+                    # One session per batch (each batch is its own plan),
+                    # abandoned without close: the settle registered the
+                    # store's merge recipe, and closing here would sync it
+                    # eagerly — serialising the device→host gather against
+                    # this thread. Left pending, the NEXT batch's state
+                    # build (or the checkpoint flush) resolves it instead.
+                    batch_band = band(index) if callable(band) else band
+                    session = ShardedSettlementSession(
+                        store, plan, mesh, dtype=dtype, band=batch_band
+                    )
+                    result = session.settle(
+                        outcomes, steps=steps, now=batch_now
+                    )
                 settle_dispatch_s = _time.perf_counter() - settle_start
                 checkpoint_s = None
                 if db_path is not None and (index + 1) % checkpoint_every == 0:

@@ -669,3 +669,173 @@ class TestSettleStreamColumnar:
             assert s["markets"] == 9
             assert s["plan_wait_s"] >= 0
             assert s["settle_dispatch_s"] >= 0
+
+
+class TestSettleStreamSharded:
+    """settle_stream(mesh=...): the streamed service loop over a device
+    mesh must equal the flat stream — bit-identical on a markets-only
+    mesh (same reduction tree), and the overlap contract (deferred band
+    gathers, background checkpoints) must hold unchanged."""
+
+    def _batches(self, num_batches=4, markets=9, seed=47):
+        rng = random.Random(seed)
+        out = []
+        for b in range(num_batches):
+            payloads = random_payloads(rng, markets, universe=15, tag=f"-sh{b}")
+            outcomes = [rng.random() < 0.5 for _ in range(markets)]
+            out.append((payloads, outcomes))
+        return out
+
+    def _flat(self, batches, db, steps=2, now=21_100.0):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store, batches, steps=steps, now=now, db_path=db
+            )
+        )
+        store.sync()
+        return store, results
+
+    def test_markets_only_mesh_matches_flat_stream_bitwise(self, tmp_path):
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        batches = self._batches()
+        flat_store, flat_results = self._flat(batches, tmp_path / "flat.db")
+
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store,
+                batches,
+                steps=2,
+                now=21_100.0,
+                db_path=tmp_path / "mesh.db",
+                mesh=make_mesh(),  # (8, 1): markets-only
+            )
+        )
+        assert len(results) == len(flat_results)
+        for mine, ref in zip(results, flat_results):
+            assert mine.market_keys == ref.market_keys
+            np.testing.assert_array_equal(
+                np.asarray(mine.consensus), ref.consensus
+            )
+        store.sync()
+        assert store.list_sources() == flat_store.list_sources()
+        assert db_records(tmp_path / "mesh.db") == db_records(
+            tmp_path / "flat.db"
+        )
+
+    def test_two_d_mesh_matches_to_ulp(self, tmp_path):
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        batches = self._batches(seed=53)
+        flat_store, flat_results = self._flat(batches, tmp_path / "flat.db")
+
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store,
+                batches,
+                steps=2,
+                now=21_100.0,
+                db_path=tmp_path / "mesh.db",
+                mesh=make_mesh((4, 2)),  # sources split: psum partials
+            )
+        )
+        for mine, ref in zip(results, flat_results):
+            assert mine.market_keys == ref.market_keys
+            np.testing.assert_allclose(
+                np.asarray(mine.consensus), ref.consensus,
+                rtol=2e-6, atol=1e-7,
+            )
+        store.sync()
+        mine, theirs = store.list_sources(), flat_store.list_sources()
+        assert len(mine) == len(theirs) > 0
+        for a, b in zip(mine, theirs):
+            assert (a.source_id, a.market_id) == (b.source_id, b.market_id)
+            assert abs(a.reliability - b.reliability) < 1e-6
+            assert a.confidence == b.confidence  # host-replayed, both paths
+            assert a.updated_at == b.updated_at
+
+    def test_band_gather_stays_deferred_between_batches(self):
+        """The mesh path must NOT sync eagerly after each settle: the last
+        batch's merge recipe stays pending until a host read resolves it
+        (the overlap the per-batch session must preserve)."""
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store, self._batches(num_batches=2), steps=1,
+                now=21_110.0, mesh=make_mesh(),
+            )
+        )
+        assert len(results) == 2
+        assert store._pending_sync, "last batch's recipe was synced eagerly"
+        store.sync()
+        assert not store._pending_sync
+
+    def test_stats_and_checkpoint_every_on_mesh(self, tmp_path):
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        stats = []
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store, self._batches(num_batches=3), steps=1, now=21_120.0,
+                db_path=tmp_path / "s.db", checkpoint_every=2, stats=stats,
+                mesh=make_mesh(),
+            )
+        )
+        assert len(results) == 3
+        assert [s["checkpoint_s"] is not None for s in stats] == [
+            False, True, False,
+        ]
+
+    def test_band_parameter_validation(self):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        with pytest.raises(ValueError, match="band= requires mesh="):
+            next(iter(settle_stream(
+                TensorReliabilityStore(), [], band=(0, 8)
+            )))
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+
+        with pytest.raises(ValueError, match="globally-agreed integer"):
+            next(iter(settle_stream(
+                TensorReliabilityStore(), [], mesh=make_mesh(),
+                band=(0, 8),
+            )))
+        with pytest.raises(ValueError, match="globally-agreed integer"):
+            # num_slots=None (natural K) is per-process too, not just
+            # "bucket": processes' plans would disagree on the block shape.
+            next(iter(settle_stream(
+                TensorReliabilityStore(), [], mesh=make_mesh(),
+                band=(0, 8), num_slots=None,
+            )))
+
+    def test_sessions_share_one_compiled_loop_per_mesh(self):
+        """Per-batch sessions must reuse ONE jit wrapper per mesh — a fresh
+        build_cycle_loop() per session would retrace (and on TPU recompile)
+        the sharded cycle on every streamed batch at identical shapes."""
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+            build_settlement_plan,
+        )
+
+        mesh = make_mesh()
+        store = TensorReliabilityStore()
+        loops = []
+        for b, (payloads, outcomes) in enumerate(self._batches(num_batches=2)):
+            plan = build_settlement_plan(store, payloads, num_slots="bucket")
+            session = ShardedSettlementSession(store, plan, mesh)
+            session.settle(outcomes, steps=1, now=21_130.0 + b)
+            loops.append(session._loop)
+        assert loops[0] is loops[1]
