@@ -84,10 +84,13 @@ import sys
 import tempfile
 import time
 
-# Measured 2026-07-30 via scripts/measure_reference_baseline.py (1000 markets,
-# 16 sources/market, in-memory SQLite, warm reliability table). 2026-07-29
-# measured 0.0019838 on a busier CPU; the faster (reference-favouring)
-# number is recorded.
+# Measured via scripts/measure_reference_baseline.py (in-memory SQLite, warm
+# reliability table; min-of-N methodology + full trial record in BASELINE.md).
+# History on this host: 0.0019838 (2026-07-29, busy CPU), 0.0027102
+# (2026-07-30, 1000 markets, single pass), 0.0024822 / 0.0023932 (2026-07-31,
+# 2000 markets, min-of-5 / min-of-8, load 0.5-0.8 on nproc=1). The FASTEST
+# ever observed is recorded — reference-favouring, so vs_baseline is a lower
+# bound on the true ratio.
 REFERENCE_BASELINE_CYCLES_PER_SEC = 0.0027102
 
 NUM_MARKETS = 1_000_000

@@ -7,12 +7,31 @@ SQLite reads, plus one ``update_reliability`` per (source, market) pair —
 one full "consensus + reliability-update cycle" over the batch
 (reference: market.py:200-221, reliability.py:185-231).
 
-Usage:  python scripts/measure_reference_baseline.py [markets] [sources_per_market]
+Usage:  python scripts/measure_reference_baseline.py [markets] [sources_per_market] [trials]
 
-Prints markets/sec and the extrapolated cycles/sec at 1M markets — the
-constant baked into bench.py (re-run this script to refresh it).
+Methodology (pinned — the shared host carries external load that can
+inflate any single pass up to ~4x, so one-shot numbers are not
+defensible):
+
+  * ``trials`` (default 5) independent timed passes run in THIS process,
+    each on a freshly built store (the build is outside the timer);
+  * the headline is the FASTEST pass (min elapsed = max throughput):
+    external load only ever slows the reference down, so min-of-N is the
+    reference-favouring bound — the conservative denominator for any
+    "× faster" claim we publish;
+  * 1-minute load average is sampled before and after and recorded next
+    to the number; a run whose load swamps ``nproc`` should be re-taken;
+  * per-trial throughputs are printed so the spread (contention) is
+    visible in the record.
+
+Prints one JSON line with all trials + the headline markets/sec and the
+extrapolated cycles/sec at 1M markets — the constant baked into bench.py
+(re-run this script to refresh it; update BASELINE.md's table with the
+JSON).
 """
 
+import json
+import os
 import random
 import sys
 import time
@@ -23,7 +42,7 @@ from bayesian_engine.market import MarketId, MarketStore  # noqa: E402
 from bayesian_engine.reliability import SQLiteReliabilityStore  # noqa: E402
 
 
-def measure(num_markets: int = 500, sources_per_market: int = 16) -> dict:
+def one_trial(num_markets: int = 500, sources_per_market: int = 16) -> dict:
     rng = random.Random(0)
     store = MarketStore()
     rel = SQLiteReliabilityStore(":memory:")
@@ -51,21 +70,45 @@ def measure(num_markets: int = 500, sources_per_market: int = 16) -> dict:
                 (signal["probability"] >= 0.5) == outcome,
             )
     elapsed = time.perf_counter() - start
+    del store, rel
 
     assert len(results) == num_markets
     markets_per_sec = num_markets / elapsed
     return {
-        "markets": num_markets,
-        "sources_per_market": sources_per_market,
         "elapsed_s": elapsed,
         "markets_per_sec": markets_per_sec,
-        "cycles_per_sec_at_1M": markets_per_sec / 1_000_000,
+    }
+
+
+def measure(
+    num_markets: int = 500, sources_per_market: int = 16, trials: int = 5
+) -> dict:
+    """Min-of-N load-controlled measurement (see module docstring)."""
+    load_before = os.getloadavg()[0]
+    runs = [
+        one_trial(num_markets, sources_per_market) for _ in range(trials)
+    ]
+    load_after = os.getloadavg()[0]
+    best = max(r["markets_per_sec"] for r in runs)
+    worst = min(r["markets_per_sec"] for r in runs)
+    return {
+        "markets": num_markets,
+        "sources_per_market": sources_per_market,
+        "trials": trials,
+        "trial_markets_per_sec": [
+            round(r["markets_per_sec"], 2) for r in runs
+        ],
+        "markets_per_sec": best,  # min-of-N elapsed: reference-favouring
+        "spread_worst_over_best": round(worst / best, 3),
+        "load_1m_before": load_before,
+        "load_1m_after": load_after,
+        "nproc": os.cpu_count(),
+        "cycles_per_sec_at_1M": best / 1_000_000,
     }
 
 
 if __name__ == "__main__":
     markets = int(sys.argv[1]) if len(sys.argv) > 1 else 500
     spm = int(sys.argv[2]) if len(sys.argv) > 2 else 16
-    out = measure(markets, spm)
-    for key, value in out.items():
-        print(f"{key}: {value}")
+    trials = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    print(json.dumps(measure(markets, spm, trials)))

@@ -1,0 +1,154 @@
+"""Failure-injection soak for the streamed settlement service at scale.
+
+Streams ≥1M (source, market) rows through ``settle_stream`` with rolling
+background checkpoints, then injects a REAL mid-stream checkpoint failure
+— an exclusive SQLite lock held by a second connection, the
+operationally-common stand-in for disk-full/locked-volume (the rollback
+path is failure-agnostic; tests/test_overlap.py pins the injected-
+exception variant) — and proves the service contract at scale:
+
+  1. the failure surfaces at the next flush join (``database is locked``
+     after the native writer's busy timeout);
+  2. the flush bookkeeping rolled back (failed rows re-marked dirty);
+  3. NO settled batch is lost: after the lock clears, one caller retry
+     flush produces a checkpoint holding exactly the store's live rows.
+
+Run from the repo root:
+
+    python scripts/stream_failure_soak.py [--markets 60000] [--batches 10]
+                                          [--fail-at 5] [--mesh] [--steps 1]
+
+CPU by default (the contract is host-side); ``--tpu`` leaves the default
+backend alone. Exit code 0 iff every assertion holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sqlite3
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--markets", type=int, default=60_000)
+    parser.add_argument("--batches", type=int, default=10)
+    parser.add_argument("--fail-at", type=int, default=5,
+                        help="batch index whose checkpoint hits the lock")
+    parser.add_argument("--steps", type=int, default=1)
+    parser.add_argument("--checkpoint-every", type=int, default=2)
+    parser.add_argument("--sources", type=int, default=3_000,
+                        help="source-id universe (rows ≈ markets × ~2.1)")
+    parser.add_argument("--mesh", action="store_true",
+                        help="stream sharded over an 8-device CPU mesh")
+    parser.add_argument("--tpu", action="store_true",
+                        help="keep the default backend (else force CPU)")
+    args = parser.parse_args()
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+    from bayesian_consensus_engine_tpu.pipeline import settle_stream
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    rng = np.random.default_rng(11)
+    lock_holder: dict = {}
+
+    def day_batch(day: int):
+        """Columnar (keys, source_ids, probs, offsets) + outcomes."""
+        counts = rng.poisson(1.2, args.markets) + 1
+        total = int(counts.sum())
+        keys = [f"d{day}-m{m}" for m in range(args.markets)]
+        sids = [f"s{v}" for v in rng.integers(0, args.sources, total)]
+        probs = rng.random(total)
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        outcomes = (rng.random(args.markets) < 0.5).tolist()
+        return (keys, sids, probs, offsets), outcomes
+
+    tmp = tempfile.mkdtemp()
+    db = os.path.join(tmp, "soak.db")
+    store = TensorReliabilityStore()
+
+    def batches():
+        for day in range(args.batches):
+            yield day_batch(day)
+            if day == args.fail_at:
+                # The NEXT flush's background write must hit a held lock:
+                # take it now, from a second connection, like an external
+                # process pinning the file. This generator runs on the
+                # PlanPrefetcher's worker thread; the main thread releases
+                # the lock later, so the connection must be thread-free.
+                conn = sqlite3.connect(db, check_same_thread=False)
+                conn.execute("PRAGMA locking_mode=EXCLUSIVE")
+                conn.execute("BEGIN EXCLUSIVE")
+                lock_holder["conn"] = conn
+                print(f"  [inject] exclusive lock taken after batch {day}")
+
+    mesh = make_mesh() if args.mesh else None
+    stats: list = []
+    settled = 0
+    start = time.perf_counter()
+    failure = None
+    try:
+        for _result in settle_stream(
+            store, batches(), steps=args.steps, now=21_500.0, db_path=db,
+            checkpoint_every=args.checkpoint_every, columnar=True,
+            stats=stats, mesh=mesh,
+        ):
+            settled += 1
+            print(f"  batch {settled - 1} settled "
+                  f"({len(store):,} store rows)")
+    except Exception as exc:  # the injected failure
+        failure = exc
+    elapsed = time.perf_counter() - start
+
+    assert failure is not None, "injected lock never failed a checkpoint"
+    assert "locked" in str(failure), failure
+    print(f"failure surfaced after {settled} settled batches in "
+          f"{elapsed:.1f}s: {type(failure).__name__}: {failure}")
+
+    used = len(store)
+    dirty = int(store._dirty[:used].sum())
+    assert dirty > 0, "rollback did not re-mark failed rows dirty"
+    print(f"rollback OK: {dirty:,} rows re-marked dirty of {used:,}")
+
+    lock_holder["conn"].rollback()
+    lock_holder["conn"].close()
+    store.sync()
+    t0 = time.perf_counter()
+    store.flush_to_sqlite(db)
+    print(f"retry flush re-covered in {time.perf_counter() - t0:.1f}s")
+
+    live = store.list_sources()
+    with sqlite3.connect(db) as conn:
+        rows = conn.execute(
+            "SELECT source_id, market_id, reliability, confidence"
+            " FROM sources ORDER BY source_id, market_id"
+        ).fetchall()
+    assert len(rows) == len(live), (len(rows), len(live))
+    for rec, row in zip(live, rows):
+        assert (rec.source_id, rec.market_id) == (row[0], row[1])
+        assert rec.reliability == row[2] and rec.confidence == row[3]
+    assert len(rows) >= 1_000_000, (
+        f"soak must cover ≥1M rows, got {len(rows):,} — raise --markets"
+    )
+    print(f"checkpoint complete: {len(rows):,} rows byte-equal to the "
+          f"store's live records; no settled batch lost")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
