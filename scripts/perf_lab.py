@@ -184,18 +184,14 @@ def cmd_scaling(args):
 
 
 def cmd_ab(args):
-    """XLA-fused cycle loop vs the hand-fused Pallas kernel at 1M x 16."""
-    xla = bench.bench_headline()
+    """XLA-fused cycle loop vs the hand-fused Pallas kernel at 1M x 16.
+
+    Delegates to the bench's adjudication leg (same-process interleaved
+    XLA/Pallas passes, autotuned tile, large-K attempt, verdict)."""
     try:
-        pallas = bench.bench_pallas()
+        return bench.bench_pallas_ab()
     except Exception as exc:  # noqa: BLE001 — Pallas needs the TPU backend
-        pallas = f"failed: {type(exc).__name__}: {exc}"
-    return {
-        "xla_loop_cycles_per_sec": round(xla, 1),
-        "pallas_cycles_per_sec": (
-            round(pallas, 1) if isinstance(pallas, float) else pallas
-        ),
-    }
+        return {"pallas_ab": f"failed: {type(exc).__name__}: {exc}"}
 
 
 def cmd_large_k(args):

@@ -98,7 +98,7 @@ def _full_results(compact=7200.0, f32=2200.0):
         "large_k": _ok({"flat_loop_cycles_per_sec": 233.0}),
         "e2e_pipeline": _ok({"cycles_per_sec_amortised": 0.4}),
         "tiebreak_10k_agents": _ok({"ring_markets_per_sec": 1142.0}),
-        "pallas_1m16": _ok(620.0),
+        "pallas_ab": _ok({"xla_cycles_per_sec": 887.0, "pallas_tile2048_cycles_per_sec": 620.0, "verdict": "xla_wins_1m16 (887.0 vs 620.0)"}),
     }
 
 
@@ -152,12 +152,12 @@ class TestCompose:
     def test_partial_failure_costs_only_that_leg(self):
         results = _full_results()
         results["large_k"] = _fail("timeout after 1200s (killed)")
-        del results["pallas_1m16"]
+        del results["pallas_ab"]
         payload, rc = bench.compose(results, [], {}, 1.0)
         assert rc == 0
         assert payload["value"] == 7200.0
         assert "timeout" in payload["extras"]["large_k"]
-        assert payload["extras"]["pallas_1m16_cycles_per_sec"] == (
+        assert payload["extras"]["pallas_ab"] == (
             "failed: not run"
         )
         json.dumps(payload)
@@ -355,7 +355,7 @@ class TestCircuitBreaker:
         assert rc == 0
         # dispatch_rtt succeeded between the two timeouts: breaker reset,
         # every device leg was attempted.
-        assert log.count("pallas_1m16") == 1
+        assert log.count("pallas_ab") == 1
         assert "degraded" not in payload["extras"]
 
     def test_fast_crash_mentioning_timeout_does_not_trip(self, monkeypatch):
@@ -381,7 +381,7 @@ class TestCircuitBreaker:
             run_leg=run_leg, sleeper=lambda s: None
         )
         assert rc == 0
-        assert log.count("pallas_1m16") == 1  # nothing was circuit-broken
+        assert log.count("pallas_ab") == 1  # nothing was circuit-broken
         assert "degraded" not in payload["extras"]
 
     def test_trailing_timeouts_do_not_claim_a_trip(self, monkeypatch):
@@ -392,7 +392,7 @@ class TestCircuitBreaker:
         canned = {"probe": _ok({"platform": "tpu"})}
         canned.update(_full_results())
         canned["tiebreak_10k_agents"] = _fail("timeout after 900s (killed)")
-        canned["pallas_1m16"] = _fail("timeout after 700s (killed)")
+        canned["pallas_ab"] = _fail("timeout after 1500s (killed)")
 
         def run_leg(name, timeout=None, fast=False, cpu=False):
             return canned.get(name, _fail("unexpected"))
