@@ -170,10 +170,55 @@ band_records = [
     for r in band_store.list_sources()
 ]
 
+# Streamed band-mode service across the cluster: each process streams
+# ONLY its own payload shard through settle_stream(mesh=, band=) with the
+# globally-agreed integer num_slots — the multi-host service shape
+# (prefetch + per-batch sharded sessions + deferred band gathers), three
+# batches of fresh markets.
+from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+rng3 = np.random.default_rng(SEED + 2)
+stream_full = []
+for b in range(3):
+    pays = []
+    for m in range(M):
+        n = int(rng3.integers(1, 4))
+        pays.append((
+            f"sm-b{{b}}-m{{m}}",
+            [
+                {{
+                    "sourceId": f"t{{int(rng3.integers(0, 6))}}",
+                    "probability": round(float(rng3.random()), 6),
+                }}
+                for _ in range(n)
+            ],
+        ))
+    outs = (rng3.random(M) < 0.5).tolist()
+    stream_full.append((pays, outs))
+
+stream_store = TensorReliabilityStore()
+stream_batches = [
+    (pays[blo:min(bhi, M)], outs[blo:min(bhi, M)])
+    for pays, outs in stream_full
+]
+stream_results = list(settle_stream(
+    stream_store, stream_batches, steps=2, now=20760.0,
+    mesh=mesh, band=(blo, M), num_slots=4,
+))
+stream_store.sync()
+
 band = {{
     "pid": pid,
     "lo": lo,
     "hi": hi,
+    "stream_market_keys": [r.market_keys for r in stream_results],
+    "stream_consensus": [
+        np.asarray(r.consensus).tolist() for r in stream_results
+    ],
+    "stream_records": [
+        [r.source_id, r.market_id, r.reliability, r.confidence, r.updated_at]
+        for r in stream_store.list_sources()
+    ],
     "consensus": np.asarray(local_view(result.consensus)).tolist(),
     "reliability": np.asarray(local_view(result.state.reliability)).tolist(),
     "loop_consensus": np.asarray(local_view(loop_consensus)).tolist(),
@@ -540,6 +585,73 @@ class TestTwoProcessCluster:
             reference = ref_records[key]
             assert abs(rel - reference.reliability) < 2e-6, key
             assert conf == reference.confidence, key
+            assert iso == reference.updated_at, key
+
+    def test_streamed_band_service_matches_flat_stream(self, worker_bands):
+        """settle_stream(mesh=, band=) across the REAL 2-process cluster:
+        each process streamed only its payload shard; the union of the two
+        stream stores must equal a flat single-process settle_stream over
+        the full batches (records: conf/timestamps exact, rel to psum
+        tolerance; per-batch consensus bands match)."""
+        import math
+
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        rng3 = np.random.default_rng(SEED + 2)
+        stream_full = []
+        for b in range(3):
+            pays = []
+            for m in range(M):
+                n = int(rng3.integers(1, 4))
+                pays.append((
+                    f"sm-b{b}-m{m}",
+                    [
+                        {
+                            "sourceId": f"t{int(rng3.integers(0, 6))}",
+                            "probability": round(float(rng3.random()), 6),
+                        }
+                        for _ in range(n)
+                    ],
+                ))
+            outs = (rng3.random(M) < 0.5).tolist()
+            stream_full.append((pays, outs))
+
+        flat_store = TensorReliabilityStore()
+        flat_results = list(settle_stream(
+            flat_store, stream_full, steps=2, now=20760.0, num_slots=4
+        ))
+        flat_store.sync()
+        ref_records = {
+            (r.source_id, r.market_id): r for r in flat_store.list_sources()
+        }
+        expected = [
+            dict(zip(r.market_keys, np.asarray(r.consensus)))
+            for r in flat_results
+        ]
+
+        union = {}
+        for band in worker_bands:
+            for sid, mid, rel, conf, iso in band["stream_records"]:
+                assert (sid, mid) not in union, "band stream stores overlap"
+                union[(sid, mid)] = (rel, conf, iso)
+            assert len(band["stream_market_keys"]) == 3  # one per batch
+            for b, (keys, values) in enumerate(zip(
+                band["stream_market_keys"], band["stream_consensus"]
+            )):
+                for key, value in zip(keys, values):
+                    want = expected[b][key]
+                    if math.isnan(want):
+                        assert value is None or math.isnan(value)
+                    else:
+                        assert abs(value - want) < 2e-6, (b, key)
+        assert set(union) == set(ref_records)
+        for key, (rel, conf, iso) in union.items():
+            reference = ref_records[key]
+            assert abs(rel - reference.reliability) < 2e-6, key
+            assert conf == reference.confidence, key  # host-replayed exactly
             assert iso == reference.updated_at, key
 
     def test_production_loop_matches_single_process(self, worker_bands):
