@@ -867,7 +867,12 @@ class ShardedSettlementSession:
         store, mesh, cdtype = self._store, self._mesh, self._cdtype
         band_mask = self._band_mask
         safe = np.where(self._band_rows >= 0, self._band_rows, 0)
-        host_rel, host_conf, host_days, host_exists = store.host_rows(safe)
+        # sync=False: settle() already resolved any deferral that touches
+        # this session's rows; a standing one is disjoint (stale only at
+        # rows band_mask never selects — padding reads row 0 but is masked).
+        host_rel, host_conf, host_days, host_exists = store.host_rows(
+            safe, sync=False
+        )
         self._state = MarketBlockState(
             reliability=global_slot_block(
                 np.where(band_mask, host_rel, _REL0).astype(cdtype),
@@ -916,8 +921,16 @@ class ShardedSettlementSession:
             # would go non-positive): (re)build from host at an epoch below
             # now. The rebuild path keeps the rare backdated case bit-equal
             # to the one-shot settle_sharded (no stamp re-expression drift).
-            store.sync()
-            self._build_state(min(store.epoch_origin(), now_abs - 1.0))
+            # Deferred settlements merge ONLY if one touches this plan's
+            # rows: a streamed service whose batches bring fresh markets
+            # (disjoint rows) keeps its predecessors' band gathers deferred
+            # — the device→host fetch overlaps later work instead of
+            # stalling this build (chain bounded at 8 by the store).
+            if store.pending_overlaps(self._touched):
+                store.sync()
+            self._build_state(
+                min(store.epoch_origin(sync=False), now_abs - 1.0)
+            )
 
         conf_exact = store.host_confidences(self._touched)
         # Band-local outcome columns, padded to the band width (band mode:
@@ -1236,10 +1249,11 @@ def settle_stream(
     each batch settles through a :class:`ShardedSettlementSession`
     (markets on the lane axis, source slots optionally split with a
     ``psum`` reduction), abandoned without an eager close — the
-    session's host-merge recipe is registered at settle, and the NEXT
-    batch's state build (or the next checkpoint) resolves it, so the
-    device→host gather of batch N overlaps nothing worse than batch
-    N+1's plan prefetch. Results, store state, and checkpoint files are
+    session's host-merge recipe is registered at settle and resolves at
+    the next checkpoint or the first later batch that OVERLAPS its rows
+    (batches of fresh markets never stall on their predecessors'
+    device→host gathers; the deferral chain is bounded at 8, older links
+    applying early). Results, store state, and checkpoint files are
     bit-identical to the flat stream on a markets-only mesh (a 2-D mesh
     re-associates each market's slot sum into psum partials: ≤1 ulp on
     consensus, state updates quantised identically — see

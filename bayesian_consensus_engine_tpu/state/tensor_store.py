@@ -649,14 +649,18 @@ class TensorReliabilityStore:
 
     @_locked
     def host_rows(
-        self, rows: np.ndarray
+        self, rows: np.ndarray, sync: bool = True
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Raw exact host state for flat *rows*: (rel, conf, days, exists).
 
         Fancy-indexed copies, no cold-start defaulting — the sharded settle
         path's gather (it applies its own masking/defaults per slot).
+        ``sync=False`` skips resolving deferred settlements; only valid
+        after ``pending_overlaps(rows)`` returned False (the host values
+        for *rows* are then exact with the deferral left standing).
         """
-        self._sync_pending()
+        if sync:
+            self._sync_pending()
         return (
             self._rel[rows],
             self._conf[rows],
@@ -766,9 +770,19 @@ class TensorReliabilityStore:
         return state, epoch0
 
     @_locked
-    def epoch_origin(self) -> float:
-        """The epoch-days origin for relative device stamps (min live −1)."""
-        self._sync_pending()
+    def epoch_origin(self, sync: bool = True) -> float:
+        """The epoch-days origin for relative device stamps (min live −1).
+
+        ``sync=False`` computes it from the host arrays as they stand.
+        Safe for a caller building state over rows no pending recipe
+        touches: those rows' host stamps are exact and participate in the
+        min, so the unsynced origin is ≤ every stamp the caller will
+        re-express — positivity of its relative stamps holds. (Pending
+        recipes stay self-consistent either way: each merges against its
+        own recorded epoch.)
+        """
+        if sync:
+            self._sync_pending()
         used = len(self._pairs)
         stamps = self._days[:used]
         live = stamps[stamps > NEVER]
@@ -937,6 +951,30 @@ class TensorReliabilityStore:
         timing boundaries and session teardown.
         """
         self._sync_pending()
+
+    @_locked
+    def pending_overlaps(self, rows) -> bool:
+        """Must deferred state merge before *rows* can be read raw?
+
+        True with a flat pending device state (it covers every row) or any
+        pending settle recipe touching one of *rows*. False means the host
+        arrays are exact for *rows* AS THEY ARE — the streamed sharded
+        service's fast path: consecutive batches of fresh markets touch
+        disjoint row sets, so batch N's device→host band gather can stay
+        deferred (resolving at the next checkpoint or overlap) instead of
+        stalling batch N+1's state build. Callers that skip the sync must
+        read via ``host_rows(..., sync=False)`` /
+        ``epoch_origin(sync=False)`` and touch only *rows*.
+        """
+        if self._pending is not None:
+            return True
+        if not self._pending_sync:
+            return False
+        rows = np.asarray(rows)
+        return any(
+            len(touched) and np.isin(rows, touched).any()
+            for touched, _rel, _epoch0, _stamp in self._pending_sync
+        )
 
     @_locked
     def absorb(self, state: DeviceReliabilityState, epoch0: float) -> None:

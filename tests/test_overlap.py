@@ -761,6 +761,75 @@ class TestSettleStreamSharded:
             assert a.confidence == b.confidence  # host-replayed, both paths
             assert a.updated_at == b.updated_at
 
+    def test_disjoint_batches_never_sync_mid_stream(self):
+        """Fresh-market batches touch disjoint rows, so NO per-batch sync
+        may happen: every batch's band gather stays deferred (chain
+        bounded at 8 — older links apply early), and the store still
+        equals the flat stream bit-for-bit after the final sync."""
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        batches = self._batches(num_batches=10)
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store, batches, steps=1, now=21_200.0, mesh=make_mesh(),
+            )
+        )
+        assert len(results) == 10
+        # All ten stayed deferred up to the bound; none was resolved by a
+        # mid-stream sync (a per-batch sync would leave exactly one).
+        assert len(store._pending_sync) == 8
+        store.sync()
+        assert not store._pending_sync
+
+        flat_store = TensorReliabilityStore()
+        flat_results = list(
+            settle_stream(flat_store, batches, steps=1, now=21_200.0)
+        )
+        for mine, ref in zip(results, flat_results):
+            np.testing.assert_array_equal(
+                np.asarray(mine.consensus), np.asarray(ref.consensus)
+            )
+        flat_store.sync()
+        assert store.list_sources() == flat_store.list_sources()
+
+    def test_overlapping_batches_sync_and_stay_exact(self):
+        """Re-settling the SAME markets every batch (the daily
+        re-settlement shape) overlaps rows, so each batch must resolve
+        its predecessor's gather before building — and results must stay
+        bit-identical to the flat stream."""
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        rng = random.Random(61)
+        payloads = random_payloads(rng, 9, universe=15, tag="-ov")
+        batches = [
+            (payloads, [rng.random() < 0.5 for _ in range(9)])
+            for _ in range(3)
+        ]
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store, batches, steps=1, now=21_210.0, mesh=make_mesh(),
+            )
+        )
+        # Overlap forced the per-batch sync: at most the LAST batch's
+        # recipe is still pending.
+        assert len(store._pending_sync or []) <= 1
+        store.sync()
+
+        flat_store = TensorReliabilityStore()
+        flat_results = list(
+            settle_stream(flat_store, batches, steps=1, now=21_210.0)
+        )
+        for mine, ref in zip(results, flat_results):
+            np.testing.assert_array_equal(
+                np.asarray(mine.consensus), np.asarray(ref.consensus)
+            )
+        flat_store.sync()
+        assert store.list_sources() == flat_store.list_sources()
+
     def test_band_gather_stays_deferred_between_batches(self):
         """The mesh path must NOT sync eagerly after each settle: the last
         batch's merge recipe stays pending until a host read resolves it
