@@ -1151,6 +1151,13 @@ LEGS = {
         bench_compact, dict(timed_steps=CPU_FALLBACK_STEPS),
         dict(**_FAST_SHAPE, timed_steps=16), 1200,
     ),
+    # The streamed-service rate is mostly host work (ingest, checkpoint,
+    # orchestration), so even the CPU fallback's number is informative —
+    # a degraded round still records the amortised service rate.
+    "e2e_stream_cpu": (
+        bench_e2e_stream, {},
+        dict(markets=6000, batches=3, steps=3), 1500,
+    ),
     # Harness self-test hooks (tests/test_bench_harness.py); never scheduled.
     "selftest": (lambda: {"hello": 1}, {}, {}, 60),
     "selftest_hang": (lambda: time.sleep(3600), {}, {}, 60),
@@ -1173,7 +1180,7 @@ DEVICE_LEG_ORDER = [
     "tiebreak_10k_agents",
     "pallas_ab",
 ]
-CPU_FALLBACK_ORDER = ["headline_f32_cpu", "compact_cpu"]
+CPU_FALLBACK_ORDER = ["headline_f32_cpu", "compact_cpu", "e2e_stream_cpu"]
 
 _SELF = os.path.abspath(__file__)
 
@@ -1445,6 +1452,12 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         "e2e_pipeline": _show(results, "e2e_pipeline"),
         "e2e_overlap": _show(results, "e2e_overlap"),
         "e2e_stream": _show(results, "e2e_stream"),
+        # Fallback-only leg: absent (not "failed") on healthy runs.
+        **(
+            {"e2e_stream_cpu": _show(results, "e2e_stream_cpu")}
+            if "e2e_stream_cpu" in results
+            else {}
+        ),
         "tiebreak_10k_agents": _show(results, "tiebreak_10k_agents"),
         "per_slot_throughput": slot_updates,
         "harness": harness,

@@ -326,7 +326,8 @@ class TestCircuitBreaker:
         # circuit-broken without burning their timeouts, and the CPU
         # fallback still secured the headline.
         assert log == ["probe", "headline_f32", "compact",
-                       "headline_f32_cpu", "compact_cpu"]
+                       "headline_f32_cpu", "compact_cpu",
+                       "e2e_stream_cpu"]
         assert rc == 0
         assert payload["value"] == 5.0
         legs = payload["extras"]["harness"]["legs"]
