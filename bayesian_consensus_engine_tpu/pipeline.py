@@ -1200,6 +1200,7 @@ def settle_stream(
     mesh=None,
     band=None,
     dtype=None,
+    lazy_checkpoints: bool = False,
 ):
     """The streamed settle-and-checkpoint service loop, fully overlapped.
 
@@ -1237,7 +1238,9 @@ def settle_stream(
     runs asynchronously (fencing here would serialise away the overlap),
     so device time is NOT in it; device backpressure surfaces instead in
     ``checkpoint_s`` (the flush call drains the pending device results
-    before snapshotting) — ``None`` on batches that didn't checkpoint.
+    before snapshotting — unless *lazy_checkpoints*, whose flushes never
+    drain, leaving backpressure invisible until the tail flush) —
+    ``None`` on batches that didn't checkpoint.
     Raw floats, un-rounded. The dict for a batch is appended BEFORE its
     result is yielded. Under ``mesh=`` the dispatch-only reading of
     ``settle_dispatch_s`` does NOT hold: each batch's session build first
@@ -1269,6 +1272,22 @@ def settle_stream(
     integer *num_slots* (``"bucket"`` pads per-process maxima, which
     processes disagree on). *dtype* overrides the mesh path's compute
     dtype (:func:`~.utils.dtypes.default_float_dtype` otherwise).
+
+    *lazy_checkpoints* takes the checkpoint drain off the critical path:
+    periodic flushes snapshot the APPLIED host truth without resolving
+    deferred device results (``resolve_pending=False``), so they never
+    block on the device — mid-stream files then lag by the deferred
+    chain (bounded at 8 settles) instead of being current through the
+    yielding batch. The tail flush on exit always resolves, so the final
+    file is identical to the eager mode's. Trade-off, measured
+    (bench.py ``e2e_stream`` A/B, CPU 2026-07-31): what the lag defers
+    it also UN-OVERLAPS — periodic lazy flushes write almost nothing, so
+    the tail flush pays one large serial write where eager mode streamed
+    those rows on the background thread between batches. On this host's
+    CPU backend lazy therefore LOSES (~0.75 vs ~0.50 amortised
+    1M-cycles/sec); it can only pay where the device drain (not the
+    SQLite write) dominates the flush — the remote-tunnel/TPU
+    hypothesis the bench leg exists to adjudicate. Default off.
     """
     import time as _time
 
@@ -1337,9 +1356,12 @@ def settle_stream(
                     # Joins any in-flight write first (flushes serialise), so
                     # a prior background failure surfaces here, not silently.
                     checkpoint_start = _time.perf_counter()
-                    handle = store.flush_to_sqlite_async(db_path)
+                    handle = store.flush_to_sqlite_async(
+                        db_path, resolve_pending=not lazy_checkpoints
+                    )
                     checkpoint_s = _time.perf_counter() - checkpoint_start
-                    flushed_through = index
+                    if not lazy_checkpoints:
+                        flushed_through = index
                 if stats is not None:
                     stats.append(
                         {

@@ -1158,11 +1158,23 @@ class TensorReliabilityStore:
             self._last_flush_path = target
         return written
 
-    def _plan_flush(self, db_path, incremental: Optional[bool]):
+    def _plan_flush(self, db_path, incremental: Optional[bool],
+                    resolve_pending: bool = True):
         """Shared flush-entry bookkeeping: join any in-flight background
         flush, sync pending device state, resolve the incremental mode,
         and select the rows to write / delete. Returns
-        ``(target, incremental, selected, dead, used)``."""
+        ``(target, incremental, selected, dead, used)``.
+
+        ``resolve_pending=False`` checkpoints the host truth AS APPLIED —
+        deferred settle results (device-resident chains, band gathers)
+        are left deferred instead of drained, so the flush never blocks
+        on the device. The file then lags by the deferred chain (bounded
+        at 8 links; rows a recipe will touch are simply absent-or-stale
+        until a later resolving flush covers them) — a complete, valid
+        snapshot of every APPLIED settlement, which is the rolling-
+        checkpoint semantic a streamed service wants mid-stream. A final
+        resolving flush (the default) makes the file current.
+        """
         if self._flush_inflight is not None:
             # Serialise checkpoints: a second flush may not interleave with
             # (or outrun) an in-flight one; a prior failure surfaces here.
@@ -1170,7 +1182,8 @@ class TensorReliabilityStore:
         # ":memory:" is a fresh empty DB on every open — never a valid
         # incremental target.
         in_memory = str(db_path) == ":memory:"
-        self._sync_pending()
+        if resolve_pending:
+            self._sync_pending()
         target = None if in_memory else str(Path(db_path).resolve())
         # Path identity alone is not enough: a deleted/rotated target would
         # make an incremental write silently truncate the checkpoint to the
@@ -1207,7 +1220,10 @@ class TensorReliabilityStore:
 
     @_locked
     def flush_to_sqlite_async(
-        self, db_path: Union[str, Path], incremental: Optional[bool] = None
+        self,
+        db_path: Union[str, Path],
+        incremental: Optional[bool] = None,
+        resolve_pending: bool = True,
     ) -> FlushHandle:
         """Checkpoint like :meth:`flush_to_sqlite`, writing on a background
         thread so the caller overlaps the SQLite transaction with further
@@ -1229,9 +1245,14 @@ class TensorReliabilityStore:
         runs on the thread — harmless (each connection opens a fresh
         transient DB, exactly like the synchronous path) — so always join
         via ``result()``, never assume completion.
+
+        ``resolve_pending=False`` snapshots the APPLIED host truth without
+        draining deferred device results (see ``_plan_flush``): the call
+        never blocks on the device, at the cost of the file lagging by
+        the deferred chain until a later resolving flush.
         """
         target, incremental, selected, dead, used = self._plan_flush(
-            db_path, incremental
+            db_path, incremental, resolve_pending
         )
         dead_ids = [self._pairs.id_of(r) for r in dead]
         writer = self._build_snapshot_writer(db_path, selected, incremental,

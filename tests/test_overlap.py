@@ -830,6 +830,46 @@ class TestSettleStreamSharded:
         flat_store.sync()
         assert store.list_sources() == flat_store.list_sources()
 
+    @pytest.mark.parametrize("use_mesh", [False, True],
+                             ids=["flat", "sharded"])
+    def test_lazy_checkpoints_lag_then_tail_flush_catches_up(self, tmp_path,
+                                                            use_mesh):
+        """lazy_checkpoints=True: mid-stream files snapshot only APPLIED
+        settlements (no device drain — they lag the yielded batches), and
+        the tail flush makes the final file identical to eager mode's."""
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        mesh = make_mesh() if use_mesh else None
+        batches = self._batches(num_batches=3)
+        db = tmp_path / "lazy.db"
+        store = TensorReliabilityStore()
+        lagged = None
+        for i, _result in enumerate(settle_stream(
+            store, batches, steps=1, now=21_220.0, db_path=db,
+            mesh=mesh, lazy_checkpoints=True,
+        )):
+            if i == 1:
+                # Batch 1's lazy flush: batch 1's settle is still deferred,
+                # so its rows must NOT be in the file yet.
+                store._flush_inflight.result()
+                lagged = len(db_records(db))
+        self._flat(batches[:2], tmp_path / "prefix.db",
+                   steps=1, now=21_220.0)
+        assert lagged < len(db_records(tmp_path / "prefix.db")), (
+            "lazy checkpoint drained the newest deferred settle"
+        )
+        if use_mesh:
+            # Session recipes survive capacity growth, so NOTHING applies
+            # mid-stream; the flat chain may legitimately apply older
+            # batches when interning outgrows the pending state's capacity.
+            assert lagged == 0
+        eager_store, _ = self._flat(batches, tmp_path / "eager.db",
+                                    steps=1, now=21_220.0)
+        assert db_records(db) == db_records(tmp_path / "eager.db")
+        store.sync()
+        assert store.list_sources() == eager_store.list_sources()
+
     def test_band_gather_stays_deferred_between_batches(self):
         """The mesh path must NOT sync eagerly after each settle: the last
         batch's merge recipe stays pending until a host read resolves it
