@@ -207,6 +207,57 @@ class TestCompose:
         assert payload["extras"]["per_slot_throughput"] == {}
 
 
+class TestPallasAdjudication:
+    """bench_pallas_ab's decision logic, with the measurement functions
+    stubbed (the real kernels need the TPU backend)."""
+
+    def _run(self, monkeypatch, xla=(887.0, 900.0), pallas2048=620.0,
+             auto_tile=1024, pallas_auto=700.0, large_k_error=None):
+        xla_values = iter(xla)
+        monkeypatch.setattr(
+            bench, "bench_headline", lambda *a, **k: next(xla_values)
+        )
+
+        def fake_rate(markets, slots, steps, tile):
+            if slots == bench.LARGE_K_SLOTS:
+                if large_k_error is not None:
+                    raise large_k_error
+                return 50.0
+            return pallas2048 if tile == 2048 else pallas_auto
+
+        monkeypatch.setattr(bench, "_pallas_rate", fake_rate)
+        monkeypatch.setattr(
+            "bayesian_consensus_engine_tpu.ops.pallas_cycle._tuned_tile",
+            lambda m, k: auto_tile,
+        )
+        return bench.bench_pallas_ab(num_markets=4096, slots=8,
+                                     timed_steps=200)
+
+    def test_xla_win_verdict(self, monkeypatch):
+        out = self._run(monkeypatch)
+        assert out["verdict"].startswith("xla_wins_1m16 (900.0 vs 700.0")
+        assert out["autotuned_tile"] == 1024
+        assert out["pallas_16k10k_cycles_per_sec"] == 50.0
+
+    def test_pallas_win_verdict_uses_best_of_both(self, monkeypatch):
+        out = self._run(monkeypatch, xla=(500.0, 480.0), pallas_auto=650.0)
+        assert out["verdict"].startswith("pallas_wins_1m16 (650.0 vs 500.0")
+
+    def test_auto_tile_2048_reuses_the_fixed_measurement(self, monkeypatch):
+        out = self._run(monkeypatch, auto_tile=2048, pallas_auto=999.0)
+        # Same tile: the auto number must BE the fixed-tile number, not a
+        # separate (drift-prone) re-measurement.
+        assert out["pallas_auto_cycles_per_sec"] == 620.0
+
+    def test_large_k_infeasibility_is_data_not_a_crash(self, monkeypatch):
+        out = self._run(
+            monkeypatch, large_k_error=RuntimeError("VMEM OOM: 51MB > 16MB")
+        )
+        assert "pallas_16k10k_cycles_per_sec" not in out
+        assert out["pallas_16k10k"].startswith("infeasible: RuntimeError")
+        assert out["verdict"]  # the 1M×16 verdict still renders
+
+
 class TestOrchestrate:
     def _runner(self, canned, log):
         def run_leg(name, timeout=None, fast=False, cpu=False):
