@@ -1242,7 +1242,11 @@ def settle_stream(
     drain, leaving backpressure invisible until the tail flush) —
     ``None`` on batches that didn't checkpoint.
     Raw floats, un-rounded. The dict for a batch is appended BEFORE its
-    result is yielded. Under ``mesh=`` the dispatch-only reading of
+    result is yielded — and before its checkpoint, so ``len(stats)`` is
+    the SETTLED batch count even when a checkpoint failure aborts the
+    stream: the failing batch has settled without yielding, and a
+    restart must resume from ``batches[len(stats):]`` (re-settling it
+    would double its updates — see examples/fault_tolerant_service.py). Under ``mesh=`` the dispatch-only reading of
     ``settle_dispatch_s`` does NOT hold: each batch's session build first
     drains the PREVIOUS batch's device→host band gather and re-uploads
     host state, so device backpressure surfaces here (not in
@@ -1351,17 +1355,11 @@ def settle_stream(
                         outcomes, steps=steps, now=batch_now
                     )
                 settle_dispatch_s = _time.perf_counter() - settle_start
-                checkpoint_s = None
-                if db_path is not None and (index + 1) % checkpoint_every == 0:
-                    # Joins any in-flight write first (flushes serialise), so
-                    # a prior background failure surfaces here, not silently.
-                    checkpoint_start = _time.perf_counter()
-                    handle = store.flush_to_sqlite_async(
-                        db_path, resolve_pending=not lazy_checkpoints
-                    )
-                    checkpoint_s = _time.perf_counter() - checkpoint_start
-                    if not lazy_checkpoints:
-                        flushed_through = index
+                # Appended BEFORE the checkpoint so ``len(stats)`` is the
+                # SETTLED count even when the checkpoint raises: a failing
+                # batch has settled but never yields, and a consumer that
+                # restarted from its yielded count would re-settle it
+                # (doubling its updates). Resume with batches[len(stats):].
                 if stats is not None:
                     stats.append(
                         {
@@ -1369,9 +1367,22 @@ def settle_stream(
                             "markets": plan.num_markets,
                             "plan_wait_s": plan_wait_s,
                             "settle_dispatch_s": settle_dispatch_s,
-                            "checkpoint_s": checkpoint_s,
+                            "checkpoint_s": None,
                         }
                     )
+                if db_path is not None and (index + 1) % checkpoint_every == 0:
+                    # Joins any in-flight write first (flushes serialise), so
+                    # a prior background failure surfaces here, not silently.
+                    checkpoint_start = _time.perf_counter()
+                    handle = store.flush_to_sqlite_async(
+                        db_path, resolve_pending=not lazy_checkpoints
+                    )
+                    if stats is not None:
+                        stats[-1]["checkpoint_s"] = (
+                            _time.perf_counter() - checkpoint_start
+                        )
+                    if not lazy_checkpoints:
+                        flushed_through = index
                 yield result
     finally:
         # Runs on EVERY exit — exhaustion, a consumer break/close

@@ -1,0 +1,155 @@
+"""A settlement service that survives checkpoint failures mid-stream.
+
+The service contract (pinned by tests/test_overlap.py and the 1.3M-row
+soak in scripts/stream_failure_soak.py): when a background checkpoint
+dies — disk full, volume detached, another process holding the SQLite
+file — ``settle_stream`` surfaces the failure at the next flush join,
+the store rolls the flush bookkeeping back (failed rows re-dirtied), and
+NO settled batch is lost. This example shows the user-side half of that
+contract: the restart recipe.
+
+    completed = 0
+    while completed < len(batches):
+        stats = []
+        try:
+            for result in settle_stream(store, batches[completed:],
+                                        stats=stats, ...):
+                ...
+        except OSError/RuntimeError:
+            <fix the world>          # free disk, release the lock, ...
+            store.flush_to_sqlite(db)  # re-covers everything settled
+        completed += len(stats)      # SETTLED count, not yielded count
+
+The resume point is ``len(stats)``, NOT the number of results consumed:
+a checkpoint failure aborts the stream AFTER the current batch settled
+but BEFORE it yielded, and re-settling that batch would double its
+updates. The same ``store`` carries across restarts — interning,
+capacity, and deferred state all survive — so the retried stream
+continues exactly where the failed one stopped. The failure here is
+real: a second SQLite connection takes an exclusive lock on the
+checkpoint file mid-stream (the native writer fails with "database is
+locked" after its busy timeout), then the service releases it and
+resumes.
+
+Run from the repo root:  python examples/fault_tolerant_service.py
+"""
+
+import os
+import pathlib
+import sqlite3
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from bayesian_consensus_engine_tpu.pipeline import settle_stream  # noqa: E402
+from bayesian_consensus_engine_tpu.state.tensor_store import (  # noqa: E402
+    TensorReliabilityStore,
+)
+
+BATCHES = 5
+MARKETS_PER_BATCH = 1_500
+START_DAY = 20_820.0
+
+rng = np.random.default_rng(37)
+
+
+def day_batch(day: int):
+    counts = rng.poisson(2, MARKETS_PER_BATCH) + 1
+    payloads = []
+    for m, count in enumerate(counts):
+        signals = [
+            {
+                "sourceId": f"src-{rng.integers(0, 400)}",
+                "probability": round(float(rng.random()), 6),
+            }
+            for _ in range(count)
+        ]
+        payloads.append((f"day{day}-market-{m}", signals))
+    outcomes = (rng.random(MARKETS_PER_BATCH) < 0.5).tolist()
+    return payloads, outcomes
+
+
+def main() -> None:
+    batches = [day_batch(day) for day in range(BATCHES)]
+    store = TensorReliabilityStore()
+    lock: dict = {}
+
+    def sabotage_after(index):
+        """Simulate an external process pinning the checkpoint file."""
+        conn = sqlite3.connect(db, check_same_thread=False)
+        conn.execute("PRAGMA locking_mode=EXCLUSIVE")
+        conn.execute("BEGIN EXCLUSIVE")
+        lock["conn"] = conn
+        print(f"  [outage] checkpoint file locked after batch {index}")
+
+    def repair():
+        conn = lock.pop("conn")
+        conn.rollback()
+        conn.close()  # EXCLUSIVE locking-mode holds the lock until close
+        print("  [repair] lock released; retrying the checkpoint")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        global db
+        db = os.path.join(tmp, "service.db")
+
+        completed = 0
+        restarts = 0
+        while completed < len(batches):
+            stats: list = []
+            try:
+                for i, result in enumerate(settle_stream(
+                    store,
+                    batches[completed:],
+                    steps=1,
+                    now=START_DAY + completed,
+                    db_path=db,
+                    stats=stats,
+                )):
+                    print(
+                        f"  batch {completed + i} settled "
+                        f"({len(result.market_keys)} markets)"
+                    )
+                    if completed + i == 1 and not restarts:
+                        sabotage_after(completed + i)
+            except Exception as exc:
+                restarts += 1
+                print(f"  [failure] {type(exc).__name__}: {exc}")
+                repair()
+                # Rollback re-dirtied the failed rows: one retry flush
+                # re-covers every batch settled so far.
+                store.flush_to_sqlite(db)
+            # The settled count — NOT the yielded count: the batch whose
+            # checkpoint failed settled without yielding.
+            completed += len(stats)
+
+        store.sync()
+        rows = sqlite3.connect(db).execute(
+            "SELECT COUNT(*) FROM sources"
+        ).fetchone()[0]
+        live = len(store.list_sources())
+        print(
+            f"\n{completed} batches settled across {restarts + 1} stream "
+            f"runs ({restarts} failure restart); final checkpoint holds "
+            f"{rows} rows == store's {live} live records: {rows == live}"
+        )
+        assert completed == BATCHES and rows == live and restarts == 1
+
+        # The recovered run must equal a never-failed straight-through run
+        # record for record — the restart settled each batch exactly once.
+        straight = TensorReliabilityStore()
+        for _ in settle_stream(straight, batches, steps=1, now=START_DAY):
+            pass
+        straight.sync()
+        assert store.list_sources() == straight.list_sources()
+        print("recovered state == straight-through state, record for record")
+
+
+if __name__ == "__main__":
+    main()

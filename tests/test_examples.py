@@ -3,10 +3,13 @@
 Mirrors the reference's examples-as-documentation role (reference:
 examples/*.py); only the fast scalar examples run here — the device-loop
 examples (settlement_cycle, compact_settlement, distributed_settlement,
-settlement_service, streaming_settlement, batched_consensus) each pay
-tens of seconds of XLA compilation and are exercised through the library
-tests instead (streaming_settlement's path: tests/test_overlap.py::
-TestSettleStream and the driver dryrun's _dryrun_settle_stream leg).
+settlement_service, streaming_settlement, batched_consensus,
+fault_tolerant_service) each pay tens of seconds of XLA compilation and
+are exercised through the library tests instead (streaming_settlement's
+path: tests/test_overlap.py::TestSettleStream and the driver dryrun's
+_dryrun_settle_stream leg; fault_tolerant_service's restart recipe:
+TestSettleStreamSharded's failure cases pin the settled-count contract
+it relies on).
 """
 
 import pathlib

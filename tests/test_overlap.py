@@ -962,10 +962,11 @@ class TestSettleStreamSharded:
         monkeypatch.setattr(store, "_build_snapshot_writer",
                             broken_second_flush)
         settled = 0
+        stats: list = []
         with pytest.raises(RuntimeError, match="checkpoint disk gone"):
             for _result in settle_stream(
                 store, batches, steps=1, now=21_140.0, db_path=db,
-                mesh=mesh,
+                mesh=mesh, stats=stats,
             ):
                 settled += 1
         # Batch 2's flush was the broken one; batch 3 settled, then ITS
@@ -973,6 +974,7 @@ class TestSettleStreamSharded:
         # be lost: the rollback re-marked batch 2's rows dirty, so one
         # caller retry must produce the complete checkpoint.
         assert settled == 2  # batch 3's result never yielded (raise first)
+        assert len(stats) == 3  # ...but stats counts it: the resume point
         store.sync()
         store.flush_to_sqlite(db)
         serial_store, _ = self._serial_flat(
