@@ -739,6 +739,12 @@ class _BandGather:
     values, in ``band_rows[band_mask]`` order — only when the store's sync
     actually needs the bytes. Keeping the block on device until then is
     what makes chained sharded settles free of per-settle transfers.
+
+    ``held_nbytes`` is the FULL block the deferral pins in HBM (not the
+    touched subset): the store's recipe chain uses it to apply old links
+    early before deep disjoint-batch chains of big blocks exhaust device
+    memory (a north-star-scale band block is ~0.6 GB; eight would pin
+    ~5 GB of a 16 GB chip).
     """
 
     __slots__ = ("_block", "_mask")
@@ -749,6 +755,10 @@ class _BandGather:
 
     def __len__(self) -> int:
         return int(self._mask.sum())
+
+    @property
+    def held_nbytes(self) -> int:
+        return int(getattr(self._block, "nbytes", 0))
 
     def __array__(self, dtype=None, copy=None):
         from bayesian_consensus_engine_tpu.parallel.distributed import (

@@ -26,6 +26,7 @@ the SQLite file *is* the checkpoint format (SURVEY §5).
 
 from __future__ import annotations
 
+import os
 import threading
 from functools import wraps
 from typing import List, NamedTuple, Optional, Sequence, Union
@@ -58,6 +59,12 @@ from bayesian_consensus_engine_tpu.utils.timeconv import (
 
 _GROW = 2
 _MIN_CAPACITY = 64
+# Deferred settle recipes pin device memory (a sharded band gather holds
+# its full block); beyond this, the oldest links apply early — always
+# safe, they describe values that were final when gathered.
+_MAX_DEFERRED_BYTES = int(
+    os.environ.get("BCE_MAX_DEFERRED_BYTES", 2 * 1024**3)
+)
 
 
 def _locked(method):
@@ -323,7 +330,19 @@ class TensorReliabilityStore:
             )
         ]
         kept.append((touched_rows, rel_touched, epoch0, stamp_rel))
-        while len(kept) > 8:
+
+        def held_bytes():
+            # What the chain pins in HBM: a lazy band gather holds its
+            # FULL device block (held_nbytes); a flat settle's recipe
+            # holds only the touched vector (nbytes).
+            return sum(
+                getattr(r[1], "held_nbytes", getattr(r[1], "nbytes", 0))
+                for r in kept
+            )
+
+        while len(kept) > 8 or (
+            len(kept) > 1 and held_bytes() > _MAX_DEFERRED_BYTES
+        ):
             touched, rel_dev, r_epoch0, r_stamp = kept.pop(0)
             self._apply_settle_recipe(
                 touched, np.asarray(rel_dev), r_epoch0, r_stamp

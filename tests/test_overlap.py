@@ -794,6 +794,38 @@ class TestSettleStreamSharded:
         flat_store.sync()
         assert store.list_sources() == flat_store.list_sources()
 
+    def test_deferred_chain_bounded_by_held_device_bytes(self, monkeypatch):
+        """Big-block chains must apply old links before exhausting HBM:
+        with a tiny byte budget the chain stays at one link (older
+        gathers resolved early) and results still match the flat stream
+        bit-for-bit."""
+        import bayesian_consensus_engine_tpu.state.tensor_store as ts
+
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        monkeypatch.setattr(ts, "_MAX_DEFERRED_BYTES", 1)
+        batches = self._batches(num_batches=4)
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store, batches, steps=1, now=21_230.0, mesh=make_mesh(),
+            )
+        )
+        assert len(store._pending_sync) == 1  # early-applied down to one
+        store.sync()
+
+        flat_store = TensorReliabilityStore()
+        flat_results = list(
+            settle_stream(flat_store, batches, steps=1, now=21_230.0)
+        )
+        for mine, ref in zip(results, flat_results):
+            np.testing.assert_array_equal(
+                np.asarray(mine.consensus), np.asarray(ref.consensus)
+            )
+        flat_store.sync()
+        assert store.list_sources() == flat_store.list_sources()
+
     def test_overlapping_batches_sync_and_stay_exact(self):
         """Re-settling the SAME markets every batch (the daily
         re-settlement shape) overlaps rows, so each batch must resolve
