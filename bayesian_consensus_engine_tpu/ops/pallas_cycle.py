@@ -31,6 +31,17 @@ paths are bound by the chip's measured ~400 GB/s streaming bandwidth. The
 XLA path is therefore the production default; this kernel is kept as the
 measured Pallas reference point and as the scaffold for any future op that
 XLA fusion handles badly.
+
+Adjudication (round 5, 2026-07-31 — VERDICT r4 #6 "win or retire"):
+**retired to a bench-only artifact.** Every on-chip measurement has the
+kernel losing to the XLA loop at 1M×16 — r02: 620 vs 887 cycles/sec;
+r03: 1,173 vs 7,226 (1600-step amortised) — and the 16k×10k regime is
+VMEM-infeasible for this design (a (10k, 128) f32 block is 5.1 MB and
+the kernel holds ~10 such blocks against a 16 MB budget). No production
+path dispatches it; ``bench.py --leg pallas_ab`` remains the standing
+re-adjudication (same-process XLA/Pallas bracket with the autotuned
+tile) — a future hardware run where Pallas wins reopens the decision
+with data, not argument.
 """
 
 from __future__ import annotations
