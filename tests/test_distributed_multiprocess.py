@@ -361,11 +361,51 @@ with ShardedSettlementSession(
     band_result = session.settle(band_outcomes, steps=2, now=20760.0)
 assert len(band_result.market_keys) == live, (live, band_result.market_keys)
 
+# Streamed band-mode service over the uneven cluster: two batches of
+# fresh markets, each process streaming only its shard — including the
+# pure-padding process (live=0), which streams EMPTY batches.
+from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+rng3 = np.random.default_rng(SEED + 2)
+stream_full = []
+for b in range(2):
+    pays = []
+    for m in range(M):
+        n = int(rng3.integers(1, 4))
+        pays.append((
+            f"s4-b{{b}}-m{{m}}",
+            [
+                {{
+                    "sourceId": f"u{{int(rng3.integers(0, 6))}}",
+                    "probability": round(float(rng3.random()), 6),
+                }}
+                for _ in range(n)
+            ],
+        ))
+    outs = (rng3.random(M) < 0.5).tolist()
+    stream_full.append((pays, outs))
+
+stream_store = TensorReliabilityStore()
+stream_results = list(settle_stream(
+    stream_store,
+    [(p[lo:min(hi, M)], o[lo:min(hi, M)]) for p, o in stream_full],
+    steps=2, now=20765.0, mesh=mesh, band=(lo, M), num_slots=NUM_SLOTS,
+))
+stream_store.sync()
+
 band = {{
     "pid": pid,
     "lo": lo,
     "hi": hi,
     "live": live,
+    "stream_market_keys": [r.market_keys for r in stream_results],
+    "stream_consensus": [
+        np.asarray(r.consensus).tolist() for r in stream_results
+    ],
+    "stream_records": [
+        [r.source_id, r.market_id, r.reliability, r.confidence, r.updated_at]
+        for r in stream_store.list_sources()
+    ],
     "loop_consensus": np.asarray(local_view(loop_consensus)).tolist(),
     "loop_reliability": np.asarray(local_view(loop_state.reliability)).tolist(),
     "settle_market_keys": settle_result.market_keys,
@@ -849,3 +889,71 @@ class TestFourProcessUnevenCluster:
         assert len(empty) == 1
         assert empty[0]["bandplan_market_keys"] == []
         assert empty[0]["bandplan_records"] == []
+
+    def test_streamed_band_union_matches_flat_stream(self, worker_bands4):
+        """settle_stream(mesh=, band=) across the 4-process uneven
+        cluster — one process streaming EMPTY batches — must union to a
+        flat single-process stream over the full batches. The cluster's
+        markets axis is 1-wide on sources, so equality is EXACT."""
+        import math
+
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        rng3 = np.random.default_rng(SEED + 2)
+        stream_full = []
+        for b in range(2):
+            pays = []
+            for m in range(M4):
+                n = int(rng3.integers(1, 4))
+                pays.append((
+                    f"s4-b{b}-m{m}",
+                    [
+                        {
+                            "sourceId": f"u{int(rng3.integers(0, 6))}",
+                            "probability": round(float(rng3.random()), 6),
+                        }
+                        for _ in range(n)
+                    ],
+                ))
+            outs = (rng3.random(M4) < 0.5).tolist()
+            stream_full.append((pays, outs))
+
+        flat_store = TensorReliabilityStore()
+        flat_results = list(settle_stream(
+            flat_store, stream_full, steps=2, now=20765.0, num_slots=4
+        ))
+        flat_store.sync()
+        ref_records = {
+            (r.source_id, r.market_id): r for r in flat_store.list_sources()
+        }
+        expected = [
+            dict(zip(r.market_keys, np.asarray(r.consensus)))
+            for r in flat_results
+        ]
+
+        union = {}
+        for band in worker_bands4:
+            if band["live"] == 0:
+                assert band["stream_market_keys"] == [[], []]
+                assert band["stream_records"] == []
+            for sid, mid, rel, conf, iso in band["stream_records"]:
+                assert (sid, mid) not in union, "band stream stores overlap"
+                union[(sid, mid)] = (rel, conf, iso)
+            for b, (keys, values) in enumerate(zip(
+                band["stream_market_keys"], band["stream_consensus"]
+            )):
+                for key, value in zip(keys, values):
+                    want = expected[b][key]
+                    if math.isnan(want):
+                        assert value is None or math.isnan(value)
+                    else:
+                        assert value == want, (b, key)  # markets-only mesh
+        assert set(union) == set(ref_records)
+        for key, (rel, conf, iso) in union.items():
+            reference = ref_records[key]
+            assert rel == reference.reliability, key
+            assert conf == reference.confidence, key
+            assert iso == reference.updated_at, key
