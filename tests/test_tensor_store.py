@@ -409,3 +409,67 @@ class TestBatchFailureConsistency:
         assert record.updated_at != ""
         assert store.get_reliability("a", "m").reliability == record.reliability
         assert len(store.list_sources()) == 1
+
+
+class TestPendingOverlaps:
+    """The store-level contract behind the streamed service's
+    skip-the-sync fast path: ``pending_overlaps(rows)`` says whether
+    deferred settlements must merge before *rows* can be read raw, and
+    ``host_rows(..., sync=False)`` / ``epoch_origin(sync=False)`` read
+    without resolving them."""
+
+    def _store_with_recipe(self):
+        import jax.numpy as jnp
+
+        store = _populated()
+        touched = np.asarray([0, 2, 4], dtype=np.int64)
+        before = store._rel[touched].copy()
+        store.defer_settle_recipe(
+            touched,
+            jnp.asarray([0.9, 0.8, 0.7], dtype=jnp.float32),
+            store.epoch_origin(),
+            np.float32(5.0),
+        )
+        return store, touched, before
+
+    def test_no_deferral_means_no_overlap(self):
+        store = _populated()
+        assert not store.pending_overlaps(np.asarray([0, 1, 2]))
+
+    def test_recipe_rows_overlap_and_others_do_not(self):
+        store, touched, _ = self._store_with_recipe()
+        assert store.pending_overlaps(np.asarray([2]))
+        assert store.pending_overlaps(np.asarray([7, 4]))
+        assert not store.pending_overlaps(np.asarray([1, 3, 5]))
+        # Still deferred: the query itself must not resolve anything.
+        assert store._pending_sync
+
+    def test_flat_pending_state_always_overlaps(self):
+        store = _populated()
+        state, epoch0 = store.take_device_state(None)
+        store.defer_absorb(state, epoch0)
+        assert store.pending_overlaps(np.asarray([0]))
+
+    def test_unsynced_host_rows_exact_for_disjoint_stale_for_touched(self):
+        store, touched, before = self._store_with_recipe()
+        exact = store._rel[np.asarray([1, 3])].copy()
+        rel, _conf, _days, _exists = store.host_rows(
+            np.asarray([1, 3]), sync=False
+        )
+        np.testing.assert_array_equal(rel, exact)
+        assert store._pending_sync  # unresolved
+        # Touched rows read STALE without sync...
+        stale, *_ = store.host_rows(touched, sync=False)
+        np.testing.assert_array_equal(stale, before)
+        # ...and exact with the default (which resolves the recipe).
+        synced, *_ = store.host_rows(touched)
+        np.testing.assert_allclose(synced, [0.9, 0.8, 0.7], atol=1e-6)
+        assert not store._pending_sync
+
+    def test_unsynced_epoch_origin_lower_bounds_caller_rows(self):
+        store, touched, _ = self._store_with_recipe()
+        unsynced = store.epoch_origin(sync=False)
+        days = store._days[: len(store)]
+        live = days[days > 0]
+        assert unsynced <= live.min() - 1.0 + 1e-9
+        assert store._pending_sync  # still deferred
