@@ -1317,7 +1317,10 @@ def settle_stream(
         raise ValueError("checkpoint_every must be >= 1")
     if band is not None and mesh is None:
         raise ValueError("band= requires mesh=")
-    if band is not None and not isinstance(num_slots, int):
+    if band is not None and (
+        isinstance(num_slots, bool)
+        or not isinstance(num_slots, (int, np.integer))
+    ):
         raise ValueError(
             "band mode needs a globally-agreed integer num_slots; "
             f"{num_slots!r} derives K from per-process maxima, which "

@@ -1164,7 +1164,7 @@ class TensorReliabilityStore:
             SQLiteReliabilityStore,
         )
 
-        target, incremental, selected, dead, used = self._plan_flush(
+        target, incremental, selected, dead, used, _deferred = self._plan_flush(
             db_path, incremental
         )
         written = self._write_sqlite_rows(db_path, selected, incremental, used)
@@ -1201,8 +1201,26 @@ class TensorReliabilityStore:
         # ":memory:" is a fresh empty DB on every open — never a valid
         # incremental target.
         in_memory = str(db_path) == ":memory:"
+        deferred = np.empty(0, dtype=np.int64)
         if resolve_pending:
             self._sync_pending()
+        elif self._pending is not None and not self._pending_sync:
+            # A recipe-less flat pending state: its changed rows are
+            # unknowable, so a consistent partial snapshot is impossible —
+            # resolve rather than write torn records.
+            self._sync_pending()
+        elif self._pending_sync:
+            # Rows behind deferred recipes must be excluded ENTIRELY: the
+            # settle's eager confidence replay already updated (and
+            # dirtied) their host confidences, while reliability/stamp
+            # wait on the recipe — writing them now would pair new
+            # confidence with old reliability, a state that never
+            # existed. They stay dirty (caller bookkeeping) so the next
+            # resolving flush covers them whole.
+            deferred = np.unique(np.concatenate([
+                np.asarray(touched, dtype=np.int64)
+                for touched, _rel, _e, _s in self._pending_sync
+            ]))
         target = None if in_memory else str(Path(db_path).resolve())
         # Path identity alone is not enough: a deleted/rotated target would
         # make an incremental write silently truncate the checkpoint to the
@@ -1222,20 +1240,21 @@ class TensorReliabilityStore:
             )
 
         used = len(self._pairs)
-        select = self._exists[:used]
+        select = self._exists[:used].copy()
         if incremental:
-            select = select & self._dirty[:used]
+            select &= self._dirty[:used]
+        dead_mask = self._dirty[:used] & ~self._exists[:used]
+        deferred = deferred[deferred < used]
+        if deferred.size:
+            select[deferred] = False
+            dead_mask[deferred] = False
         # Rows whose exists flag flipped False since the last flush (only
         # reachable through absorb() of a mutated device state — no kernel
         # does it, but the API allows it) must be DELETED from the file, or
         # an incremental flush would strand the stale record forever.
-        dead = (
-            np.nonzero(self._dirty[:used] & ~self._exists[:used])[0].tolist()
-            if same_target
-            else []
-        )
+        dead = np.nonzero(dead_mask)[0].tolist() if same_target else []
         selected = np.nonzero(select)[0]
-        return target, incremental, selected, dead, used
+        return target, incremental, selected, dead, used, deferred
 
     @_locked
     def flush_to_sqlite_async(
@@ -1270,7 +1289,7 @@ class TensorReliabilityStore:
         never blocks on the device, at the cost of the file lagging by
         the deferred chain until a later resolving flush.
         """
-        target, incremental, selected, dead, used = self._plan_flush(
+        target, incremental, selected, dead, used, deferred = self._plan_flush(
             db_path, incremental, resolve_pending
         )
         dead_ids = [self._pairs.id_of(r) for r in dead]
@@ -1279,6 +1298,11 @@ class TensorReliabilityStore:
         prev_path = self._last_flush_path
         if target is not None:
             self._dirty[:used] = False
+            if deferred.size:
+                # Excluded-for-consistency rows (behind deferred recipes)
+                # were not written: keep them dirty so the next resolving
+                # flush covers them whole.
+                self._dirty[deferred] = True
             self._last_flush_path = target
             restore = (selected, dead, prev_path)
         else:

@@ -562,33 +562,49 @@ def bench_pallas_ab(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
     """
     from bayesian_consensus_engine_tpu.ops.pallas_cycle import _tuned_tile
 
+    prior_autotune = os.environ.get("BCE_AUTOTUNE")
     os.environ["BCE_AUTOTUNE"] = "1"
-    out = {}
-    out["xla_cycles_per_sec"] = bench_headline(num_markets, slots, timed_steps)
-    out["pallas_tile2048_cycles_per_sec"] = _pallas_rate(
-        num_markets, slots, timed_steps, 2048
-    )
-    padded = -(-num_markets // 2048) * 2048
-    auto_tile = _tuned_tile(padded, slots)
-    out["autotuned_tile"] = auto_tile
-    out["pallas_auto_cycles_per_sec"] = (
-        out["pallas_tile2048_cycles_per_sec"]
-        if auto_tile == 2048
-        else _pallas_rate(num_markets, slots, timed_steps, auto_tile)
-    )
-    out["xla_recheck_cycles_per_sec"] = bench_headline(
-        num_markets, slots, timed_steps
-    )
+    try:
+        out = {}
+        out["xla_cycles_per_sec"] = bench_headline(
+            num_markets, slots, timed_steps
+        )
+        out["pallas_tile2048_cycles_per_sec"] = _pallas_rate(
+            num_markets, slots, timed_steps, 2048
+        )
+        # The tuner is asked at the SAME 2048-padded M that _pallas_rate's
+        # "auto" branch uses, so the reported tile, the tuner's cache key,
+        # and the measured workload all agree (and match the tile-2048
+        # pass's M — apples to apples).
+        padded = -(-num_markets // 2048) * 2048
+        auto_tile = _tuned_tile(padded, slots)
+        out["autotuned_tile"] = auto_tile
+        out["pallas_auto_cycles_per_sec"] = (
+            out["pallas_tile2048_cycles_per_sec"]
+            if auto_tile == 2048
+            else _pallas_rate(num_markets, slots, timed_steps, "auto")
+        )
+        out["xla_recheck_cycles_per_sec"] = bench_headline(
+            num_markets, slots, timed_steps
+        )
 
-    if large_k_attempt:
-        try:
-            out["pallas_16k10k_cycles_per_sec"] = _pallas_rate(
-                LARGE_K_MARKETS, LARGE_K_SLOTS, max(2, timed_steps // 100), 128
-            )
-        except Exception as exc:  # VMEM overflow is the expected datum
-            out["pallas_16k10k"] = (
-                f"infeasible: {type(exc).__name__}: {str(exc)[:200]}"
-            )
+        if large_k_attempt:
+            try:
+                out["pallas_16k10k_cycles_per_sec"] = _pallas_rate(
+                    LARGE_K_MARKETS, LARGE_K_SLOTS,
+                    max(2, timed_steps // 100), 128,
+                )
+            except Exception as exc:  # VMEM overflow is the expected datum
+                out["pallas_16k10k"] = (
+                    f"infeasible: {type(exc).__name__}: {str(exc)[:200]}"
+                )
+    finally:
+        # Autotune is documented default-off; in-process callers
+        # (perf_lab ab) must not leave it enabled behind the user's back.
+        if prior_autotune is None:
+            os.environ.pop("BCE_AUTOTUNE", None)
+        else:
+            os.environ["BCE_AUTOTUNE"] = prior_autotune
 
     xla_best = max(out["xla_cycles_per_sec"], out["xla_recheck_cycles_per_sec"])
     pallas_best = max(

@@ -91,7 +91,12 @@ def main() -> int:
                 # process pinning the file. This generator runs on the
                 # PlanPrefetcher's worker thread; the main thread releases
                 # the lock later, so the connection must be thread-free.
-                conn = sqlite3.connect(db, check_same_thread=False)
+                # timeout=300: a rolling background checkpoint may hold the
+                # write lock right now — the injector must WAIT for it, not
+                # die with its own spurious "database is locked".
+                conn = sqlite3.connect(
+                    db, check_same_thread=False, timeout=300
+                )
                 conn.execute("PRAGMA locking_mode=EXCLUSIVE")
                 conn.execute("BEGIN EXCLUSIVE")
                 lock_holder["conn"] = conn

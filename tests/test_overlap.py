@@ -902,6 +902,62 @@ class TestSettleStreamSharded:
         store.sync()
         assert store.list_sources() == eager_store.list_sources()
 
+    def test_lazy_checkpoints_never_write_torn_resettled_rows(self,
+                                                              tmp_path):
+        """Re-settling the SAME markets with lazy checkpoints: the eager
+        confidence replay updates (and dirties) host confidences while
+        reliabilities/stamps wait on the deferred recipe — the lazy flush
+        must exclude those rows ENTIRELY, never pairing a new confidence
+        with an old reliability (a state that never existed)."""
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        rng = random.Random(67)
+        payloads = random_payloads(rng, 8, universe=10, tag="-torn")
+        batches = [
+            (payloads, [rng.random() < 0.5 for _ in range(8)])
+            for _ in range(3)
+        ]
+
+        # Every consistent state a checkpoint may legally show: the store
+        # after each fully-applied batch prefix.
+        legal_states = []
+        prefix_store = TensorReliabilityStore()
+        for k in range(len(batches)):
+            for _ in settle_stream(
+                prefix_store, batches[k:k + 1], steps=1, now=21_240.0 + k,
+            ):
+                pass
+            prefix_store.sync()
+            legal_states.append({
+                (r.source_id, r.market_id): (r.reliability, r.confidence)
+                for r in prefix_store.list_sources()
+            })
+
+        db = tmp_path / "lazy.db"
+        store = TensorReliabilityStore()
+        stream = settle_stream(
+            store, batches, steps=1, now=21_240.0, db_path=db,
+            lazy_checkpoints=True,
+        )
+        for _result in stream:
+            store._flush_inflight.result()
+            for sid, mid, rel, conf, _iso in db_records(db):
+                pairs = {state.get((sid, mid)) for state in legal_states}
+                assert (rel, conf) in pairs, (
+                    f"torn record for ({sid}, {mid}): ({rel}, {conf}) "
+                    "matches no fully-applied state"
+                )
+        store.sync()
+        final = {
+            (r.source_id, r.market_id): (r.reliability, r.confidence)
+            for r in store.list_sources()
+        }
+        assert final == legal_states[-1]
+        assert {
+            (sid, mid): (rel, conf)
+            for sid, mid, rel, conf, _iso in db_records(db)
+        } == legal_states[-1]
+
     def test_band_gather_stays_deferred_between_batches(self):
         """The mesh path must NOT sync eagerly after each settle: the last
         batch's merge recipe stays pending until a host read resolves it
@@ -959,6 +1015,16 @@ class TestSettleStreamSharded:
             next(iter(settle_stream(
                 TensorReliabilityStore(), [], mesh=make_mesh(),
                 band=(0, 8), num_slots=None,
+            )))
+        # NumPy integers (num_slots from array math) are agreed integers.
+        assert list(settle_stream(
+            TensorReliabilityStore(), [], mesh=make_mesh(),
+            band=(0, 8), num_slots=np.int64(4),
+        )) == []
+        with pytest.raises(ValueError, match="globally-agreed integer"):
+            next(iter(settle_stream(
+                TensorReliabilityStore(), [], mesh=make_mesh(),
+                band=(0, 8), num_slots=True,  # bool is not an agreed K
             )))
 
     @pytest.mark.parametrize("use_mesh", [False, True],
