@@ -473,3 +473,35 @@ class TestPendingOverlaps:
         live = days[days > 0]
         assert unsynced <= live.min() - 1.0 + 1e-9
         assert store._pending_sync  # still deferred
+
+    def test_lazy_flush_excludes_deferred_rows_and_keeps_them_dirty(
+        self, tmp_path
+    ):
+        """resolve_pending=False writes only APPLIED truth: rows behind a
+        deferred recipe are excluded whole (their eagerly-replayed
+        confidences must not pair with stale reliabilities) and stay
+        dirty so the next resolving flush covers them."""
+        store, touched, _before = self._store_with_recipe()
+        db = tmp_path / "lazy.db"
+        handle = store.flush_to_sqlite_async(db, resolve_pending=False)
+        written = handle.result()
+        used = len(store)
+        assert written == used - len(touched)
+        assert store._pending_sync  # still deferred
+        assert store._dirty[touched].all()  # kept for the next flush
+        import sqlite3
+
+        with sqlite3.connect(db) as conn:
+            in_file = {
+                (sid, mid) for sid, mid in conn.execute(
+                    "SELECT source_id, market_id FROM sources"
+                )
+            }
+        deferred_ids = {store._pairs.id_of(int(r)) for r in touched}
+        assert not (in_file & deferred_ids)
+        # The resolving flush completes the file.
+        store.flush_to_sqlite(db)
+        with sqlite3.connect(db) as conn:
+            count = conn.execute("SELECT COUNT(*) FROM sources").fetchone()[0]
+        assert count == used
+        assert not store._pending_sync
