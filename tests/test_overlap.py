@@ -958,6 +958,46 @@ class TestSettleStreamSharded:
             for sid, mid, rel, conf, _iso in db_records(db)
         } == legal_states[-1]
 
+    def test_lazy_checkpoint_failure_rollback_composes(self, tmp_path,
+                                                       monkeypatch):
+        """A failing LAZY flush must roll back like an eager one: its
+        written-row selection re-dirties, the deferred rows it excluded
+        were never un-dirtied in the first place, and one caller retry
+        after the stream aborts re-covers everything settled."""
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        batches = self._batches(num_batches=4, seed=71)
+        db = tmp_path / "lazy.db"
+        store = TensorReliabilityStore()
+        real_builder = store._build_snapshot_writer
+        calls = {"n": 0}
+
+        def broken_second(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                def writer():
+                    raise RuntimeError("checkpoint disk gone")
+
+                return writer
+            return real_builder(*args, **kwargs)
+
+        monkeypatch.setattr(store, "_build_snapshot_writer", broken_second)
+        stats: list = []
+        with pytest.raises(RuntimeError, match="checkpoint disk gone"):
+            for _result in settle_stream(
+                store, batches, steps=1, now=21_250.0, db_path=db,
+                lazy_checkpoints=True, stats=stats,
+            ):
+                pass
+        settled = len(stats)
+        assert settled >= 2
+        store.sync()
+        store.flush_to_sqlite(db)
+        serial_store, _ = self._serial_flat(
+            batches[:settled], tmp_path / "serial.db", steps=1, now=21_250.0
+        )
+        assert db_records(db) == db_records(tmp_path / "serial.db")
+
     def test_band_gather_stays_deferred_between_batches(self):
         """The mesh path must NOT sync eagerly after each settle: the last
         batch's merge recipe stays pending until a host read resolves it
