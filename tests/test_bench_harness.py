@@ -162,6 +162,20 @@ class TestCompose:
         )
         json.dumps(payload)
 
+    def test_e2e_stream_cpu_absent_on_healthy_runs(self):
+        """The fallback-only leg must not pollute healthy records with a
+        'failed: not run' entry — absent means 'was never scheduled'."""
+        payload, _ = bench.compose(
+            _full_results(), [], {"platform": "tpu", "devices": 1}, 100.0
+        )
+        assert "e2e_stream_cpu" not in payload["extras"]
+        results = _full_results()
+        results["e2e_stream_cpu"] = _ok({"eager": {"wall_s": 20.0}})
+        payload, _ = bench.compose(results, [], {}, 1.0)
+        assert payload["extras"]["e2e_stream_cpu"] == {
+            "eager": {"wall_s": 20.0}
+        }
+
     def test_cpu_fallback_headline(self):
         results = {
             "headline_f32": _fail("timeout after 900s (killed)"),
