@@ -14,6 +14,8 @@ Layers (bottom → top), mirroring the reference's layer map but TPU-first:
   state/     reliability stores: SQLite (durable/compat), device-tensor (HBM)
   models/    market orchestration, cross-market aggregation, tie-breaking
   parallel/  device mesh + shard_map sharded consensus/update step
+  pipeline   payloads → plan → device settle → store → SQLite, end to end
+             (sessions, the streamed service loop, mesh/band sharding)
   cli        command-line surface (byte-compatible with the reference CLI)
 
 The scalar path imports no JAX; array paths import it lazily.
