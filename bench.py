@@ -181,16 +181,24 @@ def timed_best_of(loop_call, make_state, steps, trials=3):
     Every run gets a fresh donated state and is fenced by a scalar value
     fetch (``block_until_ready`` does not force execution through the axon
     tunnel — see module notes). ``loop_call`` returns (state, consensus).
+
+    The output state is explicitly dropped before the next trial's fresh
+    state allocates: holding it across ``make_state()`` doubles the state
+    footprint, which at the north-star band (7.5 GB compact state on a
+    16 GB chip with 6.25 GB of inputs resident) is the difference between
+    running and RESOURCE_EXHAUSTED.
     """
-    _, consensus = loop_call(make_state())
+    out_state, consensus = loop_call(make_state())
     _fence(consensus)
+    del out_state, consensus
     best = float("inf")
     for _ in range(trials):
         state_in = make_state()
         start = time.perf_counter()
-        _, consensus = loop_call(state_in)
+        out_state, consensus = loop_call(state_in)
         _fence(consensus)
         best = min(best, (time.perf_counter() - start) / steps)
+        del out_state, consensus
     return 1.0 / best
 
 
@@ -374,6 +382,100 @@ def bench_large_k(markets=LARGE_K_MARKETS, slots=LARGE_K_SLOTS,
     }
 
 
+def _gen_chunked(key, slots, markets_n, dtype, chunks=8):
+    """Slot-axis-chunked on-device RNG for the north-star bands.
+
+    Threefry at the full band (1.25e9 draws) materialises multi-GB uint32
+    transients and OOMs the chip ON ITS OWN (measured 2026-07-31: uint16
+    bits at (10k, 62528) fits, (10k, 125056) does not, with 14 GiB free),
+    so draw per-chunk, fence each so its transients die, and pay one
+    2x-output concatenate instead."""
+    import jax
+    import jax.numpy as jnp
+
+    parts = []
+    for i, k in enumerate(jax.random.split(key, chunks)):
+        lo = slots * i // chunks
+        hi = slots * (i + 1) // chunks
+        if dtype == jnp.float32:
+            p = jax.random.uniform(k, (hi - lo, markets_n), dtype=jnp.float32)
+        else:
+            p = jax.random.bits(k, (hi - lo, markets_n), dtype)
+        _fence(p)
+        parts.append(p)
+    out = jnp.concatenate(parts, axis=0)
+    _fence(out)
+    return out
+
+
+def _gen_mask_outcome(k_mask, k_outcome, slots, markets_n):
+    """Band mask/outcome from 16-bit draws: threshold 58982/65536 ≈
+    0.90002 occupancy — the headline's ~90% without a 5 GB f32 uniform
+    transient ever existing."""
+    import jax
+    import jax.numpy as jnp
+
+    bits = _gen_chunked(k_mask, slots, markets_n, jnp.uint16)
+    mask_n = bits < 58982
+    _fence(mask_n)
+    del bits
+    outcome_n = jax.random.bits(k_outcome, (markets_n,), jnp.uint16) < 32768
+    _fence(outcome_n)
+    return mask_n, outcome_n
+
+
+def _band_working_set_gb(slots, markets_n, probs_bytes):
+    """Resident HBM of one band: compact state (i8 + u8 + f32 per slot —
+    CompactBlockState's fields) + probs at ``probs_bytes`` + bool mask +
+    bool outcome. ONE formula for both north-star legs."""
+    state_bytes = (1 + 1 + 4) * slots * markets_n
+    input_bytes = (probs_bytes + 1) * slots * markets_n + markets_n
+    return round((state_bytes + input_bytes) / 1e9, 1)
+
+
+def _band_fit(loop, probs, mask, outcome, markets_n, slots, steps, fit_steps):
+    """Two-point (steps, fit_steps) fit of the compact loop at one band.
+
+    Returns ``(out_dict, marginal_s)`` — the end-to-end rate plus the
+    dispatch-free marginal seconds/step (0.0 when the fit is degenerate).
+    Both north-star legs go through HERE so their numbers stay
+    methodologically identical (same fencing, same fresh-state
+    discipline, same fit)."""
+    import jax.numpy as jnp
+
+    from bayesian_consensus_engine_tpu.parallel import init_compact_state
+
+    day = jnp.asarray(1.0, jnp.float32)
+
+    def fresh_state():
+        state = init_compact_state(markets_n, slots)
+        _fence(state.updated_days)
+        return state
+
+    cps_big = timed_best_of(
+        lambda s: loop(probs, mask, outcome, s, day, steps),
+        fresh_state,
+        steps,
+    )
+    cps_small = timed_best_of(
+        lambda s: loop(probs, mask, outcome, s, day, fit_steps),
+        fresh_state,
+        fit_steps,
+    )
+    out = {"end_to_end_cycles_per_sec": round(cps_big, 2)}
+    t_big, t_small = steps / cps_big, fit_steps / cps_small
+    marginal_s = (t_big - t_small) / (steps - fit_steps)
+    if marginal_s <= 0:
+        out["fit"] = (
+            f"degenerate (t_{fit_steps}={t_small * 1e3:.1f}ms, "
+            f"t_{steps}={t_big * 1e3:.1f}ms)"
+        )
+        return out, 0.0
+    out["marginal_ms_per_step"] = round(marginal_s * 1e3, 2)
+    out["band_sustained_cycles_per_sec"] = round(1.0 / marginal_s, 1)
+    return out, marginal_s
+
+
 def bench_north_star_band(markets=NORTH_STAR_MARKETS, slots=NORTH_STAR_SLOTS,
                           steps=NORTH_STAR_STEPS,
                           fit_steps=NORTH_STAR_FIT_STEPS):
@@ -384,110 +486,129 @@ def bench_north_star_band(markets=NORTH_STAR_MARKETS, slots=NORTH_STAR_SLOTS,
     band and the cycle moves zero cross-device bytes (the one psum
     compiles to singleton replica groups — checked in HLO on the 8-device
     virtual mesh), so ONE measured band step IS the projected global step.
-    This leg runs that exact band through the counter-compact loop (the
-    only state encoding that fits the shape in 16 GB) and reports the
-    marginal ms/step via a two-point fit, replacing the projection table's
-    extrapolated ~18 ms/step row (docs/tpu-architecture.md) with a
-    measured anchor.
+    This leg runs that band through the counter-compact loop and reports
+    the marginal ms/step via a two-point fit, replacing the projection
+    table's extrapolated row (docs/tpu-architecture.md) with a measured
+    anchor.
 
-    Inputs are generated ON DEVICE directly in slot-major layout — a host
-    transfer or a (M,K)→(K,M) device transpose of a 5 GB operand would
-    both blow the budget/HBM; generation is sequenced with fences so the
-    5 GB uniform transient for the mask dies before the state allocates.
+    Capacity, measured on the axon chip 2026-07-31: ~15 GiB of plain
+    buffers allocate, but each multi-GB RNG generation pass leaves ~1 GiB
+    of unreclaimed scratch, so the f32-probs band (13.75 GB resident)
+    does NOT reliably fit — the final 5 GB state block dies with
+    RESOURCE_EXHAUSTED. The band that fits with headroom is the u16
+    fixed-point probability block (11.25 GB resident), generated directly
+    as 16-bit draws (`jax.random.bits` — uniform on the u16 lattice,
+    which IS `encode_probs_u16`'s codomain, parallel/compact.py:90-113)
+    so no 5 GB f32 transient ever exists. That u16 band is this leg's
+    number; the f32 numeric contract is anchored at the v5e-16 half band
+    by the separate ``north_star_f32`` leg (an OOM poisons every later
+    allocation in its process, so the two run in separate subprocesses).
+    Inputs are generated ON DEVICE in slot-major layout (a multi-GB host
+    transfer through the tunnel would blow the time budget).
     """
     import jax
     import jax.numpy as jnp
 
-    from bayesian_consensus_engine_tpu.parallel import (
-        build_compact_cycle_loop,
-        init_compact_state,
-    )
-
-    k_probs, k_mask, k_outcome = jax.random.split(jax.random.PRNGKey(2), 3)
-    probs = jax.random.uniform(k_probs, (slots, markets), dtype=jnp.float32)
-    _fence(probs)
-    mask = jax.random.uniform(k_mask, (slots, markets)) < 0.9
-    _fence(mask)
-    outcome = jax.random.uniform(k_outcome, (markets,)) < 0.5
-    _fence(outcome)
+    from bayesian_consensus_engine_tpu.parallel import build_compact_cycle_loop
 
     loop = build_compact_cycle_loop(mesh=None, donate=True)
+    k_probs, k_mask, k_outcome = jax.random.split(jax.random.PRNGKey(2), 3)
 
-    def fresh_state():
-        state = init_compact_state(markets, slots)
-        _fence(state.updated_days)
-        return state
-
-    day = jnp.asarray(1.0, jnp.float32)
-
-    def fit(probs_in):
-        cps_big = timed_best_of(
-            lambda s: loop(probs_in, mask, outcome, s, day, steps),
-            fresh_state,
-            steps,
-        )
-        cps_small = timed_best_of(
-            lambda s: loop(probs_in, mask, outcome, s, day, fit_steps),
-            fresh_state,
-            fit_steps,
-        )
-        out = {"end_to_end_cycles_per_sec": round(cps_big, 2)}
-        t_big, t_small = steps / cps_big, fit_steps / cps_small
-        marginal_s = (t_big - t_small) / (steps - fit_steps)
-        if marginal_s <= 0:
-            out["fit"] = (
-                f"degenerate (t_{fit_steps}={t_small * 1e3:.1f}ms, "
-                f"t_{steps}={t_big * 1e3:.1f}ms)"
-            )
-        else:
-            out["marginal_ms_per_step"] = round(marginal_s * 1e3, 2)
-            out["band_sustained_cycles_per_sec"] = round(1.0 / marginal_s, 1)
-        return out, marginal_s
-
-    f32_result, f32_marginal = fit(probs)
-
-    state_bytes = (1 + 1 + 4) * slots * markets
-    input_bytes = (4 + 1) * slots * markets + markets
     result = {
         "workload": (
             f"{markets} markets x {slots} slots (dense; the per-chip band "
             f"of 1M x 10k on a v5e-8 markets-only mesh)"
         ),
-        "hbm_working_set_gb": round((state_bytes + input_bytes) / 1e9, 1),
-        **f32_result,
     }
-    if f32_marginal > 0:
-        result["projected_v5e8_1m_x_10k_cycles_per_sec"] = round(
-            1.0 / f32_marginal, 1
+
+    mask, outcome = _gen_mask_outcome(k_mask, k_outcome, slots, markets)
+    # The u16 fit IS this leg's measurement — no blanket except here: a
+    # failure must fail the leg (harness status, degraded accounting,
+    # circuit breaker), not report ok with a failure string inside.
+    probs_u16 = _gen_chunked(k_probs, slots, markets, jnp.uint16)
+    u16_result, u16_marginal = _band_fit(
+        loop, probs_u16, mask, outcome, markets, slots, steps, fit_steps
+    )
+    del probs_u16
+    u16_result["contract"] = (
+        "u16 fixed-point signals (quantization <= 7.6e-6 — "
+        "parallel/compact.py::encode_probs_u16); bitwise equal to the "
+        "f32 loop on the decoded inputs"
+    )
+    u16_result["hbm_working_set_gb"] = _band_working_set_gb(
+        slots, markets, probs_bytes=2
+    )
+    result["u16_probs"] = u16_result
+    if u16_marginal > 0:
+        result["projected_v5e8_1m_x_10k_u16_cycles_per_sec"] = round(
+            1.0 / u16_marginal, 1
         )
         result["projection_basis"] = (
-            "8 chips each run this band in lockstep with zero cross-device "
-            "bytes (singleton psum groups on a markets-only mesh), so the "
-            "global 1M x 10k sustained rate equals the measured band rate"
+            "u16-probs band (reduced-precision contract — NOT an f32 "
+            "number): 8 chips each run this band in lockstep with zero "
+            "cross-device bytes (singleton psum groups on a markets-only "
+            "mesh), so the global 1M x 10k sustained rate equals the "
+            "measured band rate"
         )
+    return result
 
-    # u16 fixed-point probability block: same kernel auto-decodes, halving
-    # the largest per-step read AND freeing 2.5 GB of the band's working
-    # set. Reduced-precision contract (quantization ≤ 7.6e-6 per signal —
-    # parallel/compact.py::encode_probs_u16) — reported alongside, never
-    # AS, the f32 number.
-    try:
-        from bayesian_consensus_engine_tpu.parallel import encode_probs_u16
 
-        probs_u16 = encode_probs_u16(probs)
-        _fence(probs_u16)  # scalar fetch, any dtype — never a bulk convert
-        del probs  # free the 5 GB f32 block before the u16 runs
-        u16_result, _ = fit(probs_u16)
-        u16_result["contract"] = (
-            "u16 fixed-point signals (quantization <= 7.6e-6); bitwise "
-            "equal to the f32 loop on the decoded inputs"
+def bench_north_star_f32(markets=NORTH_STAR_MARKETS // 2,
+                         slots=NORTH_STAR_SLOTS, steps=NORTH_STAR_STEPS,
+                         fit_steps=NORTH_STAR_FIT_STEPS):
+    """The f32-probs anchor for the north-star band: the HALF band.
+
+    The full f32 band (125,056 markets: 13.75 GB resident) does not fit
+    the axon chip — measured three ways on 2026-07-31, each dying in
+    RESOURCE_EXHAUSTED at the final 5 GB state block (~15 GiB of plain
+    buffers allocate, but multi-GB RNG passes leave ~1 GiB of scratch
+    residue each, and an OOM poisons every later allocation in the same
+    process — which is why this is its OWN leg, not a fallback inside
+    ``north_star_band``). So the f32 loop is anchored at 62,528 markets —
+    exactly the v5e-16 per-chip slice — and the published projection is
+    the v5e-16 lockstep rate: 16 chips each running this measured band,
+    global rate = the band rate (1/marginal). The u16 band
+    (``north_star_band``) is the encoding that actually fits a v5e-8;
+    this leg pins the f32 numeric-contract rate the projection table
+    quotes alongside it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bayesian_consensus_engine_tpu.parallel import build_compact_cycle_loop
+
+    loop = build_compact_cycle_loop(mesh=None, donate=True)
+    k_probs, k_mask, k_outcome = jax.random.split(jax.random.PRNGKey(2), 3)
+
+    mask, outcome = _gen_mask_outcome(k_mask, k_outcome, slots, markets)
+    probs = _gen_chunked(k_probs, slots, markets, jnp.float32)
+    fit_result, marginal_s = _band_fit(
+        loop, probs, mask, outcome, markets, slots, steps, fit_steps
+    )
+    result = {
+        "workload": (
+            f"{markets} markets x {slots} slots, f32 probs (the v5e-16 "
+            f"per-chip slice of 1M x 10k; the v5e-8 slice at f32 exceeds "
+            f"this chip's usable HBM — see leg docstring)"
+        ),
+        **fit_result,
+        "hbm_working_set_gb": _band_working_set_gb(
+            slots, markets, probs_bytes=4
+        ),
+    }
+    if marginal_s > 0:
+        result["projected_v5e16_1m_x_10k_f32_cycles_per_sec"] = round(
+            1.0 / marginal_s, 1
         )
-        u16_result["hbm_working_set_gb"] = round(
-            (state_bytes + (2 + 1) * slots * markets + markets) / 1e9, 1
+        result["projection_basis"] = (
+            "a v5e-16 markets-only mesh runs 16 of these half-bands in "
+            "lockstep with zero cross-device bytes, so the global 1M x "
+            "10k f32 rate equals the measured band rate; a v5e-8 full "
+            "band would run at ~2x the band marginal (the cycle is "
+            "elementwise + a slots-axis reduce, linear in markets) but "
+            "does not fit at f32 — the u16 band (north_star_band) is the "
+            "v5e-8 story"
         )
-        result["u16_probs"] = u16_result
-    except Exception as exc:  # noqa: BLE001 — variant must not sink the leg
-        result["u16_probs"] = f"failed: {type(exc).__name__}: {exc}"
     return result
 
 
@@ -1157,6 +1278,10 @@ LEGS = {
         bench_north_star_band, {},
         dict(markets=2048, slots=64, steps=8, fit_steps=2), 1200,
     ),
+    "north_star_f32": (
+        bench_north_star_f32, {},
+        dict(markets=1024, slots=64, steps=8, fit_steps=2), 1200,
+    ),
     "large_k": (
         bench_large_k, {}, dict(markets=512, slots=64, steps=4), 1200,
     ),
@@ -1208,6 +1333,7 @@ DEVICE_LEG_ORDER = [
     "dispatch_rtt",
     "stream_probe",
     "north_star_band",
+    "north_star_f32",
     "large_k",
     "e2e_pipeline",
     "e2e_overlap",
@@ -1442,9 +1568,14 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         ),
     }
     if band and band.get("ok") and isinstance(band["value"], dict):
-        projected = band["value"].get("projected_v5e8_1m_x_10k_cycles_per_sec")
+        projected = band["value"].get(
+            "projected_v5e8_1m_x_10k_u16_cycles_per_sec"
+        )
         if projected is not None:
-            baseline_shape["projected_v5e8_cycles_per_sec"] = projected
+            # Labelled u16: the v5e-8 projection rests on the
+            # reduced-precision band (the f32 band does not fit a 16 GB
+            # chip); the f32 anchor is extras.north_star_f32.
+            baseline_shape["projected_v5e8_u16_cycles_per_sec"] = projected
 
     # Slot throughput multiplies by the PRODUCTION shapes — skip under
     # --fast, where the legs ran tiny ones.
@@ -1482,6 +1613,7 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         "normalised_vs_probe": normalised,
         "baseline_shape": baseline_shape,
         "north_star_band": band_value,
+        "north_star_f32": _show(results, "north_star_f32"),
         "large_k": _show(results, "large_k"),
         "pallas_ab": _show(results, "pallas_ab"),
         "e2e_pipeline": _show(results, "e2e_pipeline"),

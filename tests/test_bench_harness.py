@@ -90,9 +90,19 @@ def _full_results(compact=7200.0, f32=2200.0):
         "north_star_band": _ok(
             {
                 "workload": "125056 markets x 10000 slots",
-                "marginal_ms_per_step": 18.0,
-                "band_sustained_cycles_per_sec": 55.6,
-                "projected_v5e8_1m_x_10k_cycles_per_sec": 55.6,
+                "u16_probs": {
+                    "marginal_ms_per_step": 14.31,
+                    "band_sustained_cycles_per_sec": 69.9,
+                },
+                "projected_v5e8_1m_x_10k_u16_cycles_per_sec": 69.9,
+            }
+        ),
+        "north_star_f32": _ok(
+            {
+                "workload": "62528 markets x 10000 slots, f32 probs",
+                "marginal_ms_per_step": 8.94,
+                "band_sustained_cycles_per_sec": 111.9,
+                "projected_v5e16_1m_x_10k_f32_cycles_per_sec": 111.9,
             }
         ),
         "large_k": _ok({"flat_loop_cycles_per_sec": 233.0}),
@@ -117,9 +127,17 @@ class TestCompose:
         assert extras["normalised_vs_probe"]["headline_cycles_per_gbs"] == round(
             7200.0 / 400.0, 3
         )
-        # BASELINE-shaped metric rides along every run.
+        # BASELINE-shaped metric rides along every run, u16-labelled (the
+        # f32 band does not fit one chip; its anchor is north_star_f32).
         assert (
-            extras["baseline_shape"]["projected_v5e8_cycles_per_sec"] == 55.6
+            extras["baseline_shape"]["projected_v5e8_u16_cycles_per_sec"]
+            == 69.9
+        )
+        assert (
+            extras["north_star_f32"][
+                "projected_v5e16_1m_x_10k_f32_cycles_per_sec"
+            ]
+            == 111.9
         )
         assert extras["harness"]["legs"]["compact"] == "ok"
         json.dumps(payload)  # driver contract: serializable
