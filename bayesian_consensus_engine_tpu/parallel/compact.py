@@ -13,10 +13,12 @@ than three f32 tensors (reference: reliability.py:163-175):
     (``1 − 0.75·0.9ⁿ`` from the 0.25 prior), saturating in u8 range.
 
 So the loop state compresses to one int8 + one uint8 per slot (plus the
-f32 day stamps, which the fast loop already reads once and reconstructs —
-parallel/sharded.py). Per step the carried traffic drops from ~21 to
-~9 bytes/slot; on a bandwidth-bound cycle that is the whole game
-(same-process A/B on v5e: see bench.py extras).
+day stamps, which the fast loop already reads once and reconstructs —
+parallel/sharded.py; f32 by default, or u16 via
+``init_compact_state(days_dtype=jnp.uint16)`` for integral days ≤ 65535,
+exact and 2 bytes/slot cheaper at rest). Per step the carried traffic
+drops from ~21 to ~9 bytes/slot; on a bandwidth-bound cycle that is the
+whole game (same-process A/B on v5e: see bench.py extras).
 
 Numeric contract: decode computes ``0.5 + 0.1·c`` and ``1 − 0.75·2^(n·log₂0.9)``
 in f32 — equal to the f32 sequential-add path within a few ulp (the f32
@@ -84,7 +86,7 @@ class CompactBlockState(NamedTuple):
 
     rel_steps: jax.Array     # i8[...] net (correct − incorrect), clamped ±5
     conf_steps: jax.Array    # u8[...] total updates, saturating at 255
-    updated_days: jax.Array  # f32[...] day of last update (0 ⇒ never)
+    updated_days: jax.Array  # f32 or u16[...] day of last update (0 ⇒ never)
 
 
 def encode_probs_u16(probs: jax.Array) -> jax.Array:
@@ -122,13 +124,33 @@ def _decode_probs(probs: jax.Array) -> jax.Array:
 
 
 def init_compact_state(
-    num_markets: int, slots: int, slot_major: bool = True
+    num_markets: int,
+    slots: int,
+    slot_major: bool = True,
+    days_dtype=jnp.float32,
 ) -> CompactBlockState:
+    """Zero (= cold-start) counter state.
+
+    ``days_dtype=jnp.uint16`` shrinks the day stamps from 4 to 2
+    bytes/slot — at the north-star band that is 2.5 GB, the difference
+    between the f32-signal band fitting one 16 GB chip (11.25 GB) and
+    OOMing it (13.75 GB — measured, see bench.bench_north_star_f32).
+    Contract: day values must be integral and in [0, 65535] (u16→f32
+    conversion is then exact, so every read/decay/stamp is bit-identical
+    to the f32-days state — tests/test_compact.py pins it). The
+    settlement day streams the reference passes around are day counts
+    (reference: decay.py:94-118 takes whole ``days_elapsed``), so the
+    domain is the natural one; 65,535 days ≈ 179 years of them.
+    """
+    if days_dtype not in (jnp.float32, jnp.uint16):
+        raise ValueError(
+            f"days_dtype must be float32 or uint16, got {days_dtype!r}"
+        )
     shape = (slots, num_markets) if slot_major else (num_markets, slots)
     return CompactBlockState(
         rel_steps=jnp.zeros(shape, jnp.int8),
         conf_steps=jnp.zeros(shape, jnp.uint8),
-        updated_days=jnp.zeros(shape, jnp.float32),
+        updated_days=jnp.zeros(shape, days_dtype),
     )
 
 
@@ -155,7 +177,9 @@ def compact_to_block(state: CompactBlockState) -> MarketBlockState:
         confidence=jnp.where(
             exists, decode_confidence(state.conf_steps), DEFAULT_CONFIDENCE
         ),
-        updated_days=state.updated_days,
+        # Block-state days are f32 by contract; exact for the u16-days
+        # state's integral domain.
+        updated_days=state.updated_days.astype(jnp.float32),
         exists=exists,
     )
 
@@ -253,10 +277,19 @@ def _compact_loop_math(probs, mask, outcome, state, now0, steps, axis_name,
 def _stamp_updated_days(mask, now0, steps, updated_days):
     """Masked day stamp after N cycles — SHARED by the loop and the closed
     form; both must stamp the identical value or their documented exact
-    equality breaks."""
+    equality breaks. Dtype follows the state (u16-days states stamp the
+    same integral value exactly — the f32→u16 convert truncates, which
+    is lossless on the documented integral [0, 65535] domain). Past that
+    horizon the u16 stamp CLIPS rather than wraps (mirroring
+    ``encode_probs_u16``): a saturated stamp under-decays by a bounded
+    amount on a later read, where a wrapped one would mark the row ~65k
+    days stale and silently collapse its reliability to the floor."""
+    stamp = now0 + (steps - 1)
+    if updated_days.dtype == jnp.uint16:
+        stamp = jnp.clip(stamp, 0, 65535)
     return jnp.where(
         mask,
-        jnp.asarray(now0 + (steps - 1), updated_days.dtype),
+        jnp.asarray(stamp, updated_days.dtype),
         updated_days,
     )
 

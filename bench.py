@@ -424,16 +424,17 @@ def _gen_mask_outcome(k_mask, k_outcome, slots, markets_n):
     return mask_n, outcome_n
 
 
-def _band_working_set_gb(slots, markets_n, probs_bytes):
-    """Resident HBM of one band: compact state (i8 + u8 + f32 per slot —
+def _band_working_set_gb(slots, markets_n, probs_bytes, days_bytes=4):
+    """Resident HBM of one band: compact state (i8 + u8 + days per slot —
     CompactBlockState's fields) + probs at ``probs_bytes`` + bool mask +
     bool outcome. ONE formula for both north-star legs."""
-    state_bytes = (1 + 1 + 4) * slots * markets_n
+    state_bytes = (1 + 1 + days_bytes) * slots * markets_n
     input_bytes = (probs_bytes + 1) * slots * markets_n + markets_n
     return round((state_bytes + input_bytes) / 1e9, 1)
 
 
-def _band_fit(loop, probs, mask, outcome, markets_n, slots, steps, fit_steps):
+def _band_fit(loop, probs, mask, outcome, markets_n, slots, steps, fit_steps,
+              days_dtype=None):
     """Two-point (steps, fit_steps) fit of the compact loop at one band.
 
     Returns ``(out_dict, marginal_s)`` — the end-to-end rate plus the
@@ -445,10 +446,12 @@ def _band_fit(loop, probs, mask, outcome, markets_n, slots, steps, fit_steps):
 
     from bayesian_consensus_engine_tpu.parallel import init_compact_state
 
+    if days_dtype is None:
+        days_dtype = jnp.float32
     day = jnp.asarray(1.0, jnp.float32)
 
     def fresh_state():
-        state = init_compact_state(markets_n, slots)
+        state = init_compact_state(markets_n, slots, days_dtype=days_dtype)
         _fence(state.updated_days)
         return state
 
@@ -604,10 +607,47 @@ def bench_north_star_f32(markets=NORTH_STAR_MARKETS // 2,
             "a v5e-16 markets-only mesh runs 16 of these half-bands in "
             "lockstep with zero cross-device bytes, so the global 1M x "
             "10k f32 rate equals the measured band rate; a v5e-8 full "
-            "band would run at ~2x the band marginal (the cycle is "
-            "elementwise + a slots-axis reduce, linear in markets) but "
-            "does not fit at f32 — the u16 band (north_star_band) is the "
-            "v5e-8 story"
+            "band does not fit at f32 with f32 day stamps — see "
+            "f32_probs_u16_days_full_band for the encoding that makes "
+            "the f32-signal v5e-8 band fit"
+        )
+
+    # --- Full f32-signal band via u16 day stamps (11.25 GB resident —
+    # init_compact_state(days_dtype=uint16), bit-identical on integral
+    # days): the capacity rung that makes the f32-SIGNAL north star fit
+    # a v5e-8 chip. Attempted LAST: the half band above is already
+    # banked, so an OOM here costs only this entry. ---
+    full_markets = markets * 2
+    try:
+        del probs, mask, outcome  # free ALL half-band buffers first
+        mask, outcome = _gen_mask_outcome(
+            k_mask, k_outcome, slots, full_markets
+        )
+        probs = _gen_chunked(k_probs, slots, full_markets, jnp.float32)
+        u16d_result, u16d_marginal = _band_fit(
+            loop, probs, mask, outcome, full_markets, slots, steps,
+            fit_steps, days_dtype=jnp.uint16,
+        )
+        u16d_result["workload"] = (
+            f"{full_markets} markets x {slots} slots, f32 probs + u16 day "
+            f"stamps (the v5e-8 per-chip slice)"
+        )
+        u16d_result["contract"] = (
+            "f32 signals (full numeric contract), u16 day stamps — "
+            "bit-identical to f32 days on the integral [0, 65535] day "
+            "domain (tests/test_compact.py::TestU16Days)"
+        )
+        u16d_result["hbm_working_set_gb"] = _band_working_set_gb(
+            slots, full_markets, probs_bytes=4, days_bytes=2
+        )
+        if u16d_marginal > 0:
+            u16d_result["projected_v5e8_1m_x_10k_f32_cycles_per_sec"] = (
+                round(1.0 / u16d_marginal, 1)
+            )
+        result["f32_probs_u16_days_full_band"] = u16d_result
+    except Exception as exc:  # noqa: BLE001 — must not sink the half band
+        result["f32_probs_u16_days_full_band"] = (
+            f"failed: {type(exc).__name__}: {exc}"
         )
     return result
 

@@ -527,3 +527,119 @@ class TestReducedPrecisionProbs:
         np.testing.assert_array_equal(
             np.asarray(s_enc.rel_steps), np.asarray(s_ref.rel_steps)
         )
+
+
+class TestU16Days:
+    """Opt-in u16 day stamps (`init_compact_state(days_dtype=uint16)`):
+    2 bytes/slot at rest instead of 4 — at the north-star band the
+    2.5 GB that decides whether the f32-signal band fits one 16 GB chip
+    (bench.bench_north_star_f32). Contract: integral days in [0, 65535]
+    are BIT-IDENTICAL to the f32-days state on every path (u16→f32
+    conversion is exact there)."""
+
+    def test_init_dtype_and_validation(self):
+        state = init_compact_state(4, 2, days_dtype=jnp.uint16)
+        assert state.updated_days.dtype == jnp.uint16
+        assert init_compact_state(4, 2).updated_days.dtype == jnp.float32
+        with pytest.raises(ValueError, match="days_dtype"):
+            init_compact_state(4, 2, days_dtype=jnp.int32)
+
+    @pytest.mark.parametrize("steps", [1, 2, 7])
+    def test_loop_bit_identical_to_f32_days(self, steps):
+        probs, mask, outcome = _workload(steps + 100)
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        day = jnp.float32(3.0)
+        want_state, want_consensus = loop(
+            probs, mask, outcome, init_compact_state(M, K), day, steps
+        )
+        got_state, got_consensus = loop(
+            probs, mask, outcome,
+            init_compact_state(M, K, days_dtype=jnp.uint16), day, steps,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_consensus), np.asarray(want_consensus)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_state.rel_steps), np.asarray(want_state.rel_steps)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_state.conf_steps),
+            np.asarray(want_state.conf_steps),
+        )
+        assert got_state.updated_days.dtype == jnp.uint16
+        np.testing.assert_array_equal(
+            np.asarray(got_state.updated_days, dtype=np.float32),
+            np.asarray(want_state.updated_days),
+        )
+
+    def test_warm_resume_and_read_time_decay_bit_identical(self):
+        # A warm u16-days state entering a LATER loop must decay from its
+        # per-slot stamps on step 0 exactly as the f32-days state does —
+        # the one place the stored days are actually read.
+        probs, mask, outcome = _workload(11)
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        f32_state, _ = loop(
+            probs, mask, outcome, init_compact_state(M, K),
+            jnp.float32(1.0), 3,
+        )
+        u16_state, _ = loop(
+            probs, mask, outcome,
+            init_compact_state(M, K, days_dtype=jnp.uint16),
+            jnp.float32(1.0), 3,
+        )
+        # resume 40 days later: decay has real work to do
+        want_state, want_consensus = loop(
+            probs, mask, outcome, f32_state, jnp.float32(43.0), 2
+        )
+        got_state, got_consensus = loop(
+            probs, mask, outcome, u16_state, jnp.float32(43.0), 2
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_consensus), np.asarray(want_consensus)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_state.rel_steps), np.asarray(want_state.rel_steps)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got_state.updated_days, dtype=np.float32),
+            np.asarray(want_state.updated_days),
+        )
+
+    def test_advance_counters_preserves_dtype_and_value(self):
+        from bayesian_consensus_engine_tpu.parallel import advance_counters
+
+        probs, mask, outcome = _workload(5)
+        correct = (probs >= 0.5) == outcome[None, :]
+        got = advance_counters(
+            init_compact_state(M, K, days_dtype=jnp.uint16),
+            mask, correct, 6, jnp.float32(10.0),
+        )
+        want = advance_counters(
+            init_compact_state(M, K), mask, correct, 6, jnp.float32(10.0)
+        )
+        assert got.updated_days.dtype == jnp.uint16
+        np.testing.assert_array_equal(
+            np.asarray(got.updated_days, dtype=np.float32),
+            np.asarray(want.updated_days),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.rel_steps), np.asarray(want.rel_steps)
+        )
+
+    def test_compact_to_block_returns_f32_days(self):
+        state = init_compact_state(8, 4, days_dtype=jnp.uint16)
+        block = compact_to_block(state)
+        assert block.updated_days.dtype == jnp.float32
+
+    def test_stamp_clips_past_the_u16_horizon_instead_of_wrapping(self):
+        # 70000 would wrap to 4464 on a bare cast, making rows read as
+        # ~65k days stale; the stamp must saturate at 65535 instead.
+        probs, mask, outcome = _workload(17)
+        loop = build_compact_cycle_loop(mesh=None, donate=False)
+        state, _ = loop(
+            probs, mask, outcome,
+            init_compact_state(M, K, days_dtype=jnp.uint16),
+            jnp.float32(70000.0), 1,
+        )
+        stamped = np.asarray(state.updated_days)[np.asarray(mask)]
+        assert np.all(stamped == 65535)
