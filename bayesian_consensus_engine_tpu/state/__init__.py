@@ -12,8 +12,13 @@ from bayesian_consensus_engine_tpu.state.decay import (
     days_since_update,
     decay_reliability_if_needed,
 )
+from bayesian_consensus_engine_tpu.state.journal import (
+    JournalWriter,
+    replay_journal,
+)
 
 __all__ = [
+    "JournalWriter",
     "ReliabilityRecord",
     "ReliabilityStore",
     "SQLiteReliabilityStore",
@@ -21,4 +26,5 @@ __all__ = [
     "compute_decay_factor",
     "days_since_update",
     "decay_reliability_if_needed",
+    "replay_journal",
 ]

@@ -1,0 +1,371 @@
+"""Append-only binary durability journal — the tier under the SQLite floor.
+
+The SQLite file is the interchange format, byte-compatible with the
+reference engine's database (reference: reliability.py:36-45 for the
+schema, :221-231 for the UPSERT semantics) — and its text-PK bulk UPSERT
+floors near ~200-300k rows/s no matter how the writer is built
+(docs/tpu-architecture.md "GC note"). A streamed settlement service that
+checkpoints every few batches therefore pays ~13-20 s of SQLite time per
+million fresh markets; measured on-chip 2026-07-31, that was 11.8 s of a
+21.7 s stream wall (`bench.py --leg e2e_stream`). The journal is the
+rolling-durability tier UNDER that floor: each epoch appends the rows
+dirtied since the last epoch as raw little-endian columns plus the newly
+interned pair strings, written (and fsynced) at disk bandwidth. The
+service keeps SQLite for what it is — the interchange file, produced
+once at exit by :func:`~.pipeline.settle_stream`'s tail flush — while
+mid-stream durability costs ~40 bytes/row of sequential IO.
+
+Why not orbax for this: the store's identity sidecar (interned
+(source, market) strings) is not an array. `save_checkpoint` ships it as
+JSON metadata, which re-serialises EVERY pair on EVERY snapshot —
+O(total rows) per epoch where the journal is O(new + re-touched rows).
+
+File format (all little-endian)::
+
+    header   MAGIC = b"BCEJRNL1"
+    epoch    fixed header (struct <QQQQQdQ>):
+               epoch_index     u64   (0, 1, 2, ... — dense)
+               used_after      u64   total interned rows after this epoch
+               pair_blob_len   u64
+               dirty_count     u64
+               iso_blob_len    u64
+               wall_unix_ts    f64
+               tag             u64   caller watermark (settle_stream: the
+                                     settled batch index this epoch covers)
+             pair_blob: for each row in [prev used_after, used_after):
+               u32 src_len, src utf-8, u32 mkt_len, mkt utf-8
+             columns: idx u64[d], rel f64[d], conf f64[d], days f64[d],
+                      exists u8[d]
+             iso_blob: per dirty row, u32 len + utf-8 bytes
+             crc32    u32 of everything from the fixed header through the
+                      iso_blob (zlib.crc32)
+
+Recovery (:func:`replay_journal`) replays epochs in order onto a fresh
+store — interning the pair blob in row order reproduces the original row
+assignment exactly — and STOPS at the first truncated or CRC-failing
+epoch: a crash mid-append leaves the journal valid through the last
+complete epoch, which is exactly the durable point the stream last
+reported. The returned ``tag`` is that epoch's watermark; a restarted
+service resumes from ``batches[tag + 1:]`` (see
+examples/fault_tolerant_service.py for the SQLite-recipe sibling).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+import zlib
+from pathlib import Path
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+MAGIC = b"BCEJRNL1"
+_EPOCH_HDR = struct.Struct("<QQQQQdQ")
+
+
+def _pack_pair_blob(pairs) -> bytes:
+    """Python fallback packer; ``NativePairInterner.pair_blob`` is the
+    C fast path producing identical bytes (internmap.c)."""
+    parts: List[bytes] = []
+    for source_id, market_id in pairs:
+        src = source_id.encode("utf-8")
+        mkt = market_id.encode("utf-8")
+        parts.append(struct.pack("<I", len(src)))
+        parts.append(src)
+        parts.append(struct.pack("<I", len(mkt)))
+        parts.append(mkt)
+    return b"".join(parts)
+
+
+def _pack_iso_blob(iso_values: List[str]) -> bytes:
+    """One C pass when the extension is built (measured: the per-row
+    Python struct.pack loop cost ~seconds per million rows and dominated
+    a journal epoch); identical bytes either way."""
+    from bayesian_consensus_engine_tpu.utils.interning import (
+        pack_strings_native,
+    )
+
+    blob = pack_strings_native(iso_values)
+    if blob is not None:
+        return blob
+    parts: List[bytes] = []
+    for value in iso_values:
+        raw = value.encode("utf-8")
+        parts.append(struct.pack("<I", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+class JournalWriter:
+    """Appends epochs to one journal file.
+
+    A fresh path starts a new journal recording ONE store lifetime from
+    its attach point — on attach to a non-empty store,
+    :meth:`~.tensor_store.TensorReliabilityStore.flush_to_journal` makes
+    the first epoch a full snapshot, so replay never needs an external
+    base. An EXISTING non-empty journal is never truncated: opening one
+    raises unless ``resume=True``, which scans the valid epochs (exactly
+    as replay would), drops any torn tail, and appends after them — the
+    crash-recovery shape: ``store, tag = replay_journal(path)`` then
+    ``settle_stream(store, batches[tag + 1:],
+    journal=JournalWriter(path, resume=True))``. ``fsync=True``
+    (default) makes each epoch durable before the call returns — that is
+    the point of a durability journal; pass ``False`` only for
+    benchmarking the format itself.
+    """
+
+    def __init__(self, path: Union[str, Path], fsync: bool = True,
+                 resume: bool = False) -> None:
+        self._path = str(path)
+        self._fsync = fsync
+        existing = (
+            os.path.exists(self._path) and os.path.getsize(self._path) > 0
+        )
+        if existing and not resume:
+            raise ValueError(
+                f"{self._path} already holds a journal; refusing to "
+                "truncate durable epochs — replay it and pass "
+                "resume=True, or use a fresh path"
+            )
+        if existing:
+            valid_end, epochs, rows, _tag = _scan_valid_end(self._path)
+            self._file = open(self._path, "r+b")
+            try:
+                # Drop a torn tail (crash mid-append) before appending:
+                # the next epoch index must be dense from the valid
+                # prefix replay will actually see.
+                self._file.truncate(valid_end)
+                self._file.seek(valid_end)
+            except Exception:
+                self._file.close()
+                raise
+            self.epoch_index = epochs
+            self.rows_covered = rows
+            return
+        self._file = open(self._path, "wb")
+        try:
+            self._file.write(MAGIC)
+            self._file.flush()
+            if fsync:
+                os.fsync(self._file.fileno())
+        except Exception:
+            self._file.close()
+            raise
+        self.epoch_index = 0
+        self.rows_covered = 0  # pairs journaled so far (= used_after)
+
+    def append_epoch(
+        self,
+        used_after: int,
+        new_pairs,
+        idx: np.ndarray,
+        rel: np.ndarray,
+        conf: np.ndarray,
+        days: np.ndarray,
+        exists: np.ndarray,
+        iso_values,
+        tag: int = 0,
+    ) -> None:
+        """Append one epoch; atomic at replay granularity (CRC + lengths).
+
+        *new_pairs* must cover rows ``[self.rows_covered, used_after)`` in
+        row order — as an iterable of ``(source, market)`` pairs, or as
+        already-wire-format bytes (the C ``pair_blob`` fast path). *idx*
+        rows all < *used_after*.
+        """
+        if used_after < self.rows_covered:
+            raise ValueError(
+                f"used_after={used_after} < rows already journaled "
+                f"({self.rows_covered})"
+            )
+        pair_blob = (
+            new_pairs if isinstance(new_pairs, bytes)
+            else _pack_pair_blob(new_pairs)
+        )
+        iso_blob = _pack_iso_blob(iso_values)
+        dirty = int(len(idx))
+        if not (len(rel) == len(conf) == len(days) == len(exists)
+                == len(iso_values) == dirty):
+            raise ValueError("column length mismatch")
+        header = _EPOCH_HDR.pack(
+            self.epoch_index, used_after, len(pair_blob), dirty,
+            len(iso_blob), time.time(), tag,
+        )
+        payload = b"".join(
+            (
+                header,
+                pair_blob,
+                np.ascontiguousarray(idx, dtype=np.uint64).tobytes(),
+                np.ascontiguousarray(rel, dtype=np.float64).tobytes(),
+                np.ascontiguousarray(conf, dtype=np.float64).tobytes(),
+                np.ascontiguousarray(days, dtype=np.float64).tobytes(),
+                np.ascontiguousarray(exists, dtype=np.uint8).tobytes(),
+                iso_blob,
+            )
+        )
+        self._file.write(payload)
+        self._file.write(struct.pack("<I", zlib.crc32(payload)))
+        self._file.flush()
+        if self._fsync:
+            os.fsync(self._file.fileno())
+        self.epoch_index += 1
+        self.rows_covered = used_after
+
+    def close(self) -> None:
+        if not self._file.closed:
+            self._file.close()
+
+    def __enter__(self) -> "JournalWriter":
+        return self
+
+    def __exit__(self, *exc_info: object) -> None:
+        self.close()
+
+
+def _read_exact(f, n: int) -> Optional[bytes]:
+    data = f.read(n)
+    return data if len(data) == n else None
+
+
+def _unpack_pairs(blob: bytes, count: int) -> Optional[List[Tuple[str, str]]]:
+    pairs: List[Tuple[str, str]] = []
+    off = 0
+    for _ in range(count):
+        if off + 4 > len(blob):
+            return None
+        (n,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        if off + n > len(blob):
+            return None
+        src = blob[off:off + n].decode("utf-8")
+        off += n
+        if off + 4 > len(blob):
+            return None
+        (n,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        if off + n > len(blob):
+            return None
+        mkt = blob[off:off + n].decode("utf-8")
+        off += n
+        pairs.append((src, mkt))
+    if off != len(blob):
+        return None
+    return pairs
+
+
+def _unpack_iso(blob: bytes, count: int) -> Optional[List[str]]:
+    values: List[str] = []
+    off = 0
+    for _ in range(count):
+        if off + 4 > len(blob):
+            return None
+        (n,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        if off + n > len(blob):
+            return None
+        values.append(blob[off:off + n].decode("utf-8"))
+        off += n
+    if off != len(blob):
+        return None
+    return values
+
+
+def _iter_frames(f):
+    """Yield ``(header_fields, body, end_offset)`` for each complete,
+    CRC-valid epoch in order, stopping at the first torn or corrupt
+    frame — replay and resume-scan share this walk, so what resume
+    appends after is exactly what replay will see."""
+    expected_epoch = 0
+    expected_rows = 0
+    while True:
+        header = _read_exact(f, _EPOCH_HDR.size)
+        if header is None:
+            return  # clean end (or torn mid-header): stop here
+        fields = _EPOCH_HDR.unpack(header)
+        (epoch_index, used_after, pair_blob_len, dirty, iso_blob_len,
+         _wall, _tag) = fields
+        if epoch_index != expected_epoch or used_after < expected_rows:
+            return  # corrupt header: treat as torn tail
+        columns_len = dirty * (8 + 8 + 8 + 8 + 1)
+        body = _read_exact(f, pair_blob_len + columns_len + iso_blob_len)
+        if body is None:
+            return
+        crc_raw = _read_exact(f, 4)
+        if crc_raw is None:
+            return
+        (crc,) = struct.unpack("<I", crc_raw)
+        if zlib.crc32(header + body) != crc:
+            return
+        yield fields, body, f.tell()
+        expected_epoch += 1
+        expected_rows = used_after
+
+
+def _scan_valid_end(path):
+    """(valid_byte_end, epoch_count, rows_covered, last_tag) of a journal."""
+    with open(path, "rb") as f:
+        if _read_exact(f, len(MAGIC)) != MAGIC:
+            raise ValueError(f"{path}: not a BCE journal (bad magic)")
+        end = f.tell()
+        epochs = 0
+        rows = 0
+        tag = None
+        for fields, _body, off in _iter_frames(f):
+            end = off
+            epochs += 1
+            rows = fields[1]
+            tag = int(fields[6])
+        return end, epochs, rows, tag
+
+
+def replay_journal(path: Union[str, Path]):
+    """Rebuild a store from a journal: ``(store, last_tag)``.
+
+    Replays complete epochs in order; a truncated or CRC-failing tail
+    epoch (crash mid-append) is dropped. ``last_tag`` is the last
+    complete epoch's ``tag`` watermark (``None`` when the journal holds
+    no complete epoch): with :func:`~.pipeline.settle_stream`'s
+    ``journal=`` mode that is the last durably-covered settled batch
+    index — resume from ``batches[last_tag + 1:]``.
+    """
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    store = TensorReliabilityStore()
+    last_tag = None
+    with open(path, "rb") as f:
+        if _read_exact(f, len(MAGIC)) != MAGIC:
+            raise ValueError(f"{path}: not a BCE journal (bad magic)")
+        expected_rows = 0
+        for fields, body, _off in _iter_frames(f):
+            (_epoch_index, used_after, pair_blob_len, dirty, _iso_blob_len,
+             _wall, tag) = fields
+            pairs = _unpack_pairs(
+                body[:pair_blob_len], used_after - expected_rows
+            )
+            off = pair_blob_len
+            idx = np.frombuffer(body, np.uint64, dirty, off)
+            off += dirty * 8
+            rel = np.frombuffer(body, np.float64, dirty, off)
+            off += dirty * 8
+            conf = np.frombuffer(body, np.float64, dirty, off)
+            off += dirty * 8
+            days = np.frombuffer(body, np.float64, dirty, off)
+            off += dirty * 8
+            exists = np.frombuffer(body, np.uint8, dirty, off)
+            off += dirty
+            iso_values = _unpack_iso(body[off:], dirty)
+            if pairs is None or iso_values is None or (
+                dirty and idx.max() >= used_after
+            ):
+                break  # malformed epoch that still passed CRC-of-garbage
+            store._apply_journal_epoch(
+                used_after, pairs, idx.astype(np.int64), rel, conf, days,
+                exists.astype(bool), iso_values,
+            )
+            last_tag = int(tag)
+            expected_rows = used_after
+    return store, last_tag

@@ -202,6 +202,11 @@ class TensorReliabilityStore:
         # (reference semantics: UPSERT only what changed, reliability.py:221-231).
         self._dirty = np.zeros(capacity, dtype=bool)
         self._last_flush_path: Optional[str] = None
+        # Separate dirty tracking for the durability journal
+        # (state/journal.py): journal epochs and SQLite flushes are
+        # independent tiers — a journal epoch must not steal rows from
+        # the next SQLite checkpoint or vice versa.
+        self._journal_dirty = np.zeros(capacity, dtype=bool)
         # Host-tier thread safety (see _locked): one reentrant lock over
         # every public host-side method, so plan-building ingest threads,
         # settle-side host reads, and checkpoint bookkeeping can interleave
@@ -231,6 +236,7 @@ class TensorReliabilityStore:
         self._days = grow(self._days, NEVER)
         self._exists = grow(self._exists, False)
         self._dirty = grow(self._dirty, False)
+        self._journal_dirty = grow(self._journal_dirty, False)
 
     def _row_for(self, source_id: str, market_id: str) -> int:
         """Row for a pair, allocating (but NOT marking existing) if new."""
@@ -382,9 +388,9 @@ class TensorReliabilityStore:
 
         newly_existing = ~self._exists[touched]
         self._exists[touched] = True
-        self._dirty[
-            touched[rel_changed | stamps_changed | newly_existing]
-        ] = True
+        changed = touched[rel_changed | stamps_changed | newly_existing]
+        self._dirty[changed] = True
+        self._journal_dirty[changed] = True
         changed_rows = touched[stamps_changed]
         if changed_rows.size:
             iso_value = days_to_iso(stamp_abs)
@@ -473,6 +479,7 @@ class TensorReliabilityStore:
         self._exists[row] = True
         self._iso[row] = record.updated_at
         self._dirty[row] = True
+        self._journal_dirty[row] = True
         self._invalidate()
 
     @_locked
@@ -652,6 +659,7 @@ class TensorReliabilityStore:
         self._days[rows] = stamp_days
         self._exists[rows] = True
         self._dirty[rows] = True
+        self._journal_dirty[rows] = True
         for row in rows:
             self._iso[row] = stamp_iso
         self._invalidate()
@@ -700,6 +708,7 @@ class TensorReliabilityStore:
         """
         self._conf[rows] = values
         self._dirty[rows] = True
+        self._journal_dirty[rows] = True
         if self._pending is None:
             self._invalidate()
         # With a pending settled state the cache stays: host confidences
@@ -1092,8 +1101,10 @@ class TensorReliabilityStore:
         self._exists[idx] = new_exists
         if isinstance(idx, slice):
             self._dirty[idx] |= touched
+            self._journal_dirty[idx] |= touched
         else:
             self._dirty[idx[touched]] = True
+            self._journal_dirty[idx[touched]] = True
         # A settlement stamps every touched row with the same handful of day
         # values, so format each UNIQUE stamp once instead of running the
         # datetime formatter per row (it dominated absorb at 500k rows).
@@ -1450,6 +1461,87 @@ class TensorReliabilityStore:
     # they are host data either way, and JSON encode + intern_all is far
     # cheaper than SQLite's per-row execute. Exact f64 host values
     # round-trip bit-identically.
+
+    @_locked
+    def flush_to_journal(self, journal, tag: int = 0) -> int:
+        """Append one durability epoch to *journal* (state/journal.py).
+
+        Resolves pending device results first (same drain semantics as an
+        eager SQLite flush — the epoch's content is the store's truth as
+        of this call), then appends only the rows dirtied since the LAST
+        journal epoch plus any newly interned pairs. Journal dirtiness is
+        tracked separately from SQLite dirtiness: an epoch here never
+        shrinks the next :meth:`flush_to_sqlite` and vice versa. The
+        first epoch on a journal is a full snapshot, so replay is
+        self-contained even when the journal is attached to a non-empty
+        store. Returns the number of rows written. *tag* is the replay
+        watermark (:func:`~.state.journal.replay_journal` returns the
+        last complete epoch's tag — settle_stream passes the settled
+        batch index).
+        """
+        self._sync_pending()
+        self._resync_sidecars()
+        used = len(self._pairs)
+        if used < journal.rows_covered:
+            raise ValueError(
+                f"store holds {used} rows but the journal already covers "
+                f"{journal.rows_covered} — resume a journal only with a "
+                "store replayed from it"
+            )
+        if journal.epoch_index == 0:
+            select = self._exists[:used] | self._journal_dirty[:used]
+        else:
+            select = self._journal_dirty[:used]
+        idx = np.flatnonzero(select)
+        if hasattr(self._pairs, "pair_blob"):
+            # C fast path: wire-format bytes straight from the key arena.
+            new_pairs = self._pairs.pair_blob(journal.rows_covered, used)
+        else:
+            new_pairs = [
+                self._pairs.id_of(r) for r in range(journal.rows_covered, used)
+            ]
+        iso = self._iso
+        journal.append_epoch(
+            used,
+            new_pairs,
+            idx,
+            self._rel[idx],
+            self._conf[idx],
+            self._days[idx],
+            self._exists[idx],
+            [iso[i] for i in idx.tolist()],
+            tag=tag,
+        )
+        self._journal_dirty[:used] = False
+        return int(idx.size)
+
+    def _apply_journal_epoch(
+        self, used_after, pairs, idx, rel, conf, days, exists, iso_values
+    ) -> None:
+        """Replay hook for :func:`~.state.journal.replay_journal` (same-
+        package private): intern the epoch's new pairs in row order —
+        which reproduces the original row assignment — then overwrite the
+        epoch's dirty rows."""
+        with self._host_lock:
+            before = len(self._pairs)
+            rows = self._pairs.intern_all(pairs)
+            if rows != list(range(before, used_after)):
+                raise ValueError(
+                    "journal pairs do not extend the store contiguously "
+                    f"(rows {before}..{used_after} expected)"
+                )
+            self._ensure_capacity(max(used_after, 1))
+            self._resync_sidecars()
+            self._rel[idx] = rel
+            self._conf[idx] = conf
+            self._days[idx] = days
+            self._exists[idx] = exists
+            iso = self._iso
+            for row, value in zip(idx.tolist(), iso_values):
+                iso[row] = value
+            self._dirty[idx] = True
+            self._journal_dirty[idx] = True
+            self._invalidate()
 
     @_locked
     def save_checkpoint(self, directory: Union[str, Path], step: int = 0) -> None:

@@ -147,6 +147,11 @@ class NativePairInterner:
     def items(self):
         return [(key, row) for row, key in enumerate(self._map.ids())]
 
+    def pair_blob(self, lo: int, hi: int) -> bytes:
+        """Rows [lo, hi) in the durability journal's pair wire format
+        (state/journal.py) — one C memcpy pass over the key arena."""
+        return self._map.pair_blob(lo, hi)
+
     def intern_arrays(
         self, sources: Sequence[str], markets: Sequence[str]
     ) -> np.ndarray:
@@ -249,3 +254,13 @@ def make_pair_interner():
     if module is None:
         return IdInterner()
     return NativePairInterner(module)
+
+
+def pack_strings_native(values: List[str]) -> "bytes | None":
+    """u32-length-prefixed UTF-8 blob via the C extension, or ``None``
+    when it is not built (the journal falls back to Python packing —
+    same bytes, ~100x slower per million rows)."""
+    module = _load_internmap()
+    if module is None:
+        return None
+    return module.pack_strings(values)

@@ -1,0 +1,373 @@
+"""Durability journal (state/journal.py): binary epochs, replay, torn
+tails, and the settle_stream(journal=) rolling tier.
+
+The journal exists because rolling SQLite checkpoints floor near
+~200-300k rows/s (the interchange format's text-PK UPSERT — measured
+11.8 s of a 21.7 s stream wall on-chip, docs/round5-notes.md); an epoch
+appends the same rows as raw fsynced columns. The non-negotiable
+contracts pinned here: replay reproduces the store EXACTLY (values, ISO
+strings, row assignment), a torn tail never corrupts — the journal is
+valid through the last complete epoch and reports its watermark — and
+journal mode changes nothing about the stream's results or its SQLite
+interchange file.
+"""
+
+import random
+import sqlite3
+import struct
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from bayesian_consensus_engine_tpu.state import JournalWriter, replay_journal
+from bayesian_consensus_engine_tpu.state.records import ReliabilityRecord
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+
+def db_records(path):
+    with sqlite3.connect(path) as conn:
+        return conn.execute(
+            "SELECT source_id, market_id, reliability, confidence, updated_at"
+            " FROM sources ORDER BY source_id, market_id"
+        ).fetchall()
+
+
+def store_fingerprint(store):
+    """Everything replay must reproduce: records AND row assignment."""
+    store.sync()
+    return (store.list_sources(), store._pairs.ids())
+
+
+def seeded_store(n=40, seed=3):
+    rng = random.Random(seed)
+    store = TensorReliabilityStore()
+    for i in range(n):
+        store.put_record(
+            ReliabilityRecord(
+                source_id=f"src-{i % 7}",
+                market_id=f"mkt-{i}",
+                reliability=round(rng.random(), 6),
+                confidence=round(rng.random(), 6),
+                updated_at=f"2026-07-{10 + (i % 19):02d}T12:00:00+00:00",
+            )
+        )
+    return store
+
+
+class TestJournalRoundTrip:
+    def test_single_epoch_replay_exact(self, tmp_path):
+        store = seeded_store()
+        path = tmp_path / "a.jrnl"
+        with JournalWriter(path) as journal:
+            rows = store.flush_to_journal(journal, tag=7)
+        assert rows == len(store)
+        replayed, tag = replay_journal(path)
+        assert tag == 7
+        assert store_fingerprint(replayed) == store_fingerprint(store)
+
+    def test_incremental_epochs_write_only_dirty(self, tmp_path):
+        store = seeded_store(n=30)
+        path = tmp_path / "b.jrnl"
+        with JournalWriter(path) as journal:
+            first = store.flush_to_journal(journal, tag=0)
+            assert first == 30
+            # Touch 3 rows + add 2 new pairs: the next epoch is exactly
+            # those 5 rows, not a re-snapshot.
+            for i in (4, 9, 11):
+                store.update_reliability(f"src-{i % 7}", f"mkt-{i}", True)
+            store.put_record(
+                ReliabilityRecord(
+                    source_id="src-new",
+                    market_id="mkt-new-1",
+                    reliability=0.625,
+                    confidence=0.5,
+                    updated_at="2026-07-31T00:00:00+00:00",
+                )
+            )
+            store.put_record(
+                ReliabilityRecord(
+                    source_id="src-new",
+                    market_id="mkt-new-2",
+                    reliability=0.125,
+                    confidence=0.75,
+                    updated_at="2026-07-31T01:00:00+00:00",
+                )
+            )
+            second = store.flush_to_journal(journal, tag=1)
+            assert second == 5
+            # Nothing dirty: an empty epoch is legal and cheap.
+            assert store.flush_to_journal(journal, tag=2) == 0
+        replayed, tag = replay_journal(path)
+        assert tag == 2
+        assert store_fingerprint(replayed) == store_fingerprint(store)
+
+    def test_journal_dirty_is_independent_of_sqlite_dirty(self, tmp_path):
+        store = seeded_store(n=12)
+        path = tmp_path / "c.jrnl"
+        db = tmp_path / "c.db"
+        with JournalWriter(path) as journal:
+            store.flush_to_journal(journal, tag=0)
+            # A journal epoch must not shrink the next SQLite flush...
+            store.flush_to_sqlite(db)
+            assert db_records(db) != []
+            # ...and an SQLite flush must not shrink the next epoch.
+            store.update_reliability("src-1", "mkt-1", False)
+            store.flush_to_sqlite(db)
+            assert store.flush_to_journal(journal, tag=1) == 1
+        replayed, _ = replay_journal(path)
+        assert store_fingerprint(replayed) == store_fingerprint(store)
+
+    def test_replayed_store_flushes_full_sqlite(self, tmp_path):
+        # Replay marks rows dirty in the NEW store's lifetime, so its
+        # first SQLite flush writes the complete interchange file.
+        store = seeded_store(n=9)
+        path = tmp_path / "d.jrnl"
+        with JournalWriter(path) as journal:
+            store.flush_to_journal(journal)
+        replayed, _ = replay_journal(path)
+        replayed.flush_to_sqlite(tmp_path / "replayed.db")
+        store.flush_to_sqlite(tmp_path / "orig.db")
+        assert db_records(tmp_path / "replayed.db") == db_records(
+            tmp_path / "orig.db"
+        )
+
+
+class TestTornTail:
+    def _two_epoch_journal(self, tmp_path):
+        store = seeded_store(n=20)
+        path = tmp_path / "torn.jrnl"
+        with JournalWriter(path) as journal:
+            store.flush_to_journal(journal, tag=0)
+            store.update_reliability("src-2", "mkt-2", True)
+            store.flush_to_journal(journal, tag=1)
+        return path, store
+
+    def test_truncated_tail_drops_last_epoch_only(self, tmp_path):
+        path, store = self._two_epoch_journal(tmp_path)
+        raw = path.read_bytes()
+        path.write_bytes(raw[:-7])  # torn mid-CRC of epoch 1
+        replayed, tag = replay_journal(path)
+        assert tag == 0
+        # Epoch 0's content is intact: same pairs, epoch-0 values.
+        assert len(replayed) == len(store)
+
+    def test_corrupt_byte_fails_crc_and_drops_epoch(self, tmp_path):
+        path, _ = self._two_epoch_journal(tmp_path)
+        raw = bytearray(path.read_bytes())
+        raw[-20] ^= 0xFF  # inside epoch 1's payload
+        path.write_bytes(bytes(raw))
+        _, tag = replay_journal(path)
+        assert tag == 0
+
+    def test_resume_truncates_torn_tail_and_appends(self, tmp_path):
+        path, store = self._two_epoch_journal(tmp_path)
+        raw = path.read_bytes()
+        path.write_bytes(raw[:-7])  # torn mid-CRC of epoch 1
+        # Resume drops the torn epoch 1, then appends a fresh epoch whose
+        # index is dense with the valid prefix.
+        with JournalWriter(path, resume=True) as journal:
+            assert journal.epoch_index == 1
+            store._journal_dirty[:] = False
+            store.update_reliability("src-3", "mkt-3", True)
+            store.flush_to_journal(journal, tag=9)
+        replayed, tag = replay_journal(path)
+        assert tag == 9
+        rec = {
+            (r.source_id, r.market_id): r for r in replayed.list_sources()
+        }
+        live = {
+            (r.source_id, r.market_id): r for r in store.list_sources()
+        }
+        assert rec[("src-3", "mkt-3")] == live[("src-3", "mkt-3")]
+
+    def test_store_behind_journal_rejected(self, tmp_path):
+        path, _ = self._two_epoch_journal(tmp_path)
+        with JournalWriter(path, resume=True) as journal:
+            with pytest.raises(ValueError, match="journal already covers"):
+                TensorReliabilityStore().flush_to_journal(journal)
+
+    def test_empty_journal_replays_to_empty_store(self, tmp_path):
+        path = tmp_path / "empty.jrnl"
+        JournalWriter(path).close()
+        store, tag = replay_journal(path)
+        assert tag is None and len(store) == 0
+
+    def test_bad_magic_raises(self, tmp_path):
+        path = tmp_path / "bad.jrnl"
+        path.write_bytes(b"NOTAJRNL" + b"\x00" * 32)
+        with pytest.raises(ValueError, match="magic"):
+            replay_journal(path)
+
+
+def random_payloads(rng, num_markets, universe=15, max_signals=5, tag=""):
+    payloads = []
+    for m in range(num_markets):
+        n = rng.randint(1, max_signals)
+        signals = [
+            {
+                "sourceId": f"src-{rng.randrange(universe)}",
+                "probability": round(rng.random(), 6),
+            }
+            for _ in range(n)
+        ]
+        payloads.append((f"jm{tag}-{m}", signals))
+    return payloads
+
+
+def stream_batches(num_batches=4, markets=9, seed=61):
+    rng = random.Random(seed)
+    out = []
+    for b in range(num_batches):
+        payloads = random_payloads(rng, markets, tag=f"-b{b}")
+        outcomes = [rng.random() < 0.5 for _ in range(markets)]
+        out.append((payloads, outcomes))
+    return out
+
+
+class TestSettleStreamJournal:
+    def _run(self, batches, db=None, journal=None, **kw):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store, batches, steps=2, now=21_300.0, db_path=db,
+                journal=journal, **kw,
+            )
+        )
+        return store, results
+
+    def test_journal_mode_matches_plain_stream_and_replays(self, tmp_path):
+        batches = stream_batches()
+        plain_store, plain_results = self._run(
+            batches, db=tmp_path / "plain.db"
+        )
+        store, results = self._run(
+            batches, db=tmp_path / "stream.db",
+            journal=tmp_path / "s.jrnl", checkpoint_every=2,
+        )
+        for mine, ref in zip(results, plain_results):
+            np.testing.assert_array_equal(mine.consensus, ref.consensus)
+        # The interchange file is unchanged by journal mode.
+        assert db_records(tmp_path / "stream.db") == db_records(
+            tmp_path / "plain.db"
+        )
+        # The journal's durable truth equals the live store, watermarked
+        # at the last settled batch.
+        replayed, tag = replay_journal(tmp_path / "s.jrnl")
+        assert tag == len(batches) - 1
+        assert store_fingerprint(replayed) == store_fingerprint(store)
+
+    def test_journal_only_mode_needs_no_db(self, tmp_path):
+        batches = stream_batches(num_batches=3)
+        store, _ = self._run(batches, journal=tmp_path / "only.jrnl")
+        replayed, tag = replay_journal(tmp_path / "only.jrnl")
+        assert tag == 2
+        assert store_fingerprint(replayed) == store_fingerprint(store)
+
+    def test_break_recovery_resumes_from_watermark(self, tmp_path):
+        # Consumer dies after 2 of 5 batches; replay + resume from
+        # tag+1 must equal the uninterrupted run exactly.
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        batches = stream_batches(num_batches=5)
+        full_store, _ = self._run(batches)
+
+        store = TensorReliabilityStore()
+        stream = settle_stream(
+            store, batches, steps=2, now=21_300.0,
+            journal=tmp_path / "r.jrnl",
+        )
+        for i, _result in enumerate(stream):
+            if i == 1:
+                stream.close()  # GeneratorExit -> tail epoch, tag=1
+                break
+        replayed, tag = replay_journal(tmp_path / "r.jrnl")
+        assert tag == 1
+        # Resume APPENDS to the same journal (resume=True); a bare path
+        # must refuse rather than truncate durable epochs.
+        with pytest.raises(ValueError, match="refusing to truncate"):
+            JournalWriter(tmp_path / "r.jrnl")
+        resumed = list(
+            settle_stream(
+                replayed, batches[tag + 1:], steps=2,
+                now=21_300.0 + tag + 1,
+                journal=JournalWriter(tmp_path / "r.jrnl", resume=True),
+            )
+        )
+        assert len(resumed) == 3
+        assert store_fingerprint(replayed) == store_fingerprint(full_store)
+        # The appended-to journal now replays to the COMPLETE run.
+        replayed2, tag2 = replay_journal(tmp_path / "r.jrnl")
+        assert tag2 == 2  # resumed stream's own batch indices (0-based)
+        assert store_fingerprint(replayed2) == store_fingerprint(full_store)
+
+    def test_sharded_stream_journal_matches_flat(self, tmp_path):
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+
+        batches = stream_batches(num_batches=3, seed=71)
+        flat_store, flat_results = self._run(batches)
+        store, results = self._run(
+            batches, journal=tmp_path / "m.jrnl", mesh=make_mesh(),
+            checkpoint_every=2,
+        )
+        for mine, ref in zip(results, flat_results):
+            np.testing.assert_array_equal(mine.consensus, ref.consensus)
+        replayed, tag = replay_journal(tmp_path / "m.jrnl")
+        assert tag == 2
+        assert store_fingerprint(replayed) == store_fingerprint(store)
+
+    def test_lazy_checkpoints_rejected_with_journal(self, tmp_path):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        with pytest.raises(ValueError, match="lazy"):
+            list(
+                settle_stream(
+                    TensorReliabilityStore(), [],
+                    journal=tmp_path / "x.jrnl", lazy_checkpoints=True,
+                )
+            )
+
+    def test_settle_raise_never_claims_failed_batch(self, tmp_path):
+        # A batch that raises mid-settle must not be covered by the tail
+        # epoch: the journal watermark stops at the last SETTLED batch.
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        batches = stream_batches(num_batches=3, seed=81)
+        bad = (batches[1][0], batches[1][1][:2])  # truncated outcomes
+        store = TensorReliabilityStore()
+        with pytest.raises(Exception):
+            list(
+                settle_stream(
+                    store, [batches[0], bad, batches[2]], steps=1,
+                    now=21_400.0, journal=tmp_path / "f.jrnl",
+                )
+            )
+        _, tag = replay_journal(tmp_path / "f.jrnl")
+        assert tag == 0
+
+
+class TestWriterValidation:
+    def test_used_after_regression_rejected(self, tmp_path):
+        with JournalWriter(tmp_path / "v.jrnl") as journal:
+            store = seeded_store(n=4)
+            store.flush_to_journal(journal)
+            with pytest.raises(ValueError, match="used_after"):
+                journal.append_epoch(
+                    2, [], np.array([], np.int64), np.array([]),
+                    np.array([]), np.array([]), np.array([], np.uint8),
+                    [],
+                )
+
+    def test_column_length_mismatch_rejected(self, tmp_path):
+        with JournalWriter(tmp_path / "w.jrnl") as journal:
+            with pytest.raises(ValueError, match="length"):
+                journal.append_epoch(
+                    0, [], np.array([0], np.int64), np.array([0.5]),
+                    np.array([]), np.array([0.0]), np.array([1], np.uint8),
+                    ["x"],
+                )
