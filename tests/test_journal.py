@@ -14,7 +14,6 @@ interchange file.
 
 import random
 import sqlite3
-import struct
 
 import numpy as np
 import pytest
