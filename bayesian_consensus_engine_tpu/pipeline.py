@@ -1233,11 +1233,18 @@ def settle_stream(
 
     Sizing note: every ×2 growth of the store's capacity ladder compiles
     a fresh settle program (the flat state's shape changes). A service
-    that knows its scale should pre-size —
-    ``TensorReliabilityStore(capacity=expected_rows)`` — which skipped
-    every growth recompile and cut a 30-batch/1.5M-row cold stream from
-    14.6 to 9.7 s in the round-5 host measurement. (The ``mesh=`` path
-    is immune: its per-batch block shapes never depend on store size.)
+    that knows its scale can pre-size —
+    ``TensorReliabilityStore(capacity=expected_rows)`` — but whether
+    that pays depends entirely on compile cost: pre-sizing cut a
+    30-batch/1.5M-row COLD stream from 14.6 to 9.7 s (round-5 host
+    measurement), yet with a warm persistent compile cache it LOSES
+    (measured on-chip 2026-07-31, ``e2e_stream`` ``journal_presized``
+    vs ``journal``: 0.84 vs 1.06 amortised 1M-cycles/sec) — the
+    pre-sized program pays capacity-length gather/scatter from batch 1
+    while the ladder's early batches run over small state and its
+    recompiles are cache hits. Pre-size only when compiles are cold and
+    uncached. (The ``mesh=`` path sidesteps the trade: its per-batch
+    block shapes never depend on store size.)
 
     *batches* yields ``(payloads, outcomes)`` pairs — with
     ``columnar=True``, ``((market_keys, source_ids, probabilities,
