@@ -201,11 +201,21 @@ stream_batches = [
     (pays[blo:min(bhi, M)], outs[blo:min(bhi, M)])
     for pays, outs in stream_full
 ]
+# Rolling durability rides a PER-PROCESS journal (each process's store
+# is its own band; there is no cross-process state to journal) — replay
+# must reproduce this process's live store exactly.
+from bayesian_consensus_engine_tpu.state.journal import replay_journal
+
+stream_jrnl = str(pathlib.Path(outdir, f"stream_{{pid}}.jrnl"))
 stream_results = list(settle_stream(
     stream_store, stream_batches, steps=2, now=20760.0,
-    mesh=mesh, band=(blo, M), num_slots=4,
+    mesh=mesh, band=(blo, M), num_slots=4, journal=stream_jrnl,
 ))
 stream_store.sync()
+replayed_store, stream_journal_tag = replay_journal(stream_jrnl)
+stream_journal_ok = (
+    replayed_store.list_sources() == stream_store.list_sources()
+)
 
 band = {{
     "pid": pid,
@@ -219,6 +229,8 @@ band = {{
         [r.source_id, r.market_id, r.reliability, r.confidence, r.updated_at]
         for r in stream_store.list_sources()
     ],
+    "stream_journal_ok": stream_journal_ok,
+    "stream_journal_tag": stream_journal_tag,
     "consensus": np.asarray(local_view(result.consensus)).tolist(),
     "reliability": np.asarray(local_view(result.state.reliability)).tolist(),
     "loop_consensus": np.asarray(local_view(loop_consensus)).tolist(),
@@ -674,6 +686,10 @@ class TestTwoProcessCluster:
 
         union = {}
         for band in worker_bands:
+            # Each process's journal replayed to its own live band store
+            # inside the worker, watermarked at the last batch.
+            assert band["stream_journal_ok"] is True
+            assert band["stream_journal_tag"] == 2
             for sid, mid, rel, conf, iso in band["stream_records"]:
                 assert (sid, mid) not in union, "band stream stores overlap"
                 union[(sid, mid)] = (rel, conf, iso)
