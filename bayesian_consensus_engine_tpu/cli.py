@@ -11,14 +11,18 @@ exit code 1. Preserved quirks: ``--input`` exists both top-level and on the
 consensus subcommand (quirk #13); DB subcommand failures print ``Error: ...``
 and exit 1.
 
-Extension (additive, does not change reference-shaped outputs): ``--backend
-{python,jax,tpu}`` selects the consensus engine implementation.
+Extensions (additive, do not change reference-shaped outputs): ``--backend
+{python,jax,tpu}`` selects the consensus engine implementation;
+``journal-export JRNL`` replays a ``settle_stream`` durability journal
+(state/journal.py) and exports the reference-compatible SQLite file to
+``--db`` — the crash-recovery path without writing Python.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Any
 
@@ -122,6 +126,59 @@ def _run_report_outcome(args: argparse.Namespace) -> None:
         raise SystemExit(1) from exc
 
 
+def _run_journal_export(args: argparse.Namespace) -> None:
+    """Replay a durability journal and export the SQLite interchange file.
+
+    Additive maintenance command (no reference counterpart): the
+    crash-recovery path for a service that streamed with
+    ``settle_stream(journal=...)`` — replay the fsynced epochs (torn
+    tails dropped), report the durable watermark, and write the
+    reference-compatible SQLite file. Honors the global ``--dry-run``
+    (replay + report, never write) and ``--db`` (the export target).
+    """
+    if not args.db and not args.dry_run:
+        print(
+            "Error: --db is required for journal-export (or use --dry-run)",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    if (
+        not args.dry_run
+        and os.path.exists(args.db)
+        and os.path.getsize(args.db) > 0
+    ):
+        # An export must EQUAL the recovered journal state; flushing into
+        # an existing file would UPSERT-merge and leave stale rows the
+        # journal never held.
+        print(
+            f"Error: export target {args.db} already exists — "
+            "journal-export writes a fresh interchange file",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    try:
+        from bayesian_consensus_engine_tpu.state.journal import replay_journal
+
+        store, tag = replay_journal(args.journal)
+        rows = store.live_row_count()
+        exported = None
+        if not args.dry_run:
+            store.flush_to_sqlite(args.db)
+            exported = args.db
+        _emit(
+            {
+                "journal": args.journal,
+                "epochTag": tag,
+                "rows": rows,
+                "exportedTo": exported,
+                "dryRun": args.dry_run,
+            }
+        )
+    except Exception as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+
+
 def _run_list_sources(args: argparse.Namespace) -> None:
     if not args.db:
         print("Error: --db is required for list-sources", file=sys.stderr)
@@ -216,6 +273,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--market-id", help="restrict the listing to one market"
     )
     listing.set_defaults(handler=_run_list_sources)
+
+    journal = sub.add_parser(
+        "journal-export",
+        help=(
+            "replay a settle_stream durability journal and export the "
+            "SQLite interchange file to --db"
+        ),
+    )
+    journal.add_argument(
+        "journal", help="path to the journal written by settle_stream"
+    )
+    journal.set_defaults(handler=_run_journal_export)
 
     return parser
 

@@ -219,6 +219,13 @@ class TensorReliabilityStore:
     def __len__(self) -> int:
         return len(self._pairs)
 
+    @_locked
+    def live_row_count(self) -> int:
+        """Rows with a live record (``exists``) — what ``list_sources``
+        would return, without materialising and sorting the records."""
+        self._sync_pending()
+        return int(self._exists[: len(self._pairs)].sum())
+
     def _ensure_capacity(self, needed: int) -> None:
         if needed <= len(self._rel):
             return

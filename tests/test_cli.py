@@ -252,3 +252,101 @@ class TestListSources:
         out = json.loads(proc.stdout)
         assert out["count"] == 1
         assert out["sources"][0]["marketId"] == "m-1"
+
+
+class TestJournalExport:
+    """Additive maintenance subcommand: replay a settle_stream durability
+    journal and export the reference-compatible SQLite file — the
+    crash-recovery path without writing Python."""
+
+    def _journal(self, tmp_path: Path) -> Path:
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        batches = [
+            (
+                [
+                    (
+                        f"jx-b{b}-m{m}",
+                        [{"sourceId": f"s{m % 3}", "probability": 0.25 * (m % 4)}],
+                    )
+                    for m in range(5)
+                ],
+                [bool(m % 2) for m in range(5)],
+            )
+            for b in range(2)
+        ]
+        jrnl = tmp_path / "svc.jrnl"
+        store = TensorReliabilityStore()
+        for _result in settle_stream(
+            store, batches, steps=1, now=21_800.0, journal=jrnl
+        ):
+            pass
+        store.sync()
+        self._live = store.list_sources()
+        return jrnl
+
+    def test_export_then_list_sources_round_trip(self, tmp_path: Path):
+        jrnl = self._journal(tmp_path)
+        db = tmp_path / "out.db"
+        proc = run_cli(["--db", str(db), "journal-export", str(jrnl)])
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["epochTag"] == 1
+        assert out["rows"] == len(self._live)
+        assert out["exportedTo"] == str(db)
+        assert out["dryRun"] is False
+        # State asserted through the public surface: a second CLI process.
+        listing = run_cli(["--db", str(db), "list-sources"])
+        assert listing.returncode == 0
+        got = json.loads(listing.stdout)["sources"]
+        assert [
+            (s["sourceId"], s["marketId"], s["reliability"], s["confidence"])
+            for s in got
+        ] == [
+            (r.source_id, r.market_id, r.reliability, r.confidence)
+            for r in self._live
+        ]
+
+    def test_dry_run_reports_without_writing(self, tmp_path: Path):
+        jrnl = self._journal(tmp_path)
+        db = tmp_path / "never.db"
+        proc = run_cli(
+            ["--db", str(db), "--dry-run", "journal-export", str(jrnl)]
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["exportedTo"] is None and out["dryRun"] is True
+        assert not db.exists()
+
+    def test_dry_run_needs_no_db(self, tmp_path: Path):
+        jrnl = self._journal(tmp_path)
+        proc = run_cli(["--dry-run", "journal-export", str(jrnl)])
+        assert proc.returncode == 0, proc.stderr
+        assert json.loads(proc.stdout)["rows"] > 0
+
+    def test_existing_target_refused(self, tmp_path: Path):
+        # The export must EQUAL the recovered journal state; an existing
+        # file would UPSERT-merge stale rows in, so it is refused.
+        jrnl = self._journal(tmp_path)
+        db = tmp_path / "pre.db"
+        db.write_bytes(b"anything")
+        proc = run_cli(["--db", str(db), "journal-export", str(jrnl)])
+        assert proc.returncode == 1
+        assert "already exists" in proc.stderr
+        assert db.read_bytes() == b"anything"
+
+    def test_missing_db_errors(self, tmp_path: Path):
+        jrnl = self._journal(tmp_path)
+        proc = run_cli(["journal-export", str(jrnl)])
+        assert proc.returncode == 1
+        assert "Error: --db is required for journal-export" in proc.stderr
+
+    def test_bad_journal_errors(self, tmp_path: Path):
+        bad = tmp_path / "not.jrnl"
+        bad.write_bytes(b"NOTAJRNL")
+        proc = run_cli(["--db", str(tmp_path / "x.db"), "journal-export", str(bad)])
+        assert proc.returncode == 1
+        assert "Error:" in proc.stderr
