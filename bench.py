@@ -69,8 +69,8 @@ Prints exactly one JSON line:
      "extras": {...}}
 
 ``vs_baseline`` is against the reference implementation measured on this
-host's CPU (scripts/measure_reference_baseline.py): 2710.2 markets/sec at
-16 sources/market → 0.0027102 1M-cycles/sec. Re-run that script to refresh
+host's CPU (scripts/measure_reference_baseline.py): 2743.4 markets/sec at
+16 sources/market → 0.0027434 1M-cycles/sec. Re-run that script to refresh
 (host CPU contention moves it; the recorded value is the FASTEST measured,
 so vs_baseline is conservative).
 """
@@ -88,10 +88,11 @@ import time
 # reliability table; min-of-N methodology + full trial record in BASELINE.md).
 # History on this host: 0.0019838 (2026-07-29, busy CPU), 0.0027102
 # (2026-07-30, 1000 markets, single pass), 0.0024822 / 0.0023932 (2026-07-31,
-# 2000 markets, min-of-5 / min-of-8, load 0.5-0.8 on nproc=1). The FASTEST
+# 2000 markets, min-of-5 / min-of-8, load 0.5-0.8 on nproc=1), 0.0027434
+# (2026-07-31 quiet host, 500 markets, min-of-5, load 0.1-0.2). The FASTEST
 # ever observed is recorded — reference-favouring, so vs_baseline is a lower
 # bound on the true ratio.
-REFERENCE_BASELINE_CYCLES_PER_SEC = 0.0027102
+REFERENCE_BASELINE_CYCLES_PER_SEC = 0.0027434
 
 NUM_MARKETS = 1_000_000
 SLOTS_PER_MARKET = 16

@@ -119,7 +119,9 @@ class TestCompose:
         )
         assert rc == 0
         assert payload["value"] == 7200.0
-        assert payload["vs_baseline"] == round(7200.0 / 0.0027102, 1)
+        assert payload["vs_baseline"] == round(
+            7200.0 / bench.REFERENCE_BASELINE_CYCLES_PER_SEC, 1
+        )
         extras = payload["extras"]
         assert extras["headline_source"] == "compact_int8_loop"
         assert "degraded" not in extras
