@@ -14,6 +14,7 @@ from bayesian_consensus_engine_tpu.state.decay import (
 )
 from bayesian_consensus_engine_tpu.state.journal import (
     JournalWriter,
+    compact_journal,
     replay_journal,
 )
 
@@ -23,6 +24,7 @@ __all__ = [
     "ReliabilityStore",
     "SQLiteReliabilityStore",
     "apply_reliability_decay",
+    "compact_journal",
     "compute_decay_factor",
     "days_since_update",
     "decay_reliability_if_needed",

@@ -320,6 +320,51 @@ def _scan_valid_end(path):
         return end, epochs, rows, tag
 
 
+def compact_journal(path: Union[str, Path]) -> int:
+    """Rewrite a journal as ONE full-snapshot epoch; returns rows kept.
+
+    A long-running service's journal grows without bound — every epoch
+    appends, and a re-settled row re-appends its current values. This is
+    the WAL-checkpoint answer: replay the valid epochs (torn tail
+    dropped, exactly as recovery would), write a fresh journal holding
+    one epoch with the SAME tag watermark, and atomically rename it over
+    the original — at every instant the path holds a journal that
+    replays to the same state and watermark, so a crash mid-compaction
+    loses nothing. Resume afterwards exactly as before
+    (``JournalWriter(path, resume=True)`` appends after the snapshot
+    epoch). Run it from the service between streams, or from cron
+    against a quiesced journal; do NOT run it concurrently with a live
+    writer (the writer's open handle would keep appending to the
+    unlinked old file).
+    """
+    path = str(path)
+    store, tag = replay_journal(path)
+    tmp_path = path + ".compact"
+    if os.path.exists(tmp_path):
+        # A crash between the snapshot write and the rename leaves a
+        # stale .compact; the original journal is still intact and
+        # authoritative, so the leftover is safe to discard.
+        os.unlink(tmp_path)
+    writer = JournalWriter(tmp_path)
+    try:
+        if tag is None:
+            # No complete epoch: nothing durable to snapshot, and
+            # inventing a watermark would skip batch 0 on resume — the
+            # compacted journal is the empty (magic-only) journal, which
+            # replays to the same (empty, None) as the original.
+            rows = 0
+        else:
+            rows = store.flush_to_journal(writer, tag=tag)
+        writer.close()
+        os.replace(tmp_path, path)
+    except Exception:
+        writer.close()
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
+        raise
+    return rows
+
+
 def replay_journal(path: Union[str, Path]):
     """Rebuild a store from a journal: ``(store, last_tag)``.
 

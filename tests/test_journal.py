@@ -370,3 +370,99 @@ class TestWriterValidation:
                     np.array([]), np.array([0.0]), np.array([1], np.uint8),
                     ["x"],
                 )
+
+
+class TestCompaction:
+    """compact_journal: the WAL-checkpoint answer to unbounded growth —
+    one full-snapshot epoch, same replayed state, same watermark,
+    atomic swap, resumable afterwards."""
+
+    def _grown_journal(self, tmp_path, epochs=6):
+        store = seeded_store(n=40)
+        path = tmp_path / "grow.jrnl"
+        with JournalWriter(path) as journal:
+            store.flush_to_journal(journal, tag=0)
+            for e in range(1, epochs):
+                # Re-touch the same rows every epoch: the journal grows
+                # while the live state stays 40 rows.
+                for i in range(0, 40, 3):
+                    store.update_reliability(
+                        f"src-{i % 7}", f"mkt-{i}", bool(e % 2)
+                    )
+                store.flush_to_journal(journal, tag=e)
+        return path, store
+
+    def test_compaction_shrinks_and_preserves_state_and_tag(self, tmp_path):
+        from bayesian_consensus_engine_tpu.state import compact_journal
+
+        path, store = self._grown_journal(tmp_path)
+        before_state, before_tag = replay_journal(path)
+        before_size = path.stat().st_size
+        kept = compact_journal(path)
+        assert kept == len(store)
+        assert path.stat().st_size < before_size
+        after_state, after_tag = replay_journal(path)
+        assert after_tag == before_tag == 5
+        assert store_fingerprint(after_state) == store_fingerprint(
+            before_state
+        )
+
+    def test_resume_after_compaction_appends(self, tmp_path):
+        from bayesian_consensus_engine_tpu.state import compact_journal
+
+        path, store = self._grown_journal(tmp_path, epochs=3)
+        compact_journal(path)
+        # The store's journal-dirty view belongs to the OLD journal; a
+        # resumed writer starts from the compacted file's coverage.
+        with JournalWriter(path, resume=True) as journal:
+            assert journal.epoch_index == 1  # the snapshot epoch
+            store._journal_dirty[:] = False
+            store.update_reliability("src-1", "mkt-1", True)
+            store.flush_to_journal(journal, tag=9)
+        replayed, tag = replay_journal(path)
+        assert tag == 9
+        live = {(r.source_id, r.market_id): r for r in store.list_sources()}
+        got = {(r.source_id, r.market_id): r for r in replayed.list_sources()}
+        assert got[("src-1", "mkt-1")] == live[("src-1", "mkt-1")]
+
+    def test_epochless_journal_compacts_to_empty_not_tag_zero(
+        self, tmp_path
+    ):
+        # Inventing tag=0 would make a resumed service skip batch 0; an
+        # epoch-less journal must stay (empty, None) through compaction.
+        from bayesian_consensus_engine_tpu.state import compact_journal
+
+        path = tmp_path / "fresh.jrnl"
+        JournalWriter(path).close()
+        assert compact_journal(path) == 0
+        store, tag = replay_journal(path)
+        assert tag is None and len(store) == 0
+
+    def test_stale_compact_leftover_is_discarded(self, tmp_path):
+        # A crash between snapshot write and rename leaves path.compact;
+        # the next compaction must clean it up, not fail forever.
+        from bayesian_consensus_engine_tpu.state import compact_journal
+
+        path, store = self._grown_journal(tmp_path, epochs=2)
+        stale = tmp_path / "grow.jrnl.compact"
+        stale.write_bytes(b"BCEJRNL1leftover-from-a-crash")
+        kept = compact_journal(path)
+        assert kept == len(store)
+        assert not stale.exists()
+        replayed, tag = replay_journal(path)
+        assert tag == 1
+        assert store_fingerprint(replayed) == store_fingerprint(store)
+
+    def test_compaction_of_torn_journal_keeps_valid_prefix(self, tmp_path):
+        from bayesian_consensus_engine_tpu.state import compact_journal
+
+        path, _ = self._grown_journal(tmp_path, epochs=3)
+        _, pre_tear_tag = replay_journal(path)
+        assert pre_tear_tag == 2  # the tear below really drops an epoch
+        raw = path.read_bytes()
+        path.write_bytes(raw[:-9])  # tear the final epoch
+        want_state, want_tag = replay_journal(path)  # valid prefix only
+        compact_journal(path)
+        got_state, got_tag = replay_journal(path)
+        assert got_tag == want_tag == 1
+        assert store_fingerprint(got_state) == store_fingerprint(want_state)
