@@ -15,7 +15,9 @@ Extensions (additive, do not change reference-shaped outputs): ``--backend
 {python,jax,tpu}`` selects the consensus engine implementation;
 ``journal-export JRNL`` replays a ``settle_stream`` durability journal
 (state/journal.py) and exports the reference-compatible SQLite file to
-``--db`` — the crash-recovery path without writing Python.
+``--db`` — the crash-recovery path without writing Python; ``lint`` runs
+graftlint, the repo's JAX/determinism/layering static analysis
+(docs/static-analysis.md).
 """
 
 from __future__ import annotations
@@ -206,6 +208,21 @@ def _run_list_sources(args: argparse.Namespace) -> None:
         raise SystemExit(1) from exc
 
 
+def _run_lint(args: argparse.Namespace) -> None:
+    # Lazy import: the lint engine is tool code and the hot CLI paths
+    # (consensus on stdin) should not pay for loading it.
+    from bayesian_consensus_engine_tpu.lint import main as lint_main
+
+    argv: list[str] = list(args.paths)
+    if args.list_rules:
+        argv.append("--list-rules")
+    if args.format != "text":
+        argv += ["--format", args.format]
+    if args.select:
+        argv += ["--select", args.select]
+    raise SystemExit(lint_main(argv))
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="bce-tpu",
@@ -285,6 +302,35 @@ def build_parser() -> argparse.ArgumentParser:
         "journal", help="path to the journal written by settle_stream"
     )
     journal.set_defaults(handler=_run_journal_export)
+
+    lint = sub.add_parser(
+        "lint",
+        help=(
+            "run graftlint — JAX/determinism/layering static analysis "
+            "(exit 1 on any error-severity finding)"
+        ),
+    )
+    lint.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to check (default: the repo gate set)",
+    )
+    lint.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    lint.add_argument(
+        "--select",
+        help="comma-separated rule IDs to run (default: all)",
+    )
+    lint.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    lint.set_defaults(handler=_run_lint)
 
     return parser
 

@@ -383,9 +383,12 @@ def compute_all_consensus_batched(
     cached on each ``Market`` (``consensus_result``) exactly like the scalar
     sweep.
     """
-    from bayesian_consensus_engine_tpu.models.market import MarketStatus
-
-    open_markets = market_store.list_markets(status=MarketStatus.OPEN)
+    # `MarketStatus` is a str-Enum, so the wire value "open" IS the status
+    # contract — comparing against it keeps core/ below models/ in the
+    # layer map (lint rule LY301) without an upward import.
+    open_markets = [
+        m for m in market_store.list_markets() if m.status == "open"
+    ]
     payload = [(str(m.id), m.signals) for m in open_markets]
     lookup = (
         store_lookup(reliability_store)

@@ -1,0 +1,59 @@
+"""graftlint — JAX/TPU-aware static analysis for this repository.
+
+A stdlib-only lint engine (ast + symtable; no third-party dependencies, no
+JAX import) enforcing the three contracts the test suite cannot see until
+they are already broken:
+
+* **JAX correctness/perf** (JX1xx): host-sync hazards and re-trace hazards
+  inside the ``ops/``/``parallel/``/``core/`` hot paths, missing buffer
+  donation on state-mutating jits, dtype drift from bare array constructors
+  in kernel modules.
+* **Determinism contract** (DT2xx): unordered-``set`` iteration, wall-clock/
+  RNG/env reads inside the pure-math modules, dict-order-sensitive
+  serialization in the record layer.
+* **Layering** (LY3xx): the PAPER.md layer map as an import-graph policy
+  (``ops/`` never imports ``state/``; ``utils/`` imports nothing above
+  layer 0; import-time backend initialisation is forbidden).
+
+Plus the migrated ``scripts/devlint.py`` pyflakes-lite family (F4xx/F8xx/
+E7xx) so there is exactly one engine behind every gate.
+
+Run it as ``python -m bayesian_consensus_engine_tpu.lint`` or via the
+``lint`` subcommand of the package CLI. ``# noqa`` on the offending line
+suppresses every rule; ``# noqa: JX101,DT201`` suppresses just those IDs.
+Rule catalog: docs/static-analysis.md.
+
+This subpackage is tool code: it imports **nothing** from the rest of the
+package (enforced by its own LY301 rule) so it can never drag JAX — or a
+bug in the code under analysis — into the analysis itself.
+"""
+
+from bayesian_consensus_engine_tpu.lint.engine import (
+    Finding,
+    check_file,
+    check_source,
+    iter_target_files,
+    main,
+    run,
+)
+from bayesian_consensus_engine_tpu.lint.registry import RULES, Rule, rule
+
+# Importing the rule modules registers every rule (decorator side effect).
+from bayesian_consensus_engine_tpu.lint import (  # noqa: F401
+    rules_determinism,
+    rules_jax,
+    rules_layering,
+    rules_pyflakes,
+)
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RULES",
+    "rule",
+    "check_file",
+    "check_source",
+    "iter_target_files",
+    "main",
+    "run",
+]

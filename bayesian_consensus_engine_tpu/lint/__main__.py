@@ -1,0 +1,8 @@
+"""``python -m bayesian_consensus_engine_tpu.lint`` entry point."""
+
+import sys
+
+from bayesian_consensus_engine_tpu.lint.engine import main
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

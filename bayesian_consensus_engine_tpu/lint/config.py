@@ -1,0 +1,112 @@
+"""Checked paths, layer map, and rule scoping for graftlint.
+
+Everything path-shaped lives here so policy changes are one-file diffs:
+the default lint targets, the PAPER.md layer map the LY301 import checker
+enforces, and the module families each JX/DT rule family applies to.
+Paths are repo-root-relative POSIX strings.
+"""
+
+from __future__ import annotations
+
+PACKAGE = "bayesian_consensus_engine_tpu"
+
+#: What ``python -m bayesian_consensus_engine_tpu.lint`` (and the devlint
+#: shim) checks when given no paths — the same surface CI gates.
+DEFAULT_PATHS = [
+    PACKAGE,
+    "tests",
+    "scripts",
+    "examples",
+    "native",
+    "bench.py",
+    "__graft_entry__.py",
+]
+
+# -- layer map (LY301) --------------------------------------------------------
+#
+# The PAPER.md layer map, bottom → top, as enforced policy: a module in
+# layer N may import its own segment freely and any segment with a
+# strictly-or-equal lower number; importing upward is a violation. ``lint``
+# sits at 0 so the CLI may import it, but its own imports are pinned to
+# nothing by LAYER_IMPORT_OVERRIDES — tool code never drags runtime layers
+# (or JAX) into the analysis.
+
+LAYERS: dict[str, int] = {
+    "_native": 0,
+    "lint": 0,
+    "utils": 0,
+    "ops": 1,
+    "core": 2,
+    "state": 3,
+    "models": 4,
+    "parallel": 5,
+    "pipeline": 6,
+    "cli": 7,
+    # The root facade re-exports for users; nothing inside imports it.
+    "__init__": 99,
+}
+
+#: Segments whose allowed intra-package imports are pinned to an explicit
+#: set instead of the numeric rule. ``lint`` imports nothing.
+LAYER_IMPORT_OVERRIDES: dict[str, frozenset[str]] = {
+    "lint": frozenset(),
+}
+
+#: Deliberate exceptions to the layer map: (importer_segment,
+#: imported_segment) pairs. Keep this empty; every entry is debt.
+LAYERING_ALLOWLIST: frozenset[tuple[str, str]] = frozenset()
+
+# -- rule family scoping ------------------------------------------------------
+
+#: Hot-path modules: JX host-sync/donation/re-trace rules apply here.
+HOT_PATH_PREFIXES = (
+    f"{PACKAGE}/ops/",
+    f"{PACKAGE}/parallel/",
+    f"{PACKAGE}/core/",
+    f"{PACKAGE}/pipeline.py",
+)
+
+#: Kernel modules: the JX107 explicit-dtype rule applies here (dtype drift
+#: inside kernels changes compiled programs and numerics silently).
+KERNEL_PREFIXES = (f"{PACKAGE}/ops/",)
+
+#: Modules that must never read wall clock, RNG state, or the environment
+#: (DT202) — the pure math whose outputs the golden fixtures pin.
+CLOCK_FREE_PREFIXES = (
+    f"{PACKAGE}/ops/",
+    f"{PACKAGE}/state/update_math.py",
+)
+
+#: The record/serialization layer: DT203 (dict-order-sensitive dumps).
+SERIALIZATION_PREFIXES = (f"{PACKAGE}/state/",)
+
+
+def in_package(rel: str | None) -> bool:
+    """True for files inside the package tree (layer + determinism scope)."""
+    return rel is not None and (
+        rel.startswith(PACKAGE + "/") or rel == PACKAGE
+    )
+
+
+def matches(rel: str | None, prefixes: tuple[str, ...]) -> bool:
+    """True when *rel* is one of *prefixes* or under a directory prefix."""
+    if rel is None:
+        return False
+    return any(
+        rel == p or (p.endswith("/") and rel.startswith(p)) for p in prefixes
+    )
+
+
+def segment_of(rel: str | None) -> str | None:
+    """Package segment of a repo-relative path (``ops``, ``cli``, ...).
+
+    Top-level modules map to their stem (``pipeline.py`` → ``pipeline``);
+    files outside the package map to ``None``.
+    """
+    if not in_package(rel):
+        return None
+    parts = rel.split("/")
+    if len(parts) == 2:  # bayesian_consensus_engine_tpu/pipeline.py
+        stem = parts[1][:-3] if parts[1].endswith(".py") else parts[1]
+        return stem
+    return parts[1]

@@ -1,0 +1,271 @@
+"""The graftlint engine: file walking, suppression, output, exit codes.
+
+Rules see a :class:`FileContext` (parsed tree, source lines, repo-relative
+path, shared import-alias map) and yield ``(lineno, message)`` pairs; the
+engine turns those into :class:`Finding`s, applies ``# noqa`` suppression,
+renders text or JSON, and returns the exit code. Severity ``error`` gates
+(exit 1); ``warning`` reports without failing the run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import pathlib
+from dataclasses import asdict, dataclass
+from functools import cached_property
+from typing import Iterable, Optional, Sequence
+
+from bayesian_consensus_engine_tpu.lint import config
+from bayesian_consensus_engine_tpu.lint.registry import RULES
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule_id: str
+    message: str
+    severity: str = "error"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule_id} {self.message}"
+
+
+class FileContext:
+    """Everything a rule may need about one file, computed once."""
+
+    def __init__(self, path: str, src: str, tree: ast.AST, rel: Optional[str]):
+        self.path = path
+        self.src = src
+        self.tree = tree
+        #: repo-root-relative POSIX path, or None for files outside the repo
+        #: (scoped rules simply don't apply to those).
+        self.rel = rel
+
+    @cached_property
+    def lines(self) -> list[str]:
+        return self.src.splitlines()
+
+    @cached_property
+    def import_aliases(self) -> dict[str, str]:
+        """Local name → dotted origin for every import in the file.
+
+        ``import numpy as np`` → ``{"np": "numpy"}``;
+        ``from os import environ`` → ``{"environ": "os.environ"}``.
+        Function-scope imports are included — rules that care about scope
+        resolve it themselves; most only need "what does this name mean".
+        """
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[(a.asname or a.name).split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name != "*":
+                        aliases[a.asname or a.name] = (
+                            f"{node.module}.{a.name}"
+                        )
+        return aliases
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Resolve an expression to a dotted origin path, through aliases.
+
+        ``np.asarray`` → ``numpy.asarray`` when ``import numpy as np`` is
+        in scope; returns None for anything that is not a plain name/
+        attribute chain.
+        """
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.import_aliases.get(node.id, node.id)
+        return ".".join([root, *reversed(parts)])
+
+    def noqa_for(self, lineno: int) -> Optional[frozenset[str]]:
+        """Suppression on *lineno*: None = none, empty set = blanket."""
+        if not (0 < lineno <= len(self.lines)):
+            return None
+        line = self.lines[lineno - 1]
+        marker = line.find("# noqa")
+        if marker < 0:
+            return None
+        tail = line[marker + len("# noqa"):]
+        if tail.startswith(":"):
+            ids = {
+                t.strip() for t in tail[1:].split("#")[0].split(",") if t.strip()
+            }
+            return frozenset(ids)
+        return frozenset()  # blanket
+
+
+def _suppressed(ctx: FileContext, finding: Finding) -> bool:
+    ids = ctx.noqa_for(finding.line)
+    if ids is None:
+        return False
+    return not ids or finding.rule_id in ids
+
+
+def check_source(
+    src: str,
+    rel: Optional[str],
+    path: Optional[str] = None,
+    select: Optional[Iterable[str]] = None,
+) -> list[Finding]:
+    """Lint a source string as if it lived at repo-relative path *rel*.
+
+    The fixture-testing entry point: rules scoped to e.g. ``ops/`` can be
+    exercised without writing files into the repo.
+    """
+    shown = path or rel or "<source>"
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as exc:
+        return [
+            Finding(shown, exc.lineno or 1, "E999", f"syntax error: {exc.msg}")
+        ]
+    ctx = FileContext(shown, src, tree, rel)
+    wanted = set(select) if select is not None else None
+    findings: list[Finding] = []
+    for r in RULES.values():
+        if wanted is not None and r.id not in wanted:
+            continue
+        if not r.applies_to(rel):
+            continue
+        for lineno, message in r.check(ctx):
+            findings.append(Finding(shown, lineno, r.id, message, r.severity))
+    # Dedupe (nested walks can repeat), suppress, and order for humans.
+    findings = list(dict.fromkeys(findings))
+    findings = [f for f in findings if not _suppressed(ctx, f)]
+    findings.sort(key=lambda f: (f.line, f.rule_id, f.message))
+    return findings
+
+
+def _repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def _relativize(path: pathlib.Path, root: pathlib.Path) -> Optional[str]:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return None
+
+
+def check_file(
+    path,
+    root: Optional[pathlib.Path] = None,
+    select: Optional[Iterable[str]] = None,
+) -> list[Finding]:
+    """Lint one file; scoped rules key off its path relative to *root*."""
+    p = pathlib.Path(path)
+    rel = _relativize(p, root or _repo_root())
+    return check_source(
+        p.read_text(), rel, path=str(path), select=select
+    )
+
+
+def iter_target_files(
+    paths: Sequence[str], root: Optional[pathlib.Path] = None
+) -> list[pathlib.Path]:
+    """Expand target paths (dirs recurse to ``*.py``) against *root*."""
+    base = root or _repo_root()
+    files: list[pathlib.Path] = []
+    for t in paths:
+        p = pathlib.Path(t)
+        if not p.is_absolute():
+            p = base / t
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py" and p.exists():
+            files.append(p)
+    return files
+
+
+def run(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[pathlib.Path] = None,
+    select: Optional[Iterable[str]] = None,
+) -> tuple[int, list[Finding]]:
+    """Lint *paths* (default: the repo gate set); return (n_files, findings).
+
+    An explicitly-named path that matches no Python files is an E902 error
+    finding — a typo'd path in a CI step must not pass as "0 findings".
+    """
+    base = root or _repo_root()
+    explicit = paths is not None
+    findings: list[Finding] = []
+    n_files = 0
+    for t in paths or config.DEFAULT_PATHS:
+        files = iter_target_files([t], base)
+        if not files and explicit:
+            findings.append(
+                Finding(
+                    str(t), 1, "E902",
+                    "path does not exist or contains no Python files",
+                )
+            )
+            continue
+        n_files += len(files)
+        for f in files:
+            findings.extend(check_file(f, root=base, select=select))
+    return n_files, findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bayesian_consensus_engine_tpu.lint",
+        description=(
+            "JAX/TPU-aware static analysis: determinism, layering, and "
+            "hot-path contracts (docs/static-analysis.md)"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to check (default: the repo gate set)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--select",
+        help="comma-separated rule IDs to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.id}  [{r.severity}] {r.name}: {r.rationale}")
+        return 0
+
+    select = (
+        [s.strip() for s in args.select.split(",") if s.strip()]
+        if args.select
+        else None
+    )
+    n_files, findings = run(args.paths or None, select=select)
+    if args.format == "json":
+        print(
+            json.dumps(
+                {"files": n_files, "findings": [asdict(f) for f in findings]},
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"graftlint: {n_files} files, {len(findings)} findings")
+    return 1 if any(f.severity == "error" for f in findings) else 0

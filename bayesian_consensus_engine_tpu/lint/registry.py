@@ -1,0 +1,60 @@
+"""Rule registry: one decorator, one table, one engine behind every gate.
+
+A rule is a function ``check(ctx) -> Iterable[(lineno, message)]`` plus
+metadata. Registration is a decorator side effect at import time; the
+engine iterates ``RULES`` and applies each rule whose ``scope`` accepts
+the file's repo-relative path. Rules never format paths or handle
+``# noqa`` — the engine owns both, so every rule gets suppression and
+output formatting for free.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str  # "error" | "warning"
+    rationale: str
+    check: Callable  # check(ctx) -> Iterable[tuple[int, str]]
+    #: rel-path predicate; None means "every checked file".
+    scope: Optional[Callable[[Optional[str]], bool]] = None
+    tags: tuple[str, ...] = field(default=())
+
+    def applies_to(self, rel: Optional[str]) -> bool:
+        return self.scope is None or self.scope(rel)
+
+
+#: id → Rule, in registration order (dicts preserve insertion order).
+RULES: dict[str, Rule] = {}
+
+
+def rule(
+    rule_id: str,
+    name: str,
+    rationale: str,
+    severity: str = "error",
+    scope: Optional[Callable[[Optional[str]], bool]] = None,
+    tags: Iterable[str] = (),
+):
+    """Register ``check(ctx)`` under *rule_id*; returns the function."""
+
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate lint rule id {rule_id!r}")
+        RULES[rule_id] = Rule(
+            id=rule_id,
+            name=name,
+            severity=severity,
+            rationale=rationale,
+            check=fn,
+            scope=scope,
+            tags=tuple(tags),
+        )
+        return fn
+
+    return deco

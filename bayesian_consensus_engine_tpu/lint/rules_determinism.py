@@ -1,0 +1,143 @@
+"""DT2xx — determinism-contract rules.
+
+The paper's headline contract is byte-exact output (golden fixtures in
+``tests/``). Every breakage we have seen came from one of three ambient
+sources: Python's unordered ``set`` iteration leaking into output order,
+a wall-clock/RNG/environment read inside pure math, or dict-order-sensitive
+serialization. These rules make all three un-committable.
+"""
+
+from __future__ import annotations
+
+import ast
+from functools import partial
+
+from bayesian_consensus_engine_tpu.lint import config
+from bayesian_consensus_engine_tpu.lint.registry import rule
+
+_package = config.in_package
+_clock_free = partial(config.matches, prefixes=config.CLOCK_FREE_PREFIXES)
+_serialization = partial(config.matches, prefixes=config.SERIALIZATION_PREFIXES)
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in ("set", "frozenset")
+    )
+
+
+@rule(
+    "DT201",
+    name="unordered-set-iteration",
+    rationale=(
+        "iterating a set puts hash order — which varies across processes "
+        "(PYTHONHASHSEED) — on the path to output; wrap in sorted()"
+    ),
+    scope=_package,
+)
+def check_set_iteration(ctx):
+    iters: list[ast.AST] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters.append(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            iters.extend(gen.iter for gen in node.generators)
+    for it in iters:
+        if _is_set_expr(it):
+            yield (
+                it.lineno,
+                "iteration over an unordered set (hash order reaches "
+                "control flow/output; wrap in sorted())",
+            )
+
+
+#: Dotted call origins that read ambient nondeterministic state.
+_CLOCK_CALLS = (
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+    "os.getenv",
+    "os.environb",
+    "uuid.uuid1",
+    "uuid.uuid4",
+)
+
+
+@rule(
+    "DT202",
+    name="ambient-read-in-pure-math",
+    rationale=(
+        "the pure-math modules (ops/, state/update_math.py) define the "
+        "golden-fixture outputs; a clock/RNG/env read there makes the "
+        "same inputs produce different bytes — pass time in as data "
+        "(utils/timeconv owns the clock)"
+    ),
+    scope=_clock_free,
+)
+def check_ambient_reads(ctx):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            dotted = ctx.dotted(node.func)
+            if dotted is None:
+                continue
+            if dotted in _CLOCK_CALLS or dotted.startswith("random."):
+                yield (
+                    node.lineno,
+                    f"`{dotted}` read inside a pure-math module "
+                    "(nondeterministic input; pass it in as data)",
+                )
+            elif dotted.startswith("os.environ"):
+                yield (
+                    node.lineno,
+                    "`os.environ` read inside a pure-math module "
+                    "(ambient configuration; pass it in as data)",
+                )
+        elif isinstance(node, ast.Subscript):
+            dotted = ctx.dotted(node.value)
+            if dotted == "os.environ":
+                yield (
+                    node.lineno,
+                    "`os.environ[...]` read inside a pure-math module "
+                    "(ambient configuration; pass it in as data)",
+                )
+
+
+@rule(
+    "DT203",
+    name="unsorted-serialization",
+    rationale=(
+        "json.dumps without sort_keys serialises dict insertion order — "
+        "any refactor that reorders keys changes the bytes the record "
+        "layer persists; the interchange format must be canonical"
+    ),
+    scope=_serialization,
+)
+def check_unsorted_dumps(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.dotted(node.func)
+        if dotted not in ("json.dumps", "json.dump"):
+            continue
+        sort_kw = next(
+            (kw for kw in node.keywords if kw.arg == "sort_keys"), None
+        )
+        if sort_kw is None or (
+            isinstance(sort_kw.value, ast.Constant)
+            and sort_kw.value.value is not True
+        ):
+            yield (
+                node.lineno,
+                f"`{dotted}` without sort_keys=True in the record layer "
+                "(dict-order-sensitive bytes)",
+            )

@@ -1,0 +1,341 @@
+"""JX1xx — JAX correctness/perf rules for the hot-path modules.
+
+The failure modes these catch never raise: a ``.item()`` or ``print``
+inside a jitted function forces a device→host sync (or a tracer leak), a
+missing ``donate_argnums`` doubles HBM for the state tensor, an unhashable
+static argument silently re-traces every call, and a bare ``jnp.zeros``
+without ``dtype=`` compiles a different program under x64 than under x32.
+All of them show up only as latency or as one-ulp drift — exactly what the
+determinism contract cannot tolerate.
+"""
+
+from __future__ import annotations
+
+import ast
+from functools import partial
+
+from bayesian_consensus_engine_tpu.lint import config
+from bayesian_consensus_engine_tpu.lint.registry import rule
+
+_hot = partial(config.matches, prefixes=config.HOT_PATH_PREFIXES)
+_kernel = partial(config.matches, prefixes=config.KERNEL_PREFIXES)
+
+#: Callables that put a function under JAX tracing (so host side effects
+#: inside it are hazards). Dotted origins after alias resolution.
+_TRACING_WRAPPERS = {
+    "jax.jit",
+    "jax.pmap",
+    "jax.vmap",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pallas.pallas_call",
+    "bayesian_consensus_engine_tpu.parallel._jax_compat.shard_map",
+}
+
+
+def _is_tracing_wrapper(ctx, node: ast.AST) -> bool:
+    dotted = ctx.dotted(node)
+    if dotted is None:
+        return False
+    return dotted in _TRACING_WRAPPERS or dotted.endswith(
+        (".jit", ".pallas_call", ".shard_map")
+    )
+
+
+def _wrapped_fn_name(node: ast.AST):
+    """Function name wrapped by a jit-like call arg: ``f`` or ``partial(f, …)``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "partial"
+        and node.args
+    ):
+        return _wrapped_fn_name(node.args[0])
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "partial"
+        and node.args
+    ):
+        return _wrapped_fn_name(node.args[0])
+    return None
+
+
+def _all_defs(tree: ast.AST) -> dict[str, ast.AST]:
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    return defs
+
+
+def _jitted_defs(ctx) -> list[ast.AST]:
+    """Function defs that run under JAX tracing in this module.
+
+    Detected via (a) ``@jax.jit`` / ``@partial(jax.jit, …)`` decorators and
+    (b) the function's name being passed (directly or through ``partial``)
+    to a tracing wrapper call anywhere in the module.
+    """
+    defs = _all_defs(ctx.tree)
+    jitted: dict[int, ast.AST] = {}
+    for fn in defs.values():
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _is_tracing_wrapper(ctx, target):
+                jitted[id(fn)] = fn
+            elif (
+                isinstance(dec, ast.Call)
+                and _wrapped_fn_name(dec) is None
+                and dec.args
+                and _is_tracing_wrapper(ctx, dec.args[0])
+            ):  # @partial(jax.jit, static_argnums=…)
+                jitted[id(fn)] = fn
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_tracing_wrapper(ctx, node.func):
+            if node.args:
+                name = _wrapped_fn_name(node.args[0])
+                if name in defs:
+                    jitted[id(defs[name])] = defs[name]
+    return list(jitted.values())
+
+
+def _walk_jitted_bodies(ctx):
+    """Yield every AST node inside a jitted function body (incl. nested defs)."""
+    for fn in _jitted_defs(ctx):
+        for stmt in fn.body:
+            yield from ast.walk(stmt)
+
+
+@rule(
+    "JX101",
+    name="host-sync-item",
+    rationale=(
+        "`.item()` blocks on a device→host transfer; in a hot-path module "
+        "it serialises the dispatch pipeline (use array math, or sync once "
+        "at the boundary)"
+    ),
+    scope=_hot,
+)
+def check_item_call(ctx):
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            yield node.lineno, "`.item()` forces a host sync in a hot path"
+
+
+@rule(
+    "JX102",
+    name="scalar-cast-in-jit",
+    rationale=(
+        "float()/int() on a traced array aborts tracing or forces a "
+        "host sync; inside a jitted function use jnp casts"
+    ),
+    scope=_hot,
+)
+def check_scalar_cast_in_jit(ctx):
+    for node in _walk_jitted_bodies(ctx):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int")
+            and node.args
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            yield (
+                node.lineno,
+                f"`{node.func.id}()` on a non-literal inside a jitted "
+                "function (host sync / trace abort hazard)",
+            )
+
+
+@rule(
+    "JX103",
+    name="asarray-in-jit",
+    rationale=(
+        "np.asarray inside a jitted function materialises the tracer on "
+        "host (ConcretizationError at best, silent constant-folding at "
+        "worst); use jnp.asarray"
+    ),
+    scope=_hot,
+)
+def check_np_asarray_in_jit(ctx):
+    for node in _walk_jitted_bodies(ctx):
+        if isinstance(node, ast.Call):
+            dotted = ctx.dotted(node.func)
+            if dotted in ("numpy.asarray", "numpy.array", "numpy.asanyarray"):
+                yield (
+                    node.lineno,
+                    f"`{dotted}` inside a jitted function (host "
+                    "materialisation hazard; use jnp)",
+                )
+
+
+@rule(
+    "JX104",
+    name="print-in-jit",
+    rationale=(
+        "print() inside a jitted function fires at trace time only (or "
+        "leaks tracers); use jax.debug.print for runtime values"
+    ),
+    scope=_hot,
+)
+def check_print_in_jit(ctx):
+    for node in _walk_jitted_bodies(ctx):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            yield (
+                node.lineno,
+                "`print()` inside a jitted function (trace-time only; "
+                "use jax.debug.print)",
+            )
+
+
+@rule(
+    "JX105",
+    name="jit-state-without-donation",
+    rationale=(
+        "jitting a state-mutating entry point without donate_argnums keeps "
+        "both the old and new state resident — double HBM for the largest "
+        "tensor in the system"
+    ),
+    scope=_hot,
+)
+def check_jit_missing_donation(ctx):
+    defs = _all_defs(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and ctx.dotted(node.func) in ("jax.jit", "jax.api.jit")
+        ):
+            continue
+        if any(
+            kw.arg in ("donate_argnums", "donate_argnames")
+            for kw in node.keywords
+        ):
+            continue
+        name = _wrapped_fn_name(node.args[0]) if node.args else None
+        wrapped = defs.get(name)
+        if wrapped is None:
+            continue  # can't resolve the callee statically — stay quiet
+        params = [a.arg for a in wrapped.args.args]
+        if "state" in params:
+            yield (
+                node.lineno,
+                f"jax.jit({name}) mutates `state` but has no "
+                "donate_argnums (state buffers get duplicated in HBM)",
+            )
+
+
+def _static_positions(jit_call: ast.Call):
+    """Static argument positions declared on a ``jax.jit(...)`` call."""
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            return [
+                e.value
+                for e in elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            ]
+    return []
+
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+@rule(
+    "JX106",
+    name="unhashable-static-arg",
+    rationale=(
+        "a list/dict/set passed as a static jit argument either raises or "
+        "(via tuple conversion at each call) re-traces every invocation — "
+        "the classic silent 100× slowdown"
+    ),
+    scope=_hot,
+)
+def check_unhashable_static_args(ctx):
+    # Map jitted-name → static positions for `g = jax.jit(f, static_argnums=…)`.
+    static_by_name: dict[str, list[int]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if ctx.dotted(call.func) in ("jax.jit", "jax.api.jit"):
+                positions = _static_positions(call)
+                if positions:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            static_by_name[t.id] = positions
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # Direct form: jax.jit(f, static_argnums=…)(args…)
+        if (
+            isinstance(node.func, ast.Call)
+            and ctx.dotted(node.func.func) in ("jax.jit", "jax.api.jit")
+        ):
+            positions = _static_positions(node.func)
+        elif isinstance(node.func, ast.Name) and node.func.id in static_by_name:
+            positions = static_by_name[node.func.id]
+        else:
+            continue
+        for pos in positions:
+            if pos < len(node.args) and isinstance(node.args[pos], _UNHASHABLE):
+                yield (
+                    node.lineno,
+                    f"unhashable literal passed in static position {pos} "
+                    "of a jitted call (re-trace / TypeError hazard)",
+                )
+
+
+_DTYPE_SLOT = {
+    # constructor → index of the positional dtype slot
+    "array": 1,
+    "zeros": 1,
+    "ones": 1,
+    "empty": 1,
+    "full": 2,
+    "arange": 3,
+}
+
+
+@rule(
+    "JX107",
+    name="kernel-dtype-drift",
+    rationale=(
+        "a bare jnp constructor in a kernel module inherits the ambient "
+        "x64 flag — the same code compiles different programs (and "
+        "numerics) per process; kernels pin dtype explicitly"
+    ),
+    scope=_kernel,
+)
+def check_bare_constructor_dtype(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.dotted(node.func)
+        if dotted is None or "." not in dotted:
+            continue
+        root, _, attr = dotted.rpartition(".")
+        if root not in ("jax.numpy", "jnp", "numpy"):
+            continue
+        slot = _DTYPE_SLOT.get(attr)
+        if slot is None:
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        if len(node.args) > slot:
+            continue  # dtype passed positionally
+        yield (
+            node.lineno,
+            f"`{attr}()` without explicit dtype in a kernel module "
+            "(ambient-precision drift)",
+        )

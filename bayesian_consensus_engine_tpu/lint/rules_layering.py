@@ -1,0 +1,182 @@
+"""LY3xx — layering rules: the PAPER.md layer map as enforced policy.
+
+LY301 walks every import (module scope AND function scope — lazy imports
+are how upward dependencies hide) and checks the importer's package
+segment against the imported segment's layer number. LY302 forbids
+import-time JAX backend calls: a module-level ``jnp.…(…)`` constant
+anywhere in the package breaks ``jax.distributed.initialize()`` for every
+cluster user (it happened — see tests/test_import_hygiene.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bayesian_consensus_engine_tpu.lint import config
+from bayesian_consensus_engine_tpu.lint.registry import rule
+
+_package = config.in_package
+
+
+def _module_dotted(rel: str) -> str:
+    """Repo-relative path → dotted module (``a/b/c.py`` → ``a.b.c``)."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+def _imported_modules(ctx):
+    """Yield (lineno, dotted_module) for every import in the file."""
+    own = _module_dotted(ctx.rel) if ctx.rel else ""
+    own_parts = own.split(".")
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                yield node.lineno, a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # Relative import: resolve against this module's package.
+                # level 1 = current package, 2 = parent, ...
+                is_pkg = ctx.rel.endswith("/__init__.py")
+                anchor = own_parts if is_pkg else own_parts[:-1]
+                cut = node.level - 1
+                base = anchor[: len(anchor) - cut] if cut else anchor
+                target = ".".join(base + ([node.module] if node.module else []))
+            else:
+                target = node.module or ""
+            if not target:
+                continue
+            for a in node.names:
+                # `from pkg import models` imports the *models* segment, not
+                # the root facade — resolve the alias when it names a mapped
+                # segment; otherwise (a plain symbol, or `*`) the imported
+                # module is the base itself.
+                qualified = f"{target}.{a.name}" if a.name != "*" else target
+                if _segment_of_module(qualified) in config.LAYERS:
+                    yield node.lineno, qualified
+                else:
+                    yield node.lineno, target
+
+
+def _segment_of_module(dotted: str):
+    if dotted == config.PACKAGE:
+        return "__init__"
+    prefix = config.PACKAGE + "."
+    if not dotted.startswith(prefix):
+        return None
+    head = dotted[len(prefix):].split(".")[0]
+    return head[:-3] if head.endswith(".py") else head
+
+
+@rule(
+    "LY301",
+    name="layer-violation",
+    rationale=(
+        "the layer map (utils→ops→core→state→models→parallel→pipeline→cli) "
+        "is what keeps the scalar path JAX-free and the kernels "
+        "store-agnostic; an upward import — even a lazy one — couples "
+        "layers the tests treat as independent"
+    ),
+    scope=_package,
+)
+def check_layer_imports(ctx):
+    seg = config.segment_of(ctx.rel)
+    if seg is None:
+        return
+    own_layer = config.LAYERS.get(seg)
+    if own_layer is None:
+        yield 1, (
+            f"package segment `{seg}` is missing from the layer map "
+            "(add it to lint/config.py LAYERS)"
+        )
+        return
+    override = config.LAYER_IMPORT_OVERRIDES.get(seg)
+    for lineno, target in _imported_modules(ctx):
+        tseg = _segment_of_module(target)
+        if tseg is None or tseg == seg:
+            continue
+        if (seg, tseg) in config.LAYERING_ALLOWLIST:
+            continue
+        if override is not None:
+            if tseg not in override:
+                yield lineno, (
+                    f"`{seg}` is tool code and imports nothing from the "
+                    f"package, but imports `{tseg}`"
+                )
+            continue
+        tlayer = config.LAYERS.get(tseg)
+        if tlayer is None:
+            yield lineno, (
+                f"import of unmapped package segment `{tseg}` "
+                "(add it to lint/config.py LAYERS)"
+            )
+        elif tlayer > own_layer:
+            yield lineno, (
+                f"upward import: `{seg}` (layer {own_layer}) imports "
+                f"`{tseg}` (layer {tlayer}) — invert the dependency or "
+                "move the code"
+            )
+
+
+#: jax.* functions that initialise the XLA backend when called.
+_BACKEND_TOUCHERS = {
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.device_put",
+    "jax.device_get",
+    "jax.process_index",
+    "jax.process_count",
+    "jax.default_backend",
+}
+
+
+def _import_time_nodes(tree: ast.AST):
+    """AST nodes that execute at import: module/class bodies and their
+    control-flow blocks, but not function bodies."""
+    stack: list[ast.AST] = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # The body runs only when called — but decorators and default
+            # values execute at import.
+            stack.extend(node.decorator_list)
+            stack.extend(d for d in node.args.defaults if d is not None)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+            continue
+        if isinstance(node, ast.Lambda):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule(
+    "LY302",
+    name="import-time-backend-call",
+    rationale=(
+        "a module-level jnp/jax call initialises the XLA backend at "
+        "import, after which jax.distributed.initialize() raises for "
+        "every multi-process user; constants built from jnp must move "
+        "inside functions"
+    ),
+    scope=_package,
+)
+def check_import_time_backend_calls(ctx):
+    for node in _import_time_nodes(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.dotted(node.func)
+        if dotted is None:
+            continue
+        if (
+            dotted.startswith("jax.numpy.")
+            or dotted.startswith("jnp.")
+            or dotted in _BACKEND_TOUCHERS
+        ):
+            yield (
+                node.lineno,
+                f"import-time `{dotted}` call initialises the JAX backend "
+                "(breaks jax.distributed.initialize(); build it lazily)",
+            )

@@ -1,0 +1,337 @@
+"""F/E — the migrated ``scripts/devlint.py`` pyflakes-lite family.
+
+Same rules, same message text, one registry: F401 (unused import, module
+AND function scope), F541 (placeholder-less f-string), F811 (import
+redefinition), F821 (undefined name, via ``symtable`` scope resolution),
+F841 (unused local), E711/E712 (``== None`` / ``== True``), E722 (bare
+except). ``scripts/devlint.py`` is now a thin shim over this module so the
+CI fallback gate and the JAX/determinism/layering gate are one engine.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import symtable
+
+from bayesian_consensus_engine_tpu.lint.registry import rule
+
+_BUILTIN_NAMES = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__annotations__",
+    "__path__", "__cached__", "__class__",
+}
+
+
+def _names_loaded(tree: ast.AST) -> set[str]:
+    loaded: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            loaded.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                loaded.add(root.id)
+        elif isinstance(node, (ast.AnnAssign, ast.arg)):
+            # Quoted annotations ('decimal.Decimal') reference names too —
+            # ruff resolves them; parse the string as an expression.
+            loaded |= _annotation_names(node.annotation)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            loaded |= _annotation_names(node.returns)
+    return loaded
+
+
+def _annotation_names(annotation) -> set[str]:
+    if not (
+        isinstance(annotation, ast.Constant)
+        and isinstance(annotation.value, str)
+    ):
+        return set()
+    try:
+        parsed = ast.parse(annotation.value, mode="eval")
+    except SyntaxError:
+        return set()
+    return _names_loaded(parsed)
+
+
+@rule(
+    "F401",
+    name="unused-import",
+    rationale="an import never referenced is dead weight (or a typo)",
+)
+def check_unused_imports(ctx):
+    tree = ctx.tree
+    loaded = _names_loaded(tree)
+    exported = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            exported |= {
+                c.value for c in node.value.elts if isinstance(c, ast.Constant)
+            }
+
+    # Module-level imports.
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+                continue
+            for alias in node.names:
+                name = (alias.asname or alias.name).split(".")[0]
+                if alias.name == "*":
+                    continue
+                if (
+                    name not in loaded
+                    and name not in exported
+                    and (alias.name or "") not in exported
+                    and not (alias.asname is None and "." in alias.name)
+                ):
+                    yield node.lineno, f"{name!r} imported but unused"
+
+    # Function-scope imports (ruff flags these; a module pass misses them).
+    def visit(node: ast.AST, owner) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, child)
+                continue
+            if owner is not None and isinstance(
+                child, (ast.Import, ast.ImportFrom)
+            ):
+                if not (
+                    isinstance(child, ast.ImportFrom)
+                    and child.module == "__future__"
+                ):
+                    scope_loaded = _names_loaded(owner)
+                    for alias in child.names:
+                        if alias.name == "*":
+                            continue
+                        name = (alias.asname or alias.name).split(".")[0]
+                        if name not in scope_loaded and not (
+                            alias.asname is None and "." in alias.name
+                        ):
+                            problems.append(
+                                (
+                                    child.lineno,
+                                    f"{name!r} imported but unused "
+                                    f"(in {owner.name})",
+                                )
+                            )
+            visit(child, owner)
+
+    problems: list[tuple[int, str]] = []
+    visit(tree, None)
+    yield from problems
+
+
+@rule(
+    "F811",
+    name="import-redefinition",
+    rationale="a later import silently shadows an earlier one",
+)
+def check_import_redefinition(ctx):
+    seen: dict[str, int] = {}
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = (alias.asname or alias.name).split(".")[0]
+                if name in seen:
+                    yield (
+                        node.lineno,
+                        f"redefinition of {name!r} "
+                        f"(first import line {seen[name]})",
+                    )
+                seen[name] = node.lineno
+
+
+@rule(
+    "F821",
+    name="undefined-name",
+    rationale=(
+        "a name bound in no enclosing scope is a NameError waiting for "
+        "the one code path tests miss"
+    ),
+)
+def check_undefined_names(ctx):
+    """``symtable`` resolves scoping (locals, closures, globals, class
+    bodies, comprehensions); a GLOBAL_IMPLICIT reference with no module
+    binding and no builtin is a NameError waiting to run. Files with
+    wildcard imports are skipped (bindings unknowable statically)."""
+    tree = ctx.tree
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and any(
+            alias.name == "*" for alias in node.names
+        ):
+            return
+    try:
+        table = symtable.symtable(ctx.src, ctx.path, "exec")
+    except SyntaxError:
+        return
+
+    module_bound = {
+        s.get_name()
+        for s in table.get_symbols()
+        if s.is_assigned() or s.is_imported() or s.is_namespace()
+    }
+    # `global x` inside a function binds x at module scope at runtime.
+    declared_global: set[str] = set()
+
+    def collect_globals(t) -> None:
+        for s in t.get_symbols():
+            if s.is_declared_global() and s.is_assigned():
+                declared_global.add(s.get_name())
+        for child in t.get_children():
+            collect_globals(child)
+
+    collect_globals(table)
+    module_bound |= declared_global
+
+    undefined: set[str] = set()
+
+    def walk(t) -> None:
+        for s in t.get_symbols():
+            name = s.get_name()
+            if not s.is_referenced() or name in _BUILTIN_NAMES:
+                continue
+            if (
+                s.is_assigned() or s.is_imported() or s.is_parameter()
+                or s.is_free() or s.is_namespace()
+            ):
+                continue
+            if name not in module_bound:
+                undefined.add(name)
+        for child in t.get_children():
+            walk(child)
+
+    walk(table)
+    if not undefined:
+        return
+    # Attach line numbers from the first Load of each name.
+    first_load: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in undefined
+        ):
+            first_load.setdefault(node.id, node.lineno)
+    for name in sorted(undefined):
+        yield first_load.get(name, 1), f"undefined name {name!r}"
+
+
+@rule(
+    "F841",
+    name="unused-local",
+    rationale="a local assigned and never read usually marks a logic slip",
+)
+def check_unused_locals(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # Own scope only: nested defs report themselves. A name used by
+        # a nested def still counts as used (closures), so collect uses
+        # from the full subtree but assignments from this scope alone.
+        assigned: dict[str, int] = {}
+        used: set[str] = set()
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            inner = stack.pop()
+            if (
+                isinstance(inner, ast.Assign)
+                and len(inner.targets) == 1
+                and isinstance(inner.targets[0], ast.Name)
+            ):
+                assigned.setdefault(inner.targets[0].id, inner.lineno)
+            if not isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.extend(ast.iter_child_nodes(inner))
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Name) and not isinstance(
+                inner.ctx, ast.Store
+            ):
+                used.add(inner.id)
+        for name, lineno in assigned.items():
+            if name not in used and not name.startswith("_"):
+                yield (
+                    lineno,
+                    f"local {name!r} assigned but never used "
+                    f"(in {node.name})",
+                )
+
+
+@rule(
+    "F541",
+    name="fstring-without-placeholders",
+    rationale="an f-string with no placeholders is a plain string typo",
+)
+def check_placeholder_less_fstrings(ctx):
+    # format_spec of f"{x:,}" is itself a JoinedStr; exclude those.
+    format_specs = {
+        id(node.format_spec)
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, ast.FormattedValue) and node.format_spec is not None
+    }
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.JoinedStr)
+            and id(node) not in format_specs
+            and not any(isinstance(v, ast.FormattedValue) for v in node.values)
+        ):
+            yield node.lineno, "f-string without placeholders"
+
+
+@rule(
+    "E711",
+    name="none-comparison",
+    rationale="`== None` invokes __eq__; identity is the contract",
+)
+def check_none_comparison(ctx):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if (
+                    isinstance(op, (ast.Eq, ast.NotEq))
+                    and isinstance(comp, ast.Constant)
+                    and comp.value is None
+                ):
+                    yield node.lineno, "comparison to None (use `is`/`is not`)"
+
+
+@rule(
+    "E712",
+    name="bool-comparison",
+    rationale="`== True` invokes __eq__; truthiness is the contract",
+)
+def check_bool_comparison(ctx):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if (
+                    isinstance(op, (ast.Eq, ast.NotEq))
+                    and isinstance(comp, ast.Constant)
+                    and (comp.value is True or comp.value is False)
+                ):
+                    yield (
+                        node.lineno,
+                        f"comparison to {comp.value} (use `is` or truthiness)",
+                    )
+
+
+@rule(
+    "E722",
+    name="bare-except",
+    rationale="bare `except:` swallows KeyboardInterrupt and SystemExit",
+)
+def check_bare_except(ctx):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield node.lineno, "bare except"

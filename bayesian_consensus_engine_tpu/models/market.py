@@ -26,7 +26,7 @@ import numpy as np
 from bayesian_consensus_engine_tpu.core.engine import compute_consensus
 from bayesian_consensus_engine_tpu.utils.interning import IdInterner
 from bayesian_consensus_engine_tpu.state.sqlite_store import ReliabilityStore
-from bayesian_consensus_engine_tpu.state.update_math import utc_now_iso
+from bayesian_consensus_engine_tpu.utils.timeconv import utc_now_iso
 from bayesian_consensus_engine_tpu.utils.config import SCHEMA_VERSION
 
 

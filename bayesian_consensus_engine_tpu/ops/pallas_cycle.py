@@ -161,9 +161,10 @@ def _tuned_tile(num_markets: int, num_slots: int) -> int:
     not pick a tile. With autotune disabled (the default), ``tune``
     returns the fallback without measuring anything.
     """
-    import time
-
-    from bayesian_consensus_engine_tpu.utils.autotune import default_tuner
+    from bayesian_consensus_engine_tpu.utils.autotune import (
+        default_tuner,
+        time_best_of,
+    )
 
     candidates = [t for t in (512, 1024, 2048) if num_markets % t == 0]
     fallback = (
@@ -177,17 +178,16 @@ def _tuned_tile(num_markets: int, num_slots: int) -> int:
         km = jnp.zeros((num_slots, num_markets), jnp.float32)
         m1 = jnp.zeros((1, num_markets), jnp.float32)
         state = SlotMajorState(km + 0.5, km + 0.25, km * 0.0, km * 0.0)
-        out = call(km + 0.5, km + 1.0, m1, state, 1.0)
-        float(out[1].reshape(-1)[0])  # warm + fence (compile off the clock)
+
+        def run() -> None:
+            out = call(km + 0.5, km + 1.0, m1, state, 1.0)
+            float(out[1].reshape(-1)[0])  # fence: force the result to host
+
+        run()  # warm (compile off the clock)
         # Best-of-3: a single sample would be persisted forever, so one
         # host-load spike could lock in the wrong tile for this shape.
-        best = float("inf")
-        for _ in range(3):
-            start = time.perf_counter()
-            out = call(km + 0.5, km + 1.0, m1, state, 1.0)
-            float(out[1].reshape(-1)[0])
-            best = min(best, time.perf_counter() - start)
-        return best
+        # The clock lives in utils.autotune — ops/ is clock-free (DT202).
+        return time_best_of(run, repeats=3)
 
     return default_tuner().tune(
         "pallas_tile", (num_markets, num_slots), candidates, measure,

@@ -1,0 +1,48 @@
+"""Version shims for the shard_map surface used by the parallel layer.
+
+Newer JAX exports ``jax.shard_map`` with a ``check_vma`` kwarg and types
+manual values with varying-manual-axes (so replicated carries need
+``jax.lax.pcast(..., to="varying")``); 0.4.x keeps ``shard_map`` in the
+experimental namespace, spells the kwarg ``check_rep``, and has no vma
+typing at all. Every parallel module imports the surface from here so the
+difference lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # JAX < 0.6 keeps it in the experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_REP_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the replication-check kwarg spelled per-version."""
+    kwargs = {} if check_vma is None else {_REP_KW: check_vma}
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+if hasattr(jax.lax, "pcast"):
+
+    def pcast_varying(x, axes):
+        """Cast a replicated value to varying over *axes* (vma-typed JAX)."""
+        return jax.lax.pcast(x, axes, to="varying")
+
+else:
+
+    def pcast_varying(x, axes):
+        """Pre-vma JAX does not type manual values — nothing to cast."""
+        del axes
+        return x

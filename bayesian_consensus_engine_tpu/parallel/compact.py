@@ -38,7 +38,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from bayesian_consensus_engine_tpu.parallel._jax_compat import shard_map, pcast_varying
 
 from bayesian_consensus_engine_tpu.ops.decay import decayed_reliability_at
 from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS
@@ -231,9 +231,7 @@ def _compact_loop_math(probs, mask, outcome, state, now0, steps, axis_name,
     )
     init_consensus = jnp.zeros(outcome.shape[0], consensus_dtype)
     if axis_name is not None:
-        init_consensus = jax.lax.pcast(
-            init_consensus, (MARKETS_AXIS,), to="varying"
-        )
+        init_consensus = pcast_varying(init_consensus, (MARKETS_AXIS,))
     if steps == 0:
         return state, init_consensus
 

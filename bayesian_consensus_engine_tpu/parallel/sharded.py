@@ -29,7 +29,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from bayesian_consensus_engine_tpu.parallel._jax_compat import shard_map, pcast_varying
 
 from bayesian_consensus_engine_tpu.ops.decay import decayed_reliability_at
 from bayesian_consensus_engine_tpu.ops.update import outcome_update
@@ -482,7 +482,7 @@ def build_cycle_loop(
         cast = (
             None
             if mesh is None
-            else lambda x: jax.lax.pcast(x, (MARKETS_AXIS,), to="varying")
+            else lambda x: pcast_varying(x, (MARKETS_AXIS,))
         )
         loop_math = make_loop_math(
             cycle_fn, steps, cast_consensus=cast, fast_cycle_fn=fast_fn

@@ -28,7 +28,7 @@ from bayesian_consensus_engine_tpu.state.sqlite_store import (
     ReliabilityStore,
     SQLiteReliabilityStore,
 )
-from bayesian_consensus_engine_tpu.state.update_math import utc_now_iso
+from bayesian_consensus_engine_tpu.utils.timeconv import utc_now_iso
 
 GLOBAL_MARKET_ID = "__global__"
 _DOMAIN_PREFIX = "__domain__:"

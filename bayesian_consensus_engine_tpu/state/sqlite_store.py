@@ -32,7 +32,8 @@ from bayesian_consensus_engine_tpu.state.decay import (
     days_since_update,
 )
 from bayesian_consensus_engine_tpu.state.records import ReliabilityRecord
-from bayesian_consensus_engine_tpu.state.update_math import apply_outcome, utc_now_iso
+from bayesian_consensus_engine_tpu.state.update_math import apply_outcome
+from bayesian_consensus_engine_tpu.utils.timeconv import utc_now_iso
 
 _SCHEMA_SQL = """
 CREATE TABLE IF NOT EXISTS sources (

@@ -48,13 +48,13 @@ from bayesian_consensus_engine_tpu.state.records import ReliabilityRecord
 from bayesian_consensus_engine_tpu.state.update_math import (
     apply_outcome,
     apply_outcome_batch,
-    utc_now_iso,
 )
 from bayesian_consensus_engine_tpu.utils.interning import make_pair_interner
 from bayesian_consensus_engine_tpu.utils.timeconv import (
     NEVER,
     iso_to_days,
     now_days,
+    utc_now_iso,
 )
 
 _GROW = 2

@@ -13,8 +13,6 @@ on reads that ask for it (reference quirk #9, preserved).
 
 from __future__ import annotations
 
-from datetime import datetime, timezone
-
 from bayesian_consensus_engine_tpu.utils.config import (
     BASE_LEARNING_RATE,
     CONFIDENCE_GROWTH_RATE,
@@ -54,8 +52,3 @@ def apply_outcome_batch(reliability, confidence, correct):
         1.0, confidence + (1.0 - confidence) * CONFIDENCE_GROWTH_RATE
     )
     return new_reliability, new_confidence
-
-
-def utc_now_iso() -> str:
-    """Timestamp format stored in ``updated_at`` (reference: reliability.py:175)."""
-    return datetime.now(timezone.utc).isoformat()

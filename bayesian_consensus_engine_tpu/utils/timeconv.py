@@ -42,3 +42,13 @@ def days_to_iso(epoch_days: float) -> str:
 def now_days() -> float:
     """Current UTC time in epoch-days."""
     return datetime.now(timezone.utc).timestamp() / SECONDS_PER_DAY
+
+
+def utc_now_iso() -> str:
+    """Timestamp format stored in ``updated_at`` (reference: reliability.py:175).
+
+    Lives here — not in ``state.update_math`` — because the pure-math
+    modules are clock-free by contract (lint rule DT202); this module owns
+    the host clock.
+    """
+    return datetime.now(timezone.utc).isoformat()

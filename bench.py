@@ -1855,6 +1855,32 @@ def orchestrate(run_leg=run_leg_subprocess, fast=False, cpu=False,
     )
 
 
+def lint_gate(skip: bool) -> None:
+    """Refuse to measure a lint-dirty tree.
+
+    A tree that violates the hot-path/determinism contracts (graftlint,
+    docs/static-analysis.md) produces numbers that are not comparable to
+    the banked baselines — a stray host sync IS a benchmark result change.
+    ``--no-lint`` is the escape hatch for deliberately-dirty experiments.
+    """
+    if skip:
+        return
+    from bayesian_consensus_engine_tpu import lint
+
+    n_files, findings = lint.run()
+    errors = [f for f in findings if f.severity == "error"]
+    # stderr, not stdout: bench's stdout contract is one JSON line.
+    for f in findings:
+        print(f.render(), file=sys.stderr)
+    if errors:
+        print(
+            f"bench: tree is lint-dirty ({len(errors)} findings above); "
+            "fix them or rerun with --no-lint",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--leg", help="run one leg in-process (internal)")
@@ -1866,6 +1892,10 @@ def main(argv=None):
     parser.add_argument(
         "--cpu", action="store_true",
         help="force the CPU backend for every leg",
+    )
+    parser.add_argument(
+        "--no-lint", action="store_true",
+        help="skip the pre-run graftlint gate (docs/static-analysis.md)",
     )
     args = parser.parse_args(argv)
 
@@ -1883,6 +1913,9 @@ def main(argv=None):
             print(out)
         return 0
 
+    # Gate the orchestrated run only — each --leg subprocess is spawned by
+    # an orchestrator that already passed (or explicitly skipped) the gate.
+    lint_gate(args.no_lint)
     payload, rc = orchestrate(fast=args.fast, cpu=args.cpu)
     print(json.dumps(payload))
     return rc

@@ -1,330 +1,46 @@
-"""Stdlib approximation of the CI lint gates for this offline environment.
+"""Thin shim over the graftlint engine (the repo's one lint gate).
 
-CI runs real ``ruff check .`` and ``mypy`` (see .github/workflows/ci.yml);
-neither tool is installed in the baked TPU image, so this script covers the
-highest-signal subset of the gated rules with ``ast`` + ``symtable`` only:
+Historically this file carried its own pyflakes-lite implementation; those
+rules now live in ``bayesian_consensus_engine_tpu/lint/rules_pyflakes.py``
+alongside the JAX/determinism/layering rules, so there is exactly one
+engine behind CI, ``bench.py``, and ``python -m
+bayesian_consensus_engine_tpu.lint``. This shim keeps the old entry points
+stable:
 
-  F401  imports never referenced — module level AND function scope
-  F541  f-string without any placeholders
-  F811  redefinition of an imported name by a later import
-  F821  undefined name (referenced, bound in no enclosing scope, not a
-        builtin; skipped for files with wildcard imports)
-  F841  local assigned and never used (simple ``x = ...`` targets only,
-        matching ruff: loop variables and unpacking are not flagged)
-  E711  ``== None`` / ``!= None`` comparisons
-  E712  ``== True`` / ``== False`` comparisons
-  E722  bare ``except:``
+  ``check_file(path) -> list[str]``  findings as ``path:line: CODE msg``
+  ``main(argv) -> int``              lint paths (default: the repo gate
+                                     set), print findings, exit 1 on any
 
-``# noqa`` on the offending line suppresses, as with ruff.
-
-Usage: ``python scripts/devlint.py [paths...]`` (defaults to the package,
-tests, and repo-root scripts). Exits 1 on findings.
+Rule catalog (F401/F541/F811/F821/F841/E711/E712/E722 plus JX1xx/DT2xx/
+LY3xx): docs/static-analysis.md. ``# noqa`` / ``# noqa: ID`` suppress.
 """
 
 from __future__ import annotations
 
-import ast
-import builtins
 import pathlib
-import symtable
 import sys
 
-_BUILTIN_NAMES = set(dir(builtins)) | {
-    "__file__", "__name__", "__doc__", "__package__", "__spec__",
-    "__loader__", "__builtins__", "__debug__", "__annotations__",
-    "__path__", "__cached__", "__class__",
-}
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+if str(_ROOT) not in sys.path:
+    sys.path.insert(0, str(_ROOT))
 
-DEFAULT_PATHS = [
-    "bayesian_consensus_engine_tpu",
-    "tests",
-    "scripts",
-    "examples",
-    "native",
-    "bench.py",
-    "__graft_entry__.py",
-]
+from bayesian_consensus_engine_tpu.lint import engine as _engine
+
+DEFAULT_PATHS = list(_engine.config.DEFAULT_PATHS)
 
 
-def _names_loaded(tree: ast.AST) -> set[str]:
-    loaded: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            loaded.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            root = node
-            while isinstance(root, ast.Attribute):
-                root = root.value
-            if isinstance(root, ast.Name):
-                loaded.add(root.id)
-        elif isinstance(node, (ast.AnnAssign, ast.arg)):
-            # Quoted annotations ('decimal.Decimal') reference names too —
-            # ruff resolves them; parse the string as an expression.
-            loaded |= _annotation_names(node.annotation)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            loaded |= _annotation_names(node.returns)
-    return loaded
-
-
-def _annotation_names(annotation) -> set[str]:
-    if not (
-        isinstance(annotation, ast.Constant)
-        and isinstance(annotation.value, str)
-    ):
-        return set()
-    try:
-        parsed = ast.parse(annotation.value, mode="eval")
-    except SyntaxError:
-        return set()
-    return _names_loaded(parsed)
-
-
-def _function_scope_unused_imports(
-    tree: ast.AST, path: pathlib.Path
-) -> list[str]:
-    """F401 inside function bodies (ruff flags these; module pass misses
-    them — the exact class the round-2 advisor caught in a test)."""
-    problems: list[str] = []
-
-    def visit(node: ast.AST, owner) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                visit(child, child)
-                continue
-            if owner is not None and isinstance(
-                child, (ast.Import, ast.ImportFrom)
-            ):
-                if not (
-                    isinstance(child, ast.ImportFrom)
-                    and child.module == "__future__"
-                ):
-                    loaded = _names_loaded(owner)
-                    for alias in child.names:
-                        if alias.name == "*":
-                            continue
-                        name = (alias.asname or alias.name).split(".")[0]
-                        if name not in loaded and not (
-                            alias.asname is None and "." in alias.name
-                        ):
-                            problems.append(
-                                f"{path}:{child.lineno}: F401 {name!r} "
-                                f"imported but unused (in {owner.name})"
-                            )
-            visit(child, owner)
-
-    visit(tree, None)
-    return problems
-
-
-def _undefined_names(
-    src: str, tree: ast.AST, path: pathlib.Path
-) -> list[str]:
-    """F821: names referenced but bound in no enclosing scope.
-
-    ``symtable`` resolves scoping (locals, closures, globals, class
-    bodies, comprehensions); a GLOBAL_IMPLICIT reference with no module
-    binding and no builtin is a NameError waiting to run. Files with
-    wildcard imports are skipped (bindings unknowable statically).
-    """
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and any(
-            alias.name == "*" for alias in node.names
-        ):
-            return []
-    try:
-        table = symtable.symtable(src, str(path), "exec")
-    except SyntaxError:
-        return []
-
-    module_bound = {
-        s.get_name()
-        for s in table.get_symbols()
-        if s.is_assigned() or s.is_imported() or s.is_namespace()
-    }
-    # `global x` inside a function binds x at module scope at runtime.
-    declared_global: set[str] = set()
-
-    def collect_globals(t) -> None:
-        for s in t.get_symbols():
-            if s.is_declared_global() and s.is_assigned():
-                declared_global.add(s.get_name())
-        for child in t.get_children():
-            collect_globals(child)
-
-    collect_globals(table)
-    module_bound |= declared_global
-
-    undefined: set[str] = set()
-
-    def walk(t) -> None:
-        for s in t.get_symbols():
-            name = s.get_name()
-            if not s.is_referenced() or name in _BUILTIN_NAMES:
-                continue
-            if (
-                s.is_assigned() or s.is_imported() or s.is_parameter()
-                or s.is_free() or s.is_namespace()
-            ):
-                continue
-            if name not in module_bound:
-                undefined.add(name)
-        for child in t.get_children():
-            walk(child)
-
-    walk(table)
-    if not undefined:
-        return []
-    # Attach line numbers from the first Load of each name.
-    first_load: dict[str, int] = {}
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Name)
-            and isinstance(node.ctx, ast.Load)
-            and node.id in undefined
-        ):
-            first_load.setdefault(node.id, node.lineno)
-    return [
-        f"{path}:{first_load.get(name, 1)}: F821 undefined name {name!r}"
-        for name in sorted(undefined)
-    ]
-
-
-def check_file(path: pathlib.Path) -> list[str]:
-    src = path.read_text()
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as exc:
-        return [f"{path}:{exc.lineno}: E999 syntax error: {exc.msg}"]
-    lines = src.splitlines()
-
-    def noqa(lineno: int) -> bool:
-        return 0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]
-
-    problems: list[str] = []
-    problems += _function_scope_unused_imports(tree, path)
-    problems += _undefined_names(src, tree, path)
-    loaded = _names_loaded(tree)
-    # format_spec of f"{x:,}" is itself a JoinedStr; exclude those from F541.
-    format_specs = {
-        id(node.format_spec)
-        for node in ast.walk(tree)
-        if isinstance(node, ast.FormattedValue) and node.format_spec is not None
-    }
-    exported = set()
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Assign)
-            and any(
-                isinstance(t, ast.Name) and t.id == "__all__" for t in node.targets
-            )
-            and isinstance(node.value, (ast.List, ast.Tuple))
-        ):
-            exported |= {
-                c.value for c in node.value.elts if isinstance(c, ast.Constant)
-            }
-
-    # F401 / F811 over module-level imports.
-    seen_imports: dict[str, int] = {}
-    for node in tree.body:
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            if isinstance(node, ast.ImportFrom) and node.module == "__future__":
-                continue
-            for alias in node.names:
-                name = (alias.asname or alias.name).split(".")[0]
-                if alias.name == "*":
-                    continue
-                if name in seen_imports:
-                    problems.append(
-                        f"{path}:{node.lineno}: F811 redefinition of "
-                        f"{name!r} (first import line {seen_imports[name]})"
-                    )
-                seen_imports[name] = node.lineno
-                if (
-                    name not in loaded
-                    and name not in exported
-                    and (alias.name or "") not in exported
-                    and not (alias.asname is None and "." in alias.name)
-                ):
-                    problems.append(
-                        f"{path}:{node.lineno}: F401 {name!r} imported but unused"
-                    )
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Compare):
-            for op, comp in zip(node.ops, node.comparators):
-                if isinstance(op, (ast.Eq, ast.NotEq)) and isinstance(
-                    comp, ast.Constant
-                ):
-                    if comp.value is None:
-                        problems.append(
-                            f"{path}:{node.lineno}: E711 comparison to None "
-                            "(use `is`/`is not`)"
-                        )
-                    elif comp.value is True or comp.value is False:
-                        problems.append(
-                            f"{path}:{node.lineno}: E712 comparison to "
-                            f"{comp.value} (use `is` or truthiness)"
-                        )
-        elif isinstance(node, ast.ExceptHandler) and node.type is None:
-            problems.append(f"{path}:{node.lineno}: E722 bare except")
-        elif (
-            isinstance(node, ast.JoinedStr)
-            and id(node) not in format_specs
-            and not any(isinstance(v, ast.FormattedValue) for v in node.values)
-        ):
-            problems.append(
-                f"{path}:{node.lineno}: F541 f-string without placeholders"
-            )
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            # Own scope only: nested defs report themselves. A name used by
-            # a nested def still counts as used (closures), so collect uses
-            # from the full subtree but assignments from this scope alone.
-            assigned: dict[str, int] = {}
-            used: set[str] = set()
-            stack = list(ast.iter_child_nodes(node))
-            while stack:
-                inner = stack.pop()
-                if (
-                    isinstance(inner, ast.Assign)
-                    and len(inner.targets) == 1
-                    and isinstance(inner.targets[0], ast.Name)
-                ):
-                    assigned.setdefault(inner.targets[0].id, inner.lineno)
-                if not isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    stack.extend(ast.iter_child_nodes(inner))
-            for inner in ast.walk(node):
-                if isinstance(inner, ast.Name) and not isinstance(
-                    inner.ctx, ast.Store
-                ):
-                    used.add(inner.id)
-            for name, lineno in assigned.items():
-                if name not in used and not name.startswith("_"):
-                    problems.append(
-                        f"{path}:{lineno}: F841 local {name!r} assigned but "
-                        f"never used (in {node.name})"
-                    )
-    return [
-        msg for msg in problems if not noqa(int(msg.split(":", 2)[1] or 0))
-    ]
+def check_file(path) -> list[str]:
+    """Lint one file; returns rendered ``path:line: CODE message`` strings."""
+    return [f.render() for f in _engine.check_file(path, root=_ROOT)]
 
 
 def main(argv: list[str]) -> int:
-    root = pathlib.Path(__file__).resolve().parents[1]
-    targets = argv or DEFAULT_PATHS
-    files: list[pathlib.Path] = []
-    for t in targets:
-        p = root / t
-        if p.is_dir():
-            files.extend(sorted(p.rglob("*.py")))
-        elif p.suffix == ".py":
-            files.append(p)
-    problems: list[str] = []
-    for f in files:
-        problems.extend(dict.fromkeys(check_file(f)))  # dedupe nested-walk repeats
-    for line in problems:
-        print(line)
-    print(f"devlint: {len(files)} files, {len(problems)} findings")
-    return 1 if problems else 0
+    n_files, findings = _engine.run(argv or None, root=_ROOT)
+    for f in findings:
+        print(f.render())
+    print(f"devlint: {n_files} files, {len(findings)} findings")
+    # Same severity gating as engine.main: warnings report, errors gate.
+    return 1 if any(f.severity == "error" for f in findings) else 0
 
 
 if __name__ == "__main__":

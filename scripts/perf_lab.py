@@ -217,7 +217,14 @@ COMMANDS = {
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("command", choices=[*COMMANDS, "all"])
+    parser.add_argument(
+        "--no-lint", action="store_true",
+        help="skip the pre-run graftlint gate (docs/static-analysis.md)",
+    )
     args = parser.parse_args(argv)
+    # Same contract as bench.py: lab numbers from a lint-dirty tree are
+    # not comparable to the adjudicated baselines.
+    bench.lint_gate(args.no_lint)
     if args.command == "all":
         out = {name: fn(args) for name, fn in COMMANDS.items()}
     else:

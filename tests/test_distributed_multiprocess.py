@@ -41,10 +41,20 @@ import json, pathlib, sys
 
 sys.path.insert(0, {root!r})
 
+import os
+_FLAG = "--xla_force_host_platform_device_count=4"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FLAG
+    ).strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except AttributeError:  # old JAX: XLA_FLAGS above covers it
+    pass
 
 import numpy as np
 
@@ -257,10 +267,20 @@ import json, pathlib, sys
 
 sys.path.insert(0, {root!r})
 
+import os
+_FLAG = "--xla_force_host_platform_device_count=2"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FLAG
+    ).strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:  # old JAX: XLA_FLAGS above covers it
+    pass
 
 import numpy as np
 
@@ -470,6 +490,10 @@ def worker_bands(tmp_path_factory):
             raise
         outputs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outputs)):
+        if "Multiprocess computations aren't implemented" in out:
+            pytest.skip(
+                "this JAX's CPU backend has no multi-process collectives"
+            )
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"WORKER_OK {pid}" in out
     return [
@@ -772,6 +796,10 @@ def worker_bands4(tmp_path_factory):
             raise
         outputs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outputs)):
+        if "Multiprocess computations aren't implemented" in out:
+            pytest.skip(
+                "this JAX's CPU backend has no multi-process collectives"
+            )
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"WORKER_OK {pid}" in out
     return [

@@ -1,21 +1,47 @@
-"""Importing the package must not initialise the JAX backend.
+"""Import hygiene: layer policy is lint-enforced; the runtime probe backstops it.
 
-Multi-process bring-up requires ``jax.distributed.initialize()`` to run
-before ANY backend-touching call (jax.devices, device_put, or creating a
-jnp array at module import). A stray module-level ``jnp.something(...)``
-constant anywhere in the package breaks every cluster user — this is the
-regression test for exactly that (it happened: a module-level
+The static half of this file's old job — "no module-level backend call
+anywhere in the package" — now lives in graftlint (LY302), next to the
+layer map (LY301) and the single layering allowlist
+(``lint/config.LAYERING_ALLOWLIST``), so policy has exactly one home.
+These tests pin that delegation: the package passes the LY rules, and the
+allowlist stays empty (every entry is debt a reviewer must see).
+
+The subprocess probe stays as the dynamic backstop: static analysis can
+be fooled (getattr tricks, exec, a C extension touching XLA), but
+``xla_bridge.backends_are_initialized()`` cannot. Multi-process bring-up
+requires ``jax.distributed.initialize()`` to run before ANY
+backend-touching call — a stray module-level ``jnp.something(...)``
+constant breaks every cluster user (it happened: a module-level
 ``jnp.int32`` sentinel in ops/tiebreak.py broke the two-process suite).
-
-Runs in a subprocess because the test session itself has long since
-initialised the CPU backend.
 """
 
 import pathlib
 import subprocess
 import sys
 
+from bayesian_consensus_engine_tpu.lint import run as lint_run
+from bayesian_consensus_engine_tpu.lint.config import (
+    LAYERING_ALLOWLIST,
+    PACKAGE,
+)
+
 _ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_package_passes_the_layering_rules():
+    """LY301 (layer map) + LY302 (import-time backend calls) over the package."""
+    n_files, findings = lint_run([PACKAGE], select=("LY301", "LY302"))
+    rendered = "\n".join(f.render() for f in findings)
+    assert n_files > 20
+    assert not findings, f"layering violations:\n{rendered}"
+
+
+def test_layering_allowlist_is_empty():
+    # One allowlist, and it is empty: an upward import needs a lint-config
+    # diff this test makes loud, not a per-test special case.
+    assert LAYERING_ALLOWLIST == frozenset()
+
 
 _PROBE = """
 import sys
@@ -41,6 +67,8 @@ print("IMPORT_CLEAN")
 
 
 def test_package_import_leaves_backend_uninitialised():
+    # Runs in a subprocess because the test session itself has long since
+    # initialised the CPU backend.
     proc = subprocess.run(
         [sys.executable, "-c", _PROBE.format(root=str(_ROOT))],
         capture_output=True,

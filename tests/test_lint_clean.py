@@ -1,0 +1,300 @@
+"""graftlint's own gate: the repo is clean, and every rule actually fires.
+
+Two halves, both load-bearing:
+
+* ``test_repo_is_lint_clean`` runs the full linter over the repo gate set
+  inside tier-1, so a committed host-sync / determinism / layering
+  violation fails the suite — the repo is self-checking.
+* The fixture table seeds one minimal BAD snippet and one GOOD twin per
+  rule and asserts the rule fires on exactly the bad one (``select``
+  isolates each rule so e.g. an F401 on a deliberately-unused import
+  cannot mask a missing LY301). A rule that silently stops matching is a
+  gate that silently stopped gating.
+
+The engine is stdlib-only (ast + symtable); nothing here touches JAX.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from bayesian_consensus_engine_tpu.lint import RULES, check_source, run
+from bayesian_consensus_engine_tpu.lint import config as lint_config
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+PKG = lint_config.PACKAGE
+
+#: Every devlint-era rule the migrated engine must reproduce (ISSUE 1
+#: acceptance criterion) plus the three new families.
+_DEVLINT_IDS = ("F401", "F541", "F811", "F821", "F841", "E711", "E712", "E722")
+_NEW_FAMILY_IDS = (
+    "JX101", "JX102", "JX103", "JX104", "JX105", "JX106", "JX107",
+    "DT201", "DT202", "DT203",
+    "LY301", "LY302",
+)
+
+
+def _codes(src: str, rel: str, select=None) -> list[str]:
+    return [f.rule_id for f in check_source(src, rel, select=select)]
+
+
+# (rule_id, rel-path the snippet pretends to live at, bad source, good twin)
+_CASES = [
+    (
+        "JX101",
+        f"{PKG}/ops/case.py",
+        "def f(x):\n    return x.sum().item()\n",
+        "def f(x):\n    return x.sum()\n",
+    ),
+    (
+        "JX102",
+        f"{PKG}/ops/case.py",
+        "import jax\n\n@jax.jit\ndef f(x):\n    return float(x) + 1.0\n",
+        "import jax\n\n@jax.jit\ndef f(x):\n    return x + 1.0\n",
+    ),
+    (
+        "JX103",
+        f"{PKG}/parallel/case.py",
+        "import jax\nimport numpy as np\n\n@jax.jit\ndef f(x):\n"
+        "    return np.asarray(x)\n",
+        "import jax\nimport jax.numpy as jnp\n\n@jax.jit\ndef f(x):\n"
+        "    return jnp.asarray(x)\n",
+    ),
+    (
+        "JX104",
+        f"{PKG}/core/case.py",
+        "import jax\n\n@jax.jit\ndef f(x):\n    print(x)\n    return x\n",
+        "import jax\n\n@jax.jit\ndef f(x):\n"
+        "    jax.debug.print('x={}', x)\n    return x\n",
+    ),
+    (
+        "JX105",
+        f"{PKG}/parallel/case.py",
+        "import jax\n\ndef step(state, x):\n    return state + x\n\n"
+        "step_fast = jax.jit(step)\n",
+        "import jax\n\ndef step(state, x):\n    return state + x\n\n"
+        "step_fast = jax.jit(step, donate_argnums=(0,))\n",
+    ),
+    (
+        "JX106",
+        f"{PKG}/core/case.py",
+        "import jax\n\ndef f(x, opts):\n    return x\n\n"
+        "g = jax.jit(f, static_argnums=(1,))\ny = g(1, [1, 2])\n",
+        "import jax\n\ndef f(x, opts):\n    return x\n\n"
+        "g = jax.jit(f, static_argnums=(1,))\ny = g(1, (1, 2))\n",
+    ),
+    (
+        "JX107",
+        f"{PKG}/ops/case.py",
+        "import jax.numpy as jnp\n\ndef f():\n    return jnp.zeros((4, 4))\n",
+        "import jax.numpy as jnp\n\ndef f():\n"
+        "    return jnp.zeros((4, 4), dtype=jnp.float32)\n",
+    ),
+    (
+        "DT201",
+        f"{PKG}/state/case.py",
+        "def f():\n    return [x for x in {1, 2, 3}]\n",
+        "def f():\n    return [x for x in sorted({1, 2, 3})]\n",
+    ),
+    (
+        "DT202",
+        f"{PKG}/ops/case.py",
+        "import time\n\ndef f():\n    return time.time()\n",
+        "def f(now):\n    return now\n",
+    ),
+    (
+        "DT203",
+        f"{PKG}/state/case.py",
+        "import json\n\ndef f(d):\n    return json.dumps(d)\n",
+        "import json\n\ndef f(d):\n    return json.dumps(d, sort_keys=True)\n",
+    ),
+    (
+        "LY301",
+        f"{PKG}/ops/case.py",
+        f"from {PKG}.state import records\n",
+        f"from {PKG}.utils import config\n",
+    ),
+    (
+        "LY302",
+        f"{PKG}/core/case.py",
+        "import jax.numpy as jnp\n\nSENTINEL = jnp.int32(0)\n",
+        "import jax.numpy as jnp\n\ndef sentinel():\n    return jnp.int32(0)\n",
+    ),
+    (
+        "F401",
+        "tests/case.py",
+        "import os\n\n\ndef f():\n    return 1\n",
+        "import os\n\n\ndef f():\n    return os.sep\n",
+    ),
+    (
+        "F541",
+        "tests/case.py",
+        "x = f'constant'\n",
+        "x = f'{1}'\n",
+    ),
+    (
+        "F811",
+        "tests/case.py",
+        "import os\nimport os\n\nprint(os.sep)\n",
+        "import os\n\nprint(os.sep)\n",
+    ),
+    (
+        "F821",
+        "tests/case.py",
+        "def f():\n    return missing_name\n",
+        "def f():\n    return 1\n",
+    ),
+    (
+        "F841",
+        "tests/case.py",
+        "def f():\n    y = 1\n    return 2\n",
+        "def f():\n    y = 1\n    return y\n",
+    ),
+    (
+        "E711",
+        "tests/case.py",
+        "def f(x):\n    return x == None\n",
+        "def f(x):\n    return x is None\n",
+    ),
+    (
+        "E712",
+        "tests/case.py",
+        "def f(x):\n    return x == True\n",
+        "def f(x):\n    return bool(x)\n",
+    ),
+    (
+        "E722",
+        "tests/case.py",
+        "def f(x):\n    try:\n        return int(x)\n    except:\n"
+        "        return 0\n",
+        "def f(x):\n    try:\n        return int(x)\n    except ValueError:\n"
+        "        return 0\n",
+    ),
+]
+
+
+class TestRepoClean:
+    def test_repo_is_lint_clean(self):
+        n_files, findings = run()
+        rendered = "\n".join(f.render() for f in findings)
+        assert n_files > 50, "gate set shrank — check lint/config.DEFAULT_PATHS"
+        assert not findings, f"graftlint findings in the repo:\n{rendered}"
+
+    def test_every_devlint_rule_migrated(self):
+        for rule_id in _DEVLINT_IDS + _NEW_FAMILY_IDS:
+            assert rule_id in RULES, f"rule {rule_id} missing from the registry"
+
+
+class TestRuleFixtures:
+    @pytest.mark.parametrize(
+        "rule_id,rel,bad,good", _CASES, ids=[c[0] for c in _CASES]
+    )
+    def test_fires_on_bad_and_quiet_on_good(self, rule_id, rel, bad, good):
+        assert rule_id in _codes(bad, rel, select=[rule_id]), (
+            f"{rule_id} failed to fire on its seeded violation"
+        )
+        assert rule_id not in _codes(good, rel, select=[rule_id]), (
+            f"{rule_id} false-positived on the good twin"
+        )
+
+    @pytest.mark.parametrize(
+        "rule_id,rel,bad", [(c[0], c[1], c[2]) for c in _CASES],
+        ids=[c[0] for c in _CASES],
+    )
+    def test_scoped_rules_stay_out_of_foreign_paths(self, rule_id, rel, bad):
+        # A snippet outside the repo (rel=None) only sees unscoped rules:
+        # path-scoped families must never leak onto arbitrary files.
+        scoped = RULES[rule_id].scope is not None
+        if scoped:
+            assert rule_id not in _codes(bad, None, select=[rule_id])
+
+
+class TestLayeringResolution:
+    def test_from_package_import_segment_resolves_to_the_segment(self):
+        # `from pkg import models` imports the models segment (layer 4),
+        # not the root facade (layer 99) — legal from cli (layer 7).
+        src = f"from {PKG} import models\n"
+        assert _codes(src, f"{PKG}/cli.py", select=["LY301"]) == []
+
+    def test_from_package_import_segment_still_layer_checked(self):
+        # ...and from ops (layer 1) the same import IS an upward import.
+        src = f"from {PKG} import models\n"
+        assert "LY301" in _codes(src, f"{PKG}/ops/case.py", select=["LY301"])
+
+    def test_importing_the_root_facade_is_flagged(self):
+        # Nothing inside the package imports the root facade (layer 99).
+        src = f"from {PKG} import SCHEMA_VERSION\n"
+        assert "LY301" in _codes(src, f"{PKG}/cli.py", select=["LY301"])
+
+
+class TestSuppression:
+    def test_blanket_noqa(self):
+        src = "def f(x):\n    return x == None  # noqa\n"
+        assert _codes(src, "tests/case.py") == []
+
+    def test_id_noqa(self):
+        src = "def f(x):\n    return x == None  # noqa: E711\n"
+        assert "E711" not in _codes(src, "tests/case.py")
+
+    def test_wrong_id_noqa_does_not_suppress(self):
+        src = "def f(x):\n    return x == None  # noqa: F401\n"
+        assert "E711" in _codes(src, "tests/case.py")
+
+
+class TestCliContract:
+    """The module entry point: exit codes, JSON shape, rule IDs."""
+
+    def _run(self, *argv, cwd=None):
+        return subprocess.run(
+            [sys.executable, "-m", "bayesian_consensus_engine_tpu.lint", *argv],
+            capture_output=True,
+            text=True,
+            cwd=cwd or _ROOT,
+            timeout=120,
+        )
+
+    def test_exit_1_with_rule_ids_on_seeded_violation(self, tmp_path):
+        bad = tmp_path / "seeded.py"
+        bad.write_text(
+            "import os\n\ndef f(x):\n    try:\n        return x == None\n"
+            "    except:\n        return None\n"
+        )
+        proc = self._run(str(bad))
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        for rule_id in ("F401", "E711", "E722"):
+            assert rule_id in proc.stdout
+
+    def test_exit_0_on_clean_file(self, tmp_path):
+        clean = tmp_path / "clean.py"
+        clean.write_text("def f(x):\n    return x + 1\n")
+        proc = self._run(str(clean))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_exit_1_on_nonexistent_path(self, tmp_path):
+        # A typo'd path in a CI step must not pass as "0 findings".
+        proc = self._run(str(tmp_path / "no_such_file.py"))
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "E902" in proc.stdout
+
+    def test_json_output_shape(self, tmp_path):
+        bad = tmp_path / "seeded.py"
+        bad.write_text("x = f'constant'\n")
+        proc = self._run("--format", "json", str(bad))
+        payload = json.loads(proc.stdout)
+        assert payload["files"] == 1
+        (finding,) = payload["findings"]
+        assert finding["rule_id"] == "F541"
+        assert finding["line"] == 1
+        assert finding["severity"] == "error"
+
+
+class TestDocsCatalog:
+    def test_every_rule_documented(self):
+        catalog = (_ROOT / "docs" / "static-analysis.md").read_text()
+        for rule_id in RULES:
+            assert rule_id in catalog, (
+                f"rule {rule_id} missing from docs/static-analysis.md"
+            )

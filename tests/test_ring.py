@@ -12,8 +12,9 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from bayesian_consensus_engine_tpu.parallel._jax_compat import shard_map
 
 from bayesian_consensus_engine_tpu.models.tiebreak import (
     AgentSignal,
