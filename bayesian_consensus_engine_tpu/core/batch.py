@@ -22,6 +22,7 @@ Dtype: float64 when ``jax.config.x64_enabled`` (parity gate), else float32.
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Callable, Mapping, Sequence
@@ -106,6 +107,76 @@ class PackedBatch:
         return slice(
             int(self.pair_offsets[market_row]), int(self.pair_offsets[market_row + 1])
         )
+
+
+def topology_fingerprint(
+    market_keys: Sequence[str],
+    source_ids: Sequence[str],
+    offsets,
+) -> bytes:
+    """Order-sensitive fingerprint of a batch's SIGNAL TOPOLOGY.
+
+    The topology is everything about a batch except the probabilities and
+    outcomes: the market ids (in payload order), the raw per-signal source
+    ids (markets back to back, original signal order), and the CSR
+    *offsets* delimiting each market's signals. Two batches with equal
+    fingerprints produce byte-identical settlement plans up to the
+    probability columns — the invariant the plan-reuse fast path rests on
+    (:meth:`~.pipeline.SettlementPlan.refresh`). Any reordering of
+    markets, sources, or duplicate signals changes the digest: per-market
+    source ordering and duplicate-averaging order are both
+    float-summation-order contracts, so a reordered batch MUST rebuild.
+
+    The encoding is injective (ids are length-delimited, counts are part
+    of the digest), so distinct topologies collide only with blake2b
+    itself (~2^-64 at any realistic stream length). One join + one hash
+    pass over the columns: ~10ms per million signals, paid on the
+    prefetch thread.
+    """
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    digest = hashlib.blake2b(digest_size=16)
+    digest.update(
+        np.asarray([len(market_keys), len(source_ids)], np.int64).tobytes()
+    )
+    digest.update(
+        np.fromiter(map(len, market_keys), np.int64, len(market_keys))
+        .tobytes()
+    )
+    digest.update("".join(market_keys).encode("utf-8"))
+    digest.update(
+        np.fromiter(map(len, source_ids), np.int64, len(source_ids))
+        .tobytes()
+    )
+    digest.update("".join(source_ids).encode("utf-8"))
+    digest.update(offsets.tobytes())
+    return digest.digest()
+
+
+def columns_from_payloads(payloads):
+    """Flatten dict payloads to ``(market_keys, source_ids, probs, offsets)``.
+
+    The light single pass the delta-ingest path runs INSTEAD of packing:
+    no grouping, no sorting, no interning — just the raw columns in
+    original signal order, i.e. exactly the columnar form
+    :func:`~.pipeline.build_settlement_plan_columnar` consumes and
+    :func:`topology_fingerprint` hashes.
+    """
+    market_keys: list[str] = []
+    source_ids: list[str] = []
+    probs: list[float] = []
+    offsets: list[int] = [0]
+    for market_id, signals in payloads:
+        market_keys.append(market_id)
+        for signal in signals:
+            source_ids.append(signal["sourceId"])
+            probs.append(signal["probability"])
+        offsets.append(len(source_ids))
+    return (
+        market_keys,
+        source_ids,
+        np.asarray(probs, dtype=np.float64),
+        np.asarray(offsets, dtype=np.int64),
+    )
 
 
 try:  # native ingest packer (see native/fastpack.c; build with native/build.py)

@@ -30,7 +30,10 @@ class Finding:
     severity: str = "error"
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}: {self.rule_id} {self.message}"
+        # Errors keep the historical format; warnings self-identify so a
+        # gate's log makes the non-failing tier visible at a glance.
+        tag = "" if self.severity == "error" else f" [{self.severity}]"
+        return f"{self.path}:{self.line}: {self.rule_id}{tag} {self.message}"
 
 
 class FileContext:
@@ -267,5 +270,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         for f in findings:
             print(f.render())
-        print(f"graftlint: {n_files} files, {len(findings)} findings")
+        errors = sum(f.severity == "error" for f in findings)
+        warnings = len(findings) - errors
+        print(
+            f"graftlint: {n_files} files, {errors} errors, "
+            f"{warnings} warnings"
+        )
     return 1 if any(f.severity == "error" for f in findings) else 0

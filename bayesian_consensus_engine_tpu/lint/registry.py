@@ -14,11 +14,17 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable, Optional
 
 
+#: The two severity tiers. ``error`` gates (CLI exit 1, bench/perf_lab
+#: refuse to run); ``warning`` is advisory — printed by every gate, fails
+#: none of them.
+SEVERITIES = ("error", "warning")
+
+
 @dataclass(frozen=True)
 class Rule:
     id: str
     name: str
-    severity: str  # "error" | "warning"
+    severity: str  # one of SEVERITIES
     rationale: str
     check: Callable  # check(ctx) -> Iterable[tuple[int, str]]
     #: rel-path predicate; None means "every checked file".
@@ -46,6 +52,11 @@ def rule(
     def deco(fn):
         if rule_id in RULES:
             raise ValueError(f"duplicate lint rule id {rule_id!r}")
+        if severity not in SEVERITIES:
+            raise ValueError(
+                f"rule {rule_id!r}: severity must be one of {SEVERITIES}, "
+                f"got {severity!r}"
+            )
         RULES[rule_id] = Rule(
             id=rule_id,
             name=name,

@@ -235,6 +235,28 @@ def check_jit_missing_donation(ctx):
             )
 
 
+def _warm(rel):
+    """Package files OUTSIDE the hot paths: advisory-tier JX scope."""
+    return config.in_package(rel) and not _hot(rel)
+
+
+@rule(
+    "JX108",
+    name="advisory-donation-hint",
+    rationale=(
+        "the same missing-donation shape as JX105 in a NON-hot-path "
+        "package module: the duplicated state buffer costs HBM but not "
+        "the headline cycle, so it advises (warning tier) instead of "
+        "gating — bench/CI print it and keep running"
+    ),
+    severity="warning",
+    scope=_warm,
+)
+def check_jit_missing_donation_advisory(ctx):
+    # Same detector as the hot-path rule; only scope and severity differ.
+    yield from check_jit_missing_donation(ctx)
+
+
 def _static_positions(jit_call: ast.Call):
     """Static argument positions declared on a ``jax.jit(...)`` call."""
     for kw in jit_call.keywords:

@@ -46,7 +46,11 @@ from typing import Any, Mapping, Optional, Sequence
 
 import numpy as np
 
-from bayesian_consensus_engine_tpu.core.batch import pack_markets
+from bayesian_consensus_engine_tpu.core.batch import (
+    columns_from_payloads,
+    pack_markets,
+    topology_fingerprint,
+)
 from bayesian_consensus_engine_tpu.utils.config import (
     CONFIDENCE_GROWTH_RATE,
     DEFAULT_CONFIDENCE,
@@ -83,6 +87,10 @@ class SettlementPlan:
     mask: np.ndarray              # bool[K, M] slot carries a signal
     signals_per_market: np.ndarray  # i32[M] raw signal counts (diagnostics)
     binding: tuple[tuple[int, str, str], ...]  # (row, source, market) probes
+    #: order-sensitive topology digest (core.batch.topology_fingerprint),
+    #: or None when the builder was not asked for one. Equal fingerprints
+    #: mean "identical plan up to the probability columns" — the reuse key.
+    fingerprint: "bytes | None" = None
 
     @property
     def num_markets(self) -> int:
@@ -92,12 +100,71 @@ class SettlementPlan:
     def num_slots(self) -> int:
         return int(self.slot_rows.shape[0])
 
+    def refresh(self, probabilities) -> "SettlementPlan":
+        """Probability-only twin of this plan: same topology, new signals.
+
+        The delta-ingest fast path for the steady-state service, where
+        consecutive batches re-settle the same (source, market) universe
+        (the reference's daily re-settlement, market.py:200-221) and only
+        probabilities/outcomes change. *probabilities* is the flat
+        per-signal column (original signal order, markets back to back —
+        the same order packing consumes). The interned row mapping, slot
+        layout, padding, binding probes, and fingerprint are all SHARED
+        with this plan (same array objects); only the probability block
+        is recomputed — bit-identical to a full rebuild on the same input
+        (the duplicate-averaging pass is the builders' own).
+
+        The caller must guarantee the topology is unchanged — compare
+        :func:`~.core.batch.topology_fingerprint` digests, as
+        :class:`PlanPrefetcher` does with ``reuse_plans=True``. The
+        returned plan remembers its parent, so ``settle`` /
+        ``ShardedSettlementSession`` upload only the new probability
+        block and adopt the parent's device-resident topology arrays.
+        """
+        meta = getattr(self, "_refresh_meta", None)
+        if meta is None:
+            raise ValueError(
+                "plan carries no refresh metadata (built before the "
+                "delta-ingest path existed?)"
+            )
+        signal_pairs, counts, slot_of_pair, market_of_pair = meta
+        probabilities = np.ascontiguousarray(probabilities, dtype=np.float64)
+        if probabilities.shape != (len(signal_pairs),):
+            raise ValueError(
+                f"{len(probabilities)} probabilities for a topology of "
+                f"{len(signal_pairs)} signals"
+            )
+        # Same ordered accumulate as the builders (np.add.at in signal
+        # order = the scalar engine's left-to-right duplicate sum).
+        sums = np.zeros(len(counts), dtype=np.float64)
+        np.add.at(sums, signal_pairs, probabilities)
+        pair_mean = sums / np.maximum(counts, 1)
+        probs = np.zeros_like(self.probs)
+        probs[slot_of_pair, market_of_pair] = pair_mean
+        probs.setflags(write=False)
+        plan = SettlementPlan(
+            market_keys=self.market_keys,
+            slot_rows=self.slot_rows,
+            probs=probs,
+            mask=self.mask,
+            signals_per_market=self.signals_per_market,
+            binding=self.binding,
+            fingerprint=self.fingerprint,
+        )
+        object.__setattr__(plan, "_refresh_meta", meta)
+        object.__setattr__(plan, "_refreshed_from", self)
+        touched = getattr(self, "_touched_rows", None)
+        if touched is not None:
+            object.__setattr__(plan, "_touched_rows", touched)
+        return plan
+
 
 def build_settlement_plan(
     store,
     payloads: Payload,
     native: Optional[bool] = None,
     num_slots: "int | str | None" = None,
+    fingerprint: "bool | bytes" = False,
 ) -> SettlementPlan:
     """Pack, intern, and lay out payloads as a dense settlement block.
 
@@ -121,6 +188,11 @@ def build_settlement_plan(
     constant. Note a different K compiles a different slot-reduction
     tree, so consensus values can move ≤1 ulp vs the natural-K plan
     (state updates are quantised ±0.1 steps and typically identical).
+
+    ``fingerprint=True`` stamps the plan with its topology digest
+    (:func:`~.core.batch.topology_fingerprint`) so the plan-reuse path
+    can recognise same-topology successors; a ``bytes`` value attaches a
+    digest the caller already computed.
     """
     payloads = list(payloads)
     keys = [market_id for market_id, _ in payloads]
@@ -133,6 +205,16 @@ def build_settlement_plan(
     rows = store.rows_for_arrays(
         packed.pair_source_ids, pair_markets, allocate=True
     )
+    if fingerprint is True:
+        # Reconstruct the raw per-signal source column (flat_pair encodes
+        # each signal's pair slot; the pair table holds the id).
+        raw_sids = [
+            packed.pair_source_ids[p] for p in packed.flat_pair.tolist()
+        ]
+        signal_offsets = np.concatenate(
+            [[0], np.cumsum(packed.signals_per_market, dtype=np.int64)]
+        )
+        fingerprint = topology_fingerprint(keys, raw_sids, signal_offsets)
     return _assemble_plan(
         keys,
         rows,
@@ -143,6 +225,8 @@ def build_settlement_plan(
         pair_markets.__getitem__,
         packed.signals_per_market,
         num_slots=num_slots,
+        signal_pairs=packed.flat_pair,
+        fingerprint=fingerprint or None,
     )
 
 
@@ -153,6 +237,7 @@ def build_settlement_plan_columnar(
     probabilities,
     offsets,
     num_slots: "int | str | None" = None,
+    fingerprint: "bool | bytes" = False,
 ) -> SettlementPlan:
     """Vectorised twin of :func:`build_settlement_plan` for columnar input.
 
@@ -173,8 +258,8 @@ def build_settlement_plan_columnar(
     * duplicate signals from one (source, market) average in original
       signal order (reference: core.py:115-116).
 
-    ``num_slots`` pins the block's slot height K (see
-    :func:`build_settlement_plan`).
+    ``num_slots`` pins the block's slot height K and ``fingerprint``
+    stamps the topology digest (see :func:`build_settlement_plan`).
     """
     market_keys = list(market_keys)
     if len(set(market_keys)) != len(market_keys):
@@ -234,6 +319,8 @@ def build_settlement_plan_columnar(
     rows = store.rows_for_indexed(
         sid_of_rank, pair_rank, market_keys, pair_market
     )
+    if fingerprint is True:
+        fingerprint = topology_fingerprint(market_keys, source_ids, offsets)
     return _assemble_plan(
         market_keys,
         rows,
@@ -244,6 +331,8 @@ def build_settlement_plan_columnar(
         lambda i: market_keys[pair_market[i]],
         signals_per_market,
         num_slots=num_slots,
+        signal_pairs=pair_of_signal,
+        fingerprint=fingerprint or None,
     )
 
 
@@ -280,8 +369,15 @@ def _assemble_plan(
     market_of,
     signals_per_market,
     num_slots: "int | str | None" = None,
+    signal_pairs=None,
+    fingerprint: "bytes | None" = None,
 ) -> SettlementPlan:
-    """Shared plan tail: dense block fill + binding probes + freeze."""
+    """Shared plan tail: dense block fill + binding probes + freeze.
+
+    ``signal_pairs`` (signal → pair slot, original signal order) is kept
+    as refresh metadata so :meth:`SettlementPlan.refresh` can recompute
+    the probability block for a same-topology batch without re-packing.
+    """
     counts = np.diff(pair_offsets)
     num_markets = len(keys)
     needed_slots = int(counts.max()) if num_markets else 0
@@ -339,12 +435,20 @@ def _assemble_plan(
         mask=mask,
         signals_per_market=np.asarray(signals_per_market, dtype=np.int32),
         binding=binding,
+        fingerprint=fingerprint,
     )
     # Freeze the arrays: settle() caches device copies keyed by the plan
     # object (see SettlementPlan docstring), so host-side mutation after
     # build must fail loudly rather than desync host and device views.
     for array in (plan.slot_rows, plan.probs, plan.mask, plan.signals_per_market):
         array.setflags(write=False)
+    if signal_pairs is not None:
+        signal_pairs = np.ascontiguousarray(signal_pairs)
+        counts = np.bincount(signal_pairs, minlength=len(rows))
+        object.__setattr__(
+            plan, "_refresh_meta",
+            (signal_pairs, counts, slot_of_pair, market_of_pair),
+        )
     return plan
 
 
@@ -598,14 +702,38 @@ def settle(
     # frozen dataclass, hence object.__setattr__ — keyed by dtype.
     device_plan = getattr(plan, "_device_arrays", None)
     if device_plan is None or device_plan[0] != str(cdtype):
-        device_plan = (
-            str(cdtype),
-            jnp.asarray(plan.slot_rows),
-            jnp.asarray(plan.probs, dtype=cdtype),
-            jnp.asarray(plan.mask),
-            jnp.asarray(touched_rows),
+        parent = getattr(plan, "_refreshed_from", None)
+        donor = (
+            getattr(parent, "_device_arrays", None)
+            if parent is not None else None
         )
+        if donor is not None and donor[0] == str(cdtype):
+            # Delta-ingest fast path: a probability-only refresh shares
+            # its topology with the settled parent plan, so only the new
+            # probs block crosses host→device; the parent's device rows/
+            # mask/touched copies transfer over, and its stale probs
+            # block is dropped (donated) rather than pinned twice in HBM.
+            device_plan = (
+                donor[0],
+                donor[1],
+                jnp.asarray(plan.probs, dtype=cdtype),
+                donor[3],
+                donor[4],
+            )
+            object.__setattr__(parent, "_device_arrays", None)
+        else:
+            device_plan = (
+                str(cdtype),
+                jnp.asarray(plan.slot_rows),
+                jnp.asarray(plan.probs, dtype=cdtype),
+                jnp.asarray(plan.mask),
+                jnp.asarray(touched_rows),
+            )
         object.__setattr__(plan, "_device_arrays", device_plan)
+        # The back-reference has served its purpose; dropping it keeps a
+        # long reuse chain from pinning every predecessor plan in memory.
+        if parent is not None:
+            object.__setattr__(plan, "_refreshed_from", None)
     _, slot_rows_d, probs_d, mask_d, touched_d = device_plan
 
     rel, conf, days, exists, consensus, rel_touched = _get_settle_kernel()(
@@ -664,6 +792,12 @@ def _sharded_plan_cache(plan: SettlementPlan, mesh, cdtype, band=None):
     no process ever packs another's payloads; it must tile the process
     band exactly, and the plan must be built with the globally-agreed
     ``num_slots``.
+
+    A plan produced by :meth:`SettlementPlan.refresh` whose parent holds
+    this cache pays only the probability leg: ``band_rows``/``band_mask``
+    and the device-resident ``mask_g`` carry over unchanged, one new
+    ``probs_g`` block is uploaded, and the parent's stale block is
+    dropped (donated) — the sharded delta-ingest fast path.
     """
     from bayesian_consensus_engine_tpu.parallel.distributed import (
         global_slot_block,
@@ -716,19 +850,37 @@ def _sharded_plan_cache(plan: SettlementPlan, mesh, cdtype, band=None):
             )
             return padded[:, cols]
 
-        band_rows = pad_cols(plan.slot_rows, -1)
-        band_mask = pad_cols(plan.mask, False)
+        parent = getattr(plan, "_refreshed_from", None)
+        donor = (
+            getattr(parent, "_sharded_cache", None)
+            if parent is not None else None
+        )
+        if donor is not None and donor[0] == cache_key:
+            # Probability-only refresh: the topology legs (band_rows,
+            # band_mask, device mask_g) are the parent's — identical by
+            # construction — so only the probs block is padded, banded,
+            # and uploaded. Clearing the parent's cache donates its stale
+            # probs_g back to the device allocator.
+            band_rows, band_mask, mask_g = donor[4], donor[5], donor[7]
+            object.__setattr__(parent, "_sharded_cache", None)
+        else:
+            band_rows = pad_cols(plan.slot_rows, -1)
+            band_mask = pad_cols(plan.mask, False)
+            mask_g = global_slot_block(band_mask, mesh, padded_total)
         band_probs = pad_cols(plan.probs, 0.0)
 
         probs_g = global_slot_block(
             band_probs.astype(cdtype), mesh, padded_total
         )
-        mask_g = global_slot_block(band_mask, mesh, padded_total)
         cache = (
             cache_key, padded_total, lo, hi,
             band_rows, band_mask, probs_g, mask_g,
         )
         object.__setattr__(plan, "_sharded_cache", cache)
+        if parent is not None:
+            # Drop the back-reference so long reuse chains don't pin
+            # every predecessor plan (and its host blocks) in memory.
+            object.__setattr__(plan, "_refreshed_from", None)
     return cache[1:]
 
 
@@ -996,6 +1148,37 @@ class ShardedSettlementSession:
             consensus=_BandView(consensus, self._lo, live),
         )
 
+    def refresh(self, plan: SettlementPlan) -> None:
+        """Adopt a probability-only twin of the session's plan in place.
+
+        *plan* must come from :meth:`SettlementPlan.refresh` on this
+        session's current plan (same topology — the slot layout, row
+        mapping, and mask are shared array objects). Only the new probs
+        block is uploaded; ``mask_g`` and the retained block state stay
+        device-resident, and the old probs block is donated. This is the
+        steady-state daily re-settlement shape for a long-lived session:
+        refresh with the day's probabilities, then :meth:`settle` with
+        the day's outcomes — chained-settle semantics throughout (the
+        state never leaves the device between calls).
+        """
+        if plan is self._plan:
+            return
+        if (
+            plan.slot_rows is not self._plan.slot_rows
+            or plan.mask is not self._plan.mask
+        ):
+            raise ValueError(
+                "session refresh needs a probability-only twin of the "
+                "current plan (SettlementPlan.refresh on the same "
+                "topology); this plan has its own slot layout"
+            )
+        (self._padded_total, self._lo, self._hi,
+         self._band_rows, self._band_mask, self._probs_g,
+         self._mask_g) = _sharded_plan_cache(
+            plan, self._mesh, self._cdtype, self._band
+        )
+        self._plan = plan
+
     def sync(self) -> None:
         """Merge every deferred settlement into the host store now."""
         self._store.sync()
@@ -1087,6 +1270,18 @@ class PlanPrefetcher:
     across batches — otherwise each batch's natural K compiles its own
     settle program. A build error surfaces on the ``next()`` that would
     have yielded that plan; later batches are not attempted.
+
+    ``reuse_plans=True`` engages the DELTA-INGEST fast path: each batch's
+    topology is fingerprinted (:func:`~.core.batch.topology_fingerprint`)
+    and, when it matches the previous batch's, the previous plan is
+    :meth:`~SettlementPlan.refresh`-ed with the new probabilities instead
+    of rebuilt — skipping pack, intern, and block fill entirely. A
+    changed topology (drift, reordering, fresh markets) rebuilds. Dict
+    payloads are flattened once to columns
+    (:func:`~.core.batch.columns_from_payloads`) and built through the
+    columnar path, which is bit-identical to the dict path by contract.
+    Refreshed plans settle bit-identically to rebuilt ones (pinned by
+    tests/test_overlap.py).
     """
 
     def __init__(
@@ -1097,23 +1292,46 @@ class PlanPrefetcher:
         num_slots: "int | str | None" = None,
         native: Optional[bool] = None,
         depth: int = 1,
+        reuse_plans: bool = False,
     ) -> None:
         if depth < 1:
             raise ValueError("depth must be >= 1")
         self._queue: "_queue.Queue" = _queue.Queue(maxsize=depth)
         self._cancelled = threading.Event()
         self._sentinel = object()
+        last_plan: "list[Optional[SettlementPlan]]" = [None]
 
         def build(batch):
+            if not reuse_plans:
+                if columnar:
+                    keys, source_ids, probabilities, offsets = batch
+                    return build_settlement_plan_columnar(
+                        store, keys, source_ids, probabilities, offsets,
+                        num_slots=num_slots,
+                    )
+                return build_settlement_plan(
+                    store, batch, native=native, num_slots=num_slots
+                )
             if columnar:
                 keys, source_ids, probabilities, offsets = batch
-                return build_settlement_plan_columnar(
-                    store, keys, source_ids, probabilities, offsets,
-                    num_slots=num_slots,
+                probabilities = np.ascontiguousarray(
+                    probabilities, dtype=np.float64
                 )
-            return build_settlement_plan(
-                store, batch, native=native, num_slots=num_slots
-            )
+            else:
+                keys, source_ids, probabilities, offsets = (
+                    columns_from_payloads(batch)
+                )
+            digest = topology_fingerprint(keys, source_ids, offsets)
+            prev = last_plan[0]
+            if prev is not None and prev.fingerprint == digest:
+                plan = prev.refresh(probabilities)
+            else:
+                plan = build_settlement_plan_columnar(
+                    store, keys, source_ids, probabilities, offsets,
+                    num_slots=num_slots, fingerprint=digest,
+                )
+            last_plan[0] = plan
+            return plan
 
         def work():
             # The iterator itself may raise (a generator streaming payloads
@@ -1212,6 +1430,7 @@ def settle_stream(
     dtype=None,
     lazy_checkpoints: bool = False,
     journal=None,
+    reuse_plans: bool = False,
 ):
     """The streamed settle-and-checkpoint service loop, fully overlapped.
 
@@ -1255,9 +1474,23 @@ def settle_stream(
     and checkpoint files equal the serial build → settle → flush loop
     (pinned by tests/test_overlap.py).
 
+    *reuse_plans* engages the topology-cached DELTA-INGEST fast path: the
+    prefetcher fingerprints each batch's topology and, in the steady
+    state where consecutive batches carry the same (source, market)
+    universe (the reference's daily re-settlement shape), re-plans only
+    the probability columns (:meth:`SettlementPlan.refresh`) — pack,
+    intern, block fill, and the topology half of the host→device upload
+    all drop out of the per-batch cost; the sharded path likewise keeps
+    ``mask_g`` device-resident and uploads only the new probs block. Any
+    topology change (drift, reordering, fresh markets) falls back to a
+    full rebuild for that batch — the fast path is bit-exact with
+    ``reuse_plans=False`` (results, store state, and checkpoint bytes;
+    pinned by tests/test_overlap.py). Per-batch ``stats`` dicts record
+    the hit/miss under ``"plan_reused"``.
+
     *stats*, if given, is a mutable list the service appends one dict per
     batch to: ``{"batch", "markets", "plan_wait_s", "settle_dispatch_s",
-    "checkpoint_s"}``. ``plan_wait_s`` is how long the consumer waited on
+    "checkpoint_s", "plan_reused"}``. ``plan_wait_s`` is how long the consumer waited on
     the prefetch thread (near zero once the pipeline fills; large values
     mean ingest is the bottleneck). ``settle_dispatch_s`` is the HOST
     cost of dispatching the settle — deliberately unfenced: the kernel
@@ -1379,6 +1612,7 @@ def settle_stream(
     flushed_through = -1
     journaled_through = -1
     settled_through = -1
+    journal_write_failed = False
     index = -1
     try:
         with PlanPrefetcher(
@@ -1387,6 +1621,7 @@ def settle_stream(
             columnar=columnar,
             num_slots=num_slots,
             native=native,
+            reuse_plans=reuse_plans,
         ) as plans:
             plan_iter = iter(plans)
             while True:
@@ -1399,6 +1634,11 @@ def settle_stream(
                 index += 1
                 outcomes = outcome_queue.popleft()
                 batch_now = None if now is None else now + index
+                # Captured BEFORE the settle: the delta-upload path
+                # consumes (and drops) the refresh back-reference.
+                plan_reused = (
+                    getattr(plan, "_refreshed_from", None) is not None
+                )
                 settle_start = _time.perf_counter()
                 if mesh is None:
                     result = settle(
@@ -1434,15 +1674,22 @@ def settle_stream(
                             "plan_wait_s": plan_wait_s,
                             "settle_dispatch_s": settle_dispatch_s,
                             "checkpoint_s": None,
+                            "plan_reused": plan_reused,
                         }
                     )
                 due = (index + 1) % checkpoint_every == 0
                 if journal is not None and due:
                     # Rolling durability rides the journal (one fsynced
                     # binary epoch, tag = this settled batch); SQLite is
-                    # the tail flush's job.
+                    # the tail flush's job. A failed epoch write is
+                    # flagged so the exit tail flush does not retry the
+                    # same broken journal and shadow this error.
                     checkpoint_start = _time.perf_counter()
-                    store.flush_to_journal(journal, tag=index)
+                    try:
+                        store.flush_to_journal(journal, tag=index)
+                    except BaseException:
+                        journal_write_failed = True
+                        raise
                     journaled_through = index
                     if stats is not None:
                         stats[-1]["checkpoint_s"] = (
@@ -1468,9 +1715,18 @@ def settle_stream(
         # joined (a background failure must never be dropped) and every
         # fully settled batch reaches the checkpoint file. Tail epochs and
         # flushes cover through ``settled_through`` only — a batch that
-        # RAISED mid-settle is never claimed as durable.
+        # RAISED mid-settle is never claimed as durable. When the loop is
+        # exiting BECAUSE a journal write failed, the tail epoch is
+        # skipped: retrying the broken journal here would raise again
+        # inside this finally and replace the original error (or turn a
+        # GeneratorExit close() into a RuntimeError) — the journal's
+        # durable point is simply the last epoch that landed.
         try:
-            if journal is not None and settled_through > journaled_through:
+            if (
+                journal is not None
+                and not journal_write_failed
+                and settled_through > journaled_through
+            ):
                 store.flush_to_journal(journal, tag=settled_through)
         finally:
             if owns_journal and journal is not None:

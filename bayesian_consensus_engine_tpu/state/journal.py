@@ -42,10 +42,13 @@ File format (all little-endian)::
 
 Recovery (:func:`replay_journal`) replays epochs in order onto a fresh
 store — interning the pair blob in row order reproduces the original row
-assignment exactly — and STOPS at the first truncated or CRC-failing
-epoch: a crash mid-append leaves the journal valid through the last
-complete epoch, which is exactly the durable point the stream last
-reported. The returned ``tag`` is that epoch's watermark; a restarted
+assignment exactly — and STOPS at the first truncated, CRC-failing, or
+semantically malformed epoch (unparseable pair/iso blobs, out-of-bound
+dirty indices — "CRC-of-garbage"): a crash mid-append leaves the journal
+valid through the last complete epoch, which is exactly the durable
+point the stream last reported. The resume scan
+(``JournalWriter(path, resume=True)``) walks the SAME frame decoder, so
+a resumed writer appends exactly where replay stops. The returned ``tag`` is that epoch's watermark; a restarted
 service resumes from ``batches[tag + 1:]`` (see
 examples/fault_tolerant_service.py for the SQLite-recipe sibling).
 """
@@ -63,6 +66,27 @@ import numpy as np
 
 MAGIC = b"BCEJRNL1"
 _EPOCH_HDR = struct.Struct("<QQQQQdQ")
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory holding *path* (standard WAL practice).
+
+    ``os.fsync`` on a file makes its BYTES durable, not its directory
+    entry: a journal created (or renamed into place by compaction)
+    moments before a crash can vanish — or revert to the unlinked-over
+    old file — taking every epoch ``append_epoch`` already reported
+    durable with it. Syncing the parent directory pins the entry itself.
+    """
+    try:
+        fd = os.open(
+            os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY
+        )
+    except OSError:
+        return  # platform can't open directories (e.g. Windows): no-op
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _pack_pair_blob(pairs) -> bytes:
@@ -150,6 +174,10 @@ class JournalWriter:
             self._file.flush()
             if fsync:
                 os.fsync(self._file.fileno())
+                # The file's directory entry must survive a crash too, or
+                # every epoch fsynced into it is durable bytes in an
+                # unreachable inode.
+                _fsync_dir(self._path)
         except Exception:
             self._file.close()
             raise
@@ -272,11 +300,43 @@ def _unpack_iso(blob: bytes, count: int) -> Optional[List[str]]:
     return values
 
 
+def _decode_epoch(fields, body: bytes, expected_rows: int):
+    """Parse one CRC-valid epoch body; None if semantically malformed.
+
+    CRC protects against torn/corrupt WRITES, not against garbage a buggy
+    writer checksummed correctly ("CRC-of-garbage"): pair/iso blobs that
+    fail to parse, or dirty indices at or beyond ``used_after``. Both
+    replay and the resume scan reject these through this one decoder, so
+    the point resume appends at is exactly the point replay stops at.
+    """
+    (_epoch_index, used_after, pair_blob_len, dirty, _iso_blob_len,
+     _wall, _tag) = fields
+    pairs = _unpack_pairs(body[:pair_blob_len], used_after - expected_rows)
+    off = pair_blob_len
+    idx = np.frombuffer(body, np.uint64, dirty, off)
+    off += dirty * 8
+    rel = np.frombuffer(body, np.float64, dirty, off)
+    off += dirty * 8
+    conf = np.frombuffer(body, np.float64, dirty, off)
+    off += dirty * 8
+    days = np.frombuffer(body, np.float64, dirty, off)
+    off += dirty * 8
+    exists = np.frombuffer(body, np.uint8, dirty, off)
+    off += dirty
+    iso_values = _unpack_iso(body[off:], dirty)
+    if pairs is None or iso_values is None or (
+        dirty and idx.max() >= used_after
+    ):
+        return None
+    return pairs, idx, rel, conf, days, exists, iso_values
+
+
 def _iter_frames(f):
-    """Yield ``(header_fields, body, end_offset)`` for each complete,
-    CRC-valid epoch in order, stopping at the first torn or corrupt
-    frame — replay and resume-scan share this walk, so what resume
-    appends after is exactly what replay will see."""
+    """Yield ``(header_fields, decoded, end_offset)`` for each complete,
+    CRC-valid, semantically-valid epoch in order, stopping at the first
+    torn, corrupt, or malformed frame — replay and resume-scan share this
+    walk (decode included), so what resume appends after is exactly what
+    replay will rebuild."""
     expected_epoch = 0
     expected_rows = 0
     while True:
@@ -298,7 +358,10 @@ def _iter_frames(f):
         (crc,) = struct.unpack("<I", crc_raw)
         if zlib.crc32(header + body) != crc:
             return
-        yield fields, body, f.tell()
+        decoded = _decode_epoch(fields, body, expected_rows)
+        if decoded is None:
+            return  # CRC-of-garbage: stop exactly where replay stops
+        yield fields, decoded, f.tell()
         expected_epoch += 1
         expected_rows = used_after
 
@@ -312,7 +375,7 @@ def _scan_valid_end(path):
         epochs = 0
         rows = 0
         tag = None
-        for fields, _body, off in _iter_frames(f):
+        for fields, _decoded, off in _iter_frames(f):
             end = off
             epochs += 1
             rows = fields[1]
@@ -357,6 +420,11 @@ def compact_journal(path: Union[str, Path]) -> int:
             rows = store.flush_to_journal(writer, tag=tag)
         writer.close()
         os.replace(tmp_path, path)
+        # Pin the rename: without a directory fsync a crash here can
+        # revert the path to the unlinked-over OLD journal, silently
+        # losing every epoch appended after this compaction that the
+        # service already reported durable.
+        _fsync_dir(path)
     except Exception:
         writer.close()
         if os.path.exists(tmp_path):
@@ -384,33 +452,12 @@ def replay_journal(path: Union[str, Path]):
     with open(path, "rb") as f:
         if _read_exact(f, len(MAGIC)) != MAGIC:
             raise ValueError(f"{path}: not a BCE journal (bad magic)")
-        expected_rows = 0
-        for fields, body, _off in _iter_frames(f):
-            (_epoch_index, used_after, pair_blob_len, dirty, _iso_blob_len,
-             _wall, tag) = fields
-            pairs = _unpack_pairs(
-                body[:pair_blob_len], used_after - expected_rows
-            )
-            off = pair_blob_len
-            idx = np.frombuffer(body, np.uint64, dirty, off)
-            off += dirty * 8
-            rel = np.frombuffer(body, np.float64, dirty, off)
-            off += dirty * 8
-            conf = np.frombuffer(body, np.float64, dirty, off)
-            off += dirty * 8
-            days = np.frombuffer(body, np.float64, dirty, off)
-            off += dirty * 8
-            exists = np.frombuffer(body, np.uint8, dirty, off)
-            off += dirty
-            iso_values = _unpack_iso(body[off:], dirty)
-            if pairs is None or iso_values is None or (
-                dirty and idx.max() >= used_after
-            ):
-                break  # malformed epoch that still passed CRC-of-garbage
+        for fields, decoded, _off in _iter_frames(f):
+            used_after, tag = fields[1], fields[6]
+            pairs, idx, rel, conf, days, exists, iso_values = decoded
             store._apply_journal_epoch(
                 used_after, pairs, idx.astype(np.int64), rel, conf, days,
                 exists.astype(bool), iso_values,
             )
             last_tag = int(tag)
-            expected_rows = used_after
     return store, last_tag

@@ -13,8 +13,17 @@ The JSON line also carries the **large-K regime** (BASELINE config #5's
 source scale, 16k markets × 10k slots), the **north-star band** (125,056
 markets × 10k slots — the exact per-chip slice of BASELINE.json's 1M×10k
 dense metric on a v5e-8, ~13.8 GB HBM working set), the hand-fused Pallas
-kernel's number at 1M×16 (XLA fusion wins — kept for the record), and the
-full ingest→settle→flush pipeline at 1M markets.
+kernel's number at 1M×16 (XLA fusion wins — kept for the record), the
+full ingest→settle→flush pipeline at 1M markets, and the
+**stable-topology stream** (``e2e_stream_stable_topology``): the daily
+re-settlement steady state A/B'd with ``settle_stream(reuse_plans=)``
+off vs on — the delta-ingest fast path that fingerprints each batch's
+topology and refreshes only the probability columns on a hit. Its
+per-batch ``stats`` dicts add ``plan_reused`` to the stream's
+``{"batch", "markets", "plan_wait_s", "settle_dispatch_s",
+"checkpoint_s"}`` keys, and the leg reports the summed
+``plan_reuse_hits``/``plan_reuse_misses`` plus the off/on
+``reuse_speedup``.
 
 Harness (round 4): the round-3 driver bench died with rc=1 because a
 single hung ``jax.devices()`` during TPU-tunnel bring-up took the whole
@@ -910,6 +919,116 @@ def bench_e2e_stream(markets=NUM_MARKETS, batches=6, mean_slots=4, steps=20,
     }
 
 
+def bench_e2e_stream_stable_topology(markets=NUM_MARKETS, batches=6,
+                                     mean_slots=4, steps=20,
+                                     checkpoint_every=2):
+    """The streamed service in its STEADY STATE: one persistent
+    (source, market) universe re-settled every batch with fresh
+    probabilities/outcomes — the reference's daily re-settlement shape
+    (market.py:200-221) — A/B'd with plan reuse off vs on.
+
+    With ``reuse_plans=False`` every batch pays the full ingest (pack,
+    intern, block fill, topology upload) for a topology that has not
+    changed; with ``True`` the prefetcher fingerprints each batch and
+    refreshes the previous plan's probability columns instead
+    (``SettlementPlan.refresh`` — the delta-ingest fast path, bit-exact
+    with the rebuild path by tests/test_overlap.py). Both runs stream
+    through the same eager rolling-SQLite checkpoint loop, so the delta
+    is the ingest cost alone. ``amortised_1m_cycles_per_sec`` is
+    comparable to ``e2e_stream``'s (same formula); ``plan_reuse_hits``/
+    ``plan_reuse_misses`` come straight from the per-batch ``stats``.
+    ``reuse_speedup`` is the wall-clock ratio (off/on) — the number that
+    adjudicates whether topology caching pays on this host.
+    """
+    import gc
+    import tempfile as _tf
+
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.pipeline import settle_stream
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    per_batch = markets // batches
+    rng = np.random.default_rng(17)
+    # ONE topology for the whole stream (the persistent universe)...
+    counts = rng.poisson(mean_slots - 1, per_batch) + 1
+    total = int(counts.sum())
+    keys = [f"m-{m}" for m in range(per_batch)]
+    sids = [f"src-{v}" for v in rng.integers(0, SOURCE_UNIVERSE, total)]
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    # ...and fresh probabilities/outcomes per batch (the only delta).
+    batch_data = [
+        (
+            (keys, sids, rng.random(total), offsets),
+            (rng.random(per_batch) < 0.5).tolist(),
+        )
+        for _ in range(batches)
+    ]
+    gc.freeze()
+    try:
+        market_cycles = per_batch * batches * steps
+
+        def run(reuse):
+            stats: list = []
+            store = TensorReliabilityStore()
+            with _tf.TemporaryDirectory() as tmp:
+                db = os.path.join(tmp, "stable.db")
+                start = time.perf_counter()
+                for _result in settle_stream(
+                    store, batch_data, steps=steps, now=21_900.0,
+                    db_path=db, checkpoint_every=checkpoint_every,
+                    columnar=True, stats=stats, reuse_plans=reuse,
+                ):
+                    pass
+                store.sync()
+                wall = time.perf_counter() - start
+
+            def sum_of(key):
+                return round(
+                    sum(s[key] for s in stats if s[key] is not None), 2
+                )
+
+            hits = sum(bool(s["plan_reused"]) for s in stats)
+            return wall, {
+                "wall_s": round(wall, 2),
+                "amortised_1m_cycles_per_sec": round(
+                    market_cycles / wall / 1e6, 4
+                ),
+                "ingest_wait_s": sum_of("plan_wait_s"),
+                "settle_dispatch_s": sum_of("settle_dispatch_s"),
+                "checkpoint_s": sum_of("checkpoint_s"),
+                "plan_reuse_hits": hits,
+                "plan_reuse_misses": len(stats) - hits,
+            }
+
+        # Warm the trace/compile caches with one batch (same shapes both
+        # runs settle) so neither timed run pays compilation — whichever
+        # run went first would otherwise eat the whole warmup and the
+        # speedup ratio would measure compile attribution, not ingest.
+        warm_store = TensorReliabilityStore()
+        for _result in settle_stream(
+            warm_store, batch_data[:1], steps=steps, now=21_900.0,
+            columnar=True,
+        ):
+            pass
+        warm_store.sync()
+        wall_off, no_reuse = run(reuse=False)
+        wall_on, reuse = run(reuse=True)
+    finally:
+        gc.unfreeze()
+    return {
+        "workload": (
+            f"{batches} batches x {per_batch} markets x {steps} cycles, "
+            f"STABLE topology, checkpoint every {checkpoint_every}"
+        ),
+        "no_reuse": no_reuse,
+        "reuse": reuse,
+        "reuse_speedup": round(wall_off / wall_on, 3),
+    }
+
+
 def bench_dispatch_rtt(trials=5):
     """Pure tunnel dispatch+fence round trip: a jitted 8-element add.
 
@@ -1374,6 +1493,10 @@ LEGS = {
         bench_e2e_stream, {},
         dict(markets=6000, batches=3, steps=3), 2000,
     ),
+    "e2e_stream_stable_topology": (
+        bench_e2e_stream_stable_topology, {},
+        dict(markets=3000, batches=3, steps=2), 2000,
+    ),
     "tiebreak_10k_agents": (
         bench_tiebreak_stress, {}, dict(markets=64, agents=128, reps=1), 900,
     ),
@@ -1417,6 +1540,7 @@ DEVICE_LEG_ORDER = [
     "e2e_pipeline",
     "e2e_overlap",
     "e2e_stream",
+    "e2e_stream_stable_topology",
     "tiebreak_10k_agents",
     "pallas_ab",
 ]
@@ -1698,6 +1822,9 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         "e2e_pipeline": _show(results, "e2e_pipeline"),
         "e2e_overlap": _show(results, "e2e_overlap"),
         "e2e_stream": _show(results, "e2e_stream"),
+        "e2e_stream_stable_topology": _show(
+            results, "e2e_stream_stable_topology"
+        ),
         # Fallback-only leg: absent (not "failed") on healthy runs.
         **(
             {"e2e_stream_cpu": _show(results, "e2e_stream_cpu")}

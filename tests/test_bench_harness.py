@@ -366,6 +366,41 @@ class TestOrchestrate:
         )
 
 
+class TestStableTopologyLeg:
+    """The delta-ingest A/B leg (``e2e_stream_stable_topology``) at --fast
+    shapes: the steady-state re-settlement workload runs both with and
+    without plan reuse and reports the hit/miss accounting the per-batch
+    ``stats`` dicts carry. Bit-parity of the two paths is pinned by
+    tests/test_overlap.py; this pins the LEG's contract (shape of the
+    JSON, reuse engaging at all)."""
+
+    def test_fast_leg_reports_reuse_accounting(self):
+        result = bench.run_leg_inprocess(
+            "e2e_stream_stable_topology", fast=True
+        )
+        fast_kwargs = bench.LEGS["e2e_stream_stable_topology"][2]
+        batches = fast_kwargs["batches"]
+        for side in ("no_reuse", "reuse"):
+            for key in (
+                "wall_s", "amortised_1m_cycles_per_sec", "ingest_wait_s",
+                "settle_dispatch_s", "checkpoint_s", "plan_reuse_hits",
+                "plan_reuse_misses",
+            ):
+                assert key in result[side], (side, key)
+        # Rebuild path never reuses; the fast path misses only batch 0
+        # (one topology for the whole stream).
+        assert result["no_reuse"]["plan_reuse_hits"] == 0
+        assert result["no_reuse"]["plan_reuse_misses"] == batches
+        assert result["reuse"]["plan_reuse_hits"] == batches - 1
+        assert result["reuse"]["plan_reuse_misses"] == 1
+        assert result["reuse_speedup"] > 0
+        json.dumps(result)
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_stream_stable_topology" in bench.LEGS
+        assert "e2e_stream_stable_topology" in bench.DEVICE_LEG_ORDER
+
+
 @pytest.mark.slow
 class TestEndToEndFast:
     def test_fast_cpu_run_produces_driver_json(self, monkeypatch):

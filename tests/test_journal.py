@@ -350,6 +350,172 @@ class TestSettleStreamJournal:
         assert tag == 0
 
 
+class TestDirectoryFsync:
+    """append_epoch's durability contract covers the directory ENTRY, not
+    just the file bytes: a fresh journal (and a compaction's os.replace)
+    must fsync the parent directory, or a crash can unlink every epoch the
+    service already reported durable (ADVICE round 5, medium)."""
+
+    @staticmethod
+    def _fsync_log(monkeypatch):
+        import os as _os
+        import stat as _stat
+
+        real_fsync = _os.fsync
+        log = []
+
+        def logging_fsync(fd):
+            kind = (
+                "dir" if _stat.S_ISDIR(_os.fstat(fd).st_mode) else "file"
+            )
+            log.append(kind)
+            return real_fsync(fd)
+
+        monkeypatch.setattr(_os, "fsync", logging_fsync)
+        return log
+
+    def test_fresh_journal_fsyncs_parent_directory(self, tmp_path,
+                                                   monkeypatch):
+        log = self._fsync_log(monkeypatch)
+        JournalWriter(tmp_path / "fresh.jrnl").close()
+        assert "dir" in log, "journal creation never pinned its dir entry"
+
+    def test_append_epoch_fsyncs_the_file(self, tmp_path, monkeypatch):
+        store = seeded_store(n=4)
+        journal = JournalWriter(tmp_path / "a.jrnl")
+        log = self._fsync_log(monkeypatch)
+        with journal:
+            store.flush_to_journal(journal, tag=0)
+        assert "file" in log
+
+    def test_fsync_false_skips_both(self, tmp_path, monkeypatch):
+        log = self._fsync_log(monkeypatch)
+        with JournalWriter(tmp_path / "nf.jrnl", fsync=False) as journal:
+            seeded_store(n=3).flush_to_journal(journal)
+        assert log == []
+
+    def test_compaction_fsyncs_directory_after_replace(self, tmp_path,
+                                                       monkeypatch):
+        import os as _os
+
+        from bayesian_consensus_engine_tpu.state.journal import (
+            compact_journal,
+        )
+
+        path = tmp_path / "c.jrnl"
+        store = seeded_store(n=10)
+        with JournalWriter(path) as journal:
+            store.flush_to_journal(journal, tag=0)
+            store.update_reliability("src-1", "mkt-1", True)
+            store.flush_to_journal(journal, tag=1)
+
+        events = []
+        log = self._fsync_log(monkeypatch)
+        real_replace = _os.replace
+
+        def logging_replace(src, dst):
+            events.append(("replace", len(log)))
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(_os, "replace", logging_replace)
+        compact_journal(path)
+        (replace_event,) = [e for e in events if e[0] == "replace"]
+        # At least one DIRECTORY fsync lands after the rename — the one
+        # that pins the swapped entry against a crash-revert.
+        assert "dir" in log[replace_event[1]:], (
+            "os.replace was never followed by a directory fsync"
+        )
+        replayed, tag = replay_journal(path)
+        assert tag == 1
+        assert store_fingerprint(replayed) == store_fingerprint(store)
+
+
+def _append_raw_frame(path, epoch_index, used_after, pair_blob, idx,
+                      iso_values, tag=0):
+    """Append a CRC-VALID frame with caller-controlled (possibly garbage)
+    semantics — the 'CRC-of-garbage' shape a buggy writer produces."""
+    import struct
+    import zlib
+
+    from bayesian_consensus_engine_tpu.state.journal import _EPOCH_HDR
+
+    iso_blob = b"".join(
+        struct.pack("<I", len(v.encode())) + v.encode() for v in iso_values
+    )
+    dirty = len(idx)
+    header = _EPOCH_HDR.pack(
+        epoch_index, used_after, len(pair_blob), dirty, len(iso_blob),
+        0.0, tag,
+    )
+    payload = b"".join(
+        (
+            header,
+            pair_blob,
+            np.asarray(idx, np.uint64).tobytes(),
+            np.full(dirty, 0.5, np.float64).tobytes(),
+            np.full(dirty, 0.5, np.float64).tobytes(),
+            np.zeros(dirty, np.float64).tobytes(),
+            np.ones(dirty, np.uint8).tobytes(),
+            iso_blob,
+        )
+    )
+    with open(path, "ab") as f:
+        f.write(payload)
+        f.write(struct.pack("<I", zlib.crc32(payload)))
+
+
+class TestSemanticResumeScan:
+    """The resume scan must apply the SAME semantic checks replay does
+    (ADVICE round 5, low): a CRC-valid but malformed epoch otherwise makes
+    a resumed writer append after a frame replay stops before, surfacing
+    later as a row-count mismatch in flush_to_journal."""
+
+    def _journal_with_garbage_tail(self, tmp_path, kind):
+        store = seeded_store(n=8)
+        path = tmp_path / "g.jrnl"
+        with JournalWriter(path) as journal:
+            store.flush_to_journal(journal, tag=0)
+        rows = len(store)
+        if kind == "idx_out_of_bounds":
+            _append_raw_frame(
+                path, 1, rows, b"", [rows + 7],
+                ["2026-08-01T00:00:00+00:00"], tag=1,
+            )
+        elif kind == "unparseable_pair_blob":
+            # Claims one new pair but ships an empty blob.
+            _append_raw_frame(path, 1, rows + 1, b"", [0], ["x"], tag=1)
+        else:
+            raise AssertionError(kind)
+        return path, store, rows
+
+    @pytest.mark.parametrize(
+        "kind", ["idx_out_of_bounds", "unparseable_pair_blob"]
+    )
+    def test_replay_and_resume_stop_at_the_same_epoch(self, tmp_path, kind):
+        path, store, rows = self._journal_with_garbage_tail(tmp_path, kind)
+        replayed, tag = replay_journal(path)
+        assert tag == 0  # the garbage epoch never lands
+        with JournalWriter(path, resume=True) as journal:
+            # Resume agrees with replay: appends AFTER epoch 0, covering
+            # exactly the rows replay rebuilt — no late row-count mismatch.
+            assert journal.epoch_index == 1
+            assert journal.rows_covered == rows == len(replayed)
+            replayed._journal_dirty[:] = False
+            replayed.update_reliability("src-2", "mkt-2", True)
+            assert replayed.flush_to_journal(journal, tag=5) == 1
+        rere, tag = replay_journal(path)
+        assert tag == 5
+        assert store_fingerprint(rere) == store_fingerprint(replayed)
+
+    def test_garbage_tail_is_truncated_by_resume(self, tmp_path):
+        path, _store, _rows = self._journal_with_garbage_tail(
+            tmp_path, "idx_out_of_bounds"
+        )
+        before = path.stat().st_size
+        JournalWriter(path, resume=True).close()
+        assert path.stat().st_size < before
+
+
 class TestWriterValidation:
     def test_used_after_regression_rejected(self, tmp_path):
         with JournalWriter(tmp_path / "v.jrnl") as journal:

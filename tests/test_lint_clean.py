@@ -31,7 +31,7 @@ PKG = lint_config.PACKAGE
 #: acceptance criterion) plus the three new families.
 _DEVLINT_IDS = ("F401", "F541", "F811", "F821", "F841", "E711", "E712", "E722")
 _NEW_FAMILY_IDS = (
-    "JX101", "JX102", "JX103", "JX104", "JX105", "JX106", "JX107",
+    "JX101", "JX102", "JX103", "JX104", "JX105", "JX106", "JX107", "JX108",
     "DT201", "DT202", "DT203",
     "LY301", "LY302",
 )
@@ -73,6 +73,14 @@ _CASES = [
     (
         "JX105",
         f"{PKG}/parallel/case.py",
+        "import jax\n\ndef step(state, x):\n    return state + x\n\n"
+        "step_fast = jax.jit(step)\n",
+        "import jax\n\ndef step(state, x):\n    return state + x\n\n"
+        "step_fast = jax.jit(step, donate_argnums=(0,))\n",
+    ),
+    (
+        "JX108",
+        f"{PKG}/state/case.py",  # in the package, OUTSIDE the hot paths
         "import jax\n\ndef step(state, x):\n    return state + x\n\n"
         "step_fast = jax.jit(step)\n",
         "import jax\n\ndef step(state, x):\n    return state + x\n\n"
@@ -289,6 +297,75 @@ class TestCliContract:
         assert finding["rule_id"] == "F541"
         assert finding["line"] == 1
         assert finding["severity"] == "error"
+
+
+class TestSeverityTiers:
+    """The two-tier contract: ``error`` gates (CLI exit 1, bench/perf_lab
+    refuse to measure), ``warning`` is advisory — printed everywhere,
+    failing nothing."""
+
+    _BAD_WARM = (
+        "import jax\n\ndef step(state, x):\n    return state + x\n\n"
+        "step_fast = jax.jit(step)\n"
+    )
+
+    def test_jx108_is_warning_tier(self):
+        assert RULES["JX108"].severity == "warning"
+        (finding,) = [
+            f for f in check_source(
+                self._BAD_WARM, f"{PKG}/state/case.py", select=["JX108"]
+            )
+        ]
+        assert finding.severity == "warning"
+        assert "[warning]" in finding.render()
+
+    def test_same_shape_in_a_hot_path_stays_error_tier(self):
+        (finding,) = check_source(
+            self._BAD_WARM, f"{PKG}/core/case.py", select=["JX105", "JX108"]
+        )
+        assert finding.rule_id == "JX105"
+        assert finding.severity == "error"
+
+    def test_registry_rejects_unknown_severity(self):
+        from bayesian_consensus_engine_tpu.lint.registry import rule
+
+        with pytest.raises(ValueError, match="severity"):
+            rule("ZZ999", name="bad-tier", rationale="x", severity="fatal")(
+                lambda ctx: ()
+            )
+
+    def test_cli_exits_0_on_warnings_only(self, tmp_path, capsys,
+                                          monkeypatch):
+        from bayesian_consensus_engine_tpu.lint import engine
+
+        case = tmp_path / PKG / "state" / "case.py"
+        case.parent.mkdir(parents=True)
+        case.write_text(self._BAD_WARM)
+        monkeypatch.setattr(engine, "_repo_root", lambda: tmp_path)
+        rc = engine.main(["--select", "JX108", f"{PKG}/state/case.py"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "JX108 [warning]" in out
+        assert "1 warnings" in out and "0 errors" in out
+
+    def test_bench_gate_passes_warnings_fails_errors(self, monkeypatch,
+                                                     capsys):
+        import bench
+        from bayesian_consensus_engine_tpu import lint
+        from bayesian_consensus_engine_tpu.lint.engine import Finding
+
+        warning = Finding("x.py", 1, "JX108", "advisory", "warning")
+        error = Finding("y.py", 2, "JX105", "gating", "error")
+
+        monkeypatch.setattr(lint, "run", lambda: (1, [warning]))
+        bench.lint_gate(skip=False)  # warnings only: the gate passes...
+        assert "JX108" in capsys.readouterr().err  # ...but still prints
+
+        monkeypatch.setattr(lint, "run", lambda: (2, [warning, error]))
+        with pytest.raises(SystemExit):
+            bench.lint_gate(skip=False)
+        err = capsys.readouterr().err
+        assert "1 findings above" in err  # errors counted, warnings not
 
 
 class TestDocsCatalog:

@@ -671,6 +671,343 @@ class TestSettleStreamColumnar:
             assert s["settle_dispatch_s"] >= 0
 
 
+def stable_topology_batches(num_batches=4, markets=9, universe=12, seed=23,
+                            duplicates=False):
+    """One persistent (source, market) universe, fresh probabilities and
+    outcomes per batch — the reference's daily re-settlement shape and the
+    steady state the delta-ingest fast path exists for."""
+    rng = random.Random(seed)
+    base = []
+    for m in range(markets):
+        n = rng.randint(1, 4)
+        sids = [f"src-{rng.randrange(universe)}" for _ in range(n)]
+        if duplicates and n > 1:
+            sids[-1] = sids[0]  # same (source, market) twice per market
+        base.append((f"mkt-r{m}", sids))
+    batches = []
+    for _ in range(num_batches):
+        payloads = [
+            (
+                market_id,
+                [
+                    {"sourceId": sid, "probability": round(rng.random(), 6)}
+                    for sid in sids
+                ],
+            )
+            for market_id, sids in base
+        ]
+        outcomes = [rng.random() < 0.5 for _ in range(markets)]
+        batches.append((payloads, outcomes))
+    return batches
+
+
+class TestPlanReuse:
+    """The topology-cached delta-ingest fast path: reuse_plans=True must be
+    bit-exact with the rebuild path — results, store state, and checkpoint
+    BYTES — and any topology change must force a rebuild."""
+
+    def _stream(self, batches, db, reuse, stats=None, mesh=None,
+                columnar=False, now=21_300.0):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        store = TensorReliabilityStore()
+        results = list(
+            settle_stream(
+                store, batches, steps=2, now=now, db_path=db,
+                checkpoint_every=2, stats=stats, reuse_plans=reuse,
+                mesh=mesh, columnar=columnar,
+            )
+        )
+        store.sync()
+        return store, results
+
+    def _assert_bit_equal(self, tmp_path, batches, mesh=None,
+                          columnar=False):
+        off_db = tmp_path / "off.db"
+        on_db = tmp_path / "on.db"
+        off_stats, on_stats = [], []
+        off_store, off_results = self._stream(
+            batches, off_db, False, off_stats, mesh, columnar
+        )
+        on_store, on_results = self._stream(
+            batches, on_db, True, on_stats, mesh, columnar
+        )
+        for mine, ref in zip(on_results, off_results):
+            assert mine.market_keys == ref.market_keys
+            np.testing.assert_array_equal(
+                np.asarray(mine.consensus), np.asarray(ref.consensus)
+            )
+        assert on_store.list_sources() == off_store.list_sources()
+        # The interchange files must be identical to the BYTE: the reuse
+        # path fed the exact same rows through the exact same flushes.
+        assert on_db.read_bytes() == off_db.read_bytes()
+        return off_stats, on_stats
+
+    def test_stable_topology_stream_is_bit_exact_with_rebuild(self,
+                                                              tmp_path):
+        batches = stable_topology_batches()
+        off_stats, on_stats = self._assert_bit_equal(tmp_path, batches)
+        # Rebuild path never reuses; fast path misses only batch 0.
+        assert [s["plan_reused"] for s in off_stats] == [False] * 4
+        assert [s["plan_reused"] for s in on_stats] == [
+            False, True, True, True,
+        ]
+
+    def test_duplicate_signals_reuse_parity(self, tmp_path):
+        # Duplicate (source, market) signals exercise the refresh path's
+        # ordered accumulate — the float-summation-order contract.
+        batches = stable_topology_batches(duplicates=True)
+        _, on_stats = self._assert_bit_equal(tmp_path, batches)
+        assert [s["plan_reused"] for s in on_stats] == [
+            False, True, True, True,
+        ]
+
+    def test_columnar_stream_reuse_parity(self, tmp_path):
+        def to_columns(payloads):
+            keys = [market_id for market_id, _ in payloads]
+            source_ids, probs, offsets = [], [], [0]
+            for _, signals in payloads:
+                for signal in signals:
+                    source_ids.append(signal["sourceId"])
+                    probs.append(signal["probability"])
+                offsets.append(len(source_ids))
+            return (
+                keys,
+                source_ids,
+                np.asarray(probs, dtype=np.float64),
+                np.asarray(offsets, dtype=np.int64),
+            )
+
+        batches = [
+            (to_columns(p), o) for p, o in stable_topology_batches(seed=29)
+        ]
+        _, on_stats = self._assert_bit_equal(
+            tmp_path, batches, columnar=True
+        )
+        assert [s["plan_reused"] for s in on_stats] == [
+            False, True, True, True,
+        ]
+
+    def test_sharded_stream_reuse_parity(self, tmp_path):
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+
+        batches = stable_topology_batches(seed=37)
+        _, on_stats = self._assert_bit_equal(
+            tmp_path, batches, mesh=make_mesh()  # markets-only: bit-exact
+        )
+        assert [s["plan_reused"] for s in on_stats] == [
+            False, True, True, True,
+        ]
+
+    def test_reordered_markets_force_rebuild(self, tmp_path):
+        # Same signals, markets permuted in batch 1: per-market float
+        # summation order changes, so the fingerprint MUST miss and the
+        # stream must stay exact (vs the rebuild path on the same input).
+        batches = stable_topology_batches(num_batches=3, seed=41)
+        payloads, outcomes = batches[1]
+        batches[1] = (list(reversed(payloads)), list(reversed(outcomes)))
+        off_stats, on_stats = self._assert_bit_equal(tmp_path, batches)
+        assert [s["plan_reused"] for s in on_stats] == [
+            # Batch 1's reorder misses, and batch 2 (back in the original
+            # order) misses against batch 1's reordered fingerprint.
+            False, False, False,
+        ]
+
+    def test_reordered_signals_within_market_force_rebuild(self, tmp_path):
+        batches = stable_topology_batches(num_batches=3, seed=43)
+        payloads, outcomes = batches[1]
+        batches[1] = (
+            [(mid, list(reversed(signals))) for mid, signals in payloads],
+            outcomes,
+        )
+        _, on_stats = self._assert_bit_equal(tmp_path, batches)
+        assert on_stats[1]["plan_reused"] is False
+
+    def test_topology_drift_rebuilds_then_reuses_again(self, tmp_path):
+        # A fresh market joining mid-stream (capacity/universe drift) must
+        # rebuild that batch; the NEW topology then reuses from there on.
+        stable = stable_topology_batches(num_batches=2, seed=47)
+        grown = stable_topology_batches(
+            num_batches=2, markets=10, seed=47
+        )
+        batches = stable + grown
+        _, on_stats = self._assert_bit_equal(tmp_path, batches)
+        assert [s["plan_reused"] for s in on_stats] == [
+            False, True, False, True,
+        ]
+
+
+class TestTopologyFingerprint:
+    def _columns(self, payloads):
+        from bayesian_consensus_engine_tpu.core.batch import (
+            columns_from_payloads,
+        )
+
+        keys, sids, _probs, offsets = columns_from_payloads(payloads)
+        return keys, sids, offsets
+
+    def test_probability_change_keeps_digest(self):
+        from bayesian_consensus_engine_tpu.core.batch import (
+            topology_fingerprint,
+        )
+
+        a = [("m-1", [{"sourceId": "s1", "probability": 0.25},
+                      {"sourceId": "s2", "probability": 0.5}])]
+        b = [("m-1", [{"sourceId": "s1", "probability": 0.75},
+                      {"sourceId": "s2", "probability": 0.125}])]
+        assert topology_fingerprint(*self._columns(a)) == \
+            topology_fingerprint(*self._columns(b))
+
+    def test_order_and_boundary_sensitivity(self):
+        from bayesian_consensus_engine_tpu.core.batch import (
+            topology_fingerprint,
+        )
+
+        def digest(keys, sids, offsets):
+            return topology_fingerprint(
+                keys, sids, np.asarray(offsets, dtype=np.int64)
+            )
+
+        base = digest(["m1", "m2"], ["a", "b", "c"], [0, 2, 3])
+        # Market order, source order, and signal→market assignment all
+        # feed the float-summation-order contract: each must change it.
+        assert digest(["m2", "m1"], ["a", "b", "c"], [0, 2, 3]) != base
+        assert digest(["m1", "m2"], ["b", "a", "c"], [0, 2, 3]) != base
+        assert digest(["m1", "m2"], ["a", "b", "c"], [0, 1, 3]) != base
+        # Length-delimited ids: shifting bytes between adjacent ids must
+        # not collide ("ab","c" vs "a","bc").
+        assert digest(["m1"], ["ab", "c"], [0, 2]) != \
+            digest(["m1"], ["a", "bc"], [0, 2])
+        assert digest(["m1m2"], ["a"], [0, 1]) != \
+            digest(["m1", "m2"], ["a"], [0, 0, 1])
+
+    def test_refresh_twin_is_bitwise_equal_to_rebuilt_plan(self):
+        from bayesian_consensus_engine_tpu.core.batch import (
+            columns_from_payloads,
+        )
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan_columnar,
+        )
+
+        batches = stable_topology_batches(num_batches=2, seed=53)
+        cols = [columns_from_payloads(p) for p, _ in batches]
+
+        store = TensorReliabilityStore()
+        plan0 = build_settlement_plan_columnar(
+            store, *cols[0], fingerprint=True
+        )
+        refreshed = plan0.refresh(cols[1][2])
+
+        twin_store = TensorReliabilityStore()
+        build_settlement_plan_columnar(twin_store, *cols[0])
+        rebuilt = build_settlement_plan_columnar(twin_store, *cols[1])
+
+        np.testing.assert_array_equal(refreshed.probs, rebuilt.probs)
+        assert refreshed.binding == rebuilt.binding
+        # Topology arrays are SHARED with the parent, not copied.
+        assert refreshed.slot_rows is plan0.slot_rows
+        assert refreshed.mask is plan0.mask
+        assert refreshed.fingerprint == plan0.fingerprint
+        assert not refreshed.probs.flags.writeable
+
+    def test_refresh_validates_probability_count(self):
+        from bayesian_consensus_engine_tpu.core.batch import (
+            columns_from_payloads,
+        )
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan_columnar,
+        )
+
+        (payloads, _), = stable_topology_batches(num_batches=1, seed=59)
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan_columnar(
+            store, *columns_from_payloads(payloads)
+        )
+        with pytest.raises(ValueError, match="probabilities"):
+            plan.refresh(np.zeros(1))
+
+    def test_refresh_without_metadata_rejected(self):
+        import dataclasses
+
+        (payloads, _), = stable_topology_batches(num_batches=1, seed=59)
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads)
+        # dataclasses.replace drops the object.__setattr__ sidecars — the
+        # shape of a plan minted before the delta-ingest path existed.
+        bare = dataclasses.replace(plan)
+        with pytest.raises(ValueError, match="refresh metadata"):
+            bare.refresh(np.zeros(4))
+
+    def test_session_refresh_delta_matches_rebuilt_sessions(self):
+        """A LONG-LIVED sharded session taking probability-only refreshes
+        must equal per-batch rebuilt sessions bit-for-bit (markets-only
+        mesh) — the chained device-resident daily re-settlement shape."""
+        from bayesian_consensus_engine_tpu.core.batch import (
+            columns_from_payloads,
+        )
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+            build_settlement_plan_columnar,
+        )
+
+        batches = stable_topology_batches(num_batches=3, seed=61)
+        cols = [columns_from_payloads(p) for p, _ in batches]
+        outcomes = [o for _, o in batches]
+        mesh = make_mesh()
+
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan_columnar(
+            store, *cols[0], num_slots="bucket", fingerprint=True
+        )
+        session = ShardedSettlementSession(store, plan, mesh)
+        results = [session.settle(outcomes[0], steps=2, now=21_400.0)]
+        for i in (1, 2):
+            plan = plan.refresh(cols[i][2])
+            session.refresh(plan)
+            results.append(
+                session.settle(outcomes[i], steps=2, now=21_400.0 + i)
+            )
+        session.close()
+
+        ref_store = TensorReliabilityStore()
+        ref_results = []
+        for i in range(3):
+            ref_plan = build_settlement_plan_columnar(
+                ref_store, *cols[i], num_slots="bucket"
+            )
+            with ShardedSettlementSession(
+                ref_store, ref_plan, mesh
+            ) as ref_session:
+                ref_results.append(
+                    ref_session.settle(outcomes[i], steps=2, now=21_400.0 + i)
+                )
+        for mine, ref in zip(results, ref_results):
+            assert mine.market_keys == ref.market_keys
+            np.testing.assert_array_equal(
+                np.asarray(mine.consensus), np.asarray(ref.consensus)
+            )
+        assert store.list_sources() == ref_store.list_sources()
+
+    def test_session_refresh_rejects_foreign_plan(self):
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+        )
+
+        batches = stable_topology_batches(num_batches=2, seed=67)
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(
+            store, batches[0][0], num_slots="bucket"
+        )
+        other = build_settlement_plan(
+            store, batches[1][0], num_slots="bucket"
+        )
+        with ShardedSettlementSession(store, plan, make_mesh()) as session:
+            with pytest.raises(ValueError, match="probability-only twin"):
+                session.refresh(other)
+
+
 class TestSettleStreamSharded:
     """settle_stream(mesh=...): the streamed service loop over a device
     mesh must equal the flat stream — bit-identical on a markets-only
