@@ -17,7 +17,8 @@ Extensions (additive, do not change reference-shaped outputs): ``--backend
 (state/journal.py) and exports the reference-compatible SQLite file to
 ``--db`` — the crash-recovery path without writing Python; ``lint`` runs
 graftlint, the repo's JAX/determinism/layering static analysis
-(docs/static-analysis.md).
+(docs/static-analysis.md); ``stats`` renders an obs run ledger
+(obs/ledger.py JSONL — the min-of-N bench discipline) as per-leg bands.
 """
 
 from __future__ import annotations
@@ -208,6 +209,36 @@ def _run_list_sources(args: argparse.Namespace) -> None:
         raise SystemExit(1) from exc
 
 
+def _run_stats(args: argparse.Namespace) -> None:
+    """Render an obs run ledger (bench/soak JSONL) as per-leg bands.
+
+    The reading half of the min-of-N discipline: every bench/soak capture
+    appends ledger records (``bench.py --ledger``, obs/ledger.py); this
+    subcommand folds one file into per-leg min/max bands with the host
+    load that attributes the spread. ``--json`` emits the machine-shaped
+    summary instead of the table.
+    """
+    from bayesian_consensus_engine_tpu.obs.ledger import (
+        read_ledger,
+        render,
+        summarize,
+    )
+
+    try:
+        records = read_ledger(args.ledger)
+    except (OSError, ValueError) as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+    if args.leg:
+        records = [r for r in records if r.get("leg") == args.leg]
+    if args.json:
+        _emit({"ledger": args.ledger, "records": len(records),
+               "legs": summarize(records)})
+    else:
+        print(f"{args.ledger}: {len(records)} records")
+        print(render(records))
+
+
 def _run_lint(args: argparse.Namespace) -> None:
     # Lazy import: the lint engine is tool code and the hot CLI paths
     # (consensus on stdin) should not pay for loading it.
@@ -302,6 +333,23 @@ def build_parser() -> argparse.ArgumentParser:
         "journal", help="path to the journal written by settle_stream"
     )
     journal.set_defaults(handler=_run_journal_export)
+
+    stats = sub.add_parser(
+        "stats",
+        help=(
+            "render an obs run ledger (bench/soak JSONL) as per-leg "
+            "min/max bands with host-load attribution"
+        ),
+    )
+    stats.add_argument(
+        "ledger", help="path to a JSONL run ledger (bench.py --ledger)"
+    )
+    stats.add_argument("--leg", help="restrict to one leg name")
+    stats.add_argument(
+        "--json", action="store_true",
+        help="machine-readable summary instead of the table",
+    )
+    stats.set_defaults(handler=_run_stats)
 
     lint = sub.add_parser(
         "lint",

@@ -34,6 +34,7 @@ DEFAULT_PATHS = [
 LAYERS: dict[str, int] = {
     "_native": 0,
     "lint": 0,
+    "obs": 0,
     "utils": 0,
     "ops": 1,
     "core": 2,
@@ -47,10 +48,24 @@ LAYERS: dict[str, int] = {
 }
 
 #: Segments whose allowed intra-package imports are pinned to an explicit
-#: set instead of the numeric rule. ``lint`` imports nothing.
+#: set instead of the numeric rule. ``lint`` imports nothing; ``obs`` is
+#: stdlib-only instrumentation and imports nothing either.
 LAYER_IMPORT_OVERRIDES: dict[str, frozenset[str]] = {
     "lint": frozenset(),
+    "obs": frozenset(),
 }
+
+#: Segments allowed to import ``obs`` (LY303). Observability is an
+#: orchestration concern: the streamed service, the state tiers whose
+#: fsync/export phases it names, and the CLI that renders ledgers. The
+#: pure-math layers (``ops``, ``parallel``, ``core``, ``models``,
+#: ``utils``) must stay instrumentation-free — a kernel module that grows
+#: a host-side timing dependency is a kernel module one refactor away
+#: from a host sync. bench/scripts/tests live outside the package and
+#: are unconstrained.
+OBS_ALLOWED_IMPORTERS: frozenset[str] = frozenset(
+    {"obs", "pipeline", "state", "cli", "__init__"}
+)
 
 #: Deliberate exceptions to the layer map: (importer_segment,
 #: imported_segment) pairs. Keep this empty; every entry is debt.
@@ -78,7 +93,9 @@ CLOCK_FREE_PREFIXES = (
 )
 
 #: The record/serialization layer: DT203 (dict-order-sensitive dumps).
-SERIALIZATION_PREFIXES = (f"{PACKAGE}/state/",)
+#: ``obs`` is held to its own deterministic-export promise: ledger lines
+#: and metric exports must be byte-stable across dict orderings.
+SERIALIZATION_PREFIXES = (f"{PACKAGE}/state/", f"{PACKAGE}/obs/")
 
 
 def in_package(rel: str | None) -> bool:

@@ -5,7 +5,9 @@ are how upward dependencies hide) and checks the importer's package
 segment against the imported segment's layer number. LY302 forbids
 import-time JAX backend calls: a module-level ``jnp.…(…)`` constant
 anywhere in the package breaks ``jax.distributed.initialize()`` for every
-cluster user (it happened — see tests/test_import_hygiene.py).
+cluster user (it happened — see tests/test_import_hygiene.py). LY303
+confines ``obs`` (host-side observability) to the orchestration layers —
+the numeric map alone would let a kernel module import it.
 """
 
 from __future__ import annotations
@@ -116,6 +118,32 @@ def check_layer_imports(ctx):
                 f"upward import: `{seg}` (layer {own_layer}) imports "
                 f"`{tseg}` (layer {tlayer}) — invert the dependency or "
                 "move the code"
+            )
+
+
+@rule(
+    "LY303",
+    name="obs-outside-orchestration",
+    rationale=(
+        "obs (metrics/timeline/ledger) is host-side instrumentation for "
+        "the orchestration layers; a pure-math module that imports it is "
+        "one refactor away from reading wall clock inside a kernel — "
+        "only the segments in lint/config.OBS_ALLOWED_IMPORTERS may "
+        "import obs"
+    ),
+    scope=_package,
+)
+def check_obs_imports(ctx):
+    seg = config.segment_of(ctx.rel)
+    if seg is None or seg in config.OBS_ALLOWED_IMPORTERS:
+        return
+    for lineno, target in _imported_modules(ctx):
+        if _segment_of_module(target) == "obs":
+            allowed = ", ".join(sorted(config.OBS_ALLOWED_IMPORTERS))
+            yield lineno, (
+                f"`{seg}` imports `obs` — observability is confined to "
+                f"the orchestration layers ({allowed}); pure-math "
+                "modules stay instrumentation-free"
             )
 
 

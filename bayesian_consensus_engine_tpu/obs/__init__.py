@@ -1,0 +1,67 @@
+"""obs — host-side observability: metrics, phase timelines, run ledger.
+
+Three pillars, one contract:
+
+* :mod:`~.obs.metrics` — process-local counters/gauges/log-spaced
+  histograms with deterministic sorted-JSON export and a zero-overhead
+  null-object disabled mode (the default).
+* :mod:`~.obs.timeline` — named ``perf_counter`` phase spans with
+  exclusive attribution, thread-locally activated, so a leg's wall clock
+  decomposes additively into the canonical :data:`~.obs.timeline.PHASES`.
+* :mod:`~.obs.ledger` — an append-only JSONL record of every bench/soak
+  measurement (host load, backend, repeat index) plus the min-of-N
+  repeat-policy helpers; rendered by ``bce-tpu stats``.
+
+The contract: obs is pure host, stdlib-only, never traced, and write-only
+from the engine's point of view — enabling it changes NO settlement byte
+(golden-fixture parity pinned by tests/test_obs.py) and importing it is
+confined to the orchestration layers (``pipeline``, ``state``, ``cli``,
+bench/scripts — lint rule LY303; ``ops``/``parallel`` kernels stay
+instrumentation-free).
+"""
+
+from bayesian_consensus_engine_tpu.obs.ledger import (
+    RunLedger,
+    host_snapshot,
+    min_of_repeats,
+    read_ledger,
+    summarize,
+)
+from bayesian_consensus_engine_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NULL_REGISTRY,
+    log_spaced_bounds,
+    metrics_registry,
+    set_metrics_registry,
+)
+from bayesian_consensus_engine_tpu.obs.timeline import (
+    NULL_TIMELINE,
+    PHASES,
+    PhaseTimeline,
+    active_timeline,
+    recording,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NULL_REGISTRY",
+    "NULL_TIMELINE",
+    "PHASES",
+    "PhaseTimeline",
+    "RunLedger",
+    "active_timeline",
+    "host_snapshot",
+    "log_spaced_bounds",
+    "metrics_registry",
+    "min_of_repeats",
+    "read_ledger",
+    "recording",
+    "set_metrics_registry",
+    "summarize",
+]

@@ -1,0 +1,245 @@
+"""Append-only JSONL run ledger: every capture attributable, every
+headline a band.
+
+Round-5 VERDICT #5/#6: the stream bench legs flip sign between same-day
+captures (``journal_presized`` 1.45 vs 0.82 amortised; ``e2e_overlap``
+1.17× vs 0.907×) because each capture is a single run on a host whose
+load swings several-fold. BASELINE.md already pins the discipline for the
+reference baseline — min-of-N repeats with the host load recorded per
+trial — and this module applies it to our own numbers:
+
+* :class:`RunLedger` appends one JSON line per measurement, carrying the
+  leg name, repeat index, value/unit, phase breakdown, host conditions
+  (loadavg, cpu count, pid), backend identity, and a wall timestamp.
+  Lines are written with sorted keys (deterministic bytes for identical
+  records — the DT203 contract) and flushed per record, so a killed run
+  keeps every completed measurement; a torn final line is dropped on
+  read, like a journal's torn tail epoch.
+* :func:`min_of_repeats` is the min-of-N policy helper: the published
+  number is the minimum over repeats (host-load noise only ever ADDS
+  time), and the min–max band rides along so a round can quote a range
+  instead of a lucky single.
+* :func:`summarize` folds a ledger into per-leg bands for the
+  ``bce-tpu stats`` renderer.
+
+The ledger never feeds back into measurement or settlement — it is an
+output-only record, which is why writing one cannot perturb the numbers
+it records. Stdlib-only by contract (lint rule LY303 confines importers
+to the orchestration layers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Union
+
+#: Bump when a record field changes meaning; readers key off this.
+SCHEMA_VERSION = 1
+
+
+def host_snapshot() -> Dict[str, object]:
+    """Host conditions at record time: the attribution context.
+
+    ``loadavg_1m`` is the number that adjudicates a slow capture (a
+    host-bound leg under load 3 on a 1-core box is not a regression);
+    platforms without ``getloadavg`` record ``None`` rather than lying.
+    """
+    try:
+        load1, load5, load15 = os.getloadavg()
+        loadavg = {
+            "loadavg_1m": round(load1, 3),
+            "loadavg_5m": round(load5, 3),
+            "loadavg_15m": round(load15, 3),
+        }
+    except (AttributeError, OSError):
+        loadavg = {"loadavg_1m": None, "loadavg_5m": None, "loadavg_15m": None}
+    return {
+        "cpu_count": os.cpu_count(),
+        "pid": os.getpid(),
+        **loadavg,
+    }
+
+
+class RunLedger:
+    """Appends measurement records to one JSONL file.
+
+    Append-only by construction: an existing file is extended, never
+    truncated, so one ledger accumulates a round's captures across
+    processes (each record carries its pid + run id). Each record is
+    flushed before :meth:`record` returns.
+    """
+
+    def __init__(
+        self,
+        path: Union[str, Path],
+        run_id: Optional[str] = None,
+        backend: Optional[str] = None,
+    ) -> None:
+        self._path = str(path)
+        self._run_id = run_id or f"{int(time.time())}-{os.getpid()}"
+        self._backend = backend
+        self._seq = 0
+        self._file = open(self._path, "a", encoding="utf-8")
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    @property
+    def run_id(self) -> str:
+        return self._run_id
+
+    def record(
+        self,
+        leg: str,
+        value: Optional[float] = None,
+        unit: Optional[str] = None,
+        repeat: int = 0,
+        phases: Optional[Dict[str, float]] = None,
+        extras: Optional[Dict[str, object]] = None,
+    ) -> Dict[str, object]:
+        """Append one measurement record; returns the record dict.
+
+        *repeat* is the trial index within a min-of-N leg (0-based).
+        *phases* is a :meth:`~.timeline.PhaseTimeline.totals`-shaped
+        breakdown. *extras* rides along verbatim (must be JSON-safe).
+        """
+        entry = {
+            "schema": SCHEMA_VERSION,
+            "run_id": self._run_id,
+            "seq": self._seq,
+            "leg": leg,
+            "repeat": int(repeat),
+            "value": value,
+            "unit": unit,
+            "backend": self._backend,
+            "wall_unix_ts": time.time(),
+            "host": host_snapshot(),
+            "phases": dict(phases or {}),
+            "extras": dict(extras or {}),
+        }
+        self._file.write(json.dumps(entry, sort_keys=True) + "\n")
+        self._file.flush()
+        self._seq += 1
+        return entry
+
+    def close(self) -> None:
+        if not self._file.closed:
+            self._file.close()
+
+    def __enter__(self) -> "RunLedger":
+        return self
+
+    def __exit__(self, *exc_info: object) -> None:
+        self.close()
+
+
+def read_ledger(path: Union[str, Path]) -> List[Dict[str, object]]:
+    """Parse a ledger file; a torn/garbage FINAL line is dropped, torn
+    interior lines raise (an interior parse failure means the file is not
+    an append-only ledger — refuse to guess)."""
+    records: List[Dict[str, object]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            if i == len(lines) - 1:
+                break  # torn tail: the process died mid-append
+            raise ValueError(f"{path}: malformed ledger line {i + 1}")
+    return records
+
+
+def min_of_repeats(
+    records: List[Dict[str, object]], leg: str
+) -> Optional[Dict[str, object]]:
+    """The min-of-N policy applied to one leg's records.
+
+    Returns ``{"leg", "n", "min", "max", "spread_pct", "unit",
+    "loadavg_1m_range"}`` over every record of *leg* that carries a
+    numeric value, or ``None`` when there are none. ``min`` is the
+    publishable number (load noise only ever adds time — for a
+    throughput-style value the caller wants ``max``; both are here).
+    """
+    values = []
+    loads = []
+    unit = None
+    for rec in records:
+        if rec.get("leg") != leg:
+            continue
+        value = rec.get("value")
+        if not isinstance(value, (int, float)):
+            continue
+        values.append(float(value))
+        unit = rec.get("unit") or unit
+        load = (rec.get("host") or {}).get("loadavg_1m")
+        if isinstance(load, (int, float)):
+            loads.append(float(load))
+    if not values:
+        return None
+    lo, hi = min(values), max(values)
+    return {
+        "leg": leg,
+        "n": len(values),
+        "min": lo,
+        "max": hi,
+        "spread_pct": round((hi - lo) / lo * 100.0, 1) if lo else None,
+        "unit": unit,
+        "loadavg_1m_range": (
+            [min(loads), max(loads)] if loads else None
+        ),
+    }
+
+
+def summarize(records: List[Dict[str, object]]) -> Dict[str, Dict[str, object]]:
+    """Per-leg min/max bands over a whole ledger, legs sorted by name."""
+    legs = sorted({rec.get("leg") for rec in records if rec.get("leg")})
+    out: Dict[str, Dict[str, object]] = {}
+    for leg in legs:
+        band = min_of_repeats(records, leg)
+        if band is None:
+            n = sum(1 for rec in records if rec.get("leg") == leg)
+            band = {"leg": leg, "n": n, "min": None, "max": None,
+                    "spread_pct": None, "unit": None,
+                    "loadavg_1m_range": None}
+        out[leg] = band
+    return out
+
+
+def render(records: List[Dict[str, object]]) -> str:
+    """Human-readable per-leg table for ``bce-tpu stats``."""
+    summary = summarize(records)
+    if not summary:
+        return "empty ledger"
+    lines = [
+        f"{'leg':<34} {'n':>3} {'min':>12} {'max':>12} "
+        f"{'spread':>7} {'load(1m)':>12} unit"
+    ]
+    for leg, band in summary.items():
+
+        def num(x):
+            return f"{x:.4g}" if isinstance(x, (int, float)) else "-"
+
+        load_range = band["loadavg_1m_range"]
+        load = (
+            f"{load_range[0]:.2f}-{load_range[1]:.2f}"
+            if load_range
+            else "-"
+        )
+        spread = (
+            f"{band['spread_pct']:.1f}%"
+            if isinstance(band["spread_pct"], (int, float))
+            else "-"
+        )
+        lines.append(
+            f"{leg:<34} {band['n']:>3} {num(band['min']):>12} "
+            f"{num(band['max']):>12} {spread:>7} {load:>12} "
+            f"{band['unit'] or '-'}"
+        )
+    return "\n".join(lines)

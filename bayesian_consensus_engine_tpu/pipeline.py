@@ -51,6 +51,11 @@ from bayesian_consensus_engine_tpu.core.batch import (
     pack_markets,
     topology_fingerprint,
 )
+from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
+from bayesian_consensus_engine_tpu.obs.timeline import (
+    PhaseTimeline,
+    active_timeline,
+)
 from bayesian_consensus_engine_tpu.utils.config import (
     CONFIDENCE_GROWTH_RATE,
     DEFAULT_CONFIDENCE,
@@ -691,7 +696,12 @@ def settle(
     # settlement if one exists — the chained-settle fast path: no host→
     # device re-upload and no per-settle absorb; this call's settled state
     # subsumes the predecessor's changes and replaces it as pending below.
-    (flat, epoch0) = store.take_device_state(dtype)
+    # Phase spans (obs/timeline.py) are no-ops unless this thread is
+    # recording; they time the host-side call windows only (the dispatch
+    # is deliberately unfenced — see settle_stream's stats contract).
+    timeline = active_timeline()
+    with timeline.span("upload"):
+        (flat, epoch0) = store.take_device_state(dtype)
     now_abs = _now_days() if now is None else now
     flat, epoch0 = _rebase_epoch(flat, epoch0, now_abs)
     cdtype = flat.reliability.dtype
@@ -707,28 +717,29 @@ def settle(
             getattr(parent, "_device_arrays", None)
             if parent is not None else None
         )
-        if donor is not None and donor[0] == str(cdtype):
-            # Delta-ingest fast path: a probability-only refresh shares
-            # its topology with the settled parent plan, so only the new
-            # probs block crosses host→device; the parent's device rows/
-            # mask/touched copies transfer over, and its stale probs
-            # block is dropped (donated) rather than pinned twice in HBM.
-            device_plan = (
-                donor[0],
-                donor[1],
-                jnp.asarray(plan.probs, dtype=cdtype),
-                donor[3],
-                donor[4],
-            )
-            object.__setattr__(parent, "_device_arrays", None)
-        else:
-            device_plan = (
-                str(cdtype),
-                jnp.asarray(plan.slot_rows),
-                jnp.asarray(plan.probs, dtype=cdtype),
-                jnp.asarray(plan.mask),
-                jnp.asarray(touched_rows),
-            )
+        with timeline.span("upload"):
+            if donor is not None and donor[0] == str(cdtype):
+                # Delta-ingest fast path: a probability-only refresh shares
+                # its topology with the settled parent plan, so only the new
+                # probs block crosses host→device; the parent's device rows/
+                # mask/touched copies transfer over, and its stale probs
+                # block is dropped (donated) rather than pinned twice in HBM.
+                device_plan = (
+                    donor[0],
+                    donor[1],
+                    jnp.asarray(plan.probs, dtype=cdtype),
+                    donor[3],
+                    donor[4],
+                )
+                object.__setattr__(parent, "_device_arrays", None)
+            else:
+                device_plan = (
+                    str(cdtype),
+                    jnp.asarray(plan.slot_rows),
+                    jnp.asarray(plan.probs, dtype=cdtype),
+                    jnp.asarray(plan.mask),
+                    jnp.asarray(touched_rows),
+                )
         object.__setattr__(plan, "_device_arrays", device_plan)
         # The back-reference has served its purpose; dropping it keeps a
         # long reuse chain from pinning every predecessor plan in memory.
@@ -736,19 +747,20 @@ def settle(
             object.__setattr__(plan, "_refreshed_from", None)
     _, slot_rows_d, probs_d, mask_d, touched_d = device_plan
 
-    rel, conf, days, exists, consensus, rel_touched = _get_settle_kernel()(
-        flat.reliability,
-        flat.confidence,
-        flat.updated_days,
-        flat.exists,
-        slot_rows_d,
-        probs_d,
-        mask_d,
-        jnp.asarray(np.asarray(outcomes, dtype=bool)),
-        jnp.asarray(now_abs - epoch0, dtype=cdtype),
-        touched_d,
-        steps,
-    )
+    with timeline.span("settle_dispatch"):
+        rel, conf, days, exists, consensus, rel_touched = _get_settle_kernel()(
+            flat.reliability,
+            flat.confidence,
+            flat.updated_days,
+            flat.exists,
+            slot_rows_d,
+            probs_d,
+            mask_d,
+            jnp.asarray(np.asarray(outcomes, dtype=bool)),
+            jnp.asarray(now_abs - epoch0, dtype=cdtype),
+            touched_d,
+            steps,
+        )
     # Deferred absorb: the settled state becomes the store's pending device
     # truth (merged into the host lazily, on the first host read that needs
     # it); the exact confidence trajectory is maintained host-side NOW so
@@ -1003,9 +1015,12 @@ class ShardedSettlementSession:
         self._mesh = mesh
         self._band = band
         self._cdtype = dtype or default_float_dtype()
-        (self._padded_total, self._lo, self._hi,
-         self._band_rows, self._band_mask, self._probs_g,
-         self._mask_g) = _sharded_plan_cache(plan, mesh, self._cdtype, band)
+        with active_timeline().span("upload"):
+            (self._padded_total, self._lo, self._hi,
+             self._band_rows, self._band_mask, self._probs_g,
+             self._mask_g) = _sharded_plan_cache(
+                plan, mesh, self._cdtype, band
+            )
         self._touched = self._band_rows[self._band_mask]
         self._state = None  # built lazily: epoch depends on the first now
         self._epoch0 = None
@@ -1076,6 +1091,7 @@ class ShardedSettlementSession:
         )
 
         store, plan = self._store, self._plan
+        timeline = active_timeline()
         _check_plan(store, plan, outcomes)
         now_abs = _now_days() if now is None else now
         if self._state is None or now_abs <= self._epoch0:
@@ -1090,9 +1106,10 @@ class ShardedSettlementSession:
             # stalling this build (chain bounded at 8 by the store).
             if store.pending_overlaps(self._touched):
                 store.sync()
-            self._build_state(
-                min(store.epoch_origin(sync=False), now_abs - 1.0)
-            )
+            with timeline.span("upload"):
+                self._build_state(
+                    min(store.epoch_origin(sync=False), now_abs - 1.0)
+                )
 
         conf_exact = store.host_confidences(self._touched)
         # Band-local outcome columns, padded to the band width (band mode:
@@ -1111,13 +1128,15 @@ class ShardedSettlementSession:
                 (0, band_width - len(outcome_arr)),
                 constant_values=False,
             )
-        outcome_g = global_market(
-            outcome_band, self._mesh, self._padded_total
-        )
-        new_state, consensus = self._loop(
-            self._probs_g, self._mask_g, outcome_g, self._state,
-            jnp.asarray(now_abs - self._epoch0, dtype=self._cdtype), steps,
-        )
+        with timeline.span("settle_dispatch"):
+            outcome_g = global_market(
+                outcome_band, self._mesh, self._padded_total
+            )
+            new_state, consensus = self._loop(
+                self._probs_g, self._mask_g, outcome_g, self._state,
+                jnp.asarray(now_abs - self._epoch0, dtype=self._cdtype),
+                steps,
+            )
         self._state = new_state
 
         # Merge recipe: closed-form stamps/existence; reliabilities stay on
@@ -1333,10 +1352,18 @@ class PlanPrefetcher:
             last_plan[0] = plan
             return plan
 
+        # Build times feed the metrics registry, not the timeline: the
+        # worker overlaps the consumer's wall clock by design, so its
+        # seconds must not enter the additive phase breakdown — the
+        # consumer-visible share is settle_stream's "pack" wait span.
+        build_hist = metrics_registry().histogram("stream.plan_build_s")
+
         def work():
             # The iterator itself may raise (a generator streaming payloads
             # from disk/network): that failure must surface on next() like
             # a build failure, never collapse into a clean StopIteration.
+            import time as _time
+
             try:
                 iterator = iter(batches)
                 while not self._cancelled.is_set():
@@ -1344,7 +1371,9 @@ class PlanPrefetcher:
                         batch = next(iterator)
                     except StopIteration:
                         break
+                    build_start = _time.perf_counter()
                     plan = build(batch)
+                    build_hist.observe(_time.perf_counter() - build_start)
                     self._put(("ok", plan))
             except BaseException as exc:  # noqa: BLE001 — re-raised on next()
                 self._put(("err", exc))
@@ -1511,6 +1540,17 @@ def settle_stream(
     host state, so device backpressure surfaces here (not in
     ``checkpoint_s``) — read it as the full per-batch settle window.
 
+    When this thread is recording a phase timeline
+    (:func:`~.obs.timeline.recording`), each stats dict additionally
+    carries ``"phases"``: the batch's additive breakdown into the
+    canonical :data:`~.obs.timeline.PHASES` names (exclusive seconds —
+    ``checkpoint`` excludes the nested ``journal_fsync`` /
+    ``interchange_export`` it wraps). Obs-disabled callers see the
+    unchanged schema. The stream also feeds the process metrics registry
+    (``stream.batches``, ``stream.plan_reuse_hits``/``misses``,
+    ``stream.settle_dispatch_s``, ``stream.plan_build_s``) — all no-ops
+    unless :func:`~.obs.metrics.set_metrics_registry` enabled one.
+
     *mesh*, if given, runs every settle sharded over the device mesh:
     each batch settles through a :class:`ShardedSettlementSession`
     (markets on the lane axis, source slots optionally split with a
@@ -1536,7 +1576,14 @@ def settle_stream(
     processes disagree on). *dtype* overrides the mesh path's compute
     dtype (:func:`~.utils.dtypes.default_float_dtype` otherwise).
 
-    *lazy_checkpoints* takes the checkpoint drain off the critical path:
+    *lazy_checkpoints* is RETIRED TO BENCH-ONLY (round-5 adjudication,
+    the Pallas precedent): it lost every on-chip and CPU capture —
+    0.57–0.77 amortised 1M-cycles/sec vs eager's 0.9–1.2 across the four
+    round-5 banked runs — because what the lag defers it also
+    un-overlaps. The flag remains for the standing ``e2e_stream``
+    re-adjudication leg (and the tests that pin its torn-state
+    semantics); production services should leave it off. Mechanics, for
+    the record: it takes the checkpoint drain off the critical path:
     periodic flushes snapshot the APPLIED host truth without resolving
     deferred device results (``resolve_pending=False``), so they never
     block on the device — mid-stream files then lag by the deferred
@@ -1608,6 +1655,17 @@ def settle_stream(
             outcome_queue.append(outcomes)
             yield payloads
 
+    # Observability (obs/): phase spans land on this thread's active
+    # timeline (null by default — zero overhead), per-batch phase deltas
+    # ride the stats dicts when a timeline is recording, and the stream
+    # counters feed the process metrics registry (null by default).
+    timeline = active_timeline()
+    registry = metrics_registry()
+    batches_counter = registry.counter("stream.batches")
+    reuse_hit_counter = registry.counter("stream.plan_reuse_hits")
+    reuse_miss_counter = registry.counter("stream.plan_reuse_misses")
+    dispatch_hist = registry.histogram("stream.settle_dispatch_s")
+
     handle = None
     flushed_through = -1
     journaled_through = -1
@@ -1625,9 +1683,11 @@ def settle_stream(
         ) as plans:
             plan_iter = iter(plans)
             while True:
+                phase_mark = timeline.totals() if timeline.enabled else None
                 wait_start = _time.perf_counter()
                 try:
-                    plan = next(plan_iter)
+                    with timeline.span("pack"):
+                        plan = next(plan_iter)
                 except StopIteration:
                     break
                 plan_wait_s = _time.perf_counter() - wait_start
@@ -1661,6 +1721,10 @@ def settle_stream(
                     )
                 settle_dispatch_s = _time.perf_counter() - settle_start
                 settled_through = index
+                batches_counter.inc()
+                (reuse_hit_counter if plan_reused
+                 else reuse_miss_counter).inc()
+                dispatch_hist.observe(settle_dispatch_s)
                 # Appended BEFORE the checkpoint so ``len(stats)`` is the
                 # SETTLED count even when the checkpoint raises: a failing
                 # batch has settled but never yields, and a consumer that
@@ -1686,7 +1750,8 @@ def settle_stream(
                     # same broken journal and shadow this error.
                     checkpoint_start = _time.perf_counter()
                     try:
-                        store.flush_to_journal(journal, tag=index)
+                        with timeline.span("checkpoint"):
+                            store.flush_to_journal(journal, tag=index)
                     except BaseException:
                         journal_write_failed = True
                         raise
@@ -1699,15 +1764,24 @@ def settle_stream(
                     # Joins any in-flight write first (flushes serialise), so
                     # a prior background failure surfaces here, not silently.
                     checkpoint_start = _time.perf_counter()
-                    handle = store.flush_to_sqlite_async(
-                        db_path, resolve_pending=not lazy_checkpoints
-                    )
+                    with timeline.span("checkpoint"):
+                        handle = store.flush_to_sqlite_async(
+                            db_path, resolve_pending=not lazy_checkpoints
+                        )
                     if stats is not None:
                         stats[-1]["checkpoint_s"] = (
                             _time.perf_counter() - checkpoint_start
                         )
                     if not lazy_checkpoints:
                         flushed_through = index
+                if phase_mark is not None and stats is not None:
+                    # The batch's additive phase breakdown (exclusive
+                    # seconds per obs/timeline.PHASES name) — present only
+                    # when a timeline is recording, so the stats schema is
+                    # unchanged for obs-disabled callers.
+                    stats[-1]["phases"] = PhaseTimeline.delta(
+                        phase_mark, timeline.totals()
+                    )
                 yield result
     finally:
         # Runs on EVERY exit — exhaustion, a consumer break/close

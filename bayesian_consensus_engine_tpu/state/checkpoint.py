@@ -29,6 +29,8 @@ from typing import Any, Optional, Union
 
 import jax
 
+from bayesian_consensus_engine_tpu.obs.timeline import active_timeline
+
 
 class CycleCheckpointer:
     """Orbax-backed snapshot/resume for cycle-state pytrees.
@@ -71,14 +73,18 @@ class CycleCheckpointer:
         already exists unless ``force``).
         """
         ocp = self._ocp
-        saved = self._manager.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                meta=ocp.args.JsonSave(meta or {}),
-            ),
-            force=force,
-        )
+        # Only the synchronous snapshot window is on the caller's clock
+        # (the commit itself is async) — that window is the "checkpoint"
+        # phase in the obs timeline (no-op unless recording).
+        with active_timeline().span("checkpoint"):
+            saved = self._manager.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    meta=ocp.args.JsonSave(meta or {}),
+                ),
+                force=force,
+            )
         return bool(saved)
 
     def wait(self) -> None:

@@ -64,6 +64,9 @@ from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
+from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
+from bayesian_consensus_engine_tpu.obs.timeline import active_timeline
+
 MAGIC = b"BCEJRNL1"
 _EPOCH_HDR = struct.Struct("<QQQQQdQ")
 
@@ -233,11 +236,19 @@ class JournalWriter:
                 iso_blob,
             )
         )
-        self._file.write(payload)
-        self._file.write(struct.pack("<I", zlib.crc32(payload)))
-        self._file.flush()
-        if self._fsync:
-            os.fsync(self._file.fileno())
+        # The write+flush+fsync is the durability wait a streaming service
+        # actually blocks on — named "journal_fsync" in the phase timeline
+        # (no-op unless this thread is recording; obs/timeline.py).
+        with active_timeline().span("journal_fsync"):
+            self._file.write(payload)
+            self._file.write(struct.pack("<I", zlib.crc32(payload)))
+            self._file.flush()
+            if self._fsync:
+                os.fsync(self._file.fileno())
+        registry = metrics_registry()
+        registry.counter("journal.epochs").inc()
+        registry.counter("journal.bytes").inc(len(payload) + 4)
+        registry.counter("journal.dirty_rows").inc(dirty)
         self.epoch_index += 1
         self.rows_covered = used_after
 

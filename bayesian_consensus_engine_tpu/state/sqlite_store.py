@@ -31,6 +31,8 @@ from bayesian_consensus_engine_tpu.state.decay import (
     apply_reliability_decay,
     days_since_update,
 )
+from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
+from bayesian_consensus_engine_tpu.obs.timeline import active_timeline
 from bayesian_consensus_engine_tpu.state.records import ReliabilityRecord
 from bayesian_consensus_engine_tpu.state.update_math import apply_outcome
 from bayesian_consensus_engine_tpu.utils.timeconv import utc_now_iso
@@ -271,17 +273,25 @@ class SQLiteReliabilityStore:
         sql = _FRESH_INSERT_SQL if empty else _UPSERT_SQL
         # Bulk-load page cache (the default ~2 MB thrashes on multi-million-
         # row B-trees), restored afterwards so a long-lived store connection
-        # does not keep a 256 MB cache ceiling from one bulk call.
+        # does not keep a 256 MB cache ceiling from one bulk call. The
+        # transaction is the "interchange_export" phase of the obs timeline
+        # (the SQLite floor the journal tier exists to duck) — a no-op span
+        # unless this thread is recording.
         prior_cache = self._conn.execute("PRAGMA cache_size").fetchone()[0]
         self._conn.execute("PRAGMA cache_size=-262144")
         try:
-            self._conn.execute("BEGIN")
-            try:
-                self._conn.executemany(sql, rows)
-            except BaseException:
-                self._conn.execute("ROLLBACK")
-                raise
-            self._conn.execute("COMMIT")
+            with active_timeline().span("interchange_export"):
+                self._conn.execute("BEGIN")
+                try:
+                    cursor = self._conn.executemany(sql, rows)
+                except BaseException:
+                    self._conn.execute("ROLLBACK")
+                    raise
+                self._conn.execute("COMMIT")
+            if cursor.rowcount > 0:
+                metrics_registry().counter("sqlite.rows_written").inc(
+                    cursor.rowcount
+                )
         finally:
             self._conn.execute(f"PRAGMA cache_size={int(prior_cache)}")
 

@@ -34,6 +34,9 @@ from pathlib import Path
 
 import numpy as np
 
+from bayesian_consensus_engine_tpu.obs.timeline import (
+    active_timeline as _active_timeline,
+)
 from bayesian_consensus_engine_tpu.utils.config import (
     DECAY_HALF_LIFE_DAYS,
     DECAY_MINIMUM,
@@ -277,6 +280,11 @@ class TensorReliabilityStore:
         """
         if self._pending is None and self._pending_sync is None:
             return
+        # The deferred device→host merge is the "fetch" phase of the obs
+        # timeline (no-op span unless this thread is recording): the
+        # np.asarray calls below are where deferred device results
+        # actually cross to the host.
+        timeline = _active_timeline()
         recipes = self._pending_sync
         self._pending_sync = None
         if recipes is not None:
@@ -286,11 +294,13 @@ class TensorReliabilityStore:
             # predecessor settle's results are still recoverable here.
             pend = self._pending
             self._pending = None
-            for touched, rel_touched_dev, recipe_epoch0, stamp_rel in recipes:
-                self._apply_settle_recipe(
-                    touched, np.asarray(rel_touched_dev), recipe_epoch0,
-                    stamp_rel,
-                )
+            with timeline.span("fetch"):
+                for (touched, rel_touched_dev, recipe_epoch0,
+                     stamp_rel) in recipes:
+                    self._apply_settle_recipe(
+                        touched, np.asarray(rel_touched_dev), recipe_epoch0,
+                        stamp_rel,
+                    )
             # The flat device state is still EXACTLY the host's truth for
             # rel/days/exists (the recipes just made the host match it), so
             # keep it as the cache: a settle after a flush/read chains with
@@ -308,14 +318,15 @@ class TensorReliabilityStore:
         # Merge at the PENDING state's length: pairs interned after the
         # settle (e.g. a new plan) have host-only (cold) rows — correct.
         used = int(state.reliability.shape[0])
-        self._merge_device_rows(
-            slice(0, used),
-            np.asarray(state.reliability),
-            None,  # confidences: host-authoritative
-            np.asarray(state.updated_days),
-            np.asarray(state.exists, dtype=bool),
-            epoch0,
-        )
+        with timeline.span("fetch"):
+            self._merge_device_rows(
+                slice(0, used),
+                np.asarray(state.reliability),
+                None,  # confidences: host-authoritative
+                np.asarray(state.updated_days),
+                np.asarray(state.exists, dtype=bool),
+                epoch0,
+            )
         # Drop the cache: its confidences are the device's (ulp-drifted)
         # values, while the host's replayed ones are now authoritative.
         self._device_cache = None
@@ -1434,12 +1445,16 @@ class TensorReliabilityStore:
             # Availability is pre-checked so a genuine write failure (locked
             # file, full disk) propagates instead of silently re-running the
             # whole flush through the fallback against the same broken target.
+            # The C write is the "interchange_export" phase here (the
+            # sqlite3-module fallback records the same phase inside
+            # put_rows; exclusive span accounting keeps them additive).
             order = self._pairs.sorted_rows(
                 np.ascontiguousarray(selected, dtype=np.int32)
             )
-            return self._pairs.flush_sqlite(
-                str(db_path), order, self._rel, self._conf, self._iso
-            )
+            with _active_timeline().span("interchange_export"):
+                return self._pairs.flush_sqlite(
+                    str(db_path), order, self._rel, self._conf, self._iso
+                )
 
         rows, keys = self._ordered_flush_rows(selected, incremental, used)
         order = np.asarray(rows, dtype=np.int64)

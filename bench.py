@@ -73,9 +73,24 @@ Measurement notes (all learned the hard way on this host):
     rounds — ``extras.normalised_vs_probe`` carries the division already
     done
 
-Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": "cycles/sec", "vs_baseline": N,
-     "extras": {...}}
+Output contract (round 6 — headline durability, VERDICT r5 #4): stdout
+carries the full JSON record line, then a COMPACT headline line LAST —
+``{"metric": ..., "vs_baseline": ..., "value": N, "unit": "cycles/sec"}``
+with value/unit as its final bytes, so a front-truncated tail capture
+still contains the round's headline. ``--out FILE`` additionally writes
+the full record atomically (tmp + rename) — the self-contained artifact
+no tail capture can lose.
+
+Observability (round 6, obs/): every ``--leg`` subprocess runs under a
+phase timeline (obs/timeline.py) and reports ``wall_s`` + ``phases`` — an
+additive breakdown of the leg wall clock into the canonical phase names
+(pack/upload/settle_dispatch/fetch/journal_fsync/checkpoint/
+interchange_export, remainder as ``untracked``) — surfaced per leg under
+``extras.harness.phase_breakdown``. ``--ledger FILE`` appends one
+obs/ledger.py JSONL record per leg (and per repeat inside min-of-N legs)
+carrying loadavg + repeat index; render with ``bce-tpu stats``. The
+``obs_overhead`` leg A/Bs the streamed service with obs off vs on (the
+"within 1%" contract).
 
 ``vs_baseline`` is against the reference implementation measured on this
 host's CPU (scripts/measure_reference_baseline.py): 2743.4 markets/sec at
@@ -134,6 +149,25 @@ NORTH_STAR_FIT_STEPS = 10
 # per-dispatch overhead on the in-process CPU backend, small enough to
 # finish within the leg budget on a loaded host.
 CPU_FALLBACK_STEPS = 96
+
+# The run ledger (obs/ledger.py) this process appends measurement records
+# to — None unless --ledger was given. Leg functions with internal repeats
+# (e2e_overlap) record per-repeat through _ledger_record; the --leg entry
+# point records one summary per leg.
+_LEDGER = None
+
+
+def _ledger_record(leg, **kwargs):
+    """Append one run-ledger record; silently a no-op without --ledger."""
+    if _LEDGER is not None:
+        _LEDGER.record(leg, **kwargs)
+
+
+def _loadavg_1m():
+    """1-minute loadavg via the ledger's one host-snapshot implementation."""
+    from bayesian_consensus_engine_tpu.obs.ledger import host_snapshot
+
+    return host_snapshot()["loadavg_1m"]
 
 
 def _setup_compile_cache() -> None:
@@ -1029,6 +1063,107 @@ def bench_e2e_stream_stable_topology(markets=NUM_MARKETS, batches=6,
     }
 
 
+def bench_obs_overhead(markets=60_000, batches=3, mean_slots=4, steps=10,
+                       trials=3):
+    """The obs contract's A/B: the streamed service with observability
+    DISABLED vs fully ENABLED (phase timeline recording + live metrics
+    registry + per-batch ``phases`` stats).
+
+    obs promises provably-zero disabled overhead and ≤1% enabled overhead
+    on the e2e stream; this leg measures the second claim (the first is a
+    structural property — null-object singletons — pinned by
+    tests/test_obs.py). Both runs stream the same pre-generated columnar
+    batches through the eager rolling-SQLite loop, alternating min-of-N
+    (*trials*) after one shared warmup, so compile attribution and load
+    bursts fall evenly — on this externally-loaded 1-core host a single
+    short run swings several-fold (the min converges; the mean lies).
+    ``overhead_ratio`` is on/off wall (min-of-N each); ``phases`` is the
+    enabled run's timeline total — the named decomposition of the stream
+    wall the 600×-gap work navigates by.
+    """
+    import gc
+    import tempfile as _tf
+
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.obs import (
+        MetricsRegistry,
+        PhaseTimeline,
+        recording,
+        set_metrics_registry,
+    )
+    from bayesian_consensus_engine_tpu.pipeline import settle_stream
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    per_batch = markets // batches
+    rng = np.random.default_rng(23)
+    batch_data = []
+    for b in range(batches):
+        counts = rng.poisson(mean_slots - 1, per_batch) + 1
+        total = int(counts.sum())
+        keys = [f"b{b}-m{m}" for m in range(per_batch)]
+        sids = [f"src-{v}" for v in rng.integers(0, SOURCE_UNIVERSE, total)]
+        probs = rng.random(total)
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        outcomes = (rng.random(per_batch) < 0.5).tolist()
+        batch_data.append(((keys, sids, probs, offsets), outcomes))
+    gc.freeze()
+    try:
+
+        def run(enabled):
+            store = TensorReliabilityStore()
+            stats: list = []
+            timeline = PhaseTimeline() if enabled else None
+            previous = (
+                set_metrics_registry(MetricsRegistry()) if enabled else None
+            )
+            try:
+                with _tf.TemporaryDirectory() as tmp:
+                    db = os.path.join(tmp, "obs.db")
+                    start = time.perf_counter()
+                    with recording(timeline):
+                        for _result in settle_stream(
+                            store, batch_data, steps=steps, now=21_900.0,
+                            db_path=db, checkpoint_every=1, columnar=True,
+                            stats=stats,
+                        ):
+                            pass
+                        store.sync()
+                    wall = time.perf_counter() - start
+            finally:
+                if enabled:
+                    set_metrics_registry(previous)
+            phases = timeline.totals() if timeline is not None else {}
+            return wall, phases, stats
+
+        run(enabled=False)  # shared warmup: compiles land on nobody's clock
+        wall_off = wall_on = float("inf")
+        phases_on = {}
+        for _trial in range(trials):
+            off, _p, _s = run(enabled=False)
+            wall_off = min(wall_off, off)
+            on, phases, stats = run(enabled=True)
+            if on < wall_on:
+                wall_on, phases_on = on, phases
+        assert all("phases" in s for s in stats)
+        return {
+            "workload": (
+                f"{batches} batches x {per_batch} markets x {steps} "
+                f"cycles, eager checkpoints, min of {trials} "
+                f"alternating trials"
+            ),
+            "obs_off_wall_s": round(wall_off, 3),
+            "obs_on_wall_s": round(wall_on, 3),
+            "overhead_ratio": round(wall_on / wall_off, 4),
+            "within_1pct": wall_on / wall_off <= 1.01,
+            "phases": {k: round(v, 4) for k, v in phases_on.items()},
+        }
+    finally:
+        gc.unfreeze()
+
+
 def bench_dispatch_rtt(trials=5):
     """Pure tunnel dispatch+fence round trip: a jitted 8-element add.
 
@@ -1204,7 +1339,7 @@ def _e2e_payloads(markets, mean_slots, seed=7):
     return payloads, outcomes, counts, src, prob, offsets
 
 
-def bench_e2e_overlap(markets=NUM_MARKETS, mean_slots=4, steps=20):
+def bench_e2e_overlap(markets=NUM_MARKETS, mean_slots=4, steps=20, trials=2):
     """Serial vs overlapped two-batch settlement service at headline scale.
 
     The same work, twice: batch A then batch B, each ingest → settle →
@@ -1215,6 +1350,20 @@ def bench_e2e_overlap(markets=NUM_MARKETS, mean_slots=4, steps=20):
     writes on a background thread (GIL-released native writer) while B
     ingests/settles. Identical results by construction (pinned by
     tests/test_overlap.py); the measured delta is pure wall-clock.
+
+    Adjudication discipline (round 6, VERDICT r5 #2 — the feature's sign
+    flipped between captures: 1.172× in round 5's session, 0.907× in
+    BANKED run4): *trials* alternating repeats per flow, each repeat's
+    wall time AND 1-minute loadavg recorded (to the ``repeats`` list and,
+    with ``--ledger``, to the run ledger), min-of-N for the headline
+    ``speedup``, the per-trial ratio band alongside (*trials* defaults to
+    2 — the pre-round-6 run count, so the leg stays inside its subprocess
+    timeout at 1M shapes; raise it when the budget allows). The DECISION
+    RULE
+    (``decision_rule`` in the output, quoted by docs/round5-notes.md):
+    overlap **wins** iff min-of-N speedup ≥ 1.05 AND no paired trial ran
+    slower than 1.0×; **loses** iff min-of-N speedup ≤ 0.95; anything
+    between is a **wash** (quote the band, ship nothing on it).
     """
     import gc
     import tempfile as _tf
@@ -1270,28 +1419,66 @@ def bench_e2e_overlap(markets=NUM_MARKETS, mean_slots=4, steps=20):
             # its clock while the overlapped flow reused the cache; the
             # capacity-ladder export keeps the overlapped flow's dispatch
             # shapes on the same rungs). Flows then run ALTERNATING,
-            # min-of-2 each — this box's external load bursts can swing a
+            # min-of-N each — this box's external load bursts can swing a
             # host-bound pass several-fold, and alternation keeps a burst
             # from landing wholly on one flow.
             run_serial(os.path.join(tmp, "warm.db"))
+            repeats = []
             t_serial = t_overlap = float("inf")
-            for trial in range(2):
-                t_serial = min(
-                    t_serial, run_serial(os.path.join(tmp, f"s{trial}.db"))
-                )
-                t_overlap = min(
-                    t_overlap,
-                    run_overlapped(os.path.join(tmp, f"o{trial}.db")),
-                )
+            ratios = []
+            for trial in range(trials):
+                for flow, runner in (
+                    ("serial", run_serial), ("overlapped", run_overlapped)
+                ):
+                    load = _loadavg_1m()
+                    seconds = runner(
+                        os.path.join(tmp, f"{flow[0]}{trial}.db")
+                    )
+                    repeats.append(
+                        {
+                            "trial": trial,
+                            "flow": flow,
+                            "s": round(seconds, 3),
+                            "loadavg_1m": load,
+                        }
+                    )
+                    _ledger_record(
+                        f"e2e_overlap.{flow}", value=round(seconds, 3),
+                        unit="s", repeat=trial,
+                        extras={"loadavg_1m_before": load},
+                    )
+                    if flow == "serial":
+                        trial_serial = seconds
+                        t_serial = min(t_serial, seconds)
+                    else:
+                        ratios.append(trial_serial / seconds)
+                        t_overlap = min(t_overlap, seconds)
+        speedup = t_serial / t_overlap
+        if speedup >= 1.05 and min(ratios) >= 1.0:
+            decision = "wins"
+        elif speedup <= 0.95:
+            decision = "loses"
+        else:
+            decision = "wash"
         return {
             "workload": (
                 f"2 batches x {half} markets, {steps} cycles each, "
-                f"checkpoint per batch, min of 2 alternating trials"
+                f"checkpoint per batch, min of {trials} alternating trials"
             ),
             "serial_s": round(t_serial, 2),
             "overlapped_s": round(t_overlap, 2),
             "saved_s": round(t_serial - t_overlap, 2),
-            "speedup": round(t_serial / t_overlap, 3),
+            "speedup": round(speedup, 3),
+            "speedup_band": [
+                round(min(ratios), 3), round(max(ratios), 3)
+            ],
+            "repeats": repeats,
+            "decision": decision,
+            "decision_rule": (
+                "wins iff min-of-N speedup >= 1.05 and every paired "
+                "trial >= 1.0x; loses iff min-of-N <= 0.95; else wash "
+                "(quote the band)"
+            ),
         }
     finally:
         gc.unfreeze()
@@ -1487,7 +1674,7 @@ LEGS = {
         bench_e2e, {}, dict(markets=2000, resettle_markets=200), 1500,
     ),
     "e2e_overlap": (
-        bench_e2e_overlap, {}, dict(markets=2000, steps=3), 900,
+        bench_e2e_overlap, {}, dict(markets=2000, steps=3, trials=2), 900,
     ),
     "e2e_stream": (
         bench_e2e_stream, {},
@@ -1496,6 +1683,10 @@ LEGS = {
     "e2e_stream_stable_topology": (
         bench_e2e_stream_stable_topology, {},
         dict(markets=3000, batches=3, steps=2), 2000,
+    ),
+    "obs_overhead": (
+        bench_obs_overhead, {},
+        dict(markets=2000, batches=2, steps=2, trials=6), 900,
     ),
     "tiebreak_10k_agents": (
         bench_tiebreak_stress, {}, dict(markets=64, agents=128, reps=1), 900,
@@ -1541,6 +1732,7 @@ DEVICE_LEG_ORDER = [
     "e2e_overlap",
     "e2e_stream",
     "e2e_stream_stable_topology",
+    "obs_overhead",
     "tiebreak_10k_agents",
     "pallas_ab",
 ]
@@ -1563,12 +1755,15 @@ def run_leg_inprocess(name, fast=False, cpu=False):
     return fn(**(fast_kwargs if fast else kwargs))
 
 
-def run_leg_subprocess(name, timeout=None, fast=False, cpu=False):
+def run_leg_subprocess(name, timeout=None, fast=False, cpu=False,
+                       ledger=None):
     """Run one leg as a killable subprocess; never raises, never hangs.
 
-    Returns ``{"ok": True, "value": ...}`` or ``{"ok": False, "error": ...}``.
-    The child gets its own session so a hard kill takes its whole process
-    group (jax runtimes spawn threads; a hung tunnel read ignores SIGTERM).
+    Returns ``{"ok": True, "value": ..., "wall_s": ..., "phases": {...}}``
+    or ``{"ok": False, "error": ...}``. The child gets its own session so
+    a hard kill takes its whole process group (jax runtimes spawn threads;
+    a hung tunnel read ignores SIGTERM). *ledger* forwards an obs run-
+    ledger path so the child appends its own measurement records.
     """
     spec = LEGS.get(name)
     if spec is None:
@@ -1584,6 +1779,8 @@ def run_leg_subprocess(name, timeout=None, fast=False, cpu=False):
         cmd.append("--fast")
     if cpu:
         cmd.append("--cpu")
+    if ledger:
+        cmd += ["--ledger", str(ledger)]
     try:
         proc = subprocess.Popen(
             cmd,
@@ -1801,6 +1998,15 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
             name: ("ok" if res.get("ok") else res.get("error", "unknown"))
             for name, res in results.items()
         },
+        # Per-leg phase decomposition (obs/timeline.py): named spans +
+        # "untracked" remainder summing to the leg's wall_s — how the
+        # 600× resident-vs-store gap is navigated across rounds. Only
+        # legs that ran in a phase-recording subprocess carry one.
+        "phase_breakdown": {
+            name: {"wall_s": res["wall_s"], "phases": res["phases"]}
+            for name, res in results.items()
+            if res.get("ok") and "phases" in res
+        },
     }
 
     extras = {
@@ -1825,6 +2031,7 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         "e2e_stream_stable_topology": _show(
             results, "e2e_stream_stable_topology"
         ),
+        "obs_overhead": _show(results, "obs_overhead"),
         # Fallback-only leg: absent (not "failed") on healthy runs.
         **(
             {"e2e_stream_cpu": _show(results, "e2e_stream_cpu")}
@@ -2008,10 +2215,101 @@ def lint_gate(skip: bool) -> None:
         sys.exit(1)
 
 
+def headline_line(payload):
+    """The compact durable headline: final bytes carry value + unit.
+
+    VERDICT r5 #4: BENCH_r05.json lost the round's headline because the
+    single (huge) JSON line scrolled off the front of the driver's tail
+    capture. This line is printed LAST and is small — any tail capture
+    that holds its end holds the number; key order is fixed so
+    ``"value"``/``"unit"`` are literally the closing bytes.
+    """
+    return json.dumps(
+        {
+            "headline": True,
+            "metric": payload["metric"],
+            "vs_baseline": payload["vs_baseline"],
+            "value": payload["value"],
+            "unit": payload["unit"],
+        }
+    )
+
+
+def _atomic_write(path, text):
+    """Write *text* to *path* via tmp + rename: the file is never torn."""
+    tmp_path = f"{path}.tmp.{os.getpid()}"
+    with open(tmp_path, "w") as fh:
+        fh.write(text)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp_path, path)
+
+
+def _run_leg_with_obs(args):
+    """The ``--leg`` entry: run under a phase timeline, report the
+    breakdown, append the run-ledger record. Returns the child payload."""
+    from bayesian_consensus_engine_tpu.obs.ledger import RunLedger
+    from bayesian_consensus_engine_tpu.obs.timeline import (
+        PhaseTimeline,
+        recording,
+    )
+
+    global _LEDGER
+    if args.ledger:
+        backend = "cpu" if args.cpu else os.environ.get("JAX_PLATFORMS")
+        _LEDGER = RunLedger(args.ledger, backend=backend)
+    timeline = PhaseTimeline()
+    start = time.perf_counter()
+    try:
+        with recording(timeline):
+            value = run_leg_inprocess(args.leg, fast=args.fast, cpu=args.cpu)
+    except Exception as exc:  # noqa: BLE001 — reported to the parent
+        _ledger_record(
+            args.leg, extras={"error": f"{type(exc).__name__}: {exc}"}
+        )
+        if _LEDGER is not None:
+            _LEDGER.close()
+        return {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+    wall = time.perf_counter() - start
+    # The additive leg breakdown: named exclusive spans plus the
+    # untracked remainder, summing to wall_s by construction.
+    phases = {k: round(v, 6) for k, v in timeline.totals().items()}
+    untracked = wall - sum(phases.values())
+    if untracked > 0:
+        phases["untracked"] = round(untracked, 6)
+    if _LEDGER is not None:
+        _ledger_record(
+            args.leg,
+            value=value if isinstance(value, (int, float)) else None,
+            phases=phases,
+            extras={"wall_s": round(wall, 3)},
+        )
+        _LEDGER.close()
+    return {
+        "ok": True,
+        "value": value,
+        "wall_s": round(wall, 3),
+        "phases": phases,
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--leg", help="run one leg in-process (internal)")
-    parser.add_argument("--out", help="JSON result path for --leg")
+    parser.add_argument(
+        "--out",
+        help=(
+            "atomically write the full JSON record here (per-leg result "
+            "for --leg; the driver record otherwise)"
+        ),
+    )
+    parser.add_argument(
+        "--ledger",
+        help=(
+            "append per-leg obs run-ledger records (JSONL; loadavg + "
+            "repeat index) here — render with `bce-tpu stats`"
+        ),
+    )
     parser.add_argument(
         "--fast", action="store_true",
         help="tiny shapes + short budgets (harness self-test)",
@@ -2027,15 +2325,12 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     if args.leg:
-        try:
-            value = run_leg_inprocess(args.leg, fast=args.fast, cpu=args.cpu)
-            payload = {"ok": True, "value": value}
-        except Exception as exc:  # noqa: BLE001 — reported to the parent
-            payload = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+        payload = _run_leg_with_obs(args)
         out = json.dumps(payload)
         if args.out:
-            with open(args.out, "w") as fh:
-                fh.write(out)
+            # Atomic like the driver record: the parent must never read a
+            # torn per-leg file from a child that died mid-write.
+            _atomic_write(args.out, out)
         else:
             print(out)
         return 0
@@ -2043,8 +2338,19 @@ def main(argv=None):
     # Gate the orchestrated run only — each --leg subprocess is spawned by
     # an orchestrator that already passed (or explicitly skipped) the gate.
     lint_gate(args.no_lint)
-    payload, rc = orchestrate(fast=args.fast, cpu=args.cpu)
-    print(json.dumps(payload))
+    if args.ledger:
+        import functools
+
+        run_leg = functools.partial(run_leg_subprocess, ledger=args.ledger)
+    else:
+        run_leg = run_leg_subprocess
+    payload, rc = orchestrate(run_leg=run_leg, fast=args.fast, cpu=args.cpu)
+    full = json.dumps(payload)
+    if args.out:
+        _atomic_write(args.out, full + "\n")
+    print(full)
+    # LAST line, always: the compact headline no tail capture can lose.
+    print(headline_line(payload))
     return rc
 
 

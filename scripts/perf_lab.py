@@ -221,14 +221,40 @@ def main(argv=None):
         "--no-lint", action="store_true",
         help="skip the pre-run graftlint gate (docs/static-analysis.md)",
     )
+    parser.add_argument(
+        "--ledger",
+        help=(
+            "append one obs run-ledger record per experiment (JSONL, "
+            "loadavg attribution) — render with `bce-tpu stats`"
+        ),
+    )
     args = parser.parse_args(argv)
     # Same contract as bench.py: lab numbers from a lint-dirty tree are
     # not comparable to the adjudicated baselines.
     bench.lint_gate(args.no_lint)
-    if args.command == "all":
-        out = {name: fn(args) for name, fn in COMMANDS.items()}
-    else:
-        out = COMMANDS[args.command](args)
+    ledger = None
+    if args.ledger:
+        from bayesian_consensus_engine_tpu.obs.ledger import RunLedger
+
+        ledger = RunLedger(args.ledger)
+
+    def run_one(name, fn):
+        # Record IMMEDIATELY after each experiment (same discipline as
+        # bench --ledger): the record's host snapshot then reflects the
+        # load during/around that experiment, not the end of the run.
+        result = fn(args)
+        if ledger is not None:
+            ledger.record(f"perf_lab.{name}", extras={"result": result})
+        return result
+
+    try:
+        if args.command == "all":
+            out = {name: run_one(name, fn) for name, fn in COMMANDS.items()}
+        else:
+            out = run_one(args.command, COMMANDS[args.command])
+    finally:
+        if ledger is not None:
+            ledger.close()
     print(json.dumps(out))
     return 0
 

@@ -110,6 +110,32 @@ class TestScenarioSemantics:
         )
 
 
+class TestGoldenWithObsEnabled:
+    """The golden bytes with observability fully ON (ISSUE 3 acceptance).
+
+    obs (metrics registry + phase timeline) is write-only host
+    instrumentation; enabling it may not move a single output byte. The
+    deeper settle/settle_stream + checkpoint-byte parity lives in
+    tests/test_obs.py; this pins the user-visible fixture contract in
+    the same file that pins it for the disabled default.
+    """
+
+    def test_exact_output_match_with_obs_enabled(self):
+        from bayesian_consensus_engine_tpu import obs
+
+        fixture = _load("golden_regression.json")
+        timeline = obs.PhaseTimeline()
+        previous = obs.set_metrics_registry(obs.MetricsRegistry())
+        try:
+            with obs.recording(timeline):
+                result = compute_consensus(fixture["input"]["signals"])
+        finally:
+            obs.set_metrics_registry(previous)
+        assert json.dumps(result, indent=2) == json.dumps(
+            fixture["expectedOutput"], indent=2
+        )
+
+
 class TestFixtureIntegrity:
     """Every fixture file must be valid JSON with required meta keys."""
 

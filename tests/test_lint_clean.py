@@ -33,7 +33,7 @@ _DEVLINT_IDS = ("F401", "F541", "F811", "F821", "F841", "E711", "E712", "E722")
 _NEW_FAMILY_IDS = (
     "JX101", "JX102", "JX103", "JX104", "JX105", "JX106", "JX107", "JX108",
     "DT201", "DT202", "DT203",
-    "LY301", "LY302",
+    "LY301", "LY302", "LY303",
 )
 
 
@@ -130,6 +130,15 @@ _CASES = [
         f"{PKG}/core/case.py",
         "import jax.numpy as jnp\n\nSENTINEL = jnp.int32(0)\n",
         "import jax.numpy as jnp\n\ndef sentinel():\n    return jnp.int32(0)\n",
+    ),
+    (
+        # obs is layer 0, so LY301 alone would let a kernel import it —
+        # LY303 is the rule that keeps pure-math layers instrumentation-
+        # free (config.OBS_ALLOWED_IMPORTERS).
+        "LY303",
+        f"{PKG}/ops/case.py",
+        f"from {PKG}.obs.timeline import active_timeline\n",
+        f"from {PKG}.utils import config\n",
     ),
     (
         "F401",
@@ -236,6 +245,26 @@ class TestLayeringResolution:
         # Nothing inside the package imports the root facade (layer 99).
         src = f"from {PKG} import SCHEMA_VERSION\n"
         assert "LY301" in _codes(src, f"{PKG}/cli.py", select=["LY301"])
+
+    def test_obs_import_allowed_from_orchestration_layers(self):
+        src = f"from {PKG}.obs.metrics import metrics_registry\n"
+        for rel in (
+            f"{PKG}/pipeline.py",
+            f"{PKG}/state/journal.py",
+            f"{PKG}/cli.py",
+        ):
+            assert _codes(src, rel, select=["LY303"]) == [], rel
+
+    def test_obs_import_flagged_from_pure_math_layers(self):
+        # `from pkg import obs` and lazy in-function imports both count.
+        for src in (
+            f"from {PKG} import obs\n",
+            f"def f():\n    from {PKG}.obs import ledger\n    return ledger\n",
+        ):
+            for rel in (f"{PKG}/parallel/case.py", f"{PKG}/ops/case.py"):
+                assert "LY303" in _codes(src, rel, select=["LY303"]), (
+                    src, rel,
+                )
 
 
 class TestSuppression:

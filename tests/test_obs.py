@@ -1,0 +1,422 @@
+"""obs/ determinism contract: the instrumentation may never move a byte.
+
+Four pinned properties (ISSUE 3 acceptance):
+
+* the default histogram bucket layout is FROZEN — a changed edge silently
+  re-bins every historical capture;
+* metric export and ledger lines are byte-stable regardless of the order
+  call sites registered things in (DT203 applied to ourselves);
+* disabled mode is structurally free: every lookup returns one shared
+  null object (no allocation to measure, nothing to misattribute);
+* enabling obs changes NOTHING the engine produces — golden fixture
+  bytes, settle/settle_stream results, and SQLite checkpoint files are
+  identical with obs off and fully on.
+"""
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bayesian_consensus_engine_tpu import obs
+from bayesian_consensus_engine_tpu.obs import ledger as obs_ledger
+from bayesian_consensus_engine_tpu.obs import metrics as obs_metrics
+from bayesian_consensus_engine_tpu.obs import timeline as obs_timeline
+
+
+class TestHistogramLayout:
+    def test_default_bounds_pinned(self):
+        # 1 µs → 100 s, 2 per decade: 17 edges, frozen. Re-deriving from
+        # the closed form guards the formula; the literal endpoints guard
+        # the parameters.
+        bounds = obs_metrics.DEFAULT_BOUNDS
+        assert len(bounds) == 17
+        assert bounds[0] == 1e-6
+        assert bounds[-1] == pytest.approx(100.0)
+        expected = tuple(1e-6 * 10.0 ** (i / 2) for i in range(17))
+        assert bounds == expected
+
+    def test_bounds_require_whole_decade_steps(self):
+        with pytest.raises(ValueError):
+            obs_metrics.log_spaced_bounds(1e-3, 5e-2, 2)
+        with pytest.raises(ValueError):
+            obs_metrics.log_spaced_bounds(-1.0, 1.0, 2)
+
+    def test_observe_bins_and_overflow(self):
+        h = obs_metrics.Histogram(bounds=(1.0, 10.0, 100.0))
+        for value in (0.5, 1.0, 5.0, 99.0, 1000.0):
+            h.observe(value)
+        snap = h.snapshot()
+        # value <= edge lands in that bucket; past the last edge is the
+        # implicit overflow bucket.
+        assert snap["counts"] == [2, 1, 1, 1]
+        assert snap["count"] == 5
+        assert snap["sum"] == pytest.approx(0.5 + 1.0 + 5.0 + 99.0 + 1000.0)
+
+    def test_conflicting_bounds_rejected(self):
+        registry = obs_metrics.MetricsRegistry()
+        registry.histogram("h", bounds=(1.0, 2.0))
+        with pytest.raises(ValueError, match="different"):
+            registry.histogram("h", bounds=(1.0, 3.0))
+
+
+class TestDeterministicExport:
+    def test_byte_stable_across_registration_order(self):
+        def populate(registry, names):
+            for name in names:
+                registry.counter(f"c.{name}").inc(3)
+                registry.gauge(f"g.{name}").set(1.5)
+                registry.histogram(f"h.{name}").observe(0.01)
+
+        a = obs_metrics.MetricsRegistry()
+        b = obs_metrics.MetricsRegistry()
+        populate(a, ["alpha", "beta", "gamma"])
+        populate(b, ["gamma", "alpha", "beta"])
+        assert a.to_json().encode() == b.to_json().encode()
+
+    def test_export_names_sorted(self):
+        registry = obs_metrics.MetricsRegistry()
+        registry.counter("z").inc()
+        registry.counter("a").inc()
+        assert list(registry.export()["counters"]) == ["a", "z"]
+
+
+class TestDisabledModeIdentity:
+    def test_null_registry_returns_one_shared_object(self):
+        null = obs_metrics.NULL_REGISTRY
+        assert null.counter("a") is null.counter("b")
+        assert null.counter("a") is null.gauge("x") is null.histogram("y")
+        # The no-ops really are no-ops.
+        null.counter("a").inc(5)
+        null.histogram("h").observe(1.0)
+        assert null.export() == {
+            "counters": {}, "gauges": {}, "histograms": {},
+        }
+
+    def test_default_registry_is_the_null_one(self):
+        assert obs.metrics_registry() is obs_metrics.NULL_REGISTRY
+        assert not obs.metrics_registry().enabled
+
+    def test_set_registry_roundtrip(self):
+        live = obs_metrics.MetricsRegistry()
+        previous = obs.set_metrics_registry(live)
+        try:
+            assert obs.metrics_registry() is live
+        finally:
+            obs.set_metrics_registry(previous)
+        assert obs.metrics_registry() is previous
+
+    def test_null_timeline_span_is_one_shared_noop(self):
+        null = obs_timeline.NULL_TIMELINE
+        assert null.span("a") is null.span("b")
+        with null.span("a"):
+            pass
+        assert null.totals() == {}
+        assert not null.enabled
+
+    def test_default_active_timeline_is_null(self):
+        assert obs.active_timeline() is obs_timeline.NULL_TIMELINE
+
+
+class TestTimeline:
+    def test_recording_is_thread_local(self):
+        timeline = obs.PhaseTimeline()
+        seen = {}
+
+        def worker():
+            seen["timeline"] = obs.active_timeline()
+
+        with obs.recording(timeline):
+            assert obs.active_timeline() is timeline
+            thread = threading.Thread(target=worker)
+            thread.start()
+            thread.join()
+        # The worker thread saw the null timeline: overlapped worker time
+        # must not enter the additive breakdown.
+        assert seen["timeline"] is obs_timeline.NULL_TIMELINE
+        assert obs.active_timeline() is obs_timeline.NULL_TIMELINE
+
+    def test_nested_spans_attribute_exclusively(self):
+        timeline = obs.PhaseTimeline()
+        with obs.recording(timeline):
+            with obs.active_timeline().span("checkpoint"):
+                time.sleep(0.02)
+                with obs.active_timeline().span("journal_fsync"):
+                    time.sleep(0.02)
+        totals = timeline.totals()
+        # The outer phase excludes the nested one: both ~20 ms, and the
+        # pair sums to the outer wall instead of double-counting it.
+        assert totals["journal_fsync"] >= 0.015
+        assert totals["checkpoint"] >= 0.015
+        assert totals["checkpoint"] + totals["journal_fsync"] < 0.08
+
+    def test_delta_reports_only_advanced_phases(self):
+        before = {"pack": 1.0, "upload": 2.0}
+        after = {"pack": 1.5, "upload": 2.0, "fetch": 0.25}
+        assert obs.PhaseTimeline.delta(before, after) == {
+            "fetch": 0.25, "pack": 0.5,
+        }
+
+    def test_canonical_phase_vocabulary(self):
+        assert obs.PHASES == (
+            "pack", "upload", "settle_dispatch", "fetch", "journal_fsync",
+            "checkpoint", "interchange_export",
+        )
+
+
+class TestLedger:
+    def test_schema_roundtrip(self, tmp_path):
+        path = tmp_path / "run.jsonl"
+        with obs.RunLedger(path, run_id="r1", backend="cpu") as ledger:
+            ledger.record(
+                "leg_a", value=1.25, unit="s", repeat=0,
+                phases={"pack": 0.5}, extras={"k": "v"},
+            )
+            ledger.record("leg_a", value=1.5, unit="s", repeat=1)
+        first, second = obs.read_ledger(path)
+        assert first["schema"] == obs_ledger.SCHEMA_VERSION
+        assert first["run_id"] == "r1"
+        assert first["backend"] == "cpu"
+        assert (first["seq"], second["seq"]) == (0, 1)
+        assert first["leg"] == "leg_a"
+        assert first["value"] == 1.25
+        assert first["unit"] == "s"
+        assert first["repeat"] == 0
+        assert first["phases"] == {"pack": 0.5}
+        assert first["extras"] == {"k": "v"}
+        assert "loadavg_1m" in first["host"]
+        assert first["host"]["cpu_count"] == os.cpu_count()
+        assert first["wall_unix_ts"] <= second["wall_unix_ts"]
+
+    def test_record_lines_are_sorted_key_json(self, tmp_path):
+        path = tmp_path / "run.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            ledger.record("leg", extras={"zz": 1, "aa": 2})
+        (line,) = path.read_text().strip().splitlines()
+        assert line == json.dumps(json.loads(line), sort_keys=True)
+
+    def test_append_only_across_writers(self, tmp_path):
+        path = tmp_path / "run.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            ledger.record("a")
+        with obs.RunLedger(path, run_id="r2") as ledger:
+            ledger.record("b")
+        records = obs.read_ledger(path)
+        assert [r["run_id"] for r in records] == ["r1", "r2"]
+
+    def test_torn_tail_dropped_interior_garbage_raises(self, tmp_path):
+        path = tmp_path / "run.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            ledger.record("a")
+            ledger.record("b")
+        with open(path, "a") as f:
+            f.write('{"torn": ')  # crash mid-append
+        records = obs.read_ledger(path)
+        assert [r["leg"] for r in records] == ["a", "b"]
+        with open(path, "w") as f:
+            f.write('{"torn": \n')
+            f.write(json.dumps({"leg": "c"}) + "\n")
+        with pytest.raises(ValueError, match="malformed"):
+            obs.read_ledger(path)
+
+    def test_min_of_repeats_band(self, tmp_path):
+        path = tmp_path / "run.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            for i, value in enumerate((2.0, 1.0, 1.5)):
+                ledger.record("leg", value=value, unit="s", repeat=i)
+            ledger.record("leg", value=None)  # non-numeric: ignored
+        band = obs.min_of_repeats(obs.read_ledger(path), "leg")
+        assert band["n"] == 3
+        assert band["min"] == 1.0
+        assert band["max"] == 2.0
+        assert band["spread_pct"] == 100.0
+        assert band["unit"] == "s"
+        assert obs.min_of_repeats([], "leg") is None
+
+    def test_summarize_and_render(self, tmp_path):
+        path = tmp_path / "run.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            ledger.record("b_leg", value=3.0, unit="s")
+            ledger.record("a_leg", value=1.0, unit="s")
+        records = obs.read_ledger(path)
+        summary = obs.summarize(records)
+        assert list(summary) == ["a_leg", "b_leg"]
+        rendered = obs_ledger.render(records)
+        assert "a_leg" in rendered and "b_leg" in rendered
+
+
+class TestGoldenParityWithObsEnabled:
+    """Enabling obs may not move a single output byte."""
+
+    def _enable(self):
+        timeline = obs.PhaseTimeline()
+        previous = obs.set_metrics_registry(obs.MetricsRegistry())
+        return timeline, previous
+
+    def test_golden_fixture_bytes_with_obs_enabled(self):
+        import pathlib
+
+        from bayesian_consensus_engine_tpu.core import compute_consensus
+
+        fixture = json.loads(
+            (pathlib.Path(__file__).parent / "fixtures" /
+             "golden_regression.json").read_text(encoding="utf-8")
+        )
+        timeline, previous = self._enable()
+        try:
+            with obs.recording(timeline):
+                result = compute_consensus(fixture["input"]["signals"])
+        finally:
+            obs.set_metrics_registry(previous)
+        assert json.dumps(result, indent=2) == json.dumps(
+            fixture["expectedOutput"], indent=2
+        )
+
+    def _stream(self, enabled):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        def batches():
+            rng = np.random.default_rng(5)
+            for b in range(3):
+                payloads = [
+                    (
+                        f"m{b}-{i}",
+                        [
+                            {"sourceId": f"s{j}",
+                             "probability": float(rng.random())}
+                            for j in range(3)
+                        ],
+                    )
+                    for i in range(6)
+                ]
+                yield payloads, (rng.random(6) < 0.5).tolist()
+
+        store = TensorReliabilityStore()
+        stats = []
+        timeline, previous = (
+            self._enable() if enabled else (None, None)
+        )
+        try:
+            with tempfile.TemporaryDirectory() as tmp:
+                db = os.path.join(tmp, "ckpt.db")
+                journal = os.path.join(tmp, "ckpt.jrnl")
+                with obs.recording(timeline):
+                    results = [
+                        result.by_market()
+                        for result in settle_stream(
+                            store, batches(), steps=2, now=21_900.0,
+                            db_path=db, journal=journal,
+                            checkpoint_every=2, stats=stats,
+                        )
+                    ]
+                    store.sync()
+                db_digest = hashlib.sha256(
+                    open(db, "rb").read()
+                ).hexdigest()
+                journal_head = open(journal, "rb").read(8)
+        finally:
+            if enabled:
+                obs.set_metrics_registry(previous)
+        return results, db_digest, journal_head, stats, timeline
+
+    def test_settle_stream_byte_parity_and_phases(self):
+        res_off, db_off, jrnl_off, stats_off, _ = self._stream(False)
+        res_on, db_on, jrnl_on, stats_on, timeline = self._stream(True)
+        # Bit-exact results and checkpoint BYTES, obs on vs off.
+        assert res_on == res_off
+        assert db_on == db_off
+        assert jrnl_on == jrnl_off == b"BCEJRNL1"
+        # Obs-disabled stats keep the unchanged schema; enabled stats add
+        # the additive per-batch phase breakdown in canonical names.
+        assert all("phases" not in s for s in stats_off)
+        assert all("phases" in s for s in stats_on)
+        recorded = set()
+        for entry in stats_on:
+            recorded |= set(entry["phases"])
+            assert all(v >= 0 for v in entry["phases"].values())
+        assert recorded <= set(obs.PHASES)
+        assert "settle_dispatch" in recorded
+        # The stream's wiring reached the state tiers too.
+        totals = timeline.totals()
+        assert "journal_fsync" in totals
+        assert "interchange_export" in totals  # tail SQLite export
+
+    def test_settle_stream_metrics_counters(self):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            rng = np.random.default_rng(7)
+            payloads = [
+                ("m0", [{"sourceId": "s0", "probability": 0.5}]),
+                ("m1", [{"sourceId": "s1", "probability": 0.25}]),
+            ]
+            list(settle_stream(
+                TensorReliabilityStore(),
+                [(payloads, [True, False])] * 2,
+                steps=1, now=21_900.0, reuse_plans=True,
+            ))
+            del rng
+        finally:
+            obs.set_metrics_registry(previous)
+        export = registry.export()
+        assert export["counters"]["stream.batches"] == 2
+        assert export["counters"]["stream.plan_reuse_hits"] == 1
+        assert export["counters"]["stream.plan_reuse_misses"] == 1
+        assert export["histograms"]["stream.settle_dispatch_s"]["count"] == 2
+        assert export["histograms"]["stream.plan_build_s"]["count"] >= 1
+
+
+class TestCliStats:
+    def _main(self, argv, capsys):
+        import sys
+        from unittest import mock
+
+        from bayesian_consensus_engine_tpu import cli
+
+        with mock.patch.object(sys, "argv", ["bce-tpu", *argv]):
+            cli.main()
+        return capsys.readouterr()
+
+    def test_stats_renders_ledger(self, tmp_path, capsys):
+        path = tmp_path / "run.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            ledger.record("headline_f32", value=7000.0, unit="cycles/sec",
+                          repeat=0)
+            ledger.record("headline_f32", value=6800.0, unit="cycles/sec",
+                          repeat=1)
+        out = self._main(["stats", str(path)], capsys).out
+        assert "headline_f32" in out
+        assert "2 records" in out
+
+    def test_stats_json_band(self, tmp_path, capsys):
+        path = tmp_path / "run.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            ledger.record("leg", value=2.0, unit="s", repeat=0)
+            ledger.record("leg", value=1.0, unit="s", repeat=1)
+            ledger.record("other", value=9.0, unit="s")
+        out = self._main(
+            ["stats", str(path), "--json", "--leg", "leg"], capsys
+        ).out
+        payload = json.loads(out)
+        assert payload["records"] == 2
+        assert payload["legs"]["leg"]["min"] == 1.0
+        assert payload["legs"]["leg"]["max"] == 2.0
+        assert "other" not in payload["legs"]
+
+    def test_stats_missing_file_errors(self, tmp_path, capsys):
+        with pytest.raises(SystemExit) as excinfo:
+            self._main(["stats", str(tmp_path / "nope.jsonl")], capsys)
+        assert excinfo.value.code == 1
