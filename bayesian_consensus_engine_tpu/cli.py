@@ -217,20 +217,44 @@ def _run_stats(args: argparse.Namespace) -> None:
     subcommand folds one file into per-leg min/max bands with the host
     load that attributes the spread. ``--json`` emits the machine-shaped
     summary instead of the table.
+
+    ``--against OLD.jsonl`` switches to cross-round diffing: each leg's
+    band is compared against the old ledger's and flagged when the bands
+    stopped overlapping (``shifted_up``/``shifted_down`` — the
+    regression signal the VERDICT previously extracted by hand; which
+    direction is the regression depends on the leg's unit).
     """
     from bayesian_consensus_engine_tpu.obs.ledger import (
+        diff_bands,
         read_ledger,
         render,
+        render_diff,
         summarize,
     )
 
     try:
         records = read_ledger(args.ledger)
+        old_records = (
+            read_ledger(args.against) if args.against else None
+        )
     except (OSError, ValueError) as exc:
         print(f"Error: {exc}", file=sys.stderr)
         raise SystemExit(1) from exc
     if args.leg:
         records = [r for r in records if r.get("leg") == args.leg]
+        if old_records is not None:
+            old_records = [
+                r for r in old_records if r.get("leg") == args.leg
+            ]
+    if old_records is not None:
+        diff = diff_bands(old_records, records)
+        if args.json:
+            _emit({"ledger": args.ledger, "against": args.against,
+                   "legs": diff})
+        else:
+            print(f"{args.ledger} vs {args.against}")
+            print(render_diff(diff))
+        return
     if args.json:
         _emit({"ledger": args.ledger, "records": len(records),
                "legs": summarize(records)})
@@ -345,6 +369,13 @@ def build_parser() -> argparse.ArgumentParser:
         "ledger", help="path to a JSONL run ledger (bench.py --ledger)"
     )
     stats.add_argument("--leg", help="restrict to one leg name")
+    stats.add_argument(
+        "--against", metavar="OLD_LEDGER",
+        help=(
+            "cross-round diff: compare each leg's band against this "
+            "older ledger and flag bands that stopped overlapping"
+        ),
+    )
     stats.add_argument(
         "--json", action="store_true",
         help="machine-readable summary instead of the table",

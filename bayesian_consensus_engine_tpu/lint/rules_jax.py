@@ -257,6 +257,90 @@ def check_jit_missing_donation_advisory(ctx):
     yield from check_jit_missing_donation(ctx)
 
 
+#: Wall-clock reads that mark a scope as TIMING code (dotted origins
+#: after alias resolution — `import time as _time` still resolves).
+_TIMING_CALLS = {
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "timeit.default_timer",
+}
+
+
+def _scope_walk(body):
+    """Walk statements WITHOUT descending into nested function defs —
+    each def is its own timing scope (a timed outer function must not
+    contaminate an untimed inner helper or vice versa). Class bodies
+    pass through: their statements execute in the enclosing scope."""
+    stack = [
+        node for node in body
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                stack.append(child)
+
+
+@rule(
+    "JX109",
+    name="block-until-ready-fence",
+    rationale=(
+        "timing code fenced with block_until_ready measures the wrong "
+        "thing through a remote device tunnel: it does not force remote "
+        "execution (the perf_lab fencing contract), so the stopwatch "
+        "stops before the kernel ran — fence with a scalar value fetch "
+        "(bench._fence / float(x.reshape(-1)[0])) instead. Warning tier: "
+        "an audit, not a gate — a deliberately-local fence can carry a "
+        "noqa with its justification"
+    ),
+    severity="warning",
+)
+def check_block_until_ready_fence(ctx):
+    """Flag ``block_until_ready`` inside a scope that also reads a
+    monotonic clock — the co-occurrence that defines a timing window.
+    A bare correctness sync (no stopwatch in the same scope) is fine.
+    Scopes are per function def (EVERY def, same-named methods
+    included) plus the module top level; nested defs never leak their
+    calls into the enclosing scope."""
+
+    def scan(nodes):
+        timing = False
+        fences = []
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.dotted(node.func) in _TIMING_CALLS:
+                timing = True
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                fences.append(node.lineno)
+        return fences if timing else []
+
+    flagged = set()
+    # NOT _all_defs: that map dedupes by name (lookup semantics), and
+    # this rule needs exhaustive coverage — the second of two same-named
+    # methods must still be scanned.
+    for fn in ast.walk(ctx.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            flagged.update(scan(_scope_walk(fn.body)))
+    flagged.update(scan(_scope_walk(ctx.tree.body)))
+    for lineno in sorted(flagged):
+        yield (
+            lineno,
+            "`block_until_ready` fences a timed window (does not force "
+            "execution through a remote tunnel; fence with a scalar "
+            "value fetch)",
+        )
+
+
 def _static_positions(jit_call: ast.Call):
     """Static argument positions declared on a ``jax.jit(...)`` call."""
     for kw in jit_call.keywords:

@@ -22,9 +22,11 @@ instrumentation-free).
 
 from bayesian_consensus_engine_tpu.obs.ledger import (
     RunLedger,
+    diff_bands,
     host_snapshot,
     min_of_repeats,
     read_ledger,
+    render_diff,
     summarize,
 )
 from bayesian_consensus_engine_tpu.obs.metrics import (
@@ -56,12 +58,14 @@ __all__ = [
     "PhaseTimeline",
     "RunLedger",
     "active_timeline",
+    "diff_bands",
     "host_snapshot",
     "log_spaced_bounds",
     "metrics_registry",
     "min_of_repeats",
     "read_ledger",
     "recording",
+    "render_diff",
     "set_metrics_registry",
     "summarize",
 ]

@@ -212,6 +212,85 @@ def summarize(records: List[Dict[str, object]]) -> Dict[str, Dict[str, object]]:
     return out
 
 
+def diff_bands(
+    old_records: List[Dict[str, object]],
+    new_records: List[Dict[str, object]],
+) -> Dict[str, Dict[str, object]]:
+    """Cross-round band comparison — the regression signal as data.
+
+    For every leg in either ledger, compare the old and new min–max bands
+    (``min_of_repeats``). ``status`` per leg:
+
+    * ``"overlap"`` — the bands share at least one value: the rounds are
+      statistically indistinguishable under the min-of-N policy (the
+      adjudication the VERDICT previously extracted by hand).
+    * ``"shifted_up"`` / ``"shifted_down"`` — the bands stopped
+      overlapping (``new.min > old.max`` / ``new.max < old.min``). Which
+      direction is the regression depends on the leg's unit — seconds up
+      is worse, cycles/sec up is better — so the diff reports direction
+      and leaves the verdict to the reader (the unit rides along).
+    * ``"old_only"`` / ``"new_only"`` — the leg has numeric values in
+      only one ledger (added, removed, or failed legs).
+
+    The ``old``/``new`` bands are included verbatim so a renderer (or a
+    round note) can quote the ranges, not just the flag.
+    """
+    old_summary = summarize(old_records)
+    new_summary = summarize(new_records)
+    out: Dict[str, Dict[str, object]] = {}
+    for leg in sorted(set(old_summary) | set(new_summary)):
+        old_band = old_summary.get(leg)
+        new_band = new_summary.get(leg)
+        has_old = old_band is not None and old_band["min"] is not None
+        has_new = new_band is not None and new_band["min"] is not None
+        if not has_old and not has_new:
+            status = "no_values"
+        elif not has_old:
+            status = "new_only"
+        elif not has_new:
+            status = "old_only"
+        elif new_band["min"] > old_band["max"]:
+            status = "shifted_up"
+        elif new_band["max"] < old_band["min"]:
+            status = "shifted_down"
+        else:
+            status = "overlap"
+        out[leg] = {"leg": leg, "status": status,
+                    "old": old_band, "new": new_band}
+    return out
+
+
+def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
+    """Human-readable cross-round table for ``bce-tpu stats --against``."""
+    if not diff:
+        return "no legs in either ledger"
+
+    def band_str(band):
+        if band is None or band["min"] is None:
+            return "-"
+        return f"{band['min']:.4g}..{band['max']:.4g}"
+
+    lines = [
+        f"{'leg':<34} {'old band':>16} {'new band':>16} {'status':>13} unit"
+    ]
+    moved = 0
+    for leg, entry in diff.items():
+        band = entry["new"] or entry["old"]
+        unit = (band or {}).get("unit") or "-"
+        if entry["status"] in ("shifted_up", "shifted_down"):
+            moved += 1
+        lines.append(
+            f"{leg:<34} {band_str(entry['old']):>16} "
+            f"{band_str(entry['new']):>16} {entry['status']:>13} {unit}"
+        )
+    lines.append(
+        f"{moved} leg(s) stopped overlapping"
+        if moved
+        else "all shared legs overlap"
+    )
+    return "\n".join(lines)
+
+
 def render(records: List[Dict[str, object]]) -> str:
     """Human-readable per-leg table for ``bce-tpu stats``."""
     summary = summarize(records)

@@ -1460,6 +1460,7 @@ def settle_stream(
     lazy_checkpoints: bool = False,
     journal=None,
     reuse_plans: bool = False,
+    sync_checkpoints: bool = False,
 ):
     """The streamed settle-and-checkpoint service loop, fully overlapped.
 
@@ -1617,6 +1618,27 @@ def settle_stream(
     the ``len(stats)`` recipe, which assumes rolling SQLite. With
     *journal* set, ``lazy_checkpoints`` must be off (an epoch's content
     is the drained truth by contract).
+
+    Journal epochs are ASYNC by default (*sync_checkpoints* = False):
+    the epoch's content is snapshotted in-loop (the delta drain + dirty-
+    row copy — the ``checkpoint`` phase, now snapshot-cheap), and the
+    frame/CRC/append/fsync run on a background writer thread
+    (:meth:`~.state.tensor_store.TensorReliabilityStore.
+    flush_to_journal_async`) that the NEXT epoch joins — writes
+    serialise, and a background failure surfaces at that join (the
+    ``journal_async_wait`` phase, near zero when the write overlapped the
+    intervening batches), never silently. The durability contract at a
+    yield is therefore *epoch N−1 fsynced, epoch N in flight*; a crash
+    between them loses at most one cadence of batches, which replay's
+    torn-frame drop and ``batches[tag + 1:]`` resumption already handle.
+    Every clean exit (exhaustion, break/close, a batch error) joins the
+    in-flight epoch in the tail, so the journal a caller observes after
+    the stream ends is identical to the synchronous mode's.
+    ``sync_checkpoints=True`` is the escape hatch restoring the strict
+    "yield implies fsynced" — each epoch writes and fsyncs in-loop,
+    today's pre-async semantics. The flag is journal-mode only: rolling
+    SQLite checkpoints were already backgrounded and keep their
+    semantics either way.
     """
     import time as _time
 
@@ -1667,6 +1689,7 @@ def settle_stream(
     dispatch_hist = registry.histogram("stream.settle_dispatch_s")
 
     handle = None
+    journal_handle = None
     flushed_through = -1
     journaled_through = -1
     settled_through = -1
@@ -1743,15 +1766,25 @@ def settle_stream(
                     )
                 due = (index + 1) % checkpoint_every == 0
                 if journal is not None and due:
-                    # Rolling durability rides the journal (one fsynced
-                    # binary epoch, tag = this settled batch); SQLite is
-                    # the tail flush's job. A failed epoch write is
-                    # flagged so the exit tail flush does not retry the
-                    # same broken journal and shadow this error.
+                    # Rolling durability rides the journal (one binary
+                    # epoch, tag = this settled batch); SQLite is the
+                    # tail flush's job. Async mode (the default) pins the
+                    # epoch's content here but backgrounds the write —
+                    # the fsync overlaps the next batches, and the
+                    # PREVIOUS epoch's completion (or failure) surfaces
+                    # at the join inside this call (journal_async_wait).
+                    # A failed epoch write is flagged so the exit tail
+                    # flush does not retry the same broken journal and
+                    # shadow this error.
                     checkpoint_start = _time.perf_counter()
                     try:
                         with timeline.span("checkpoint"):
-                            store.flush_to_journal(journal, tag=index)
+                            if sync_checkpoints:
+                                store.flush_to_journal(journal, tag=index)
+                            else:
+                                journal_handle = store.flush_to_journal_async(
+                                    journal, tag=index
+                                )
                     except BaseException:
                         journal_write_failed = True
                         raise
@@ -1796,12 +1829,18 @@ def settle_stream(
         # GeneratorExit close() into a RuntimeError) — the journal's
         # durable point is simply the last epoch that landed.
         try:
-            if (
-                journal is not None
-                and not journal_write_failed
-                and settled_through > journaled_through
-            ):
-                store.flush_to_journal(journal, tag=settled_through)
+            if journal is not None and not journal_write_failed:
+                if settled_through > journaled_through:
+                    # Joins any in-flight background epoch first, so the
+                    # tail epoch lands after (and surfaces any failure
+                    # of) the last cadence's write.
+                    store.flush_to_journal(journal, tag=settled_through)
+                elif journal_handle is not None:
+                    # Nothing new to journal, but the last cadence's
+                    # epoch may still be in flight: the stream must not
+                    # end before its durability (or failure) is known.
+                    with timeline.span("journal_async_wait"):
+                        journal_handle.result()
         finally:
             if owns_journal and journal is not None:
                 journal.close()

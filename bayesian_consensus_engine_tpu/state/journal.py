@@ -20,6 +20,18 @@ Why not orbax for this: the store's identity sidecar (interned
 JSON metadata, which re-serialises EVERY pair on EVERY snapshot —
 O(total rows) per epoch where the journal is O(new + re-touched rows).
 
+Epochs may also be written ASYNCHRONOUSLY
+(:meth:`~.tensor_store.TensorReliabilityStore.flush_to_journal_async`):
+the epoch's content is snapshotted under the store lock, and the frame/
+CRC/append/fsync run on a background writer thread the next flush joins
+— writes still serialise, and a background failure surfaces at the join
+with the torn frame truncated back (``append_epoch``'s failure path), so
+the file is ALWAYS valid through the last joined epoch. This is what
+shifts :func:`~.pipeline.settle_stream`'s durability contract from
+"yield implies fsynced" to "yield implies the previous cadence's epoch
+fsynced, this one in flight" (``sync_checkpoints=True`` restores the
+strict form).
+
 File format (all little-endian)::
 
     header   MAGIC = b"BCEJRNL1"
@@ -238,17 +250,39 @@ class JournalWriter:
         )
         # The write+flush+fsync is the durability wait a streaming service
         # actually blocks on — named "journal_fsync" in the phase timeline
-        # (no-op unless this thread is recording; obs/timeline.py).
+        # (no-op unless this thread is recording; obs/timeline.py). With
+        # the async-epoch path (tensor_store.flush_to_journal_async) this
+        # runs on a background writer thread, which records nothing by
+        # design: the consumer-visible share is the "journal_async_wait"
+        # join span.
         with active_timeline().span("journal_fsync"):
-            self._file.write(payload)
-            self._file.write(struct.pack("<I", zlib.crc32(payload)))
-            self._file.flush()
-            if self._fsync:
-                os.fsync(self._file.fileno())
+            start = self._file.tell()
+            try:
+                self._file.write(payload)
+                self._file.write(struct.pack("<I", zlib.crc32(payload)))
+                self._file.flush()
+                if self._fsync:
+                    os.fsync(self._file.fileno())
+            except BaseException:
+                # Drop the torn frame (best effort) so a continuing or
+                # resumed writer appends at exactly the valid end replay
+                # stops at; if even the truncate fails, replay's CRC walk
+                # drops the frame at read time instead.
+                try:
+                    self._file.truncate(start)
+                    self._file.seek(start)
+                except (OSError, ValueError):
+                    pass
+                raise
         registry = metrics_registry()
         registry.counter("journal.epochs").inc()
         registry.counter("journal.bytes").inc(len(payload) + 4)
         registry.counter("journal.dirty_rows").inc(dirty)
+        if self.epoch_index > 0:
+            # Rows carried by DELTA epochs (every epoch after the full-
+            # snapshot first): the cost-scales-with-touched-rows claim,
+            # as a counter. Counted after the write+fsync landed.
+            registry.counter("journal.delta_rows").inc(dirty)
         self.epoch_index += 1
         self.rows_covered = used_after
 

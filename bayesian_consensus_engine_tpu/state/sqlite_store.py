@@ -17,6 +17,7 @@ compatible with the reference.
 
 from __future__ import annotations
 
+import os
 import sqlite3
 from pathlib import Path
 from typing import Iterable, List, Optional, Protocol, Union, runtime_checkable
@@ -67,6 +68,42 @@ INSERT OR REPLACE INTO sources
     (source_id, market_id, reliability, confidence, updated_at)
 VALUES (?, ?, ?, ?, ?)
 """
+
+
+def interchange_fingerprint(db_path: Union[str, Path]):
+    """Cheap content identity of an interchange file, or ``None``.
+
+    The incremental-flush guard (``TensorReliabilityStore._plan_flush``):
+    an O(1) probe — file size, nanosecond mtime, and the 100-byte SQLite
+    header (which carries the file change counter, schema cookie, and
+    WAL checkpoint sequence) — captured right after each export and
+    compared right before the next. A mismatch means someone else wrote
+    (or rotated) the file since our export, so upserting only the dirty
+    delta would silently produce a checkpoint that is neither our state
+    nor theirs; the flush falls back to a full write instead. A false
+    MISMATCH merely costs one full rewrite; a false match would need an
+    external writer that preserves size, mtime_ns, and every header byte
+    — not something SQLite does. The ``-wal`` sidecar's (size, mtime_ns)
+    rides along: a foreign writer whose commit still sits un-checkpointed
+    in the WAL leaves the main file untouched, and the sidecar is the
+    only place that write is visible (our own exports close their last
+    connection, which checkpoints and DELETES the sidecar — after a
+    clean export the component is None). ``None`` (unreadable/absent
+    file) never matches anything.
+    """
+    path = str(db_path)
+    try:
+        stat = os.stat(path)
+        with open(path, "rb") as fh:
+            header = fh.read(100)
+    except OSError:
+        return None
+    try:
+        wal = os.stat(path + "-wal")
+        wal_mark = (wal.st_size, wal.st_mtime_ns)
+    except OSError:
+        wal_mark = None
+    return (stat.st_size, stat.st_mtime_ns, header, wal_mark)
 
 
 @runtime_checkable

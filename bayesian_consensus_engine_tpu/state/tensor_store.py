@@ -34,6 +34,9 @@ from pathlib import Path
 
 import numpy as np
 
+from bayesian_consensus_engine_tpu.obs.metrics import (
+    metrics_registry as _metrics_registry,
+)
 from bayesian_consensus_engine_tpu.obs.timeline import (
     active_timeline as _active_timeline,
 )
@@ -70,6 +73,27 @@ _MAX_DEFERRED_BYTES = int(
 )
 
 
+def _device_take(array, rows: np.ndarray) -> np.ndarray:
+    """Device-side gather of *rows*, robust to the ambient x64 flag.
+
+    A deferred f64 settled state may be synced AFTER the scope that
+    enabled x64 exited (the deferral is the point); tracing the gather
+    under the now-x32 config then lowers an f64 operand into an f32
+    program and fails. Re-enter x64 for the one gather when the operand
+    is 64-bit wide and the flag is currently off.
+    """
+    import jax
+
+    wide = array.dtype.itemsize == 8 and array.dtype.kind != "b"
+    if wide and not jax.config.jax_enable_x64:
+        enable = getattr(jax, "enable_x64", None)
+        if enable is None:  # older JAX spells it experimental
+            from jax.experimental import enable_x64 as enable
+        with enable():
+            return np.asarray(array[rows])
+    return np.asarray(array[rows])
+
+
 def _locked(method):
     """Serialise a host-tier method on the store's reentrant lock.
 
@@ -103,15 +127,20 @@ class FlushHandle:
     """
 
     __slots__ = ("_store", "_thread", "_writer", "_rows", "_exc",
-                 "_restore", "_finished")
+                 "_restore", "_finished", "_fingerprint")
 
     def __init__(self, store, writer, restore) -> None:
         self._store = store
         self._writer = writer
-        self._restore = restore  # (selected, dead, prev_path) | None
+        self._restore = restore  # (selected, dead, prev_path, prev_fp) | None
         self._rows: Optional[int] = None
         self._exc: Optional[BaseException] = None
         self._finished = False
+        # Captured by the writer thread AFTER its transaction commits: the
+        # target's post-write content identity, recorded on the store at
+        # join so the next auto-incremental flush can verify nothing else
+        # touched the file in between (see _plan_flush).
+        self._fingerprint = None
         self._thread = threading.Thread(
             target=self._run, name="bce-flush", daemon=True
         )
@@ -123,7 +152,7 @@ class FlushHandle:
         # that already holds it (result() is called under the store lock by
         # the flush entry points).
         try:
-            self._rows = self._writer()
+            self._rows, self._fingerprint = self._writer()
         except BaseException as exc:  # noqa: BLE001 — re-raised in result()
             self._exc = exc
 
@@ -145,15 +174,81 @@ class FlushHandle:
                 if store._flush_inflight is self:
                     store._flush_inflight = None
                 if self._restore is not None:
-                    selected, dead, prev_path = self._restore
+                    selected, dead, prev_path, prev_fp = self._restore
                     store._dirty[selected] = True
                     if dead:
                         store._dirty[dead] = True
                     store._last_flush_path = prev_path
+                    store._last_flush_fp = prev_fp
             raise self._exc
         with self._store._host_lock:
             if self._store._flush_inflight is self:
                 self._store._flush_inflight = None
+            if self._restore is not None:
+                # Restorable target ⇒ this flush claimed it: record its
+                # post-write identity for the next incremental check.
+                self._store._last_flush_fp = self._fingerprint
+        return self._rows
+
+
+class JournalFlushHandle:
+    """An in-flight background journal epoch (``flush_to_journal_async``).
+
+    The durability twin of :class:`FlushHandle` for the journal tier: the
+    epoch's CONTENT was snapshotted synchronously under the store lock
+    (the drained truth as of the ``flush_to_journal_async`` call); only
+    the framing, CRC, append, and fsync run on the writer thread.
+    ``result()`` joins and returns the epoch's dirty-row count; a failed
+    write re-raises here with the snapshot's rows re-marked
+    journal-dirty (the next epoch re-covers them) and the journal file
+    truncated back to its pre-append length (best effort — the writer
+    never advanced its epoch index, so a resumed/continuing writer
+    appends at the same valid end replay stops at). The store joins any
+    in-flight epoch before starting another, so epochs never interleave.
+    """
+
+    __slots__ = ("_store", "_thread", "_writer", "_rows", "_exc",
+                 "_restore_idx", "_finished")
+
+    def __init__(self, store, writer, restore_idx) -> None:
+        self._store = store
+        self._writer = writer
+        self._restore_idx = restore_idx  # rows to re-mark journal-dirty
+        self._rows: Optional[int] = None
+        self._exc: Optional[BaseException] = None
+        self._finished = False
+        self._thread = threading.Thread(
+            target=self._run, name="bce-journal-flush", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        # Same lock discipline as FlushHandle._run: snapshot data only.
+        try:
+            self._rows = self._writer()
+        except BaseException as exc:  # noqa: BLE001 — re-raised in result()
+            self._exc = exc
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def result(self, timeout: Optional[float] = None) -> int:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("background journal epoch still running")
+        if self._finished:
+            if self._exc is not None:
+                raise self._exc
+            return self._rows
+        self._finished = True
+        store = self._store
+        with store._host_lock:
+            if store._journal_inflight is self:
+                store._journal_inflight = None
+            if self._exc is not None:
+                store._journal_dirty[self._restore_idx] = True
+        if self._exc is not None:
+            raise self._exc
         return self._rows
 
 
@@ -205,6 +300,13 @@ class TensorReliabilityStore:
         # (reference semantics: UPSERT only what changed, reliability.py:221-231).
         self._dirty = np.zeros(capacity, dtype=bool)
         self._last_flush_path: Optional[str] = None
+        # Content identity of the last flush target as this store left it
+        # (state/sqlite_store.interchange_fingerprint): an incremental
+        # flush additionally requires the file to still MATCH it — a
+        # target rewritten/rotated by anyone else since our export falls
+        # back to a full write instead of silently upserting a delta onto
+        # foreign content.
+        self._last_flush_fp = None
         # Separate dirty tracking for the durability journal
         # (state/journal.py): journal epochs and SQLite flushes are
         # independent tiers — a journal epoch must not steal rows from
@@ -216,6 +318,7 @@ class TensorReliabilityStore:
         # safely. Device compute never waits on it.
         self._host_lock = threading.RLock()
         self._flush_inflight: Optional[FlushHandle] = None
+        self._journal_inflight: Optional[JournalFlushHandle] = None
 
     # -- row management ------------------------------------------------------
 
@@ -271,12 +374,20 @@ class TensorReliabilityStore:
         come from the device. Idempotent and cheap when nothing is pending.
 
         When the pending state carries settle sync recipes (see
-        :meth:`defer_absorb`), the merge fetches ONLY the touched
-        reliabilities from device — stamps and existence are closed-form
-        on the host (every settled slot carries the final cycle's stamp;
-        existence is monotone) — instead of pulling three full columns
-        through the device→host path, whose bandwidth dominates the merge
-        at million-row scale.
+        :meth:`defer_absorb`), the merge is DELTA-SHAPED: the flat pending
+        state subsumes every recipe in the chain (chained settles carry
+        state forward), so ONE device-side take of the UNION of touched
+        rows — rel, relative stamp, and existence at exactly those rows —
+        replaces both the full three-column pull and the per-recipe
+        fetches, and the gathered rows route through the same
+        :meth:`_merge_device_rows` as a full sync: the host arrays after a
+        delta sync are byte-identical to a full sync by construction
+        (pinned by tests/test_tensor_store.py::TestDeltaSync). Sync cost
+        therefore scales with rows *touched since the last sync*, not
+        store size. Session recipes without a flat pending state (the
+        sharded path, and the orphaned-predecessor case) still apply
+        per-recipe — their values live plan-shaped on device, so there is
+        no flat state to take from.
         """
         if self._pending is None and self._pending_sync is None:
             return
@@ -294,19 +405,55 @@ class TensorReliabilityStore:
             # predecessor settle's results are still recoverable here.
             pend = self._pending
             self._pending = None
-            with timeline.span("fetch"):
-                for (touched, rel_touched_dev, recipe_epoch0,
-                     stamp_rel) in recipes:
-                    self._apply_settle_recipe(
-                        touched, np.asarray(rel_touched_dev), recipe_epoch0,
-                        stamp_rel,
+            if pend is not None:
+                # Delta sync: one small transfer for the union of rows the
+                # recipe chain touched; everything else on host is already
+                # exact. The recipes' own pre-gathered arrays are dropped
+                # unused — the pending state post-dates every one of them.
+                state, epoch0 = pend
+                union = np.unique(np.concatenate(
+                    [np.asarray(t, dtype=np.int64) for t, _r, _e, _s
+                     in recipes]
+                    + [np.empty(0, dtype=np.int64)]
+                ))
+                if union.size and int(union[-1]) >= int(
+                    state.reliability.shape[0]
+                ):
+                    # Impossible for an honest settle (recipes touch rows
+                    # the state covered when it was exported); guard it
+                    # because a JAX gather would CLAMP out-of-bounds rows
+                    # silently instead of failing.
+                    raise ValueError(
+                        "sync recipe touches rows beyond the pending state"
                     )
+                if union.size:
+                    with timeline.span("fetch"):
+                        rel_u = _device_take(state.reliability, union)
+                        days_u = _device_take(state.updated_days, union)
+                        exists_u = _device_take(
+                            state.exists, union
+                        ).astype(bool)
+                    self._merge_device_rows(
+                        union, rel_u, None, days_u, exists_u, epoch0
+                    )
+                    _metrics_registry().counter("store.delta_sync_rows").inc(
+                        int(union.size)
+                    )
+            else:
+                with timeline.span("fetch"):
+                    for (touched, rel_touched_dev, recipe_epoch0,
+                         stamp_rel) in recipes:
+                        self._apply_settle_recipe(
+                            touched, np.asarray(rel_touched_dev),
+                            recipe_epoch0, stamp_rel,
+                        )
             # The flat device state is still EXACTLY the host's truth for
-            # rel/days/exists (the recipes just made the host match it), so
-            # keep it as the cache: a settle after a flush/read chains with
-            # zero re-upload. Only its confidences carry the documented ulp
-            # drift — flagged, and refreshed from host for device_state
-            # consumers (the settle chain tolerates the drift by contract).
+            # rel/days/exists (the delta merge just made the host match
+            # it), so keep it as the cache: a settle after a flush/read
+            # chains with zero re-upload. Only its confidences carry the
+            # documented ulp drift — flagged, and refreshed from host for
+            # device_state consumers (the settle chain tolerates the drift
+            # by contract).
             if pend is not None:
                 self._device_cache = pend
                 self._cache_conf_drifted = True
@@ -522,11 +669,15 @@ class TensorReliabilityStore:
 
     @_locked
     def close(self) -> None:
-        """Join any in-flight background checkpoint (the writer thread is
-        a daemon — dropped at interpreter exit, which would silently lose
-        the checkpoint; its transaction rolls back, but the caller asked
-        for durability). A prior write failure re-raises here with the
-        flush bookkeeping rolled back, like any flush entry point."""
+        """Join any in-flight background checkpoint (the writer threads
+        are daemons — dropped at interpreter exit, which would silently
+        lose the checkpoint; a SQLite transaction rolls back and a torn
+        journal epoch is dropped at replay, but the caller asked for
+        durability). A prior write failure re-raises here with the flush
+        bookkeeping rolled back, like any flush entry point. The journal
+        tier joins first — its epoch is the rolling durability floor."""
+        if self._journal_inflight is not None:
+            self._journal_inflight.result()
         if self._flush_inflight is not None:
             self._flush_inflight.result()
 
@@ -1153,11 +1304,19 @@ class TensorReliabilityStore:
             for record in sqlite_store.list_sources():
                 store.put_record(record)
         # The freshly-loaded state IS the file's state: flushing back to the
-        # same path starts from a clean slate and stays incremental.
+        # same path starts from a clean slate and stays incremental — as
+        # long as the file still carries the content we loaded
+        # (interchange_fingerprint; captured after the reader closed so
+        # the probe sees the settled post-WAL state).
         used = len(store._pairs)
         store._dirty[:used] = False
         if str(db_path) != ":memory:":
+            from bayesian_consensus_engine_tpu.state.sqlite_store import (
+                interchange_fingerprint,
+            )
+
             store._last_flush_path = str(Path(db_path).resolve())
+            store._last_flush_fp = interchange_fingerprint(db_path)
         return store
 
     @_locked
@@ -1191,6 +1350,7 @@ class TensorReliabilityStore:
         """
         from bayesian_consensus_engine_tpu.state.sqlite_store import (
             SQLiteReliabilityStore,
+            interchange_fingerprint,
         )
 
         target, incremental, selected, dead, used, _deferred = self._plan_flush(
@@ -1201,9 +1361,14 @@ class TensorReliabilityStore:
             with SQLiteReliabilityStore(db_path) as sqlite_store:
                 id_of = self._pairs.id_of
                 sqlite_store.delete_rows(id_of(r) for r in dead)
+        if incremental:
+            _metrics_registry().counter("interchange.delta_rows").inc(
+                int(selected.size)
+            )
         if target is not None:
             self._dirty[:used] = False
             self._last_flush_path = target
+            self._last_flush_fp = interchange_fingerprint(target)
         return written
 
     def _plan_flush(self, db_path, incremental: Optional[bool],
@@ -1253,19 +1418,31 @@ class TensorReliabilityStore:
         target = None if in_memory else str(Path(db_path).resolve())
         # Path identity alone is not enough: a deleted/rotated target would
         # make an incremental write silently truncate the checkpoint to the
-        # dirty delta — the file must still exist to receive a delta.
+        # dirty delta — the file must still exist AND still carry the
+        # content our last export left there (interchange_fingerprint): a
+        # file rewritten by anyone else since then receives a full write,
+        # never a delta upserted onto foreign rows.
+        from bayesian_consensus_engine_tpu.state.sqlite_store import (
+            interchange_fingerprint,
+        )
+
         same_target = (
             target is not None
             and self._last_flush_path == target
             and Path(target).exists()
+            and (
+                self._last_flush_fp is None
+                or interchange_fingerprint(target) == self._last_flush_fp
+            )
         )
         if incremental is None:
             incremental = same_target
         elif incremental and not same_target:
             raise ValueError(
                 f"incremental flush to {db_path} but the last full flush "
-                f"went to {self._last_flush_path!r} — an incremental write "
-                "would be an incomplete checkpoint"
+                f"went to {self._last_flush_path!r} (or the file's content "
+                "fingerprint no longer matches that export) — an "
+                "incremental write would be an incomplete checkpoint"
             )
 
         used = len(self._pairs)
@@ -1324,7 +1501,22 @@ class TensorReliabilityStore:
         dead_ids = [self._pairs.id_of(r) for r in dead]
         writer = self._build_snapshot_writer(db_path, selected, incremental,
                                              used, dead_ids)
+        if incremental:
+            # Counted AFTER the background write lands (mirrors the
+            # journal tier): a failed write must not claim its rows, and
+            # the retry would otherwise double-count them.
+            inner_writer = writer
+            delta_count = int(selected.size)
+
+            def writer():
+                out = inner_writer()
+                _metrics_registry().counter("interchange.delta_rows").inc(
+                    delta_count
+                )
+                return out
+
         prev_path = self._last_flush_path
+        prev_fp = self._last_flush_fp
         if target is not None:
             self._dirty[:used] = False
             if deferred.size:
@@ -1333,7 +1525,7 @@ class TensorReliabilityStore:
                 # flush covers them whole.
                 self._dirty[deferred] = True
             self._last_flush_path = target
-            restore = (selected, dead, prev_path)
+            restore = (selected, dead, prev_path, prev_fp)
         else:
             restore = None
         handle = FlushHandle(self, writer, restore)
@@ -1371,6 +1563,7 @@ class TensorReliabilityStore:
         """
         from bayesian_consensus_engine_tpu.state.sqlite_store import (
             SQLiteReliabilityStore,
+            interchange_fingerprint,
         )
 
         def delete_dead(path):
@@ -1394,7 +1587,7 @@ class TensorReliabilityStore:
             def writer():
                 written = flush_snapshot(path, blob)
                 delete_dead(path)
-                return written
+                return written, interchange_fingerprint(path)
 
             return writer
 
@@ -1416,7 +1609,9 @@ class TensorReliabilityStore:
             with SQLiteReliabilityStore(db_path) as sqlite_store:
                 sqlite_store.put_rows(params)
             delete_dead(db_path)
-            return len(rows)
+            if str(db_path) == ":memory:":
+                return len(rows), None
+            return len(rows), interchange_fingerprint(db_path)
 
         return writer
 
@@ -1484,23 +1679,11 @@ class TensorReliabilityStore:
     # cheaper than SQLite's per-row execute. Exact f64 host values
     # round-trip bit-identically.
 
-    @_locked
-    def flush_to_journal(self, journal, tag: int = 0) -> int:
-        """Append one durability epoch to *journal* (state/journal.py).
-
-        Resolves pending device results first (same drain semantics as an
-        eager SQLite flush — the epoch's content is the store's truth as
-        of this call), then appends only the rows dirtied since the LAST
-        journal epoch plus any newly interned pairs. Journal dirtiness is
-        tracked separately from SQLite dirtiness: an epoch here never
-        shrinks the next :meth:`flush_to_sqlite` and vice versa. The
-        first epoch on a journal is a full snapshot, so replay is
-        self-contained even when the journal is attached to a non-empty
-        store. Returns the number of rows written. *tag* is the replay
-        watermark (:func:`~.state.journal.replay_journal` returns the
-        last complete epoch's tag — settle_stream passes the settled
-        batch index).
-        """
+    def _journal_epoch_snapshot(self, journal):
+        """Select + copy one journal epoch's content (caller holds the
+        lock): ``(used, idx, append_args)``. The copies make the snapshot
+        independent of later store mutation — what lets the async path
+        hand it to a writer thread. Dirty flags are NOT cleared here."""
         self._sync_pending()
         self._resync_sidecars()
         used = len(self._pairs)
@@ -1523,19 +1706,85 @@ class TensorReliabilityStore:
                 self._pairs.id_of(r) for r in range(journal.rows_covered, used)
             ]
         iso = self._iso
-        journal.append_epoch(
+        args = (
             used,
             new_pairs,
             idx,
-            self._rel[idx],
+            self._rel[idx],  # fancy indexing: already a copy
             self._conf[idx],
             self._days[idx],
             self._exists[idx],
             [iso[i] for i in idx.tolist()],
-            tag=tag,
         )
+        return used, idx, args
+
+    @_locked
+    def _join_journal_inflight(self) -> None:
+        """Join any in-flight background epoch (epochs serialise; a prior
+        background failure surfaces HERE, never silently). The wait is the
+        ``journal_async_wait`` phase — near zero when the write overlapped
+        the batches since the last cadence."""
+        if self._journal_inflight is not None:
+            with _active_timeline().span("journal_async_wait"):
+                self._journal_inflight.result()
+
+    @_locked
+    def flush_to_journal(self, journal, tag: int = 0) -> int:
+        """Append one durability epoch to *journal* (state/journal.py).
+
+        Joins any in-flight background epoch first (epochs serialise),
+        then resolves pending device results (same drain semantics as an
+        eager SQLite flush — the epoch's content is the store's truth as
+        of this call; with a recipe-bounded dirty set the drain is the
+        DELTA sync, one touched-rows transfer) and appends only the rows
+        dirtied since the LAST journal epoch plus any newly interned
+        pairs. Journal dirtiness is tracked separately from SQLite
+        dirtiness: an epoch here never shrinks the next
+        :meth:`flush_to_sqlite` and vice versa. The first epoch on a
+        journal is a full snapshot, so replay is self-contained even when
+        the journal is attached to a non-empty store. Returns the number
+        of rows written. *tag* is the replay watermark
+        (:func:`~.state.journal.replay_journal` returns the last complete
+        epoch's tag — settle_stream passes the settled batch index).
+        """
+        self._join_journal_inflight()
+        used, idx, args = self._journal_epoch_snapshot(journal)
+        journal.append_epoch(*args, tag=tag)
         self._journal_dirty[:used] = False
         return int(idx.size)
+
+    @_locked
+    def flush_to_journal_async(self, journal, tag: int = 0
+                               ) -> JournalFlushHandle:
+        """Append an epoch like :meth:`flush_to_journal`, with the frame/
+        CRC/write/fsync on a background thread so the epoch's durability
+        wait overlaps the caller's next batch instead of blocking it.
+
+        The epoch's CONTENT is pinned synchronously: any in-flight epoch
+        is joined (epochs serialise, and a background failure surfaces at
+        that join), pending device results drain (the delta sync), and
+        the dirty rows/new pairs are snapshotted under the lock before
+        this returns — mutating the store afterwards cannot leak into the
+        epoch. Returns a :class:`JournalFlushHandle`; ``result()`` joins
+        and returns the row count (a failed write re-marks the snapshot
+        rows journal-dirty and truncates the torn frame — see the handle).
+        The durability contract this enables in
+        :func:`~.pipeline.settle_stream`: *yield of batch N implies the
+        previous cadence's epoch is fsynced and this one is in flight* —
+        the ``sync_checkpoints=True`` escape hatch restores the strict
+        "yield implies fsynced".
+        """
+        self._join_journal_inflight()
+        used, idx, args = self._journal_epoch_snapshot(journal)
+        self._journal_dirty[:used] = False
+
+        def writer():
+            journal.append_epoch(*args, tag=tag)
+            return int(idx.size)
+
+        handle = JournalFlushHandle(self, writer, idx)
+        self._journal_inflight = handle
+        return handle
 
     def _apply_journal_epoch(
         self, used_after, pairs, idx, rel, conf, days, exists, iso_values

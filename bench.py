@@ -1063,6 +1063,170 @@ def bench_e2e_stream_stable_topology(markets=NUM_MARKETS, batches=6,
     }
 
 
+def bench_e2e_stream_delta(markets=NUM_MARKETS, batches=6, mean_slots=4,
+                           steps=20, checkpoint_every=2,
+                           resettle_fraction=0.1):
+    """Sync-full vs async-delta DURABILITY on the stable-topology stream
+    — the round-6 tentpole's A/B (VERDICT r5 gap (a): ``checkpoint_s``
+    7.5-9.5 s and ``interchange_export_s`` 15-18 s of a 16.8 s wall).
+
+    Both variants stream the same workload with journal-mode durability:
+    a persistent (source, market) universe re-settled per batch
+    (``reuse_plans=True``, the steady-state service shape), then a
+    second act re-settling only ``resettle_fraction`` of the markets
+    (the daily partial re-settlement that makes interchange exports
+    delta-shaped). ``sync_full`` writes+fsyncs each epoch in-loop
+    (``sync_checkpoints=True`` — the pre-round-6 semantics);
+    ``async_delta`` snapshots in-loop and backgrounds the write
+    (``sync_checkpoints=False``, the new default), so its serial
+    ``checkpoint_s`` is the snapshot+delta-drain alone and the fsync
+    shows up (if at all) as the ``journal_async_wait`` join phase.
+
+    Interchange: each variant exports the SQLite file after act 1 (a
+    FULL baseline write) and re-exports to the SAME path after act 2 —
+    O(rows dirtied since), the incremental fast path with the
+    content-fingerprint fallback. ``interchange_delta_rows`` ≪
+    ``store_rows`` is the O(dirty) proof; ``checkpoint_serial_speedup``
+    (sync/async in-loop checkpoint seconds) is the headline of the A/B
+    — strictly > 1 when the background write actually overlapped.
+    """
+    import gc
+    import tempfile as _tf
+
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.obs.timeline import (
+        PhaseTimeline,
+        recording,
+    )
+    from bayesian_consensus_engine_tpu.pipeline import settle_stream
+    from bayesian_consensus_engine_tpu.state.journal import JournalWriter
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    per_batch = markets // batches
+    rng = np.random.default_rng(29)
+    # ONE persistent topology (act 1: the full universe)...
+    counts = rng.poisson(mean_slots - 1, per_batch) + 1
+    total = int(counts.sum())
+    keys = [f"m-{m}" for m in range(per_batch)]
+    sids = [f"src-{v}" for v in rng.integers(0, SOURCE_UNIVERSE, total)]
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    half = max(1, batches // 2)
+    act1 = [
+        (
+            (keys, sids, rng.random(total), offsets),
+            (rng.random(per_batch) < 0.5).tolist(),
+        )
+        for _ in range(half)
+    ]
+    # ...and a partial-universe act 2 (a prefix slice of the same
+    # topology): only these rows dirty between the two exports.
+    sub_markets = max(1, int(per_batch * resettle_fraction))
+    sub_total = int(offsets[sub_markets])
+    sub_keys = keys[:sub_markets]
+    sub_sids = sids[:sub_total]
+    sub_offsets = offsets[: sub_markets + 1]
+    act2 = [
+        (
+            (sub_keys, sub_sids, rng.random(sub_total), sub_offsets),
+            (rng.random(sub_markets) < 0.5).tolist(),
+        )
+        for _ in range(batches - half)
+    ]
+    gc.freeze()
+    try:
+        market_cycles = (
+            per_batch * half + sub_markets * (batches - half)
+        ) * steps
+
+        def run(sync_full):
+            stats: list = []
+            store = TensorReliabilityStore()
+            timeline = PhaseTimeline()
+            with _tf.TemporaryDirectory() as tmp:
+                db = os.path.join(tmp, "delta.db")
+                journal = JournalWriter(os.path.join(tmp, "delta.jrnl"))
+                start = time.perf_counter()
+                with recording(timeline):
+                    for _result in settle_stream(
+                        store, act1, steps=steps, now=21_900.0,
+                        journal=journal, checkpoint_every=checkpoint_every,
+                        columnar=True, stats=stats, reuse_plans=True,
+                        sync_checkpoints=sync_full,
+                    ):
+                        pass
+                    t0 = time.perf_counter()
+                    full_rows = store.flush_to_sqlite(db)
+                    full_s = time.perf_counter() - t0
+                    for _result in settle_stream(
+                        store, act2, steps=steps, now=21_900.0 + half,
+                        journal=journal, checkpoint_every=checkpoint_every,
+                        columnar=True, stats=stats, reuse_plans=True,
+                        sync_checkpoints=sync_full,
+                    ):
+                        pass
+                    store.sync()
+                    t0 = time.perf_counter()
+                    delta_rows = store.flush_to_sqlite(db)
+                    delta_s = time.perf_counter() - t0
+                wall = time.perf_counter() - start
+                journal.close()
+
+            checkpoint_s = sum(
+                s["checkpoint_s"] for s in stats
+                if s["checkpoint_s"] is not None
+            )
+            phases = {
+                k: round(v, 6) for k, v in timeline.totals().items()
+            }
+            return len(store), checkpoint_s, {
+                "wall_s": round(wall, 2),
+                "amortised_1m_cycles_per_sec": round(
+                    market_cycles / wall / 1e6, 4
+                ),
+                "checkpoint_s": round(checkpoint_s, 4),
+                "journal_fsync_s": phases.get("journal_fsync", 0.0),
+                "journal_async_wait_s": phases.get(
+                    "journal_async_wait", 0.0
+                ),
+                "interchange_full_s": round(full_s, 3),
+                "interchange_full_rows": full_rows,
+                "interchange_delta_s": round(delta_s, 3),
+                "interchange_delta_rows": delta_rows,
+                "phases": phases,
+            }
+
+        # Warm both acts' compiled shapes so neither timed variant pays
+        # compilation (whichever ran first would otherwise eat act 2's
+        # sub-topology compile and skew the checkpoint A/B).
+        warm_store = TensorReliabilityStore()
+        for _result in settle_stream(
+            warm_store, act1[:1] + act2[:1], steps=steps, now=21_900.0,
+            columnar=True,
+        ):
+            pass
+        warm_store.sync()
+        rows, sync_cp, sync_full = run(sync_full=True)
+        _, async_cp, async_delta = run(sync_full=False)
+    finally:
+        gc.unfreeze()
+    return {
+        "workload": (
+            f"{half} batches x {per_batch} markets + {batches - half} "
+            f"batches x {sub_markets} markets, {steps} cycles, STABLE "
+            f"topology, journal epoch every {checkpoint_every}"
+        ),
+        "store_rows": rows,
+        "sync_full": sync_full,
+        "async_delta": async_delta,
+        "checkpoint_serial_speedup": (
+            round(sync_cp / async_cp, 3) if async_cp > 0 else None
+        ),
+    }
+
+
 def bench_obs_overhead(markets=60_000, batches=3, mean_slots=4, steps=10,
                        trials=3):
     """The obs contract's A/B: the streamed service with observability
@@ -1684,6 +1848,10 @@ LEGS = {
         bench_e2e_stream_stable_topology, {},
         dict(markets=3000, batches=3, steps=2), 2000,
     ),
+    "e2e_stream_delta": (
+        bench_e2e_stream_delta, {},
+        dict(markets=3000, batches=4, steps=2), 2000,
+    ),
     "obs_overhead": (
         bench_obs_overhead, {},
         dict(markets=2000, batches=2, steps=2, trials=6), 900,
@@ -1732,6 +1900,7 @@ DEVICE_LEG_ORDER = [
     "e2e_overlap",
     "e2e_stream",
     "e2e_stream_stable_topology",
+    "e2e_stream_delta",
     "obs_overhead",
     "tiebreak_10k_agents",
     "pallas_ab",
@@ -2031,6 +2200,7 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         "e2e_stream_stable_topology": _show(
             results, "e2e_stream_stable_topology"
         ),
+        "e2e_stream_delta": _show(results, "e2e_stream_delta"),
         "obs_overhead": _show(results, "obs_overhead"),
         # Fallback-only leg: absent (not "failed") on healthy runs.
         **(

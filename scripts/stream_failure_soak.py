@@ -17,9 +17,13 @@ Run from the repo root:
 
     python scripts/stream_failure_soak.py [--markets 60000] [--batches 10]
                                           [--fail-at 5] [--mesh] [--steps 1]
+                                          [--ledger soak.jsonl]
 
 CPU by default (the contract is host-side); ``--tpu`` leaves the default
-backend alone. Exit code 0 iff every assertion holds.
+backend alone. ``--ledger`` routes the captures through the obs run
+ledger (loadavg/min-of-N attribution, same as bench legs — render with
+``bce-tpu stats``; ROADMAP obs follow-up). Exit code 0 iff every
+assertion holds.
 """
 
 from __future__ import annotations
@@ -48,21 +52,47 @@ def main() -> int:
                         help="stream sharded over an 8-device CPU mesh")
     parser.add_argument("--tpu", action="store_true",
                         help="keep the default backend (else force CPU)")
+    parser.add_argument("--ledger",
+                        help="append obs run-ledger records here "
+                             "(render: bce-tpu stats)")
     args = parser.parse_args()
+
+    if not args.tpu:
+        # Old JAX has no jax_num_cpu_devices option; the XLA flag only
+        # works if set before jax's first import in this process.
+        flag = "--xla_force_host_platform_device_count=8"
+        if flag not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + flag
+            ).strip()
 
     import jax
 
     if not args.tpu:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except AttributeError:
+            pass  # old JAX: the XLA_FLAGS fallback above covers it
 
     import numpy as np
 
+    from bayesian_consensus_engine_tpu.obs.ledger import RunLedger
     from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
     from bayesian_consensus_engine_tpu.pipeline import settle_stream
     from bayesian_consensus_engine_tpu.state.tensor_store import (
         TensorReliabilityStore,
     )
+
+    ledger = (
+        RunLedger(args.ledger, backend=None if args.tpu else "cpu")
+        if args.ledger else None
+    )
+
+    def record(leg, value=None, unit=None, extras=None):
+        if ledger is not None:
+            ledger.record(f"soak.stream_failure.{leg}", value=value,
+                          unit=unit, extras=extras)
 
     rng = np.random.default_rng(11)
     lock_holder: dict = {}
@@ -124,6 +154,10 @@ def main() -> int:
     assert "locked" in str(failure), failure
     print(f"failure surfaced after {settled} settled batches in "
           f"{elapsed:.1f}s: {type(failure).__name__}: {failure}")
+    record("stream_to_failure_s", value=round(elapsed, 3), unit="s",
+           extras={"settled_batches": settled,
+                   "failure": f"{type(failure).__name__}: {failure}",
+                   "mesh": bool(args.mesh)})
 
     used = len(store)
     dirty = int(store._dirty[:used].sum())
@@ -135,7 +169,10 @@ def main() -> int:
     store.sync()
     t0 = time.perf_counter()
     store.flush_to_sqlite(db)
-    print(f"retry flush re-covered in {time.perf_counter() - t0:.1f}s")
+    retry_s = time.perf_counter() - t0
+    print(f"retry flush re-covered in {retry_s:.1f}s")
+    record("retry_flush_s", value=round(retry_s, 3), unit="s",
+           extras={"rows_dirty_at_retry": dirty, "store_rows": used})
 
     live = store.list_sources()
     with sqlite3.connect(db) as conn:
@@ -152,6 +189,10 @@ def main() -> int:
     )
     print(f"checkpoint complete: {len(rows):,} rows byte-equal to the "
           f"store's live records; no settled batch lost")
+    record("checkpoint_rows", value=float(len(rows)), unit="rows",
+           extras={"byte_equal": True})
+    if ledger is not None:
+        ledger.close()
     return 0
 
 

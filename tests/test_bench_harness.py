@@ -461,6 +461,50 @@ class TestStableTopologyLeg:
         assert "e2e_stream_stable_topology" in bench.DEVICE_LEG_ORDER
 
 
+class TestDeltaDurabilityLeg:
+    """The round-6 durability A/B leg (``e2e_stream_delta``) at --fast
+    shapes: sync-full vs async-delta journal epochs on the stable-
+    topology workload, plus the full-then-delta interchange export pair.
+    Byte-parity of the two durability modes is pinned by
+    tests/test_journal.py::TestAsyncEpochs; this pins the LEG's contract
+    (JSON shape, the serial checkpoint win, the O(dirty) export, and the
+    journal-wait attribution being visible)."""
+
+    def test_fast_leg_reports_durability_ab(self):
+        result = bench.run_leg_inprocess("e2e_stream_delta", fast=True)
+        for side in ("sync_full", "async_delta"):
+            for key in (
+                "wall_s", "amortised_1m_cycles_per_sec", "checkpoint_s",
+                "journal_fsync_s", "journal_async_wait_s",
+                "interchange_full_s", "interchange_full_rows",
+                "interchange_delta_s", "interchange_delta_rows", "phases",
+            ):
+                assert key in result[side], (side, key)
+        sync_full, async_delta = result["sync_full"], result["async_delta"]
+        # The headline: async-delta's serial in-loop checkpoint cost is
+        # strictly below sync-full's (the fsync left the loop).
+        assert async_delta["checkpoint_s"] < sync_full["checkpoint_s"]
+        assert result["checkpoint_serial_speedup"] > 1
+        # Sync mode fsyncs in-loop (the phase is visible); async mode's
+        # in-loop share is the join wait instead.
+        assert sync_full["journal_fsync_s"] > 0
+        assert sync_full["journal_async_wait_s"] == 0
+        assert "journal_async_wait" in async_delta["phases"]
+        assert async_delta["journal_fsync_s"] == 0
+        # Interchange: the re-export to the baseline file is O(dirty).
+        for side in (sync_full, async_delta):
+            assert side["interchange_full_rows"] == result["store_rows"]
+            assert (
+                0 < side["interchange_delta_rows"]
+                < side["interchange_full_rows"]
+            )
+        json.dumps(result)
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_stream_delta" in bench.LEGS
+        assert "e2e_stream_delta" in bench.DEVICE_LEG_ORDER
+
+
 class TestOverlapAdjudication:
     """The re-adjudicated e2e_overlap leg (VERDICT r5 #2): min-of-N
     alternating repeats, per-repeat load, a band, and a documented

@@ -632,3 +632,198 @@ class TestCompaction:
         got_state, got_tag = replay_journal(path)
         assert got_tag == want_tag == 1
         assert store_fingerprint(got_state) == store_fingerprint(want_state)
+
+
+class TestAsyncEpochs:
+    """flush_to_journal_async: snapshot-now/write-in-background epochs.
+
+    The round-6 contracts: a stream's async journal equals the sync
+    journal byte-for-byte on any clean exit; a crash (or failure) mid
+    background write recovers to the last JOINED epoch, never a torn
+    one; a background failure surfaces at the next join with the dirty
+    rows restored so a later epoch re-covers them.
+    """
+
+    @staticmethod
+    def _pin_clock(monkeypatch):
+        from bayesian_consensus_engine_tpu.state import journal as jmod
+
+        monkeypatch.setattr(jmod.time, "time", lambda: 9_876.5)
+
+    def test_async_epochs_byte_identical_to_sync(
+        self, tmp_path, monkeypatch
+    ):
+        self._pin_clock(monkeypatch)
+
+        def run(async_mode):
+            path = tmp_path / ("a.jrnl" if async_mode else "s.jrnl")
+            store = seeded_store()
+            with JournalWriter(path) as journal:
+                for round_no in range(3):
+                    if async_mode:
+                        handle = store.flush_to_journal_async(
+                            journal, tag=round_no
+                        )
+                        assert handle.result() >= 0
+                    else:
+                        store.flush_to_journal(journal, tag=round_no)
+                    store.put_record(ReliabilityRecord(
+                        source_id=f"src-{round_no}",
+                        market_id=f"mkt-{round_no}",
+                        reliability=0.6,
+                        confidence=0.7,
+                        updated_at="2026-08-01T00:00:00+00:00",
+                    ))
+            return path.read_bytes()
+
+        assert run(async_mode=True) == run(async_mode=False)
+
+    def test_epochs_serialise_without_explicit_joins(self, tmp_path):
+        # Back-to-back async flushes: each joins its predecessor, so the
+        # journal replays to the final state even though the caller never
+        # joined the intermediate handles.
+        store = seeded_store()
+        with JournalWriter(tmp_path / "chain.jrnl") as journal:
+            for round_no in range(4):
+                store.update_reliability("src-1", f"mkt-{round_no}", True)
+                handle = store.flush_to_journal_async(journal, tag=round_no)
+            handle.result()
+        replayed, tag = replay_journal(tmp_path / "chain.jrnl")
+        assert tag == 3
+        assert store_fingerprint(replayed) == store_fingerprint(store)
+
+    class _TornFile:
+        """Writes the first *allow* bytes then fails — a disk-full crash
+        mid background append."""
+
+        def __init__(self, real, allow):
+            self._real = real
+            self._allow = allow
+
+        def write(self, data):
+            chunk = data[: self._allow]
+            self._real.write(chunk)
+            self._allow -= len(chunk)
+            if len(chunk) < len(data):
+                raise OSError(28, "No space left on device")
+            return len(chunk)
+
+        def __getattr__(self, name):
+            return getattr(self._real, name)
+
+    def test_crash_mid_async_epoch_recovers_last_joined(self, tmp_path):
+        path = tmp_path / "torn.jrnl"
+        store = seeded_store()
+        journal = JournalWriter(path)
+        store.flush_to_journal_async(journal, tag=0).result()  # baseline
+        durable = store_fingerprint(store)
+
+        store.update_reliability("src-0", "mkt-1", True)
+        real_file = journal._file
+        journal._file = self._TornFile(real_file, allow=32)
+        handle = store.flush_to_journal_async(journal, tag=1)
+        with pytest.raises(OSError, match="No space"):
+            handle.result()
+        journal._file = real_file
+
+        # Replay lands at the last JOINED epoch — tag 0, bit-exact —
+        # whether or not the torn frame's prefix bytes hit the disk.
+        replayed, tag = replay_journal(path)
+        assert tag == 0
+        assert store_fingerprint(replayed) == durable
+        # The failed epoch's rows were re-marked dirty: the retry epoch
+        # re-covers them and replay now reaches the live state.
+        store.flush_to_journal(journal, tag=1)
+        journal.close()
+        replayed, tag = replay_journal(path)
+        assert tag == 1
+        assert store_fingerprint(replayed) == store_fingerprint(store)
+
+    def test_background_failure_surfaces_at_next_flush(self, tmp_path):
+        store = seeded_store()
+        journal = JournalWriter(tmp_path / "fail.jrnl")
+        store.flush_to_journal_async(journal, tag=0).result()
+        store.update_reliability("src-0", "mkt-2", True)
+        journal._file = self._TornFile(journal._file, allow=0)
+        store.flush_to_journal_async(journal, tag=1)  # handle dropped
+        store.update_reliability("src-1", "mkt-3", True)
+        with pytest.raises(OSError, match="No space"):
+            store.flush_to_journal_async(journal, tag=2)
+        journal.close()
+
+    def test_store_close_joins_inflight_epoch(self, tmp_path):
+        store = seeded_store()
+        journal = JournalWriter(tmp_path / "join.jrnl")
+        store.flush_to_journal_async(journal, tag=0)
+        store.close()  # joins; an unjoined daemon write could be lost
+        journal.close()
+        _, tag = replay_journal(tmp_path / "join.jrnl")
+        assert tag == 0
+
+    def test_stream_async_journal_byte_identical_to_sync(
+        self, tmp_path, monkeypatch
+    ):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        self._pin_clock(monkeypatch)
+        batches = stream_batches(num_batches=4, seed=91)
+
+        def run(sync):
+            path = tmp_path / ("sync.jrnl" if sync else "async.jrnl")
+            store = TensorReliabilityStore()
+            for _result in settle_stream(
+                store, batches, steps=2, now=21_300.0, journal=path,
+                checkpoint_every=2, sync_checkpoints=sync,
+            ):
+                pass
+            return path.read_bytes()
+
+        assert run(sync=False) == run(sync=True)
+
+    def test_delta_counters_in_metrics_dump(self, tmp_path):
+        # journal.delta_rows counts rows carried by DELTA epochs (the
+        # full-snapshot first epoch is excluded); interchange.delta_rows
+        # counts rows upserted by incremental SQLite exports. Both land
+        # in the deterministic sorted-JSON dump.
+        import json
+
+        from bayesian_consensus_engine_tpu import obs
+
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            store = seeded_store()
+            with JournalWriter(tmp_path / "m.jrnl") as journal:
+                store.flush_to_journal(journal, tag=0)  # full snapshot
+                store.update_reliability("src-0", "mkt-0", True)
+                store.update_reliability("src-1", "mkt-1", False)
+                store.flush_to_journal_async(journal, tag=1).result()
+            db = tmp_path / "x.db"
+            store.flush_to_sqlite(db)  # baseline: full export
+            store.update_reliability("src-2", "mkt-2", True)
+            store.flush_to_sqlite(db)  # incremental
+        finally:
+            obs.set_metrics_registry(previous)
+        counters = json.loads(registry.to_json())["counters"]
+        assert counters["journal.delta_rows"] == 2
+        assert counters["interchange.delta_rows"] == 1
+
+    def test_stream_consumer_break_joins_inflight(self, tmp_path):
+        # A consumer that stops mid-stream (GeneratorExit) must still get
+        # the in-flight epoch's durability resolved before the generator
+        # returns — the tail either joins it or appends after it.
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        batches = stream_batches(num_batches=4, seed=93)
+        store = TensorReliabilityStore()
+        stream = settle_stream(
+            store, batches, steps=2, now=21_300.0,
+            journal=tmp_path / "brk.jrnl", checkpoint_every=2,
+        )
+        for i, _result in enumerate(stream):
+            if i == 1:
+                stream.close()
+                break
+        replayed, tag = replay_journal(tmp_path / "brk.jrnl")
+        assert tag == 1
+        assert store_fingerprint(replayed) == store_fingerprint(store)

@@ -32,6 +32,7 @@ PKG = lint_config.PACKAGE
 _DEVLINT_IDS = ("F401", "F541", "F811", "F821", "F841", "E711", "E712", "E722")
 _NEW_FAMILY_IDS = (
     "JX101", "JX102", "JX103", "JX104", "JX105", "JX106", "JX107", "JX108",
+    "JX109",
     "DT201", "DT202", "DT203",
     "LY301", "LY302", "LY303",
 )
@@ -93,6 +94,21 @@ _CASES = [
         "g = jax.jit(f, static_argnums=(1,))\ny = g(1, [1, 2])\n",
         "import jax\n\ndef f(x, opts):\n    return x\n\n"
         "g = jax.jit(f, static_argnums=(1,))\ny = g(1, (1, 2))\n",
+    ),
+    (
+        # Timing window (perf_counter in scope) fenced by block_until_ready:
+        # the audit fires; the scalar-fetch fence twin stays quiet, and so
+        # does a fence with no stopwatch in scope (third case below).
+        "JX109",
+        "scripts/case.py",
+        "import time\nimport jax\n\n\ndef timed(f, x):\n"
+        "    start = time.perf_counter()\n"
+        "    jax.block_until_ready(f(x))\n"
+        "    return time.perf_counter() - start\n",
+        "import time\n\n\ndef timed(f, x):\n"
+        "    start = time.perf_counter()\n"
+        "    float(f(x).reshape(-1)[0])\n"
+        "    return time.perf_counter() - start\n",
     ),
     (
         "JX107",
@@ -279,6 +295,76 @@ class TestSuppression:
     def test_wrong_id_noqa_does_not_suppress(self):
         src = "def f(x):\n    return x == None  # noqa: F401\n"
         assert "E711" in _codes(src, "tests/case.py")
+
+
+class TestFenceAudit:
+    """JX109: the block_until_ready-vs-fence audit. Co-occurrence with a
+    monotonic-clock read defines a timing window; a bare correctness sync
+    is legitimate and stays quiet."""
+
+    def test_bare_sync_without_stopwatch_is_quiet(self):
+        src = (
+            "import jax\n\n\ndef sync(x):\n"
+            "    jax.block_until_ready(x)\n    return x\n"
+        )
+        assert _codes(src, "scripts/case.py", select=["JX109"]) == []
+
+    def test_module_level_timing_script_is_flagged(self):
+        src = (
+            "import time\nimport jax\n\nstart = time.perf_counter()\n"
+            "jax.block_until_ready(start)\n"
+            "print(time.perf_counter() - start)\n"
+        )
+        assert "JX109" in _codes(src, "scripts/case.py", select=["JX109"])
+
+    def test_second_same_named_method_still_scanned(self):
+        # _all_defs dedupes by name (lookup semantics); the fence audit
+        # must scan EVERY def — the violating second `run` here.
+        src = (
+            "import time\nimport jax\n\n\nclass A:\n    def run(self, x):\n"
+            "        return x\n\n\nclass B:\n    def run(self, f, x):\n"
+            "        t0 = time.perf_counter()\n"
+            "        jax.block_until_ready(f(x))\n"
+            "        return time.perf_counter() - t0\n"
+        )
+        assert "JX109" in _codes(src, "scripts/case.py", select=["JX109"])
+
+    def test_nested_def_does_not_contaminate_module_scope(self):
+        # A def nested in an `if` block is its own scope: its stopwatch
+        # must not turn an unrelated module-level correctness sync into
+        # a finding, and the module-level sync must not silence it.
+        src = (
+            "import time\nimport jax\n\n"
+            "jax.block_until_ready(warmup())\n\n"
+            "if True:\n    def main():\n"
+            "        t0 = time.perf_counter()\n"
+            "        return time.perf_counter() - t0\n"
+        )
+        assert _codes(src, "scripts/case.py", select=["JX109"]) == []
+
+    def test_timed_outer_does_not_contaminate_inner_helper(self):
+        # The enclosing function times something; the nested helper's
+        # bare sync is a different scope and stays quiet.
+        src = (
+            "import time\nimport jax\n\n\ndef outer(f, x):\n"
+            "    t0 = time.perf_counter()\n\n"
+            "    def helper(y):\n"
+            "        jax.block_until_ready(y)\n        return y\n\n"
+            "    return helper(f(x)), time.perf_counter() - t0\n"
+        )
+        assert _codes(src, "scripts/case.py", select=["JX109"]) == []
+
+    def test_aliased_clock_still_counts(self):
+        src = (
+            "import time as _time\nimport jax\n\n\ndef timed(f, x):\n"
+            "    t0 = _time.monotonic()\n"
+            "    jax.block_until_ready(f(x))\n"
+            "    return _time.monotonic() - t0\n"
+        )
+        assert "JX109" in _codes(src, "scripts/case.py", select=["JX109"])
+
+    def test_is_warning_tier(self):
+        assert RULES["JX109"].severity == "warning"
 
 
 class TestCliContract:

@@ -165,7 +165,7 @@ class TestTimeline:
     def test_canonical_phase_vocabulary(self):
         assert obs.PHASES == (
             "pack", "upload", "settle_dispatch", "fetch", "journal_fsync",
-            "checkpoint", "interchange_export",
+            "journal_async_wait", "checkpoint", "interchange_export",
         )
 
 
@@ -379,6 +379,58 @@ class TestGoldenParityWithObsEnabled:
         assert export["histograms"]["stream.plan_build_s"]["count"] >= 1
 
 
+class TestLedgerDiff:
+    """Cross-round band diffing (``bce-tpu stats --against``): the
+    regression signal is bands that STOPPED overlapping, with direction
+    reported and the verdict left to the unit's polarity."""
+
+    @staticmethod
+    def _records(leg, values, unit="s"):
+        return [
+            {"leg": leg, "value": v, "unit": unit, "host": {}}
+            for v in values
+        ]
+
+    def test_overlapping_bands_are_a_wash(self):
+        old = self._records("leg", [1.0, 2.0])
+        new = self._records("leg", [1.5, 3.0])
+        diff = obs.diff_bands(old, new)
+        assert diff["leg"]["status"] == "overlap"
+
+    def test_disjoint_bands_flag_direction(self):
+        old = self._records("leg", [1.0, 2.0])
+        up = obs.diff_bands(old, self._records("leg", [2.5, 3.0]))
+        down = obs.diff_bands(old, self._records("leg", [0.25, 0.5]))
+        assert up["leg"]["status"] == "shifted_up"
+        assert down["leg"]["status"] == "shifted_down"
+        # Bands ride along verbatim so a round note can quote the range.
+        assert up["leg"]["old"]["max"] == 2.0
+        assert up["leg"]["new"]["min"] == 2.5
+
+    def test_touching_bands_still_overlap(self):
+        # Shared endpoint = one value both rounds produced: not a shift.
+        old = self._records("leg", [1.0, 2.0])
+        new = self._records("leg", [2.0, 3.0])
+        assert obs.diff_bands(old, new)["leg"]["status"] == "overlap"
+
+    def test_one_sided_legs_reported_not_compared(self):
+        old = self._records("gone", [1.0])
+        new = self._records("fresh", [2.0])
+        diff = obs.diff_bands(old, new)
+        assert diff["gone"]["status"] == "old_only"
+        assert diff["fresh"]["status"] == "new_only"
+
+    def test_render_diff_counts_moved_legs(self):
+        old = self._records("a", [1.0, 2.0]) + self._records("b", [5.0])
+        new = self._records("a", [4.0, 6.0]) + self._records("b", [5.0])
+        rendered = obs.render_diff(obs.diff_bands(old, new))
+        assert "shifted_up" in rendered
+        assert "1 leg(s) stopped overlapping" in rendered
+        # An all-overlap diff says so instead of counting zero.
+        calm = obs.render_diff(obs.diff_bands(old, old))
+        assert "all shared legs overlap" in calm
+
+
 class TestCliStats:
     def _main(self, argv, capsys):
         import sys
@@ -420,3 +472,39 @@ class TestCliStats:
         with pytest.raises(SystemExit) as excinfo:
             self._main(["stats", str(tmp_path / "nope.jsonl")], capsys)
         assert excinfo.value.code == 1
+
+    def _two_round_ledgers(self, tmp_path):
+        old = tmp_path / "old.jsonl"
+        new = tmp_path / "new.jsonl"
+        with obs.RunLedger(old, run_id="r1") as ledger:
+            ledger.record("slow_leg", value=1.0, unit="s", repeat=0)
+            ledger.record("slow_leg", value=1.2, unit="s", repeat=1)
+            ledger.record("steady", value=5.0, unit="s")
+        with obs.RunLedger(new, run_id="r2") as ledger:
+            ledger.record("slow_leg", value=2.0, unit="s", repeat=0)
+            ledger.record("slow_leg", value=2.1, unit="s", repeat=1)
+            ledger.record("steady", value=5.0, unit="s")
+        return old, new
+
+    def test_stats_against_flags_non_overlap(self, tmp_path, capsys):
+        old, new = self._two_round_ledgers(tmp_path)
+        out = self._main(
+            ["stats", str(new), "--against", str(old)], capsys
+        ).out
+        assert "shifted_up" in out
+        assert "1 leg(s) stopped overlapping" in out
+
+    def test_stats_against_json(self, tmp_path, capsys):
+        old, new = self._two_round_ledgers(tmp_path)
+        out = self._main(
+            ["stats", str(new), "--against", str(old), "--json"], capsys
+        ).out
+        payload = json.loads(out)
+        assert payload["legs"]["slow_leg"]["status"] == "shifted_up"
+        assert payload["legs"]["steady"]["status"] == "overlap"
+        # --leg restricts BOTH sides of the diff.
+        out = self._main(
+            ["stats", str(new), "--against", str(old), "--json",
+             "--leg", "steady"], capsys
+        ).out
+        assert set(json.loads(out)["legs"]) == {"steady"}

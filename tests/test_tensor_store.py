@@ -505,3 +505,193 @@ class TestPendingOverlaps:
             count = conn.execute("SELECT COUNT(*) FROM sources").fetchone()[0]
         assert count == used
         assert not store._pending_sync
+
+
+class TestDeltaSync:
+    """The round-6 delta device→host sync: with a flat pending state and
+    recipe-bounded dirty set, _sync_pending fetches ONE union-of-touched
+    take and merges through the same row merge as a full sync — the host
+    arrays (values, stamps, ISO strings, and BOTH dirty ledgers) must be
+    byte-identical to the full-column sync, and a journal epoch built
+    after either sync must be byte-identical too."""
+
+    @staticmethod
+    def _chained_settles(store):
+        """Two chained settles: duplicate signals in batch 1, new
+        interning (plus a row overlap) in batch 2 — the union-take path
+        with accumulated distinct-plan recipes."""
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan,
+            settle,
+        )
+
+        batch1 = [
+            ("m0", [
+                {"sourceId": "a", "probability": 0.9},
+                {"sourceId": "a", "probability": 0.4},  # duplicate signal
+                {"sourceId": "b", "probability": 0.3},
+            ]),
+            ("m1", [{"sourceId": "a", "probability": 0.7}]),
+        ]
+        plan1 = build_settlement_plan(store, batch1)
+        settle(store, plan1, [True, False], steps=2, now=21_000.0)
+        batch2 = [
+            ("m2", [
+                {"sourceId": "c", "probability": 0.6},  # new interning
+                {"sourceId": "a", "probability": 0.2},
+            ]),
+            ("m0", [{"sourceId": "b", "probability": 0.8}]),  # overlap
+        ]
+        plan2 = build_settlement_plan(store, batch2)
+        settle(store, plan2, [True, True], steps=1, now=21_001.0)
+
+    @staticmethod
+    def _host_state(store):
+        used = len(store)
+        return (
+            store._rel[:used].tobytes(),
+            store._conf[:used].tobytes(),
+            store._days[:used].tobytes(),
+            store._exists[:used].tobytes(),
+            list(store._iso[:used]),
+            store._dirty[:used].tobytes(),
+            store._journal_dirty[:used].tobytes(),
+        )
+
+    def _twin_stores(self):
+        delta, full = TensorReliabilityStore(), TensorReliabilityStore()
+        self._chained_settles(delta)
+        self._chained_settles(full)
+        assert delta._pending is not None and delta._pending_sync
+        # Force the full-column sync on the twin: dropping the recipes
+        # leaves only the recipe-less flat-pending path.
+        full._pending_sync = None
+        delta.sync()
+        full.sync()
+        return delta, full
+
+    def test_delta_sync_host_arrays_byte_identical_to_full(self):
+        delta, full = self._twin_stores()
+        assert len(delta) == len(full)
+        for mine, theirs in zip(
+            self._host_state(delta), self._host_state(full)
+        ):
+            assert mine == theirs
+
+    def test_journal_epoch_after_delta_sync_byte_identical(
+        self, tmp_path, monkeypatch
+    ):
+        from bayesian_consensus_engine_tpu.state import journal as jmod
+        from bayesian_consensus_engine_tpu.state.journal import (
+            JournalWriter,
+        )
+
+        delta, full = self._twin_stores()
+        monkeypatch.setattr(jmod.time, "time", lambda: 1_234.5)
+        with JournalWriter(tmp_path / "delta.jrnl") as writer:
+            delta.flush_to_journal(writer, tag=7)
+        with JournalWriter(tmp_path / "full.jrnl") as writer:
+            full.flush_to_journal(writer, tag=7)
+        assert (
+            (tmp_path / "delta.jrnl").read_bytes()
+            == (tmp_path / "full.jrnl").read_bytes()
+        )
+
+    def test_delta_sync_counts_union_rows(self):
+        from bayesian_consensus_engine_tpu import obs
+
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            store = TensorReliabilityStore()
+            self._chained_settles(store)
+            store.sync()
+        finally:
+            obs.set_metrics_registry(previous)
+        # Union of the two settles' touched rows: 3 + 3 with one overlap.
+        assert registry.export()["counters"]["store.delta_sync_rows"] == 5
+
+
+class TestInterchangeFingerprint:
+    """Incremental interchange exports verify the target still carries
+    OUR last export (content fingerprint) before upserting a delta; a
+    foreign write or rotation falls back to a full rewrite."""
+
+    def _seeded(self, n=30):
+        store = TensorReliabilityStore()
+        store.batch_update_reliability(
+            [(f"s{i}", f"m{i % 7}") for i in range(n)], [True] * n
+        )
+        return store
+
+    def test_untouched_target_stays_incremental(self, tmp_path):
+        db = tmp_path / "x.db"
+        store = self._seeded()
+        assert store.flush_to_sqlite(db) == 30
+        store.update_reliability("s3", "m3", False)
+        assert store.flush_to_sqlite(db) == 1
+
+    def test_foreign_write_falls_back_to_full(self, tmp_path):
+        import sqlite3
+        import time
+
+        db = tmp_path / "x.db"
+        store = self._seeded()
+        store.flush_to_sqlite(db)
+        time.sleep(0.01)  # ensure the foreign mtime is distinguishable
+        with sqlite3.connect(db) as conn:
+            conn.execute(
+                "INSERT OR REPLACE INTO sources VALUES"
+                " ('zz', 'zz', 0.1, 0.1, 'then')"
+            )
+        store.update_reliability("s3", "m3", False)
+        # Auto mode: fingerprint mismatch → the complete store, not the
+        # 1-row delta; forcing incremental refuses outright.
+        with pytest.raises(ValueError, match="fingerprint"):
+            store.flush_to_sqlite(db, incremental=True)
+        assert store.flush_to_sqlite(db) == 30
+
+    def test_rotated_target_falls_back_to_full(self, tmp_path):
+        db = tmp_path / "x.db"
+        store = self._seeded()
+        store.flush_to_sqlite(db)
+        other = self._seeded(n=5)
+        other.flush_to_sqlite(tmp_path / "other.db")
+        (tmp_path / "other.db").replace(db)  # rotation: same path, other file
+        store.update_reliability("s3", "m3", False)
+        assert store.flush_to_sqlite(db) == 30
+
+    def test_async_flush_chain_keeps_fingerprint_current(self, tmp_path):
+        db = tmp_path / "x.db"
+        store = self._seeded()
+        store.flush_to_sqlite_async(db).result()
+        store.update_reliability("s3", "m3", False)
+        # The async write recorded the post-write fingerprint: a clean
+        # follow-up flush is still a delta.
+        assert store.flush_to_sqlite(db) == 1
+
+    def test_delta_export_db_equals_full_export(self, tmp_path):
+        """The acceptance pin: an incremental re-export to the baseline
+        file is ROW-FOR-ROW identical to a fresh full export (and to a
+        second full export — dump comparison covers values and keys)."""
+        import sqlite3
+
+        def dump(path):
+            with sqlite3.connect(path) as conn:
+                return conn.execute(
+                    "SELECT source_id, market_id, reliability, confidence,"
+                    " updated_at FROM sources"
+                    " ORDER BY source_id, market_id"
+                ).fetchall()
+
+        store = self._seeded()
+        delta_db = tmp_path / "delta.db"
+        store.flush_to_sqlite(delta_db)  # baseline: full export
+        # Touch a subset (including a retired row) then delta-export.
+        store.update_reliability("s1", "m1", True)
+        store.update_reliability("s9", "m2", False)
+        written = store.flush_to_sqlite(delta_db)
+        assert 0 < written < 30  # genuinely a delta write
+        full_db = tmp_path / "full.db"
+        store.flush_to_sqlite(full_db)  # fresh full export of same state
+        assert dump(delta_db) == dump(full_db)
