@@ -43,19 +43,23 @@ from typing import Dict, Optional
 
 #: Canonical phase names, in pipeline order. ``pack`` is the consumer's
 #: wait on plan ingest (the non-overlapped part of pack/intern/fill);
-#: ``upload`` is host→device state/plan transfer; ``settle_dispatch`` is
-#: the unfenced kernel dispatch; ``fetch`` is the deferred device→host
-#: merge; ``journal_fsync`` is the durability write+fsync (on the caller's
-#: thread only — an async epoch's fsync runs on a worker thread, which by
-#: design records nothing); ``journal_async_wait`` is the consumer's join
-#: on an in-flight background epoch (near zero when the write overlapped
-#: the batches between cadences — the async-durability win is literally
-#: this phase staying empty); ``checkpoint`` is checkpoint-call overhead
-#: around the inner phases; ``interchange_export`` is the SQLite
-#: interchange write.
+#: ``upload`` is host→device state/plan transfer; ``state_adopt`` is the
+#: resident sharded session carrying its device block onto a new plan's
+#: layout after a topology miss (host traffic scales with rows entering
+#: the active set — the steady-state topology HIT records nothing here);
+#: ``settle_dispatch`` is the unfenced kernel dispatch; ``fetch`` is the
+#: deferred device→host merge; ``journal_fsync`` is the durability
+#: write+fsync (on the caller's thread only — an async epoch's fsync runs
+#: on a worker thread, which by design records nothing);
+#: ``journal_async_wait`` is the consumer's join on an in-flight
+#: background epoch (near zero when the write overlapped the batches
+#: between cadences — the async-durability win is literally this phase
+#: staying empty); ``checkpoint`` is checkpoint-call overhead around the
+#: inner phases; ``interchange_export`` is the SQLite interchange write.
 PHASES = (
     "pack",
     "upload",
+    "state_adopt",
     "settle_dispatch",
     "fetch",
     "journal_fsync",

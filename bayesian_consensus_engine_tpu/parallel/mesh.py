@@ -56,6 +56,15 @@ def market_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(MARKETS_AXIS))
 
 
+def slot_block_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for SLOT-MAJOR (K, M) blocks: slots over sources, markets on
+    lanes — the production cycle-loop layout. A resident settlement block
+    relaid onto a new plan (``ShardedSettlementSession.adopt``) is pinned
+    back to this sharding so the block survives plan swaps without the
+    loop's ``shard_map`` paying a lazy reshard on the next dispatch."""
+    return NamedSharding(mesh, PartitionSpec(SOURCES_AXIS, MARKETS_AXIS))
+
+
 def shard_block(array: jax.Array, mesh: Mesh) -> jax.Array:
     """Place a blocked (M, K) array onto the mesh."""
     return jax.device_put(array, block_sharding(mesh))

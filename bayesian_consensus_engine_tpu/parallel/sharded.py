@@ -512,6 +512,110 @@ def build_cycle_loop(
     return loop
 
 
+@partial(
+    jax.jit, static_argnames=("new_shape",), donate_argnums=(1, 2, 3)
+)
+def _relayout_math(
+    rel, conf, days, exists, src, enter_pos,
+    e_rel, e_conf, e_days, e_ex, *, new_shape,
+):
+    """The relayout gather/scatter, jitted ONCE per shape signature (a
+    per-call ``jax.jit`` would recompile on every adopt — measured ~58 ms
+    per topology swap at 10k-market shapes on CPU). Donation covers the
+    three tensors the new layout replaces; ``rel`` is kept alive for the
+    standing recipe (see :func:`relayout_slot_state`)."""
+
+    def onto(old_flat, fill, entered):
+        out = jnp.where(
+            src >= 0,
+            old_flat.reshape(-1)[jnp.clip(src, 0)],
+            jnp.asarray(fill, old_flat.dtype),
+        )
+        if entered.shape[0]:
+            out = out.at[enter_pos].set(entered)
+        return out.reshape(new_shape)
+
+    return MarketBlockState(
+        reliability=onto(rel, DEFAULT_RELIABILITY, e_rel),
+        confidence=onto(conf, DEFAULT_CONFIDENCE, e_conf),
+        updated_days=onto(days, 0.0, e_days),
+        exists=onto(exists, False, e_ex),
+    )
+
+
+def relayout_slot_state(
+    state: MarketBlockState,
+    src,
+    enter_pos,
+    enter_rel,
+    enter_conf,
+    enter_days,
+    enter_exists,
+    new_shape: tuple,
+    mesh: Mesh | None = None,
+) -> MarketBlockState:
+    """Carry a resident slot-major block onto a NEW plan's (K, M) layout.
+
+    The device half of ``ShardedSettlementSession.adopt``: after a
+    topology miss the session's state block must be re-laid-out for the
+    incoming plan — slots move, markets reorder, the padded extents may
+    grow (the capacity ladder) — without round-tripping the block through
+    the host. ``src`` (i32/i64, length ``K_new * M_new``) maps each new
+    flat slot-major position to the old block's flat position it carries
+    forward, or −1 for positions not carried (padding and rows entering
+    the active set); ``enter_pos``/``enter_*`` scatter the entering rows'
+    host-exact values (pre-cast to the block dtype, stamps already
+    re-expressed against the session epoch) into their new positions.
+    Everything else reads the cold-start defaults, exactly as a fresh
+    ``_build_state`` would leave unmasked padding.
+
+    Rows *leaving* the active set are deliberately NOT gathered here:
+    their last settled values are already covered by the session's
+    standing sync recipe (a lazy band gather over the old block), so they
+    reach the host store at the next checkpoint/sync — the adopt itself
+    moves O(entering) bytes host→device and nothing device→host.
+
+    The old block's ``confidence``/``updated_days``/``exists`` are donated
+    (the new layout replaces them); ``reliability`` is NOT — the standing
+    recipe may still resolve against it. With *mesh*, the relaid block is
+    pinned back to the slot-major sharding so the plan swap leaves the
+    state exactly where the cycle loop's ``shard_map`` expects it.
+    """
+    import warnings
+
+    with warnings.catch_warnings():
+        # A capacity-ladder adopt CHANGES the block shape, so the donated
+        # old tensors legitimately cannot back the new buffers — jax's
+        # "donated buffers were not usable" warning is expected there
+        # (same-shape adopts, the common drift case, do reuse them).
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        relaid = _relayout_math(
+            state.reliability,
+            state.confidence,
+            state.updated_days,
+            state.exists,
+            jnp.asarray(src),
+            jnp.asarray(enter_pos),
+            jnp.asarray(enter_rel),
+            jnp.asarray(enter_conf),
+            jnp.asarray(enter_days),
+            jnp.asarray(enter_exists),
+            new_shape=tuple(int(x) for x in new_shape),
+        )
+    if mesh is None:
+        return relaid
+    from bayesian_consensus_engine_tpu.parallel.mesh import (
+        slot_block_sharding,
+    )
+
+    sharding = slot_block_sharding(mesh)
+    return MarketBlockState(
+        *(jax.device_put(x, sharding) for x in relaid)
+    )
+
+
 def pad_markets(
     probs: jax.Array,
     mask: jax.Array,

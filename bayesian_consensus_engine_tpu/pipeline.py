@@ -40,6 +40,7 @@ from __future__ import annotations
 import collections as _collections
 import queue as _queue
 import threading
+import weakref as _weakref
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Mapping, Optional, Sequence
@@ -908,20 +909,35 @@ class _BandGather:
     touched subset): the store's recipe chain uses it to apply old links
     early before deep disjoint-batch chains of big blocks exhaust device
     memory (a north-star-scale band block is ~0.6 GB; eight would pin
-    ~5 GB of a 16 GB chip).
+    ~5 GB of a 16 GB chip). A gather whose block is the live state of a
+    STANDING resident session reports 0: the session pins that block
+    whether or not the recipe exists, so applying the link early would
+    free nothing — the store's byte budget must not count it. The
+    session is held by WEAK reference and consulted live, so the bytes
+    count again the moment the block stops being session-pinned: the
+    session adopts a new plan (``_standing_gather`` moves on), closes,
+    or is simply dropped (the per-batch stream's abandoned sessions die
+    at the next statement, so their gathers never evade the budget).
     """
 
-    __slots__ = ("_block", "_mask")
+    __slots__ = ("_block", "_mask", "_session")
 
-    def __init__(self, block, mask: np.ndarray) -> None:
+    def __init__(self, block, mask: np.ndarray, session=None) -> None:
         self._block = block
         self._mask = mask
+        self._session = (
+            _weakref.ref(session) if session is not None else None
+        )
 
     def __len__(self) -> int:
         return int(self._mask.sum())
 
     @property
     def held_nbytes(self) -> int:
+        if self._session is not None:
+            session = self._session()
+            if session is not None and session._standing_gather is self:
+                return 0
         return int(getattr(self._block, "nbytes", 0))
 
     def __array__(self, dtype=None, copy=None):
@@ -981,7 +997,8 @@ def _cached_cycle_loop(mesh):
 
 
 class ShardedSettlementSession:
-    """Chained, device-resident sharded settlements for one plan.
+    """Chained, device-resident sharded settlements for one plan — or, via
+    :meth:`refresh`/:meth:`adopt`, a long-lived SUCCESSION of plans.
 
     The mesh twin of :func:`settle`'s deferred chain: the sharded block
     state is built (per process band) ONCE, every :meth:`settle` runs the
@@ -990,6 +1007,11 @@ class ShardedSettlementSession:
     registered sync recipe (closed-form stamps/existence + a lazy band
     gather of reliabilities) that any host read resolves transparently.
     Host confidences stay exact throughout via the eager replay.
+    :meth:`refresh` swaps in a probability-only twin (topology hit: probs
+    upload only); :meth:`adopt` swaps in ANY same-store plan, carrying the
+    resident block across the topology change with host traffic scaling
+    with the row-set delta — the two moves ``settle_stream(mesh=...)``
+    makes every batch of the resident streamed service.
 
     Contract: one live session per store for any given set of rows — a
     flat :func:`settle` or direct host write to rows this session covers,
@@ -1025,6 +1047,10 @@ class ShardedSettlementSession:
         self._state = None  # built lazily: epoch depends on the first now
         self._epoch0 = None
         self._loop = None
+        # The last settle's registered band gather: while the session is
+        # live its block is session-pinned (the recipe chain must not
+        # count its bytes); adopt/teardown flips it back to counted.
+        self._standing_gather = None
 
     # -- state lifecycle -----------------------------------------------------
 
@@ -1145,12 +1171,13 @@ class ShardedSettlementSession:
         stamp_rel = np_dtype(
             np_dtype(now_abs - self._epoch0) + np_dtype(steps - 1)
         )
-        store.defer_settle_recipe(
-            self._touched,
-            _BandGather(new_state.reliability, self._band_mask),
-            self._epoch0,
-            stamp_rel,
+        gather = _BandGather(
+            new_state.reliability, self._band_mask, session=self
         )
+        store.defer_settle_recipe(
+            self._touched, gather, self._epoch0, stamp_rel,
+        )
+        self._standing_gather = gather
         _replay_confidences(store, self._touched, conf_exact, steps)
 
         # A band can lie entirely in padding (more band capacity than
@@ -1191,12 +1218,170 @@ class ShardedSettlementSession:
                 "current plan (SettlementPlan.refresh on the same "
                 "topology); this plan has its own slot layout"
             )
-        (self._padded_total, self._lo, self._hi,
-         self._band_rows, self._band_mask, self._probs_g,
-         self._mask_g) = _sharded_plan_cache(
-            plan, self._mesh, self._cdtype, self._band
-        )
+        with active_timeline().span("upload"):
+            (self._padded_total, self._lo, self._hi,
+             self._band_rows, self._band_mask, self._probs_g,
+             self._mask_g) = _sharded_plan_cache(
+                plan, self._mesh, self._cdtype, self._band
+            )
         self._plan = plan
+
+    def _release_standing(self) -> None:
+        """The session is leaving its current block: the standing recipe
+        (if any) becomes the only thing pinning it, so its bytes count
+        against the store's deferral budget again (held_nbytes checks
+        ``_standing_gather is self`` through a weakref, so clearing the
+        attribute — or the session dying — is the release)."""
+        self._standing_gather = None
+
+    def adopt(self, plan: SettlementPlan, band=None) -> str:
+        """Swap the session onto a NEW-topology *plan* without teardown.
+
+        The topology-miss half of the resident streamed service
+        (:func:`settle_stream` with ``mesh=``): where :meth:`refresh`
+        handles the steady-state probability-only twin, ``adopt`` takes
+        any plan bound to the same store and carries the RESIDENT block
+        across the swap. Returns how the swap was served:
+
+        * ``"refresh"`` — *plan* shares the current plan's topology
+          arrays (the fingerprint-hit fast path): probs-only upload.
+        * ``"relayout"`` — the resident block was re-laid-out on device
+          for the new plan (:func:`~.parallel.sharded.relayout_slot_state`):
+          rows STAYING in the active set move with it (zero host
+          traffic), rows ENTERING upload their host values (O(entering)
+          — fresh markets enter as cold defaults), and rows LEAVING stay
+          covered by the standing sync recipe, reaching the host store
+          lazily at the next checkpoint/sync exactly as any deferred
+          band gather does. Capacity-ladder growth of the padded extents
+          re-pads the block in place (the relayout's output shape is the
+          new plan's). Bit-equal to tearing the session down and
+          rebuilding (pinned by tests/test_overlap.py).
+        * ``"rebuild"`` — the resident state was dropped; the next
+          :meth:`settle` rebuilds from host (the per-batch-session
+          cost). Taken when there is no resident state yet, in ``band=``
+          / multi-process mode (the relayout mapping is process-local —
+          each process would need its peers' layouts), or when an
+          entering row's host stamp cannot be re-expressed against the
+          session epoch (backdated settlements).
+        """
+        if band == self._band:
+            # The hit shortcut only applies within the SAME band: a band
+            # change under a shared topology still re-slices rows, so it
+            # must take the miss path (which rebuilds for band mode).
+            if plan is self._plan:
+                return "refresh"
+            if (
+                plan.slot_rows is self._plan.slot_rows
+                and plan.mask is self._plan.mask
+            ):
+                self.refresh(plan)
+                return "refresh"
+        # Topology miss from here on: the swap work (row-set delta, host
+        # reads for entering rows, device relayout) is the ``state_adopt``
+        # phase; the probs/mask upload inside the plan cache stays
+        # attributed to ``upload`` (exclusive nesting).
+        with active_timeline().span("state_adopt"):
+            return self._adopt_miss(plan, band)
+
+    def _adopt_miss(self, plan: SettlementPlan, band) -> str:
+        import jax
+
+        from bayesian_consensus_engine_tpu.parallel.sharded import (
+            relayout_slot_state,
+        )
+        from bayesian_consensus_engine_tpu.utils.timeconv import NEVER
+
+        store = self._store
+        old_state = self._state
+        old_band_rows, old_band_mask = self._band_rows, self._band_mask
+        old_lo, old_hi, old_total = self._lo, self._hi, self._padded_total
+        resident = (
+            old_state is not None
+            and self._band is None
+            and band is None
+            and jax.process_count() == 1
+        )
+        self._band = band
+        with active_timeline().span("upload"):
+            (self._padded_total, self._lo, self._hi,
+             self._band_rows, self._band_mask, self._probs_g,
+             self._mask_g) = _sharded_plan_cache(
+                plan, self._mesh, self._cdtype, band
+            )
+        self._plan = plan
+        self._touched = self._band_rows[self._band_mask]
+        # Single-process bands span the whole axis; anything else means the
+        # flat position maps below would be band-local, not global.
+        resident = resident and (
+            old_lo == 0 and old_hi == old_total
+            and self._lo == 0 and self._hi == self._padded_total
+        )
+        # The session is mid-swap from here: drop the resident binding
+        # FIRST, so an exception anywhere below (a sync failure, a device
+        # error in the relayout) leaves a clean rebuild posture — never
+        # the OLD layout's block bound to the NEW plan, which a retrying
+        # caller would silently settle against the wrong rows. The old
+        # block stays reachable through the standing recipe (and the
+        # local reference) for the relayout/recipe resolution.
+        self._release_standing()
+        self._state = None
+        if not resident:
+            return "rebuild"
+
+        # Row-set delta between the outgoing and incoming layout, as flat
+        # slot-major positions (each plan maps a row to exactly one slot).
+        old_pos = np.flatnonzero(old_band_mask.ravel())
+        old_rows = old_band_rows.ravel()[old_pos]
+        new_pos = np.flatnonzero(self._band_mask.ravel())
+        new_rows = self._band_rows.ravel()[new_pos]
+        if old_rows.size:
+            order = np.argsort(old_rows, kind="stable")
+            sorted_rows = old_rows[order]
+            sorted_pos = old_pos[order]
+            idx = np.minimum(
+                np.searchsorted(sorted_rows, new_rows), old_rows.size - 1
+            )
+            staying = sorted_rows[idx] == new_rows
+        else:
+            sorted_pos = old_pos
+            idx = np.zeros(new_rows.size, dtype=np.int64)
+            staying = np.zeros(new_rows.size, dtype=bool)
+        entering_rows = new_rows[~staying]
+        entering_pos = new_pos[~staying]
+
+        # Entering rows may sit behind an OLDER deferred recipe (e.g. a
+        # previous adopt's leaving rows re-entering): resolve before
+        # reading their host values. The session's own standing recipe
+        # covers only the outgoing set — disjoint from entering rows by
+        # construction — so the steady drift case never syncs here.
+        if entering_rows.size and store.pending_overlaps(entering_rows):
+            store.sync()
+        host_rel, host_conf, host_days, host_exists = store.host_rows(
+            entering_rows, sync=False
+        )
+        live = host_days > NEVER
+        rel_days = np.where(live, host_days - self._epoch0, 0.0)
+        if bool((live & (rel_days <= 0)).any()):
+            # A host stamp at/below the session epoch has no positive
+            # relative expression (backdated writes): stay in the rebuild
+            # posture; the next settle rebuilds at a fresh epoch.
+            return "rebuild"
+
+        src = np.full(self._band_mask.size, -1, dtype=np.int64)
+        src[new_pos[staying]] = sorted_pos[idx[staying]]
+        np_cdtype = np.dtype(self._cdtype)
+        self._state = relayout_slot_state(
+            old_state,
+            src,
+            entering_pos,
+            host_rel.astype(np_cdtype),
+            host_conf.astype(np_cdtype),
+            rel_days.astype(np_cdtype),
+            host_exists.astype(bool),
+            self._band_mask.shape,
+            mesh=self._mesh,
+        )
+        return "relayout"
 
     def sync(self) -> None:
         """Merge every deferred settlement into the host store now."""
@@ -1204,6 +1389,7 @@ class ShardedSettlementSession:
 
     def close(self) -> None:
         self.sync()
+        self._release_standing()
         self._state = None
 
     def __enter__(self) -> "ShardedSettlementSession":
@@ -1461,6 +1647,7 @@ def settle_stream(
     journal=None,
     reuse_plans: bool = False,
     sync_checkpoints: bool = False,
+    resident_session: bool = True,
 ):
     """The streamed settle-and-checkpoint service loop, fully overlapped.
 
@@ -1535,11 +1722,18 @@ def settle_stream(
     the SETTLED batch count even when a checkpoint failure aborts the
     stream: the failing batch has settled without yielding, and a
     restart must resume from ``batches[len(stats):]`` (re-settling it
-    would double its updates — see examples/fault_tolerant_service.py). Under ``mesh=`` the dispatch-only reading of
-    ``settle_dispatch_s`` does NOT hold: each batch's session build first
-    drains the PREVIOUS batch's device→host band gather and re-uploads
-    host state, so device backpressure surfaces here (not in
-    ``checkpoint_s``) — read it as the full per-batch settle window.
+    would double its updates — see examples/fault_tolerant_service.py).
+    Under ``mesh=`` each dict also carries ``"session_adopt"``: how the
+    resident session served the batch (``"start"``/``"refresh"``/
+    ``"relayout"``/``"rebuild"`` — ``None`` on the flat path and with
+    *resident_session* off). The dispatch-only reading of
+    ``settle_dispatch_s`` holds under ``mesh=`` too since round 7: the
+    persistent session keeps the reliability block in HBM across
+    batches, so nothing drains or re-uploads inside the settle window on
+    a topology hit (with ``resident_session=False`` — the per-batch
+    legacy shape — the old caveat returns: each batch's session build
+    drains the previous batch's band gather and re-uploads host state,
+    so read it as the full per-batch settle window there).
 
     When this thread is recording a phase timeline
     (:func:`~.obs.timeline.recording`), each stats dict additionally
@@ -1552,21 +1746,35 @@ def settle_stream(
     ``stream.settle_dispatch_s``, ``stream.plan_build_s``) — all no-ops
     unless :func:`~.obs.metrics.set_metrics_registry` enabled one.
 
-    *mesh*, if given, runs every settle sharded over the device mesh:
-    each batch settles through a :class:`ShardedSettlementSession`
-    (markets on the lane axis, source slots optionally split with a
-    ``psum`` reduction), abandoned without an eager close — the
-    session's host-merge recipe is registered at settle and resolves at
-    the next checkpoint or the first later batch that OVERLAPS its rows
-    (batches of fresh markets never stall on their predecessors'
-    device→host gathers; the deferral chain is bounded at 8, older links
-    applying early). Results, store state, and checkpoint files are
-    bit-identical to the flat stream on a markets-only mesh (a 2-D mesh
-    re-associates each market's slot sum into psum partials: ≤1 ulp on
-    consensus, state updates quantised identically — see
-    :func:`settle_sharded`). ``num_slots="bucket"`` remains the default;
-    the mesh path additionally pads K to the sources-axis extent, so
-    wobbling batch widths still share compiled settle programs.
+    *mesh*, if given, runs every settle sharded over the device mesh
+    through ONE long-lived :class:`ShardedSettlementSession` (markets on
+    the lane axis, source slots optionally split with a ``psum``
+    reduction) held across batches — the round-7 resident service shape.
+    On a topology-fingerprint hit (the ``reuse_plans`` steady state) a
+    batch is: upload the probs block (:meth:`~ShardedSettlementSession.
+    refresh`) → in-jit donated cycle loop → register the deferred
+    band-gather recipe — ZERO reliability-state host traffic. On a miss
+    the session is NOT torn down: :meth:`~ShardedSettlementSession.
+    adopt` re-lays the resident block out for the new plan on device,
+    uploading only rows entering the active set (rows leaving reach the
+    host lazily through the standing recipe; capacity-ladder growth
+    re-pads in place). ``resident_session=False`` restores the
+    per-batch-session legacy shape (one session per batch, state rebuilt
+    from host each time) — kept for A/B benches and as the
+    multi-process ``band=`` fallback the resident path itself takes.
+    Either way the session's host-merge recipe resolves at the next
+    checkpoint or the first later batch that OVERLAPS its rows (batches
+    of fresh markets never stall on their predecessors' device→host
+    gathers; the deferral chain is bounded at 8, older links applying
+    early). Results, store state, and checkpoint files are bit-identical
+    to the per-batch-session stream AND to the flat stream on a
+    markets-only mesh (a 2-D mesh re-associates each market's slot sum
+    into psum partials: ≤1 ulp on consensus, state updates quantised
+    identically — see :func:`settle_sharded`); pinned by
+    tests/test_overlap.py::TestResidentSessionStream.
+    ``num_slots="bucket"`` remains the default; the mesh path
+    additionally pads K to the sources-axis extent, so wobbling batch
+    widths still share compiled settle programs.
 
     *band*, multi-process only: ``(lo, global_markets)`` marks each
     batch's plan as covering ONLY this process's markets — rows
@@ -1687,9 +1895,13 @@ def settle_stream(
     reuse_hit_counter = registry.counter("stream.plan_reuse_hits")
     reuse_miss_counter = registry.counter("stream.plan_reuse_misses")
     dispatch_hist = registry.histogram("stream.settle_dispatch_s")
+    adopts_counter = registry.counter("stream.session_adopts")
+    resident_gauge = registry.gauge("stream.resident_rows")
 
     handle = None
     journal_handle = None
+    session = None  # the mesh path's long-lived resident session
+    session_band = None
     flushed_through = -1
     journaled_through = -1
     settled_through = -1
@@ -1722,23 +1934,49 @@ def settle_stream(
                 plan_reused = (
                     getattr(plan, "_refreshed_from", None) is not None
                 )
+                session_adopt = None
                 settle_start = _time.perf_counter()
                 if mesh is None:
                     result = settle(
                         store, plan, outcomes, steps=steps, now=batch_now,
                         dtype=dtype,
                     )
-                else:
-                    # One session per batch (each batch is its own plan),
+                elif not resident_session:
+                    # LEGACY per-batch session (A/B benches + tests),
                     # abandoned without close: the settle registered the
                     # store's merge recipe, and closing here would sync it
                     # eagerly — serialising the device→host gather against
                     # this thread. Left pending, the NEXT batch's state
                     # build (or the checkpoint flush) resolves it instead.
                     batch_band = band(index) if callable(band) else band
-                    session = ShardedSettlementSession(
+                    result = ShardedSettlementSession(
                         store, plan, mesh, dtype=dtype, band=batch_band
-                    )
+                    ).settle(outcomes, steps=steps, now=batch_now)
+                else:
+                    # ONE resident session across batches: a topology hit
+                    # uploads only the probs block, a miss adopts the new
+                    # plan with the block held in HBM (never closed
+                    # mid-stream — the standing recipe resolves at the
+                    # next checkpoint/overlap exactly like the per-batch
+                    # shape's deferred gathers; a crash restart simply
+                    # builds a fresh session from batches[len(stats):]).
+                    batch_band = band(index) if callable(band) else band
+                    if session is None or batch_band != session_band:
+                        if session is not None:
+                            # The replaced session's standing gather is no
+                            # longer session-pinned: let its bytes count
+                            # against the deferral budget again.
+                            session._release_standing()
+                        session = ShardedSettlementSession(
+                            store, plan, mesh, dtype=dtype, band=batch_band
+                        )
+                        session_band = batch_band
+                        session_adopt = "start"
+                    else:
+                        session_adopt = session.adopt(plan, band=batch_band)
+                        if session_adopt != "refresh":
+                            adopts_counter.inc()
+                    resident_gauge.set(float(session._touched.size))
                     result = session.settle(
                         outcomes, steps=steps, now=batch_now
                     )
@@ -1762,6 +2000,7 @@ def settle_stream(
                             "settle_dispatch_s": settle_dispatch_s,
                             "checkpoint_s": None,
                             "plan_reused": plan_reused,
+                            "session_adopt": session_adopt,
                         }
                     )
                 due = (index + 1) % checkpoint_every == 0

@@ -491,6 +491,16 @@ class TensorReliabilityStore:
         DISTINCT plans would grow HBM linearly; applying the oldest links
         early is always safe (they describe values that were final when
         gathered; later links overwrite any overlap in order).
+
+        A STANDING RESIDENT SESSION's link reports ``held_nbytes == 0``
+        (pipeline._BandGather holds its session by weakref): its block
+        is pinned by the live session whether or not the recipe exists,
+        so early-applying that link frees nothing and the byte budget
+        must not trip on it. The moment the block stops being
+        session-pinned — the session adopts a new plan, closes, or is
+        dropped — the link's bytes count again; the length bound (8)
+        applies to every link either way, and applying a resident link
+        early remains safe (it gathers from the live block).
         """
         kept = [
             r for r in (recipes or [])
@@ -1125,6 +1135,15 @@ class TensorReliabilityStore:
         sync time. Same accumulation rules as :meth:`defer_absorb`'s
         recipes: content-duplicate touched sets replace, the chain is
         bounded by early application, and orphaned recipes still sync.
+
+        This is also how a LONG-LIVED resident session keeps checkpoints
+        delta-shaped: every settle re-registers one link for the
+        session's touched rows (replacing the previous — same array
+        object across same-topology batches), so a checkpoint's
+        ``_sync_pending`` fetches exactly the session's dirty rows once,
+        while the block itself never leaves HBM. Durability cost stays
+        O(touched), independent of store size and of how many batches
+        ran since the last checkpoint.
         """
         if self._pending is not None:
             # A flat pending state exists (recipe-less: its changes live

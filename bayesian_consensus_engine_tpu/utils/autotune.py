@@ -54,11 +54,25 @@ class ShapeTuner:
 
     * disabled → *default*, ``measure`` never called;
     * cached (same knob + shape + device kind, cached value still among
-      *candidates*) → the cached winner, ``measure`` never called;
+      *candidates* — or the cached default itself) → the cached winner,
+      ``measure`` never called;
     * otherwise → ``measure(candidate)`` once each (seconds; raising or
       non-finite means "ineligible here", e.g. a tile over the VMEM
-      budget), persist and return the argmin — or *default* if nothing
+      budget), persist and return the winner — or *default* if nothing
       measured successfully.
+
+    HONESTY GUARD: a tuned value ships only when it BEATS the default on
+    the same A/B clock. The default is always measured alongside the
+    candidates (appended when not among them), and the argmin replaces
+    it only with ``timings[argmin] < timings[default]`` — a tie, a
+    loss, or measurement noise that merely reordered near-equal times
+    records the DEFAULT, so the cache can never lock in a "winner" that
+    was not demonstrated to win. The cache entry carries the verdict
+    (``default``, ``beat_default``, per-candidate ``timings_s``);
+    :meth:`decision` reads it back for reporting (bench ``pallas_ab``
+    records which one won). Only when the default itself is ineligible
+    (its measure raises — e.g. a tile that does not divide the shape)
+    does the plain argmin ship.
     """
 
     def __init__(
@@ -118,12 +132,24 @@ class ShapeTuner:
             # .get twice: a malformed entry (hand-edited / other-schema
             # cache file) falls through to re-measurement — the cache is an
             # optimisation only, never a crash.
+            # A cached verdict only answers when it was adjudicated
+            # against THIS default ("default" matching): entries from the
+            # pre-guard schema (no recorded default — argmin winners that
+            # were never raced against the default, exactly the VERDICT
+            # r5 #9 failure) and entries tuned against a different
+            # default both fall through to re-measurement.
             if entry is not None and isinstance(entry, dict) and (
-                entry.get("choice") in list(candidates)
+                entry.get("default") == default
             ):
-                return entry["choice"]
+                cached = entry.get("choice")
+                if cached in list(candidates) or cached == default:
+                    return cached
+            to_measure = list(candidates)
+            if default not in to_measure:
+                # The honesty guard needs the default on the same clock.
+                to_measure.append(default)
             timings = {}
-            for candidate in candidates:
+            for candidate in to_measure:
                 try:
                     seconds = float(measure(candidate))
                 except Exception:  # noqa: BLE001 — ineligible candidate
@@ -133,12 +159,27 @@ class ShapeTuner:
             if not timings:
                 return default
             choice = min(timings, key=timings.__getitem__)
+            default_s = timings.get(default)
+            if default_s is not None and timings[choice] >= default_s:
+                # Not demonstrated to beat the default on this clock:
+                # record the default, never a noise-ordered "winner".
+                choice = default
             cache[key] = {
                 "choice": choice,
+                "default": default,
+                "beat_default": choice != default,
                 "timings_s": {str(c): round(t, 6) for c, t in timings.items()},
             }
             self._store()
             return choice
+
+    def decision(self, knob: str, shape_key: tuple):
+        """The recorded tuning verdict for (knob, shape) — the cache entry
+        (``choice``/``default``/``beat_default``/``timings_s``), or
+        ``None`` when nothing was measured/persisted yet."""
+        with self._lock:
+            entry = self._load().get(self._key(knob, shape_key))
+            return dict(entry) if isinstance(entry, dict) else None
 
 
 def time_best_of(run: Callable[[], object], repeats: int = 3) -> float:

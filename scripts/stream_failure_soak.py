@@ -20,7 +20,10 @@ Run from the repo root:
                                           [--ledger soak.jsonl]
 
 CPU by default (the contract is host-side); ``--tpu`` leaves the default
-backend alone. ``--ledger`` routes the captures through the obs run
+backend alone. ``--mesh`` streams sharded — through the round-7 RESIDENT
+session by default, so the soak's failure/rollback/retry contract covers
+the persistent-session service shape; ``--per-batch-session`` restores
+the legacy one-session-per-batch stream for A/B. ``--ledger`` routes the captures through the obs run
 ledger (loadavg/min-of-N attribution, same as bench legs — render with
 ``bce-tpu stats``; ROADMAP obs follow-up). Exit code 0 iff every
 assertion holds.
@@ -50,6 +53,10 @@ def main() -> int:
                         help="source-id universe (rows ≈ markets × ~2.1)")
     parser.add_argument("--mesh", action="store_true",
                         help="stream sharded over an 8-device CPU mesh")
+    parser.add_argument("--per-batch-session", action="store_true",
+                        help="with --mesh: the legacy one-session-per-"
+                             "batch shape (default: the round-7 resident "
+                             "session held across batches)")
     parser.add_argument("--tpu", action="store_true",
                         help="keep the default backend (else force CPU)")
     parser.add_argument("--ledger",
@@ -142,6 +149,7 @@ def main() -> int:
             store, batches(), steps=args.steps, now=21_500.0, db_path=db,
             checkpoint_every=args.checkpoint_every, columnar=True,
             stats=stats, mesh=mesh,
+            resident_session=not args.per_batch_session,
         ):
             settled += 1
             print(f"  batch {settled - 1} settled "
@@ -157,7 +165,10 @@ def main() -> int:
     record("stream_to_failure_s", value=round(elapsed, 3), unit="s",
            extras={"settled_batches": settled,
                    "failure": f"{type(failure).__name__}: {failure}",
-                   "mesh": bool(args.mesh)})
+                   "mesh": bool(args.mesh),
+                   "resident_session": bool(
+                       args.mesh and not args.per_batch_session
+                   )})
 
     used = len(store)
     dirty = int(store._dirty[:used].sum())

@@ -290,3 +290,104 @@ class TestMalformedCache:
             "knob", (1,), [1, 2], {1: 2.0, 2: 1.0}.__getitem__, 1
         )
         assert choice == 2
+
+
+class TestHonestyGuard:
+    """A tuned value ships only when it BEATS the default on the same
+    clock (VERDICT r5 #9): a rigged timer that makes every candidate
+    slower than — or equal to — the default must leave the default in
+    the cache, never a noise-ordered "winner"."""
+
+    def _tuner(self, tmp_path):
+        return ShapeTuner(
+            cache_path=str(tmp_path / "honest.json"),
+            enabled=True,
+            device_kind="test-device",
+        )
+
+    def test_loser_candidates_record_the_default(self, tmp_path):
+        # Rigged clock: the default (512) is fastest; the "tuned"
+        # candidates all lose. Pre-guard, argmin over candidates-only
+        # would have shipped 1024 without ever timing 512.
+        clock = {512: 1.0, 1024: 2.0, 2048: 3.0}
+        tuner = self._tuner(tmp_path)
+        assert tuner.tune(
+            "tile", (8, 8), [1024, 2048], clock.__getitem__, 512
+        ) == 512
+        entry = tuner.decision("tile", (8, 8))
+        assert entry["choice"] == 512
+        assert entry["default"] == 512
+        assert entry["beat_default"] is False
+        assert set(entry["timings_s"]) == {"512", "1024", "2048"}
+        # Cached verdict answers without re-measuring (default is not in
+        # the candidate list — the cached-default validity path).
+        assert tuner.tune(
+            "tile", (8, 8), [1024, 2048],
+            lambda c: pytest.fail("re-measured"), 512,
+        ) == 512
+
+    def test_tie_ships_the_default(self, tmp_path):
+        tuner = self._tuner(tmp_path)
+        assert tuner.tune(
+            "tile", (4,), [1, 2], {1: 1.0, 2: 1.0}.__getitem__, 1
+        ) == 1
+        assert tuner.decision("tile", (4,))["beat_default"] is False
+
+    def test_winner_still_ships_and_records_the_win(self, tmp_path):
+        tuner = self._tuner(tmp_path)
+        assert tuner.tune(
+            "tile", (4,), [1, 2], {1: 2.0, 2: 1.0}.__getitem__, 1
+        ) == 2
+        entry = tuner.decision("tile", (4,))
+        assert entry["beat_default"] is True and entry["choice"] == 2
+
+    def test_default_measured_even_when_not_a_candidate(self, tmp_path):
+        measured = []
+
+        def clock(candidate):
+            measured.append(candidate)
+            return {7: 0.5, 1: 1.0, 2: 2.0}[candidate]
+
+        tuner = self._tuner(tmp_path)
+        assert tuner.tune("tile", (2,), [1, 2], clock, 7) == 7
+        assert 7 in measured
+
+    def test_infeasible_default_ships_the_argmin(self, tmp_path):
+        def clock(candidate):
+            if candidate == 7:
+                raise RuntimeError("default tile does not divide")
+            return float(candidate)
+
+        tuner = self._tuner(tmp_path)
+        assert tuner.tune("tile", (3,), [1, 2], clock, 7) == 1
+        assert tuner.decision("tile", (3,))["beat_default"] is True
+
+    def test_pre_guard_cache_entry_is_remeasured(self, tmp_path):
+        """An old-schema cache entry (argmin winner, no recorded default
+        verdict) must NOT answer: it was never raced against the default
+        — the exact failure the guard exists for."""
+        import json as _json
+
+        path = tmp_path / "honest.json"
+        tuner = self._tuner(tmp_path)
+        key = tuner._key("tile", (9,))
+        path.write_text(_json.dumps(
+            {key: {"choice": 1024, "timings_s": {"1024": 1.0}}}
+        ))
+        clock = {512: 1.0, 1024: 2.0}
+        fresh = self._tuner(tmp_path)
+        assert fresh.tune(
+            "tile", (9,), [1024], clock.__getitem__, 512
+        ) == 512
+        assert fresh.decision("tile", (9,))["beat_default"] is False
+
+    def test_cached_verdict_for_other_default_is_remeasured(self, tmp_path):
+        tuner = self._tuner(tmp_path)
+        assert tuner.tune(
+            "tile", (11,), [1, 2], {1: 2.0, 2: 1.0}.__getitem__, 1
+        ) == 2
+        # Same knob+shape, different DEFAULT: the recorded race does not
+        # apply — re-measure against the new default.
+        assert tuner.tune(
+            "tile", (11,), [1, 2], {1: 2.0, 2: 1.0, 3: 0.5}.__getitem__, 3
+        ) == 3

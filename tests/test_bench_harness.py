@@ -670,8 +670,8 @@ class TestCircuitBreaker:
         monkeypatch.setenv("BCE_BENCH_PROBE_BUDGET_S", "10")
         canned = {"probe": _ok({"platform": "tpu"})}
         canned.update(_full_results())
-        canned["tiebreak_10k_agents"] = _fail("timeout after 900s (killed)")
         canned["pallas_ab"] = _fail("timeout after 1500s (killed)")
+        canned["dryrun_multichip"] = _fail("timeout after 1500s (killed)")
 
         def run_leg(name, timeout=None, fast=False, cpu=False):
             return canned.get(name, _fail("unexpected"))
@@ -681,3 +681,119 @@ class TestCircuitBreaker:
         )
         assert rc == 0
         assert "degraded" not in payload["extras"]
+
+
+class TestResidentSessionLeg:
+    """The round-7 persistent-session A/B leg (``e2e_stream_resident``)
+    at --fast shapes: per-batch vs resident sharded streaming over the
+    two-act (steady + drift) workload. Byte-parity of the two shapes is
+    pinned by tests/test_overlap.py::TestResidentSessionStream; this
+    pins the LEG's contract (JSON shape, the adopt accounting, the
+    min-of-N band fields)."""
+
+    def test_fast_leg_reports_resident_ab(self):
+        result = bench.run_leg_inprocess("e2e_stream_resident", fast=True)
+        for side in ("per_batch", "resident"):
+            for key in (
+                "wall_s", "wall_s_band", "repeats",
+                "amortised_1m_cycles_per_sec",
+                "dispatch_s_per_batch_act1", "dispatch_s_per_batch_act2",
+                "adopt_s", "session_adopts", "session_modes",
+                "plan_reuse_hits", "phases",
+            ):
+                assert key in result[side], (side, key)
+        per_batch, resident = result["per_batch"], result["resident"]
+        fast_kwargs = bench.LEGS["e2e_stream_resident"][2]
+        batches = fast_kwargs["batches"]
+        # The resident run holds ONE session: a start, hits served by
+        # refresh, exactly one adopt at the act boundary.
+        assert resident["session_modes"][0] == "start"
+        assert resident["session_adopts"] == 1
+        assert resident["session_modes"].count("relayout") == 1
+        assert resident["adopt_s"] > 0
+        # Legacy shape: no session bookkeeping at all.
+        assert per_batch["session_modes"] == [None] * batches
+        assert per_batch["session_adopts"] == 0
+        # Scaling with rows CHANGED, not store size, is a production-
+        # shape claim (at --fast sizes both windows are dominated by
+        # per-dispatch noise); the smoke pins that both windows exist
+        # and were measured.
+        assert resident["dispatch_s_per_batch_act1"] > 0
+        assert resident["dispatch_s_per_batch_act2"] > 0
+        # Min-of-N band fields are coherent.
+        lo, hi = resident["wall_s_band"]
+        assert lo <= resident["wall_s"] <= hi
+        assert result["resident_speedup"] > 0
+        json.dumps(result)
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_stream_resident" in bench.LEGS
+        assert "e2e_stream_resident" in bench.DEVICE_LEG_ORDER
+
+
+class TestStreamLegBands:
+    """VERDICT r5 #6: every e2e_stream* leg reports min-of-N bands and
+    routes per-repeat records through the run ledger like e2e_overlap."""
+
+    def test_stream_leg_records_repeats_to_ledger(self, tmp_path):
+        from bayesian_consensus_engine_tpu.obs.ledger import (
+            RunLedger,
+            min_of_repeats,
+            read_ledger,
+        )
+
+        ledger_path = tmp_path / "stream.jsonl"
+        old = bench._LEDGER
+        bench._LEDGER = RunLedger(ledger_path, backend="cpu")
+        try:
+            fast_kwargs = bench.LEGS["e2e_stream_stable_topology"][2]
+            result = bench.bench_e2e_stream_stable_topology(
+                **{**fast_kwargs, "trials": 2}
+            )
+        finally:
+            bench._LEDGER.close()
+            bench._LEDGER = old
+        records = read_ledger(ledger_path)
+        for variant in ("no_reuse", "reuse"):
+            band = min_of_repeats(
+                records, f"e2e_stream_stable_topology.{variant}"
+            )
+            assert band is not None and band["n"] == 2
+            assert band["unit"] == "s"
+            lo, hi = result[variant]["wall_s_band"]
+            assert band["min"] == pytest.approx(lo, abs=0.01)
+            assert band["max"] == pytest.approx(hi, abs=0.01)
+        # Every repeat carried its pre-run loadavg for attribution.
+        assert all(
+            "loadavg_1m_before" in r["extras"] for r in records
+        )
+
+    def test_all_stream_legs_take_trials(self):
+        import inspect
+
+        for leg in ("e2e_stream", "e2e_stream_stable_topology",
+                    "e2e_stream_delta", "e2e_stream_resident"):
+            fn = bench.LEGS[leg][0]
+            assert "trials" in inspect.signature(fn).parameters, leg
+
+
+class TestDryrunMultichipLeg:
+    """The scaled virtual-mesh leg (VERDICT r5 #3): the north-star band
+    over 8 virtual devices with a REAL psum epilogue, parity-asserted
+    inside the leg itself. The full 8 × 16k × 10k shape runs in
+    tests/test_multichip_scale.py (slow) and as the production leg; the
+    --fast shape smoke-tests the same code path here."""
+
+    def test_fast_leg_runs_scaled_band_with_real_psum(self):
+        result = bench.run_leg_inprocess("dryrun_multichip", fast=True)
+        assert result["devices"] == 8
+        assert result["mesh_shape"] == [4, 2]
+        assert result["psum_replica_groups"].startswith("real")
+        assert result["step_ms"] > 0
+        assert result["parity"].startswith("allclose")
+        assert result["ring_tiebreak_ms"] > 0
+        json.dumps(result)
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "dryrun_multichip" in bench.LEGS
+        assert "dryrun_multichip" in bench.DEVICE_LEG_ORDER

@@ -24,7 +24,9 @@ from bayesian_consensus_engine_tpu.pipeline import (
     PlanPrefetcher,
     build_settlement_plan,
     settle,
+    settle_stream,
 )
+from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
 from bayesian_consensus_engine_tpu.state.records import ReliabilityRecord
 from bayesian_consensus_engine_tpu.state.tensor_store import TensorReliabilityStore
 
@@ -1523,3 +1525,293 @@ class TestSettleStreamSharded:
             session.settle(outcomes, steps=1, now=21_130.0 + b)
             loops.append(session._loop)
         assert loops[0] is loops[1]
+
+
+class TestResidentSessionStream:
+    """settle_stream(mesh=...) round 7: ONE long-lived session across
+    batches. The persistent-session stream must be byte-identical to the
+    per-batch-session stream (``resident_session=False``) and to the flat
+    stream on a markets-only mesh — results, store state, journal epochs,
+    and SQLite checkpoint bytes — across topology hits (refresh), drift
+    (adopt relayout), and capacity-ladder growth; and the crash contract
+    (restart from ``batches[len(stats):]`` with a fresh session) must
+    survive unchanged."""
+
+    def _mixed_batches(self):
+        """Hits, drift, and growth in one stream: two stable-topology
+        batches (the refresh steady state), two batches of a DRIFTED
+        topology overlapping the first (adopt relayout with rows staying,
+        entering, and leaving), and one batch of fresh markets large
+        enough to run the store up its capacity ladder."""
+        stable = stable_topology_batches(num_batches=2, seed=47)
+        drifted = stable_topology_batches(
+            num_batches=2, markets=40, universe=30, seed=47
+        )
+        rng = random.Random(5)
+        growth = [(
+            random_payloads(rng, 60, universe=40, tag="-grow"),
+            [rng.random() < 0.5 for _ in range(60)],
+        )]
+        return stable + drifted + growth
+
+    @staticmethod
+    def _journal_epochs_sans_clock(path):
+        """Decoded epoch frames with the wall-clock field masked: the
+        byte-for-byte comparable content of a journal (``wall_unix_ts``
+        — and the CRC covering it — legitimately differ between two
+        runs of identical work)."""
+        import struct
+
+        blob = path.read_bytes()
+        assert blob[:8] == b"BCEJRNL1"
+        hdr = struct.Struct("<QQQQQdQ")
+        off = 8
+        epochs = []
+        while off < len(blob):
+            fields = hdr.unpack_from(blob, off)
+            (epoch_index, used_after, pair_len, dirty, iso_len,
+             _wall_ts, tag) = fields
+            payload_len = pair_len + 33 * dirty + iso_len
+            start = off + hdr.size
+            epochs.append((
+                (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+                blob[start:start + payload_len],
+            ))
+            off = start + payload_len + 4  # + crc32
+        return epochs
+
+    def _stream(self, batches, tmp_path, name, resident, mesh,
+                journal=True, stats=None, now=21_300.0):
+        from bayesian_consensus_engine_tpu.state.journal import JournalWriter
+
+        store = TensorReliabilityStore()
+        db = tmp_path / f"{name}.db"
+        jrnl = tmp_path / f"{name}.jrnl"
+        results = list(
+            settle_stream(
+                store, batches, steps=2, now=now, db_path=db,
+                checkpoint_every=2, stats=stats, reuse_plans=True,
+                mesh=mesh, resident_session=resident,
+                journal=JournalWriter(jrnl) if journal else None,
+            )
+        )
+        store.sync()
+        return store, results, db, jrnl
+
+    def test_persistent_equals_per_batch_and_flat_bytes(self, tmp_path):
+        batches = self._mixed_batches()
+        mesh = make_mesh()  # markets-only: the bit-exact regime
+        on_stats, off_stats = [], []
+        s_on, r_on, db_on, j_on = self._stream(
+            batches, tmp_path, "on", True, mesh, stats=on_stats
+        )
+        s_off, r_off, db_off, j_off = self._stream(
+            batches, tmp_path, "off", False, mesh, stats=off_stats
+        )
+        s_flat, r_flat, db_flat, j_flat = self._stream(
+            batches, tmp_path, "flat", True, None
+        )
+        # The session was served resident: one start, hits refresh, drift
+        # and growth adopt WITHOUT teardown.
+        assert [s["session_adopt"] for s in on_stats] == [
+            "start", "refresh", "relayout", "refresh", "relayout",
+        ]
+        assert [s["session_adopt"] for s in off_stats] == [None] * 5
+        for mine, ref, flat in zip(r_on, r_off, r_flat):
+            assert mine.market_keys == ref.market_keys
+            np.testing.assert_array_equal(
+                np.asarray(mine.consensus), np.asarray(ref.consensus)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(mine.consensus), np.asarray(flat.consensus)
+            )
+        assert s_on.list_sources() == s_off.list_sources()
+        assert s_on.list_sources() == s_flat.list_sources()
+        assert db_on.read_bytes() == db_off.read_bytes()
+        assert db_on.read_bytes() == db_flat.read_bytes()
+        # Journal EPOCH BYTES: same cadence, same dirty rows, same frame
+        # payloads (the wall-clock stamp each epoch carries is the one
+        # legitimately run-varying field — masked by the helper).
+        epochs_on = self._journal_epochs_sans_clock(j_on)
+        assert epochs_on == self._journal_epochs_sans_clock(j_off)
+        assert epochs_on == self._journal_epochs_sans_clock(j_flat)
+
+    def test_relayout_never_rebuilds_from_host(self, tmp_path, monkeypatch):
+        """The drift batches must be served by the device relayout, not a
+        host-state rebuild: ``_build_state`` runs exactly once (batch 0)
+        even though the drifted topology OVERLAPS the session's rows."""
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+        )
+
+        builds = []
+        real_build = ShardedSettlementSession._build_state
+
+        def counting_build(self, epoch0):
+            builds.append(epoch0)
+            return real_build(self, epoch0)
+
+        monkeypatch.setattr(
+            ShardedSettlementSession, "_build_state", counting_build
+        )
+        stats = []
+        self._stream(
+            self._mixed_batches(), tmp_path, "count", True, make_mesh(),
+            journal=False, stats=stats,
+        )
+        assert len(builds) == 1
+        assert [s["session_adopt"] for s in stats] == [
+            "start", "refresh", "relayout", "refresh", "relayout",
+        ]
+
+    def test_resident_counters_and_adopt_phase(self, tmp_path):
+        from bayesian_consensus_engine_tpu import obs
+        from bayesian_consensus_engine_tpu.obs.timeline import (
+            PhaseTimeline,
+            recording,
+        )
+
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        timeline = PhaseTimeline()
+        try:
+            stats = []
+            with recording(timeline):
+                self._stream(
+                    self._mixed_batches(), tmp_path, "obs", True,
+                    make_mesh(), journal=False, stats=stats,
+                )
+        finally:
+            obs.set_metrics_registry(previous)
+        export = registry.export()
+        assert export["counters"]["stream.session_adopts"] == 2
+        # Last batch's active set: 60 fresh markets' rows.
+        assert export["gauges"]["stream.resident_rows"] > 0
+        # The adopt cost lands in the new canonical phase, inside the
+        # additive per-batch breakdown, on exactly the adopting batches.
+        adopted = [s["session_adopt"] == "relayout" for s in stats]
+        recorded = ["state_adopt" in s.get("phases", {}) for s in stats]
+        assert recorded == adopted
+        assert timeline.totals().get("state_adopt", 0.0) > 0.0
+
+    def test_crash_resume_with_fresh_session(self, tmp_path, monkeypatch):
+        """Kill the resident stream mid-flight (a failing journal epoch
+        write), restart from ``batches[len(stats):]`` with a fresh
+        session on the same store: the final store, the journal's
+        replayed state, and a full SQLite export must equal the
+        uninterrupted run's."""
+        from bayesian_consensus_engine_tpu.state.journal import (
+            JournalWriter,
+            replay_journal,
+        )
+
+        batches = self._mixed_batches()
+        mesh = make_mesh()
+        ref_store, _, _, _ = self._stream(
+            batches, tmp_path, "uninterrupted", True, mesh
+        )
+
+        store = TensorReliabilityStore()
+        jrnl = tmp_path / "crash.jrnl"
+        real_flush = TensorReliabilityStore.flush_to_journal_async
+        calls = {"n": 0}
+
+        def broken_second(self, journal, tag=0):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("journal disk gone")
+            return real_flush(self, journal, tag=tag)
+
+        monkeypatch.setattr(
+            TensorReliabilityStore, "flush_to_journal_async", broken_second
+        )
+        stats: list = []
+        writer = JournalWriter(jrnl)
+        with pytest.raises(RuntimeError, match="journal disk gone"):
+            for _result in settle_stream(
+                store, batches, steps=2, now=21_300.0,
+                checkpoint_every=2, stats=stats, reuse_plans=True,
+                mesh=mesh, journal=writer,
+            ):
+                pass
+        writer.close()
+        monkeypatch.setattr(
+            TensorReliabilityStore, "flush_to_journal_async", real_flush
+        )
+        settled = len(stats)
+        assert 0 < settled < len(batches)
+        # Restart: same store, FRESH session (settle_stream builds one),
+        # the documented resume point, now advanced by the settled count.
+        resume_stats: list = []
+        for _result in settle_stream(
+            store, batches[settled:], steps=2, now=21_300.0 + settled,
+            checkpoint_every=2, stats=resume_stats, reuse_plans=True,
+            mesh=mesh, journal=JournalWriter(jrnl, resume=True),
+        ):
+            pass
+        store.sync()
+        assert resume_stats[0]["session_adopt"] == "start"
+        assert store.list_sources() == ref_store.list_sources()
+        # Journal: replaying the crashed-then-resumed journal rebuilds the
+        # same live state (epoch tags restart with the resumed stream, so
+        # byte-equality is not the contract here — replayed STATE is).
+        replayed, _tag = replay_journal(jrnl)
+        replayed.sync()
+        assert replayed.list_sources() == store.list_sources()
+        # SQLite: a fresh full export of each final store, byte-compared.
+        (tmp_path / "resumed_full.db").unlink(missing_ok=True)
+        store.flush_to_sqlite(tmp_path / "resumed_full.db")
+        ref_store.flush_to_sqlite(tmp_path / "ref_full.db")
+        assert (tmp_path / "resumed_full.db").read_bytes() == (
+            tmp_path / "ref_full.db"
+        ).read_bytes()
+
+    def test_two_d_mesh_resident_drift_matches_flat_to_ulp(self, tmp_path):
+        """The adopt relayout under a sources-sharded mesh: the resident
+        stream's psum re-association stays within the documented ulp
+        envelope of the flat stream across drift batches."""
+        batches = self._mixed_batches()
+        s_mesh, r_mesh, _, _ = self._stream(
+            batches, tmp_path, "2d", True, make_mesh((4, 2)), journal=False
+        )
+        s_flat, r_flat, _, _ = self._stream(
+            batches, tmp_path, "2dflat", True, None, journal=False
+        )
+        for mine, ref in zip(r_mesh, r_flat):
+            np.testing.assert_allclose(
+                np.asarray(mine.consensus), np.asarray(ref.consensus),
+                rtol=2e-6, atol=1e-7,
+            )
+        mine, theirs = s_mesh.list_sources(), s_flat.list_sources()
+        assert len(mine) == len(theirs) > 0
+        for a, b in zip(mine, theirs):
+            assert (a.source_id, a.market_id) == (b.source_id, b.market_id)
+            assert abs(a.reliability - b.reliability) < 1e-6
+            assert a.confidence == b.confidence
+            assert a.updated_at == b.updated_at
+
+    def test_per_batch_flag_still_available_for_ab(self, tmp_path):
+        """resident_session=False is the A/B lever the bench leg uses —
+        it must keep the legacy per-batch behaviour observable (a
+        session build per batch)."""
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+        )
+
+        batches = self._mixed_batches()[:3]
+        builds = []
+        real_init = ShardedSettlementSession.__init__
+
+        def counting_init(self, *args, **kwargs):
+            builds.append(1)
+            return real_init(self, *args, **kwargs)
+
+        import unittest.mock as mock
+
+        with mock.patch.object(
+            ShardedSettlementSession, "__init__", counting_init
+        ):
+            self._stream(
+                batches, tmp_path, "ab", False, make_mesh(), journal=False
+            )
+        assert len(builds) == len(batches)
