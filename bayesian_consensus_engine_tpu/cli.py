@@ -215,8 +215,11 @@ def _run_stats(args: argparse.Namespace) -> None:
     The reading half of the min-of-N discipline: every bench/soak capture
     appends ledger records (``bench.py --ledger``, obs/ledger.py); this
     subcommand folds one file into per-leg min/max bands with the host
-    load that attributes the spread. ``--json`` emits the machine-shaped
-    summary instead of the table.
+    load that attributes the spread. Legs whose records carry per-request
+    latency distributions (``extras.latency_hist`` — the serving bench)
+    additionally render ``p50``/``p99`` columns, merged across repeats
+    through the shared log-bucket quantile rule. ``--json`` emits the
+    machine-shaped summary instead of the table.
 
     ``--against OLD.jsonl`` switches to cross-round diffing: each leg's
     band is compared against the old ledger's and flagged when the bands

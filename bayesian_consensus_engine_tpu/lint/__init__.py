@@ -44,6 +44,7 @@ from bayesian_consensus_engine_tpu.lint import (  # noqa: F401
     rules_jax,
     rules_layering,
     rules_pyflakes,
+    rules_sharding,
 )
 
 __all__ = [

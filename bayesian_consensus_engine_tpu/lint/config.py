@@ -41,7 +41,11 @@ LAYERS: dict[str, int] = {
     "state": 3,
     "models": 4,
     "parallel": 5,
+    # pipeline and serve share a layer: settle_stream runs on the serve
+    # layer's SessionDriver while serve's coalescer builds plans through
+    # pipeline — one orchestration tier, two faces (batch and online).
     "pipeline": 6,
+    "serve": 6,
     "cli": 7,
     # The root facade re-exports for users; nothing inside imports it.
     "__init__": 99,
@@ -64,7 +68,7 @@ LAYER_IMPORT_OVERRIDES: dict[str, frozenset[str]] = {
 #: from a host sync. bench/scripts/tests live outside the package and
 #: are unconstrained.
 OBS_ALLOWED_IMPORTERS: frozenset[str] = frozenset(
-    {"obs", "pipeline", "state", "cli", "__init__"}
+    {"obs", "pipeline", "serve", "state", "cli", "__init__"}
 )
 
 #: Deliberate exceptions to the layer map: (importer_segment,

@@ -1,0 +1,113 @@
+"""SH4xx — sharding-annotation rules for the ``parallel/`` modules.
+
+A ``PartitionSpec`` naming an axis the mesh does not have fails in two
+ways, both worse than a crash: jax raises at ``NamedSharding``
+construction only when the spec is actually bound (a rarely-taken branch
+ships broken), and a TYPO'd-but-absent annotation in a ``shard_map``
+in_spec silently replicates the operand — a 2-D mesh then runs the
+markets axis un-sharded at full memory per device, visible only as an OOM
+or a flat scaling curve. The mesh axis vocabulary is two constants
+(``parallel/mesh.py``: ``MARKETS_AXIS``/``SOURCES_AXIS``), so the checker
+is exact: every ``PartitionSpec(...)`` argument must resolve to one of
+them (or the literal axis names they are pinned to), ``None``, or a tuple
+of those — anything else is a spec no mesh in this repo can satisfy.
+"""
+
+from __future__ import annotations
+
+import ast
+from functools import partial
+
+from bayesian_consensus_engine_tpu.lint import config
+from bayesian_consensus_engine_tpu.lint.registry import rule
+
+_parallel = partial(
+    config.matches, prefixes=(f"{config.PACKAGE}/parallel/",)
+)
+
+#: The repo's real mesh axes — the names ``make_mesh`` constructs
+#: (parallel/mesh.py) — and the constants pinned to them. The literal
+#: strings are accepted so mesh.py's own definitions (and a doc example)
+#: pass; everywhere else the constants are the idiom.
+_AXIS_CONSTANTS = frozenset({"MARKETS_AXIS", "SOURCES_AXIS"})
+_AXIS_LITERALS = frozenset({"markets", "sources"})
+
+#: Dotted origins that construct a PartitionSpec, post-alias-resolution
+#: (``from jax.sharding import PartitionSpec as P`` → ``P`` resolves).
+_SPEC_ORIGINS = (
+    "jax.sharding.PartitionSpec",
+    "jax.experimental.pjit.PartitionSpec",
+)
+
+
+def _is_partition_spec(ctx, node: ast.AST) -> bool:
+    dotted = ctx.dotted(node)
+    return dotted is not None and (
+        dotted in _SPEC_ORIGINS or dotted.endswith(".PartitionSpec")
+    )
+
+
+def _axis_problem(entry: ast.AST):
+    """The offending description for one spec argument, or None if legal.
+
+    Legal entries: ``None``, an axis constant name (``MARKETS_AXIS`` /
+    ``SOURCES_AXIS``, possibly attribute-qualified), one of the literal
+    axis strings, or a tuple of legal entries (a multi-axis dimension).
+    """
+    if isinstance(entry, ast.Constant):
+        if entry.value is None:
+            return None
+        if isinstance(entry.value, str):
+            if entry.value in _AXIS_LITERALS:
+                return None
+            return f"string {entry.value!r} is not a mesh axis"
+        return f"constant {entry.value!r} is not a mesh axis"
+    if isinstance(entry, ast.Name):
+        if entry.id in _AXIS_CONSTANTS:
+            return None
+        return f"name `{entry.id}` is not a mesh-axis constant"
+    if isinstance(entry, ast.Attribute):
+        if entry.attr in _AXIS_CONSTANTS:
+            return None
+        return f"attribute `{entry.attr}` is not a mesh-axis constant"
+    if isinstance(entry, ast.Tuple):
+        for element in entry.elts:
+            problem = _axis_problem(element)
+            if problem is not None:
+                return problem
+        return None
+    if isinstance(entry, ast.Starred):
+        return _axis_problem(entry.value)
+    # Anything computed (a variable, a call result) cannot be verified
+    # statically; the repo's idiom is the constants, so flag it.
+    return "computed axis expression cannot be checked against the mesh"
+
+
+@rule(
+    "SH401",
+    name="partition-spec-axis",
+    rationale=(
+        "a PartitionSpec axis the mesh does not define either raises at "
+        "sharding construction (only when the branch is taken) or — in a "
+        "shard_map in_spec — silently replicates the operand at full "
+        "memory per device; specs must name the real mesh axes "
+        "(parallel/mesh.py MARKETS_AXIS/SOURCES_AXIS)"
+    ),
+    scope=_parallel,
+    tags=("sharding",),
+)
+def check_partition_spec_axes(ctx):
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and _is_partition_spec(ctx, node.func)
+        ):
+            continue
+        for arg in node.args:
+            problem = _axis_problem(arg)
+            if problem is not None:
+                yield node.lineno, (
+                    f"PartitionSpec axis not in the mesh vocabulary: "
+                    f"{problem} (use MARKETS_AXIS/SOURCES_AXIS from "
+                    "parallel.mesh)"
+                )

@@ -37,6 +37,7 @@ from bayesian_consensus_engine_tpu.obs.metrics import (
     NULL_REGISTRY,
     log_spaced_bounds,
     metrics_registry,
+    quantile_from_snapshot,
     set_metrics_registry,
 )
 from bayesian_consensus_engine_tpu.obs.timeline import (
@@ -63,6 +64,7 @@ __all__ = [
     "log_spaced_bounds",
     "metrics_registry",
     "min_of_repeats",
+    "quantile_from_snapshot",
     "read_ledger",
     "recording",
     "render_diff",

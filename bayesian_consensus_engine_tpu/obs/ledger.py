@@ -184,7 +184,7 @@ def min_of_repeats(
     if not values:
         return None
     lo, hi = min(values), max(values)
-    return {
+    band = {
         "leg": leg,
         "n": len(values),
         "min": lo,
@@ -194,6 +194,55 @@ def min_of_repeats(
         "loadavg_1m_range": (
             [min(loads), max(loads)] if loads else None
         ),
+    }
+    band.update(_latency_quantiles(records, leg))
+    return band
+
+
+def _latency_quantiles(
+    records: List[Dict[str, object]], leg: str
+) -> Dict[str, object]:
+    """p50/p99 over a leg's per-request latency distributions.
+
+    Records that carry ``extras["latency_hist"]`` (a
+    :meth:`~.metrics.Histogram.snapshot` dict — the serving bench's
+    per-request record) are MERGED across repeats by summing bucket
+    counts (legal only for identical bounds; a layout mismatch raises —
+    the layout is part of the schema), then folded into p50/p99 via the
+    shared bucket interpolation. Legs without latency records contribute
+    nothing — the keys stay absent so the stats table renders dashes.
+    """
+    from bayesian_consensus_engine_tpu.obs.metrics import (
+        quantile_from_snapshot,
+    )
+
+    merged_bounds = None
+    merged_counts: List[int] = []
+    for rec in records:
+        if rec.get("leg") != leg:
+            continue
+        hist = (rec.get("extras") or {}).get("latency_hist")
+        if not isinstance(hist, dict):
+            continue
+        bounds = list(hist.get("bounds") or [])
+        counts = list(hist.get("counts") or [])
+        if merged_bounds is None:
+            merged_bounds, merged_counts = bounds, counts
+        else:
+            if bounds != merged_bounds:
+                raise ValueError(
+                    f"leg {leg!r}: latency_hist bucket layouts differ "
+                    "across records — cannot merge repeats"
+                )
+            merged_counts = [
+                a + b for a, b in zip(merged_counts, counts)
+            ]
+    if merged_bounds is None:
+        return {}
+    snap = {"bounds": merged_bounds, "counts": merged_counts}
+    return {
+        "p50": quantile_from_snapshot(snap, 0.5),
+        "p99": quantile_from_snapshot(snap, 0.99),
     }
 
 
@@ -292,13 +341,18 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
 
 
 def render(records: List[Dict[str, object]]) -> str:
-    """Human-readable per-leg table for ``bce-tpu stats``."""
+    """Human-readable per-leg table for ``bce-tpu stats``.
+
+    The ``p50``/``p99`` columns render for legs whose records carry
+    per-request latency distributions (``extras.latency_hist`` — the
+    serving bench); every other leg shows dashes.
+    """
     summary = summarize(records)
     if not summary:
         return "empty ledger"
     lines = [
         f"{'leg':<34} {'n':>3} {'min':>12} {'max':>12} "
-        f"{'spread':>7} {'load(1m)':>12} unit"
+        f"{'spread':>7} {'p50':>9} {'p99':>9} {'load(1m)':>12} unit"
     ]
     for leg, band in summary.items():
 
@@ -318,7 +372,8 @@ def render(records: List[Dict[str, object]]) -> str:
         )
         lines.append(
             f"{leg:<34} {band['n']:>3} {num(band['min']):>12} "
-            f"{num(band['max']):>12} {spread:>7} {load:>12} "
-            f"{band['unit'] or '-'}"
+            f"{num(band['max']):>12} {spread:>7} "
+            f"{num(band.get('p50')):>9} {num(band.get('p99')):>9} "
+            f"{load:>12} {band['unit'] or '-'}"
         )
     return "\n".join(lines)

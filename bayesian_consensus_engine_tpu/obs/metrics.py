@@ -152,6 +152,64 @@ class Histogram:
                 "sum": self._sum,
             }
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile estimate (see
+        :func:`quantile_from_snapshot`); ``None`` before any observation."""
+        return quantile_from_snapshot(self.snapshot(), q)
+
+    def summary(
+        self, quantiles: Sequence[float] = (0.5, 0.99)
+    ) -> Dict[str, object]:
+        """``{"count", "sum", "p50", "p99", ...}`` — the latency digest a
+        load report quotes. Quantile keys are ``p`` + the percentile with
+        any fractional digits retained (``0.999`` → ``"p99.9"``)."""
+        snap = self.snapshot()
+        out: Dict[str, object] = {"count": snap["count"], "sum": snap["sum"]}
+        for q in quantiles:
+            out[f"p{q * 100:g}"] = quantile_from_snapshot(snap, q)
+        return out
+
+
+def quantile_from_snapshot(
+    snapshot: Dict[str, object], q: float
+) -> Optional[float]:
+    """Quantile *q* of a :meth:`Histogram.snapshot`-shaped dict.
+
+    The standard bucket interpolation (what Prometheus'
+    ``histogram_quantile`` computes): find the bucket where the
+    cumulative count crosses ``q * count`` and interpolate linearly
+    between its lower and upper edges (the first bucket's lower edge is
+    0). A rank landing exactly on a bucket's cumulative count returns
+    that bucket's UPPER edge exactly — the log-spaced layout makes every
+    published quantile reproducible from counts alone, with resolution
+    bounded by the bucket width (½ decade at the default layout). Ranks
+    in the overflow bucket clamp to the last finite edge (reported as a
+    lower bound, never an invented value). ``None`` when the histogram
+    is empty. Works on merged snapshots too — sum the ``counts`` of
+    same-``bounds`` histograms first (the ledger's cross-repeat path).
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1]; got {q}")
+    bounds = list(snapshot["bounds"])
+    counts = list(snapshot["counts"])
+    total = sum(counts)
+    if total == 0:
+        return None
+    target = q * total
+    cumulative = 0.0
+    for i, bucket_count in enumerate(counts):
+        if bucket_count == 0:
+            continue
+        if cumulative + bucket_count >= target:
+            if i >= len(bounds):
+                return bounds[-1] if bounds else None  # overflow: clamp
+            lower = bounds[i - 1] if i > 0 else 0.0
+            upper = bounds[i]
+            fraction = (target - cumulative) / bucket_count
+            return lower + (upper - lower) * min(max(fraction, 0.0), 1.0)
+        cumulative += bucket_count
+    return bounds[-1] if bounds else None
+
 
 class MetricsRegistry:
     """Named metric namespace with deterministic export.
@@ -229,6 +287,17 @@ class _NullMetric:
 
     def observe(self, value: float) -> None:
         pass
+
+    def quantile(self, q: float) -> None:
+        return None
+
+    def summary(
+        self, quantiles: Sequence[float] = (0.5, 0.99)
+    ) -> Dict[str, object]:
+        out: Dict[str, object] = {"count": 0, "sum": 0.0}
+        for q in quantiles:
+            out[f"p{q * 100:g}"] = None
+        return out
 
     @property
     def value(self) -> int:

@@ -1878,6 +1878,13 @@ def settle_stream(
 
         journal = JournalWriter(journal)
         owns_journal = True
+    # The loop body — session lifecycle, checkpoint cadence, exit contract
+    # — is the serve-layer SessionDriver (round 8): this stream and the
+    # online coalescing front end drive the same object, which is what
+    # makes their byte-equality structural. Lazy import: serve sits at the
+    # same layer and imports this module at its top level.
+    from bayesian_consensus_engine_tpu.serve.driver import SessionDriver
+
     outcome_queue: "deque" = _collections.deque()
 
     def payload_stream():
@@ -1895,17 +1902,20 @@ def settle_stream(
     reuse_hit_counter = registry.counter("stream.plan_reuse_hits")
     reuse_miss_counter = registry.counter("stream.plan_reuse_misses")
     dispatch_hist = registry.histogram("stream.settle_dispatch_s")
-    adopts_counter = registry.counter("stream.session_adopts")
-    resident_gauge = registry.gauge("stream.resident_rows")
 
-    handle = None
-    journal_handle = None
-    session = None  # the mesh path's long-lived resident session
-    session_band = None
-    flushed_through = -1
-    journaled_through = -1
-    settled_through = -1
-    journal_write_failed = False
+    driver = SessionDriver(
+        store,
+        steps=steps,
+        mesh=mesh,
+        dtype=dtype,
+        resident_session=resident_session,
+        journal=journal,
+        owns_journal=owns_journal,
+        db_path=db_path,
+        checkpoint_every=checkpoint_every,
+        sync_checkpoints=sync_checkpoints,
+        lazy_checkpoints=lazy_checkpoints,
+    )
     index = -1
     try:
         with PlanPrefetcher(
@@ -1934,54 +1944,13 @@ def settle_stream(
                 plan_reused = (
                     getattr(plan, "_refreshed_from", None) is not None
                 )
-                session_adopt = None
+                batch_band = band(index) if callable(band) else band
                 settle_start = _time.perf_counter()
-                if mesh is None:
-                    result = settle(
-                        store, plan, outcomes, steps=steps, now=batch_now,
-                        dtype=dtype,
-                    )
-                elif not resident_session:
-                    # LEGACY per-batch session (A/B benches + tests),
-                    # abandoned without close: the settle registered the
-                    # store's merge recipe, and closing here would sync it
-                    # eagerly — serialising the device→host gather against
-                    # this thread. Left pending, the NEXT batch's state
-                    # build (or the checkpoint flush) resolves it instead.
-                    batch_band = band(index) if callable(band) else band
-                    result = ShardedSettlementSession(
-                        store, plan, mesh, dtype=dtype, band=batch_band
-                    ).settle(outcomes, steps=steps, now=batch_now)
-                else:
-                    # ONE resident session across batches: a topology hit
-                    # uploads only the probs block, a miss adopts the new
-                    # plan with the block held in HBM (never closed
-                    # mid-stream — the standing recipe resolves at the
-                    # next checkpoint/overlap exactly like the per-batch
-                    # shape's deferred gathers; a crash restart simply
-                    # builds a fresh session from batches[len(stats):]).
-                    batch_band = band(index) if callable(band) else band
-                    if session is None or batch_band != session_band:
-                        if session is not None:
-                            # The replaced session's standing gather is no
-                            # longer session-pinned: let its bytes count
-                            # against the deferral budget again.
-                            session._release_standing()
-                        session = ShardedSettlementSession(
-                            store, plan, mesh, dtype=dtype, band=batch_band
-                        )
-                        session_band = batch_band
-                        session_adopt = "start"
-                    else:
-                        session_adopt = session.adopt(plan, band=batch_band)
-                        if session_adopt != "refresh":
-                            adopts_counter.inc()
-                    resident_gauge.set(float(session._touched.size))
-                    result = session.settle(
-                        outcomes, steps=steps, now=batch_now
-                    )
+                result = driver.dispatch(
+                    plan, outcomes, now=batch_now, band=batch_band
+                )
+                session_adopt = driver.last_adopt
                 settle_dispatch_s = _time.perf_counter() - settle_start
-                settled_through = index
                 batches_counter.inc()
                 (reuse_hit_counter if plan_reused
                  else reuse_miss_counter).inc()
@@ -2003,49 +1972,14 @@ def settle_stream(
                             "session_adopt": session_adopt,
                         }
                     )
-                due = (index + 1) % checkpoint_every == 0
-                if journal is not None and due:
-                    # Rolling durability rides the journal (one binary
-                    # epoch, tag = this settled batch); SQLite is the
-                    # tail flush's job. Async mode (the default) pins the
-                    # epoch's content here but backgrounds the write —
-                    # the fsync overlaps the next batches, and the
-                    # PREVIOUS epoch's completion (or failure) surfaces
-                    # at the join inside this call (journal_async_wait).
-                    # A failed epoch write is flagged so the exit tail
-                    # flush does not retry the same broken journal and
-                    # shadow this error.
-                    checkpoint_start = _time.perf_counter()
-                    try:
-                        with timeline.span("checkpoint"):
-                            if sync_checkpoints:
-                                store.flush_to_journal(journal, tag=index)
-                            else:
-                                journal_handle = store.flush_to_journal_async(
-                                    journal, tag=index
-                                )
-                    except BaseException:
-                        journal_write_failed = True
-                        raise
-                    journaled_through = index
-                    if stats is not None:
-                        stats[-1]["checkpoint_s"] = (
-                            _time.perf_counter() - checkpoint_start
-                        )
-                elif db_path is not None and due:
-                    # Joins any in-flight write first (flushes serialise), so
-                    # a prior background failure surfaces here, not silently.
-                    checkpoint_start = _time.perf_counter()
-                    with timeline.span("checkpoint"):
-                        handle = store.flush_to_sqlite_async(
-                            db_path, resolve_pending=not lazy_checkpoints
-                        )
-                    if stats is not None:
-                        stats[-1]["checkpoint_s"] = (
-                            _time.perf_counter() - checkpoint_start
-                        )
-                    if not lazy_checkpoints:
-                        flushed_through = index
+                # Rolling durability rides the driver: journal mode appends
+                # one epoch (tag = this settled batch; async by default —
+                # the PREVIOUS epoch's completion or failure surfaces at
+                # the join inside the call), SQLite mode backgrounds the
+                # rolling flush. ``None`` when this batch is off-cadence.
+                checkpoint_s = driver.checkpoint(index)
+                if checkpoint_s is not None and stats is not None:
+                    stats[-1]["checkpoint_s"] = checkpoint_s
                 if phase_mark is not None and stats is not None:
                     # The batch's additive phase breakdown (exclusive
                     # seconds per obs/timeline.PHASES name) — present only
@@ -2057,34 +1991,9 @@ def settle_stream(
                 yield result
     finally:
         # Runs on EVERY exit — exhaustion, a consumer break/close
-        # (GeneratorExit), or a batch error: the in-flight write is always
-        # joined (a background failure must never be dropped) and every
-        # fully settled batch reaches the checkpoint file. Tail epochs and
-        # flushes cover through ``settled_through`` only — a batch that
-        # RAISED mid-settle is never claimed as durable. When the loop is
-        # exiting BECAUSE a journal write failed, the tail epoch is
-        # skipped: retrying the broken journal here would raise again
-        # inside this finally and replace the original error (or turn a
-        # GeneratorExit close() into a RuntimeError) — the journal's
-        # durable point is simply the last epoch that landed.
-        try:
-            if journal is not None and not journal_write_failed:
-                if settled_through > journaled_through:
-                    # Joins any in-flight background epoch first, so the
-                    # tail epoch lands after (and surfaces any failure
-                    # of) the last cadence's write.
-                    store.flush_to_journal(journal, tag=settled_through)
-                elif journal_handle is not None:
-                    # Nothing new to journal, but the last cadence's
-                    # epoch may still be in flight: the stream must not
-                    # end before its durability (or failure) is known.
-                    with timeline.span("journal_async_wait"):
-                        journal_handle.result()
-        finally:
-            if owns_journal and journal is not None:
-                journal.close()
-            if db_path is not None and index >= 0:
-                if handle is not None:
-                    handle.result()
-                if flushed_through != index:
-                    store.flush_to_sqlite(db_path)  # batches since last flush
+        # (GeneratorExit), or a batch error: the driver's exit contract
+        # joins the in-flight write, covers every fully settled batch
+        # with a tail epoch/flush (never a batch that raised mid-settle),
+        # skips the tail epoch when the loop is exiting BECAUSE a journal
+        # write failed, closes an owned journal, and tail-flushes SQLite.
+        driver.finalize()

@@ -1,0 +1,51 @@
+"""serve/ — the online micro-batch coalescing front end (round 8).
+
+PR 5 made the device side a standing service (one long-lived
+:class:`~.pipeline.ShardedSettlementSession` with O(row-delta) adopt and
+probs-only refresh); this package is the request-facing layer over it —
+the continuous-batching discipline of modern model serving applied to
+settlement:
+
+* :mod:`~.serve.driver` — :class:`SessionDriver`, the
+  ``settle_stream`` loop body as a reusable drive-one-batch-over-a-
+  resident-session API (dispatch + durability cadence + exit contract),
+  and :class:`PlanCache`, the topology-fingerprint plan-reuse step for
+  caller-scheduled builds. ``settle_stream`` itself runs on the driver.
+* :mod:`~.serve.coalesce` — :class:`ConsensusService`, an asyncio
+  request layer that accepts per-market signal updates + outcome reports,
+  coalesces them into topology-stable micro-batches under a
+  max-delay/max-size window, and drives the session with per-request
+  latency accounting (enqueue→coalesce→dispatch→durable spans through
+  ``obs``).
+* :mod:`~.serve.admission` — bounded admission with an explicit overload
+  policy (reject-with-retry-after or shed-oldest) so queue growth — and
+  therefore p99 — stays bounded when offered load exceeds capacity.
+
+The serving path is byte-exact with ``settle_stream`` over the same
+coalesced batch sequence (results, store state, journal epoch payloads,
+SQLite bytes) because both drive the SAME ``SessionDriver`` — pinned by
+tests/test_serve.py.
+"""
+
+from bayesian_consensus_engine_tpu.serve.admission import (
+    AdmissionConfig,
+    Overloaded,
+    ServiceClosed,
+    ShedError,
+)
+from bayesian_consensus_engine_tpu.serve.coalesce import (
+    ConsensusService,
+    ServeResult,
+)
+from bayesian_consensus_engine_tpu.serve.driver import PlanCache, SessionDriver
+
+__all__ = [
+    "AdmissionConfig",
+    "ConsensusService",
+    "Overloaded",
+    "PlanCache",
+    "ServeResult",
+    "ServiceClosed",
+    "SessionDriver",
+    "ShedError",
+]

@@ -1,0 +1,134 @@
+"""Bounded admission for the serving front end: overload is a policy,
+not an accident.
+
+An online service without an admission bound has exactly one overload
+behavior — an unbounded queue whose p99 grows without limit until memory
+does (the goodput-under-load framing the fleet retrospectives in
+PAPERS.md treat as the metric that matters). This module makes the bound
+and the policy explicit:
+
+* :class:`AdmissionConfig` — the knobs: how many requests may be resident
+  in the coalescer at once (``max_pending``), what happens to the request
+  that would exceed it (``policy``), and the retry hint a rejection
+  carries (``retry_after_s``).
+* ``policy="reject"`` — the arriving request is refused with
+  :class:`Overloaded` (carrying ``retry_after_s``): the client sees
+  backpressure immediately, everything already admitted keeps its latency.
+  The right default for open-loop traffic.
+* ``policy="shed_oldest"`` — the OLDEST pending (not-yet-flushed) request
+  is dropped (its future fails with :class:`ShedError`) and the arriving
+  one is admitted: freshest-data-wins, for workloads where a newer signal
+  update supersedes the one still queued.
+
+The controller only decides and counts (``serve.admitted`` /
+``serve.rejected`` / ``serve.shed`` counters); the coalescer owns the
+queue it bounds. Deciding is O(1) and lock-free — admission sits on the
+submit path of every request.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
+
+_POLICIES = ("reject", "shed_oldest")
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-layer request failures."""
+
+
+class Overloaded(ServeError):
+    """The service is at ``max_pending`` and the policy is ``reject``.
+
+    ``retry_after_s`` is the client hint (the coalescer's flush cadence
+    is the natural scale: one window's worth of capacity frees up per
+    ``max_delay_s``); ``pending`` is the queue depth at rejection time.
+    """
+
+    def __init__(self, retry_after_s: float, pending: int) -> None:
+        super().__init__(
+            f"service overloaded ({pending} requests pending); "
+            f"retry after {retry_after_s:.3f}s"
+        )
+        self.retry_after_s = retry_after_s
+        self.pending = pending
+
+
+class ShedError(ServeError):
+    """This request was shed (dropped unsettled) under ``shed_oldest``."""
+
+
+class ServiceClosed(ServeError):
+    """Submitted after :meth:`ConsensusService.close` began draining."""
+
+
+@dataclass(frozen=True)
+class AdmissionConfig:
+    """Overload knobs for :class:`~.serve.coalesce.ConsensusService`.
+
+    ``max_pending`` bounds the requests resident in the SERVICE —
+    submitted and not yet settled, so it covers both the coalescer's open
+    windows and batches waiting on (or inside) the dispatch worker: when
+    settlement is the bottleneck the bound still holds and overload
+    surfaces as policy, not as an ever-deeper dispatch queue. ``policy``
+    is one of ``"reject"`` / ``"shed_oldest"``.
+    """
+
+    max_pending: int = 4096
+    policy: str = "reject"
+    retry_after_s: float = 0.05
+
+    def __post_init__(self) -> None:
+        if self.max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        if self.policy not in _POLICIES:
+            raise ValueError(
+                f"policy must be one of {_POLICIES}; got {self.policy!r}"
+            )
+        if self.retry_after_s < 0:
+            raise ValueError("retry_after_s must be >= 0")
+
+
+class AdmissionController:
+    """Decide accept/reject/shed for one arriving request.
+
+    :meth:`decide` returns ``"accept"`` (room below the bound),
+    ``"shed_oldest"`` (at the bound, shedding policy — the caller drops
+    its oldest pending request, fails that request's future with
+    :class:`ShedError`, and admits the arrival), or raises
+    :class:`Overloaded` (at the bound, reject policy). Counters land in
+    the process metrics registry; like all obs they are no-ops unless a
+    registry is enabled.
+    """
+
+    def __init__(self, config: AdmissionConfig) -> None:
+        self.config = config
+        registry = metrics_registry()
+        self._admitted = registry.counter("serve.admitted")
+        self._rejected = registry.counter("serve.rejected")
+        self._shed = registry.counter("serve.shed")
+
+    def decide(self, pending: int) -> str:
+        if pending < self.config.max_pending:
+            self._admitted.inc()
+            return "accept"
+        if self.config.policy == "reject":
+            self._rejected.inc()
+            raise Overloaded(self.config.retry_after_s, pending)
+        # The shed outcome is not counted here: the caller may find
+        # nothing left to shed (everything resident already dispatched)
+        # and degrade to rejection — it reports which actually happened
+        # via count_shed / count_degraded_reject, so the overload
+        # counters never claim a shed that did not occur.
+        return "shed_oldest"
+
+    def count_shed(self) -> None:
+        """A shed succeeded: the victim counts shed, the arrival admitted."""
+        self._shed.inc()
+        self._admitted.inc()
+
+    def count_degraded_reject(self) -> None:
+        """Nothing was sheddable: the arrival was rejected after all."""
+        self._rejected.inc()

@@ -1,0 +1,533 @@
+"""`ConsensusService` — online micro-batch coalescing over the resident
+settlement session.
+
+The request-facing layer the ROADMAP's "millions of users" north star was
+missing: callers submit per-market signal updates + outcome reports one
+at a time; the service coalesces them into topology-stable micro-batches
+and drives ONE long-lived device session through the same
+:class:`~.serve.driver.SessionDriver` that powers
+:func:`~.pipeline.settle_stream` — so the served path is byte-exact with
+the batch stream over the same coalesced batch sequence by construction
+(results, store state, journal epoch payloads, SQLite bytes; pinned by
+tests/test_serve.py).
+
+**Coalescing discipline.** Requests accumulate in an ordered list of open
+*windows*. A request joins the FIRST window that does not already hold
+its market and has room (duplicate market ids cannot share one settlement
+plan — two slots would race in the scatter — so a same-market successor
+opens/joins the next window; updates for one market therefore settle in
+submission order, one batch apart). A window flushes when it reaches
+``max_batch`` markets, when its oldest request has waited ``max_delay_s``,
+or on :meth:`drain`/:meth:`close`; windows always flush oldest-first, so
+the batch sequence — and every byte derived from it — is a deterministic
+function of the submission order (the "same trace, same bytes" contract).
+
+Steady traffic — the same market universe updating in the same order —
+re-creates identically composed windows, so consecutive batches share a
+topology fingerprint: the :class:`~.serve.driver.PlanCache` serves them
+with a probability-only refresh and the resident session uploads one
+probs block per batch (the plan-cache hit the bucketing exists to
+maximise). Drifted traffic (markets entering/leaving, source sets
+changing) misses the fingerprint once and pays one session ``adopt()`` —
+never a per-request rebuild.
+
+**Admission.** ``admission`` bounds the requests resident in the service
+(submitted, not yet settled). At the bound, ``policy="reject"`` refuses
+the arrival with :class:`~.serve.admission.Overloaded` (carrying the
+retry-after hint) and ``policy="shed_oldest"`` drops the oldest
+not-yet-flushed request in favour of the arrival (its future fails with
+:class:`~.serve.admission.ShedError`); with nothing left to shed (every
+resident request already dispatched) shedding degrades to rejection.
+Either way queue depth — and therefore p99 — stays bounded under
+overload.
+
+**Latency accounting.** Each request's life is recorded as four spans in
+the process metrics registry (log-spaced histograms, no-ops unless obs is
+enabled): ``serve.latency_enqueue_s`` (submit → admitted+placed),
+``serve.latency_coalesce_s`` (placed → window flushed),
+``serve.latency_dispatch_s`` (flushed → settled, including the wait for
+the dispatch worker — where backpressure surfaces),
+``serve.latency_durable_s`` (settled → covering journal epoch fsynced;
+journal mode only) and ``serve.latency_total_s`` (submit → durable, or →
+settled without a journal). ``Histogram.quantile`` turns them into the
+p50/p99 a load test quotes.
+
+**Threading.** All coalescing runs on the asyncio event loop thread;
+settlement runs on ONE dedicated worker thread (batches dispatch in flush
+order — the driver is single-driver by contract). The store underneath is
+thread-safe. Use as an async context manager, or call :meth:`close`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import time as _time
+from dataclasses import dataclass
+from typing import Any, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
+from bayesian_consensus_engine_tpu.serve.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    Overloaded,
+    ServiceClosed,
+    ShedError,
+)
+from bayesian_consensus_engine_tpu.serve.driver import PlanCache, SessionDriver
+
+Signal = Union[Mapping[str, Any], tuple]
+
+
+@dataclass(frozen=True)
+class ServeResult:
+    """What a settled request's future resolves to."""
+
+    market_id: str
+    consensus: float
+    batch_index: int
+
+
+class _Request:
+    __slots__ = (
+        "market_id", "source_ids", "probabilities", "outcome", "future",
+        "t_submit", "t_enqueued", "t_flush",
+    )
+
+    def __init__(self, market_id, source_ids, probabilities, outcome, future):
+        self.market_id = market_id
+        self.source_ids = source_ids
+        self.probabilities = probabilities
+        self.outcome = outcome
+        self.future = future
+        self.t_submit = 0.0
+        self.t_enqueued = 0.0
+        self.t_flush = 0.0
+
+
+class _Window:
+    """One open micro-batch: requests in submission order, markets unique."""
+
+    __slots__ = ("requests", "markets", "t_created")
+
+    def __init__(self, t_created: float) -> None:
+        self.requests: list[_Request] = []
+        self.markets: set[str] = set()
+        self.t_created = t_created
+
+
+def _normalise_signals(signals: Sequence[Signal]):
+    """Accept reference-payload dicts or (source_id, probability) pairs."""
+    source_ids: list[str] = []
+    probabilities: list[float] = []
+    for signal in signals:
+        if isinstance(signal, Mapping):
+            source_ids.append(signal["sourceId"])
+            probabilities.append(float(signal["probability"]))
+        else:
+            sid, prob = signal
+            source_ids.append(sid)
+            probabilities.append(float(prob))
+    return source_ids, probabilities
+
+
+class ConsensusService:
+    """Asyncio front end coalescing per-market requests into micro-batches.
+
+    One service instance owns one :class:`~.serve.driver.SessionDriver`
+    (and, under ``mesh=``, its long-lived resident session) plus the
+    durability cadence ``settle_stream`` would run on the same batches:
+    a journal epoch (or rolling SQLite flush) every *checkpoint_every*
+    batches and a tail flush on :meth:`close`, which always leaves a
+    journal on a JOINED (fsynced) epoch. ``now`` is the first batch's
+    settlement day, advancing one day per batch — ``None`` stamps wall
+    clock, exactly like the stream.
+
+    ``record_batches=True`` keeps every flushed batch (columnar columns +
+    outcomes) in :attr:`batch_log` — the replay artefact the byte-
+    exactness tests (and a crash post-mortem) feed back through
+    ``settle_stream``. Off by default: a long-running service must not
+    grow an unbounded log.
+    """
+
+    def __init__(
+        self,
+        store,
+        steps: int = 1,
+        now: Optional[float] = None,
+        mesh=None,
+        dtype=None,
+        journal=None,
+        db_path=None,
+        checkpoint_every: int = 1,
+        sync_checkpoints: bool = False,
+        num_slots: "int | str | None" = "bucket",
+        max_batch: int = 256,
+        max_delay_s: Optional[float] = 0.005,
+        admission: Optional[AdmissionConfig] = None,
+        record_batches: bool = False,
+    ) -> None:
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if max_delay_s is not None and max_delay_s < 0:
+            raise ValueError("max_delay_s must be >= 0 (or None)")
+        owns_journal = False
+        if journal is not None and not hasattr(journal, "append_epoch"):
+            from bayesian_consensus_engine_tpu.state.journal import (
+                JournalWriter,
+            )
+
+            journal = JournalWriter(journal)
+            owns_journal = True
+        self._store = store
+        self._now = now
+        self._max_batch = max_batch
+        self._max_delay_s = max_delay_s
+        self._record_batches = record_batches
+        self._plans = PlanCache(store, num_slots=num_slots)
+        self._driver = SessionDriver(
+            store,
+            steps=steps,
+            mesh=mesh,
+            dtype=dtype,
+            journal=journal,
+            owns_journal=owns_journal,
+            db_path=db_path,
+            checkpoint_every=checkpoint_every,
+            sync_checkpoints=sync_checkpoints,
+        )
+        self._journal_mode = journal is not None
+        self._admission = AdmissionController(
+            admission if admission is not None else AdmissionConfig()
+        )
+
+        self._windows: list[_Window] = []
+        self._resident = 0  # submitted and not yet settled (the bound)
+        self._next_batch = 0
+        self._timer: Optional[asyncio.TimerHandle] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._inflight: set = set()
+        self._closed = False
+        self._failure: Optional[BaseException] = None
+        #: requests settled but not yet covered by a joined journal epoch,
+        #: as (batch_index, [(request, t_settled)]). Worker-thread-only.
+        self._await_durable: list = []
+        self.batch_log: list = []
+
+        registry = metrics_registry()
+        self._requests_counter = registry.counter("serve.requests")
+        self._batches_counter = registry.counter("serve.batches")
+        self._pending_gauge = registry.gauge("serve.pending_requests")
+        self._hist_enqueue = registry.histogram("serve.latency_enqueue_s")
+        self._hist_coalesce = registry.histogram("serve.latency_coalesce_s")
+        self._hist_dispatch = registry.histogram("serve.latency_dispatch_s")
+        self._hist_durable = registry.histogram("serve.latency_durable_s")
+        self._hist_total = registry.histogram("serve.latency_total_s")
+
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="bce-serve-dispatch"
+        )
+
+    # -- submission (event-loop thread) --------------------------------------
+
+    @property
+    def settled_batches(self) -> int:
+        """Batches fully settled — the resume point after a crash
+        (``batch_log[settled_batches:]`` holds the unsettled tail)."""
+        return self._driver.settled_through + 1
+
+    @property
+    def pending_requests(self) -> int:
+        return self._resident
+
+    def submit(self, market_id: str, signals: Sequence[Signal],
+               outcome: bool) -> "asyncio.Future[ServeResult]":
+        """Enqueue one market's signal update + outcome report.
+
+        Returns an :class:`asyncio.Future` resolving to
+        :class:`ServeResult` once the request's micro-batch has settled
+        (and, in journal mode, been through its checkpoint cadence).
+        Raises :class:`~.serve.admission.Overloaded` at the admission
+        bound under the reject policy and :class:`ServiceClosed` after
+        :meth:`close` began. Must be called on the event-loop thread —
+        the coalescer is loop-owned state.
+        """
+        t_submit = _time.perf_counter()
+        if self._closed:
+            raise ServiceClosed("submit after close() began draining")
+        if self._failure is not None:
+            raise ServiceClosed(
+                f"service failed: {self._failure!r}"
+            ) from self._failure
+        if self._loop is None:
+            self._loop = asyncio.get_running_loop()
+        self._requests_counter.inc()
+        if self._admission.decide(self._resident) == "shed_oldest":
+            if self._shed_oldest():
+                self._admission.count_shed()
+            else:
+                # Everything resident is already dispatch-bound — nothing
+                # left to shed; degrade to rejection so the bound holds.
+                self._admission.count_degraded_reject()
+                raise Overloaded(
+                    self._admission.config.retry_after_s, self._resident
+                )
+        source_ids, probabilities = _normalise_signals(signals)
+        request = _Request(
+            market_id, source_ids, probabilities, bool(outcome),
+            self._loop.create_future(),
+        )
+        request.t_submit = t_submit
+        window = self._place(request)
+        self._resident += 1
+        self._pending_gauge.set(float(self._resident))
+        request.t_enqueued = _time.perf_counter()
+        self._hist_enqueue.observe(request.t_enqueued - t_submit)
+        # Size trigger: only the window this request joined can have
+        # newly filled (an O(1) check — scanning every open window would
+        # be O(windows) per submit on the hot-key path). When it fills,
+        # flush oldest-first up to and including it — usually it IS the
+        # oldest; under heavy duplicate traffic its underfull
+        # predecessors go out ahead of it so batches never overtake each
+        # other (flush order IS submission order).
+        if len(window.requests) >= self._max_batch:
+            while True:
+                oldest = self._windows[0]
+                self._flush_oldest()
+                if oldest is window:
+                    break
+        self._arm_timer()
+        return request.future
+
+    def _place(self, request: _Request) -> "_Window":
+        for window in self._windows:
+            if (
+                request.market_id not in window.markets
+                and len(window.requests) < self._max_batch
+            ):
+                window.requests.append(request)
+                window.markets.add(request.market_id)
+                return window
+        window = _Window(_time.perf_counter())
+        window.requests.append(request)
+        window.markets.add(request.market_id)
+        self._windows.append(window)
+        return window
+
+    def _shed_oldest(self) -> bool:
+        """Drop the oldest not-yet-flushed request; False when none."""
+        for window in self._windows:
+            if window.requests:
+                victim = window.requests.pop(0)
+                window.markets.discard(victim.market_id)
+                if not window.requests:
+                    self._windows.remove(window)
+                self._resident -= 1
+                self._pending_gauge.set(float(self._resident))
+                if not victim.future.done():
+                    victim.future.set_exception(
+                        ShedError(
+                            f"request for {victim.market_id!r} shed under "
+                            "overload (shed_oldest policy)"
+                        )
+                    )
+                return True
+        return False
+
+    # -- flushing (event-loop thread) ----------------------------------------
+
+    def _arm_timer(self) -> None:
+        if (
+            self._max_delay_s is None
+            or self._timer is not None
+            or not self._windows
+            or self._loop is None
+        ):
+            return
+        deadline = self._windows[0].t_created + self._max_delay_s
+        delay = max(0.0, deadline - _time.perf_counter())
+        self._timer = self._loop.call_later(delay, self._on_timer)
+
+    def _on_timer(self) -> None:
+        self._timer = None
+        if self._closed:
+            return
+        now = _time.perf_counter()
+        while self._windows and (
+            now - self._windows[0].t_created >= self._max_delay_s
+        ):
+            self._flush_oldest()
+        self._arm_timer()
+
+    def _flush_oldest(self) -> None:
+        window = self._windows.pop(0)
+        requests = window.requests
+        if not requests:
+            return
+        t_flush = _time.perf_counter()
+        keys = [r.market_id for r in requests]
+        source_ids: list[str] = []
+        probabilities: list[float] = []
+        offsets = np.zeros(len(requests) + 1, dtype=np.int64)
+        for i, request in enumerate(requests):
+            source_ids.extend(request.source_ids)
+            probabilities.extend(request.probabilities)
+            offsets[i + 1] = len(source_ids)
+            request.t_flush = t_flush
+            self._hist_coalesce.observe(t_flush - request.t_enqueued)
+        probabilities = np.asarray(probabilities, dtype=np.float64)
+        outcomes = [r.outcome for r in requests]
+        batch_index = self._next_batch
+        self._next_batch += 1
+        self._batches_counter.inc()
+        if self._record_batches:
+            self.batch_log.append(
+                ((keys, source_ids, probabilities, offsets), outcomes)
+            )
+        future = self._loop.run_in_executor(
+            self._executor, self._run_batch,
+            batch_index, keys, source_ids, probabilities, offsets, outcomes,
+            requests,
+        )
+        self._inflight.add(future)
+        future.add_done_callback(self._inflight.discard)
+
+    # -- dispatch (worker thread) --------------------------------------------
+
+    def _run_batch(self, batch_index, keys, source_ids, probabilities,
+                   offsets, outcomes, requests) -> None:
+        loop = self._loop
+        if self._failure is not None:
+            failure = ServiceClosed(
+                f"batch {batch_index} abandoned after an earlier failure"
+            )
+            for request in requests:
+                loop.call_soon_threadsafe(
+                    self._resolve, request, None, failure
+                )
+            return
+        try:
+            plan = self._plans.plan_for(
+                keys, source_ids, probabilities, offsets
+            )
+            batch_now = (
+                None if self._now is None else self._now + batch_index
+            )
+            result = self._driver.dispatch(
+                plan, outcomes, now=batch_now, band=None
+            )
+            consensus = np.asarray(result.consensus)
+            t_settled = _time.perf_counter()
+            if self._journal_mode:
+                self._await_durable.append(
+                    (batch_index, [(r, t_settled) for r in requests])
+                )
+            self._driver.checkpoint(batch_index)
+        except BaseException as exc:  # noqa: BLE001 — routed to futures
+            self._failure = exc
+            for request in requests:
+                loop.call_soon_threadsafe(self._resolve, request, None, exc)
+            return
+        # Resolution happens AFTER the checkpoint — the service analogue
+        # of settle_stream yielding after the cadence — so a caller never
+        # observes a result whose durability window has silently failed.
+        for i, request in enumerate(requests):
+            self._hist_dispatch.observe(t_settled - request.t_flush)
+            value = ServeResult(
+                request.market_id, float(consensus[i]), batch_index
+            )
+            if not self._journal_mode:
+                self._hist_total.observe(t_settled - request.t_submit)
+            loop.call_soon_threadsafe(self._resolve, request, value, None)
+        self._observe_durable()
+
+    def _observe_durable(self) -> None:
+        """Fold the driver's durable watermark into per-request spans."""
+        durable_through = self._driver.durable_through
+        t_durable = _time.perf_counter()
+        while self._await_durable and (
+            self._await_durable[0][0] <= durable_through
+        ):
+            _, entries = self._await_durable.pop(0)
+            for request, t_settled in entries:
+                self._hist_durable.observe(t_durable - t_settled)
+                self._hist_total.observe(t_durable - request.t_submit)
+
+    def _resolve(self, request: _Request, value, exc) -> None:
+        self._resident -= 1
+        self._pending_gauge.set(float(self._resident))
+        if request.future.done():
+            return
+        if exc is not None:
+            request.future.set_exception(exc)
+        else:
+            request.future.set_result(value)
+
+    # -- drain / shutdown (event-loop thread) --------------------------------
+
+    async def flush(self) -> None:
+        """Flush every open window now (oldest first), without waiting."""
+        while self._windows:
+            self._flush_oldest()
+
+    async def drain(self) -> None:
+        """Flush everything and wait until every in-flight batch settled."""
+        await self.flush()
+        while self._inflight:
+            await asyncio.gather(
+                *list(self._inflight), return_exceptions=True
+            )
+
+    async def close(self) -> None:
+        """Drain, finalize durability, and shut the dispatch worker down.
+
+        Stops admitting (subsequent :meth:`submit` raises
+        :class:`ServiceClosed`), flushes every open window, waits for the
+        in-flight batches, then runs the driver's exit contract on the
+        worker thread — the tail journal epoch covering every settled
+        batch, written and fsynced synchronously, so a clean close always
+        leaves the journal on a JOINED epoch (crash recovery replays to
+        exactly the served state). A failure from a batch or from the
+        finalize itself is re-raised here, never dropped.
+        """
+        if self._closed:
+            return
+        self._closed = True
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if self._loop is None:
+            self._loop = asyncio.get_running_loop()
+        await self.drain()
+        try:
+            await self._loop.run_in_executor(
+                self._executor, self._finalize_worker
+            )
+        finally:
+            self._executor.shutdown(wait=True)
+        if self._failure is not None:
+            raise self._failure
+
+    def _finalize_worker(self) -> None:
+        try:
+            self._driver.finalize()
+            self._observe_durable()
+        except BaseException as exc:  # noqa: BLE001 — surfaced by close()
+            if self._failure is None:
+                self._failure = exc
+
+    async def __aenter__(self) -> "ConsensusService":
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb) -> None:
+        # A body that already failed should surface ITS error; close's
+        # drain still runs so the journal ends joined where possible.
+        if exc_type is None:
+            await self.close()
+        else:
+            try:
+                await self.close()
+            except BaseException:  # noqa: BLE001 — body error wins
+                pass

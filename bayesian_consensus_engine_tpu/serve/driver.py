@@ -1,0 +1,353 @@
+"""`SessionDriver` — drive one batch at a time over a resident settlement
+session, with the stream's durability cadence factored out of the loop.
+
+Before round 8 the only way to run the resident device service was
+:func:`~.pipeline.settle_stream`: the session lifecycle (start / probs-only
+refresh / in-HBM adopt), the flat and per-batch-session fallbacks, the
+journal-epoch/SQLite checkpoint cadence, and the tail-flush contract all
+lived inline in one generator body, so nothing else — in particular no
+request-facing front end — could drive a batch over the standing session
+without re-implementing (and inevitably forking) that logic. This module
+is that loop body as an API:
+
+* :class:`SessionDriver` owns the per-batch dispatch (``dispatch``), the
+  rolling durability cadence (``checkpoint``), and the exit contract
+  (``finalize``). ``settle_stream`` itself is reimplemented on top of it —
+  byte-exact with the pre-refactor stream (results, store state, journal
+  epoch payloads, SQLite bytes; pinned by tests/test_overlap.py) — and the
+  online coalescing front end (:class:`~.serve.coalesce.ConsensusService`)
+  drives the SAME driver from its flush worker, which is what makes
+  "serving path ≡ settle_stream over the coalesced batch list" a
+  structural property instead of a parallel implementation to keep honest.
+* :class:`PlanCache` is the topology-fingerprint plan-reuse step
+  (:class:`~.pipeline.PlanPrefetcher`'s ``reuse_plans`` logic) as a
+  synchronous object, for callers that build plans on their own schedule:
+  a fingerprint hit refreshes the previous plan's probability block, a
+  miss rebuilds — bit-identical to the prefetcher by sharing the same
+  builders and the same compare.
+
+The driver is deliberately not thread-safe: one driver, one driving
+thread (the stream's consumer thread, or the service's single flush
+worker). The store underneath is thread-safe; the driver's session and
+durability bookkeeping are not shared state.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu.core.batch import topology_fingerprint
+from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
+from bayesian_consensus_engine_tpu.obs.timeline import active_timeline
+
+
+class PlanCache:
+    """Fingerprint-keyed plan reuse for caller-scheduled (columnar) builds.
+
+    The delta-ingest compare :class:`~.pipeline.PlanPrefetcher` runs on its
+    worker thread, exposed synchronously: ``plan_for`` fingerprints the
+    batch's topology and, when it matches the previous batch's, refreshes
+    the cached plan with the new probabilities (probs-only twin — pack,
+    intern, and block fill all skipped) instead of rebuilding. Identical
+    decisions and identical plans to ``PlanPrefetcher(reuse_plans=True)``
+    on the same batch sequence, by construction: same fingerprint, same
+    ``SettlementPlan.refresh``, same columnar builder on a miss.
+    """
+
+    def __init__(self, store, num_slots: "int | str | None" = "bucket"):
+        self._store = store
+        self._num_slots = num_slots
+        self._last = None
+
+    @property
+    def last_plan(self):
+        return self._last
+
+    def plan_for(self, market_keys, source_ids, probabilities, offsets):
+        """Plan for one columnar batch; reuses on a topology-digest hit."""
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan_columnar,
+        )
+
+        probabilities = np.ascontiguousarray(probabilities, dtype=np.float64)
+        digest = topology_fingerprint(market_keys, source_ids, offsets)
+        prev = self._last
+        if prev is not None and prev.fingerprint == digest:
+            plan = prev.refresh(probabilities)
+        else:
+            plan = build_settlement_plan_columnar(
+                self._store, market_keys, source_ids, probabilities, offsets,
+                num_slots=self._num_slots, fingerprint=digest,
+            )
+        self._last = plan
+        return plan
+
+
+class SessionDriver:
+    """One batch at a time over a resident session, durability included.
+
+    The loop body of :func:`~.pipeline.settle_stream` as a reusable
+    object. A driver holds (lazily) ONE long-lived
+    :class:`~.pipeline.ShardedSettlementSession` under ``mesh=`` — served
+    resident across batches exactly as the stream does: topology hits
+    refresh the probs block, misses ``adopt()`` with the block held in
+    HBM — plus the durability ladder: journal epochs (sync or async) or
+    rolling SQLite flushes every *checkpoint_every* batches, and the
+    tail-flush/join contract on :meth:`finalize`.
+
+    Protocol per batch ``i`` (indexes must be sequential from 0):
+
+    1. ``result = driver.dispatch(plan, outcomes, now=..., band=...)``
+    2. ``checkpoint_s = driver.checkpoint(i)`` (``None`` when not due)
+
+    and once, on EVERY exit path (success, consumer break, batch error):
+
+    3. ``driver.finalize()`` — joins/ writes the tail epoch covering every
+       fully settled batch (never one that raised mid-settle), re-raises
+       any background write failure, closes an owned journal, and tail-
+       flushes SQLite. After a clean ``finalize`` a journal's last epoch
+       is JOINED (fsynced) — the drain contract the serving front end's
+       shutdown leans on.
+
+    ``last_adopt`` after a dispatch is how the session served it
+    (``"start"``/``"refresh"``/``"relayout"``/``"rebuild"``; ``None`` on
+    the flat path and with ``resident_session=False``), and
+    ``durable_through`` is the highest batch index whose journal epoch is
+    known fsynced — the watermark per-request durability accounting reads.
+    """
+
+    def __init__(
+        self,
+        store,
+        steps: int = 1,
+        mesh=None,
+        dtype=None,
+        resident_session: bool = True,
+        journal=None,
+        owns_journal: bool = False,
+        db_path=None,
+        checkpoint_every: int = 1,
+        sync_checkpoints: bool = False,
+        lazy_checkpoints: bool = False,
+    ) -> None:
+        if checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
+        if journal is not None and lazy_checkpoints:
+            raise ValueError(
+                "journal= epochs are drained truth by contract; "
+                "lazy_checkpoints cannot combine with a journal"
+            )
+        self._store = store
+        self._steps = steps
+        self._mesh = mesh
+        self._dtype = dtype
+        self._resident_session = resident_session
+        self._journal = journal
+        self._owns_journal = owns_journal
+        self._db_path = db_path
+        self._checkpoint_every = checkpoint_every
+        self._sync_checkpoints = sync_checkpoints
+        self._lazy_checkpoints = lazy_checkpoints
+
+        registry = metrics_registry()
+        self._adopts_counter = registry.counter("stream.session_adopts")
+        self._resident_gauge = registry.gauge("stream.resident_rows")
+
+        self._session = None  # the mesh path's long-lived resident session
+        self._session_band = None
+        self._handle = None  # in-flight background SQLite flush
+        self._journal_handle = None  # in-flight background journal epoch
+        self._flushed_through = -1
+        self._journaled_through = -1
+        self._settled_through = -1
+        self._started_through = -1  # batches BEGUN (≥ settled on a raise)
+        self._journal_write_failed = False
+        self.last_adopt: Optional[str] = None
+        #: Highest batch index whose journal epoch is known fsynced. Sync
+        #: mode advances it at each checkpoint; async mode advances it to
+        #: the PREVIOUS epoch when the next checkpoint (or finalize) joins
+        #: the in-flight write — the "yield implies epoch N−1 fsynced"
+        #: contract as a readable watermark.
+        self.durable_through = -1
+
+    # -- dispatch ------------------------------------------------------------
+
+    @property
+    def settled_through(self) -> int:
+        """Index of the last batch that fully settled (−1 before any)."""
+        return self._settled_through
+
+    @property
+    def session(self):
+        return self._session
+
+    def dispatch(
+        self,
+        plan,
+        outcomes: Sequence[bool],
+        now: Optional[float] = None,
+        band=None,
+    ):
+        """Settle one batch; returns its :class:`~.pipeline.SettlementResult`.
+
+        ``mesh=None`` → the flat :func:`~.pipeline.settle` chain.
+        ``mesh`` + ``resident_session=False`` → the legacy per-batch
+        session (abandoned unclosed so its merge recipe stays deferred).
+        Otherwise ONE resident session across calls: started on the first
+        batch (or a band change), topology hits served by a probs-only
+        refresh, misses adopted with the block held in HBM. How the batch
+        was served is ``self.last_adopt``.
+        """
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+            settle,
+        )
+
+        store = self._store
+        self._started_through += 1
+        self.last_adopt = None
+        if self._mesh is None:
+            result = settle(
+                store, plan, outcomes, steps=self._steps, now=now,
+                dtype=self._dtype,
+            )
+        elif not self._resident_session:
+            # LEGACY per-batch session (A/B benches + tests), abandoned
+            # without close: the settle registered the store's merge
+            # recipe, and closing here would sync it eagerly — serialising
+            # the device→host gather against this thread. Left pending,
+            # the NEXT batch's state build (or the checkpoint flush)
+            # resolves it instead.
+            result = ShardedSettlementSession(
+                store, plan, self._mesh, dtype=self._dtype, band=band
+            ).settle(outcomes, steps=self._steps, now=now)
+        else:
+            # ONE resident session across batches: a topology hit uploads
+            # only the probs block, a miss adopts the new plan with the
+            # block held in HBM (never closed mid-stream — the standing
+            # recipe resolves at the next checkpoint/overlap exactly like
+            # the per-batch shape's deferred gathers; a crash restart
+            # simply builds a fresh session for the resume stream).
+            if self._session is None or band != self._session_band:
+                if self._session is not None:
+                    # The replaced session's standing gather is no longer
+                    # session-pinned: let its bytes count against the
+                    # deferral budget again.
+                    self._session._release_standing()
+                self._session = ShardedSettlementSession(
+                    store, plan, self._mesh, dtype=self._dtype, band=band
+                )
+                self._session_band = band
+                self.last_adopt = "start"
+            else:
+                self.last_adopt = self._session.adopt(plan, band=band)
+                if self.last_adopt != "refresh":
+                    self._adopts_counter.inc()
+            self._resident_gauge.set(float(self._session._touched.size))
+            result = self._session.settle(
+                outcomes, steps=self._steps, now=now
+            )
+        self._settled_through = self._started_through
+        return result
+
+    # -- durability ----------------------------------------------------------
+
+    def checkpoint_due(self, index: int) -> bool:
+        return (
+            (index + 1) % self._checkpoint_every == 0
+            and (self._journal is not None or self._db_path is not None)
+        )
+
+    def checkpoint(self, index: int) -> Optional[float]:
+        """Run the rolling durability step for settled batch *index*.
+
+        Journal mode appends one epoch (tag = *index*): in-loop
+        write+fsync under ``sync_checkpoints``, else snapshotted here and
+        written on the background thread — the join inside surfaces the
+        PREVIOUS epoch's completion or failure. SQLite mode backgrounds
+        the rolling flush. Returns the serial seconds spent, or ``None``
+        when this index is not on the cadence. A journal-write failure is
+        remembered so :meth:`finalize` does not retry the broken journal
+        and shadow the original error.
+        """
+        if not self.checkpoint_due(index):
+            return None
+        store, timeline = self._store, active_timeline()
+        checkpoint_start = _time.perf_counter()
+        if self._journal is not None:
+            try:
+                with timeline.span("checkpoint"):
+                    if self._sync_checkpoints:
+                        store.flush_to_journal(self._journal, tag=index)
+                        self.durable_through = index
+                    else:
+                        previous_inflight = (
+                            self._journaled_through
+                            if self._journal_handle is not None
+                            else self.durable_through
+                        )
+                        self._journal_handle = store.flush_to_journal_async(
+                            self._journal, tag=index
+                        )
+                        # The async call joined any in-flight epoch before
+                        # writing: the previous cadence is durable now.
+                        self.durable_through = previous_inflight
+            except BaseException:
+                self._journal_write_failed = True
+                raise
+            self._journaled_through = index
+        else:
+            # Joins any in-flight write first (flushes serialise), so a
+            # prior background failure surfaces here, not silently.
+            with timeline.span("checkpoint"):
+                self._handle = store.flush_to_sqlite_async(
+                    self._db_path,
+                    resolve_pending=not self._lazy_checkpoints,
+                )
+            if not self._lazy_checkpoints:
+                self._flushed_through = index
+        return _time.perf_counter() - checkpoint_start
+
+    def finalize(self) -> None:
+        """The exit contract — run on EVERY exit path, exactly once.
+
+        The in-flight journal write is always joined (a background
+        failure must never be dropped) and every fully settled batch
+        reaches the checkpoint file. Tail epochs and flushes cover
+        through ``settled_through`` only — a batch that RAISED mid-settle
+        is never claimed as durable. When the caller is exiting BECAUSE a
+        journal write failed, the tail epoch is skipped: retrying the
+        broken journal here would raise again and replace the original
+        error — the journal's durable point is simply the last epoch that
+        landed. After a clean return the journal (if any) ends on a
+        JOINED, fsynced epoch.
+        """
+        store, timeline = self._store, active_timeline()
+        try:
+            if self._journal is not None and not self._journal_write_failed:
+                if self._settled_through > self._journaled_through:
+                    # Joins any in-flight background epoch first, so the
+                    # tail epoch lands after (and surfaces any failure
+                    # of) the last cadence's write.
+                    store.flush_to_journal(
+                        self._journal, tag=self._settled_through
+                    )
+                    self.durable_through = self._settled_through
+                elif self._journal_handle is not None:
+                    # Nothing new to journal, but the last cadence's
+                    # epoch may still be in flight: the stream must not
+                    # end before its durability (or failure) is known.
+                    with timeline.span("journal_async_wait"):
+                        self._journal_handle.result()
+                    self.durable_through = self._journaled_through
+        finally:
+            if self._owns_journal and self._journal is not None:
+                self._journal.close()
+            if self._db_path is not None and self._started_through >= 0:
+                if self._handle is not None:
+                    self._handle.result()
+                if self._flushed_through != self._started_through:
+                    store.flush_to_sqlite(self._db_path)

@@ -777,6 +777,75 @@ class TestStreamLegBands:
             assert "trials" in inspect.signature(fn).parameters, leg
 
 
+class TestServeLeg:
+    """The round-8 serving-latency leg (``e2e_serve``) at --fast shapes:
+    closed-loop, open-loop (Poisson), and bounded-overload acts over the
+    coalescing front end. Byte-parity of the serving path is pinned by
+    tests/test_serve.py; this pins the LEG's contract (JSON shape, the
+    latency quantiles, the bounded-overload claim, ledger latency
+    records)."""
+
+    def test_fast_leg_reports_latency_bands(self, tmp_path):
+        from bayesian_consensus_engine_tpu.obs.ledger import read_ledger
+
+        ledger_path = tmp_path / "serve.jsonl"
+        old = bench._LEDGER
+        from bayesian_consensus_engine_tpu.obs.ledger import RunLedger
+
+        bench._LEDGER = RunLedger(ledger_path, backend="cpu")
+        try:
+            result = bench.run_leg_inprocess("e2e_serve", fast=True)
+        finally:
+            bench._LEDGER.close()
+            bench._LEDGER = old
+        for act in ("closed_loop", "open_loop", "overload"):
+            side = result[act]
+            for key in (
+                "wall_s", "wall_s_band", "repeats", "requests_offered",
+                "served", "rejected", "shed", "batches", "mean_batch_fill",
+                "throughput_rps", "p50_ms", "p99_ms", "dispatch_p50_ms",
+                "dispatch_p99_ms", "max_pending_seen",
+            ):
+                assert key in side, (act, key)
+            assert side["p50_ms"] is not None
+            assert side["p99_ms"] >= side["p50_ms"]
+        # Unconstrained acts serve everything they were offered.
+        assert result["closed_loop"]["served"] == (
+            result["closed_loop"]["requests_offered"]
+        )
+        assert result["closed_loop"]["rejected"] == 0
+        # The overload act actually overloaded — and stayed bounded.
+        overload = result["overload"]
+        assert overload["rejected"] > 0
+        assert overload["max_pending_seen"] <= 64
+        assert result["overload_bounded"] is True
+        json.dumps(result)
+        # Per-request distributions reached the ledger, and the stats
+        # renderer folds them into p50/p99 columns.
+        from bayesian_consensus_engine_tpu.obs.ledger import (
+            render,
+            summarize,
+        )
+
+        records = read_ledger(ledger_path)
+        bands = summarize(records)
+        latency_legs = [
+            leg for leg in bands if leg.endswith(".latency")
+        ]
+        assert len(latency_legs) == 3
+        for leg in latency_legs:
+            assert bands[leg]["p50"] is not None
+            assert bands[leg]["p99"] is not None
+        assert "p99" in render(records).splitlines()[0]
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_serve" in bench.LEGS
+        assert "e2e_serve" in bench.DEVICE_LEG_ORDER
+        assert "trials" in __import__("inspect").signature(
+            bench.LEGS["e2e_serve"][0]
+        ).parameters
+
+
 class TestDryrunMultichipLeg:
     """The scaled virtual-mesh leg (VERDICT r5 #3): the north-star band
     over 8 virtual devices with a REAL psum epilogue, parity-asserted

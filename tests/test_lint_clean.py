@@ -35,6 +35,7 @@ _NEW_FAMILY_IDS = (
     "JX109",
     "DT201", "DT202", "DT203",
     "LY301", "LY302", "LY303",
+    "SH401",
 )
 
 
@@ -155,6 +156,17 @@ _CASES = [
         f"{PKG}/ops/case.py",
         f"from {PKG}.obs.timeline import active_timeline\n",
         f"from {PKG}.utils import config\n",
+    ),
+    (
+        # A PartitionSpec axis the mesh does not define: the typo'd
+        # string is flagged; the axis-constant twin is the idiom.
+        "SH401",
+        f"{PKG}/parallel/case.py",
+        "from jax.sharding import PartitionSpec as P\n\n"
+        "SPEC = P('markets', 'source')\n",
+        f"from {PKG}.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS\n"
+        "from jax.sharding import PartitionSpec as P\n\n"
+        "SPEC = P(MARKETS_AXIS, SOURCES_AXIS)\n",
     ),
     (
         "F401",
@@ -365,6 +377,77 @@ class TestFenceAudit:
 
     def test_is_warning_tier(self):
         assert RULES["JX109"].severity == "warning"
+
+
+class TestShardingSpecAudit:
+    """SH401: PartitionSpec arguments in ``parallel/`` must resolve to the
+    mesh's real axes. The vocabulary is tiny (MARKETS_AXIS/SOURCES_AXIS)
+    so the checker is exact; it must accept every legal spec shape the
+    repo uses (None dims, tuple dims, empty specs, attribute-qualified
+    constants) and stay out of foreign paths."""
+
+    _REL = f"{PKG}/parallel/case.py"
+
+    def _codes(self, src):
+        return [
+            f.rule_id
+            for f in check_source(src, self._REL, select=["SH401"])
+        ]
+
+    def test_empty_and_none_and_tuple_specs_are_legal(self):
+        src = (
+            f"from {PKG}.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS\n"
+            "from jax.sharding import PartitionSpec as P\n\n"
+            "A = P()\n"
+            "B = P(MARKETS_AXIS, None)\n"
+            "C = P((MARKETS_AXIS, SOURCES_AXIS), None)\n"
+        )
+        assert self._codes(src) == []
+
+    def test_attribute_qualified_constant_is_legal(self):
+        src = (
+            f"from {PKG}.parallel import mesh\n"
+            "from jax.sharding import PartitionSpec\n\n"
+            "SPEC = PartitionSpec(mesh.MARKETS_AXIS)\n"
+        )
+        assert self._codes(src) == []
+
+    def test_literal_axis_names_are_legal(self):
+        # mesh.py itself pins the constants to these strings; a doc
+        # example using them directly must not be a violation.
+        src = (
+            "from jax.sharding import PartitionSpec as P\n\n"
+            "SPEC = P('markets', 'sources')\n"
+        )
+        assert self._codes(src) == []
+
+    def test_typo_string_and_unknown_name_are_flagged(self):
+        src = (
+            "from jax.sharding import PartitionSpec as P\n\n"
+            "AXIS = 'markets'\n"
+            "A = P('market')\n"      # typo'd literal
+            "B = P(AXIS)\n"          # computed — unverifiable
+            "C = P(AGENTS_AXIS)\n"   # unknown constant
+        )
+        assert self._codes(src) == ["SH401", "SH401", "SH401"]
+
+    def test_tuple_with_one_bad_axis_is_flagged(self):
+        src = (
+            f"from {PKG}.parallel.mesh import MARKETS_AXIS\n"
+            "from jax.sharding import PartitionSpec as P\n\n"
+            "SPEC = P((MARKETS_AXIS, 'agent'), None)\n"
+        )
+        assert self._codes(src) == ["SH401"]
+
+    def test_stays_out_of_non_parallel_paths(self):
+        src = (
+            "from jax.sharding import PartitionSpec as P\n\n"
+            "SPEC = P('bogus')\n"
+        )
+        for rel in (f"{PKG}/ops/case.py", "scripts/case.py", None):
+            assert "SH401" not in [
+                f.rule_id for f in check_source(src, rel, select=["SH401"])
+            ], rel
 
 
 class TestCliContract:

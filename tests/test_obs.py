@@ -65,6 +65,109 @@ class TestHistogramLayout:
             registry.histogram("h", bounds=(1.0, 3.0))
 
 
+class TestHistogramQuantile:
+    """Round-8 quantile surface: bucket-interpolated, EXACT when the
+    rank lands on a log-bucket boundary, reproducible from counts alone
+    (the stats renderer's p50/p99 come from exported snapshots)."""
+
+    def _hist(self, values, bounds=(1.0, 10.0, 100.0)):
+        h = obs_metrics.Histogram(bounds=bounds)
+        for value in values:
+            h.observe(value)
+        return h
+
+    def test_empty_histogram_has_no_quantile(self):
+        assert self._hist([]).quantile(0.5) is None
+        assert obs_metrics.NULL_REGISTRY.histogram("x").quantile(0.5) is None
+
+    def test_exact_on_log_bucket_boundary(self):
+        # 5 observations in (0,1], 5 in (1,10]: the 0.5 rank lands
+        # EXACTLY on the first bucket's cumulative count → its upper
+        # edge, exactly — no interpolation drift.
+        h = self._hist([0.5] * 5 + [5.0] * 5)
+        assert h.quantile(0.5) == 1.0
+        # ...and with 5+5+10, rank 0.25 ends bucket 0, rank 0.5 ends
+        # bucket 1 — each is that bucket's exact upper edge.
+        h = self._hist([0.5] * 5 + [5.0] * 5 + [50.0] * 10)
+        assert h.quantile(0.25) == 1.0
+        assert h.quantile(0.5) == 10.0
+        assert h.quantile(1.0) == 100.0
+
+    def test_interpolates_within_a_bucket(self):
+        # All 4 observations in (1, 10]: rank q falls q of the way
+        # through the bucket — linear between the edges.
+        h = self._hist([5.0] * 4)
+        assert h.quantile(0.5) == pytest.approx(1.0 + (10.0 - 1.0) * 0.5)
+        assert h.quantile(0.0) == pytest.approx(1.0)
+
+    def test_overflow_clamps_to_last_finite_edge(self):
+        h = self._hist([1000.0] * 3)
+        assert h.quantile(0.99) == 100.0  # a lower bound, never invented
+
+    def test_rejects_out_of_range_q(self):
+        with pytest.raises(ValueError, match="quantile"):
+            self._hist([1.0]).quantile(1.5)
+
+    def test_snapshot_and_merged_snapshot_agree(self):
+        h = self._hist([0.5, 5.0, 5.0, 50.0])
+        snap = h.snapshot()
+        assert obs_metrics.quantile_from_snapshot(snap, 0.99) == (
+            h.quantile(0.99)
+        )
+        # Merging two identical snapshots (the ledger's cross-repeat
+        # path) preserves every quantile: same distribution, more mass.
+        merged = {
+            "bounds": snap["bounds"],
+            "counts": [c * 2 for c in snap["counts"]],
+        }
+        for q in (0.25, 0.5, 0.75, 0.99):
+            assert obs_metrics.quantile_from_snapshot(merged, q) == (
+                h.quantile(q)
+            )
+
+    def test_summary_names_percentiles(self):
+        h = self._hist([0.5] * 5 + [5.0] * 5)
+        summary = h.summary((0.5, 0.99, 0.999))
+        assert summary["count"] == 10
+        assert summary["p50"] == 1.0
+        assert set(summary) == {"count", "sum", "p50", "p99", "p99.9"}
+
+    def test_ledger_merges_latency_hists_into_p50_p99(self, tmp_path):
+        path = tmp_path / "latency.jsonl"
+        bounds = [1.0, 10.0, 100.0]
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            for counts in ([5, 5, 0, 0], [5, 5, 0, 0]):
+                ledger.record(
+                    "serve.latency", value=1.0, unit="s",
+                    extras={"latency_hist": {
+                        "bounds": bounds, "counts": counts,
+                    }},
+                )
+            ledger.record("plain_leg", value=2.0, unit="s")
+        records = obs.read_ledger(path)
+        summary = obs.summarize(records)
+        # Merged counts [10, 10, 0, 0]: the q=0.5 rank (10 of 20) lands
+        # exactly on bucket 0's cumulative end → its upper edge, 1.0.
+        assert summary["serve.latency"]["p50"] == 1.0
+        assert summary["serve.latency"]["p99"] is not None
+        assert "p50" not in summary["plain_leg"]
+        rendered = obs_ledger.render(records)
+        header = rendered.splitlines()[0]
+        assert "p50" in header and "p99" in header
+
+    def test_mismatched_hist_layouts_refuse_to_merge(self, tmp_path):
+        path = tmp_path / "bad.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            ledger.record("leg", value=1.0, extras={"latency_hist": {
+                "bounds": [1.0, 10.0], "counts": [1, 1, 0],
+            }})
+            ledger.record("leg", value=1.0, extras={"latency_hist": {
+                "bounds": [1.0, 100.0], "counts": [1, 1, 0],
+            }})
+        with pytest.raises(ValueError, match="layouts differ"):
+            obs.summarize(obs.read_ledger(path))
+
+
 class TestDeterministicExport:
     def test_byte_stable_across_registration_order(self):
         def populate(registry, names):

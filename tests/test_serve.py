@@ -1,0 +1,608 @@
+"""serve/: the online micro-batch coalescing front end (round 8).
+
+The non-negotiable contract, in three parts:
+
+* **Byte-exactness** — the same request trace replayed through
+  :class:`ConsensusService` and through plain ``settle_stream`` over the
+  coalesced batch list produces identical results, store state, journal
+  epoch payloads, and SQLite bytes, across topology hits, drift (session
+  adopt), and growth — on the flat path and over the sharded resident
+  session. Structural, because both drive the same ``SessionDriver``;
+  these tests keep it structural.
+* **Determinism** — the same submission order yields the same batch
+  sequence and the same bytes, run to run.
+* **Overload is policy** — bounded admission rejects (with a retry hint)
+  or sheds oldest; queue depth never exceeds the bound; a clean drain
+  leaves the journal on a joined epoch; a mid-serve crash resumes from
+  ``settled_batches`` exactly like the stream's ``len(stats)`` recipe.
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from bayesian_consensus_engine_tpu import obs
+from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+from bayesian_consensus_engine_tpu.pipeline import settle_stream
+from bayesian_consensus_engine_tpu.serve import (
+    AdmissionConfig,
+    ConsensusService,
+    Overloaded,
+    PlanCache,
+    ServiceClosed,
+    SessionDriver,
+    ShedError,
+)
+from bayesian_consensus_engine_tpu.state.journal import (
+    JournalWriter,
+    replay_journal,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 21_900.0
+
+
+def journal_epochs_sans_clock(path):
+    """Decoded epoch frames with the wall-clock field masked (the one
+    legitimately run-varying field; same helper as test_overlap)."""
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = hdr.unpack_from(blob, off)
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4  # + crc32
+    return epochs
+
+
+def mixed_trace(width=8):
+    """Hits, drift, and growth as one submission-ordered request trace.
+
+    Two rounds of one stable (source, market) universe (windows coalesce
+    each round back into the same topology — fingerprint HITS), two
+    rounds of a drifted universe (changed source sets — one adopt, then
+    hits on the drifted topology), then 2×*width* fresh markets (growth
+    up the store's ladder, two full windows). Every round submits
+    exactly *width* distinct markets so ``max_batch=width`` seals one
+    deterministic window per round.
+    """
+    trace = []
+    for rnd in range(2):
+        for m in range(width):
+            trace.append((
+                f"m-{m}",
+                [(f"s-{m}", 0.55 + 0.01 * rnd), (f"s-{(m + 1) % 5}", 0.40)],
+                (m + rnd) % 2 == 0,
+            ))
+    for rnd in range(2):
+        for m in range(width):
+            trace.append((
+                f"m-{m}",
+                [(f"s-{m}", 0.35 + 0.01 * rnd), ("s-drift", 0.70)],
+                (m + rnd) % 3 == 0,
+            ))
+    for m in range(2 * width):
+        trace.append((
+            f"fresh-{m}", [(f"s-{m % 5}", 0.62), (f"g-{m}", 0.48)],
+            m % 2 == 1,
+        ))
+    return trace
+
+
+def run_service(store, trace, tmp_path, name, mesh=None, width=8,
+                journal=True, db=True, **kwargs):
+    """Submit *trace* in order, drain, close; return (service, futures)."""
+    kwargs.setdefault("steps", 2)
+    kwargs.setdefault("now", NOW)
+    kwargs.setdefault("checkpoint_every", 2)
+
+    async def main():
+        service = ConsensusService(
+            store,
+            mesh=mesh,
+            journal=(tmp_path / f"{name}.jrnl") if journal else None,
+            db_path=(tmp_path / f"{name}.db") if db else None,
+            max_batch=width,
+            max_delay_s=None,
+            record_batches=True,
+            **kwargs,
+        )
+        futures = []
+        async with service:
+            for market_id, signals, outcome in trace:
+                futures.append(service.submit(market_id, signals, outcome))
+            await service.drain()
+        return service, futures
+
+    service, futures = asyncio.run(main())
+    store.sync()
+    return service, futures
+
+
+def run_stream(store, batches, tmp_path, name, mesh=None, steps=2,
+               checkpoint_every=2, now=NOW):
+    """The reference: plain settle_stream over a coalesced batch list.
+
+    Driven in LOCKSTEP — batch N+1 is released to the prefetch worker
+    only after result N is consumed — so the stream's journal epochs
+    carry exactly the batches they cover. (Free-running, the prefetcher
+    interns batch N+1's new pairs while batch N checkpoints, which can
+    land pair-table rows one epoch EARLY depending on thread timing:
+    same replayed state, racy bytes. An online service cannot intern the
+    future, so the lockstep drive is the byte-comparable reference.)
+    """
+    import threading
+
+    released = [threading.Event() for _ in range(len(batches) + 1)]
+    released[0].set()
+
+    def lockstep():
+        for i, batch in enumerate(batches):
+            released[i].wait()
+            yield batch
+
+    results = []
+    stream = settle_stream(
+        store, lockstep(), steps=steps, now=now,
+        db_path=tmp_path / f"{name}.db",
+        journal=JournalWriter(tmp_path / f"{name}.jrnl"),
+        checkpoint_every=checkpoint_every, columnar=True,
+        reuse_plans=True, mesh=mesh,
+    )
+    for i, result in enumerate(stream):
+        results.append(result)
+        released[i + 1].set()
+    store.sync()
+    return results
+
+
+class TestCoalescerByteExactness:
+    """ISSUE 6 satellite 3: service ≡ settle_stream over the coalesced
+    batch list — results, store, journal payloads, SQLite bytes — across
+    hit/drift/growth, flat and sharded-resident."""
+
+    @pytest.mark.parametrize("use_mesh", [False, True], ids=["flat", "mesh"])
+    def test_trace_equals_stream_over_batch_log(self, tmp_path, use_mesh):
+        trace = mixed_trace()
+        store = TensorReliabilityStore()
+        service, futures = run_service(
+            store, trace, tmp_path, "svc",
+            mesh=make_mesh() if use_mesh else None,
+        )
+        # Steady rounds coalesce back into one topology per round: 2 hit
+        # batches, 2 drift batches, 2 growth batches.
+        assert len(service.batch_log) == 6
+        assert service.settled_batches == 6
+
+        ref_store = TensorReliabilityStore()
+        ref_results = run_stream(
+            ref_store, service.batch_log, tmp_path, "ref",
+            mesh=make_mesh() if use_mesh else None,
+        )
+
+        # Per-request results == the stream's per-batch consensus.
+        by_batch = [r.by_market() for r in ref_results]
+        for future, (market_id, _signals, _outcome) in zip(futures, trace):
+            served = future.result()
+            assert served.market_id == market_id
+            assert served.consensus == by_batch[served.batch_index][market_id]
+
+        # Store state, journal epoch payloads, and SQLite bytes.
+        assert store.list_sources() == ref_store.list_sources()
+        assert journal_epochs_sans_clock(tmp_path / "svc.jrnl") == (
+            journal_epochs_sans_clock(tmp_path / "ref.jrnl")
+        )
+        assert (tmp_path / "svc.db").read_bytes() == (
+            tmp_path / "ref.db"
+        ).read_bytes()
+
+    def test_steady_traffic_hits_the_plan_cache(self, tmp_path):
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            store = TensorReliabilityStore()
+            service, _ = run_service(
+                store, mixed_trace(), tmp_path, "hits", mesh=make_mesh()
+            )
+        finally:
+            obs.set_metrics_registry(previous)
+        counters = registry.export()["counters"]
+        # 6 batches; batch 1 (steady round 2), batch 3 (drift round 2)
+        # are fingerprint hits served by a probs-only refresh. Drift and
+        # growth adopt the resident session instead of rebuilding:
+        # batch 2 (drift) and batch 4 (growth) relayout in HBM (growth
+        # batch 5's fresh window is a miss with a fresh topology too).
+        assert counters["serve.batches"] == 6
+        assert counters["stream.session_adopts"] >= 2
+        assert registry.histogram("serve.latency_dispatch_s").snapshot()[
+            "count"
+        ] == len(mixed_trace())
+
+    def test_same_trace_same_bytes(self, tmp_path):
+        trace = mixed_trace()
+        store_a = TensorReliabilityStore()
+        service_a, _ = run_service(store_a, trace, tmp_path, "a")
+        store_b = TensorReliabilityStore()
+        service_b, _ = run_service(store_b, trace, tmp_path, "b")
+        assert len(service_a.batch_log) == len(service_b.batch_log)
+        for (cols_a, out_a), (cols_b, out_b) in zip(
+            service_a.batch_log, service_b.batch_log
+        ):
+            assert cols_a[0] == cols_b[0] and out_a == out_b
+        assert journal_epochs_sans_clock(tmp_path / "a.jrnl") == (
+            journal_epochs_sans_clock(tmp_path / "b.jrnl")
+        )
+        assert (tmp_path / "a.db").read_bytes() == (
+            tmp_path / "b.db"
+        ).read_bytes()
+
+
+class TestWindowing:
+    def test_duplicate_market_opens_next_window(self, tmp_path):
+        store = TensorReliabilityStore()
+        trace = [
+            ("m-0", [("s-0", 0.6)], True),
+            ("m-1", [("s-1", 0.4)], False),
+            ("m-0", [("s-0", 0.7)], True),  # same market → next window
+        ]
+        service, futures = run_service(
+            store, trace, tmp_path, "dupe", width=8, journal=False, db=False
+        )
+        assert len(service.batch_log) == 2
+        (keys0, _, _, _), _ = service.batch_log[0]
+        (keys1, _, _, _), _ = service.batch_log[1]
+        assert keys0 == ["m-0", "m-1"] and keys1 == ["m-0"]
+        # Same-market updates settle in submission order, one batch apart.
+        assert futures[0].result().batch_index == 0
+        assert futures[2].result().batch_index == 1
+
+    def test_full_window_flushes_at_size(self, tmp_path):
+        store = TensorReliabilityStore()
+        trace = [(f"m-{i}", [("s", 0.5)], True) for i in range(7)]
+        service, futures = run_service(
+            store, trace, tmp_path, "size", width=3, journal=False, db=False
+        )
+        assert [len(cols[0]) for cols, _ in service.batch_log] == [3, 3, 1]
+        assert [f.result().batch_index for f in futures] == [
+            0, 0, 0, 1, 1, 1, 2,
+        ]
+
+
+class TestOverload:
+    """ISSUE 6 satellite 4: bounded queues, explicit policy, bounded p99's
+    prerequisite — bounded depth."""
+
+    def _burst(self, service, n, distinct=True):
+        futures, rejected = [], 0
+        for i in range(n):
+            market = f"m-{i if distinct else 0}-{i}"
+            try:
+                futures.append(
+                    service.submit(market, [("s", 0.5)], True)
+                )
+            except Overloaded as exc:
+                assert exc.retry_after_s == pytest.approx(0.01)
+                assert exc.pending >= 4
+                rejected += 1
+        return futures, rejected
+
+    def test_reject_policy_bounds_pending(self):
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            async def main():
+                store = TensorReliabilityStore()
+                service = ConsensusService(
+                    store, now=NOW, max_batch=2, max_delay_s=None,
+                    admission=AdmissionConfig(
+                        max_pending=4, policy="reject", retry_after_s=0.01
+                    ),
+                )
+                async with service:
+                    futures, rejected = self._burst(service, 30)
+                    assert service.pending_requests <= 4
+                    await service.drain()
+                return futures, rejected
+
+            futures, rejected = asyncio.run(main())
+        finally:
+            obs.set_metrics_registry(previous)
+        assert rejected > 0 and len(futures) + rejected == 30
+        for future in futures:
+            assert future.result().consensus == pytest.approx(0.5)
+        counters = registry.export()["counters"]
+        assert counters["serve.rejected"] == rejected
+        assert counters["serve.admitted"] == len(futures)
+
+    def test_shed_oldest_policy_drops_oldest_pending(self):
+        async def main():
+            store = TensorReliabilityStore()
+            service = ConsensusService(
+                store, now=NOW, max_batch=100, max_delay_s=None,
+                admission=AdmissionConfig(
+                    max_pending=5, policy="shed_oldest"
+                ),
+            )
+            async with service:
+                futures = [
+                    service.submit(f"m-{i}", [("s", 0.5)], True)
+                    for i in range(12)
+                ]
+                assert service.pending_requests <= 5
+                await service.drain()
+            return futures
+
+        futures = asyncio.run(main())
+        shed = [
+            f for f in futures
+            if isinstance(f.exception(), ShedError)
+        ]
+        served = [f for f in futures if f.exception() is None]
+        assert len(shed) == 7 and len(served) == 5
+        # Oldest-first: the first 7 submissions were the ones shed.
+        assert shed == futures[:7]
+
+    def test_shed_with_nothing_pending_degrades_to_reject(self):
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+
+        async def main():
+            store = TensorReliabilityStore()
+            service = ConsensusService(
+                store, now=NOW, max_batch=1, max_delay_s=None,
+                admission=AdmissionConfig(
+                    max_pending=2, policy="shed_oldest"
+                ),
+            )
+            async with service:
+                # max_batch=1 → every submit flushes immediately: the
+                # resident requests are dispatch-bound, windows empty.
+                futures = []
+                rejected = 0
+                for i in range(20):
+                    try:
+                        futures.append(
+                            service.submit(f"m-{i}", [("s", 0.5)], True)
+                        )
+                    except Overloaded:
+                        rejected += 1
+                await service.drain()
+            return futures, rejected
+
+        try:
+            futures, rejected = asyncio.run(main())
+        finally:
+            obs.set_metrics_registry(previous)
+        assert len(futures) + rejected == 20
+        for future in futures:
+            assert not isinstance(future.exception(), ShedError)
+        # The degrade path must report what actually happened: nothing
+        # was shed, the arrivals were rejected.
+        counters = registry.export()["counters"]
+        assert counters.get("serve.shed", 0) == 0
+        assert counters["serve.rejected"] == rejected
+        assert counters["serve.admitted"] == len(futures)
+
+
+class TestDrainAndShutdown:
+    def test_close_leaves_journal_on_joined_epoch(self, tmp_path):
+        store = TensorReliabilityStore()
+        trace = [(f"m-{i}", [("s", 0.5)], True) for i in range(5)]
+        service, _ = run_service(
+            store, trace, tmp_path, "joined", width=2, db=False,
+            checkpoint_every=3,
+        )
+        # 3 batches (2+2+1); cadence 3 journals none in-loop — the close
+        # tail epoch covers ALL settled batches, synchronously fsynced.
+        replayed, tag = replay_journal(tmp_path / "joined.jrnl")
+        assert tag == service.settled_batches - 1 == 2
+        replayed.sync()
+        assert replayed.list_sources() == store.list_sources()
+
+    def test_submit_after_close_raises(self, tmp_path):
+        async def main():
+            store = TensorReliabilityStore()
+            service = ConsensusService(store, now=NOW, max_delay_s=None)
+            async with service:
+                service.submit("m-0", [("s", 0.5)], True)
+                await service.drain()
+            with pytest.raises(ServiceClosed):
+                service.submit("m-1", [("s", 0.5)], True)
+
+        asyncio.run(main())
+
+    def test_timer_flush_settles_without_filling_window(self):
+        async def main():
+            store = TensorReliabilityStore()
+            service = ConsensusService(
+                store, now=NOW, max_batch=64, max_delay_s=0.01
+            )
+            async with service:
+                future = service.submit("m-0", [("s", 0.7)], True)
+                value = await asyncio.wait_for(future, timeout=30)
+            return value
+
+        value = asyncio.run(main())
+        assert value.batch_index == 0
+        assert value.consensus == pytest.approx(0.7)
+
+
+class TestCrashResume:
+    def test_journal_failure_surfaces_and_resume_matches(
+        self, tmp_path, monkeypatch
+    ):
+        """A failing journal epoch mid-serve: the batch's futures fail,
+        close() re-raises, and ``batch_log[settled_batches:]`` re-served
+        through a fresh service (journal resume=True, now advanced by
+        the settled count) converges on the uninterrupted run — the
+        stream's crash recipe, at the request layer."""
+        trace = mixed_trace()
+        ref_store = TensorReliabilityStore()
+        run_service(ref_store, trace, tmp_path, "ref")
+
+        real_flush = TensorReliabilityStore.flush_to_journal_async
+        calls = {"n": 0}
+
+        def broken_second(self, journal, tag=0):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("journal disk gone")
+            return real_flush(self, journal, tag=tag)
+
+        monkeypatch.setattr(
+            TensorReliabilityStore, "flush_to_journal_async", broken_second
+        )
+
+        store = TensorReliabilityStore()
+
+        async def crashing():
+            service = ConsensusService(
+                store, steps=2, now=NOW, checkpoint_every=2,
+                journal=tmp_path / "crash.jrnl", max_batch=8,
+                max_delay_s=None, record_batches=True,
+            )
+            futures = []
+            for market_id, signals, outcome in trace:
+                futures.append(service.submit(market_id, signals, outcome))
+            await service.drain()
+            with pytest.raises(RuntimeError, match="journal disk gone"):
+                await service.close()
+            return service, futures
+
+        service, futures = asyncio.run(crashing())
+        monkeypatch.setattr(
+            TensorReliabilityStore, "flush_to_journal_async", real_flush
+        )
+        settled = service.settled_batches
+        assert 0 < settled < len(service.batch_log)
+        failed = [f for f in futures if f.exception() is not None]
+        assert failed  # the failing cadence's batch + the abandoned tail
+
+        # Resume on the SAME store from the settled watermark.
+        async def resumed():
+            resume = ConsensusService(
+                store, steps=2, now=NOW + settled, checkpoint_every=2,
+                journal=JournalWriter(tmp_path / "crash.jrnl", resume=True),
+                max_batch=8, max_delay_s=None,
+            )
+            async with resume:
+                for (keys, sids, probs, offsets), outcomes in (
+                    service.batch_log[settled:]
+                ):
+                    for i, market in enumerate(keys):
+                        lo, hi = int(offsets[i]), int(offsets[i + 1])
+                        resume.submit(
+                            market,
+                            list(zip(sids[lo:hi], probs[lo:hi])),
+                            outcomes[i],
+                        )
+                    await resume.flush()
+                await resume.drain()
+
+        asyncio.run(resumed())
+        store.sync()
+        ref_store.sync()
+        assert store.list_sources() == ref_store.list_sources()
+        # The resumed journal replays to the same live state.
+        replayed, _tag = replay_journal(tmp_path / "crash.jrnl")
+        replayed.sync()
+        assert replayed.list_sources() == store.list_sources()
+
+
+class TestLatencyAccounting:
+    def test_per_request_spans_and_quantiles(self, tmp_path):
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            store = TensorReliabilityStore()
+            trace = [(f"m-{i}", [("s", 0.5)], True) for i in range(6)]
+            run_service(
+                store, trace, tmp_path, "lat", width=3, db=False
+            )
+        finally:
+            obs.set_metrics_registry(previous)
+        export = registry.export()
+        n = len(trace)
+        for span in ("enqueue", "coalesce", "dispatch", "durable", "total"):
+            hist = export["histograms"][f"serve.latency_{span}_s"]
+            assert hist["count"] == n, span
+        # The quantile surface: p50 ≤ p99, both defined, exactly the
+        # Histogram.quantile the stats renderer uses.
+        total = registry.histogram("serve.latency_total_s")
+        p50, p99 = total.quantile(0.5), total.quantile(0.99)
+        assert p50 is not None and p99 is not None and p50 <= p99
+        summary = total.summary()
+        assert summary["count"] == n and summary["p99"] == p99
+        assert export["gauges"]["serve.pending_requests"] == 0.0
+
+
+class TestSessionDriverApi:
+    """The tentpole's refactor contract: SessionDriver driven directly
+    (the serving worker's shape) equals settle_stream on the same
+    batches — and PlanCache makes the same reuse decisions as the
+    prefetcher."""
+
+    def test_manual_drive_equals_stream(self, tmp_path):
+        trace = mixed_trace()
+        svc_store = TensorReliabilityStore()
+        service, _ = run_service(svc_store, trace, tmp_path, "log")
+        batches = service.batch_log
+
+        store = TensorReliabilityStore()
+        driver = SessionDriver(
+            store, steps=2,
+            journal=JournalWriter(tmp_path / "drv.jrnl"),
+            owns_journal=True, db_path=tmp_path / "drv.db",
+            checkpoint_every=2,
+        )
+        plans = PlanCache(store)
+        reused = []
+        try:
+            for index, ((keys, sids, probs, offsets), outcomes) in (
+                enumerate(batches)
+            ):
+                plan = plans.plan_for(keys, sids, probs, offsets)
+                reused.append(plan is not plans.last_plan or (
+                    getattr(plan, "_refreshed_from", None) is not None
+                ))
+                driver.dispatch(plan, outcomes, now=NOW + index)
+                driver.checkpoint(index)
+        finally:
+            driver.finalize()
+        store.sync()
+
+        ref_store = TensorReliabilityStore()
+        run_stream(ref_store, batches, tmp_path, "drvref", mesh=None)
+        assert store.list_sources() == ref_store.list_sources()
+        assert journal_epochs_sans_clock(tmp_path / "drv.jrnl") == (
+            journal_epochs_sans_clock(tmp_path / "drvref.jrnl")
+        )
+        assert (tmp_path / "drv.db").read_bytes() == (
+            tmp_path / "drvref.db"
+        ).read_bytes()
+        # The steady second round and the drifted second round were
+        # fingerprint hits — PlanCache refreshed instead of rebuilding.
+        assert reused[1] and reused[3]
+
+    def test_driver_validates_like_the_stream(self):
+        store = TensorReliabilityStore()
+        with pytest.raises(ValueError, match="checkpoint_every"):
+            SessionDriver(store, checkpoint_every=0)
+        with pytest.raises(ValueError, match="lazy_checkpoints"):
+            SessionDriver(
+                store, journal=object.__new__(JournalWriter),
+                lazy_checkpoints=True,
+            )
