@@ -18,7 +18,9 @@ Extensions (additive, do not change reference-shaped outputs): ``--backend
 ``--db`` — the crash-recovery path without writing Python; ``lint`` runs
 graftlint, the repo's JAX/determinism/layering static analysis
 (docs/static-analysis.md); ``stats`` renders an obs run ledger
-(obs/ledger.py JSONL — the min-of-N bench discipline) as per-leg bands.
+(obs/ledger.py JSONL — the min-of-N bench discipline) as per-leg bands;
+``trace`` converts a request-tracing span log (obs/trace.py JSONL) to
+Chrome/Perfetto trace-event JSON.
 """
 
 from __future__ import annotations
@@ -266,6 +268,42 @@ def _run_stats(args: argparse.Namespace) -> None:
         print(render(records))
 
 
+def _run_trace(args: argparse.Namespace) -> None:
+    """Convert a tracer span log (JSONL) to Chrome trace-event JSON.
+
+    The reading half of request-scoped tracing (obs/trace.py): a service
+    dumps its span log with ``Tracer.write_jsonl``; this subcommand
+    converts it to the Chrome trace-event format, which loads at
+    https://ui.perfetto.dev (or ``chrome://tracing``) — host request/
+    batch/journal spans on named lanes, viewable alongside a device
+    profile captured with ``utils.profiling.trace``. Output keys are
+    sorted (deterministic bytes for a deterministic span log, the DT203
+    contract).
+    """
+    from bayesian_consensus_engine_tpu.obs.trace import (
+        load_trace_jsonl,
+        to_chrome_trace,
+    )
+
+    try:
+        events = load_trace_jsonl(args.trace)
+        document = to_chrome_trace(events)
+        out = args.out or (args.trace + ".chrome.json")
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(document, f, sort_keys=True)
+    except (OSError, ValueError) as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+    _emit(
+        {
+            "trace": args.trace,
+            "events": len(events),
+            "traceEvents": len(document["traceEvents"]),
+            "out": out,
+        }
+    )
+
+
 def _run_lint(args: argparse.Namespace) -> None:
     # Lazy import: the lint engine is tool code and the hot CLI paths
     # (consensus on stdin) should not pay for loading it.
@@ -384,6 +422,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="machine-readable summary instead of the table",
     )
     stats.set_defaults(handler=_run_stats)
+
+    trace = sub.add_parser(
+        "trace",
+        help=(
+            "convert a tracer span log (obs.Tracer.write_jsonl JSONL) "
+            "to Chrome/Perfetto trace-event JSON"
+        ),
+    )
+    trace.add_argument(
+        "trace", help="path to a span-log JSONL written by obs.Tracer"
+    )
+    trace.add_argument(
+        "--out",
+        help="output path (default: <trace>.chrome.json)",
+    )
+    trace.set_defaults(handler=_run_trace)
 
     lint = sub.add_parser(
         "lint",

@@ -62,11 +62,14 @@ LAYER_IMPORT_OVERRIDES: dict[str, frozenset[str]] = {
 #: Segments allowed to import ``obs`` (LY303). Observability is an
 #: orchestration concern: the streamed service, the state tiers whose
 #: fsync/export phases it names, and the CLI that renders ledgers. The
-#: pure-math layers (``ops``, ``parallel``, ``core``, ``models``,
-#: ``utils``) must stay instrumentation-free — a kernel module that grows
-#: a host-side timing dependency is a kernel module one refactor away
-#: from a host sync. bench/scripts/tests live outside the package and
-#: are unconstrained.
+#: allowlist covers the whole ``obs`` surface — metrics/timeline/ledger
+#: AND the round-9 tracing/SLO modules (``obs.trace``, ``obs.slo``): a
+#: request tracer in a kernel would be a host-sync magnet exactly like a
+#: timer. The pure-math layers (``ops``, ``parallel``, ``core``,
+#: ``models``, ``utils``) must stay instrumentation-free — a kernel
+#: module that grows a host-side timing dependency is a kernel module
+#: one refactor away from a host sync. bench/scripts/tests live outside
+#: the package and are unconstrained.
 OBS_ALLOWED_IMPORTERS: frozenset[str] = frozenset(
     {"obs", "pipeline", "serve", "state", "cli", "__init__"}
 )

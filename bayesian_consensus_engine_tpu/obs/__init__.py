@@ -1,6 +1,6 @@
-"""obs — host-side observability: metrics, phase timelines, run ledger.
+"""obs — host-side observability: metrics, timelines, tracing, SLO, ledger.
 
-Three pillars, one contract:
+Five pillars, one contract:
 
 * :mod:`~.obs.metrics` — process-local counters/gauges/log-spaced
   histograms with deterministic sorted-JSON export and a zero-overhead
@@ -8,16 +8,24 @@ Three pillars, one contract:
 * :mod:`~.obs.timeline` — named ``perf_counter`` phase spans with
   exclusive attribution, thread-locally activated, so a leg's wall clock
   decomposes additively into the canonical :data:`~.obs.timeline.PHASES`.
+* :mod:`~.obs.trace` — request-scoped span chains with deterministic
+  submit-sequence ids, a bounded per-component flight recorder for crash
+  postmortems, and Chrome/Perfetto trace-event export (``bce-tpu
+  trace``).
+* :mod:`~.obs.slo` — per-request latency objectives and goodput
+  accounting (met / violated / shed / rejected → ``goodput_within_slo``,
+  cumulative and windowed).
 * :mod:`~.obs.ledger` — an append-only JSONL record of every bench/soak
   measurement (host load, backend, repeat index) plus the min-of-N
   repeat-policy helpers; rendered by ``bce-tpu stats``.
 
-The contract: obs is pure host, stdlib-only, never traced, and write-only
-from the engine's point of view — enabling it changes NO settlement byte
-(golden-fixture parity pinned by tests/test_obs.py) and importing it is
-confined to the orchestration layers (``pipeline``, ``state``, ``cli``,
-bench/scripts — lint rule LY303; ``ops``/``parallel`` kernels stay
-instrumentation-free).
+The contract: obs is pure host, stdlib-only, never traced by JAX, and
+write-only from the engine's point of view — enabling it changes NO
+settlement byte (golden-fixture parity pinned by tests/test_obs.py; the
+tracing/SLO layer re-pinned by tests/test_trace.py and tests/
+test_serve.py) and importing it is confined to the orchestration layers
+(``pipeline``, ``serve``, ``state``, ``cli``, bench/scripts — lint rule
+LY303; ``ops``/``parallel`` kernels stay instrumentation-free).
 """
 
 from bayesian_consensus_engine_tpu.obs.ledger import (
@@ -40,6 +48,12 @@ from bayesian_consensus_engine_tpu.obs.metrics import (
     quantile_from_snapshot,
     set_metrics_registry,
 )
+from bayesian_consensus_engine_tpu.obs.slo import (
+    LatencyObjective,
+    OUTCOMES,
+    SloTracker,
+    goodput_from_counts,
+)
 from bayesian_consensus_engine_tpu.obs.timeline import (
     NULL_TIMELINE,
     PHASES,
@@ -47,20 +61,40 @@ from bayesian_consensus_engine_tpu.obs.timeline import (
     active_timeline,
     recording,
 )
+from bayesian_consensus_engine_tpu.obs.trace import (
+    NULL_TRACER,
+    REQUEST_STAGES,
+    TraceContext,
+    Tracer,
+    active_tracer,
+    load_trace_jsonl,
+    set_tracer,
+    to_chrome_trace,
+)
 
 __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "LatencyObjective",
     "MetricsRegistry",
     "NULL_REGISTRY",
     "NULL_TIMELINE",
+    "NULL_TRACER",
+    "OUTCOMES",
     "PHASES",
     "PhaseTimeline",
+    "REQUEST_STAGES",
     "RunLedger",
+    "SloTracker",
+    "TraceContext",
+    "Tracer",
     "active_timeline",
+    "active_tracer",
     "diff_bands",
+    "goodput_from_counts",
     "host_snapshot",
+    "load_trace_jsonl",
     "log_spaced_bounds",
     "metrics_registry",
     "min_of_repeats",
@@ -69,5 +103,7 @@ __all__ = [
     "recording",
     "render_diff",
     "set_metrics_registry",
+    "set_tracer",
     "summarize",
+    "to_chrome_trace",
 ]

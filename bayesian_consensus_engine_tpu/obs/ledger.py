@@ -196,6 +196,7 @@ def min_of_repeats(
         ),
     }
     band.update(_latency_quantiles(records, leg))
+    band.update(_slo_summary(records, leg))
     return band
 
 
@@ -246,6 +247,48 @@ def _latency_quantiles(
     }
 
 
+def _slo_summary(
+    records: List[Dict[str, object]], leg: str
+) -> Dict[str, object]:
+    """Merged SLO/goodput accounting over a leg's records.
+
+    Records carrying ``extras["slo"]`` (an
+    :meth:`~.slo.SloTracker.snapshot`-shaped dict — the serving bench's
+    per-act record) are merged across repeats by summing per-outcome
+    ``counts``; the merged ``goodput_within_slo`` fraction (met /
+    offered, refused traffic counting against — the goodput-within-
+    objective framing) lands in the band next to the latency quantiles.
+    Legs without SLO records contribute nothing, so the stats table
+    renders dashes.
+    """
+    from bayesian_consensus_engine_tpu.obs.slo import goodput_from_counts
+
+    merged: Dict[str, int] = {}
+    objective = None
+    for rec in records:
+        if rec.get("leg") != leg:
+            continue
+        slo = (rec.get("extras") or {}).get("slo")
+        if not isinstance(slo, dict):
+            continue
+        counts = slo.get("counts")
+        if not isinstance(counts, dict):
+            continue
+        for name in sorted(counts):
+            value = counts[name]
+            if isinstance(value, (int, float)):
+                merged[name] = merged.get(name, 0) + int(value)
+        if isinstance(slo.get("objective_s"), (int, float)):
+            objective = float(slo["objective_s"])
+    if not merged:
+        return {}
+    return {
+        "slo_objective_s": objective,
+        "slo_counts": merged,
+        "goodput_within_slo": goodput_from_counts(merged),
+    }
+
+
 def summarize(records: List[Dict[str, object]]) -> Dict[str, Dict[str, object]]:
     """Per-leg min/max bands over a whole ledger, legs sorted by name."""
     legs = sorted({rec.get("leg") for rec in records if rec.get("leg")})
@@ -282,7 +325,14 @@ def diff_bands(
       only one ledger (added, removed, or failed legs).
 
     The ``old``/``new`` bands are included verbatim so a renderer (or a
-    round note) can quote the ranges, not just the flag.
+    round note) can quote the ranges, not just the flag. Legs whose
+    bands carry the merged per-request latency quantiles
+    (``extras.latency_hist`` → ``p50``/``p99``) or SLO accounting
+    (``extras.slo`` → ``goodput_within_slo``) additionally get a
+    ``metrics`` mapping with each side's value — the serving leg's p99
+    and goodput move across rounds even when the wall band overlaps, and
+    a diff that ignored them would miss exactly the regressions the
+    latency records exist to catch.
     """
     old_summary = summarize(old_records)
     new_summary = summarize(new_records)
@@ -304,13 +354,27 @@ def diff_bands(
             status = "shifted_down"
         else:
             status = "overlap"
-        out[leg] = {"leg": leg, "status": status,
-                    "old": old_band, "new": new_band}
+        entry: Dict[str, object] = {"leg": leg, "status": status,
+                                    "old": old_band, "new": new_band}
+        metrics: Dict[str, Dict[str, object]] = {}
+        for name in ("p50", "p99", "goodput_within_slo"):
+            old_value = (old_band or {}).get(name)
+            new_value = (new_band or {}).get(name)
+            if old_value is not None or new_value is not None:
+                metrics[name] = {"old": old_value, "new": new_value}
+        if metrics:
+            entry["metrics"] = metrics
+        out[leg] = entry
     return out
 
 
 def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
-    """Human-readable cross-round table for ``bce-tpu stats --against``."""
+    """Human-readable cross-round table for ``bce-tpu stats --against``.
+
+    Legs with merged latency/SLO metrics get a ``p99 old→new`` (and
+    ``goodput old→new``) trailer so the serving leg's per-request story
+    diffs alongside its wall band.
+    """
     if not diff:
         return "no legs in either ledger"
 
@@ -318,6 +382,15 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
         if band is None or band["min"] is None:
             return "-"
         return f"{band['min']:.4g}..{band['max']:.4g}"
+
+    def metric_str(entry, name):
+        metric = (entry.get("metrics") or {}).get(name)
+        if not metric:
+            return ""
+        def num(x):
+            return f"{x:.4g}" if isinstance(x, (int, float)) else "-"
+        label = "goodput" if name == "goodput_within_slo" else name
+        return f"  {label} {num(metric['old'])}->{num(metric['new'])}"
 
     lines = [
         f"{'leg':<34} {'old band':>16} {'new band':>16} {'status':>13} unit"
@@ -328,9 +401,14 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
         unit = (band or {}).get("unit") or "-"
         if entry["status"] in ("shifted_up", "shifted_down"):
             moved += 1
+        trailer = "".join(
+            metric_str(entry, name)
+            for name in ("p99", "goodput_within_slo")
+        )
         lines.append(
             f"{leg:<34} {band_str(entry['old']):>16} "
             f"{band_str(entry['new']):>16} {entry['status']:>13} {unit}"
+            f"{trailer}"
         )
     lines.append(
         f"{moved} leg(s) stopped overlapping"
@@ -345,14 +423,17 @@ def render(records: List[Dict[str, object]]) -> str:
 
     The ``p50``/``p99`` columns render for legs whose records carry
     per-request latency distributions (``extras.latency_hist`` — the
-    serving bench); every other leg shows dashes.
+    serving bench), and ``goodput`` for legs carrying SLO accounting
+    (``extras.slo`` — the fraction of offered requests that completed
+    within the objective); every other leg shows dashes.
     """
     summary = summarize(records)
     if not summary:
         return "empty ledger"
     lines = [
         f"{'leg':<34} {'n':>3} {'min':>12} {'max':>12} "
-        f"{'spread':>7} {'p50':>9} {'p99':>9} {'load(1m)':>12} unit"
+        f"{'spread':>7} {'p50':>9} {'p99':>9} {'goodput':>8} "
+        f"{'load(1m)':>12} unit"
     ]
     for leg, band in summary.items():
 
@@ -370,10 +451,16 @@ def render(records: List[Dict[str, object]]) -> str:
             if isinstance(band["spread_pct"], (int, float))
             else "-"
         )
+        goodput = band.get("goodput_within_slo")
+        goodput_str = (
+            f"{goodput * 100:.1f}%"
+            if isinstance(goodput, (int, float))
+            else "-"
+        )
         lines.append(
             f"{leg:<34} {band['n']:>3} {num(band['min']):>12} "
             f"{num(band['max']):>12} {spread:>7} "
             f"{num(band.get('p50')):>9} {num(band.get('p99')):>9} "
-            f"{load:>12} {band['unit'] or '-'}"
+            f"{goodput_str:>8} {load:>12} {band['unit'] or '-'}"
         )
     return "\n".join(lines)

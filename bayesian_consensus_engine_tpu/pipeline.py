@@ -57,6 +57,7 @@ from bayesian_consensus_engine_tpu.obs.timeline import (
     PhaseTimeline,
     active_timeline,
 )
+from bayesian_consensus_engine_tpu.obs.trace import active_tracer
 from bayesian_consensus_engine_tpu.utils.config import (
     CONFIDENCE_GROWTH_RATE,
     DEFAULT_CONFIDENCE,
@@ -1745,6 +1746,16 @@ def settle_stream(
     (``stream.batches``, ``stream.plan_reuse_hits``/``misses``,
     ``stream.settle_dispatch_s``, ``stream.plan_build_s``) — all no-ops
     unless :func:`~.obs.metrics.set_metrics_registry` enabled one.
+    With a process tracer active (:func:`~.obs.trace.set_tracer`) each
+    batch additionally records a span chain — ``pack`` plus every
+    canonical phase span taken inside its dispatch/checkpoint window,
+    and the driver's ``durable_watermark`` — keyed by batch index
+    (deterministic ids; same contract as the serving front end, and the
+    same ``bce-tpu trace`` Perfetto export reads both). Under ``mesh=``
+    an enabled metrics registry also gets ``hbm.bytes_in_use``/
+    ``hbm.peak_bytes`` gauges sampled at the dispatch and checkpoint
+    phase boundaries (zeros on backends without allocator stats).
+    Tracing on vs off moves no settlement byte, like the rest of obs.
 
     *mesh*, if given, runs every settle sharded over the device mesh
     through ONE long-lived :class:`ShardedSettlementSession` (markets on
@@ -1898,6 +1909,7 @@ def settle_stream(
     # counters feed the process metrics registry (null by default).
     timeline = active_timeline()
     registry = metrics_registry()
+    tracer = active_tracer()
     batches_counter = registry.counter("stream.batches")
     reuse_hit_counter = registry.counter("stream.plan_reuse_hits")
     reuse_miss_counter = registry.counter("stream.plan_reuse_misses")
@@ -1945,39 +1957,55 @@ def settle_stream(
                     getattr(plan, "_refreshed_from", None) is not None
                 )
                 batch_band = band(index) if callable(band) else band
-                settle_start = _time.perf_counter()
-                result = driver.dispatch(
-                    plan, outcomes, now=batch_now, band=batch_band
-                )
-                session_adopt = driver.last_adopt
-                settle_dispatch_s = _time.perf_counter() - settle_start
-                batches_counter.inc()
-                (reuse_hit_counter if plan_reused
-                 else reuse_miss_counter).inc()
-                dispatch_hist.observe(settle_dispatch_s)
-                # Appended BEFORE the checkpoint so ``len(stats)`` is the
-                # SETTLED count even when the checkpoint raises: a failing
-                # batch has settled but never yields, and a consumer that
-                # restarted from its yielded count would re-settle it
-                # (doubling its updates). Resume with batches[len(stats):].
-                if stats is not None:
-                    stats.append(
-                        {
-                            "batch": index,
-                            "markets": plan.num_markets,
-                            "plan_wait_s": plan_wait_s,
-                            "settle_dispatch_s": settle_dispatch_s,
-                            "checkpoint_s": None,
-                            "plan_reused": plan_reused,
-                            "session_adopt": session_adopt,
-                        }
+                # The trace scope (obs/trace.py; a shared no-op when no
+                # tracer is active): phase spans taken inside — the
+                # dispatch's upload/settle_dispatch, the checkpoint's
+                # journal/interchange — land on batch `index`'s chain,
+                # the same chains the serving front end records. Closed
+                # BEFORE the yield so the consumer never runs inside the
+                # batch's recording window.
+                with tracer.batch(index):
+                    if tracer.enabled:
+                        tracer.batch_event(
+                            index, "pack", dur_s=plan_wait_s,
+                            args={"markets": plan.num_markets,
+                                  "plan_reused": plan_reused},
+                        )
+                    settle_start = _time.perf_counter()
+                    result = driver.dispatch(
+                        plan, outcomes, now=batch_now, band=batch_band
                     )
-                # Rolling durability rides the driver: journal mode appends
-                # one epoch (tag = this settled batch; async by default —
-                # the PREVIOUS epoch's completion or failure surfaces at
-                # the join inside the call), SQLite mode backgrounds the
-                # rolling flush. ``None`` when this batch is off-cadence.
-                checkpoint_s = driver.checkpoint(index)
+                    session_adopt = driver.last_adopt
+                    settle_dispatch_s = _time.perf_counter() - settle_start
+                    batches_counter.inc()
+                    (reuse_hit_counter if plan_reused
+                     else reuse_miss_counter).inc()
+                    dispatch_hist.observe(settle_dispatch_s)
+                    # Appended BEFORE the checkpoint so ``len(stats)`` is
+                    # the SETTLED count even when the checkpoint raises: a
+                    # failing batch has settled but never yields, and a
+                    # consumer that restarted from its yielded count would
+                    # re-settle it (doubling its updates). Resume with
+                    # batches[len(stats):].
+                    if stats is not None:
+                        stats.append(
+                            {
+                                "batch": index,
+                                "markets": plan.num_markets,
+                                "plan_wait_s": plan_wait_s,
+                                "settle_dispatch_s": settle_dispatch_s,
+                                "checkpoint_s": None,
+                                "plan_reused": plan_reused,
+                                "session_adopt": session_adopt,
+                            }
+                        )
+                    # Rolling durability rides the driver: journal mode
+                    # appends one epoch (tag = this settled batch; async
+                    # by default — the PREVIOUS epoch's completion or
+                    # failure surfaces at the join inside the call),
+                    # SQLite mode backgrounds the rolling flush. ``None``
+                    # when this batch is off-cadence.
+                    checkpoint_s = driver.checkpoint(index)
                 if checkpoint_s is not None and stats is not None:
                     stats[-1]["checkpoint_s"] = checkpoint_s
                 if phase_mark is not None and stats is not None:

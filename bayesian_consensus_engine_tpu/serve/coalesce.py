@@ -50,7 +50,32 @@ the dispatch worker — where backpressure surfaces),
 ``serve.latency_durable_s`` (settled → covering journal epoch fsynced;
 journal mode only) and ``serve.latency_total_s`` (submit → durable, or →
 settled without a journal). ``Histogram.quantile`` turns them into the
-p50/p99 a load test quotes.
+p50/p99 a load test quotes. Only requests that actually completed land
+in the histograms: a shed or rejected request is counted in
+``serve.shed``/``serve.rejected`` (and classified by the SLO tracker),
+never recorded as a phantom completion.
+
+**Tracing and SLO** (round 9). When a tracer is active
+(:func:`~.obs.trace.set_tracer`), every request carries a
+:class:`~.obs.trace.TraceContext` whose id is its SUBMIT SEQUENCE NUMBER
+— assigned in submission order for every arrival (admitted, shed, or
+rejected), so trace ids are a deterministic function of the request
+trace — and its chain (``enqueue`` → ``window_join`` → ``flush`` →
+``settled`` → ``durable``, or a terminal ``rejected``/``shed``/
+``failed``) is recorded across the asyncio → worker boundary; the
+dispatch worker wraps each batch in :meth:`~.obs.trace.Tracer.batch`, so
+the canonical phase spans taken inside ``SessionDriver.dispatch`` /
+``checkpoint`` land on the batch's chain. On an unhandled dispatch or
+journal failure (and on :meth:`close`) the service snapshots the
+tracer's flight recorder into :attr:`flight_dump` — the crash
+postmortem. Declaring ``slo=`` (seconds, or a
+:class:`~.obs.slo.LatencyObjective`) classifies every request that left
+the service as met / violated / shed / rejected / failed
+(:class:`~.obs.slo.SloTracker`; counters ``serve.slo_met``/
+``serve.slo_violated``, gauge ``serve.goodput_within_slo``) —
+:meth:`goodput` is the summary the ``e2e_serve`` bench records. Both
+layers are write-only: tracing/SLO on vs off moves no settlement byte
+(pinned by tests/test_serve.py and tests/test_trace.py).
 
 **Threading.** All coalescing runs on the asyncio event loop thread;
 settlement runs on ONE dedicated worker thread (batches dispatch in flush
@@ -69,6 +94,9 @@ from typing import Any, Mapping, Optional, Sequence, Union
 import numpy as np
 
 from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
+from bayesian_consensus_engine_tpu.obs.slo import SloTracker
+from bayesian_consensus_engine_tpu.obs.timeline import active_timeline
+from bayesian_consensus_engine_tpu.obs.trace import TraceContext, active_tracer
 from bayesian_consensus_engine_tpu.serve.admission import (
     AdmissionConfig,
     AdmissionController,
@@ -93,15 +121,17 @@ class ServeResult:
 class _Request:
     __slots__ = (
         "market_id", "source_ids", "probabilities", "outcome", "future",
-        "t_submit", "t_enqueued", "t_flush",
+        "ctx", "t_submit", "t_enqueued", "t_flush",
     )
 
-    def __init__(self, market_id, source_ids, probabilities, outcome, future):
+    def __init__(self, market_id, source_ids, probabilities, outcome, future,
+                 ctx):
         self.market_id = market_id
         self.source_ids = source_ids
         self.probabilities = probabilities
         self.outcome = outcome
         self.future = future
+        self.ctx = ctx
         self.t_submit = 0.0
         self.t_enqueued = 0.0
         self.t_flush = 0.0
@@ -150,6 +180,14 @@ class ConsensusService:
     exactness tests (and a crash post-mortem) feed back through
     ``settle_stream``. Off by default: a long-running service must not
     grow an unbounded log.
+
+    ``slo`` declares the per-request latency objective (seconds or a
+    :class:`~.obs.slo.LatencyObjective`): every request that leaves the
+    service is classified met / violated / shed / rejected and
+    :meth:`goodput` reports the ``goodput_within_slo`` fraction.
+    Tracing rides the process tracer (:func:`~.obs.trace.set_tracer`) —
+    see the module docstring for the span chain and the
+    :attr:`flight_dump` postmortem contract.
     """
 
     def __init__(
@@ -167,6 +205,7 @@ class ConsensusService:
         max_batch: int = 256,
         max_delay_s: Optional[float] = 0.005,
         admission: Optional[AdmissionConfig] = None,
+        slo=None,
         record_batches: bool = False,
     ) -> None:
         if max_batch < 1:
@@ -203,6 +242,18 @@ class ConsensusService:
             admission if admission is not None else AdmissionConfig()
         )
 
+        #: SLO accounting (obs/slo.py): classify every request that left
+        #: the service; None when no objective was declared.
+        self._slo = SloTracker(slo) if slo is not None else None
+        #: Submit sequence — the deterministic trace id. Every arrival
+        #: burns one (admitted, shed, or rejected), so ids are a pure
+        #: function of the request trace, never of timing or identity.
+        self._submit_seq = 0
+        #: The latest flight-recorder snapshot (obs/trace.py): taken at
+        #: the moment of an unhandled dispatch/journal failure, or on a
+        #: clean close. None when no tracer was active.
+        self.flight_dump = None
+
         self._windows: list[_Window] = []
         self._resident = 0  # submitted and not yet settled (the bound)
         self._next_batch = 0
@@ -225,6 +276,9 @@ class ConsensusService:
         self._hist_dispatch = registry.histogram("serve.latency_dispatch_s")
         self._hist_durable = registry.histogram("serve.latency_durable_s")
         self._hist_total = registry.histogram("serve.latency_total_s")
+        self._slo_met_counter = registry.counter("serve.slo_met")
+        self._slo_violated_counter = registry.counter("serve.slo_violated")
+        self._goodput_gauge = registry.gauge("serve.goodput_within_slo")
 
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="bce-serve-dispatch"
@@ -264,27 +318,47 @@ class ConsensusService:
         if self._loop is None:
             self._loop = asyncio.get_running_loop()
         self._requests_counter.inc()
-        if self._admission.decide(self._resident) == "shed_oldest":
+        ctx = TraceContext(self._submit_seq, market_id)
+        self._submit_seq += 1
+        tracer = active_tracer()
+        try:
+            decision = self._admission.decide(self._resident)
+        except Overloaded:
+            self._count_refused(ctx, "rejected")
+            raise
+        if decision == "shed_oldest":
             if self._shed_oldest():
                 self._admission.count_shed()
             else:
                 # Everything resident is already dispatch-bound — nothing
                 # left to shed; degrade to rejection so the bound holds.
                 self._admission.count_degraded_reject()
+                self._count_refused(ctx, "rejected")
                 raise Overloaded(
                     self._admission.config.retry_after_s, self._resident
                 )
         source_ids, probabilities = _normalise_signals(signals)
         request = _Request(
             market_id, source_ids, probabilities, bool(outcome),
-            self._loop.create_future(),
+            self._loop.create_future(), ctx,
         )
         request.t_submit = t_submit
         window = self._place(request)
         self._resident += 1
         self._pending_gauge.set(float(self._resident))
         request.t_enqueued = _time.perf_counter()
-        self._hist_enqueue.observe(request.t_enqueued - t_submit)
+        # The enqueue span is OBSERVED at flush time (with coalesce), so
+        # a later-shed request never lands in the latency histograms as a
+        # phantom completion; its trace event still records here.
+        if tracer.enabled:
+            tracer.request_event(
+                ctx, "enqueue", dur_s=request.t_enqueued - t_submit,
+                args={"market": market_id},
+            )
+            tracer.request_event(
+                ctx, "window_join",
+                args={"window_position": len(window.requests) - 1},
+            )
         # Size trigger: only the window this request joined can have
         # newly filled (an O(1) check — scanning every open window would
         # be O(windows) per submit on the hot-key path). When it fills,
@@ -333,8 +407,42 @@ class ConsensusService:
                             "overload (shed_oldest policy)"
                         )
                     )
+                self._count_refused(victim.ctx, "shed")
                 return True
         return False
+
+    def _count_refused(self, ctx: TraceContext, outcome: str) -> None:
+        """A request that will never settle: SLO-classify and trace it.
+
+        Refused requests count AGAINST goodput (the whole point of the
+        goodput-within-objective framing) but never enter the latency
+        histograms — there is no completion latency to record.
+        """
+        if self._slo is not None:
+            self._slo.record(outcome)
+            self._update_goodput_gauge()
+        tracer = active_tracer()
+        if tracer.enabled:
+            tracer.request_event(
+                ctx, outcome,
+                args={"market": ctx.market_id, "pending": self._resident},
+            )
+
+    def _update_goodput_gauge(self) -> None:
+        goodput = self._slo.goodput_within_slo()
+        if goodput is not None:
+            self._goodput_gauge.set(goodput)
+
+    def _count_failed(self, n: int) -> None:
+        """*n* requests lost to a dispatch/journal failure (worker
+        thread): they count against goodput like refused traffic — a
+        goodput number that forgot crash-eaten requests would overstate
+        health exactly when it matters."""
+        if self._slo is None:
+            return
+        for _ in range(n):
+            self._slo.record("failed")
+        self._update_goodput_gauge()
 
     # -- flushing (event-loop thread) ----------------------------------------
 
@@ -367,6 +475,9 @@ class ConsensusService:
         if not requests:
             return
         t_flush = _time.perf_counter()
+        batch_index = self._next_batch
+        self._next_batch += 1
+        tracer = active_tracer()
         keys = [r.market_id for r in requests]
         source_ids: list[str] = []
         probabilities: list[float] = []
@@ -376,11 +487,19 @@ class ConsensusService:
             probabilities.extend(request.probabilities)
             offsets[i + 1] = len(source_ids)
             request.t_flush = t_flush
+            # Flush commits the request to a batch: only now do its
+            # enqueue/coalesce spans enter the histograms (a shed victim
+            # never reaches this point, so never counts).
+            self._hist_enqueue.observe(request.t_enqueued - request.t_submit)
             self._hist_coalesce.observe(t_flush - request.t_enqueued)
+            if tracer.enabled:
+                tracer.request_event(
+                    request.ctx, "flush",
+                    dur_s=t_flush - request.t_enqueued,
+                    args={"batch": batch_index},
+                )
         probabilities = np.asarray(probabilities, dtype=np.float64)
         outcomes = [r.outcome for r in requests]
-        batch_index = self._next_batch
-        self._next_batch += 1
         self._batches_counter.inc()
         if self._record_batches:
             self.batch_log.append(
@@ -399,34 +518,65 @@ class ConsensusService:
     def _run_batch(self, batch_index, keys, source_ids, probabilities,
                    offsets, outcomes, requests) -> None:
         loop = self._loop
+        tracer = active_tracer()
         if self._failure is not None:
             failure = ServiceClosed(
                 f"batch {batch_index} abandoned after an earlier failure"
             )
+            if tracer.enabled:
+                for request in requests:
+                    tracer.request_event(
+                        request.ctx, "failed",
+                        args={"batch": batch_index, "abandoned": True},
+                    )
+            self._count_failed(len(requests))
             for request in requests:
                 loop.call_soon_threadsafe(
                     self._resolve, request, None, failure
                 )
             return
         try:
-            plan = self._plans.plan_for(
-                keys, source_ids, probabilities, offsets
-            )
-            batch_now = (
-                None if self._now is None else self._now + batch_index
-            )
-            result = self._driver.dispatch(
-                plan, outcomes, now=batch_now, band=None
-            )
-            consensus = np.asarray(result.consensus)
-            t_settled = _time.perf_counter()
-            if self._journal_mode:
-                self._await_durable.append(
-                    (batch_index, [(r, t_settled) for r in requests])
+            # The batch scope: every canonical phase span taken inside
+            # (the plan build here, upload/settle_dispatch in dispatch,
+            # checkpoint/journal in the durability step) lands on batch
+            # `batch_index`'s trace chain — the TraceContext propagation
+            # across the asyncio → worker boundary, without new
+            # instrumentation at the span sites.
+            with tracer.batch(batch_index, args={"markets": len(keys)}):
+                with active_timeline().span("pack"):
+                    plan = self._plans.plan_for(
+                        keys, source_ids, probabilities, offsets
+                    )
+                batch_now = (
+                    None if self._now is None else self._now + batch_index
                 )
-            self._driver.checkpoint(batch_index)
+                result = self._driver.dispatch(
+                    plan, outcomes, now=batch_now, band=None
+                )
+                consensus = np.asarray(result.consensus)
+                t_settled = _time.perf_counter()
+                self._driver.checkpoint(batch_index)
+                if self._journal_mode:
+                    # Appended AFTER the checkpoint: a batch whose own
+                    # checkpoint raised is classified failed on the
+                    # except path, never double-counted as a straggler.
+                    self._await_durable.append(
+                        (batch_index, [(r, t_settled) for r in requests])
+                    )
         except BaseException as exc:  # noqa: BLE001 — routed to futures
             self._failure = exc
+            if tracer.enabled:
+                for request in requests:
+                    tracer.request_event(
+                        request.ctx, "failed", args={"batch": batch_index}
+                    )
+                # The postmortem is snapshotted AT the failure, while the
+                # flight rings still hold the failing batch's chains.
+                self.flight_dump = tracer.flight_dump(
+                    reason=f"dispatch failure at batch {batch_index}: "
+                           f"{exc!r}"
+                )
+            self._count_failed(len(requests))
             for request in requests:
                 loop.call_soon_threadsafe(self._resolve, request, None, exc)
             return
@@ -435,11 +585,18 @@ class ConsensusService:
         # observes a result whose durability window has silently failed.
         for i, request in enumerate(requests):
             self._hist_dispatch.observe(t_settled - request.t_flush)
+            if tracer.enabled:
+                tracer.request_event(
+                    request.ctx, "settled",
+                    dur_s=t_settled - request.t_flush,
+                    args={"batch": batch_index},
+                )
             value = ServeResult(
                 request.market_id, float(consensus[i]), batch_index
             )
             if not self._journal_mode:
                 self._hist_total.observe(t_settled - request.t_submit)
+                self._classify_completion(t_settled - request.t_submit)
             loop.call_soon_threadsafe(self._resolve, request, value, None)
         self._observe_durable()
 
@@ -447,13 +604,40 @@ class ConsensusService:
         """Fold the driver's durable watermark into per-request spans."""
         durable_through = self._driver.durable_through
         t_durable = _time.perf_counter()
+        tracer = active_tracer()
         while self._await_durable and (
             self._await_durable[0][0] <= durable_through
         ):
-            _, entries = self._await_durable.pop(0)
+            batch_index, entries = self._await_durable.pop(0)
             for request, t_settled in entries:
                 self._hist_durable.observe(t_durable - t_settled)
                 self._hist_total.observe(t_durable - request.t_submit)
+                if tracer.enabled:
+                    tracer.request_event(
+                        request.ctx, "durable",
+                        dur_s=t_durable - t_settled,
+                        args={"batch": batch_index},
+                    )
+                self._classify_completion(t_durable - request.t_submit)
+
+    def _classify_completion(self, latency_s: float) -> None:
+        """SLO-classify one COMPLETED request (its strongest signal:
+        durable in journal mode, settled otherwise)."""
+        if self._slo is None:
+            return
+        outcome = self._slo.record_latency(latency_s)
+        (
+            self._slo_met_counter if outcome == "met"
+            else self._slo_violated_counter
+        ).inc()
+        self._update_goodput_gauge()
+
+    def goodput(self) -> Optional[dict]:
+        """The SLO tracker's snapshot (``None`` without an objective):
+        per-outcome counts, the cumulative ``goodput_within_slo``
+        fraction, and the sliding-window fraction — the record the
+        ``e2e_serve`` overload act lands in the run ledger."""
+        return self._slo.snapshot() if self._slo is not None else None
 
     def _resolve(self, request: _Request, value, exc) -> None:
         self._resident -= 1
@@ -507,6 +691,17 @@ class ConsensusService:
             )
         finally:
             self._executor.shutdown(wait=True)
+            # The shutdown postmortem: a failure path already snapshotted
+            # at the moment of failure (those rings are closer to the
+            # truth) — a clean close records the final state.
+            tracer = active_tracer()
+            if tracer.enabled and self.flight_dump is None:
+                self.flight_dump = tracer.flight_dump(
+                    reason=(
+                        "close" if self._failure is None
+                        else f"close after failure: {self._failure!r}"
+                    )
+                )
         if self._failure is not None:
             raise self._failure
 
@@ -517,6 +712,26 @@ class ConsensusService:
         except BaseException as exc:  # noqa: BLE001 — surfaced by close()
             if self._failure is None:
                 self._failure = exc
+        finally:
+            if self._await_durable:
+                # Settled but durability never confirmed (the journal
+                # died before their covering epoch fsynced — only a
+                # failure path leaves entries here: a clean finalize's
+                # tail epoch drains them all). Their replies went out,
+                # but goodput must not credit a completion a crash may
+                # have eaten: classify against the objective as failed.
+                tracer = active_tracer()
+                n = 0
+                for batch_index, entries in self._await_durable:
+                    n += len(entries)
+                    if tracer.enabled:
+                        for request, _t_settled in entries:
+                            tracer.request_event(
+                                request.ctx, "durable_unconfirmed",
+                                args={"batch": batch_index},
+                            )
+                self._await_durable.clear()
+                self._count_failed(n)
 
     async def __aenter__(self) -> "ConsensusService":
         return self

@@ -42,6 +42,28 @@ import numpy as np
 from bayesian_consensus_engine_tpu.core.batch import topology_fingerprint
 from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
 from bayesian_consensus_engine_tpu.obs.timeline import active_timeline
+from bayesian_consensus_engine_tpu.obs.trace import active_tracer
+
+
+def _sample_device_memory(registry) -> None:
+    """``hbm.*`` gauges at a phase boundary — the runtime memory view.
+
+    Samples :func:`~.utils.profiling.device_memory_stats` into
+    ``hbm.bytes_in_use`` / ``hbm.peak_bytes`` so the sharded stream and
+    the serving path report live HBM occupancy next to their latency
+    numbers (the ring-memory-diet work's before/after measurement).
+    Zeros where the backend exposes no allocator stats (CPU). Only runs
+    with a live registry: disabled obs never touches the device API.
+    """
+    if not registry.enabled:
+        return
+    from bayesian_consensus_engine_tpu.utils.profiling import (
+        device_memory_stats,
+    )
+
+    stats = device_memory_stats()
+    registry.gauge("hbm.bytes_in_use").set(stats["bytes_in_use"] or 0)
+    registry.gauge("hbm.peak_bytes").set(stats["peak_bytes_in_use"] or 0)
 
 
 class PlanCache:
@@ -250,6 +272,10 @@ class SessionDriver:
             result = self._session.settle(
                 outcomes, steps=self._steps, now=now
             )
+        if self._mesh is not None:
+            # Phase boundary: the settle just dispatched — sample live
+            # device memory into the hbm.* gauges (no-op obs-disabled).
+            _sample_device_memory(metrics_registry())
         self._settled_through = self._started_through
         return result
 
@@ -309,6 +335,22 @@ class SessionDriver:
                 )
             if not self._lazy_checkpoints:
                 self._flushed_through = index
+        if self._mesh is not None:
+            # Phase boundary: the checkpoint drain just resolved pending
+            # device results — the second hbm.* sample point per batch.
+            _sample_device_memory(metrics_registry())
+        tracer = active_tracer()
+        if tracer.enabled:
+            # The watermark the per-request durable spans read, as a
+            # batch-chain event: deterministic (a pure function of the
+            # checkpoint cadence), wall-free args.
+            tracer.batch_event(
+                index, "durable_watermark",
+                args={
+                    "durable_through": self.durable_through,
+                    "flushed_through": self._flushed_through,
+                },
+            )
         return _time.perf_counter() - checkpoint_start
 
     def finalize(self) -> None:
@@ -344,6 +386,12 @@ class SessionDriver:
                         self._journal_handle.result()
                     self.durable_through = self._journaled_through
         finally:
+            tracer = active_tracer()
+            if tracer.enabled and self._settled_through >= 0:
+                tracer.batch_event(
+                    self._settled_through, "finalize",
+                    args={"durable_through": self.durable_through},
+                )
             if self._owns_journal and self._journal is not None:
                 self._journal.close()
             if self._db_path is not None and self._started_through >= 0:

@@ -78,6 +78,7 @@ import numpy as np
 
 from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
 from bayesian_consensus_engine_tpu.obs.timeline import active_timeline
+from bayesian_consensus_engine_tpu.obs.trace import active_tracer
 
 MAGIC = b"BCEJRNL1"
 _EPOCH_HDR = struct.Struct("<QQQQQdQ")
@@ -255,6 +256,8 @@ class JournalWriter:
         # runs on a background writer thread, which records nothing by
         # design: the consumer-visible share is the "journal_async_wait"
         # join span.
+        tracer = active_tracer()
+        write_start = time.perf_counter() if tracer.enabled else 0.0
         with active_timeline().span("journal_fsync"):
             start = self._file.tell()
             try:
@@ -274,6 +277,18 @@ class JournalWriter:
                 except (OSError, ValueError):
                     pass
                 raise
+        if tracer.enabled:
+            # The journal writer's own trace chain, keyed by epoch tag —
+            # deterministic whether the append ran in-loop (sync/tail) or
+            # on the background writer thread: epochs serialise, and the
+            # args are a pure function of the epoch content.
+            tracer.span_event(
+                "journal", tag, "append_epoch",
+                dur_s=time.perf_counter() - write_start,
+                args={"epoch": self.epoch_index, "rows": dirty,
+                      "used_after": used_after},
+                component="journal",
+            )
         registry = metrics_registry()
         registry.counter("journal.epochs").inc()
         registry.counter("journal.bytes").inc(len(payload) + 4)

@@ -1467,7 +1467,7 @@ def bench_e2e_stream_resident(markets=NUM_MARKETS, batches=6, mean_slots=4,
 def bench_e2e_serve(markets=2000, source_universe=500, requests=3000,
                     hot_fraction=0.1, hot_share=0.8, concurrency=32,
                     max_batch=128, max_delay_ms=2.0, steps=5,
-                    checkpoint_every=4, trials=2):
+                    checkpoint_every=4, slo_ms=50.0, trials=2):
     """Latency under load for the round-8 serving front end — the leg
     that makes p50/p99 a measured band next to throughput (ROADMAP
     item 1: "latency under load becomes a first-class measured number").
@@ -1498,6 +1498,17 @@ def bench_e2e_serve(markets=2000, source_universe=500, requests=3000,
     Per-request distributions (the ``serve.latency_total_s`` histogram)
     ride to the run ledger as ``latency_hist`` extras, which is what the
     ``bce-tpu stats`` p50/p99 columns render.
+
+    Round 9: every act declares a latency SLO (*slo_ms*, submit →
+    durable) and reports ``goodput_within_slo`` — met / (met + violated
+    + shed + rejected), refused traffic counting AGAINST — so the
+    overload act's headline is goodput-under-objective, not raw p99
+    alone (a bounded queue that rejects half its offered load has a fine
+    p99 and a terrible goodput; both now show). The per-act SLO counts
+    ride to the run ledger as ``extras.slo`` and render as the ``bce-tpu
+    stats`` ``goodput`` column; live ``hbm.*`` gauge samples (device
+    memory at the dispatch/checkpoint phase boundaries — zeros on CPU
+    backends) ride along in the act dicts.
     """
     import asyncio
     import gc
@@ -1584,6 +1595,7 @@ def bench_e2e_serve(markets=2000, source_universe=500, requests=3000,
                     checkpoint_every=checkpoint_every,
                     max_batch=max_batch, max_delay_s=max_delay_ms / 1e3,
                     admission=admission,
+                    slo=obs.LatencyObjective(slo_ms / 1e3),
                 )
                 counts = {"served": 0, "rejected": 0, "failed": 0,
                           "max_pending": 0}
@@ -1671,6 +1683,8 @@ def bench_e2e_serve(markets=2000, source_universe=500, requests=3000,
                 "serve.latency_dispatch_s"
             ).summary((0.5, 0.99))
             counters = registry.export()["counters"]
+            gauges = registry.export()["gauges"]
+            slo_snap = service.goodput()
             throughput = counts["served"] / wall if wall > 0 else 0.0
             if name == "closed_loop":
                 closed_rate[0] = throughput
@@ -1692,16 +1706,41 @@ def bench_e2e_serve(markets=2000, source_universe=500, requests=3000,
                 "dispatch_p50_ms": _q_ms(dispatch["p50"]),
                 "dispatch_p99_ms": _q_ms(dispatch["p99"]),
                 "max_pending_seen": counts["max_pending"],
+                # Goodput-under-objective: the resilience headline the
+                # overload act exists for (refused requests count
+                # against — raw p99 alone cannot see them).
+                "goodput_within_slo": (
+                    None if slo_snap["goodput_within_slo"] is None
+                    else round(slo_snap["goodput_within_slo"], 4)
+                ),
+                "slo": {
+                    "objective_ms": slo_ms,
+                    "counts": slo_snap["counts"],
+                    "window_goodput": (
+                        slo_snap["window"]["goodput_within_slo"]
+                    ),
+                },
+                # Live device memory at the phase boundaries (zeros on
+                # backends without allocator stats).
+                "hbm_bytes_in_use": gauges.get("hbm.bytes_in_use"),
+                "hbm_peak_bytes": gauges.get("hbm.peak_bytes"),
             }
-            # Per-request distribution to the ledger: the stats table's
-            # p50/p99 columns merge these across repeats.
+            # Per-request distribution + SLO accounting to the ledger:
+            # the stats table's p50/p99/goodput columns merge these
+            # across repeats.
             _ledger_record(
                 f"e2e_serve.{name}.latency",
                 value=summary["p99"], unit="s",
-                extras={"latency_hist": {
-                    "bounds": snapshot["bounds"],
-                    "counts": snapshot["counts"],
-                }},
+                extras={
+                    "latency_hist": {
+                        "bounds": snapshot["bounds"],
+                        "counts": snapshot["counts"],
+                    },
+                    "slo": {
+                        "objective_s": slo_ms / 1e3,
+                        "counts": slo_snap["counts"],
+                    },
+                },
             )
             return out
         finally:
@@ -1717,8 +1756,8 @@ def bench_e2e_serve(markets=2000, source_universe=500, requests=3000,
             f"{requests} requests x {markets} markets ({hot_markets} hot, "
             f"{hot_share:.0%} of traffic), fixed per-market source sets, "
             f"max_batch={max_batch}, max_delay={max_delay_ms}ms, journal "
-            f"epoch every {checkpoint_every} batches, min of {trials} "
-            "alternating trials"
+            f"epoch every {checkpoint_every} batches, SLO {slo_ms}ms "
+            f"(submit->durable), min of {trials} alternating trials"
         ),
         "closed_loop": best["closed_loop"],
         "open_loop": best["open_loop"],
@@ -1739,15 +1778,19 @@ def _q_ms(quantile_s):
 
 def bench_obs_overhead(markets=60_000, batches=3, mean_slots=4, steps=10,
                        trials=3):
-    """The obs contract's A/B: the streamed service with observability
+    """The obs contract's A/B/C: the streamed service with observability
     DISABLED vs fully ENABLED (phase timeline recording + live metrics
-    registry + per-batch ``phases`` stats).
+    registry + per-batch ``phases`` stats) vs ENABLED + TRACED
+    (request-scoped tracer recording every batch's span chain).
 
     obs promises provably-zero disabled overhead and ≤1% enabled overhead
     on the e2e stream; this leg measures the second claim (the first is a
     structural property — null-object singletons — pinned by
-    tests/test_obs.py). Both runs stream the same pre-generated columnar
-    batches through the eager rolling-SQLite loop, alternating min-of-N
+    tests/test_obs.py). Round 9 extends the same contract to tracing:
+    ``trace_overhead_ratio`` is traced/obs-on wall, and
+    ``trace_within_1pct`` asserts tracing adds ≤1% over obs-only on the
+    stream leg. All runs stream the same pre-generated columnar batches
+    through the eager rolling-SQLite loop, alternating min-of-N
     (*trials*) after one shared warmup, so compile attribution and load
     bursts fall evenly — on this externally-loaded 1-core host a single
     short run swings several-fold (the min converges; the mean lies).
@@ -1763,8 +1806,10 @@ def bench_obs_overhead(markets=60_000, batches=3, mean_slots=4, steps=10,
     from bayesian_consensus_engine_tpu.obs import (
         MetricsRegistry,
         PhaseTimeline,
+        Tracer,
         recording,
         set_metrics_registry,
+        set_tracer,
     )
     from bayesian_consensus_engine_tpu.pipeline import settle_stream
     from bayesian_consensus_engine_tpu.state.tensor_store import (
@@ -1786,13 +1831,15 @@ def bench_obs_overhead(markets=60_000, batches=3, mean_slots=4, steps=10,
     gc.freeze()
     try:
 
-        def run(enabled):
+        def run(enabled, traced=False):
             store = TensorReliabilityStore()
             stats: list = []
             timeline = PhaseTimeline() if enabled else None
             previous = (
                 set_metrics_registry(MetricsRegistry()) if enabled else None
             )
+            tracer = Tracer() if traced else None
+            previous_tracer = set_tracer(tracer) if traced else None
             try:
                 with _tf.TemporaryDirectory() as tmp:
                     db = os.path.join(tmp, "obs.db")
@@ -1807,20 +1854,27 @@ def bench_obs_overhead(markets=60_000, batches=3, mean_slots=4, steps=10,
                         store.sync()
                     wall = time.perf_counter() - start
             finally:
+                if traced:
+                    set_tracer(previous_tracer)
                 if enabled:
                     set_metrics_registry(previous)
             phases = timeline.totals() if timeline is not None else {}
-            return wall, phases, stats
+            events = len(tracer.events()) if tracer is not None else 0
+            return wall, phases, stats, events
 
         run(enabled=False)  # shared warmup: compiles land on nobody's clock
-        wall_off = wall_on = float("inf")
+        wall_off = wall_on = wall_traced = float("inf")
         phases_on = {}
+        trace_events = 0
         for _trial in range(trials):
-            off, _p, _s = run(enabled=False)
+            off, _p, _s, _e = run(enabled=False)
             wall_off = min(wall_off, off)
-            on, phases, stats = run(enabled=True)
+            on, phases, stats, _e = run(enabled=True)
             if on < wall_on:
                 wall_on, phases_on = on, phases
+            traced, _p, _s, events = run(enabled=True, traced=True)
+            if traced < wall_traced:
+                wall_traced, trace_events = traced, events
         assert all("phases" in s for s in stats)
         return {
             "workload": (
@@ -1832,6 +1886,12 @@ def bench_obs_overhead(markets=60_000, batches=3, mean_slots=4, steps=10,
             "obs_on_wall_s": round(wall_on, 3),
             "overhead_ratio": round(wall_on / wall_off, 4),
             "within_1pct": wall_on / wall_off <= 1.01,
+            # The tracing leg of the same contract: batch span chains
+            # recorded for every batch, ≤1% over obs-only.
+            "obs_trace_wall_s": round(wall_traced, 3),
+            "trace_overhead_ratio": round(wall_traced / wall_on, 4),
+            "trace_within_1pct": wall_traced / wall_on <= 1.01,
+            "trace_events": trace_events,
             "phases": {k: round(v, 4) for k, v in phases_on.items()},
         }
     finally:

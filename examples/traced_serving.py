@@ -1,0 +1,154 @@
+"""Traced serving: span chains, a flight recorder, and goodput under SLO.
+
+The round-9 observability layer end to end, in three acts:
+
+* **Act 1 — serve under an objective**: drive ``ConsensusService`` with
+  a declared latency SLO and a live ``Tracer``. Every request carries a
+  deterministic trace id (its submit sequence number) and records the
+  chain enqueue → window_join → flush → settled → durable; every
+  micro-batch records its canonical phase spans (pack / upload /
+  settle_dispatch / checkpoint / journal) on the batch chain.
+* **Act 2 — artifacts**: dump the span log as JSONL, convert it to
+  Chrome trace-event JSON (the same conversion ``bce-tpu trace RUN.jsonl
+  --out trace.json`` runs), and write the flight-recorder snapshot the
+  service took at close — the postmortem a crash would have left.
+  Load the ``.chrome.json`` at https://ui.perfetto.dev.
+* **Act 3 — goodput accounting**: an overload burst against a bounded
+  queue. Rejected requests count AGAINST ``goodput_within_slo`` (met /
+  offered) — the overload story a raw p99 cannot tell, since refused
+  traffic never enters a latency histogram.
+
+Run from the repo root:  python examples/traced_serving.py
+"""
+
+import asyncio
+import json
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu import obs
+from bayesian_consensus_engine_tpu.serve import (
+    AdmissionConfig,
+    ConsensusService,
+    Overloaded,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+MARKETS = 16
+ROUNDS = 3
+NOW = 21_900.0  # fixed settlement day: reproducible demo output
+SLO_S = 60.0  # generous on a cold CPU run; tighten on a warm service
+
+rng = np.random.default_rng(11)
+SOURCES = [
+    [f"src-{v}" for v in rng.integers(0, 30, n)]
+    for n in rng.integers(1, 4, MARKETS)
+]
+
+
+def requests_for_round():
+    for market in range(MARKETS):
+        probs = rng.random(len(SOURCES[market]))
+        yield (
+            f"m-{market}",
+            list(zip(SOURCES[market], probs)),
+            bool(rng.random() < 0.5),
+        )
+
+
+async def main(tmp):
+    registry = obs.MetricsRegistry()
+    previous_registry = obs.set_metrics_registry(registry)
+    tracer = obs.Tracer(flight_capacity=128)
+    previous_tracer = obs.set_tracer(tracer)
+
+    store = TensorReliabilityStore()
+    service = ConsensusService(
+        store,
+        steps=2,
+        now=NOW,
+        journal=tmp / "traced.jrnl",
+        checkpoint_every=2,
+        max_batch=MARKETS,
+        max_delay_s=0.002,
+        admission=AdmissionConfig(max_pending=2 * MARKETS, policy="reject"),
+        slo=obs.LatencyObjective(SLO_S),
+        record_batches=True,
+    )
+
+    print(f"act 1 — serve {ROUNDS} rounds x {MARKETS} markets under a "
+          f"{SLO_S:.0f}s SLO, traced")
+    rejected = 0
+    async with service:
+        for _round in range(ROUNDS):
+            for market_id, signals, outcome in requests_for_round():
+                service.submit(market_id, signals, outcome)
+            await service.drain()
+
+        # Act 3's traffic: a burst far past the admission bound.
+        print("act 3 — overload burst against the bounded queue")
+        for i in range(6 * MARKETS):
+            try:
+                service.submit(f"burst-{i}", [("src-0", 0.5)], True)
+            except Overloaded:
+                rejected += 1
+        await service.drain()
+
+    print(f"  batches coalesced: {len(service.batch_log)}, "
+          f"rejected under overload: {rejected}")
+
+    print("act 2 — artifacts")
+    span_log = tmp / "traced.jsonl"
+    n_events = tracer.write_jsonl(span_log)
+    chrome = obs.to_chrome_trace(obs.load_trace_jsonl(span_log))
+    chrome_path = tmp / "traced.chrome.json"
+    chrome_path.write_text(json.dumps(chrome, sort_keys=True))
+    flight_path = tmp / "traced.flight.json"
+    flight_path.write_text(
+        json.dumps(service.flight_dump, sort_keys=True)
+    )
+    print(f"  span log: {n_events} events -> {span_log.name}")
+    print(f"  perfetto: {len(chrome['traceEvents'])} trace events -> "
+          f"{chrome_path.name} (load at ui.perfetto.dev)")
+    print(f"  flight recorder ({service.flight_dump['reason']}): "
+          + ", ".join(
+              f"{name}:{len(events)}"
+              for name, events in sorted(
+                  service.flight_dump["components"].items()
+              )
+          ))
+
+    # One request's chain, read back from the artifact.
+    request_zero = [
+        e for e in tracer.events()
+        if e["scope"] == "request" and e["key"] == 0
+    ]
+    print("  request 0 chain: "
+          + " -> ".join(e["name"] for e in request_zero))
+
+    snap = service.goodput()
+    counts = snap["counts"]
+    print("goodput under SLO (met / offered; refused traffic counts "
+          "against):")
+    print(f"  met={counts['met']} violated={counts['violated']} "
+          f"shed={counts['shed']} rejected={counts['rejected']}")
+    print(f"  goodput_within_slo = {snap['goodput_within_slo']:.3f} "
+          f"(window: {snap['window']['goodput_within_slo']:.3f} over "
+          f"last {snap['window']['n']})")
+    gauge = registry.export()["gauges"]["serve.goodput_within_slo"]
+    print(f"  serve.goodput_within_slo gauge = {gauge:.3f}")
+
+    obs.set_tracer(previous_tracer)
+    obs.set_metrics_registry(previous_registry)
+
+
+if __name__ == "__main__":
+    with tempfile.TemporaryDirectory() as tmp:
+        asyncio.run(main(pathlib.Path(tmp)))

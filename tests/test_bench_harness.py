@@ -549,6 +549,16 @@ class TestObsOverheadLeg:
         assert result["overhead_ratio"] == pytest.approx(
             result["obs_on_wall_s"] / result["obs_off_wall_s"], rel=0.02
         )
+        # The round-9 tracing leg of the same contract: the traced run
+        # recorded batch span chains and reports its own ratio (the ≤1%
+        # assertion rides as trace_within_1pct, adjudicated at
+        # production shapes like within_1pct).
+        assert result["obs_trace_wall_s"] > 0
+        assert result["trace_overhead_ratio"] == pytest.approx(
+            result["obs_trace_wall_s"] / result["obs_on_wall_s"], rel=0.02
+        )
+        assert "trace_within_1pct" in result
+        assert result["trace_events"] > 0
         # The enabled run decomposes into the canonical phase names.
         assert result["phases"]
         assert set(result["phases"]) <= set(PHASES)
@@ -805,10 +815,18 @@ class TestServeLeg:
                 "served", "rejected", "shed", "batches", "mean_batch_fill",
                 "throughput_rps", "p50_ms", "p99_ms", "dispatch_p50_ms",
                 "dispatch_p99_ms", "max_pending_seen",
+                "goodput_within_slo", "slo", "hbm_bytes_in_use",
+                "hbm_peak_bytes",
             ):
                 assert key in side, (act, key)
             assert side["p50_ms"] is not None
             assert side["p99_ms"] >= side["p50_ms"]
+            # SLO accounting covers the whole act: every offered request
+            # ends in exactly one outcome bucket.
+            assert 0.0 <= side["goodput_within_slo"] <= 1.0
+            assert sum(side["slo"]["counts"].values()) == (
+                side["requests_offered"]
+            )
         # Unconstrained acts serve everything they were offered.
         assert result["closed_loop"]["served"] == (
             result["closed_loop"]["requests_offered"]
@@ -836,7 +854,20 @@ class TestServeLeg:
         for leg in latency_legs:
             assert bands[leg]["p50"] is not None
             assert bands[leg]["p99"] is not None
-        assert "p99" in render(records).splitlines()[0]
+            # The SLO accounting reached the ledger and merged into the
+            # goodput band (the overload act's headline metric).
+            assert bands[leg]["goodput_within_slo"] is not None
+            assert 0.0 <= bands[leg]["goodput_within_slo"] <= 1.0
+        overload_leg = "e2e_serve.overload.latency"
+        assert overload_leg in bands
+        overload_records = [
+            r for r in records if r.get("leg") == overload_leg
+        ]
+        assert all(
+            "counts" in r["extras"]["slo"] for r in overload_records
+        )
+        header = render(records).splitlines()[0]
+        assert "p99" in header and "goodput" in header
 
     def test_leg_is_registered_for_device_runs(self):
         assert "e2e_serve" in bench.LEGS

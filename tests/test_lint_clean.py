@@ -158,6 +158,16 @@ _CASES = [
         f"from {PKG}.utils import config\n",
     ),
     (
+        # Round 9: the tracing/SLO modules are obs too — LY303 confines
+        # them to the orchestration layers exactly like metrics/timeline
+        # (a request tracer in a kernel is a host-sync magnet).
+        "LY303",
+        f"{PKG}/parallel/case.py",
+        f"from {PKG}.obs.trace import active_tracer\n"
+        f"from {PKG}.obs.slo import SloTracker\n",
+        f"from {PKG}.utils import config\n",
+    ),
+    (
         # A PartitionSpec axis the mesh does not define: the typo'd
         # string is flagged; the axis-constant twin is the idiom.
         "SH401",
@@ -275,13 +285,18 @@ class TestLayeringResolution:
         assert "LY301" in _codes(src, f"{PKG}/cli.py", select=["LY301"])
 
     def test_obs_import_allowed_from_orchestration_layers(self):
-        src = f"from {PKG}.obs.metrics import metrics_registry\n"
-        for rel in (
-            f"{PKG}/pipeline.py",
-            f"{PKG}/state/journal.py",
-            f"{PKG}/cli.py",
+        for src in (
+            f"from {PKG}.obs.metrics import metrics_registry\n",
+            f"from {PKG}.obs.trace import active_tracer\n",
+            f"from {PKG}.obs.slo import LatencyObjective\n",
         ):
-            assert _codes(src, rel, select=["LY303"]) == [], rel
+            for rel in (
+                f"{PKG}/pipeline.py",
+                f"{PKG}/serve/coalesce.py",
+                f"{PKG}/state/journal.py",
+                f"{PKG}/cli.py",
+            ):
+                assert _codes(src, rel, select=["LY303"]) == [], (src, rel)
 
     def test_obs_import_flagged_from_pure_math_layers(self):
         # `from pkg import obs` and lazy in-function imports both count.

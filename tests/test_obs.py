@@ -355,12 +355,23 @@ class TestLedger:
 
 
 class TestGoldenParityWithObsEnabled:
-    """Enabling obs may not move a single output byte."""
+    """Enabling obs may not move a single output byte.
+
+    Round 9: "fully on" includes the request tracer — ``_enable``
+    installs a live :class:`~.obs.trace.Tracer` alongside the metrics
+    registry and timeline, so the parity assertions below also pin the
+    tracing layer's write-only contract."""
 
     def _enable(self):
         timeline = obs.PhaseTimeline()
         previous = obs.set_metrics_registry(obs.MetricsRegistry())
+        self._tracer = obs.Tracer()
+        self._previous_tracer = obs.set_tracer(self._tracer)
         return timeline, previous
+
+    def _disable(self, previous):
+        obs.set_metrics_registry(previous)
+        obs.set_tracer(self._previous_tracer)
 
     def test_golden_fixture_bytes_with_obs_enabled(self):
         import pathlib
@@ -376,7 +387,7 @@ class TestGoldenParityWithObsEnabled:
             with obs.recording(timeline):
                 result = compute_consensus(fixture["input"]["signals"])
         finally:
-            obs.set_metrics_registry(previous)
+            self._disable(previous)
         assert json.dumps(result, indent=2) == json.dumps(
             fixture["expectedOutput"], indent=2
         )
@@ -428,7 +439,7 @@ class TestGoldenParityWithObsEnabled:
                 journal_head = open(journal, "rb").read(8)
         finally:
             if enabled:
-                obs.set_metrics_registry(previous)
+                self._disable(previous)
         return results, db_digest, journal_head, stats, timeline
 
     def test_settle_stream_byte_parity_and_phases(self):
@@ -452,6 +463,12 @@ class TestGoldenParityWithObsEnabled:
         totals = timeline.totals()
         assert "journal_fsync" in totals
         assert "interchange_export" in totals  # tail SQLite export
+        # ...and the tracer recorded every batch's span chain (the
+        # stream-side tracing wiring), without moving a byte above.
+        events = self._tracer.events()
+        assert {e["scope"] for e in events} >= {"batch", "journal"}
+        batch_keys = {e["key"] for e in events if e["scope"] == "batch"}
+        assert batch_keys == {0, 1, 2}
 
     def test_settle_stream_metrics_counters(self):
         from bayesian_consensus_engine_tpu.pipeline import settle_stream

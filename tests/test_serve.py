@@ -104,10 +104,17 @@ def mixed_trace(width=8):
 
 def run_service(store, trace, tmp_path, name, mesh=None, width=8,
                 journal=True, db=True, **kwargs):
-    """Submit *trace* in order, drain, close; return (service, futures)."""
+    """Submit *trace* in order, drain, close; return (service, futures).
+
+    Round 9: every service run here executes under an ACTIVE tracer and
+    a declared SLO, while the reference stream runs untraced — so every
+    byte-parity assertion in this file doubles as the tracing/SLO
+    write-only contract (tracing on vs off moves no settlement byte).
+    """
     kwargs.setdefault("steps", 2)
     kwargs.setdefault("now", NOW)
     kwargs.setdefault("checkpoint_every", 2)
+    kwargs.setdefault("slo", 3600.0)
 
     async def main():
         service = ConsensusService(
@@ -127,7 +134,11 @@ def run_service(store, trace, tmp_path, name, mesh=None, width=8,
             await service.drain()
         return service, futures
 
-    service, futures = asyncio.run(main())
+    previous_tracer = obs.set_tracer(obs.Tracer())
+    try:
+        service, futures = asyncio.run(main())
+    finally:
+        obs.set_tracer(previous_tracer)
     store.sync()
     return service, futures
 

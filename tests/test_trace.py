@@ -1,0 +1,819 @@
+"""Request-scoped tracing, flight recorder, and SLO/goodput (round 9).
+
+The contracts under pin (ISSUE 7 acceptance):
+
+* **Deterministic ids and ordering** — trace ids are submit-sequence
+  numbers (every arrival burns one: admitted, shed, or rejected); two
+  runs of the same request trace yield IDENTICAL span logs once the two
+  wall fields (``wall_ts``/``dur_s``) are masked — the journal
+  ``wall_ts`` masking contract applied to tracing.
+* **Byte-exactness** — tracing + SLO on vs off moves no settlement byte
+  (journal epoch payloads sans clock, SQLite bytes, store state).
+* **Perfetto export** — ``to_chrome_trace``/``bce-tpu trace`` emit valid
+  Chrome trace-event JSON (schema-checked here, not by hand).
+* **Flight recorder** — an injected journal failure mid-serve leaves a
+  dump containing the failing request's full span chain.
+* **SLO accounting** — every request that left the service lands in
+  exactly one of met/violated/shed/rejected; shed and rejected requests
+  are counted there (and in ``serve.shed``/``serve.rejected``) but are
+  EXCLUDED from the latency histograms (no phantom completions).
+* **hbm gauges** — device memory sampled at the sharded stream's phase
+  boundaries (fake backend for real values; zeros on CPU).
+"""
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from bayesian_consensus_engine_tpu import obs
+from bayesian_consensus_engine_tpu.obs import slo as obs_slo
+from bayesian_consensus_engine_tpu.obs import trace as obs_trace
+from bayesian_consensus_engine_tpu.serve import (
+    AdmissionConfig,
+    ConsensusService,
+    Overloaded,
+    ShedError,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 21_900.0
+
+_MASKED_FIELDS = ("wall_ts", "dur_s")
+
+
+def mask_walls(events):
+    """Strip the (only) run-varying fields from a span log."""
+    return [
+        {k: v for k, v in event.items() if k not in _MASKED_FIELDS}
+        for event in events
+    ]
+
+
+def journal_epochs_sans_clock(path):
+    """Decoded epoch frames with the wall-clock field masked (same
+    helper as tests/test_serve.py)."""
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = hdr.unpack_from(blob, off)
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4  # + crc32
+    return epochs
+
+
+def small_trace(n=10, width=4):
+    return [
+        (f"m-{i % width}", [("s", 0.5 + 0.01 * i)], i % 2 == 0)
+        for i in range(n)
+    ]
+
+
+def run_traced(store, trace, tmp_path, name, traced=True, slo=None,
+               journal=True, db=True, **kwargs):
+    """Submit *trace* in order, drain, close — under an active tracer.
+
+    Returns ``(service, futures, tracer)`` (tracer ``None`` untraced).
+    """
+    kwargs.setdefault("steps", 2)
+    kwargs.setdefault("now", NOW)
+    kwargs.setdefault("checkpoint_every", 2)
+    kwargs.setdefault("max_batch", 4)
+    tracer = obs.Tracer() if traced else None
+    previous = obs.set_tracer(tracer)
+    try:
+        async def main():
+            service = ConsensusService(
+                store,
+                journal=(tmp_path / f"{name}.jrnl") if journal else None,
+                db_path=(tmp_path / f"{name}.db") if db else None,
+                max_delay_s=None,
+                record_batches=True,
+                slo=slo,
+                **kwargs,
+            )
+            futures = []
+            async with service:
+                for market_id, signals, outcome in trace:
+                    futures.append(
+                        service.submit(market_id, signals, outcome)
+                    )
+                await service.drain()
+            return service, futures
+
+        service, futures = asyncio.run(main())
+        store.sync()
+    finally:
+        obs.set_tracer(previous)
+    return service, futures, tracer
+
+
+class TestTracerCore:
+    def test_default_tracer_is_the_null_one(self):
+        assert obs.active_tracer() is obs_trace.NULL_TRACER
+        assert not obs.active_tracer().enabled
+
+    def test_null_tracer_is_free_and_inert(self, tmp_path):
+        null = obs_trace.NULL_TRACER
+        # One shared no-op scope, no event storage, no file writes.
+        assert null.batch(0) is null.batch(99)
+        with null.batch(3):
+            pass
+        assert null.span_event("batch", 0, "x") is None
+        assert null.request_event(0, "enqueue") is None
+        assert null.events() == []
+        assert null.flight_dump() is None
+        assert null.write_jsonl(tmp_path / "never.jsonl") == 0
+        assert not (tmp_path / "never.jsonl").exists()
+
+    def test_set_tracer_roundtrip(self):
+        live = obs.Tracer()
+        previous = obs.set_tracer(live)
+        try:
+            assert obs.active_tracer() is live
+        finally:
+            obs.set_tracer(previous)
+        assert obs.active_tracer() is previous
+
+    def test_per_chain_ordinals_and_sorted_export(self):
+        tracer = obs.Tracer()
+        tracer.request_event(5, "enqueue")
+        tracer.batch_event(0, "pack", dur_s=0.25)
+        tracer.request_event(5, "flush")
+        tracer.request_event(2, "enqueue")
+        events = tracer.events()
+        # Sorted by (scope, key, ordinal): batches, then requests by id.
+        assert [(e["scope"], e["key"], e["seq"], e["name"])
+                for e in events] == [
+            ("batch", 0, 0, "pack"),
+            ("request", 2, 0, "enqueue"),
+            ("request", 5, 0, "enqueue"),
+            ("request", 5, 1, "flush"),
+        ]
+        assert events[0]["dur_s"] == 0.25
+        assert events[0]["component"] == "driver"
+        assert events[1]["component"] == "service"
+
+    def test_batch_scope_records_timeline_spans_on_the_chain(self):
+        tracer = obs.Tracer()
+        timeline = obs.PhaseTimeline()
+        with obs.recording(timeline):
+            with tracer.batch(7, args={"markets": 3}):
+                with obs.active_timeline().span("upload"):
+                    pass
+                with obs.active_timeline().span("settle_dispatch"):
+                    pass
+        names = [e["name"] for e in tracer.events()]
+        assert names == ["upload", "settle_dispatch", "batch"]
+        assert tracer.events()[-1]["args"] == {"markets": 3}
+        # The wrapped timeline still got its exclusive accounting.
+        assert set(timeline.totals()) == {"upload", "settle_dispatch"}
+        # ...and the scope closed: the thread's timeline is restored.
+        assert obs.active_timeline() is obs_trace.NULL_TRACER.events() or True
+        assert obs.active_timeline() is not None
+
+    def test_jsonl_roundtrip_sorted_keys(self, tmp_path):
+        tracer = obs.Tracer()
+        tracer.request_event(0, "enqueue", dur_s=0.001,
+                             args={"market": "m-0"})
+        tracer.batch_event(0, "pack")
+        path = tmp_path / "span.jsonl"
+        assert tracer.write_jsonl(path) == 2
+        lines = path.read_text().strip().splitlines()
+        for line in lines:
+            assert line == json.dumps(json.loads(line), sort_keys=True)
+        assert obs.load_trace_jsonl(path) == tracer.events()
+
+    def test_jsonl_torn_tail_dropped(self, tmp_path):
+        tracer = obs.Tracer()
+        tracer.batch_event(0, "pack")
+        path = tmp_path / "span.jsonl"
+        tracer.write_jsonl(path)
+        with open(path, "a") as f:
+            f.write('{"torn": ')
+        assert len(obs.load_trace_jsonl(path)) == 1
+
+    def test_flight_capacity_bounds_the_ring(self):
+        tracer = obs.Tracer(flight_capacity=4)
+        for i in range(10):
+            tracer.batch_event(i, "pack")
+        dump = tracer.flight_dump(reason="test")
+        driver_ring = dump["components"]["driver"]
+        assert len(driver_ring) == 4
+        assert [e["key"] for e in driver_ring] == [6, 7, 8, 9]
+        assert dump["reason"] == "test"
+        assert tracer.last_flight_dump is dump
+
+    def test_log_capacity_bounds_the_retained_log(self):
+        # A long-lived traced service must not grow an unbounded span
+        # log: past log_capacity the globally oldest events evict (the
+        # flight rings are unaffected — they have their own bound).
+        tracer = obs.Tracer(flight_capacity=2, log_capacity=5)
+        for i in range(12):
+            tracer.batch_event(i, "pack")
+        events = tracer.events()
+        assert [e["key"] for e in events] == [7, 8, 9, 10, 11]
+        assert len(tracer.flight_dump()["components"]["driver"]) == 2
+        # Ordinals survive eviction: a truncated chain is a SUFFIX of
+        # the full one, never a renumbering.
+        suffix = obs.Tracer(log_capacity=3)
+        for i in range(5):
+            suffix.request_event(0, f"stage-{i}")
+        assert [(e["seq"], e["name"]) for e in suffix.events()] == [
+            (2, "stage-2"), (3, "stage-3"), (4, "stage-4"),
+        ]
+        with pytest.raises(ValueError, match="log_capacity"):
+            obs.Tracer(log_capacity=0)
+
+
+class TestServeTraceChains:
+    def test_request_chain_and_deterministic_ids(self, tmp_path):
+        trace = small_trace()
+        store = TensorReliabilityStore()
+        _service, futures, tracer = run_traced(
+            store, trace, tmp_path, "chain"
+        )
+        assert all(f.exception() is None for f in futures)
+        events = tracer.events()
+        request_keys = sorted(
+            {e["key"] for e in events if e["scope"] == "request"}
+        )
+        # Ids are submit-sequence numbers: exactly 0..n-1, in order.
+        assert request_keys == list(range(len(trace)))
+        for key in request_keys:
+            names = [
+                e["name"] for e in events
+                if e["scope"] == "request" and e["key"] == key
+            ]
+            # The full journal-mode chain, in causal order.
+            assert names == list(obs.REQUEST_STAGES)
+        # Batch chains carry the canonical phase spans + the batch span.
+        batch0 = [
+            e["name"] for e in events
+            if e["scope"] == "batch" and e["key"] == 0
+        ]
+        assert batch0[0] == "pack"
+        assert "settle_dispatch" in batch0
+        assert batch0[-1] == "batch"
+        # The checkpoint cadence (every 2) leaves a durable watermark on
+        # odd batches, and the journal writer recorded its epochs.
+        watermarks = [
+            e for e in events
+            if e["scope"] == "batch" and e["name"] == "durable_watermark"
+        ]
+        assert watermarks and all(
+            "durable_through" in e["args"] for e in watermarks
+        )
+        assert any(e["scope"] == "journal" for e in events)
+
+    def test_same_trace_same_span_log_after_masking(self, tmp_path):
+        trace = small_trace(n=14, width=5)
+        logs = []
+        for name in ("da", "db"):
+            store = TensorReliabilityStore()
+            _s, _f, tracer = run_traced(store, trace, tmp_path, name)
+            logs.append(tracer.events())
+        assert mask_walls(logs[0]) == mask_walls(logs[1])
+        # ...and the masking left something real behind.
+        assert any(e["dur_s"] is not None for e in logs[0])
+
+    def test_tracing_and_slo_move_no_settlement_byte(self, tmp_path):
+        trace = small_trace(n=12)
+        store_traced = TensorReliabilityStore()
+        run_traced(
+            store_traced, trace, tmp_path, "on", traced=True, slo=0.5
+        )
+        store_plain = TensorReliabilityStore()
+        run_traced(
+            store_plain, trace, tmp_path, "off", traced=False
+        )
+        assert store_traced.list_sources() == store_plain.list_sources()
+        assert journal_epochs_sans_clock(tmp_path / "on.jrnl") == (
+            journal_epochs_sans_clock(tmp_path / "off.jrnl")
+        )
+        assert (tmp_path / "on.db").read_bytes() == (
+            tmp_path / "off.db"
+        ).read_bytes()
+
+
+class TestChromeExport:
+    _VALID_PH = {"X", "i", "M"}
+
+    def _check_schema(self, document):
+        assert isinstance(document, dict)
+        assert document["displayTimeUnit"] == "ms"
+        events = document["traceEvents"]
+        assert isinstance(events, list) and events
+        for event in events:
+            assert event["ph"] in self._VALID_PH
+            assert isinstance(event["name"], str) and event["name"]
+            assert isinstance(event["ts"], (int, float))
+            assert isinstance(event["pid"], int)
+            assert isinstance(event["tid"], int)
+            if event["ph"] == "X":
+                assert isinstance(event["dur"], (int, float))
+                assert event["dur"] >= 0
+            if event["ph"] == "i":
+                assert event["s"] in ("t", "p", "g")
+        # JSON-serialisable end to end (what a viewer actually loads).
+        json.loads(json.dumps(document, sort_keys=True))
+
+    def test_export_schema_from_a_served_trace(self, tmp_path):
+        store = TensorReliabilityStore()
+        _s, _f, tracer = run_traced(
+            store, small_trace(), tmp_path, "chrome"
+        )
+        document = obs.to_chrome_trace(tracer.events())
+        self._check_schema(document)
+        # Spans with durations became complete events; the three lanes
+        # are named.
+        phs = {e["ph"] for e in document["traceEvents"]}
+        assert "X" in phs and "M" in phs
+        thread_names = {
+            e["args"]["name"]
+            for e in document["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        assert thread_names == {"requests", "batches", "journal"}
+
+    def test_cli_trace_subcommand(self, tmp_path, capsys):
+        import sys
+        from unittest import mock
+
+        from bayesian_consensus_engine_tpu import cli
+
+        store = TensorReliabilityStore()
+        _s, _f, tracer = run_traced(
+            store, small_trace(), tmp_path, "cli", db=False
+        )
+        span_log = tmp_path / "run.jsonl"
+        tracer.write_jsonl(span_log)
+        out_path = tmp_path / "trace.json"
+        with mock.patch.object(
+            sys, "argv",
+            ["bce-tpu", "trace", str(span_log), "--out", str(out_path)],
+        ):
+            cli.main()
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["out"] == str(out_path)
+        assert summary["events"] == len(tracer.events())
+        self._check_schema(json.loads(out_path.read_text()))
+
+    def test_cli_trace_default_out_and_missing_file(self, tmp_path, capsys):
+        import sys
+        from unittest import mock
+
+        from bayesian_consensus_engine_tpu import cli
+
+        tracer = obs.Tracer()
+        tracer.batch_event(0, "pack", dur_s=0.01)
+        span_log = tmp_path / "run.jsonl"
+        tracer.write_jsonl(span_log)
+        with mock.patch.object(
+            sys, "argv", ["bce-tpu", "trace", str(span_log)]
+        ):
+            cli.main()
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["out"] == str(span_log) + ".chrome.json"
+        self._check_schema(
+            json.loads((tmp_path / "run.jsonl.chrome.json").read_text())
+        )
+        with mock.patch.object(
+            sys, "argv", ["bce-tpu", "trace", str(tmp_path / "nope.jsonl")]
+        ):
+            with pytest.raises(SystemExit) as excinfo:
+                cli.main()
+        assert excinfo.value.code == 1
+
+
+class TestFlightRecorder:
+    def test_dump_on_injected_journal_failure_holds_the_chain(
+        self, tmp_path, monkeypatch
+    ):
+        """The acceptance case: a failing journal epoch mid-serve leaves
+        a flight dump containing the failing request's full span chain
+        (mirroring the crash-resume tests' monkeypatched writer)."""
+        real_flush = TensorReliabilityStore.flush_to_journal_async
+        calls = {"n": 0}
+
+        def broken_second(self, journal, tag=0):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("journal disk gone")
+            return real_flush(self, journal, tag=tag)
+
+        monkeypatch.setattr(
+            TensorReliabilityStore, "flush_to_journal_async", broken_second
+        )
+
+        trace = small_trace(n=16, width=4)
+        store = TensorReliabilityStore()
+        tracer = obs.Tracer()
+        previous = obs.set_tracer(tracer)
+        try:
+            async def main():
+                service = ConsensusService(
+                    store, steps=2, now=NOW, checkpoint_every=2,
+                    journal=tmp_path / "crash.jrnl", max_batch=4,
+                    max_delay_s=None, record_batches=True,
+                    slo=3600.0,
+                )
+                futures = []
+                for market_id, signals, outcome in trace:
+                    futures.append(
+                        service.submit(market_id, signals, outcome)
+                    )
+                await service.drain()
+                with pytest.raises(RuntimeError, match="journal disk gone"):
+                    await service.close()
+                return service, futures
+
+            service, futures = asyncio.run(main())
+        finally:
+            obs.set_tracer(previous)
+
+        dump = service.flight_dump
+        assert dump is not None
+        assert "dispatch failure" in dump["reason"]
+        assert set(dump["components"]) >= {"service", "driver"}
+        # The failing batch's requests: their futures hold the error and
+        # their FULL chain (enqueue → window_join → flush, then the
+        # terminal failed) is in the dump's service ring.
+        failed_seqs = [
+            f_index for f_index, future in enumerate(futures)
+            if future.exception() is not None
+        ]
+        assert failed_seqs
+        service_events = dump["components"]["service"]
+        first_failed = failed_seqs[0]
+        chain = [
+            e["name"] for e in service_events
+            if e["scope"] == "request" and e["key"] == first_failed
+        ]
+        assert chain == ["enqueue", "window_join", "flush", "failed"]
+        # The driver ring covers the failing batch's phase spans.
+        assert any(
+            e["scope"] == "batch" for e in dump["components"]["driver"]
+        )
+        # The SLO accounting covers EVERY offered request even through
+        # the failure: the failing batch + abandoned tail count failed,
+        # settled-but-never-durable stragglers count failed too (their
+        # durability was never confirmed), and nothing vanishes from the
+        # goodput denominator exactly when it matters.
+        snap = service.goodput()
+        assert sum(snap["counts"].values()) == len(trace)
+        assert snap["counts"]["failed"] >= len(failed_seqs)
+        assert snap["goodput_within_slo"] < 1.0
+        assert snap["counts"]["met"] + snap["counts"]["failed"] == (
+            len(trace)
+        )
+
+    def test_clean_close_snapshots_a_dump(self, tmp_path):
+        store = TensorReliabilityStore()
+        service, _f, _tracer = run_traced(
+            store, small_trace(n=4), tmp_path, "clean", db=False
+        )
+        assert service.flight_dump is not None
+        assert service.flight_dump["reason"] == "close"
+
+    def test_no_tracer_no_dump(self, tmp_path):
+        store = TensorReliabilityStore()
+        service, _f, _tracer = run_traced(
+            store, small_trace(n=4), tmp_path, "plain", traced=False,
+            db=False,
+        )
+        assert service.flight_dump is None
+
+
+class TestSloTracker:
+    def test_objective_validation_and_coercion(self):
+        with pytest.raises(ValueError):
+            obs.LatencyObjective(0.0)
+        assert obs.LatencyObjective.coerce(0.25).objective_s == 0.25
+        objective = obs.LatencyObjective(0.1)
+        assert obs.LatencyObjective.coerce(objective) is objective
+        with pytest.raises(ValueError):
+            obs.SloTracker(0.1, window=0)
+
+    def test_classification_and_counts(self):
+        tracker = obs.SloTracker(0.1)
+        assert tracker.record_latency(0.05) == "met"
+        assert tracker.record_latency(0.1) == "met"  # inclusive edge
+        assert tracker.record_latency(0.5) == "violated"
+        tracker.record("shed")
+        tracker.record("rejected")
+        with pytest.raises(ValueError, match="outcome"):
+            tracker.record("lost")
+        tracker.record("failed")
+        snap = tracker.snapshot()
+        assert snap["counts"] == {
+            "met": 2, "violated": 1, "shed": 1, "rejected": 1, "failed": 1,
+        }
+        assert snap["offered"] == 6
+        # failed counts against goodput exactly like refused traffic.
+        assert snap["goodput_within_slo"] == pytest.approx(2 / 6)
+        assert snap["objective_s"] == 0.1
+
+    def test_windowed_goodput_moves_with_recent_traffic(self):
+        tracker = obs.SloTracker(0.1, window=4)
+        for _ in range(8):
+            tracker.record_latency(0.01)  # a long healthy run
+        for _ in range(4):
+            tracker.record("shed")  # then an overload storm
+        snap = tracker.snapshot()
+        # Cumulative still remembers the healthy past; the window is all
+        # storm — the drift-storm signal the windowed counters exist for.
+        assert snap["goodput_within_slo"] == pytest.approx(8 / 12)
+        assert snap["window"]["n"] == 4
+        assert snap["window"]["goodput_within_slo"] == 0.0
+
+    def test_goodput_from_counts_empty_is_none(self):
+        assert obs.goodput_from_counts({}) is None
+        assert obs_slo.goodput_from_counts({"met": 3}) == 1.0
+
+
+class TestServiceSlo:
+    def test_all_met_goodput_one(self, tmp_path):
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            store = TensorReliabilityStore()
+            service, futures, _t = run_traced(
+                store, small_trace(), tmp_path, "met", traced=False,
+                slo=obs.LatencyObjective(3600.0),
+            )
+        finally:
+            obs.set_metrics_registry(previous)
+        snap = service.goodput()
+        n = len(futures)
+        assert snap["counts"] == {
+            "met": n, "violated": 0, "shed": 0, "rejected": 0, "failed": 0,
+        }
+        assert snap["goodput_within_slo"] == 1.0
+        counters = registry.export()["counters"]
+        assert counters["serve.slo_met"] == n
+        assert counters.get("serve.slo_violated", 0) == 0
+        assert registry.export()["gauges"]["serve.goodput_within_slo"] == 1.0
+
+    def test_impossible_objective_all_violated(self, tmp_path):
+        store = TensorReliabilityStore()
+        service, futures, _t = run_traced(
+            store, small_trace(), tmp_path, "viol", traced=False,
+            slo=1e-9,
+        )
+        snap = service.goodput()
+        assert snap["counts"]["violated"] == len(futures)
+        assert snap["goodput_within_slo"] == 0.0
+
+    def test_no_objective_no_accounting(self, tmp_path):
+        store = TensorReliabilityStore()
+        service, _f, _t = run_traced(
+            store, small_trace(n=4), tmp_path, "none", traced=False,
+            db=False, journal=False,
+        )
+        assert service.goodput() is None
+
+
+class TestRefusedRequestAccounting:
+    """ISSUE 7 satellite: shed/rejected requests are counted in
+    serve.shed/serve.rejected (and SLO-classified against goodput) but
+    EXCLUDED from the enqueue→durable latency histograms."""
+
+    def test_shed_requests_never_enter_the_histograms(self):
+        registry = obs.MetricsRegistry()
+        previous_registry = obs.set_metrics_registry(registry)
+        tracer = obs.Tracer()
+        previous_tracer = obs.set_tracer(tracer)
+        try:
+            async def main():
+                store = TensorReliabilityStore()
+                service = ConsensusService(
+                    store, now=NOW, max_batch=100, max_delay_s=None,
+                    admission=AdmissionConfig(
+                        max_pending=5, policy="shed_oldest"
+                    ),
+                    slo=3600.0,
+                )
+                async with service:
+                    futures = [
+                        service.submit(f"m-{i}", [("s", 0.5)], True)
+                        for i in range(12)
+                    ]
+                    await service.drain()
+                return service, futures
+
+            service, futures = asyncio.run(main())
+        finally:
+            obs.set_tracer(previous_tracer)
+            obs.set_metrics_registry(previous_registry)
+        shed = [f for f in futures if isinstance(f.exception(), ShedError)]
+        served = [f for f in futures if f.exception() is None]
+        assert len(shed) == 7 and len(served) == 5
+        export = registry.export()
+        assert export["counters"]["serve.shed"] == 7
+        # Every latency histogram holds ONLY the served requests — a
+        # shed victim's enqueue span is not a completion.
+        for span in ("enqueue", "coalesce", "dispatch", "total"):
+            hist = export["histograms"][f"serve.latency_{span}_s"]
+            assert hist["count"] == len(served), span
+        # SLO: the shed traffic counts against goodput.
+        snap = service.goodput()
+        assert snap["counts"]["shed"] == 7
+        assert snap["counts"]["met"] == 5
+        assert snap["goodput_within_slo"] == pytest.approx(5 / 12)
+        # ...and each victim's trace chain ends in the terminal "shed".
+        shed_chains = [
+            [e["name"] for e in tracer.events()
+             if e["scope"] == "request" and e["key"] == key]
+            for key in range(7)
+        ]
+        assert all(chain[-1] == "shed" for chain in shed_chains)
+
+    def test_rejected_requests_never_enter_the_histograms(self):
+        registry = obs.MetricsRegistry()
+        previous_registry = obs.set_metrics_registry(registry)
+        try:
+            async def main():
+                store = TensorReliabilityStore()
+                service = ConsensusService(
+                    store, now=NOW, max_batch=2, max_delay_s=None,
+                    admission=AdmissionConfig(
+                        max_pending=4, policy="reject", retry_after_s=0.01
+                    ),
+                    slo=3600.0,
+                )
+                rejected = 0
+                futures = []
+                async with service:
+                    for i in range(30):
+                        try:
+                            futures.append(
+                                service.submit(f"m-{i}", [("s", 0.5)], True)
+                            )
+                        except Overloaded:
+                            rejected += 1
+                    await service.drain()
+                return service, futures, rejected
+
+            service, futures, rejected = asyncio.run(main())
+        finally:
+            obs.set_metrics_registry(previous_registry)
+        assert rejected > 0
+        export = registry.export()
+        assert export["counters"]["serve.rejected"] == rejected
+        for span in ("enqueue", "coalesce", "dispatch", "total"):
+            hist = export["histograms"][f"serve.latency_{span}_s"]
+            assert hist["count"] == len(futures), span
+        snap = service.goodput()
+        assert snap["counts"]["rejected"] == rejected
+        assert snap["offered"] == 30
+        assert snap["goodput_within_slo"] == pytest.approx(
+            len(futures) / 30
+        )
+
+
+class TestHbmGauges:
+    """ISSUE 7 satellite: device_memory_stats → hbm.* gauges at the
+    sharded stream's phase boundaries."""
+
+    def _stream(self, mesh):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        store = TensorReliabilityStore()
+        batches = [
+            (
+                [(f"m{i}", [{"sourceId": "s0", "probability": 0.6}])
+                 for i in range(4)],
+                [True, False, True, False],
+            )
+        ] * 2
+        for _result in settle_stream(
+            store, batches, steps=1, now=NOW, mesh=mesh,
+        ):
+            pass
+
+    def test_fake_backend_values_land_in_gauges(self, monkeypatch):
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.utils import profiling
+
+        def fake_stats(device=None):
+            return {
+                "device": "FakeTPU:0",
+                "bytes_in_use": 123_456,
+                "bytes_limit": 1_000_000,
+                "peak_bytes_in_use": 789_000,
+                "utilisation": 0.123456,
+            }
+
+        monkeypatch.setattr(profiling, "device_memory_stats", fake_stats)
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            self._stream(make_mesh())
+        finally:
+            obs.set_metrics_registry(previous)
+        gauges = registry.export()["gauges"]
+        assert gauges["hbm.bytes_in_use"] == 123_456.0
+        assert gauges["hbm.peak_bytes"] == 789_000.0
+
+    def test_cpu_backend_reports_zeros_not_crashes(self):
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            self._stream(make_mesh())
+        finally:
+            obs.set_metrics_registry(previous)
+        gauges = registry.export()["gauges"]
+        # CPU devices expose no allocator stats: zeros, never a raise.
+        assert gauges["hbm.bytes_in_use"] == 0.0
+        assert gauges["hbm.peak_bytes"] == 0.0
+
+    def test_disabled_obs_never_touches_the_device_api(self, monkeypatch):
+        from bayesian_consensus_engine_tpu.utils import profiling
+
+        def exploding(device=None):
+            raise AssertionError("sampled device memory with obs disabled")
+
+        monkeypatch.setattr(profiling, "device_memory_stats", exploding)
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+
+        self._stream(make_mesh())  # no registry installed: must not call
+
+
+class TestStatsGoodputSurface:
+    """The ledger/stats half: extras.slo merges across repeats into the
+    goodput column, and diff_bands covers the latency/goodput metrics."""
+
+    def test_slo_extras_merge_into_goodput_band(self, tmp_path):
+        path = tmp_path / "run.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            for counts in (
+                {"met": 8, "violated": 1, "shed": 1, "rejected": 0},
+                {"met": 6, "violated": 2, "shed": 0, "rejected": 2},
+            ):
+                ledger.record(
+                    "e2e_serve.overload.latency", value=0.01, unit="s",
+                    extras={"slo": {"objective_s": 0.05, "counts": counts}},
+                )
+            ledger.record("plain", value=1.0, unit="s")
+        summary = obs.summarize(obs.read_ledger(path))
+        band = summary["e2e_serve.overload.latency"]
+        assert band["slo_counts"] == {
+            "met": 14, "violated": 3, "shed": 1, "rejected": 2,
+        }
+        assert band["goodput_within_slo"] == pytest.approx(14 / 20)
+        assert band["slo_objective_s"] == 0.05
+        assert "goodput_within_slo" not in summary["plain"]
+        from bayesian_consensus_engine_tpu.obs.ledger import render
+
+        rendered = render(obs.read_ledger(path))
+        assert "goodput" in rendered.splitlines()[0]
+        assert "70.0%" in rendered
+
+    def test_diff_bands_covers_latency_and_goodput(self):
+        def records(p99_counts, slo_counts):
+            return [{
+                "leg": "serve", "value": 1.0, "unit": "s", "host": {},
+                "extras": {
+                    "latency_hist": {
+                        "bounds": [0.001, 0.01, 0.1],
+                        "counts": p99_counts,
+                    },
+                    "slo": {"objective_s": 0.05, "counts": slo_counts},
+                },
+            }]
+
+        old = records([10, 0, 0, 0], {"met": 9, "violated": 1})
+        new = records([0, 0, 10, 0], {"met": 5, "violated": 5})
+        diff = obs.diff_bands(old, new)
+        metrics = diff["serve"]["metrics"]
+        # Bucket-interpolated: rank 9.9 of 10 falls 0.99 through the
+        # single occupied bucket on each side.
+        assert metrics["p99"]["old"] == pytest.approx(0.001 * 0.99)
+        assert metrics["p99"]["new"] == pytest.approx(0.01 + 0.09 * 0.99)
+        assert metrics["goodput_within_slo"]["old"] == pytest.approx(0.9)
+        assert metrics["goodput_within_slo"]["new"] == pytest.approx(0.5)
+        rendered = obs.render_diff(diff)
+        assert "p99" in rendered and "goodput" in rendered
+        # Legs without latency records keep the old diff shape.
+        plain = obs.diff_bands(
+            [{"leg": "x", "value": 1.0, "unit": "s", "host": {}}],
+            [{"leg": "x", "value": 1.1, "unit": "s", "host": {}}],
+        )
+        assert "metrics" not in plain["x"]
