@@ -109,9 +109,86 @@ class PackedBatch:
         )
 
 
+class SourceCodes:
+    """Zero-copy per-signal source column: int32 codes + code → id table.
+
+    The buffer-protocol intake for callers that never hold one Python
+    string per signal — a feed that already tables its source ids (the
+    streamed service's steady state) passes ``SourceCodes(codes, table)``
+    anywhere a per-signal ``source_ids`` sequence is accepted
+    (:func:`topology_fingerprint`, :func:`group_columns`,
+    :func:`~.pipeline.build_settlement_plan_columnar`) and the whole
+    ingest path runs without materialising a per-signal object: codes
+    flow straight into the native grouping pass, and the fingerprint's
+    joined-id bytes come from one C concat over the table.
+
+    ``codes[i]`` indexes ``table``; the table must be unique (two codes
+    mapping to one id would alias a pair) — validated here, once, O(U).
+    Equivalent string and coded columns produce byte-identical plans and
+    fingerprints (pinned by tests/test_fastpack.py).
+    """
+
+    __slots__ = ("codes", "table")
+
+    def __init__(self, codes, table: Sequence[str]) -> None:
+        self.codes = np.ascontiguousarray(codes, dtype=np.int32)
+        self.table = list(table)
+        if len(set(self.table)) != len(self.table):
+            raise ValueError("SourceCodes table entries must be unique")
+        if len(self.codes) and len(self.table) == 0:
+            raise ValueError("SourceCodes has signals but an empty table")
+        if len(self.codes) and (
+            int(self.codes.min()) < 0
+            or int(self.codes.max()) >= len(self.table)
+        ):
+            # Checked HERE, not just at plan build: the fingerprint path
+            # indexes the table with these codes, and a negative code
+            # would WRAP (Python/numpy negative indexing) into a silently
+            # aliased digest — a wrong-topology plan-reuse hit.
+            raise ValueError("SourceCodes codes out of table range")
+
+    def __len__(self) -> int:
+        return len(self.codes)
+
+
+def encode_source_ids(source_ids: Sequence[str]) -> SourceCodes:
+    """Strings → :class:`SourceCodes` (one interning pass, C when built).
+
+    The bridge for callers that want to pay the per-signal string walk
+    ONCE and then re-submit coded columns every batch (ids in a steady
+    feed repeat heavily; re-encoding only new ids is the caller's
+    amortisation to claim).
+    """
+    codes, table = _intern_source_codes(source_ids)
+    return SourceCodes(codes, table)
+
+
+def _intern_source_codes(source_ids):
+    """Strings → first-seen int32 codes + unique table, C pass when built."""
+    from bayesian_consensus_engine_tpu.utils.interning import (
+        IdInterner,
+        _load_internmap,
+    )
+
+    module = _load_internmap()
+    if module is not None:
+        table = module.InternMap()
+        # The C pass accepts any sequence — don't copy 4M refs when the
+        # caller already holds a list/tuple.
+        if not isinstance(source_ids, (list, tuple)):
+            source_ids = list(source_ids)
+        codes = np.frombuffer(
+            table.intern_batch(source_ids), dtype=np.int32
+        )
+        return codes, table.ids()
+    interner = IdInterner()
+    codes = np.asarray(interner.intern_all(source_ids), dtype=np.int32)
+    return codes, interner.ids()
+
+
 def topology_fingerprint(
     market_keys: Sequence[str],
-    source_ids: Sequence[str],
+    source_ids: "Sequence[str] | SourceCodes",
     offsets,
 ) -> bytes:
     """Order-sensitive fingerprint of a batch's SIGNAL TOPOLOGY.
@@ -132,6 +209,12 @@ def topology_fingerprint(
     itself (~2^-64 at any realistic stream length). One join + one hash
     pass over the columns: ~10ms per million signals, paid on the
     prefetch thread.
+
+    *source_ids* may be a :class:`SourceCodes` column: the digest is
+    IDENTICAL to the one the decoded string column would produce (the
+    per-signal code-point lengths come from a table-lengths take, the
+    joined UTF-8 from one C concat over codes), so string and coded
+    feeds interoperate in one plan-reuse chain.
     """
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     digest = hashlib.blake2b(digest_size=16)
@@ -143,24 +226,70 @@ def topology_fingerprint(
         .tobytes()
     )
     digest.update("".join(market_keys).encode("utf-8"))
-    digest.update(
-        np.fromiter(map(len, source_ids), np.int64, len(source_ids))
-        .tobytes()
-    )
-    digest.update("".join(source_ids).encode("utf-8"))
+    if isinstance(source_ids, SourceCodes):
+        table_lens = np.fromiter(
+            map(len, source_ids.table), np.int64, len(source_ids.table)
+        )
+        if len(source_ids):
+            digest.update(table_lens[source_ids.codes].tobytes())
+        if (
+            _fastpack is not None
+            and hasattr(_fastpack, "join_codes")
+            and not native_disabled()
+        ):
+            digest.update(
+                _fastpack.join_codes(source_ids.codes, source_ids.table)
+            )
+        else:
+            table = source_ids.table
+            digest.update(
+                "".join(
+                    [table[c] for c in source_ids.codes.tolist()]
+                ).encode("utf-8")
+            )
+    else:
+        digest.update(
+            np.fromiter(map(len, source_ids), np.int64, len(source_ids))
+            .tobytes()
+        )
+        digest.update("".join(source_ids).encode("utf-8"))
     digest.update(offsets.tobytes())
     return digest.digest()
 
 
-def columns_from_payloads(payloads):
+def columns_from_payloads(payloads, native: "bool | None" = None):
     """Flatten dict payloads to ``(market_keys, source_ids, probs, offsets)``.
 
     The light single pass the delta-ingest path runs INSTEAD of packing:
     no grouping, no sorting, no interning — just the raw columns in
     original signal order, i.e. exactly the columnar form
     :func:`~.pipeline.build_settlement_plan_columnar` consumes and
-    :func:`topology_fingerprint` hashes.
+    :func:`topology_fingerprint` hashes. Runs as one C pass when the
+    native extension is built (``native=None`` auto-detects; the pure-
+    Python twin below produces identical values either way), so the
+    prefetch thread's dict → columns conversion never walks per-signal
+    Python bytecode.
     """
+    use_native = _columnar_native_available() if native is None else native
+    if use_native:
+        if _fastpack is None or not hasattr(
+            _fastpack, "columns_from_payloads"
+        ):
+            raise RuntimeError(
+                "native packer requested but not built; "
+                "run python native/build.py"
+            )
+        if not isinstance(payloads, (list, tuple)):
+            payloads = list(payloads)
+        keys, source_ids, probs_buf, offs_buf = (
+            _fastpack.columns_from_payloads(payloads)
+        )
+        return (
+            keys,
+            source_ids,
+            np.frombuffer(probs_buf, dtype=np.float64),
+            np.frombuffer(offs_buf, dtype=np.int64),
+        )
     market_keys: list[str] = []
     source_ids: list[str] = []
     probs: list[float] = []
@@ -179,10 +308,166 @@ def columns_from_payloads(payloads):
     )
 
 
+from bayesian_consensus_engine_tpu.utils.interning import native_disabled
+
 try:  # native ingest packer (see native/fastpack.c; build with native/build.py)
+    # ``BCE_NO_NATIVE=1`` forces every native ingest fast path (fastpack
+    # AND the internmap interner) down to its pure-Python twin — the CI
+    # lane that keeps the twins from rotting (tests/test_fastpack.py
+    # runs a parity matrix under it in a subprocess). Gated at import
+    # AND re-consulted by every auto-detection below, so a runtime env
+    # change flips the whole stack, never a half-native hybrid.
+    if native_disabled():
+        raise ImportError("BCE_NO_NATIVE forces the pure-Python packer")
     from bayesian_consensus_engine_tpu._native import fastpack as _fastpack
 except ImportError:  # pure-Python fallback below — identical outputs
     _fastpack = None
+
+
+def _object_native_available() -> bool:
+    """Auto-detection for the object packer: extension built AND the
+    forced-fallback knob unset (explicit ``native=True`` bypasses this)."""
+    return _fastpack is not None and not native_disabled()
+
+
+def _columnar_native_available() -> bool:
+    """True when the built extension carries the columnar fast path (an
+    older ``fastpack.so`` predating it degrades to the numpy twins
+    instead of erroring) and the forced-fallback knob is unset."""
+    return (
+        _fastpack is not None
+        and hasattr(_fastpack, "group_columns")
+        and not native_disabled()
+    )
+
+
+def group_columns(
+    codes: np.ndarray,
+    rank_of_code: np.ndarray,
+    offsets: np.ndarray,
+    probabilities: np.ndarray,
+    native: "bool | None" = None,
+):
+    """The columnar grouping pass: coded signals → ordered pair arrays.
+
+    Given per-signal int32 source *codes*, the code → code-point-rank
+    permutation, int64 CSR *offsets* (market ``m``'s signals are
+    ``[offsets[m], offsets[m+1])``) and float64 *probabilities*, returns
+
+    ``(signal_pairs i64[N], pair_market i32[P], pair_rank i32[P],
+    pair_offsets i64[M+1], pair_sums f64[P], pair_counts i64[P])``
+
+    with pairs ordered market-major, rank ascending within each market
+    (the scalar engine's float-summation order) and per-pair sums
+    accumulated in original signal order (the duplicate-averaging
+    contract). ``native=None`` auto-detects the C pass
+    (``fastpack.group_columns``, emitting into preallocated buffers);
+    ``False`` forces the numpy twin; both produce identical arrays
+    bit-for-bit (pinned by tests/test_fastpack.py).
+    """
+    use_native = _columnar_native_available() if native is None else native
+    num_markets = len(offsets) - 1
+    if use_native:
+        if not _columnar_native_available():
+            raise RuntimeError(
+                "native packer requested but not built; "
+                "run python native/build.py"
+            )
+        n = len(codes)
+        signal_pairs = np.empty(n, dtype=np.int64)
+        pair_market = np.empty(n, dtype=np.int32)
+        pair_rank = np.empty(n, dtype=np.int32)
+        pair_offsets = np.empty(num_markets + 1, dtype=np.int64)
+        sums = np.empty(n, dtype=np.float64)
+        counts = np.empty(n, dtype=np.int64)
+        num_pairs = _fastpack.group_columns(
+            np.ascontiguousarray(codes, dtype=np.int32),
+            np.ascontiguousarray(rank_of_code, dtype=np.int32),
+            np.ascontiguousarray(offsets, dtype=np.int64),
+            np.ascontiguousarray(probabilities, dtype=np.float64),
+            signal_pairs, pair_market, pair_rank, pair_offsets, sums, counts,
+        )
+        return (
+            signal_pairs,
+            pair_market[:num_pairs],
+            pair_rank[:num_pairs],
+            pair_offsets,
+            sums[:num_pairs],
+            counts[:num_pairs],
+        )
+
+    # Numpy twin. Composite (market, source-rank) key: its sorted-unique
+    # sequence IS the pair list in the scalar engine's order.
+    codes = np.asarray(codes)
+    if len(codes) and int(codes.min()) < 0:
+        # Negative-index wrapping would return a plausible-but-wrong
+        # grouping where the C pass raises; the twins must error alike.
+        raise IndexError("source codes must be non-negative")
+    num_uniq = len(rank_of_code)
+    market_of_signal = np.repeat(
+        np.arange(num_markets, dtype=np.int64), np.diff(offsets)
+    )
+    stride = max(num_uniq, 1)
+    key = market_of_signal * stride + np.asarray(
+        rank_of_code, dtype=np.int64
+    )[codes]
+    uniq_keys, signal_pairs = np.unique(key, return_inverse=True)
+    pair_market = (uniq_keys // stride).astype(np.int32)
+    pair_rank = (uniq_keys % stride).astype(np.int32)
+    pair_offsets = np.searchsorted(
+        pair_market, np.arange(num_markets + 1)
+    ).astype(np.int64)
+    num_pairs = len(uniq_keys)
+    # np.add.at accumulates in signal order — the scalar path's
+    # left-to-right duplicate sum per pair.
+    sums = np.zeros(num_pairs, dtype=np.float64)
+    np.add.at(sums, signal_pairs, probabilities)
+    counts = np.bincount(signal_pairs, minlength=num_pairs)
+    return (
+        signal_pairs, pair_market, pair_rank, pair_offsets, sums, counts,
+    )
+
+
+def pair_accumulate(
+    pair_idx: np.ndarray,
+    probabilities: np.ndarray,
+    num_pairs: int,
+    native: "bool | None" = None,
+) -> np.ndarray:
+    """Ordered per-pair probability sums — the refresh twin's inner pass.
+
+    ``sums[pair_idx[i]] += probabilities[i]`` in signal order (the float
+    contract of the scalar engine's left-to-right duplicate sum). The C
+    pass and the ``np.add.at`` twin are bit-identical; ``native=None``
+    auto-detects.
+    """
+    use_native = (
+        _fastpack is not None
+        and hasattr(_fastpack, "pair_accumulate")
+        and not native_disabled()
+        if native is None
+        else native
+    )
+    sums = np.zeros(num_pairs, dtype=np.float64)
+    if use_native:
+        if _fastpack is None or not hasattr(_fastpack, "pair_accumulate"):
+            raise RuntimeError(
+                "native packer requested but not built; "
+                "run python native/build.py"
+            )
+        _fastpack.pair_accumulate(
+            np.ascontiguousarray(pair_idx),
+            np.ascontiguousarray(probabilities, dtype=np.float64),
+            sums,
+        )
+    else:
+        pair_idx = np.asarray(pair_idx)
+        if len(pair_idx) and int(pair_idx.min()) < 0:
+            # np.add.at wraps negative indices; the C pass raises — the
+            # twins must error alike.
+            raise IndexError("pair indices must be non-negative")
+        np.add.at(sums, pair_idx, probabilities)
+    return sums
 
 
 def _pack_grouping_python(markets):
@@ -232,7 +517,7 @@ def pack_markets(
     twin — both produce identical outputs). The reliability ``lookup`` is a
     user callable and always runs in Python, once per unique pair.
     """
-    use_native = (_fastpack is not None) if native is None else native
+    use_native = _object_native_available() if native is None else native
     if use_native and _fastpack is None:
         raise RuntimeError(
             "native packer requested but not built; run python native/build.py"

@@ -48,8 +48,12 @@ from typing import Any, Mapping, Optional, Sequence
 import numpy as np
 
 from bayesian_consensus_engine_tpu.core.batch import (
+    SourceCodes,
+    _intern_source_codes,
     columns_from_payloads,
+    group_columns,
     pack_markets,
+    pair_accumulate,
     topology_fingerprint,
 )
 from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
@@ -141,10 +145,10 @@ class SettlementPlan:
                 f"{len(probabilities)} probabilities for a topology of "
                 f"{len(signal_pairs)} signals"
             )
-        # Same ordered accumulate as the builders (np.add.at in signal
-        # order = the scalar engine's left-to-right duplicate sum).
-        sums = np.zeros(len(counts), dtype=np.float64)
-        np.add.at(sums, signal_pairs, probabilities)
+        # Same ordered accumulate as the builders (signal order = the
+        # scalar engine's left-to-right duplicate sum; one C pass when
+        # the native packer is built, np.add.at otherwise — bit-equal).
+        sums = pair_accumulate(signal_pairs, probabilities, len(counts))
         pair_mean = sums / np.maximum(counts, 1)
         probs = np.zeros_like(self.probs)
         probs[slot_of_pair, market_of_pair] = pair_mean
@@ -207,6 +211,13 @@ def build_settlement_plan(
         raise ValueError("duplicate market ids in one settlement plan")
 
     packed = pack_markets(payloads, native=native)
+    from bayesian_consensus_engine_tpu.core import batch as _batch_mod
+
+    _count_pack(
+        _batch_mod._object_native_available()
+        if native is None
+        else bool(native)
+    )
     market_of_pair = packed.pair_market
     pair_markets = [keys[row] for row in market_of_pair.tolist()]
     rows = store.rows_for_arrays(
@@ -237,36 +248,88 @@ def build_settlement_plan(
     )
 
 
-def build_settlement_plan_columnar(
-    store,
+@dataclass
+class StagedColumnarPlan:
+    """The store-free half of a columnar plan build.
+
+    Everything :func:`build_settlement_plan_columnar` computes WITHOUT
+    touching the store: validation, source-code resolution, the grouping
+    pass (native C when built), duplicate averaging, and the topology
+    fingerprint. :meth:`bind` completes it — one pair-interning pass plus
+    the dense block fill — and is the only part that mutates shared state.
+
+    The split is what lets the serving front end overlap packing with
+    device compute WITHOUT perturbing durability bytes: staging runs
+    ahead on a pack thread while the previous batch settles, and the
+    interning (whose order decides row assignment — and therefore which
+    journal epoch a new pair's table row lands in) stays on the single
+    dispatch thread, in batch order, exactly where it always ran.
+    ``stage(...).bind(store)`` ≡ the one-shot builder, bit-for-bit.
+    """
+
+    market_keys: list
+    sid_of_rank: list
+    pair_market: np.ndarray
+    pair_rank: np.ndarray
+    pair_offsets: np.ndarray
+    pair_mean: np.ndarray
+    signals_per_market: np.ndarray
+    signal_pairs: np.ndarray
+    num_slots: "int | str | None"
+    fingerprint: "bytes | None"
+    used_native: bool
+
+    def bind(self, store) -> SettlementPlan:
+        """Intern this stage's pairs into *store* and assemble the plan."""
+        # Interning by (table, code): no per-pair string list is ever
+        # built — the binding probes rehydrate the handful they sample.
+        rows = store.rows_for_indexed(
+            self.sid_of_rank, self.pair_rank,
+            self.market_keys, self.pair_market,
+        )
+        _count_pack(self.used_native)
+        sid_of_rank, pair_rank = self.sid_of_rank, self.pair_rank
+        market_keys, pair_market = self.market_keys, self.pair_market
+        return _assemble_plan(
+            market_keys,
+            rows,
+            pair_market,
+            self.pair_offsets,
+            self.pair_mean,
+            lambda i: sid_of_rank[pair_rank[i]],
+            lambda i: market_keys[pair_market[i]],
+            self.signals_per_market,
+            num_slots=self.num_slots,
+            signal_pairs=self.signal_pairs,
+            fingerprint=self.fingerprint,
+        )
+
+
+def _count_pack(used_native: bool) -> None:
+    """ingest.native_packs / ingest.python_packs — which packer built the
+    plan (no-ops unless obs enabled a registry)."""
+    registry = metrics_registry()
+    name = "ingest.native_packs" if used_native else "ingest.python_packs"
+    registry.counter(name).inc()
+
+
+def stage_settlement_plan_columnar(
     market_keys: Sequence[str],
-    source_ids: Sequence[str],
+    source_ids: "Sequence[str] | SourceCodes",
     probabilities,
     offsets,
     num_slots: "int | str | None" = None,
     fingerprint: "bool | bytes" = False,
-) -> SettlementPlan:
-    """Vectorised twin of :func:`build_settlement_plan` for columnar input.
+    native: Optional[bool] = None,
+) -> StagedColumnarPlan:
+    """Validate + group one columnar batch without touching any store.
 
-    Callers that already hold their signals as flat columns — *source_ids*
-    (one string per signal, markets back to back), *probabilities*
-    (float64[N]) and CSR *offsets* (int32[M+1]; market ``m``'s signals are
-    ``[offsets[m], offsets[m+1])``) — skip the per-signal Python dict walk
-    entirely: grouping, per-market source-id ordering, duplicate averaging
-    and the dense block fill all run as whole-column numpy passes, with one
-    C interning pass for the source-id strings. Produces a plan identical
-    (bit-for-bit, including binding probes and row assignment order) to the
-    dict-payload path on equivalent input.
-
-    Semantics notes pinned to the reference engine:
-
-    * pairs within a market are ordered by source id (code-point order, the
-      scalar engine's float-summation order, reference: core.py:103);
-    * duplicate signals from one (source, market) average in original
-      signal order (reference: core.py:115-116).
-
-    ``num_slots`` pins the block's slot height K and ``fingerprint``
-    stamps the topology digest (see :func:`build_settlement_plan`).
+    *source_ids* is either one string per signal (markets back to back)
+    or a :class:`~.core.batch.SourceCodes` column — the zero-copy intake
+    that skips per-signal Python objects entirely (codes flow straight
+    into the native grouping pass). ``native`` forces the C grouping
+    (True), the numpy twin (False), or auto-detects (None); outputs are
+    bit-identical either way.
     """
     market_keys = list(market_keys)
     if len(set(market_keys)) != len(market_keys):
@@ -288,82 +351,97 @@ def build_settlement_plan_columnar(
         )
 
     signals_per_market = np.diff(offsets).astype(np.int32)
-    market_of_signal = np.repeat(
-        np.arange(num_markets, dtype=np.int64), signals_per_market
-    )
 
-    # Source id strings → dense codes (one C pass), then code → rank in
-    # code-point order by sorting the unique table (small: one entry per
-    # distinct source id, not per signal).
-    codes, uniq = _intern_source_codes(source_ids)
+    # Source column → dense codes: zero-copy when the caller tabled its
+    # ids (SourceCodes), one C interning pass for strings. Then code →
+    # rank in code-point order by sorting the unique table (small: one
+    # entry per distinct source id, not per signal).
+    if isinstance(source_ids, SourceCodes):
+        codes, uniq = source_ids.codes, source_ids.table
+        if len(codes) and (
+            int(codes.min()) < 0 or int(codes.max()) >= len(uniq)
+        ):
+            raise ValueError("SourceCodes codes out of table range")
+    else:
+        codes, uniq = _intern_source_codes(source_ids)
     order = sorted(range(len(uniq)), key=uniq.__getitem__)
     rank_of_code = np.empty(max(len(uniq), 1), dtype=np.int64)
     rank_of_code[np.asarray(order, dtype=np.int64)] = np.arange(len(order))
     sid_of_rank = [uniq[code] for code in order]
 
-    # Composite (market, source-rank) key: its sorted-unique sequence IS the
-    # pair list in the scalar engine's order (market-major, source ids
-    # ascending within each market).
-    stride = max(len(uniq), 1)
-    key = market_of_signal * stride + rank_of_code[codes]
-    uniq_keys, pair_of_signal = np.unique(key, return_inverse=True)
-    pair_market = (uniq_keys // stride).astype(np.int32)
-    pair_rank = (uniq_keys % stride).astype(np.int32)
-    pair_offsets = np.searchsorted(
-        pair_market, np.arange(num_markets + 1)
-    ).astype(np.int64)
+    from bayesian_consensus_engine_tpu.core.batch import (
+        _columnar_native_available,
+    )
 
-    # Duplicate averaging: np.add.at accumulates in signal order — the
-    # scalar path's left-to-right sum per pair (see _pair_means).
-    num_pairs = len(uniq_keys)
-    sums = np.zeros(num_pairs, dtype=np.float64)
-    np.add.at(sums, pair_of_signal, probabilities)
-    counts = np.bincount(pair_of_signal, minlength=num_pairs)
+    used_native = (
+        _columnar_native_available() if native is None else bool(native)
+    )
+    (signal_pairs, pair_market, pair_rank, pair_offsets,
+     sums, counts) = group_columns(
+        codes, rank_of_code, offsets, probabilities, native=native
+    )
     pair_mean = sums / np.maximum(counts, 1)
 
-    # Interning by (table, code): no per-pair string list is ever built —
-    # the binding probes below rehydrate the handful they sample.
-    rows = store.rows_for_indexed(
-        sid_of_rank, pair_rank, market_keys, pair_market
-    )
     if fingerprint is True:
         fingerprint = topology_fingerprint(market_keys, source_ids, offsets)
-    return _assemble_plan(
-        market_keys,
-        rows,
-        pair_market,
-        pair_offsets,
-        pair_mean,
-        lambda i: sid_of_rank[pair_rank[i]],
-        lambda i: market_keys[pair_market[i]],
-        signals_per_market,
+    return StagedColumnarPlan(
+        market_keys=market_keys,
+        sid_of_rank=sid_of_rank,
+        pair_market=pair_market,
+        pair_rank=pair_rank,
+        pair_offsets=pair_offsets,
+        pair_mean=pair_mean,
+        signals_per_market=signals_per_market,
+        signal_pairs=signal_pairs,
         num_slots=num_slots,
-        signal_pairs=pair_of_signal,
         fingerprint=fingerprint or None,
+        used_native=used_native,
     )
 
 
-def _intern_source_codes(source_ids):
-    """Strings → first-seen int32 codes + unique table, C pass when built."""
-    from bayesian_consensus_engine_tpu.utils.interning import (
-        IdInterner,
-        _load_internmap,
-    )
+def build_settlement_plan_columnar(
+    store,
+    market_keys: Sequence[str],
+    source_ids: "Sequence[str] | SourceCodes",
+    probabilities,
+    offsets,
+    num_slots: "int | str | None" = None,
+    fingerprint: "bool | bytes" = False,
+    native: Optional[bool] = None,
+) -> SettlementPlan:
+    """Vectorised twin of :func:`build_settlement_plan` for columnar input.
 
-    module = _load_internmap()
-    if module is not None:
-        table = module.InternMap()
-        # The C pass accepts any sequence — don't copy 4M refs when the
-        # caller already holds a list/tuple.
-        if not isinstance(source_ids, (list, tuple)):
-            source_ids = list(source_ids)
-        codes = np.frombuffer(
-            table.intern_batch(source_ids), dtype=np.int32
-        )
-        return codes, table.ids()
-    interner = IdInterner()
-    codes = np.asarray(interner.intern_all(source_ids), dtype=np.int32)
-    return codes, interner.ids()
+    Callers that already hold their signals as flat columns — *source_ids*
+    (one string per signal, markets back to back, or a
+    :class:`~.core.batch.SourceCodes` zero-copy coded column),
+    *probabilities* (float64[N]) and CSR *offsets* (int64[M+1]; market
+    ``m``'s signals are ``[offsets[m], offsets[m+1])``) — skip the
+    per-signal Python dict walk entirely: grouping, per-market source-id
+    ordering and duplicate averaging run as ONE native C pass over the
+    coded columns (``fastpack.group_columns``; numpy twin without the
+    extension — bit-identical), with one C interning pass each for the
+    source-id strings and the (source, market) pairs. Produces a plan
+    identical (bit-for-bit, including binding probes and row assignment
+    order) to the dict-payload path on equivalent input.
+
+    Semantics notes pinned to the reference engine:
+
+    * pairs within a market are ordered by source id (code-point order, the
+      scalar engine's float-summation order, reference: core.py:103);
+    * duplicate signals from one (source, market) average in original
+      signal order (reference: core.py:115-116).
+
+    ``num_slots`` pins the block's slot height K and ``fingerprint``
+    stamps the topology digest (see :func:`build_settlement_plan`);
+    ``native`` forces/forbids the C grouping pass. The build is the
+    composition ``stage_settlement_plan_columnar(...).bind(store)`` —
+    callers that need the store-free half on its own schedule (the
+    serving front end's pack thread) use the two halves directly.
+    """
+    return stage_settlement_plan_columnar(
+        market_keys, source_ids, probabilities, offsets,
+        num_slots=num_slots, fingerprint=fingerprint, native=native,
+    ).bind(store)
 
 
 def _assemble_plan(
@@ -468,13 +546,10 @@ def _pair_means(packed) -> np.ndarray:
     accumulation reproduces it exactly.
     """
     num_pairs = len(packed.pair_source_ids)
-    sums = np.zeros(num_pairs, dtype=np.float64)
-    counts = np.zeros(num_pairs, dtype=np.int64)
-    flat_pair = packed.flat_pair
-    flat_probs = packed.flat_probs
-    # np.add.at is an ordered sequential accumulate — scalar-sum order.
-    np.add.at(sums, flat_pair, flat_probs)
-    np.add.at(counts, flat_pair, 1)
+    # Ordered sequential accumulate — scalar-sum order (C pass when the
+    # native packer is built; np.add.at twin is bit-identical).
+    sums = pair_accumulate(packed.flat_pair, packed.flat_probs, num_pairs)
+    counts = np.bincount(packed.flat_pair, minlength=num_pairs)
     return sums / np.maximum(counts, 1)
 
 
@@ -1513,7 +1588,7 @@ class PlanPrefetcher:
                     keys, source_ids, probabilities, offsets = batch
                     return build_settlement_plan_columnar(
                         store, keys, source_ids, probabilities, offsets,
-                        num_slots=num_slots,
+                        num_slots=num_slots, native=native,
                     )
                 return build_settlement_plan(
                     store, batch, native=native, num_slots=num_slots
@@ -1524,8 +1599,11 @@ class PlanPrefetcher:
                     probabilities, dtype=np.float64
                 )
             else:
+                # Dict payloads flatten to columns HERE, on the worker —
+                # one C pass when built — so the consumer thread never
+                # pays a per-signal Python walk.
                 keys, source_ids, probabilities, offsets = (
-                    columns_from_payloads(batch)
+                    columns_from_payloads(batch, native=native)
                 )
             digest = topology_fingerprint(keys, source_ids, offsets)
             prev = last_plan[0]
@@ -1534,7 +1612,7 @@ class PlanPrefetcher:
             else:
                 plan = build_settlement_plan_columnar(
                     store, keys, source_ids, probabilities, offsets,
-                    num_slots=num_slots, fingerprint=digest,
+                    num_slots=num_slots, fingerprint=digest, native=native,
                 )
             last_plan[0] = plan
             return plan
@@ -1914,6 +1992,11 @@ def settle_stream(
     reuse_hit_counter = registry.counter("stream.plan_reuse_hits")
     reuse_miss_counter = registry.counter("stream.plan_reuse_misses")
     dispatch_hist = registry.histogram("stream.settle_dispatch_s")
+    # Cumulative consumer seconds blocked on the prefetch thread — the
+    # stream's live ingest-wait number (ledger/stats surface it; ~0 in
+    # the steady state now that packing is native + overlapped).
+    ingest_wait_gauge = registry.gauge("stream.ingest_wait_s")
+    total_plan_wait = 0.0
 
     driver = SessionDriver(
         store,
@@ -1948,6 +2031,8 @@ def settle_stream(
                 except StopIteration:
                     break
                 plan_wait_s = _time.perf_counter() - wait_start
+                total_plan_wait += plan_wait_s
+                ingest_wait_gauge.set(total_plan_wait)
                 index += 1
                 outcomes = outcome_queue.popleft()
                 batch_now = None if now is None else now + index

@@ -81,12 +81,31 @@ layers are write-only: tracing/SLO on vs off moves no settlement byte
 settlement runs on ONE dedicated worker thread (batches dispatch in flush
 order — the driver is single-driver by contract). The store underneath is
 thread-safe. Use as an async context manager, or call :meth:`close`.
+
+**Pack/compute overlap** (round 10). A second single-thread executor —
+the pack thread — runs the STORE-FREE half of each batch's plan build
+(:meth:`~.serve.driver.PlanCache.stage`: fingerprint, native columnar
+grouping, the probability-only refresh on a hit) while the previous
+batch holds the device; the dispatch worker only waits for the staged
+result and, on a fingerprint miss, finishes the interning + block
+assembly (:meth:`~.serve.driver.PlanCache.bind`) in batch order. The
+split is what keeps the overlap byte-deterministic: interning order —
+which decides row assignment and which journal epoch a new pair's table
+row lands in — never leaves the single dispatch thread, so the served
+bytes stay a pure function of the submission trace (PR 6's lockstep
+byte-parity tests run unchanged). A bound-event chain sequences
+``stage(N+1)`` after ``bind(N)``, so the plan-cache hit/miss decisions
+are exactly :class:`~.pipeline.PlanPrefetcher`'s. The worker's residual
+wait is the ``pack`` phase span and accumulates in the
+``serve.ingest_wait_s`` gauge (≈ 0 in the steady state — the
+``e2e_serve`` leg's ``ingest_wait_s`` band).
 """
 
 from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import threading
 import time as _time
 from dataclasses import dataclass
 from typing import Any, Mapping, Optional, Sequence, Union
@@ -283,6 +302,20 @@ class ConsensusService:
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="bce-serve-dispatch"
         )
+        #: The pack thread: runs PlanCache.stage (store-free grouping /
+        #: refresh) one batch ahead of the dispatch worker. ONE thread,
+        #: fed in flush order, so the plan-reuse chain stays sequential.
+        self._pack_executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="bce-serve-pack"
+        )
+        #: Bound-event chain: batch N's event fires once its plan can no
+        #: longer mutate the store (stage-complete on a hit, bind-complete
+        #: on a miss) — the gate stage(N+1) waits behind.
+        self._last_bound: Optional[threading.Event] = None
+        #: Cumulative dispatch-worker seconds spent waiting on (and
+        #: finishing) plan builds — the served path's ingest wait.
+        self._ingest_wait_s = 0.0
+        self._ingest_wait_gauge = registry.gauge("serve.ingest_wait_s")
 
     # -- submission (event-loop thread) --------------------------------------
 
@@ -295,6 +328,14 @@ class ConsensusService:
     @property
     def pending_requests(self) -> int:
         return self._resident
+
+    @property
+    def ingest_wait_s(self) -> float:
+        """Cumulative dispatch-worker seconds blocked on plan builds —
+        the served path's ingest wait (also the ``serve.ingest_wait_s``
+        gauge). ≈ 0 in the steady state: staging overlaps the previous
+        batch's device window on the pack thread."""
+        return self._ingest_wait_s
 
     def submit(self, market_id: str, signals: Sequence[Signal],
                outcome: bool) -> "asyncio.Future[ServeResult]":
@@ -505,21 +546,59 @@ class ConsensusService:
             self.batch_log.append(
                 ((keys, source_ids, probabilities, offsets), outcomes)
             )
+        # The micro-batch columnar is built — hand its store-free plan
+        # stage to the pack thread NOW, so it overlaps the previous
+        # batch's device window. The bound-event chain (created here, on
+        # the loop thread, in flush order) sequences the stages.
+        prev_bound = self._last_bound
+        bound = threading.Event()
+        self._last_bound = bound
+        pack_future = self._pack_executor.submit(
+            self._stage_batch, prev_bound, bound,
+            keys, source_ids, probabilities, offsets,
+        )
         future = self._loop.run_in_executor(
             self._executor, self._run_batch,
-            batch_index, keys, source_ids, probabilities, offsets, outcomes,
-            requests,
+            batch_index, pack_future, bound, keys, outcomes, requests,
         )
         self._inflight.add(future)
         future.add_done_callback(self._inflight.discard)
 
+    # -- plan staging (pack thread) ------------------------------------------
+
+    def _stage_batch(self, prev_bound, bound, keys, source_ids,
+                     probabilities, offsets):
+        """Store-free plan stage for one batch, sequenced behind its
+        predecessor's bound point (see the module docstring)."""
+        from bayesian_consensus_engine_tpu.pipeline import StagedColumnarPlan
+
+        if prev_bound is not None:
+            prev_bound.wait()
+        try:
+            staged = self._plans.stage(
+                keys, source_ids, probabilities, offsets
+            )
+        except BaseException:
+            # Successors must never deadlock behind a failed stage; the
+            # error itself surfaces on the dispatch worker's future wait.
+            bound.set()
+            raise
+        if not isinstance(staged, StagedColumnarPlan):
+            # Fingerprint hit: the refresh twin is a complete plan and
+            # the store was never touched — the next stage may proceed.
+            bound.set()
+        return staged
+
     # -- dispatch (worker thread) --------------------------------------------
 
-    def _run_batch(self, batch_index, keys, source_ids, probabilities,
-                   offsets, outcomes, requests) -> None:
+    def _run_batch(self, batch_index, pack_future, bound, keys, outcomes,
+                   requests) -> None:
         loop = self._loop
         tracer = active_tracer()
         if self._failure is not None:
+            # The abandoned batch still fires its bound event, or the
+            # pack thread would deadlock behind it forever.
+            bound.set()
             failure = ServiceClosed(
                 f"batch {batch_index} abandoned after an earlier failure"
             )
@@ -537,16 +616,24 @@ class ConsensusService:
             return
         try:
             # The batch scope: every canonical phase span taken inside
-            # (the plan build here, upload/settle_dispatch in dispatch,
-            # checkpoint/journal in the durability step) lands on batch
-            # `batch_index`'s trace chain — the TraceContext propagation
-            # across the asyncio → worker boundary, without new
-            # instrumentation at the span sites.
+            # (the plan-stage wait here, upload/settle_dispatch in
+            # dispatch, checkpoint/journal in the durability step) lands
+            # on batch `batch_index`'s trace chain — the TraceContext
+            # propagation across the asyncio → worker boundary, without
+            # new instrumentation at the span sites.
             with tracer.batch(batch_index, args={"markets": len(keys)}):
+                # The pack phase is now mostly a WAIT on the pack
+                # thread's staged result (plus, on a fingerprint miss,
+                # the interning+assembly that must stay on THIS thread
+                # in batch order — see the module docstring).
+                t_pack = _time.perf_counter()
                 with active_timeline().span("pack"):
-                    plan = self._plans.plan_for(
-                        keys, source_ids, probabilities, offsets
-                    )
+                    try:
+                        plan = self._plans.bind(pack_future.result())
+                    finally:
+                        bound.set()
+                self._ingest_wait_s += _time.perf_counter() - t_pack
+                self._ingest_wait_gauge.set(self._ingest_wait_s)
                 batch_now = (
                     None if self._now is None else self._now + batch_index
                 )
@@ -690,6 +777,7 @@ class ConsensusService:
                 self._executor, self._finalize_worker
             )
         finally:
+            self._pack_executor.shutdown(wait=True)
             self._executor.shutdown(wait=True)
             # The shutdown postmortem: a failure path already snapshotted
             # at the moment of failure (those rings are closer to the

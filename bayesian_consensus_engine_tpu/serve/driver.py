@@ -77,6 +77,18 @@ class PlanCache:
     decisions and identical plans to ``PlanPrefetcher(reuse_plans=True)``
     on the same batch sequence, by construction: same fingerprint, same
     ``SettlementPlan.refresh``, same columnar builder on a miss.
+
+    ``plan_for`` splits into :meth:`stage` (fingerprint + grouping +
+    refresh — NO store interaction) and :meth:`bind` (the interning pass
+    + block assembly) so the serving front end can run the staging half
+    ahead on a pack thread while the previous batch holds the device:
+    a fingerprint HIT completes entirely at stage time (the refresh twin
+    never touches the store), a MISS returns a
+    :class:`~.pipeline.StagedColumnarPlan` for ``bind`` to finish on the
+    dispatch thread — in batch order, so row assignment (and which
+    journal epoch a new pair's table row lands in) stays a deterministic
+    function of the batch sequence. ``bind(stage(...)) ≡ plan_for(...)``
+    bit-for-bit.
     """
 
     def __init__(self, store, num_slots: "int | str | None" = "bucket"):
@@ -88,10 +100,18 @@ class PlanCache:
     def last_plan(self):
         return self._last
 
-    def plan_for(self, market_keys, source_ids, probabilities, offsets):
-        """Plan for one columnar batch; reuses on a topology-digest hit."""
+    def stage(self, market_keys, source_ids, probabilities, offsets):
+        """Store-free half: a complete plan on a fingerprint hit, a
+        :class:`~.pipeline.StagedColumnarPlan` for :meth:`bind` on a miss.
+
+        Calls for consecutive batches must be SEQUENTIAL (one pack
+        thread): the fingerprint compares against the previous batch's
+        plan, and on a miss the chain advances only when :meth:`bind`
+        completes — the caller sequences stage(N+1) after bind(N) (the
+        serving front end's bound-event chain).
+        """
         from bayesian_consensus_engine_tpu.pipeline import (
-            build_settlement_plan_columnar,
+            stage_settlement_plan_columnar,
         )
 
         probabilities = np.ascontiguousarray(probabilities, dtype=np.float64)
@@ -99,13 +119,29 @@ class PlanCache:
         prev = self._last
         if prev is not None and prev.fingerprint == digest:
             plan = prev.refresh(probabilities)
-        else:
-            plan = build_settlement_plan_columnar(
-                self._store, market_keys, source_ids, probabilities, offsets,
-                num_slots=self._num_slots, fingerprint=digest,
-            )
-        self._last = plan
-        return plan
+            self._last = plan
+            return plan
+        return stage_settlement_plan_columnar(
+            market_keys, source_ids, probabilities, offsets,
+            num_slots=self._num_slots, fingerprint=digest,
+        )
+
+    def bind(self, staged):
+        """Finish a :meth:`stage` result: interning + assembly on a miss
+        (the only store-touching step), identity on a hit."""
+        from bayesian_consensus_engine_tpu.pipeline import StagedColumnarPlan
+
+        if isinstance(staged, StagedColumnarPlan):
+            plan = staged.bind(self._store)
+            self._last = plan
+            return plan
+        return staged
+
+    def plan_for(self, market_keys, source_ids, probabilities, offsets):
+        """Plan for one columnar batch; reuses on a topology-digest hit."""
+        return self.bind(
+            self.stage(market_keys, source_ids, probabilities, offsets)
+        )
 
 
 class SessionDriver:

@@ -19,7 +19,27 @@ import numpy as np
 K = TypeVar("K", bound=Hashable)
 
 
+def native_disabled() -> bool:
+    """True when ``BCE_NO_NATIVE`` forces the pure-Python ingest twins.
+
+    THE one parse of the knob (consulted per call, so a runtime env
+    change flips the whole stack together — fastpack auto-detection in
+    ``core.batch`` and the interner here both route through it): the
+    forced-fallback CI lane that keeps the twins from rotting
+    unexercised (tests/test_fastpack.py). An EXPLICIT ``native=True``
+    from a caller still wins over the knob — it gates auto-detection,
+    not forced choices.
+    """
+    import os
+
+    return os.environ.get("BCE_NO_NATIVE", "").lower() not in (
+        "", "0", "false", "off",
+    )
+
+
 def _load_internmap():
+    if native_disabled():
+        return None
     try:
         from bayesian_consensus_engine_tpu._native import internmap
     except ImportError:

@@ -200,6 +200,10 @@ def _min_of_trials(leg_name, variant_names, run_variant, trials):
                     "amortised_1m_cycles_per_sec": out.get(
                         "amortised_1m_cycles_per_sec"
                     ),
+                    # Consumer seconds blocked on ingest (legs that track
+                    # it) — the `bce-tpu stats` ingest_wait column.
+                    "ingest_wait_s": out.get("ingest_wait_s"),
+                    "signals_per_sec": out.get("signals_per_sec"),
                 },
             )
             if name not in best or out["wall_s"] < best[name]["wall_s"]:
@@ -1411,10 +1415,24 @@ def bench_e2e_stream_resident(markets=NUM_MARKETS, batches=6, mean_slots=4,
                 )
 
             phases = {k: round(v, 6) for k, v in timeline.totals().items()}
+            ingest_wait = sum(s["plan_wait_s"] for s in stats)
+            # Steady state excludes batch 0: the pipeline-fill build has
+            # nothing to overlap yet (same convention as the per-act
+            # dispatch windows below). The ISSUE-8 acceptance band is
+            # the STEADY fraction ≈ 0 — every later batch's pack
+            # (topology-miss rebuilds included) rides the prefetch
+            # thread behind the previous batch's settle.
+            ingest_wait_steady = sum(s["plan_wait_s"] for s in stats[1:])
             return {
                 "wall_s": round(wall, 2),
                 "amortised_1m_cycles_per_sec": round(
                     market_cycles / wall / 1e6, 4
+                ),
+                "ingest_wait_s": round(ingest_wait, 4),
+                "ingest_wait_frac": round(ingest_wait / max(wall, 1e-9), 4),
+                "ingest_wait_s_steady": round(ingest_wait_steady, 5),
+                "ingest_wait_frac_steady": round(
+                    ingest_wait_steady / max(wall, 1e-9), 5
                 ),
                 # Steady-state windows exclude each act's first batch
                 # (act 1's compiles+session start; act 2's adopt).
@@ -1706,6 +1724,13 @@ def bench_e2e_serve(markets=2000, source_universe=500, requests=3000,
                 "dispatch_p50_ms": _q_ms(dispatch["p50"]),
                 "dispatch_p99_ms": _q_ms(dispatch["p99"]),
                 "max_pending_seen": counts["max_pending"],
+                # Dispatch-worker seconds blocked on plan builds (the
+                # pack thread overlaps staging with device compute; ≈ 0
+                # steady-state — the ISSUE-8 served-path acceptance).
+                "ingest_wait_s": round(service.ingest_wait_s, 4),
+                "ingest_wait_frac": round(
+                    service.ingest_wait_s / max(wall, 1e-9), 4
+                ),
                 # Goodput-under-objective: the resilience headline the
                 # overload act exists for (refused requests count
                 # against — raw p99 alone cannot see them).
@@ -2254,6 +2279,26 @@ def bench_e2e(markets=NUM_MARKETS, mean_slots=4, steps=20,
 
     gc.freeze()
     try:
+        # Warm the packers off the clock: the first call pays C extension
+        # import/registration (and numpy's ufunc setup), which is not a
+        # steady-state ingest cost — the same honesty rule the autotune
+        # guard follows. Both the object and columnar paths are warmed so
+        # neither timed region below reports a cold-module artefact.
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan_columnar,
+        )
+
+        warm_payloads = payloads[: min(64, markets)]
+        build_settlement_plan(TensorReliabilityStore(), warm_payloads)
+        warm_signals = int(counts[: len(warm_payloads)].sum())
+        build_settlement_plan_columnar(
+            TensorReliabilityStore(),
+            [market_id for market_id, _ in warm_payloads],
+            [f"src-{s}" for s in src[:warm_signals].tolist()],
+            prob[:warm_signals],
+            offsets[: len(warm_payloads) + 1].astype(np.int64),
+        )
+
         # Host-CPU legs run min-of-2: this box carries unrelated load whose
         # bursts can inflate a pure-host pass several-fold (device legs are
         # unaffected — they wait on the chip, not the host).
@@ -2357,6 +2402,130 @@ def bench_e2e(markets=NUM_MARKETS, mean_slots=4, steps=20,
         gc.unfreeze()
 
 
+def bench_e2e_ingest(markets=NUM_MARKETS, mean_slots=4, trials=3):
+    """Packer A/B/C at headline scale — the ingest-floor adjudication.
+
+    One full columnar plan build (grouping + duplicate averaging + pair
+    interning + dense block fill) of ~``markets × mean_slots`` signals
+    onto a FRESH store, three ways:
+
+    * ``python`` — every native fast path forced down to its pure-Python
+      twin (``BCE_NO_NATIVE`` store + ``native=False`` builders): the
+      floor the C paths are measured against, and the CI-lane twin that
+      must stay correct.
+    * ``native_columnar`` — string source-id columns through the C
+      grouping pass (``fastpack.group_columns``) + C interning.
+    * ``zero_copy`` — the :class:`~.core.batch.SourceCodes` coded intake
+      (codes tabled OFF the clock): no per-signal Python object exists
+      anywhere on the timed path.
+
+    Min-of-N alternating trials with per-repeat loadavg to the run
+    ledger (BASELINE.md protocol); the durable headline is
+    ``signals_per_sec`` (native_columnar, min wall). The ISSUE-8
+    acceptance bar — < 1 s per 4M signals — is ``sub_second_4m``:
+    min wall scaled to 4M signals, band quoted alongside.
+    """
+    import gc
+
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.core.batch import encode_source_ids
+    from bayesian_consensus_engine_tpu.pipeline import (
+        build_settlement_plan_columnar,
+    )
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    rng = np.random.default_rng(23)
+    counts = rng.poisson(mean_slots - 1, markets) + 1
+    signals = int(counts.sum())
+    keys = [f"market-{m}" for m in range(markets)]
+    sids = [f"src-{v}" for v in rng.integers(0, SOURCE_UNIVERSE, signals)]
+    probs = rng.random(signals)
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    # The zero-copy caller's tabled encoding happens once, off the clock
+    # (ids in a steady feed repeat; re-encoding only new ids is the
+    # caller's amortisation).
+    coded = encode_source_ids(sids)
+
+    # Warm every packer variant off the clock: the first call pays C
+    # extension import/registration, which is not a steady-state ingest
+    # cost (the honesty rule the autotune guard follows).
+    warm_markets = min(64, markets)
+    warm_signals = int(counts[:warm_markets].sum())
+    for native in (None, False):
+        build_settlement_plan_columnar(
+            TensorReliabilityStore(), keys[:warm_markets],
+            sids[:warm_signals], probs[:warm_signals],
+            offsets[: warm_markets + 1], native=native,
+        )
+
+    gc.freeze()
+    try:
+        def run(name):
+            # Save/RESTORE the forced-fallback knob (never pop): a run
+            # launched under BCE_NO_NATIVE=1 must keep its setting for
+            # the rest of the process, or later auto-detected paths
+            # would go half-native behind the operator's back.
+            prior_no_native = os.environ.get("BCE_NO_NATIVE")
+            if name == "python":
+                os.environ["BCE_NO_NATIVE"] = "1"
+            try:
+                # Store constructed INSIDE the env guard: the python
+                # variant's store interns through the dict-backed
+                # IdInterner, so the whole pure-Python ingest stack is
+                # what the clock sees.
+                store = TensorReliabilityStore()
+                source_column = coded if name == "zero_copy" else sids
+                native = False if name == "python" else None
+                start = time.perf_counter()
+                build_settlement_plan_columnar(
+                    store, keys, source_column, probs, offsets,
+                    native=native,
+                )
+                wall = time.perf_counter() - start
+            finally:
+                if name == "python":
+                    if prior_no_native is None:
+                        os.environ.pop("BCE_NO_NATIVE", None)
+                    else:
+                        os.environ["BCE_NO_NATIVE"] = prior_no_native
+            return {
+                "wall_s": round(wall, 4),
+                "signals_per_sec": round(signals / wall, 1),
+            }
+
+        best = _min_of_trials(
+            "e2e_ingest", ["python", "native_columnar", "zero_copy"],
+            run, trials,
+        )
+    finally:
+        gc.unfreeze()
+    native_best = best["native_columnar"]
+    scale_4m = 4_000_000 / max(signals, 1)
+    return {
+        "workload": (
+            f"{markets} markets x ~{mean_slots} signals ({signals} signals, "
+            f"{SOURCE_UNIVERSE}-source universe), full columnar plan build "
+            f"onto a fresh store, min of {trials} alternating trials"
+        ),
+        "signals": signals,
+        "python": best["python"],
+        "native_columnar": native_best,
+        "zero_copy": best["zero_copy"],
+        "signals_per_sec": native_best["signals_per_sec"],
+        "native_speedup": round(
+            best["python"]["wall_s"] / max(native_best["wall_s"], 1e-9), 2
+        ),
+        "wall_s_per_4m_signals": round(native_best["wall_s"] * scale_4m, 3),
+        "wall_s_per_4m_band": [
+            round(b * scale_4m, 3) for b in native_best["wall_s_band"]
+        ],
+        "sub_second_4m": bool(native_best["wall_s"] * scale_4m < 1.0),
+    }
+
+
 def bench_dryrun_multichip(n_devices=8, markets=LARGE_K_MARKETS,
                            slots=LARGE_K_SLOTS, steps=3):
     """Scaled virtual-mesh execution (VERDICT r5 #3): the sharded
@@ -2428,6 +2597,9 @@ LEGS = {
     ),
     "e2e_pipeline": (
         bench_e2e, {}, dict(markets=2000, resettle_markets=200), 1500,
+    ),
+    "e2e_ingest": (
+        bench_e2e_ingest, {}, dict(markets=20_000, trials=2), 900,
     ),
     "e2e_overlap": (
         bench_e2e_overlap, {}, dict(markets=2000, steps=3, trials=2), 900,
@@ -2502,6 +2674,7 @@ DEVICE_LEG_ORDER = [
     "north_star_f32",
     "large_k",
     "e2e_pipeline",
+    "e2e_ingest",
     "e2e_overlap",
     "e2e_stream",
     "e2e_stream_stable_topology",
@@ -2814,6 +2987,7 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         "large_k": _show(results, "large_k"),
         "pallas_ab": _show(results, "pallas_ab"),
         "e2e_pipeline": _show(results, "e2e_pipeline"),
+        "e2e_ingest": _show(results, "e2e_ingest"),
         "e2e_overlap": _show(results, "e2e_overlap"),
         "e2e_stream": _show(results, "e2e_stream"),
         "e2e_stream_stable_topology": _show(

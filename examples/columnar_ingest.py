@@ -1,0 +1,164 @@
+"""Columnar ingest end to end: payloads → columns → served stream.
+
+The round-10 ingest story in three acts:
+
+* **Act 1 — the packer A/B/C**: the same batch of dict payloads is
+  flattened to flat columns (one C pass) and planned three ways — the
+  numpy grouping twin (``native=False``; the FULL pure-Python stack,
+  interner included, is the ``BCE_NO_NATIVE=1`` lane the ``e2e_ingest``
+  bench leg times), the native columnar grouping
+  (``fastpack.group_columns``), and the zero-copy coded intake
+  (``SourceCodes``: int32 codes + id table, no per-signal Python object
+  on the timed path). All three produce byte-identical plans and
+  topology fingerprints; the native paths are several times faster.
+* **Act 2 — streamed, overlapped**: ``settle_stream`` over columnar
+  batches — plan N+1 packs on the prefetch thread while plan N settles,
+  so the per-batch ``plan_wait_s`` collapses to ~microseconds after the
+  pipeline fills (the ``stream.ingest_wait_s`` gauge).
+* **Act 3 — served, overlapped**: the same discipline in the online
+  front end — ``ConsensusService`` stages each micro-batch's plan on a
+  pack thread while the previous batch holds the device (interning
+  stays on the dispatch worker, so durability bytes stay deterministic)
+  — ``service.ingest_wait_s`` ends ≈ 0 relative to wall.
+
+Run from the repo root:  python examples/columnar_ingest.py
+"""
+
+import asyncio
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu import obs
+from bayesian_consensus_engine_tpu.core.batch import (
+    columns_from_payloads,
+    encode_source_ids,
+    topology_fingerprint,
+)
+from bayesian_consensus_engine_tpu.pipeline import (
+    build_settlement_plan_columnar,
+    settle_stream,
+)
+from bayesian_consensus_engine_tpu.serve import ConsensusService
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 21_900.0  # fixed settlement day: reproducible demo output
+rng = np.random.default_rng(5)
+
+
+def make_payloads(markets, mean_signals=4, tag="m"):
+    counts = rng.poisson(mean_signals - 1, markets) + 1
+    return [
+        (
+            f"{tag}-{m}",
+            [
+                {
+                    "sourceId": f"src-{rng.integers(0, 500)}",
+                    "probability": float(rng.random()),
+                }
+                for _ in range(counts[m])
+            ],
+        )
+        for m in range(markets)
+    ]
+
+
+# --- Act 1: one batch, three intakes, identical bytes -----------------------
+
+payloads = make_payloads(40_000)
+keys, sids, probs, offsets = columns_from_payloads(payloads)  # one C pass
+coded = encode_source_ids(sids)  # the zero-copy caller pays this ONCE
+
+print(f"act 1 — {len(sids)} signals over {len(keys)} markets")
+plans = {}
+for name, source_column, native in (
+    ("python-twin", sids, False),
+    ("native-columnar", sids, None),
+    ("zero-copy", coded, None),
+):
+    store = TensorReliabilityStore()
+    start = time.perf_counter()
+    plans[name] = build_settlement_plan_columnar(
+        store, keys, source_column, probs, offsets,
+        fingerprint=True, native=native,
+    )
+    wall = time.perf_counter() - start
+    print(f"  {name:>16}: {wall * 1e3:7.1f} ms "
+          f"({len(sids) / wall / 1e6:.2f}M signals/s)")
+
+reference = plans["python-twin"]
+for name, plan in plans.items():
+    assert plan.fingerprint == reference.fingerprint
+    assert plan.probs.tobytes() == reference.probs.tobytes()
+    assert plan.slot_rows.tobytes() == reference.slot_rows.tobytes()
+print("  all three plans byte-identical, fingerprints equal:",
+      reference.fingerprint.hex())
+assert topology_fingerprint(keys, coded, offsets) == reference.fingerprint
+
+# --- Act 2: streamed with the prefetch thread doing the packing -------------
+
+batches = []
+for _ in range(5):
+    # Same topology every batch (the steady-state service shape): the
+    # prefetcher's fingerprint hit re-plans only the probabilities.
+    batch_probs = rng.random(len(sids))
+    outcomes = (rng.random(len(keys)) < 0.5).tolist()
+    batches.append(((keys, sids, batch_probs, offsets), outcomes))
+
+registry = obs.MetricsRegistry()
+previous = obs.set_metrics_registry(registry)
+stats = []
+store = TensorReliabilityStore()
+for _result in settle_stream(
+    store, batches, steps=3, now=NOW, columnar=True, stats=stats,
+    reuse_plans=True,
+):
+    pass
+store.sync()
+waits = [round(s["plan_wait_s"] * 1e3, 3) for s in stats]
+print("\nact 2 — per-batch plan_wait_s (ms):", waits)
+print(f"  batch 0 fills the pipeline; steady state waits "
+      f"{max(waits[1:]):.3f} ms or less "
+      f"(stream.ingest_wait_s gauge: "
+      f"{registry.gauge('stream.ingest_wait_s').value * 1e3:.2f} ms total)")
+
+# --- Act 3: served, with the pack thread ahead of the device ----------------
+
+
+async def serve():
+    service = ConsensusService(
+        TensorReliabilityStore(), steps=3, now=NOW,
+        max_batch=64, max_delay_s=0.002,
+    )
+    markets = [f"live-{m}" for m in range(64)]
+    sources = {m: [f"src-{rng.integers(0, 200)}" for _ in range(3)]
+               for m in markets}
+    async with service:
+        start = time.perf_counter()
+        for _round in range(6):
+            futures = [
+                service.submit(
+                    m,
+                    [(sid, float(rng.random())) for sid in sources[m]],
+                    bool(rng.random() < 0.5),
+                )
+                for m in markets
+            ]
+            await asyncio.gather(*futures)
+        wall = time.perf_counter() - start
+        print(f"\nact 3 — served {6 * len(markets)} requests in "
+              f"{wall:.2f} s; dispatch-worker ingest wait "
+              f"{service.ingest_wait_s * 1e3:.2f} ms "
+              f"({service.ingest_wait_s / wall:.2%} of wall)")
+
+
+asyncio.run(serve())
+obs.set_metrics_registry(previous)
+print("\ningest is no longer the wall: packing rides the pack/prefetch "
+      "threads, the chip never waits for it in the steady state")

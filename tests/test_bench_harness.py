@@ -505,6 +505,41 @@ class TestDeltaDurabilityLeg:
         assert "e2e_stream_delta" in bench.DEVICE_LEG_ORDER
 
 
+class TestIngestLeg:
+    """The ISSUE-8 packer A/B/C (``e2e_ingest``) at --fast shapes:
+    pure-Python twin stack vs native columnar grouping vs the zero-copy
+    coded intake, each a full plan build onto a fresh store. Packer
+    byte-parity is pinned by tests/test_fastpack.py; this pins the LEG
+    contract (JSON shape, per-variant min-of-N bands, the
+    ``signals_per_sec`` headline, and the 4M-signal scaling fields the
+    acceptance bar quotes)."""
+
+    def test_fast_leg_reports_packer_abc(self):
+        result = bench.run_leg_inprocess("e2e_ingest", fast=True)
+        for side in ("python", "native_columnar", "zero_copy"):
+            for key in ("wall_s", "signals_per_sec", "wall_s_band",
+                        "repeats"):
+                assert key in result[side], (side, key)
+            lo, hi = result[side]["wall_s_band"]
+            assert lo <= hi
+            assert result[side]["wall_s"] == lo
+        assert result["signals"] > 0
+        assert (
+            result["signals_per_sec"]
+            == result["native_columnar"]["signals_per_sec"]
+        )
+        assert result["native_speedup"] > 0
+        assert (
+            result["wall_s_per_4m_band"][0] == result["wall_s_per_4m_signals"]
+        )
+        assert isinstance(result["sub_second_4m"], bool)
+        json.dumps(result)
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_ingest" in bench.LEGS
+        assert "e2e_ingest" in bench.DEVICE_LEG_ORDER
+
+
 class TestOverlapAdjudication:
     """The re-adjudicated e2e_overlap leg (VERDICT r5 #2): min-of-N
     alternating repeats, per-repeat load, a band, and a documented

@@ -1,16 +1,43 @@
-"""Native ingest packer ≡ pure-Python packer, plus build tooling."""
+"""Native ingest packer ≡ pure-Python packer, plus build tooling.
 
+Round 10 grows this into the PACKER-PARITY MATRIX: the object packer,
+the native columnar grouping pass, its numpy twin, and the zero-copy
+coded intake are all driven over the same edge-case workloads and must
+produce byte-identical plans and fingerprints — plus a forced-fallback
+subprocess lane (``BCE_NO_NATIVE=1``) proving the pure-Python twin stack
+(packers AND interner) still matches the native build bit-for-bit, so
+the twins can never rot unexercised.
+"""
+
+import hashlib
+import json
+import os
 import random
+import subprocess
+import sys
+import textwrap
 
 import numpy as np
 import pytest
 
 from bayesian_consensus_engine_tpu.core import batch as batch_mod
-from bayesian_consensus_engine_tpu.core.batch import mapping_lookup, pack_markets
+from bayesian_consensus_engine_tpu.core.batch import (
+    SourceCodes,
+    columns_from_payloads,
+    encode_source_ids,
+    mapping_lookup,
+    pack_markets,
+    topology_fingerprint,
+)
 
 needs_native = pytest.mark.skipif(
     batch_mod._fastpack is None,
     reason="native fastpack not built (python native/build.py)",
+)
+
+needs_columnar_native = pytest.mark.skipif(
+    not batch_mod._columnar_native_available(),
+    reason="columnar fastpack not built (python native/build.py)",
 )
 
 
@@ -99,6 +126,372 @@ class TestNativePythonEquivalence:
         # noise — this catches the native path becoming pathologically slow,
         # not small perf drift).
         assert native_dt < python_dt * 2.0, (native_dt, python_dt)
+
+
+# ---------------------------------------------------------------------------
+# Packer-parity matrix: every intake, same bytes.
+# ---------------------------------------------------------------------------
+
+def _edge_payloads(name):
+    """Edge-case workloads the matrix runs every intake over."""
+    if name == "dup_signals":
+        # Duplicate sources within one market: averaging order is the
+        # float contract (left-to-right per pair).
+        return [
+            ("m0", [
+                {"sourceId": "a", "probability": 0.1},
+                {"sourceId": "b", "probability": 0.9},
+                {"sourceId": "a", "probability": 0.3},
+                {"sourceId": "a", "probability": 0.70000001},
+            ]),
+            ("m1", [{"sourceId": "b", "probability": 0.5}]),
+        ]
+    if name == "empty_market":
+        # A zero-signal market between live ones: offsets carry an
+        # equal consecutive pair; slot height comes from its neighbours.
+        return [
+            ("m0", [{"sourceId": "x", "probability": 0.25}]),
+            ("empty", []),
+            ("m2", [
+                {"sourceId": "y", "probability": 0.75},
+                {"sourceId": "x", "probability": 0.5},
+            ]),
+        ]
+    if name == "extreme_probs":
+        # 0/1 probabilities: the consensus edge values must survive the
+        # accumulate bit-for-bit.
+        return [
+            ("m0", [
+                {"sourceId": "s0", "probability": 0.0},
+                {"sourceId": "s1", "probability": 1.0},
+                {"sourceId": "s0", "probability": 1.0},
+                {"sourceId": "s2", "probability": 0.0},
+            ]),
+        ]
+    assert name == "random"
+    rng = random.Random(11)
+    return [
+        (
+            f"market-{m}",
+            [
+                {
+                    "sourceId": f"src-{rng.randint(0, 30)}",
+                    "probability": rng.random(),
+                }
+                for _ in range(rng.randint(0, 9))
+            ],
+        )
+        for m in range(40)
+    ]
+
+
+def _plan_signature(plan):
+    """Everything observable about a plan, as comparable bytes."""
+    return (
+        tuple(plan.market_keys),
+        plan.slot_rows.tobytes(),
+        plan.probs.tobytes(),
+        plan.mask.tobytes(),
+        plan.signals_per_market.tobytes(),
+        plan.binding,
+        plan.fingerprint,
+    )
+
+
+def _build_by_intake(intake, payloads):
+    from bayesian_consensus_engine_tpu.pipeline import (
+        build_settlement_plan,
+        build_settlement_plan_columnar,
+    )
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    store = TensorReliabilityStore()
+    if intake == "object":
+        return build_settlement_plan(store, payloads, fingerprint=True)
+    keys, sids, probs, offsets = columns_from_payloads(
+        payloads, native=False
+    )
+    if intake == "zero_copy":
+        sids = encode_source_ids(sids)
+    native = {"columnar_native": True, "zero_copy": None,
+              "columnar_python": False}[intake]
+    return build_settlement_plan_columnar(
+        store, keys, sids, probs, offsets, fingerprint=True, native=native
+    )
+
+
+INTAKES = ("object", "columnar_native", "columnar_python", "zero_copy")
+EDGES = ("random", "dup_signals", "empty_market", "extreme_probs")
+
+
+@needs_columnar_native
+class TestPackerParityMatrix:
+    """Every intake × every edge workload → byte-identical plans."""
+
+    @pytest.mark.parametrize("edge", EDGES)
+    @pytest.mark.parametrize("intake", INTAKES[1:])
+    def test_intake_matches_object_path(self, edge, intake):
+        payloads = _edge_payloads(edge)
+        reference = _plan_signature(_build_by_intake("object", payloads))
+        assert _plan_signature(_build_by_intake(intake, payloads)) == reference
+
+    def test_reorder_misses_fingerprint_on_every_intake(self):
+        payloads = _edge_payloads("dup_signals")
+        keys, sids, probs, offsets = columns_from_payloads(
+            payloads, native=False
+        )
+        base_string = topology_fingerprint(keys, sids, offsets)
+        base_coded = topology_fingerprint(
+            keys, encode_source_ids(sids), offsets
+        )
+        assert base_string == base_coded
+        # Swap two same-market signals with DISTINCT ids: source order
+        # within a market is a float-summation contract, so the digest
+        # MUST move (a reordered batch may never be served by a
+        # probability-only refresh).
+        swapped = list(sids)
+        assert swapped[0] != swapped[1]
+        swapped[0], swapped[1] = swapped[1], swapped[0]
+        assert topology_fingerprint(keys, swapped, offsets) != base_string
+        assert (
+            topology_fingerprint(keys, encode_source_ids(swapped), offsets)
+            != base_coded
+        )
+
+    def test_zero_copy_codes_need_not_be_first_seen(self):
+        # Any consistent (codes, table) encoding is legal — only the
+        # decoded column matters. Reverse the table, remap the codes.
+        payloads = _edge_payloads("random")
+        keys, sids, probs, offsets = columns_from_payloads(
+            payloads, native=False
+        )
+        canonical = encode_source_ids(sids)
+        table = list(reversed(canonical.table))
+        remap = {sid: i for i, sid in enumerate(table)}
+        scrambled = SourceCodes(
+            np.asarray([remap[s] for s in sids], np.int32), table
+        )
+        assert (
+            topology_fingerprint(keys, scrambled, offsets)
+            == topology_fingerprint(keys, sids, offsets)
+        )
+        ref = _plan_signature(_build_by_intake("object", payloads))
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan_columnar,
+        )
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        plan = build_settlement_plan_columnar(
+            TensorReliabilityStore(), keys, scrambled, probs, offsets,
+            fingerprint=True,
+        )
+        assert _plan_signature(plan) == ref
+
+    def test_source_codes_validation(self):
+        with pytest.raises(ValueError, match="unique"):
+            SourceCodes(np.asarray([0, 1], np.int32), ["a", "a"])
+        with pytest.raises(ValueError, match="empty table"):
+            SourceCodes(np.asarray([0], np.int32), [])
+        # Out-of-range codes are rejected AT CONSTRUCTION: a negative
+        # code would wrap through Python/numpy negative indexing into a
+        # silently aliased fingerprint (a wrong-topology reuse hit).
+        with pytest.raises(ValueError, match="out of table range"):
+            SourceCodes(np.asarray([5], np.int32), ["a"])
+        with pytest.raises(ValueError, match="out of table range"):
+            SourceCodes(np.asarray([-1], np.int32), ["a", "b"])
+        from bayesian_consensus_engine_tpu.pipeline import (
+            stage_settlement_plan_columnar,
+        )
+
+        # The builder re-checks (codes are mutable numpy state): a
+        # post-construction mutation cannot sneak past the stage.
+        bad = SourceCodes(np.asarray([0], np.int32), ["a"])
+        bad.codes[0] = 5
+        with pytest.raises(ValueError, match="out of table range"):
+            stage_settlement_plan_columnar(
+                ["m"], bad, np.asarray([0.5]), np.asarray([0, 1], np.int64)
+            )
+
+    def test_group_columns_rejects_short_offsets(self):
+        # A terminal offset short of the signal count must error in BOTH
+        # twins (the C pass would otherwise drop the tail and return
+        # uninitialized signal->pair entries).
+        from bayesian_consensus_engine_tpu.core.batch import group_columns
+
+        codes = np.asarray([0, 1, 0], np.int32)
+        rank = np.asarray([0, 1], np.int32)
+        offsets = np.asarray([0, 2], np.int64)  # covers 2 of 3 signals
+        probs = np.asarray([0.1, 0.2, 0.3])
+        with pytest.raises(ValueError):
+            group_columns(codes, rank, offsets, probs, native=True)
+        with pytest.raises(ValueError):
+            group_columns(codes, rank, offsets, probs, native=False)
+
+    def test_twins_reject_negative_indices_alike(self):
+        # Negative codes/pair indices: numpy would silently WRAP them
+        # (negative indexing) where C raises — both twins must error.
+        from bayesian_consensus_engine_tpu.core.batch import (
+            group_columns,
+            pair_accumulate,
+        )
+
+        codes = np.asarray([-1], np.int32)
+        rank = np.asarray([0, 1], np.int32)
+        offsets = np.asarray([0, 1], np.int64)
+        probs = np.asarray([0.5])
+        for native in (True, False):
+            with pytest.raises(IndexError):
+                group_columns(codes, rank, offsets, probs, native=native)
+            with pytest.raises(IndexError):
+                pair_accumulate(
+                    np.asarray([-1], np.int64), probs, 2, native=native
+                )
+
+    def test_no_native_env_flips_auto_detection(self, monkeypatch):
+        assert batch_mod._columnar_native_available()
+        assert batch_mod._object_native_available()
+        monkeypatch.setenv("BCE_NO_NATIVE", "1")
+        # A RUNTIME env change flips the whole auto-detected stack (no
+        # half-native hybrid): fastpack auto-detection and the interner
+        # consult the same knob per call.
+        assert not batch_mod._columnar_native_available()
+        assert not batch_mod._object_native_available()
+        from bayesian_consensus_engine_tpu.utils.interning import (
+            _load_internmap,
+        )
+
+        assert _load_internmap() is None
+
+    def test_stage_then_bind_equals_one_shot_build(self):
+        from bayesian_consensus_engine_tpu.pipeline import (
+            build_settlement_plan_columnar,
+            stage_settlement_plan_columnar,
+        )
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        payloads = _edge_payloads("random")
+        keys, sids, probs, offsets = columns_from_payloads(
+            payloads, native=False
+        )
+        one_shot = build_settlement_plan_columnar(
+            TensorReliabilityStore(), keys, sids, probs, offsets,
+            fingerprint=True,
+        )
+        staged = stage_settlement_plan_columnar(
+            keys, sids, probs, offsets, fingerprint=True
+        )
+        plan = staged.bind(TensorReliabilityStore())
+        assert _plan_signature(plan) == _plan_signature(one_shot)
+
+    def test_columns_from_payloads_native_matches_python(self):
+        for edge in EDGES:
+            payloads = _edge_payloads(edge)
+            k0, s0, p0, o0 = columns_from_payloads(payloads, native=False)
+            k1, s1, p1, o1 = columns_from_payloads(payloads, native=True)
+            assert k1 == k0 and s1 == s0
+            np.testing.assert_array_equal(p1, p0)
+            np.testing.assert_array_equal(o1, o0)
+
+
+# ---------------------------------------------------------------------------
+# Forced-fallback lane: BCE_NO_NATIVE=1 ≡ native build, bit for bit.
+# ---------------------------------------------------------------------------
+
+_FALLBACK_SCRIPT = textwrap.dedent(
+    """
+    import hashlib, json, sys
+
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.core import batch as batch_mod
+    from bayesian_consensus_engine_tpu.utils.interning import _load_internmap
+
+    # The knob must actually have forced every native path off.
+    assert batch_mod._fastpack is None, "fastpack not gated"
+    assert _load_internmap() is None, "internmap not gated"
+
+    from bayesian_consensus_engine_tpu.pipeline import (
+        build_settlement_plan,
+        build_settlement_plan_columnar,
+    )
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    payloads = [tuple(p) for p in json.load(open(sys.argv[1]))]
+    keys = [m for m, _ in payloads]
+    sids, probs, offsets = [], [], [0]
+    for _m, signals in payloads:
+        for s in signals:
+            sids.append(s["sourceId"])
+            probs.append(s["probability"])
+        offsets.append(len(sids))
+    probs = np.asarray(probs, np.float64)
+    offsets = np.asarray(offsets, np.int64)
+
+    for plan in (
+        build_settlement_plan(
+            TensorReliabilityStore(), payloads, fingerprint=True
+        ),
+        build_settlement_plan_columnar(
+            TensorReliabilityStore(), keys, sids, probs, offsets,
+            fingerprint=True,
+        ),
+    ):
+        digest = hashlib.blake2b(digest_size=16)
+        digest.update(plan.slot_rows.tobytes())
+        digest.update(plan.probs.tobytes())
+        digest.update(plan.mask.tobytes())
+        digest.update(repr(plan.binding).encode())
+        digest.update(plan.fingerprint)
+        print(digest.hexdigest())
+    """
+)
+
+
+class TestForcedFallbackLane:
+    """``BCE_NO_NATIVE=1`` — the CI lane that keeps the twins honest."""
+
+    def test_pure_python_stack_matches_this_process(self, tmp_path):
+        payloads = _edge_payloads("random") + _edge_payloads("dup_signals")
+        keys = [f"{i}:{m}" for i, (m, _s) in enumerate(payloads)]
+        payloads = [
+            (key, signals) for key, (_m, signals) in zip(keys, payloads)
+        ]
+        payload_file = tmp_path / "payloads.json"
+        payload_file.write_text(json.dumps(payloads))
+
+        env = dict(os.environ)
+        env["BCE_NO_NATIVE"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-c", _FALLBACK_SCRIPT, str(payload_file)],
+            capture_output=True, text=True, env=env, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lane_digests = proc.stdout.split()
+        assert len(lane_digests) == 2
+
+        # The same builds in THIS (native-enabled) process must match.
+        expected = []
+        for plan in (
+            _build_by_intake("object", payloads),
+            _build_by_intake("columnar_python", payloads),
+        ):
+            digest = hashlib.blake2b(digest_size=16)
+            digest.update(plan.slot_rows.tobytes())
+            digest.update(plan.probs.tobytes())
+            digest.update(plan.mask.tobytes())
+            digest.update(repr(plan.binding).encode())
+            digest.update(plan.fingerprint)
+            expected.append(digest.hexdigest())
+        assert lane_digests == expected
 
 
 class TestFallback:
