@@ -220,7 +220,11 @@ def _run_stats(args: argparse.Namespace) -> None:
     load that attributes the spread. Legs whose records carry per-request
     latency distributions (``extras.latency_hist`` — the serving bench)
     additionally render ``p50``/``p99`` columns, merged across repeats
-    through the shared log-bucket quantile rule. ``--json`` emits the
+    through the shared log-bucket quantile rule; legs that sample the
+    device allocator's high-water mark into ``extras.hbm_peak_bytes``
+    (the ring-memory leg, on devices exposing allocator stats) render
+    the ``peak_mem`` column (min across repeats), so a memory regression
+    shows up in the same table as a wall-time one. ``--json`` emits the
     machine-shaped summary instead of the table.
 
     ``--against OLD.jsonl`` switches to cross-round diffing: each leg's

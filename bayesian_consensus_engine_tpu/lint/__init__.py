@@ -43,6 +43,7 @@ from bayesian_consensus_engine_tpu.lint import (  # noqa: F401
     rules_determinism,
     rules_jax,
     rules_layering,
+    rules_pallas,
     rules_pyflakes,
     rules_sharding,
 )

@@ -1,0 +1,219 @@
+"""PL5xx — Pallas kernel-launch rules for the ``ops/`` kernel tier.
+
+A ``pl.pallas_call`` whose grid × block tiles do not cover the operands
+exactly silently drops the ragged tail from the computation — no crash,
+just wrong sums on the last partial tile — and a block set that outgrows
+the ~16 MB scoped-VMEM budget fails only at TPU compile time, long after
+the CPU interpret-mode tests passed. Both contracts are checkable where
+they are decidable statically:
+
+* **Coverage**: a grid built with floor division (``m // tile``) MUST be
+  paired with a divisibility guard on the same pair (``m % tile`` feeding
+  a raise/assert) in the same function — the guard is what turns "tiles
+  probably cover" into "a ragged shape cannot reach the kernel".
+  ``ops/pallas_cycle.py``'s builder is the reference shape.
+* **VMEM budget**: when every block dimension in the call's BlockSpecs
+  resolves to a literal int (directly or through a module-level
+  constant), the summed f32 block footprint — double-buffered, the
+  pipelined launch's working set — must stay under the 16 MB scoped-VMEM
+  budget. Symbolic shapes are skipped: the runtime guard and the
+  autotuner's measured ineligibility (a candidate tile whose compile
+  raises) own the dynamic case.
+
+Local names are resolved through simple same-function assignments
+(``grid = (m // tile,)``; ``block = pl.BlockSpec(...)``), matching the
+repo's builder idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+from functools import partial
+
+from bayesian_consensus_engine_tpu.lint import config
+from bayesian_consensus_engine_tpu.lint.registry import rule
+
+_kernel = partial(config.matches, prefixes=(f"{config.PACKAGE}/ops/",))
+
+#: The TPU scoped-VMEM budget the recorded tile sweeps ran against
+#: (docs/tpu-architecture.md; tiles ≥4096 at K=16 blew it).
+_VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+_F32_BYTES = 4
+#: Pipelined pallas_call double-buffers every block (fetch N+1 while
+#: computing N).
+_DOUBLE_BUFFER = 2
+
+
+def _is_pallas_call(ctx, node: ast.AST) -> bool:
+    dotted = ctx.dotted(node)
+    return dotted is not None and dotted.endswith(".pallas_call")
+
+
+def _is_block_spec(ctx, node: ast.AST) -> bool:
+    dotted = ctx.dotted(node)
+    return dotted is not None and dotted.endswith(".BlockSpec")
+
+
+def _local_assignments(fn: ast.AST) -> dict:
+    """name → last simple ``name = expr`` assignment in *fn*'s body."""
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                out[target.id] = node.value
+    return out
+
+
+def _module_int_constants(tree: ast.AST) -> dict:
+    """Module-level ``NAME = <int literal>`` bindings (one level deep)."""
+    out: dict[str, int] = {}
+    for node in getattr(tree, "body", []):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+            if (
+                isinstance(target, ast.Name)
+                and isinstance(value, ast.Constant)
+                and isinstance(value.value, int)
+                and not isinstance(value.value, bool)
+            ):
+                out[target.id] = value.value
+    return out
+
+
+def _floordiv_pairs(expr: ast.AST):
+    """(numerator, denominator) Name ids of every ``a // b`` in *expr*."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.FloorDiv):
+            if isinstance(node.left, ast.Name) and isinstance(
+                node.right, ast.Name
+            ):
+                yield node.left.id, node.right.id
+
+
+def _has_mod_guard(fn: ast.AST, num: str, den: str) -> bool:
+    """Does *fn* compute ``num % den`` anywhere (the divisibility guard)?
+
+    Presence is the check — the repo idiom feeds it to an ``if …: raise``
+    or an assert, and any use at all means the ragged case was considered
+    rather than silently floor-divided away.
+    """
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.BinOp)
+            and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.Name)
+            and node.left.id == num
+            and isinstance(node.right, ast.Name)
+            and node.right.id == den
+        ):
+            return True
+    return False
+
+
+def _resolve_dim(entry: ast.AST, module_consts: dict):
+    """A block dimension as an int when statically decidable, else None."""
+    if isinstance(entry, ast.Constant) and isinstance(entry.value, int):
+        return entry.value
+    if isinstance(entry, ast.Name):
+        return module_consts.get(entry.id)
+    return None
+
+
+def _block_shapes(ctx, call: ast.Call, local, module_consts):
+    """Every BlockSpec block-shape tuple reachable from *call*'s specs.
+
+    Yields ``(lineno, [dim-or-None, ...])`` per spec that carries a
+    positional block shape; memory-space-only specs (scalars) are skipped.
+    """
+    specs: list[ast.AST] = []
+    for kw in call.keywords:
+        if kw.arg in ("in_specs", "out_specs"):
+            value = kw.value
+            if isinstance(value, ast.Name):
+                value = local.get(value.id, value)
+            if isinstance(value, (ast.List, ast.Tuple)):
+                specs.extend(value.elts)
+            else:
+                specs.append(value)
+    for spec in specs:
+        if isinstance(spec, ast.Name):
+            spec = local.get(spec.id, spec)
+        if not (
+            isinstance(spec, ast.Call) and _is_block_spec(ctx, spec.func)
+        ):
+            continue
+        if not spec.args or not isinstance(spec.args[0], ast.Tuple):
+            continue  # memory-space-only spec (SMEM scalar) or dynamic
+        dims = [
+            _resolve_dim(d, module_consts) for d in spec.args[0].elts
+        ]
+        yield spec.lineno, dims
+
+
+@rule(
+    "PL501",
+    name="pallas-grid-shape",
+    rationale=(
+        "a pallas_call grid that floor-divides away a ragged tail "
+        "silently drops the tail tile from the computation, and a "
+        "literal block set past the 16 MB scoped-VMEM budget fails only "
+        "at TPU compile time — gridded launches must guard divisibility "
+        "and keep the double-buffered block footprint inside the budget"
+    ),
+    scope=_kernel,
+    tags=("pallas",),
+)
+def check_pallas_grid_shape(ctx):
+    module_consts = _module_int_constants(ctx.tree)
+    functions = [
+        node
+        for node in ast.walk(ctx.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in functions:
+        local = _local_assignments(fn)
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call) and _is_pallas_call(ctx, node.func)
+            ):
+                continue
+            kwargs = {kw.arg: kw.value for kw in node.keywords}
+            grid = kwargs.get("grid")
+            if grid is None:
+                yield node.lineno, (
+                    "pallas_call without grid= — whole-operand launches "
+                    "hide the tiling contract; make the grid explicit"
+                )
+            else:
+                grid_expr = grid
+                if isinstance(grid_expr, ast.Name):
+                    grid_expr = local.get(grid_expr.id, grid_expr)
+                for num, den in _floordiv_pairs(grid_expr):
+                    if not _has_mod_guard(fn, num, den):
+                        yield node.lineno, (
+                            f"grid floor-divides `{num} // {den}` with no "
+                            f"`{num} % {den}` divisibility guard in scope "
+                            "— a ragged tail tile would be silently "
+                            "dropped; guard and raise (see "
+                            "ops/pallas_cycle.py)"
+                        )
+            total = 0
+            decidable = True
+            for _lineno, dims in _block_shapes(
+                ctx, node, local, module_consts
+            ):
+                if any(d is None for d in dims):
+                    decidable = False
+                    break
+                bytes_ = _F32_BYTES
+                for d in dims:
+                    bytes_ *= d
+                total += bytes_
+            if decidable and total * _DOUBLE_BUFFER > _VMEM_BUDGET_BYTES:
+                yield node.lineno, (
+                    f"literal block set is {total * _DOUBLE_BUFFER} bytes "
+                    "double-buffered — over the 16 MB scoped-VMEM budget "
+                    "(tile the operands or shrink the block)"
+                )

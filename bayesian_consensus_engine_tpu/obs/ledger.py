@@ -198,7 +198,34 @@ def min_of_repeats(
     band.update(_latency_quantiles(records, leg))
     band.update(_slo_summary(records, leg))
     band.update(_ingest_wait_summary(records, leg))
+    band.update(_peak_mem_summary(records, leg))
     return band
+
+
+def _peak_mem_summary(
+    records: List[Dict[str, object]], leg: str
+) -> Dict[str, object]:
+    """Best-case peak device memory over a leg's records.
+
+    Records carrying ``extras["hbm_peak_bytes"]`` (the device-memory legs:
+    the allocator's peak-bytes high-water mark sampled after the timed
+    region) fold to their MINIMUM across repeats — the repeat least
+    polluted by co-resident allocations is the leg's own footprint, the
+    same min-of-N reading the wall band uses. Legs without the extra (and
+    CPU backends, which expose no allocator stats) contribute nothing, so
+    the stats table renders a dash. This is how a memory regression shows
+    up in the same ``bce-tpu stats``/``--against`` workflow as a wall-time
+    regression (ISSUE 9).
+    """
+    peaks = [
+        (rec.get("extras") or {}).get("hbm_peak_bytes")
+        for rec in records
+        if rec.get("leg") == leg
+    ]
+    peaks = [p for p in peaks if isinstance(p, (int, float)) and p > 0]
+    if not peaks:
+        return {}
+    return {"hbm_peak_bytes": min(peaks)}
 
 
 def _ingest_wait_summary(
@@ -381,7 +408,8 @@ def diff_bands(
         entry: Dict[str, object] = {"leg": leg, "status": status,
                                     "old": old_band, "new": new_band}
         metrics: Dict[str, Dict[str, object]] = {}
-        for name in ("p50", "p99", "goodput_within_slo", "ingest_wait_s"):
+        for name in ("p50", "p99", "goodput_within_slo", "ingest_wait_s",
+                     "hbm_peak_bytes"):
             old_value = (old_band or {}).get(name)
             new_value = (new_band or {}).get(name)
             if old_value is not None or new_value is not None:
@@ -416,6 +444,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
         label = {
             "goodput_within_slo": "goodput",
             "ingest_wait_s": "ingest_wait",
+            "hbm_peak_bytes": "peak_mem",
         }.get(name, name)
         return f"  {label} {num(metric['old'])}->{num(metric['new'])}"
 
@@ -430,7 +459,8 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             moved += 1
         trailer = "".join(
             metric_str(entry, name)
-            for name in ("p99", "goodput_within_slo", "ingest_wait_s")
+            for name in ("p99", "goodput_within_slo", "ingest_wait_s",
+                         "hbm_peak_bytes")
         )
         lines.append(
             f"{leg:<34} {band_str(entry['old']):>16} "
@@ -452,10 +482,12 @@ def render(records: List[Dict[str, object]]) -> str:
     per-request latency distributions (``extras.latency_hist`` — the
     serving bench), ``goodput`` for legs carrying SLO accounting
     (``extras.slo`` — the fraction of offered requests that completed
-    within the objective), and ``ingest_w`` for legs carrying consumer
+    within the objective), ``ingest_w`` for legs carrying consumer
     ingest-wait seconds (``extras.ingest_wait_s`` — the stream/serve
-    legs; ≈ 0 means packing fully overlapped behind device compute);
-    every other leg shows dashes.
+    legs; ≈ 0 means packing fully overlapped behind device compute), and
+    ``peak_mem`` for legs carrying the device allocator's high-water mark
+    (``extras.hbm_peak_bytes``, min across repeats — the memory-diet
+    regression signal); every other leg shows dashes.
     """
     summary = summarize(records)
     if not summary:
@@ -463,7 +495,7 @@ def render(records: List[Dict[str, object]]) -> str:
     lines = [
         f"{'leg':<34} {'n':>3} {'min':>12} {'max':>12} "
         f"{'spread':>7} {'p50':>9} {'p99':>9} {'goodput':>8} "
-        f"{'ingest_w':>9} {'load(1m)':>12} unit"
+        f"{'ingest_w':>9} {'peak_mem':>9} {'load(1m)':>12} unit"
     ]
     for leg, band in summary.items():
 
@@ -487,11 +519,17 @@ def render(records: List[Dict[str, object]]) -> str:
             if isinstance(goodput, (int, float))
             else "-"
         )
+        peak = band.get("hbm_peak_bytes")
+        peak_str = (
+            f"{peak / 1e6:.0f}MB"
+            if isinstance(peak, (int, float))
+            else "-"
+        )
         lines.append(
             f"{leg:<34} {band['n']:>3} {num(band['min']):>12} "
             f"{num(band['max']):>12} {spread:>7} "
             f"{num(band.get('p50')):>9} {num(band.get('p99')):>9} "
             f"{goodput_str:>8} {num(band.get('ingest_wait_s')):>9} "
-            f"{load:>12} {band['unit'] or '-'}"
+            f"{peak_str:>9} {load:>12} {band['unit'] or '-'}"
         )
     return "\n".join(lines)

@@ -183,11 +183,11 @@ def _tuned_tile(num_markets: int, num_slots: int) -> int:
             out = call(km + 0.5, km + 1.0, m1, state, 1.0)
             float(out[1].reshape(-1)[0])  # fence: force the result to host
 
-        run()  # warm (compile off the clock)
-        # Best-of-3: a single sample would be persisted forever, so one
-        # host-load spike could lock in the wrong tile for this shape.
-        # The clock lives in utils.autotune — ops/ is clock-free (DT202).
-        return time_best_of(run, repeats=3)
+        # Best-of-3 after one warmup (compile off the clock): a single
+        # sample would be persisted forever, so one host-load spike could
+        # lock in the wrong tile for this shape. The clock lives in
+        # utils.autotune — ops/ is clock-free (DT202).
+        return time_best_of(run, repeats=3, warmup=1)
 
     return default_tuner().tune(
         "pallas_tile", (num_markets, num_slots), candidates, measure,

@@ -201,3 +201,366 @@ def build_batched_tiebreak(precision: int = 6):
     """Jit-compiled :func:`batched_tiebreak` (AOT-lowerable for memory
     analysis; markets sharding propagates through the row-local ops)."""
     return jax.jit(lambda p, w, c, r, v: batched_tiebreak(p, w, c, r, v, precision))
+
+
+# ---------------------------------------------------------------------------
+# Chunked ring tie-break core (round 11): the memory-diet grouping kernel.
+#
+# The pairwise/ring path used to accumulate per-agent group stats for the
+# WHOLE local block before selecting a winner — O(agents × markets) live
+# stats plus a 4-tensor rotating stack, ~369 MB of XLA temps at the
+# 2048×10k stress shape (VERDICT r5 item 7). This core consumes the block
+# in fixed-width chunks of LOCAL agents: each chunk's group stats are
+# computed against the full visiting width (so every per-agent group sum
+# keeps the same reduction expression at every chunk size), folded into a
+# per-market top-2 carry, and discarded. Live state between chunks is a
+# handful of (markets,) vectors — per-step temps are O(chunk × markets).
+#
+# Bit-exactness across chunk sizes is by construction, not luck:
+#
+# * A group's weight sum is ONE reduction over the full visiting axis
+#   (per ring origin, origins summed in fixed 0..n-1 order), identical
+#   for every member and every chunk width — chunking slices the agents
+#   axis, never the reduction axis.
+# * The winner/runner-up fold is SELECTION-ONLY (compares and selects,
+#   no float arithmetic), and the hierarchy (density, max_reliability,
+#   smallest key) is a total order over groups — so the fold result is
+#   independent of chunk boundaries and merge order entirely.
+#
+# Lives in ops/ (layer 1) so both parallel/ring.py (the standalone
+# shard_map wrapper) and parallel/sharded.py (the fused cycle+tie-break
+# resident program) can share it without an import cycle.
+# ---------------------------------------------------------------------------
+
+#: Invalid-lane key (old ring-path sentinel): joins no group (the same-key
+#: compare is additionally masked by the visiting validity), distinct from
+#: _SENTINEL so an "empty top-2 slot" can never collide with a real lane.
+_INVALID_KEY = -(2**31)
+
+#: The recorded default chunk width for the memory-diet paths: wide enough
+#: that chunk-selection overhead vanishes, narrow enough that per-chunk
+#: temps stay tens of MB at the 2048×10k stress shape (ISSUE-9 capture).
+#: Shared by the standalone ring path (``chunk_agents="auto"``'s fallback)
+#: and the fused resident program's default.
+DEFAULT_CHUNK_AGENTS = 1024
+
+
+class RingTieBreakResult(NamedTuple):
+    """Device-side tie-break outputs, one entry per market row.
+
+    ``resolved_by`` codes: 0 unanimous, 1 weight_density,
+    2 prediction_value_smallest — matching the scalar labels
+    (models/tiebreak.py, reference: tiebreak.py:119-133, including quirk #6:
+    a decision that actually fell to max_reliability still reports
+    weight_density).
+
+    ``prediction`` is the winning quantised key rescaled in f32
+    (``key.astype(f32) / 10^precision`` — the rounding contract the
+    chunked and unchunked paths share bit-for-bit); a row with no valid
+    agent reports ``prediction = inf`` and ``-inf`` group metrics (padding
+    rows, not errors — the scalar engine raises instead).
+    """
+
+    prediction: Array           # f[M] winning (rounded) prediction
+    weight_density: Array       # f[M] winning group's density
+    max_reliability: Array      # f[M] winning group's max reliability
+    resolved_by: Array          # i32[M]
+    num_groups: Array           # i32[M]
+    confidence_variance: Array  # f[M] population variance over agents
+
+
+def _lex_ge(ad, ar, ak, bd, br, bk):
+    """(density, max_rel, smallest-key) total order: does a beat-or-tie b?
+
+    The scalar hierarchy (reference: tiebreak.py:112-117) as one boolean:
+    higher density wins, then higher max reliability, then the SMALLER
+    quantised key (quirk #5's smallest-prediction tertiary — the key is
+    monotone in the prediction). Keys are unique per group, so this is a
+    total order and every selection built on it is merge-order invariant.
+    """
+    return (ad > bd) | (
+        (ad == bd) & ((ar > br) | ((ar == br) & (ak <= bk)))
+    )
+
+
+def _sel(cond, a, b):
+    return tuple(jnp.where(cond, x, y) for x, y in zip(a, b))
+
+
+def _mask_key(entry, key):
+    """Demote *entry* to the empty sentinel where its key equals *key*."""
+    d, r, k = entry
+    hit = k == key
+    neg = jnp.float32(-jnp.inf)
+    return (
+        jnp.where(hit, neg, d),
+        jnp.where(hit, neg, r),
+        jnp.where(hit, jnp.int32(_SENTINEL), k),
+    )
+
+
+def _merge_top2(a, b):
+    """Merge two per-market (winner, runner-up) pairs of distinct groups.
+
+    ``a``/``b`` are ``(d1, r1, k1, d2, r2, k2)`` tuples of (M,) arrays —
+    the two best distinct groups each side has seen, empty slots at
+    ``(-inf, -inf, _SENTINEL)``. The merged top-2 is the two best distinct
+    groups of the union: the same group arriving from both sides carries
+    bit-identical stats (one global reduction per group — see module
+    comment), so dedup is pure key equality. Selection-only: associative
+    and commutative over the group total order, which is what makes the
+    chunk fold independent of chunk boundaries.
+    """
+    a1, a2 = (a[0], a[1], a[2]), (a[3], a[4], a[5])
+    b1, b2 = (b[0], b[1], b[2]), (b[3], b[4], b[5])
+    a_wins = _lex_ge(*a1, *b1)
+    win = _sel(a_wins, a1, b1)
+    lose = _sel(a_wins, b1, a1)
+    # Runner-up: best of {losing winner, both runners} that is NOT the
+    # winning group (the losing side's winner can BE the winning group —
+    # seen from both sides — and either runner can match it too).
+    cands = [_mask_key(lose, win[2]), _mask_key(a2, win[2]),
+             _mask_key(b2, win[2])]
+    best = cands[0]
+    for cand in cands[1:]:
+        best = _sel(_lex_ge(*best, *cand), best, cand)
+    return win + best
+
+
+def ring_tiebreak_math(
+    pred: Array,
+    weight: Array,
+    conf: Array,
+    rel: Array,
+    valid: Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    precision: int = 6,
+    chunk_agents: "int | None" = None,
+    agents_last: bool = True,
+) -> RingTieBreakResult:
+    """Chunked group-metric tie-break on one device shard (shard_map body).
+
+    Blocks are ``(M, A)`` with ``agents_last=True`` (the standalone ring
+    path) or slot-major ``(A, M)`` with ``agents_last=False`` (the fused
+    resident program, where agents ARE the cycle's source slots and
+    markets ride the lane dimension). The agents axis is sharded over
+    *axis_name* (*axis_size* devices); markets may be sharded over the
+    other mesh axis — every output is per-market and communication happens
+    only over *axis_name*.
+
+    ``chunk_agents`` bounds the LOCAL working set: the shard's agents are
+    processed in fixed-width chunks (``None`` ⇒ one full-width chunk — the
+    unchunked reference), each chunk's group stats computed against the
+    full visiting width and folded into the per-market top-2 carry. A
+    ragged tail runs as one extra static-width pass. Outputs are
+    bit-identical for every chunk size (see module comment); per-chunk
+    temps replace the per-shard O(A_loc × M_loc) stat tensors.
+
+    Ring accumulation (``axis_size > 1``): the visiting (key, weight,
+    reliability, valid) stack makes one full rotation PER CHUNK — the
+    rotating carry is donated hop to hop by the scan, so chunking trades
+    bounded HBM for replayed ICI hops (on a single chip, the stress
+    bench's shape, there is no rotation at all and no stacked buffer).
+    Per-origin partial weight sums are reduced in fixed origin order
+    0..n-1 after each rotation, so same-group agents on different devices
+    see bit-identical f32 group sums (the exact-equality tie compares).
+    """
+    f32 = jnp.float32
+    pred = pred.astype(f32)
+    weight = weight.astype(f32)
+    conf = conf.astype(f32)
+    rel = rel.astype(f32)
+    scale = float(10**precision)
+    NEG = f32(-jnp.inf)
+    SENT = jnp.int32(_SENTINEL)
+
+    agents_axis = (pred.ndim - 1) if agents_last else 0
+    a_loc = pred.shape[agents_axis]
+    chunk = a_loc if chunk_agents is None else max(1, min(int(chunk_agents), a_loc))
+    n_full, tail = divmod(a_loc, chunk)
+
+    keys = jnp.where(
+        valid,
+        jnp.round(pred * scale).astype(jnp.int32),
+        jnp.int32(_INVALID_KEY),
+    )
+
+    def slice_agents(x, offset, width):
+        return jax.lax.dynamic_slice_in_dim(x, offset, width, axis=agents_axis)
+
+    def pair(local, visiting):
+        """Broadcast a (…, C) local chunk against a (…, A) visiting block."""
+        if agents_last:  # (M, C) vs (M, A) -> (M, C, A), reduce axis 2
+            return local[:, :, None], visiting[:, None, :]
+        # (C, M) vs (A, M) -> (C, A, M), reduce axis 1
+        return local[:, None, :], visiting[None, :, :]
+
+    vis_axis = 2 if agents_last else 1
+
+    def chunk_reduce(x, op):
+        return op(x, axis=(-1 if agents_last else 0))
+
+    def chunk_expand(per_market):
+        return per_market[:, None] if agents_last else per_market[None, :]
+
+    def accumulate(lk, v_key, v_w, v_rel, v_valid, count, mr):
+        """One visiting block folded into a chunk's stats; returns the
+        (count', partial_tw, mr') triple (tw handled per origin)."""
+        lk_b, vk_b = pair(lk, v_key)
+        _, vv_b = pair(lk, v_valid)
+        same = (lk_b == vk_b) & vv_b
+        count = count + jnp.sum(same, axis=vis_axis)
+        _, vw_b = pair(lk, v_w)
+        partial_tw = jnp.sum(jnp.where(same, vw_b, 0.0), axis=vis_axis)
+        _, vr_b = pair(lk, v_rel)
+        mr = jnp.maximum(
+            mr, jnp.max(jnp.where(same, vr_b, NEG), axis=vis_axis)
+        )
+        return count, partial_tw, mr
+
+    def chunk_stats(offset, width):
+        """Global group stats for the local agents [offset, offset+width)."""
+        lk = slice_agents(keys, offset, width)
+        zero_i = jnp.zeros(lk.shape, jnp.int32)
+        neg_f = jnp.full(lk.shape, NEG, dtype=f32)
+        if axis_size == 1:
+            count, tw, mr = accumulate(
+                lk, keys, weight, rel, valid, zero_i, neg_f
+            )
+        else:
+            # The rotating stack: f32-uniform so one ppermute moves it.
+            visiting0 = jnp.stack(
+                [keys.astype(f32), weight, rel, valid.astype(f32)]
+            )
+            perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+            my_idx = jax.lax.axis_index(axis_name)
+
+            def hop(carry, t):
+                (count, tw_by_origin, mr), visiting = carry
+                v_key = visiting[0].astype(jnp.int32)
+                v_w, v_rel, v_valid = (
+                    visiting[1], visiting[2], visiting[3] > 0
+                )
+                count, partial_tw, mr = accumulate(
+                    lk, v_key, v_w, v_rel, v_valid, count, mr
+                )
+                origin = jnp.mod(my_idx - t, axis_size)
+                tw_by_origin = tw_by_origin.at[origin].set(partial_tw)
+                visiting = jax.lax.ppermute(visiting, axis_name, perm)
+                return ((count, tw_by_origin, mr), visiting), None
+
+            tw_by_origin0 = jnp.zeros((axis_size,) + lk.shape, f32)
+            ((count, tw_by_origin, mr), _), _ = jax.lax.scan(
+                hop,
+                ((zero_i, tw_by_origin0, neg_f), visiting0),
+                jnp.arange(axis_size, dtype=jnp.int32),
+            )
+            # Fixed origin order on every device: exact tie detection
+            # must not depend on rotation arrival order.
+            tw = jnp.sum(tw_by_origin, axis=0)
+        lvalid = slice_agents(valid, offset, width)
+        return lk, lvalid, count, tw, mr
+
+    def top2_of_chunk(lk, member, density, mrm):
+        """The chunk's two best distinct groups under the hierarchy."""
+        bd = chunk_reduce(density, jnp.max)
+        m1 = member & (density == chunk_expand(bd))
+        br = chunk_reduce(jnp.where(m1, mrm, NEG), jnp.max)
+        m2 = m1 & (mrm == chunk_expand(br))
+        bk = chunk_reduce(jnp.where(m2, lk, SENT), jnp.min)
+
+        others = member & (lk != chunk_expand(bk))
+        od = chunk_reduce(jnp.where(others, density, NEG), jnp.max)
+        o1 = others & (density == chunk_expand(od))
+        orr = chunk_reduce(jnp.where(o1, mrm, NEG), jnp.max)
+        o2 = o1 & (mrm == chunk_expand(orr))
+        ok = chunk_reduce(jnp.where(o2, lk, SENT), jnp.min)
+        return bd, br, bk, od, orr, ok
+
+    def chunk_pass(offset, width, carry):
+        top2, sum_inv = carry
+        lk, lvalid, count, tw, mr = chunk_stats(offset, width)
+        member = lvalid & (count > 0)
+        safe_count = jnp.maximum(count, 1)
+        density = jnp.where(member, tw / safe_count, NEG)
+        mrm = jnp.where(member, mr, NEG)
+        top2 = _merge_top2(top2, top2_of_chunk(lk, member, density, mrm))
+        # Σ 1/count over member agents counts each group exactly once
+        # (count is the group's GLOBAL size, so a group split across
+        # chunks/devices still contributes exactly 1 in total).
+        sum_inv = sum_inv + chunk_reduce(
+            jnp.where(member, 1.0 / safe_count, 0.0), jnp.sum
+        )
+        return top2, sum_inv
+
+    markets = pred.shape[0 if agents_last else 1]
+    empty = (
+        jnp.full(markets, NEG, dtype=f32),
+        jnp.full(markets, NEG, dtype=f32),
+        jnp.full(markets, SENT, dtype=jnp.int32),
+    )
+    carry = (empty + empty, jnp.zeros(markets, f32))
+    if n_full:  # guard: fori_loop traces its body even for 0 trips
+        carry = jax.lax.fori_loop(
+            0,
+            n_full,
+            lambda i, c: chunk_pass(i * chunk, chunk, c),
+            carry,
+        )
+    if tail:
+        carry = chunk_pass(n_full * chunk, tail, carry)
+    top2, sum_inv = carry
+
+    if axis_size > 1:
+        # Cross-device fold in fixed device order: all_gather the tiny
+        # per-market top-2 vectors and merge 0..n-1 (selection-only, so
+        # the order is immaterial to the result — fixed anyway).
+        gathered = [
+            jax.lax.all_gather(x, axis_name) for x in top2
+        ]
+        top2 = tuple(g[0] for g in gathered)
+        for i in range(1, axis_size):
+            top2 = _merge_top2(top2, tuple(g[i] for g in gathered))
+
+    d1, r1, k1, d2, r2, k2 = top2
+    any_member = k1 != SENT
+    any_other = k2 != SENT
+    full_tie = (d1 == d2) & (r1 == r2)
+    resolved_by = jnp.where(
+        ~any_other, 0, jnp.where(full_tie, 2, 1)
+    ).astype(jnp.int32)
+    # The rounding contract (quirk #6 family): the reported prediction is
+    # the winning key rescaled in f32 — identical at every chunk size.
+    prediction = jnp.where(
+        any_member, k1.astype(f32) / f32(scale), f32(jnp.inf)
+    )
+
+    num_groups = jnp.round(
+        jax.lax.psum(sum_inv, axis_name)
+    ).astype(jnp.int32)
+
+    # Population confidence variance over valid agents
+    # (reference: tiebreak.py:107-110) — full-row reductions, deliberately
+    # OUTSIDE the chunk loop: the expression (and so its float summation
+    # order) must not change with the chunk knob.
+    agg_axis = -1 if agents_last else 0
+    n = jax.lax.psum(jnp.sum(valid, axis=agg_axis), axis_name)
+    s1 = jax.lax.psum(
+        jnp.sum(jnp.where(valid, conf, 0.0), axis=agg_axis), axis_name
+    )
+    s2 = jax.lax.psum(
+        jnp.sum(jnp.where(valid, conf * conf, 0.0), axis=agg_axis), axis_name
+    )
+    nf = jnp.maximum(n, 1).astype(f32)
+    variance = jnp.maximum(s2 / nf - (s1 / nf) ** 2, 0.0)
+
+    return RingTieBreakResult(
+        prediction=prediction,
+        weight_density=d1,
+        max_reliability=r1,
+        resolved_by=resolved_by,
+        num_groups=num_groups,
+        confidence_variance=variance,
+    )

@@ -28,13 +28,17 @@ that axis:
   mesh and blocks of (key, weight, reliability) rotate around the ring,
   each device accumulating its local agents' group metrics against the
   visiting block — exactly ring attention's "local queries vs visiting
-  keys/values" structure.
+  keys/values" structure. Since round 11 the local agents are consumed
+  in fixed-width CHUNKS that fold into a per-market top-2 carry
+  (``ops.tiebreak.ring_tiebreak_math``), so per-step temps are
+  O(chunk × markets) — ring attention's bounded working set on both
+  axes; ``chunk_agents=`` tunes the width, outputs bit-identical at
+  every setting.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +46,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bayesian_consensus_engine_tpu.parallel._jax_compat import shard_map
 
 
+from bayesian_consensus_engine_tpu.ops.tiebreak import (
+    DEFAULT_CHUNK_AGENTS,
+    RingTieBreakResult,
+    ring_tiebreak_math,
+)
 from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS
 from bayesian_consensus_engine_tpu.parallel.sharded import (
     CycleResult,
@@ -344,33 +353,123 @@ UPDATE_SPEC = P((MARKETS_AXIS, SOURCES_AXIS), None)
 REDUCE_SPEC = P(MARKETS_AXIS, SOURCES_AXIS)
 
 
-class RingTieBreakResult(NamedTuple):
-    """Device-side tie-break outputs, one entry per market row.
+#: Candidate chunk widths the shape tuner races (narrowed to the shard
+#: width at resolve time). Module constant so tests can monkeypatch the
+#: ladder down to toy shapes.
+_CHUNK_CANDIDATES = (128, 256, 512, 1024, 2048)
 
-    ``resolved_by`` codes: 0 unanimous, 1 weight_density,
-    2 prediction_value_smallest — matching the scalar labels
-    (models/tiebreak.py, reference: tiebreak.py:119-133, including quirk #6:
-    a decision that actually fell to max_reliability still reports
-    weight_density).
+
+def _tuned_chunk_agents(mesh: Mesh, precision: int, shape: tuple) -> int | None:
+    """Resolve ``chunk_agents="auto"`` for one (markets, agents) shape.
+
+    Measured once per (shape, mesh, device-kind) through the process-wide
+    :class:`~.utils.autotune.ShapeTuner` and persisted; the honesty guard
+    races every candidate against :data:`DEFAULT_CHUNK_AGENTS` on the same
+    clock and ships the default unless a candidate strictly beat it.
+    Autotune disabled (the default) resolves straight to the recorded
+    default, clamped to the shard width.
     """
+    from bayesian_consensus_engine_tpu.utils.autotune import (
+        default_tuner,
+        time_best_of,
+    )
 
-    prediction: jax.Array           # f[M] winning (rounded) prediction
-    weight_density: jax.Array       # f[M] winning group's density
-    max_reliability: jax.Array      # f[M] winning group's max reliability
-    resolved_by: jax.Array          # i32[M]
-    num_groups: jax.Array           # i32[M]
-    confidence_variance: jax.Array  # f[M] population variance over agents
+    markets, agents = int(shape[0]), int(shape[1])
+    a_loc = max(1, agents // mesh.shape[SOURCES_AXIS])
+    default = min(DEFAULT_CHUNK_AGENTS, a_loc)
+    candidates = [c for c in _CHUNK_CANDIDATES if c < a_loc]
+    candidates.append(a_loc)  # the unchunked reference rides the race too
+    candidates = [c for c in candidates if c != default]
+    if not candidates:
+        return default
+
+    def measure(chunk: int) -> float:
+        import numpy as np
+
+        fn = _compile_ring_tiebreak(mesh, precision, chunk, donate=False)
+        rng = np.random.default_rng(17)
+        grid = np.round(np.linspace(0.05, 0.95, 19), precision)
+        args = (
+            jnp.asarray(rng.choice(grid, (markets, agents)), jnp.float32),
+            jnp.asarray(rng.uniform(0.1, 2.0, (markets, agents)), jnp.float32),
+            jnp.asarray(rng.uniform(0, 1, (markets, agents)), jnp.float32),
+            jnp.asarray(rng.uniform(0, 1, (markets, agents)), jnp.float32),
+            jnp.asarray(rng.random((markets, agents)) < 0.9),
+        )
+
+        def run() -> None:
+            out = fn(*args)
+            np.asarray(out.prediction)  # fence: force the result to host
+
+        # warmup=1 takes the compile off the clock (the autotune-guard
+        # honesty rule); the clock itself lives in utils.autotune.
+        return time_best_of(run, repeats=2, warmup=1)
+
+    return default_tuner().tune(
+        "ring_chunk_agents",
+        (markets, agents, *(int(s) for s in mesh.devices.shape)),
+        candidates,
+        measure,
+        default,
+    )
 
 
-def build_ring_tiebreak(mesh: Mesh, precision: int = 6):
-    """Batched tie-break with the agents axis sharded and ring-rotated.
+def _compile_ring_tiebreak(
+    mesh: Mesh, precision: int, chunk_agents: int | None, donate: bool
+):
+    """One jitted (M, A)-layout chunked tie-break program for *mesh*."""
+    block = P(MARKETS_AXIS, SOURCES_AXIS)
+    market = P(MARKETS_AXIS)
+    fn = shard_map(
+        partial(
+            ring_tiebreak_math,
+            axis_name=SOURCES_AXIS,
+            axis_size=mesh.shape[SOURCES_AXIS],
+            precision=precision,
+            chunk_agents=chunk_agents,
+            agents_last=True,
+        ),
+        mesh=mesh,
+        in_specs=(block, block, block, block, block),
+        out_specs=RingTieBreakResult(*([market] * 6)),
+        check_vma=False,  # ring-accumulated stats defeat the vma checker
+    )
+    # Donation covers the whole operand set: the rotating visiting stack
+    # (and the per-chunk compare temps) can then alias the argument
+    # blocks instead of allocating beside them — the fused resident
+    # program always donates; the standalone path opts in when the caller
+    # is done with its blocks.
+    return jax.jit(fn, donate_argnums=(0, 1, 2, 3, 4) if donate else ())
+
+
+def build_ring_tiebreak(
+    mesh: Mesh,
+    precision: int = 6,
+    chunk_agents: "int | str | None" = None,
+    donate: bool = False,
+):
+    """Batched tie-break with the agents axis sharded and chunk-accumulated.
 
     ``tiebreak(pred, weight, conf, rel, valid) -> RingTieBreakResult`` over
     (M, A) blocks sharded ``P(markets, agents)`` (the agents axis rides the
-    mesh's sources axis). Blocks of (key, weight, reliability) rotate around
-    the ring; each device accumulates, for every local agent, its group's
-    {count, total_weight, max_reliability} against the visiting block —
-    ring attention's structure with group-key equality in place of QKᵀ.
+    mesh's sources axis). The grouping core is
+    :func:`~.ops.tiebreak.ring_tiebreak_math`: each fixed-width chunk of
+    local agents accumulates its groups' {count, total_weight,
+    max_reliability} against the visiting block (rotated around the ring
+    when the agents axis is sharded — ring attention's structure with
+    group-key equality in place of QKᵀ), then folds into a per-market
+    top-2 carry, so per-step temps are O(chunk × markets) instead of
+    O(agents × markets) — the round-11 memory diet.
+
+    ``chunk_agents``: ``None`` — one full-width chunk (the unchunked
+    reference; the pre-round-11 memory shape); an int — that local chunk
+    width (clamped to the shard); ``"auto"`` — the shape tuner's measured
+    pick (utils/autotune.py; requires ``BCE_AUTOTUNE=1``, otherwise
+    resolves to the recorded :data:`DEFAULT_CHUNK_AGENTS`). Outputs are
+    bit-identical across every setting (pinned by
+    tests/test_ring.py::TestChunkedParityMatrix). ``donate=True`` releases
+    the five operand blocks to XLA (callers that reuse their arrays across
+    calls must keep the default).
 
     Predictions are grouped on keys rounded to *precision* decimals
     (reference: tiebreak.py:49-56); keys are quantised to int32 on device
@@ -378,161 +477,50 @@ def build_ring_tiebreak(mesh: Mesh, precision: int = 6):
     predictions that are not within float error of a half-ulp decimal tie.
     Winner selection is the lexicographic hierarchy
     (weight_density, max_reliability, smallest prediction)
-    (reference: tiebreak.py:112-117), realised as three masked pmax/pmin
-    passes; runner-up metrics are recomputed with the winner's group masked
-    out to classify ``resolved_by``.
-
-    Invalid lanes (``valid=False``) are padding: they join no group and
-    contribute nothing — the ragged-agents analogue of the cycle's mask.
+    (reference: tiebreak.py:112-117). Invalid lanes (``valid=False``) are
+    padding: they join no group and contribute nothing — the ragged-agents
+    analogue of the cycle's mask.
 
     Floating-point caveat: tie *classification* compares f32 group sums for
-    exact equality. The origin-ordered accumulation (see ``hop``) makes
-    those sums bit-identical across devices and rotation schedules, but a
-    tie the scalar engine sees in f64 can still split by one ulp in f32
-    (and vice versa) when group weight sums are not exactly representable —
-    the scalar tie-breaker remains the bit-exact contract; this path is the
-    at-scale batched one. (The reference's own f64 sums are insertion-order
-    dependent too, and its ``TIE_TOLERANCE`` constant is defined but never
-    enforced — reference quirk #2.)
+    exact equality. The origin-ordered accumulation makes those sums
+    bit-identical across devices, rotation schedules, and chunk widths,
+    but a tie the scalar engine sees in f64 can still split by one ulp in
+    f32 (and vice versa) when group weight sums are not exactly
+    representable — the scalar tie-breaker remains the bit-exact contract;
+    this path is the at-scale batched one. (The reference's own f64 sums
+    are insertion-order dependent too, and its ``TIE_TOLERANCE`` constant
+    is defined but never enforced — reference quirk #2.)
+
+    The returned callable also exposes ``.lower(*blocks)`` (resolving the
+    chunk for the blocks' shape first) so AOT ``memory_analysis()``
+    captures — the bench leg's compile-temps acceptance — work unchanged.
     """
-    n_agents_axis = mesh.shape[SOURCES_AXIS]
-    block = P(MARKETS_AXIS, SOURCES_AXIS)
-    market = P(MARKETS_AXIS)
-    scale = float(10**precision)
-    NEG = jnp.float32(-jnp.inf)
+    compiled: dict = {}
 
-    def lex_winner(keys, density, max_rel, pred_r, member):
-        """(density, max_rel, -pred) lexicographic argmax over valid agents.
-
-        Returns the winning group's (pred, density, max_rel) plus a
-        per-agent membership mask of that group. All reductions are
-        axis-local max/min followed by one psum-backed pmax/pmin.
-        """
-        d = jnp.where(member, density, NEG)
-        best_d = jax.lax.pmax(jnp.max(d, axis=-1), SOURCES_AXIS)
-        m1 = member & (density == best_d[:, None])
-
-        r = jnp.where(m1, max_rel, NEG)
-        best_r = jax.lax.pmax(jnp.max(r, axis=-1), SOURCES_AXIS)
-        m2 = m1 & (max_rel == best_r[:, None])
-
-        p = jnp.where(m2, pred_r, jnp.inf)
-        best_p = jax.lax.pmin(jnp.min(p, axis=-1), SOURCES_AXIS)
-        win_key = jnp.round(best_p * scale).astype(jnp.int32)
-        in_group = member & (keys == win_key[:, None])
-        return best_p, best_d, best_r, in_group
-
-    def tiebreak_math(pred, weight, conf, rel, valid):
-        pred = pred.astype(jnp.float32)
-        weight = weight.astype(jnp.float32)
-        conf = conf.astype(jnp.float32)
-        rel = rel.astype(jnp.float32)
-
-        keys = jnp.where(
-            valid, jnp.round(pred * scale).astype(jnp.int32), jnp.int32(-(2**31))
-        )
-        pred_r = keys.astype(jnp.float32) / scale  # the rounded prediction
-
-        # Ring accumulation of per-agent group stats. The rotating block
-        # carries (key, weight, rel, valid) stacked as f32. Float weight
-        # sums are accumulated into an origin-indexed buffer and reduced in
-        # fixed origin order 0..n-1 AFTER the ring completes: two agents of
-        # the same group on different devices then see bit-identical f32
-        # group sums (rotation arrival order differs per device; summing in
-        # arrival order would make exact tie detection device-dependent —
-        # same-group members on different homes would disagree about their
-        # own group's total by an ulp and the equality masks in lex_winner
-        # would split the group). count (int) and max-reliability are
-        # order-invariant and accumulate directly.
-        #
-        # Memory tradeoff, made deliberately: the buffer is ring_size× one
-        # block shard (ring_size · M_loc · A_loc f32). Exactness requires
-        # it — any O(1)-memory schedule sums in device-dependent order
-        # (f32 addition commutes but does not associate). Tie-breaking is
-        # the diagnostics path, not the settlement hot loop; at the
-        # 10k-agent stress scale, shard markets too (M_loc shrinks with the
-        # markets axis) — the transient (M_loc, A_loc, A_visit) compare
-        # masks, not this buffer, are then the larger term.
-        visiting0 = jnp.stack(
-            [keys.astype(jnp.float32), weight, rel, valid.astype(jnp.float32)]
-        )
-        perm = [(i, (i + 1) % n_agents_axis) for i in range(n_agents_axis)]
-        my_idx = jax.lax.axis_index(SOURCES_AXIS)
-
-        def hop(carry, t):
-            (count, tw_by_origin, mr), visiting = carry
-            v_key = visiting[0].astype(jnp.int32)
-            v_w, v_rel, v_valid = visiting[1], visiting[2], visiting[3] > 0
-            # (M, A_loc, A_visit) same-group mask — local agents × visitors.
-            same = (keys[:, :, None] == v_key[:, None, :]) & v_valid[:, None, :]
-            count = count + jnp.sum(same, axis=-1)
-            partial_tw = jnp.sum(jnp.where(same, v_w[:, None, :], 0.0), axis=-1)
-            origin = jnp.mod(my_idx - t, n_agents_axis)
-            tw_by_origin = tw_by_origin.at[origin].set(partial_tw)
-            mr = jnp.maximum(
-                mr, jnp.max(jnp.where(same, v_rel[:, None, :], NEG), axis=-1)
+    def resolve(shape) -> "int | None":
+        if chunk_agents == "auto":
+            return _tuned_chunk_agents(mesh, precision, shape)
+        if isinstance(chunk_agents, str):
+            raise ValueError(
+                f"chunk_agents={chunk_agents!r}: the only supported string "
+                "is 'auto'"
             )
-            visiting = jax.lax.ppermute(visiting, SOURCES_AXIS, perm)
-            return ((count, tw_by_origin, mr), visiting), None
+        return chunk_agents
 
-        zero_i = jnp.zeros(keys.shape, jnp.int32)
-        zeros_by_origin = jnp.zeros((n_agents_axis,) + keys.shape, jnp.float32)
-        ((count, tw_by_origin, mr), _), _ = jax.lax.scan(
-            hop,
-            ((zero_i, zeros_by_origin, jnp.full(keys.shape, NEG)), visiting0),
-            jnp.arange(n_agents_axis),
-        )
-        tw = jnp.sum(tw_by_origin, axis=0)  # fixed origin order on every device
+    def program(shape):
+        chunk = resolve(shape)
+        fn = compiled.get(chunk)
+        if fn is None:
+            fn = compiled[chunk] = _compile_ring_tiebreak(
+                mesh, precision, chunk, donate
+            )
+        return fn
 
-        member = valid & (count > 0)
-        density = jnp.where(member, tw / jnp.maximum(count, 1), NEG)
+    def tiebreak(pred, weight, conf, rel, valid):
+        return program(pred.shape)(pred, weight, conf, rel, valid)
 
-        best_p, best_d, best_r, in_win = lex_winner(
-            keys, density, mr, pred_r, member
-        )
+    def lower(pred, weight, conf, rel, valid):
+        return program(pred.shape).lower(pred, weight, conf, rel, valid)
 
-        # Runner-up: winner's group masked out, same hierarchy again.
-        others = member & ~in_win
-        _, ru_d, ru_r, _ = lex_winner(keys, density, mr, pred_r, others)
-        any_other = jax.lax.psum(
-            jnp.sum(others, axis=-1), SOURCES_AXIS
-        ) > 0
-
-        # Σ 1/count over member agents counts the groups exactly.
-        inv = jnp.where(member, 1.0 / jnp.maximum(count, 1), 0.0)
-        num_groups = jnp.round(
-            jax.lax.psum(jnp.sum(inv, axis=-1), SOURCES_AXIS)
-        ).astype(jnp.int32)
-
-        full_tie = (best_d == ru_d) & (best_r == ru_r)
-        resolved_by = jnp.where(
-            ~any_other, 0, jnp.where(full_tie, 2, 1)
-        ).astype(jnp.int32)
-
-        # Population confidence variance over valid agents
-        # (reference: tiebreak.py:107-110).
-        n = jax.lax.psum(jnp.sum(valid, axis=-1), SOURCES_AXIS)
-        s1 = jax.lax.psum(jnp.sum(jnp.where(valid, conf, 0.0), axis=-1), SOURCES_AXIS)
-        s2 = jax.lax.psum(
-            jnp.sum(jnp.where(valid, conf * conf, 0.0), axis=-1), SOURCES_AXIS
-        )
-        nf = jnp.maximum(n, 1).astype(jnp.float32)
-        variance = jnp.maximum(s2 / nf - (s1 / nf) ** 2, 0.0)
-
-        return RingTieBreakResult(
-            prediction=best_p,
-            weight_density=best_d,
-            max_reliability=best_r,
-            resolved_by=resolved_by,
-            num_groups=num_groups,
-            confidence_variance=variance,
-        )
-
-    fn = shard_map(
-        tiebreak_math,
-        mesh=mesh,
-        in_specs=(block, block, block, block, block),
-        out_specs=RingTieBreakResult(*([market] * 6)),
-        check_vma=False,  # ring-accumulated stats defeat the vma checker
-    )
-    return jax.jit(fn)
+    tiebreak.lower = lower
+    return tiebreak

@@ -512,6 +512,99 @@ def build_cycle_loop(
     return loop
 
 
+def build_cycle_tiebreak_loop(
+    mesh: Mesh,
+    chunk_agents: int | None = None,
+    donate: bool = True,
+    precision: int = 6,
+):
+    """The fused co-resident program: N cycles PLUS the tie-break, one jit.
+
+    ``loop(probs, mask, outcome, state, now0, steps) ->
+    (state', consensus, RingTieBreakResult)`` — the round-11 payoff of the
+    ring memory diet. Before it, running a settlement cycle and the ring
+    tie-break against the same reliability block meant separate compiled
+    programs whose working sets (the tie-break's ~369 MB of temps at the
+    2048×10k stress shape) evicted each other from HBM between dispatches;
+    chunked accumulation (:func:`~.ops.tiebreak.ring_tiebreak_math`,
+    ``chunk_agents`` bounding per-step temps at O(chunk × markets)) makes
+    the tie-break small enough to co-reside, so both now run inside ONE
+    program per chip against the one resident block — no teardown, no
+    re-upload, no eviction between them.
+
+    Layout and sharding match :func:`build_cycle_loop` at
+    ``slot_major=True``: blocked arrays are (K, M) sharded
+    ``P(sources, markets)``, the cycle's source slots double as the
+    tie-break's agents axis (sharded over the ring), and every per-market
+    output is ``P(markets)``. Tie-break semantics: each signalling slot
+    enters as one agent with ``prediction = its probability``,
+    ``weight = reliability = the decayed read reliability`` (the same
+    weight the consensus reduction gives it, read at ``now0`` — the
+    PRE-update view the batch settles against), ``confidence = the read
+    confidence``; masked-out slots are invalid lanes. The loop half is
+    the shared :func:`make_loop_math` scaffold — same carry optimisations,
+    same resume bit-identity hazards handled.
+
+    ``steps`` is static per compilation; compiled per (steps, exists-ness)
+    like the plain loop. Donation covers the state (argnums 3) — the
+    tie-break's read happens before the in-place update in program order.
+    """
+    from bayesian_consensus_engine_tpu.ops.tiebreak import (
+        RingTieBreakResult,
+        ring_tiebreak_math,
+    )
+
+    block, market, slots_axis = _specs(slot_major=True)
+    n_sources = mesh.shape[SOURCES_AXIS]
+    compiled: dict[tuple[int, bool], object] = {}
+
+    def compile_for(steps: int, has_exists: bool):
+        cycle_fn = partial(
+            _cycle_math, axis_name=SOURCES_AXIS, slots_axis=slots_axis
+        )
+        fast_fn = partial(
+            _fast_cycle_math, axis_name=SOURCES_AXIS, slots_axis=slots_axis
+        )
+        loop_math = make_loop_math(cycle_fn, steps, fast_cycle_fn=fast_fn)
+
+        def fused_math(probs, mask, outcome, state, now0):
+            with jax.named_scope("bce.ring_tiebreak"):
+                read_rel, read_conf = read_phase(state, now0)
+                tiebreak = ring_tiebreak_math(
+                    probs, read_rel, read_conf, read_rel, mask,
+                    axis_name=SOURCES_AXIS,
+                    axis_size=n_sources,
+                    precision=precision,
+                    chunk_agents=chunk_agents,
+                    agents_last=False,  # slot-major: agents on axis 0
+                )
+            new_state, consensus = loop_math(probs, mask, outcome, state, now0)
+            return new_state, consensus, tiebreak
+
+        state_spec = MarketBlockState(
+            block, block, block, block if has_exists else None
+        )
+        fn = shard_map(
+            fused_math,
+            mesh=mesh,
+            in_specs=(block, block, market, state_spec, P()),
+            out_specs=(
+                state_spec, market, RingTieBreakResult(*([market] * 6))
+            ),
+            check_vma=False,  # ring/top-2 folds defeat the vma checker
+        )
+        return jax.jit(fn, donate_argnums=(3,) if donate else ())
+
+    def loop(probs, mask, outcome, state, now0, steps: int):
+        key = (steps, state.exists is not None)
+        fn = compiled.get(key)
+        if fn is None:
+            fn = compiled[key] = compile_for(*key)
+        return fn(probs, mask, outcome, state, now0)
+
+    return loop
+
+
 @partial(
     jax.jit, static_argnames=("new_shape",), donate_argnums=(1, 2, 3)
 )

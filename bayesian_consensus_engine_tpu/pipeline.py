@@ -1072,6 +1072,28 @@ def _cached_cycle_loop(mesh):
     return loop
 
 
+_fused_tiebreak_loop_cache: dict = {}
+
+
+def _cached_fused_tiebreak_loop(mesh, chunk_agents, precision):
+    """One fused cycle+tie-break loop per (mesh, chunk, precision) —
+    shared across sessions for the same reason as :func:`_cached_cycle_loop`
+    (the jit tracing cache lives on the wrapper instance)."""
+    key = (mesh, chunk_agents, precision)
+    loop = _fused_tiebreak_loop_cache.get(key)
+    if loop is None:
+        from bayesian_consensus_engine_tpu.parallel.sharded import (
+            build_cycle_tiebreak_loop,
+        )
+
+        loop = build_cycle_tiebreak_loop(
+            mesh, chunk_agents=chunk_agents, donate=True,
+            precision=precision,
+        )
+        _fused_tiebreak_loop_cache[key] = loop
+    return loop
+
+
 class ShardedSettlementSession:
     """Chained, device-resident sharded settlements for one plan — or, via
     :meth:`refresh`/:meth:`adopt`, a long-lived SUCCESSION of plans.
@@ -1179,19 +1201,14 @@ class ShardedSettlementSession:
             # sharded settle_stream even at identical shapes.
             self._loop = _cached_cycle_loop(mesh)
 
-    def settle(
-        self,
-        outcomes: Sequence[bool],
-        steps: int = 1,
-        now: Optional[float] = None,
-    ) -> SettlementResult:
-        """Run *steps* cycles on the retained sharded state."""
-        import jax.numpy as jnp
-
-        from bayesian_consensus_engine_tpu.parallel.distributed import (
-            global_market,
-        )
-
+    def _settle_preamble(
+        self, outcomes: Sequence[bool], now: Optional[float]
+    ) -> tuple:
+        """The shared pre-dispatch path of :meth:`settle` and
+        :meth:`settle_with_tiebreak`: plan/outcome validation, (re)build
+        of the resident state, exact host confidences, and the band-local
+        outcome columns. Returns ``(now_abs, conf_exact, outcome_band)``.
+        """
         store, plan = self._store, self._plan
         timeline = active_timeline()
         _check_plan(store, plan, outcomes)
@@ -1230,7 +1247,60 @@ class ShardedSettlementSession:
                 (0, band_width - len(outcome_arr)),
                 constant_values=False,
             )
-        with timeline.span("settle_dispatch"):
+        return now_abs, conf_exact, outcome_band
+
+    def _settle_commit(
+        self, new_state, steps: int, now_abs: float, conf_exact
+    ) -> None:
+        """The shared post-dispatch path: retain the new block, register
+        the merge recipe (closed-form stamps/existence; reliabilities stay
+        on device behind a lazy band gather until a host read needs them),
+        and replay the exact host confidences."""
+        self._state = new_state
+        np_dtype = np.dtype(self._cdtype).type
+        stamp_rel = np_dtype(
+            np_dtype(now_abs - self._epoch0) + np_dtype(steps - 1)
+        )
+        gather = _BandGather(
+            new_state.reliability, self._band_mask, session=self
+        )
+        self._store.defer_settle_recipe(
+            self._touched, gather, self._epoch0, stamp_rel,
+        )
+        self._standing_gather = gather
+        _replay_confidences(self._store, self._touched, conf_exact, steps)
+
+    def _band_live(self) -> tuple:
+        """(live, keys) for this process's band of the current plan.
+
+        A band can lie entirely in padding (more band capacity than
+        markets): clamp so keys and per-market views stay aligned
+        (maybe empty)."""
+        plan = self._plan
+        if self._band is None:
+            band_stop = min(self._hi, plan.num_markets)
+            return max(0, band_stop - self._lo), plan.market_keys[
+                self._lo:band_stop
+            ]
+        return plan.num_markets, plan.market_keys  # the plan IS the band
+
+    def settle(
+        self,
+        outcomes: Sequence[bool],
+        steps: int = 1,
+        now: Optional[float] = None,
+    ) -> SettlementResult:
+        """Run *steps* cycles on the retained sharded state."""
+        import jax.numpy as jnp
+
+        from bayesian_consensus_engine_tpu.parallel.distributed import (
+            global_market,
+        )
+
+        now_abs, conf_exact, outcome_band = self._settle_preamble(
+            outcomes, now
+        )
+        with active_timeline().span("settle_dispatch"):
             outcome_g = global_market(
                 outcome_band, self._mesh, self._padded_total
             )
@@ -1239,35 +1309,91 @@ class ShardedSettlementSession:
                 jnp.asarray(now_abs - self._epoch0, dtype=self._cdtype),
                 steps,
             )
-        self._state = new_state
-
-        # Merge recipe: closed-form stamps/existence; reliabilities stay on
-        # device behind a lazy band gather until a host read needs them.
-        np_dtype = np.dtype(self._cdtype).type
-        stamp_rel = np_dtype(
-            np_dtype(now_abs - self._epoch0) + np_dtype(steps - 1)
-        )
-        gather = _BandGather(
-            new_state.reliability, self._band_mask, session=self
-        )
-        store.defer_settle_recipe(
-            self._touched, gather, self._epoch0, stamp_rel,
-        )
-        self._standing_gather = gather
-        _replay_confidences(store, self._touched, conf_exact, steps)
-
-        # A band can lie entirely in padding (more band capacity than
-        # markets): clamp so keys and consensus stay aligned (maybe empty).
-        if self._band is None:
-            band_stop = min(self._hi, plan.num_markets)
-            live = max(0, band_stop - self._lo)
-            keys = plan.market_keys[self._lo:band_stop]
-        else:
-            live = plan.num_markets  # the plan IS this process's band
-            keys = plan.market_keys
+        self._settle_commit(new_state, steps, now_abs, conf_exact)
+        live, keys = self._band_live()
         return SettlementResult(
             market_keys=keys,
             consensus=_BandView(consensus, self._lo, live),
+        )
+
+    def settle_with_tiebreak(
+        self,
+        outcomes: Sequence[bool],
+        steps: int = 1,
+        now: Optional[float] = None,
+        chunk_agents: "int | str | None" = "default",
+        precision: int = 6,
+    ) -> tuple:
+        """Settle AND tie-break the batch in ONE compiled program per chip.
+
+        The co-resident entry point the ring memory diet exists for
+        (ROADMAP item 4): the chunked tie-break
+        (:func:`~.parallel.sharded.build_cycle_tiebreak_loop`) runs inside
+        the same dispatch as the consensus/update loop, against the same
+        resident reliability block — no second program competing for HBM,
+        no re-upload of the block it already holds. Returns
+        ``(SettlementResult, RingTieBreakResult)`` where the tie-break
+        fields are per-market band views over this process's markets: each
+        signalling slot enters as one agent with its probability as the
+        prediction and its decayed READ reliability (the pre-update view
+        this settle's consensus weighs with, at *now*) as both weight and
+        reliability score.
+
+        Settlement semantics — state merge recipe, confidence replay,
+        journal/export bytes — are exactly :meth:`settle`'s (the shared
+        commit path); the consensus itself comes from this entry's own
+        compiled program, equal to :meth:`settle`'s to float tolerance
+        (fusion may associate differently), so a stream that mixes both
+        entries should not expect bitwise-stable consensus across the mix.
+        ``chunk_agents="default"`` takes the recorded
+        :data:`~.ops.tiebreak.DEFAULT_CHUNK_AGENTS` (the diet is ON here
+        by default — co-residency is the point); ``None`` opts back into
+        the unchunked accumulation.
+        """
+        import jax.numpy as jnp
+
+        from bayesian_consensus_engine_tpu.ops.tiebreak import (
+            DEFAULT_CHUNK_AGENTS,
+            RingTieBreakResult,
+        )
+        from bayesian_consensus_engine_tpu.parallel.distributed import (
+            global_market,
+        )
+
+        if chunk_agents == "default":
+            chunk_agents = DEFAULT_CHUNK_AGENTS
+        elif isinstance(chunk_agents, str):
+            raise ValueError(
+                f"chunk_agents={chunk_agents!r}: the session entry takes "
+                "an int, None (unchunked), or 'default' (the recorded "
+                "DEFAULT_CHUNK_AGENTS); measured 'auto' tuning lives on "
+                "parallel.ring.build_ring_tiebreak"
+            )
+        now_abs, conf_exact, outcome_band = self._settle_preamble(
+            outcomes, now
+        )
+        loop = _cached_fused_tiebreak_loop(
+            self._mesh, chunk_agents, precision
+        )
+        with active_timeline().span("settle_dispatch"):
+            outcome_g = global_market(
+                outcome_band, self._mesh, self._padded_total
+            )
+            new_state, consensus, tiebreak = loop(
+                self._probs_g, self._mask_g, outcome_g, self._state,
+                jnp.asarray(now_abs - self._epoch0, dtype=self._cdtype),
+                steps,
+            )
+        self._settle_commit(new_state, steps, now_abs, conf_exact)
+        live, keys = self._band_live()
+        return (
+            SettlementResult(
+                market_keys=keys,
+                consensus=_BandView(consensus, self._lo, live),
+            ),
+            RingTieBreakResult(
+                *(_BandView(x, self._lo, live) for x in tiebreak)
+            ),
         )
 
     def refresh(self, plan: SettlementPlan) -> None:

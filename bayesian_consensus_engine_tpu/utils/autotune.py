@@ -182,14 +182,21 @@ class ShapeTuner:
             return dict(entry) if isinstance(entry, dict) else None
 
 
-def time_best_of(run: Callable[[], object], repeats: int = 3) -> float:
+def time_best_of(
+    run: Callable[[], object], repeats: int = 3, warmup: int = 0
+) -> float:
     """Minimum wall-clock seconds of ``run()`` over *repeats* calls.
 
     The one clock the tuner hands to ``measure`` callbacks: the kernel
     modules are clock-free by contract (lint rule DT202), so any timing a
     measure function needs routes through here. ``run`` must fence its own
     device work (fetch a scalar) or the timings are dispatch-only.
+    *warmup* untimed calls run first — the standard way to keep a
+    candidate's compile off its clock (the honesty guard compares
+    steady-state speed, not who compiled faster).
     """
+    for _ in range(warmup):
+        run()
     best = float("inf")
     for _ in range(repeats):
         start = time.perf_counter()

@@ -170,6 +170,134 @@ class TestPallasTileWiring:
         assert consensus.shape == (1, 384)
 
 
+class TestTimeBestOf:
+    def test_warmup_calls_are_untimed(self):
+        from bayesian_consensus_engine_tpu.utils.autotune import time_best_of
+
+        calls = []
+
+        def run():
+            calls.append(len(calls))
+
+        best = time_best_of(run, repeats=2, warmup=3)
+        assert len(calls) == 5  # 3 warmup + 2 timed
+        assert best >= 0.0
+
+    def test_warmup_default_zero(self):
+        from bayesian_consensus_engine_tpu.utils.autotune import time_best_of
+
+        calls = []
+        time_best_of(lambda: calls.append(1), repeats=2)
+        assert len(calls) == 2
+
+
+class TestRingChunkWiring:
+    """chunk_agents="auto" (parallel/ring.py) routes through the same
+    ShapeTuner contract as the Pallas tile: off → the recorded default
+    without measuring; on → the honesty guard races candidates against
+    the default and records the verdict the bench leg reports."""
+
+    def test_auto_resolves_through_tuner(self, monkeypatch):
+        from bayesian_consensus_engine_tpu.parallel import ring
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        seen = {}
+
+        class FakeTuner:
+            def tune(self, knob, shape_key, candidates, measure, default):
+                seen.update(
+                    knob=knob, shape_key=shape_key,
+                    candidates=candidates, default=default,
+                )
+                return 4
+
+        monkeypatch.setattr(autotune, "default_tuner", lambda: FakeTuner())
+        mesh = make_mesh((1, 8))
+        chunk = ring._tuned_chunk_agents(mesh, 6, (16, 80_000))
+        assert chunk == 4
+        assert seen["knob"] == "ring_chunk_agents"
+        assert seen["shape_key"] == (16, 80_000, 1, 8)
+        assert seen["default"] == ring.DEFAULT_CHUNK_AGENTS
+        # Every standard width under the 10k shard + the unchunked shard
+        # width itself ride the race (the default is measured by tune()).
+        assert seen["candidates"] == [128, 256, 512, 2048, 10_000]
+
+    def test_tiny_shard_short_circuits_to_default(self, monkeypatch):
+        # a_loc = 32/8 = 4: nothing to race (every candidate clamps to
+        # the default) — resolve without ever constructing a tuner.
+        from bayesian_consensus_engine_tpu.parallel import ring
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        def boom():
+            raise AssertionError("tuner must not be constructed")
+
+        monkeypatch.setattr(autotune, "default_tuner", boom)
+        assert ring._tuned_chunk_agents(make_mesh((1, 8)), 6, (16, 32)) == 4
+
+    def test_default_off_keeps_recorded_chunk(self, monkeypatch, tmp_path):
+        from bayesian_consensus_engine_tpu.parallel import ring
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        monkeypatch.delenv("BCE_AUTOTUNE", raising=False)
+        monkeypatch.setattr(autotune, "_default_tuner", None)
+        monkeypatch.setattr(
+            autotune, "_default_cache_path",
+            lambda: str(tmp_path / "never.json"),
+        )
+        mesh = make_mesh((1, 8))
+        chunk = ring._tuned_chunk_agents(mesh, 6, (64, 80_000))
+        assert chunk == ring.DEFAULT_CHUNK_AGENTS
+        assert not (tmp_path / "never.json").exists()
+
+    def test_enabled_tunes_races_default_and_runs(self, monkeypatch,
+                                                  tmp_path):
+        """End-to-end: a real (tiny) measured tune through the honesty
+        guard — the verdict records the default raced on the same clock,
+        and the resolved build runs and matches the unchunked output."""
+        import jax
+
+        from bayesian_consensus_engine_tpu.parallel import ring
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        tuner = autotune.ShapeTuner(
+            cache_path=str(tmp_path / "ring.json"), enabled=True,
+            device_kind="test-device",
+        )
+        monkeypatch.setattr(autotune, "_default_tuner", tuner)
+        monkeypatch.setattr(ring, "_CHUNK_CANDIDATES", (2,))
+        mesh = make_mesh((1, 2), devices=jax.devices()[:2])
+        m, a = 8, 16
+        fn = ring.build_ring_tiebreak(mesh, chunk_agents="auto")
+        rng = np.random.default_rng(2)
+        args = tuple(
+            jax.numpy.asarray(x)
+            for x in (
+                rng.choice([0.25, 0.5, 0.75], (m, a)).astype(np.float32),
+                rng.uniform(0.5, 2.0, (m, a)).astype(np.float32),
+                rng.uniform(0, 1, (m, a)).astype(np.float32),
+                rng.uniform(0, 1, (m, a)).astype(np.float32),
+                rng.random((m, a)) < 0.9,
+            )
+        )
+        got = fn(*args)
+        decision = tuner.decision("ring_chunk_agents", (m, a, 1, 2))
+        assert decision is not None
+        # The shard width is 8, so the default clamps to it; the guard
+        # recorded it raced on the same clock as the candidates.
+        assert decision["default"] == 8
+        assert str(decision["choice"]) in decision["timings_s"]
+        assert str(decision["default"]) in decision["timings_s"]
+        want = ring.build_ring_tiebreak(mesh, chunk_agents=None)(*args)
+        for name, g, w in zip(got._fields, got, want):
+            np.testing.assert_array_equal(
+                np.asarray(g), np.asarray(w), err_msg=name
+            )
+
+
 class TestSlotBucket:
     def test_bucket_pads_to_sublane_multiple(self):
         from bayesian_consensus_engine_tpu.pipeline import (

@@ -540,6 +540,46 @@ class TestIngestLeg:
         assert "e2e_ingest" in bench.DEVICE_LEG_ORDER
 
 
+class TestRingMemoryLeg:
+    """ISSUE-9's ``e2e_ring_memory`` at --fast shapes: the chunked vs
+    unchunked tie-break A/B with its AOT ``memory_analysis()`` capture
+    (``compiled_temp_bytes``/``arg_bytes``), the no-losing-trial fold,
+    and the fused co-resident program's footprint next to the two
+    programs it replaces. Bit-parity of the paths is pinned by
+    tests/test_ring.py; this pins the LEG contract."""
+
+    def test_fast_leg_reports_memory_ab(self):
+        result = bench.run_leg_inprocess("e2e_ring_memory", fast=True)
+        for side in ("unchunked", "chunked"):
+            for key in ("wall_s", "markets_per_sec", "compiled_temp_bytes",
+                        "arg_bytes", "wall_s_band", "repeats"):
+                assert key in result[side], (side, key)
+        # The diet: chunked temps strictly below unchunked, same args.
+        assert (
+            result["chunked"]["compiled_temp_bytes"]
+            < result["unchunked"]["compiled_temp_bytes"]
+        )
+        assert (
+            result["chunked"]["arg_bytes"]
+            == result["unchunked"]["arg_bytes"]
+        )
+        assert result["temp_ratio"] > 1
+        assert isinstance(result["no_losing_trial"], bool)
+        fused = result["fused_coresident"]
+        for key in ("fused_temp_bytes", "separate_cycle_temp_bytes",
+                    "separate_tiebreak_temp_bytes", "fused_arg_bytes",
+                    "separate_arg_bytes", "session_fused_dispatch_s"):
+            assert key in fused, key
+        # One program per chip: the fused program takes the block ONCE —
+        # its argument footprint undercuts the two separate programs'.
+        assert fused["fused_arg_bytes"] < fused["separate_arg_bytes"]
+        json.dumps(result)
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_ring_memory" in bench.LEGS
+        assert "e2e_ring_memory" in bench.DEVICE_LEG_ORDER
+
+
 class TestOverlapAdjudication:
     """The re-adjudicated e2e_overlap leg (VERDICT r5 #2): min-of-N
     alternating repeats, per-repeat load, a band, and a documented

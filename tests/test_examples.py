@@ -4,9 +4,11 @@ Mirrors the reference's examples-as-documentation role (reference:
 examples/*.py); only the fast scalar examples run here — the device-loop
 examples (settlement_cycle, compact_settlement, distributed_settlement,
 settlement_service, streaming_settlement, batched_consensus,
-fault_tolerant_service, columnar_ingest — the last one's packer parity
-and ingest-wait story is pinned by tests/test_fastpack.py and
-tests/test_serve.py) each pay tens of seconds of XLA compilation and
+fault_tolerant_service, columnar_ingest, coresident_tiebreak — the
+ingest example's packer parity lives in tests/test_fastpack.py and
+tests/test_serve.py; the co-resident tie-break's chunk parity and fused
+session in tests/test_ring.py) each pay tens of seconds of XLA
+compilation and
 are exercised through the library tests instead (streaming_settlement's
 path: tests/test_overlap.py::TestSettleStream and the driver dryrun's
 _dryrun_settle_stream leg; fault_tolerant_service's restart recipe:

@@ -36,6 +36,7 @@ _NEW_FAMILY_IDS = (
     "DT201", "DT202", "DT203",
     "LY301", "LY302", "LY303",
     "SH401",
+    "PL501",
 )
 
 
@@ -177,6 +178,29 @@ _CASES = [
         f"from {PKG}.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS\n"
         "from jax.sharding import PartitionSpec as P\n\n"
         "SPEC = P(MARKETS_AXIS, SOURCES_AXIS)\n",
+    ),
+    (
+        # The grid floor-divides m // tile with no divisibility guard AND
+        # the literal BlockSpec set (4096×4096 f32, double-buffered) blows
+        # the 16 MB scoped-VMEM budget — both halves of the rule fire.
+        # The good twin guards the ragged tail and tiles to a module
+        # constant the checker can resolve.
+        "PL501",
+        f"{PKG}/ops/case.py",
+        "from jax.experimental import pallas as pl\n\n\n"
+        "def build(m, tile):\n"
+        "    grid = (m // tile,)\n"
+        "    big = pl.BlockSpec((4096, 4096), lambda i: (0, i))\n"
+        "    return pl.pallas_call(None, grid=grid, in_specs=[big],\n"
+        "                          out_specs=[big])\n",
+        "from jax.experimental import pallas as pl\n\nTILE = 512\n\n\n"
+        "def build(m, tile):\n"
+        "    if m % tile:\n"
+        "        raise ValueError('ragged markets axis')\n"
+        "    grid = (m // tile,)\n"
+        "    block = pl.BlockSpec((8, TILE), lambda i: (0, i))\n"
+        "    return pl.pallas_call(None, grid=grid, in_specs=[block],\n"
+        "                          out_specs=[block])\n",
     ),
     (
         "F401",

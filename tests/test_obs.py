@@ -155,6 +155,44 @@ class TestHistogramQuantile:
         header = rendered.splitlines()[0]
         assert "p50" in header and "p99" in header
 
+    def test_ledger_peak_mem_min_across_repeats(self, tmp_path):
+        # extras.hbm_peak_bytes folds to the MIN across repeats (the
+        # repeat least polluted by co-resident allocations) and renders
+        # as the stats table's peak_mem column; zero/absent samples (CPU
+        # backends) contribute nothing.
+        path = tmp_path / "mem.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            for peak in (300_000_000, 120_000_000, 0):
+                ledger.record(
+                    "ring.chunked", value=1.0, unit="s",
+                    extras={"hbm_peak_bytes": peak},
+                )
+            ledger.record("plain_leg", value=2.0, unit="s")
+        records = obs.read_ledger(path)
+        summary = obs.summarize(records)
+        assert summary["ring.chunked"]["hbm_peak_bytes"] == 120_000_000
+        assert "hbm_peak_bytes" not in summary["plain_leg"]
+        rendered = obs_ledger.render(records)
+        assert "peak_mem" in rendered.splitlines()[0]
+        assert "120MB" in rendered
+
+    def test_diff_bands_carries_peak_mem_metric(self, tmp_path):
+        def ledger_records(path, peak):
+            with obs.RunLedger(path, run_id="r") as ledger:
+                ledger.record(
+                    "ring", value=1.0, unit="s",
+                    extras={"hbm_peak_bytes": peak},
+                )
+            return obs.read_ledger(path)
+
+        old = ledger_records(tmp_path / "old.jsonl", 300_000_000)
+        new = ledger_records(tmp_path / "new.jsonl", 90_000_000)
+        diff = obs.diff_bands(old, new)
+        metric = diff["ring"]["metrics"]["hbm_peak_bytes"]
+        assert metric == {"old": 300_000_000, "new": 90_000_000}
+        rendered = obs.render_diff(diff)
+        assert "peak_mem 3e+08->9e+07" in rendered
+
     def test_mismatched_hist_layouts_refuse_to_merge(self, tmp_path):
         path = tmp_path / "bad.jsonl"
         with obs.RunLedger(path, run_id="r1") as ledger:

@@ -495,3 +495,328 @@ class TestRingTieBreak:
                 _LABELS[int(np.asarray(result.resolved_by)[row])]
                 == want_diag.tie_resolved_by
             ), f"row {row}"
+
+
+# ---------------------------------------------------------------------------
+# Round 11: the chunked memory diet.
+# ---------------------------------------------------------------------------
+
+
+def _tb_args(m, a, workload, seed=0):
+    """One (M, A) tie-break operand set for a named parity workload."""
+    rng = np.random.default_rng(seed)
+    grid = np.array([0.1, 0.25, 0.5, 0.75, 0.9])
+    pred = rng.choice(grid, (m, a))
+    valid = rng.random((m, a)) < 0.8
+    if workload == "mask_holes":
+        # Dense hole pattern incl. fully-invalid rows (padding markets).
+        valid = rng.random((m, a)) < 0.5
+        valid[0] = False
+    elif workload == "all_tied":
+        # Every agent in one group per market: unanimous everywhere.
+        pred = np.broadcast_to(rng.choice(grid, (m, 1)), (m, a)).copy()
+        valid = np.ones((m, a), dtype=bool)
+    elif workload == "single_agent":
+        # Exactly one valid agent per market: groups of size one.
+        valid = np.zeros((m, a), dtype=bool)
+        valid[np.arange(m), rng.integers(0, a, m)] = True
+    else:
+        assert workload == "random"
+    return (
+        jnp.asarray(pred, jnp.float32),
+        jnp.asarray(rng.uniform(0.1, 2.0, (m, a)), jnp.float32),
+        jnp.asarray(rng.uniform(0, 1, (m, a)), jnp.float32),
+        jnp.asarray(rng.uniform(0, 1, (m, a)), jnp.float32),
+        jnp.asarray(valid),
+    )
+
+
+class TestChunkedParityMatrix:
+    """ISSUE-9 acceptance: chunked output BIT-EQUAL to unchunked, across
+    chunk sizes (1, a ragged 7, an exact divisor, wider-than-the-shard) ×
+    degenerate workloads, on agents-sharded AND markets-sharded meshes.
+    The guarantees this leans on are structural (ops/tiebreak.py module
+    comment): group sums never change their reduction expression with the
+    chunk width, and the winner fold is selection-only over a total
+    order — these tests are the empirical pin."""
+
+    M, A = 16, 32
+
+    @pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (8, 1)])
+    @pytest.mark.parametrize(
+        "workload", ["random", "mask_holes", "all_tied", "single_agent"]
+    )
+    def test_bit_exact_across_chunk_sizes(self, mesh_shape, workload):
+        mesh = make_mesh(mesh_shape)
+        args = _tb_args(self.M, self.A, workload)
+        want = jax.tree.map(
+            np.asarray, build_ring_tiebreak(mesh)(*args)
+        )
+        a_loc = self.A // mesh_shape[1]
+        for chunk in (1, 7, a_loc // 2 or 1, self.A + 5):
+            got = jax.tree.map(
+                np.asarray,
+                build_ring_tiebreak(mesh, chunk_agents=chunk)(*args),
+            )
+            for name, g, w in zip(want._fields, got, want):
+                np.testing.assert_array_equal(
+                    g, w, err_msg=f"{mesh_shape}/{workload}/chunk={chunk}/{name}"
+                )
+
+    def test_chunked_still_matches_scalar(self):
+        # The chunked path through the full scalar-parity gauntlet (the
+        # bit-exact-vs-unchunked matrix alone would be vacuous if both
+        # were wrong together).
+        mesh = make_mesh((1, 8))
+        args = _tb_args(self.M, self.A, "random", seed=3)
+        result = build_ring_tiebreak(mesh, chunk_agents=3)(*args)
+        TestRingTieBreak._assert_rows_match_scalar(
+            result, *[np.asarray(x) for x in args], self.M, self.A
+        )
+
+    def test_empty_market_reports_inf_prediction(self):
+        # A row with no valid agent is padding: inf prediction, -inf
+        # metrics, unanimous label, zero groups (the unchunked path's
+        # historical behaviour, now explicit).
+        mesh = make_mesh((1, 8))
+        args = _tb_args(self.M, self.A, "mask_holes")
+        result = build_ring_tiebreak(mesh, chunk_agents=4)(*args)
+        assert np.asarray(result.prediction)[0] == np.inf
+        assert np.asarray(result.weight_density)[0] == -np.inf
+        assert int(np.asarray(result.resolved_by)[0]) == 0
+        assert int(np.asarray(result.num_groups)[0]) == 0
+
+    def test_bad_chunk_string_rejected(self):
+        mesh = make_mesh((1, 8))
+        args = _tb_args(self.M, self.A, "random")
+        with pytest.raises(ValueError, match="auto"):
+            build_ring_tiebreak(mesh, chunk_agents="wide")(*args)
+
+
+class TestRingMemoryDiet:
+    """The compile-temps ceiling, read from the same AOT
+    ``memory_analysis()`` the bench leg reports. CPU lowering materialises
+    the per-chunk compare mask (TPU fuses it — the on-chip numbers in the
+    bench leg are the acceptance capture), so the tier-1 assertion is the
+    structural one: chunked temps collapse relative to unchunked by ~the
+    chunk fraction, and stay under an absolute ceiling scaled for the CPU
+    materialisation."""
+
+    def _mem(self, mesh, args, chunk):
+        lowered = build_ring_tiebreak(mesh, chunk_agents=chunk).lower(*args)
+        return lowered.compile().memory_analysis()
+
+    def test_chunked_temps_collapse(self):
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        m, a = 64, 1024
+        args = _tb_args(m, a, "random", seed=9)
+        unchunked = self._mem(mesh, args, None)
+        chunked = self._mem(mesh, args, 64)
+        assert (
+            chunked.temp_size_in_bytes
+            < unchunked.temp_size_in_bytes / 8
+        ), (chunked.temp_size_in_bytes, unchunked.temp_size_in_bytes)
+        # Absolute ceiling: per-chunk mask (m·chunk·a bool) + stats, with
+        # ~4× headroom for XLA bookkeeping — the diet holds even where the
+        # compare mask materialises.
+        assert chunked.temp_size_in_bytes <= 24 * 1024 * 1024
+        # Argument blocks are untouched by the diet (same five operands).
+        assert (
+            chunked.argument_size_in_bytes
+            == unchunked.argument_size_in_bytes
+        )
+
+    @pytest.mark.slow
+    def test_stress_shape_compile_temps(self):
+        # The full 2048×10k ISSUE shape, compile-only (running it needs a
+        # TPU; the bench leg carries the on-chip capture). The unchunked
+        # program's temps at this shape are catastrophic on any backend —
+        # the chunked program must be at least an order of magnitude
+        # smaller.
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        args = _tb_args(2048, 10_000, "random", seed=11)
+        chunked = self._mem(mesh, args, 1024)
+        unchunked = self._mem(mesh, args, None)
+        assert (
+            chunked.temp_size_in_bytes
+            < unchunked.temp_size_in_bytes / 8
+        )
+
+
+class TestFusedCycleTieBreak:
+    """build_cycle_tiebreak_loop: consensus+update+tie-break in ONE
+    program against one resident block. The loop half must keep the plain
+    loop's semantics; the tie-break half must equal the standalone ring
+    path fed the same decayed read view."""
+
+    def _slot_major_inputs(self, seed=5):
+        from bayesian_consensus_engine_tpu.parallel import MarketBlockState
+
+        rng = np.random.default_rng(seed)
+        m, k = 32, 16
+        # Exactly-representable values: the standalone path reduces the
+        # agents axis in (M, A) layout, the fused one in (K, M) — equal
+        # sums need exactly-representable weights (a 1-ulp association
+        # difference between layouts is legal; within a layout the chunk
+        # matrix is the bit-exact contract).
+        grid = np.array([0.125, 0.25, 0.5, 0.75, 0.875])
+        probs = jnp.asarray(rng.choice(grid, (k, m)), jnp.float32)
+        mask = jnp.asarray(rng.random((k, m)) < 0.8)
+        outcome = jnp.asarray(rng.random(m) < 0.5)
+        state = MarketBlockState(
+            reliability=jnp.asarray(
+                rng.choice([0.25, 0.5, 0.625, 0.75], (k, m)), jnp.float32
+            ),
+            confidence=jnp.asarray(
+                rng.choice([0.25, 0.5, 0.75], (k, m)), jnp.float32
+            ),
+            updated_days=jnp.zeros((k, m), jnp.float32),
+            exists=jnp.asarray(rng.random((k, m)) < 0.6),
+        )
+        return probs, mask, outcome, state, jnp.float32(401.0)
+
+    @pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4)])
+    def test_fused_equals_loop_plus_standalone(self, mesh_shape):
+        from bayesian_consensus_engine_tpu.parallel import (
+            build_cycle_loop,
+            build_cycle_tiebreak_loop,
+        )
+        from bayesian_consensus_engine_tpu.parallel.sharded import read_phase
+
+        mesh = make_mesh(mesh_shape)
+        probs, mask, outcome, state, now0 = self._slot_major_inputs()
+        fused = build_cycle_tiebreak_loop(mesh, chunk_agents=5, donate=False)
+        st_f, cons_f, tiebreak = fused(probs, mask, outcome, state, now0, 3)
+        st_p, cons_p = build_cycle_loop(mesh, donate=False)(
+            probs, mask, outcome, state, now0, 3
+        )
+        np.testing.assert_allclose(
+            np.asarray(cons_f), np.asarray(cons_p), rtol=2e-6, atol=1e-6
+        )
+        for got, want in zip(st_f, st_p):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+        # The tie-break half: slot-major fused output == standalone (M, A)
+        # path fed the same pre-update decayed read (weight = read_rel).
+        read_rel, read_conf = read_phase(state, now0)
+        standalone = build_ring_tiebreak(mesh, chunk_agents=5)(
+            probs.T, read_rel.T, read_conf.T, read_rel.T, mask.T
+        )
+        for name, got, want in zip(
+            tiebreak._fields, tiebreak, standalone
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want), err_msg=name
+            )
+
+    def test_session_rejects_unknown_chunk_string(self):
+        # "auto" is the STANDALONE builder's knob; the session entry must
+        # refuse it with a pointer, not die as int('auto') mid-trace.
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+            build_settlement_plan,
+        )
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(
+            store, [("m-0", [{"sourceId": "s-0", "probability": 0.5}])],
+            num_slots=4,
+        )
+        with ShardedSettlementSession(store, plan, make_mesh()) as session:
+            with pytest.raises(ValueError, match="build_ring_tiebreak"):
+                session.settle_with_tiebreak(
+                    [True], now=21_900.0, chunk_agents="auto"
+                )
+
+    def test_session_settle_with_tiebreak(self):
+        """The co-resident session entry: settlement bytes equal a plain
+        settle's, and the tie-break diagnoses the batch against the
+        scalar contract (cold store: every agent at the cold-start
+        reliability, so ties resolve on the smallest prediction)."""
+        from bayesian_consensus_engine_tpu.models.tiebreak import (
+            AgentSignal,
+            DeterministicTieBreaker,
+        )
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+            build_settlement_plan,
+        )
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+        from bayesian_consensus_engine_tpu.utils.config import (
+            DEFAULT_CONFIDENCE,
+            DEFAULT_RELIABILITY,
+        )
+
+        rng = np.random.default_rng(7)
+        grid = np.array([0.125, 0.25, 0.5, 0.75, 0.875])
+        markets, srcs = 12, 5
+        payloads = [
+            (
+                f"m-{i}",
+                [
+                    {
+                        "sourceId": f"s-{j}",
+                        "probability": float(rng.choice(grid)),
+                    }
+                    for j in range(srcs)
+                ],
+            )
+            for i in range(markets)
+        ]
+        outcomes = list(rng.random(markets) < 0.5)
+        mesh = make_mesh()
+
+        stores = [TensorReliabilityStore() for _ in range(2)]
+        plans = [
+            build_settlement_plan(s, payloads, num_slots=8) for s in stores
+        ]
+        with ShardedSettlementSession(stores[0], plans[0], mesh) as plain:
+            plain_result = plain.settle(outcomes, steps=2, now=21_900.0)
+        with ShardedSettlementSession(stores[1], plans[1], mesh) as fused:
+            fused_result, tiebreak = fused.settle_with_tiebreak(
+                outcomes, steps=2, now=21_900.0, chunk_agents=3
+            )
+
+        np.testing.assert_allclose(
+            np.asarray(fused_result.consensus),
+            np.asarray(plain_result.consensus),
+            rtol=2e-6,
+        )
+        # Settlement state bytes: the fused entry shares settle's commit
+        # path, and the elementwise update stays exact across programs.
+        rows = np.arange(stores[0].live_row_count())
+        for got, want in zip(
+            stores[1].host_rows(rows), stores[0].host_rows(rows)
+        ):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+        # Tie-break vs scalar: a cold store reads every signalling slot at
+        # the cold-start defaults.
+        breaker = DeterministicTieBreaker()
+        for row, (_key, slot_payloads) in enumerate(payloads):
+            agents = [
+                AgentSignal(
+                    s["sourceId"],
+                    s["probability"],
+                    DEFAULT_CONFIDENCE,
+                    weight=DEFAULT_RELIABILITY,
+                    reliability_score=DEFAULT_RELIABILITY,
+                )
+                for s in slot_payloads
+            ]
+            want_pred, want_diag = breaker.resolve(agents)
+            assert np.asarray(tiebreak.prediction)[row] == pytest.approx(
+                want_pred, abs=1e-6
+            ), f"market {row}"
+            assert (
+                _LABELS[int(np.asarray(tiebreak.resolved_by)[row])]
+                == want_diag.tie_resolved_by
+            ), f"market {row}"
+            assert int(np.asarray(tiebreak.num_groups)[row]) == len(
+                want_diag.groups
+            )
