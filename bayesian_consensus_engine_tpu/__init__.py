@@ -14,8 +14,11 @@ Layers (bottom → top), mirroring the reference's layer map but TPU-first:
   state/     reliability stores: SQLite (durable/compat), device-tensor (HBM)
   models/    market orchestration, cross-market aggregation, tie-breaking
   parallel/  device mesh + shard_map sharded consensus/update step
+  analytics/ additive device-resident analytics: uncertainty bands +
+             correlated-market consensus (graph-propagated)
   pipeline   payloads → plan → device settle → store → SQLite, end to end
              (sessions, the streamed service loop, mesh/band sharding)
+  serve/     online micro-batch coalescing front end over the session
   cli        command-line surface (byte-compatible with the reference CLI)
 
 The scalar path imports no JAX; array paths import it lazily.

@@ -1,0 +1,47 @@
+"""analytics/ — device-resident uncertainty bands and correlated-market
+consensus (round 12).
+
+The engine's reference surface emits POINT consensus; this subsystem is
+the additive analytics tier above it — per-market credible intervals
+(reliability-weighted dispersion, ``analytics/bands.py`` over
+``ops/uncertainty.py``) and graph-structured cross-market coupling
+(``analytics/graph.py`` over ``ops/propagate.py``) — designed to ride
+the SAME resident reliability block the settlement loop already holds:
+``ShardedSettlementSession.settle_with_analytics`` runs cycles +
+tie-break + bands (+ an optional graph sweep) as ONE compiled program
+per chip, and the serving path (``serve/``) exposes it per request via
+``ConsensusService(analytics=...)``.
+
+The whole tier is PURE-ADDITIVE by contract: golden fixtures, settle
+results, store state, journal epoch payloads, and SQLite bytes are
+byte-identical with analytics on or off (the obs on/off contract,
+applied to analytics; pinned by tests/test_analytics.py). Layer map:
+above ops/parallel (it builds on their kernels and mesh machinery),
+below pipeline/serve (which orchestrate it).
+"""
+
+from bayesian_consensus_engine_tpu.analytics.bands import (
+    AnalyticsOptions,
+    build_band_program,
+)
+from bayesian_consensus_engine_tpu.analytics.graph import MarketGraph
+from bayesian_consensus_engine_tpu.ops.propagate import (
+    DEFAULT_DAMPING,
+    DEFAULT_SWEEP_STEPS,
+)
+from bayesian_consensus_engine_tpu.ops.uncertainty import (
+    DEFAULT_CHUNK_SLOTS,
+    UncertaintyBands,
+    Z_95,
+)
+
+__all__ = [
+    "AnalyticsOptions",
+    "DEFAULT_CHUNK_SLOTS",
+    "DEFAULT_DAMPING",
+    "DEFAULT_SWEEP_STEPS",
+    "MarketGraph",
+    "UncertaintyBands",
+    "Z_95",
+    "build_band_program",
+]

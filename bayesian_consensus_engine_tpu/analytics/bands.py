@@ -1,0 +1,224 @@
+"""Sharded surfaces for the uncertainty-band kernel.
+
+Two things live here, one level above the kernel
+(``ops/uncertainty.py``) and below the orchestration tier:
+
+* :func:`build_band_program` — the STANDALONE mesh program: bands from a
+  slot-major (K, M) probability/mask block and a resident
+  ``MarketBlockState``, reading the same decayed reliabilities the
+  consensus weighs with (``parallel.sharded.read_phase`` at the given
+  ``now``). This is the two-program shape the fused resident path
+  (``ShardedSettlementSession.settle_with_analytics``) exists to beat:
+  dispatching it after a settle re-sends the whole block argument list a
+  second time — the ``e2e_analytics`` leg's co-residency A/B measures
+  exactly that arg-bytes double-pay.
+* :class:`AnalyticsOptions` — the one bag of analytics knobs the session
+  entry, the serve driver, and ``ConsensusService(analytics=...)`` all
+  accept, so the opt-in surface is a single object rather than five
+  keyword arguments threaded through three layers.
+
+``chunk_slots="auto"`` resolves through the honesty-guarded process
+:class:`~.utils.autotune.ShapeTuner` (knob ``band_chunk_slots``), racing
+the power-of-two candidate ladder against the recorded
+:data:`~.ops.uncertainty.DEFAULT_CHUNK_SLOTS` on the same clock —
+disabled (the default) it resolves straight to the recorded default.
+Every resolution is rounded down to a power of two, so the tuner can
+never buy speed at the price of the tree-alignment bit contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bayesian_consensus_engine_tpu.analytics.graph import MarketGraph
+from bayesian_consensus_engine_tpu.ops.uncertainty import (
+    DEFAULT_CHUNK_SLOTS,
+    UncertaintyBands,
+    Z_95,
+    band_math,
+)
+from bayesian_consensus_engine_tpu.parallel._jax_compat import shard_map
+from bayesian_consensus_engine_tpu.parallel.mesh import (
+    MARKETS_AXIS,
+    SOURCES_AXIS,
+)
+from bayesian_consensus_engine_tpu.parallel.sharded import (
+    MarketBlockState,
+    read_phase,
+)
+
+#: Candidate chunk widths the shape tuner races (clamped to the shard
+#: width at resolve time). Module constant so tests can monkeypatch the
+#: ladder down to toy shapes — the same idiom as parallel/ring.py.
+_CHUNK_CANDIDATES = (128, 256, 512, 1024, 2048)
+
+
+@dataclass(frozen=True)
+class AnalyticsOptions:
+    """Per-call analytics configuration (session, driver, and service).
+
+    ``chunk_slots``/``chunk_agents`` take an int, ``None`` (unchunked),
+    or ``"default"`` (the recorded defaults — the memory diet is ON by
+    default; co-residency is the point). ``graph=None`` skips the
+    propagation sweep entirely (no neighbour arguments enter the fused
+    program); ``tiebreak=False`` likewise drops the ring tie-break
+    stage from the compiled program — a service that only wants bands
+    pays for neither the ring pass nor its temps. ``z`` scales the
+    credible interval (default two-sided 95%).
+    """
+
+    z: float = Z_95
+    chunk_slots: "int | str | None" = "default"
+    chunk_agents: "int | str | None" = "default"
+    graph: Optional[MarketGraph] = None
+    precision: int = 6
+    tiebreak: bool = True
+
+
+def _tuned_chunk_slots(mesh: Mesh, z: float, shape: tuple) -> "int | None":
+    """Resolve ``chunk_slots="auto"`` for one slot-major (K, M) shape.
+
+    Measured once per (shape, mesh, device-kind) through the process
+    tuner; the honesty guard races every candidate against the recorded
+    default on the same clock and ships the default unless something
+    strictly beat it.
+    """
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.utils.autotune import (
+        default_tuner,
+        time_best_of,
+    )
+
+    slots, markets = int(shape[0]), int(shape[1])
+    k_loc = max(1, slots // mesh.shape[SOURCES_AXIS])
+    default = min(DEFAULT_CHUNK_SLOTS, k_loc)
+    candidates = [c for c in _CHUNK_CANDIDATES if c < k_loc]
+    candidates.append(k_loc)  # the unchunked reference rides the race
+    candidates = [c for c in candidates if c != default]
+    if not candidates:
+        return default
+
+    def measure(chunk: int) -> float:
+        import jax.numpy as jnp
+
+        fn = _compile_band_program(mesh, z, chunk, has_exists=True)
+        rng = np.random.default_rng(23)
+        probs = jnp.asarray(rng.random((slots, markets)), jnp.float32)
+        mask = jnp.asarray(rng.random((slots, markets)) < 0.9)
+        state = MarketBlockState(
+            reliability=jnp.asarray(
+                rng.uniform(0.1, 1.0, (slots, markets)), jnp.float32
+            ),
+            confidence=jnp.asarray(
+                rng.uniform(0.0, 1.0, (slots, markets)), jnp.float32
+            ),
+            updated_days=jnp.zeros((slots, markets), jnp.float32),
+            exists=jnp.asarray(rng.random((slots, markets)) < 0.7),
+        )
+        now = jnp.asarray(400.0, jnp.float32)
+
+        def run() -> None:
+            out = fn(probs, mask, state, now)
+            np.asarray(out.mean)  # fence: force the result to host
+
+        return time_best_of(run, repeats=2, warmup=1)
+
+    return default_tuner().tune(
+        "band_chunk_slots",
+        (slots, markets, *(int(s) for s in mesh.devices.shape)),
+        candidates,
+        measure,
+        default,
+    )
+
+
+def _compile_band_program(
+    mesh: Mesh, z: float, chunk_slots: "int | None", has_exists: bool
+):
+    """One jitted slot-major standalone band program for *mesh*."""
+    block = P(SOURCES_AXIS, MARKETS_AXIS)
+    market = P(MARKETS_AXIS)
+    n_sources = mesh.shape[SOURCES_AXIS]
+
+    def math(probs, mask, state, now):
+        read_rel, _ = read_phase(state, now)
+        return band_math(
+            probs, mask, read_rel,
+            axis_name=SOURCES_AXIS,
+            axis_size=n_sources,
+            z=z,
+            chunk_slots=chunk_slots,
+            agents_last=False,  # slot-major: slots on axis 0
+        )
+
+    state_spec = MarketBlockState(
+        block, block, block, block if has_exists else None
+    )
+    fn = shard_map(
+        math,
+        mesh=mesh,
+        in_specs=(block, block, state_spec, P()),
+        out_specs=UncertaintyBands(*([market] * 6)),
+        check_vma=False,  # the tree fold defeats the vma checker
+    )
+    # Never donates: the standalone program reads the SAME resident
+    # block a subsequent settle will donate — the whole point of the
+    # fused alternative is that this program must not own anything.
+    return jax.jit(fn)
+
+
+def build_band_program(
+    mesh: Mesh,
+    z: float = Z_95,
+    chunk_slots: "int | str | None" = None,
+):
+    """Standalone sharded band program over a resident state block.
+
+    ``bands(probs, mask, state, now) -> UncertaintyBands`` on slot-major
+    (K, M) blocks sharded ``P(sources, markets)`` — the session layout —
+    with per-market outputs ``P(markets)``. ``now`` is the scalar read
+    day: weights are the decayed reliabilities the consensus reduction
+    would use at the same instant (cold slots read the cold-start
+    prior). ``chunk_slots``: ``None`` unchunked, an int (power-of-two
+    clamped), or ``"auto"`` via the shape tuner. Outputs bit-identical
+    at every setting (tests/test_analytics.py). Exposes ``.lower`` for
+    AOT ``memory_analysis()`` captures.
+    """
+    compiled: dict = {}
+
+    def resolve(shape) -> "int | None":
+        if chunk_slots == "auto":
+            return _tuned_chunk_slots(mesh, z, shape)
+        if isinstance(chunk_slots, str):
+            raise ValueError(
+                f"chunk_slots={chunk_slots!r}: the only supported string "
+                "is 'auto'"
+            )
+        return chunk_slots
+
+    def program(shape, has_exists):
+        key = (resolve(shape), has_exists)
+        fn = compiled.get(key)
+        if fn is None:
+            fn = compiled[key] = _compile_band_program(
+                mesh, z, key[0], has_exists
+            )
+        return fn
+
+    def bands(probs, mask, state, now):
+        return program(probs.shape, state.exists is not None)(
+            probs, mask, state, now
+        )
+
+    def lower(probs, mask, state, now):
+        return program(probs.shape, state.exists is not None).lower(
+            probs, mask, state, now
+        )
+
+    bands.lower = lower
+    return bands

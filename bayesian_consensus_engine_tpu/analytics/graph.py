@@ -1,0 +1,221 @@
+"""`MarketGraph` — the host-side market-dependency graph behind the
+correlated-consensus sweep.
+
+An edge ``(market, depends_on, weight)`` declares that *market*'s
+consensus should be pulled toward *depends_on*'s, with relative
+strength *weight* — the dependency structure of composite/constituent
+markets ("Graphical Representations of Consensus Belief", PAPERS.md).
+The graph is built ONCE from an edge list, interned and CSR-compacted
+with the same machinery the signal topology uses (``core.batch``:
+first-seen id interning, market-major offsets/indices arrays), and then
+ALIGNED per plan: :meth:`align` maps node ids onto a plan's market rows
+and pads the CSR rows to a dense static ``(markets, max_degree)``
+neighbour block — the shape :func:`~.ops.propagate.damped_sweep_math`
+gathers from on device.
+
+**Fingerprints.** :attr:`fingerprint` is the order-sensitive digest of
+the graph (node table, raw edge order, weights, damping, sweep depth) —
+the graph-side extension of :func:`~.core.batch.topology_fingerprint`:
+:meth:`extended_fingerprint` folds a plan's topology digest together
+with the graph's, so any cache keyed on it (the session's aligned
+neighbour blocks, a fused-program registry) reuses across
+probability-only refreshes and misses the moment either the signal
+topology OR the graph changes. Edge reordering changes the digest by
+contract, mirroring the float-summation-order rule of the topology
+fingerprint (the neighbour accumulation order follows edge order).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Sequence, Tuple
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu.core.batch import encode_source_ids
+from bayesian_consensus_engine_tpu.ops.propagate import (
+    DEFAULT_DAMPING,
+    DEFAULT_SWEEP_STEPS,
+)
+
+Edge = Tuple[str, str, float]
+
+
+class MarketGraph:
+    """Immutable CSR market-dependency graph + sweep configuration.
+
+    Build with :meth:`from_edges`. ``damping`` (λ) and ``steps`` are
+    part of the graph object — they parameterise the compiled sweep, so
+    carrying them here keeps "one graph, one program" true.
+    """
+
+    __slots__ = (
+        "node_ids", "offsets", "indices", "weights",
+        "damping", "steps", "fingerprint",
+    )
+
+    def __init__(
+        self,
+        node_ids: Sequence[str],
+        offsets: np.ndarray,
+        indices: np.ndarray,
+        weights: np.ndarray,
+        damping: float,
+        steps: int,
+        fingerprint: bytes,
+    ) -> None:
+        self.node_ids = list(node_ids)
+        self.offsets = offsets
+        self.indices = indices
+        self.weights = weights
+        self.damping = float(damping)
+        self.steps = int(steps)
+        self.fingerprint = fingerprint
+        for array in (self.offsets, self.indices, self.weights):
+            array.setflags(write=False)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_edges(
+        cls,
+        edges: Iterable[Edge],
+        damping: float = DEFAULT_DAMPING,
+        steps: int = DEFAULT_SWEEP_STEPS,
+    ) -> "MarketGraph":
+        """Build from ``(market_id, depends_on_id, weight)`` triples.
+
+        Node ids intern first-seen (the same discipline as source-id
+        interning — ``core.batch.encode_source_ids`` does the pass);
+        edges group market-major in a stable sort, so each market's
+        neighbour order is its submission order. Self-edges and
+        non-positive weights are rejected: a zero-weight edge is an
+        absent edge, and a self-edge would double-count the damping
+        term.
+        """
+        edges = list(edges)
+        if not 0.0 <= damping <= 1.0:
+            raise ValueError(f"damping must be in [0, 1], got {damping}")
+        if steps < 0:
+            raise ValueError(f"steps must be >= 0, got {steps}")
+        flat_ids: list[str] = []
+        weights = np.empty(len(edges), dtype=np.float64)
+        for i, (src, dst, weight) in enumerate(edges):
+            if src == dst:
+                raise ValueError(f"self-edge on {src!r}")
+            if not weight > 0.0:
+                raise ValueError(
+                    f"edge ({src!r}, {dst!r}) weight must be > 0, "
+                    f"got {weight}"
+                )
+            flat_ids.append(src)
+            flat_ids.append(dst)
+            weights[i] = weight
+        codes = encode_source_ids(flat_ids)
+        src_codes = codes.codes[0::2]
+        dst_codes = codes.codes[1::2]
+        num_nodes = len(codes.table)
+        # Market-major CSR in first-seen node order; a STABLE sort keeps
+        # each node's neighbours in edge-submission order (the
+        # order-sensitivity contract).
+        order = np.argsort(src_codes, kind="stable")
+        indices = np.ascontiguousarray(dst_codes[order], dtype=np.int32)
+        csr_weights = np.ascontiguousarray(weights[order])
+        offsets = np.searchsorted(
+            src_codes[order], np.arange(num_nodes + 1)
+        ).astype(np.int64)
+
+        digest = hashlib.blake2b(digest_size=16)
+        digest.update(
+            np.asarray(
+                [num_nodes, len(edges), steps], np.int64
+            ).tobytes()
+        )
+        digest.update(np.float64(damping).tobytes())
+        digest.update(
+            np.fromiter(
+                map(len, codes.table), np.int64, len(codes.table)
+            ).tobytes()
+        )
+        digest.update("".join(codes.table).encode("utf-8"))
+        # Raw (pre-sort) edge order: reordering MUST miss.
+        digest.update(np.ascontiguousarray(src_codes).tobytes())
+        digest.update(np.ascontiguousarray(dst_codes).tobytes())
+        digest.update(weights.tobytes())
+        return cls(
+            codes.table, offsets, indices, csr_weights,
+            damping, steps, digest.digest(),
+        )
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_ids)
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.indices.shape[0])
+
+    def extended_fingerprint(self, topology_digest: "bytes | None") -> bytes:
+        """Fold a plan's topology digest with the graph's — the combined
+        reuse key for anything cached per (signal topology, graph)."""
+        digest = hashlib.blake2b(digest_size=16)
+        digest.update(topology_digest or b"\x00")
+        digest.update(self.fingerprint)
+        return digest.digest()
+
+    # -- per-plan alignment --------------------------------------------------
+
+    def align(
+        self,
+        market_keys: Sequence[str],
+        padded_total: "int | None" = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Dense neighbour blocks for one batch's market universe.
+
+        Maps graph nodes onto *market_keys* positions (row order — the
+        global padded markets axis of the sharded layout) and pads each
+        present market's CSR row to the batch's max degree:
+        ``(neighbor_idx i32[T, D], neighbor_w f32[T, D])`` with
+        ``T = padded_total or len(market_keys)`` and ``-1`` in unused
+        lanes. Edges touching a market absent from this batch are
+        dropped — the sweep couples only markets that exist in the
+        block it runs against.
+        """
+        total = len(market_keys) if padded_total is None else padded_total
+        if total < len(market_keys):
+            raise ValueError(
+                f"padded_total {total} < {len(market_keys)} markets"
+            )
+        row_of = {key: i for i, key in enumerate(market_keys)}
+        rows: list[tuple[int, np.ndarray, np.ndarray]] = []
+        max_degree = 1
+        for code, node in enumerate(self.node_ids):
+            row = row_of.get(node)
+            if row is None:
+                continue
+            lo, hi = int(self.offsets[code]), int(self.offsets[code + 1])
+            nb_rows = []
+            nb_weights = []
+            for dst, weight in zip(
+                self.indices[lo:hi], self.weights[lo:hi]
+            ):
+                dst_row = row_of.get(self.node_ids[int(dst)])
+                if dst_row is None:
+                    continue
+                nb_rows.append(dst_row)
+                nb_weights.append(weight)
+            if nb_rows:
+                rows.append((
+                    row,
+                    np.asarray(nb_rows, dtype=np.int32),
+                    np.asarray(nb_weights, dtype=np.float32),
+                ))
+                max_degree = max(max_degree, len(nb_rows))
+        neighbor_idx = np.full((total, max_degree), -1, dtype=np.int32)
+        neighbor_w = np.zeros((total, max_degree), dtype=np.float32)
+        for row, nb_rows, nb_weights in rows:
+            neighbor_idx[row, : len(nb_rows)] = nb_rows
+            neighbor_w[row, : len(nb_rows)] = nb_weights
+        return neighbor_idx, neighbor_w

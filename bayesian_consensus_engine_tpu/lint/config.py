@@ -41,12 +41,18 @@ LAYERS: dict[str, int] = {
     "state": 3,
     "models": 4,
     "parallel": 5,
+    # analytics sits between the device tier and orchestration: it
+    # builds on ops kernels + parallel's mesh/state machinery (bands,
+    # graph sweeps) and is ORCHESTRATED by pipeline/serve (the fused
+    # session entry, the service's analytics= mode) — so it must be
+    # importable from above and must never import upward.
+    "analytics": 6,
     # pipeline and serve share a layer: settle_stream runs on the serve
     # layer's SessionDriver while serve's coalescer builds plans through
     # pipeline — one orchestration tier, two faces (batch and online).
-    "pipeline": 6,
-    "serve": 6,
-    "cli": 7,
+    "pipeline": 7,
+    "serve": 7,
+    "cli": 8,
     # The root facade re-exports for users; nothing inside imports it.
     "__init__": 99,
 }
@@ -68,10 +74,16 @@ LAYER_IMPORT_OVERRIDES: dict[str, frozenset[str]] = {
 #: timer. The pure-math layers (``ops``, ``parallel``, ``core``,
 #: ``models``, ``utils``) must stay instrumentation-free — a kernel
 #: module that grows a host-side timing dependency is a kernel module
-#: one refactor away from a host sync. bench/scripts/tests live outside
+#: one refactor away from a host sync. ``analytics`` is on the allowed
+#: side of the line (its surfaces are orchestration-adjacent: graph
+#: alignment, tuner resolution), but the analytics KERNELS
+#: (``ops/uncertainty.py``, ``ops/propagate.py``) live in ``ops`` and so
+#: stay instrumentation-free like every other kernel — the round-12
+#: decision that keeps the bands math timeable without ever being able
+#: to time itself. bench/scripts/tests live outside
 #: the package and are unconstrained.
 OBS_ALLOWED_IMPORTERS: frozenset[str] = frozenset(
-    {"obs", "pipeline", "serve", "state", "cli", "__init__"}
+    {"obs", "pipeline", "serve", "state", "cli", "analytics", "__init__"}
 )
 
 #: Deliberate exceptions to the layer map: (importer_segment,

@@ -47,7 +47,12 @@ from typing import Dict, Optional
 #: resident sharded session carrying its device block onto a new plan's
 #: layout after a topology miss (host traffic scales with rows entering
 #: the active set — the steady-state topology HIT records nothing here);
-#: ``settle_dispatch`` is the unfenced kernel dispatch; ``fetch`` is the
+#: ``settle_dispatch`` is the unfenced kernel dispatch; ``analytics`` is
+#: the analytics tier's own overhead beside a fused dispatch — graph
+#: alignment/upload and fused-program resolution; the kernel time stays
+#: on ``settle_dispatch`` and the shared preamble/commit stay where
+#: plain ``settle`` leaves them (exclusive nesting, so the additive sum
+#: still ≡ wall); ``fetch`` is the
 #: deferred device→host merge; ``journal_fsync`` is the durability
 #: write+fsync (on the caller's thread only — an async epoch's fsync runs
 #: on a worker thread, which by design records nothing);
@@ -61,6 +66,7 @@ PHASES = (
     "upload",
     "state_adopt",
     "settle_dispatch",
+    "analytics",
     "fetch",
     "journal_fsync",
     "journal_async_wait",

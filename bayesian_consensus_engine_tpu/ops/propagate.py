@@ -1,0 +1,102 @@
+"""Correlated-market consensus propagation — a damped sweep over a
+market-dependency graph, as dense gather arithmetic.
+
+Markets are not independent: a constituent market's consensus carries
+information about the composites that depend on it ("Graphical
+Representations of Consensus Belief", PAPERS.md). This module is the
+device half of that coupling: a FIXED-ITERATION damped relaxation
+
+    c'_i = (1 − λ)·c_i + λ · (Σ_j w_ij·c_j) / (Σ_j w_ij)
+
+iterated ``steps`` times over a dense per-row neighbour block — the
+market-graph analogue of one synchronous belief-propagation sweep per
+iteration, with damping λ in place of message normalisation. No
+sampler, no sparse scatter: the CSR edge structure is padded host-side
+(analytics/graph.py) to a static ``(markets, max_degree)`` neighbour
+index/weight block, so each iteration is one gather + two masked
+reductions — embarrassingly parallel over the markets axis except for
+one ``all_gather`` of the tiny per-market vector when that axis is
+sharded.
+
+Semantics at the edges of the domain:
+
+* ``neighbor_idx < 0`` lanes are padding (rows with fewer than
+  ``max_degree`` dependencies) — they contribute nothing.
+* A NaN neighbour (a market that had no signalling slot this batch, or
+  a padding row of the sharded axis) is EXCLUDED from the neighbourhood
+  mean rather than poisoning it; a row with no finite neighbour (or no
+  edges) keeps its own value untouched, NaN included.
+* The sweep is an ADDITIVE analytics output: the settle's point
+  consensus and the reliability state are never written back from here
+  (the byte-parity contract of the analytics tier).
+
+Determinism: ``steps``, λ, and ``max_degree`` are static; every
+reduction is a fixed-width row-local sum, and the gathered vector is
+the same on every device — so the sweep is a pure bit-stable function
+of (values, neighbor_idx, neighbor_w) on any mesh factorisation
+(pinned by tests/test_analytics.py). Layer 1 (ops): no obs, no clock,
+explicit dtypes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+#: Recorded default damping: hold 50% of a market's own consensus per
+#: sweep step. A plain float (no backend touch at import, LY302).
+DEFAULT_DAMPING = 0.5
+
+#: Recorded default sweep depth: two synchronous iterations carry a
+#: neighbour-of-neighbour influence without letting long cycles ring.
+DEFAULT_SWEEP_STEPS = 2
+
+
+def damped_sweep_math(
+    values: Array,        # f32[M_loc] this shard's per-market values
+    neighbor_idx: Array,  # i32[M_loc, D] GLOBAL market positions; -1 pad
+    neighbor_w: Array,    # f32[M_loc, D] edge weights
+    *,
+    damping: float = DEFAULT_DAMPING,
+    steps: int = DEFAULT_SWEEP_STEPS,
+    axis_name: "str | None" = None,
+) -> Array:
+    """Run *steps* damped propagation sweeps; returns the relaxed values.
+
+    Inside ``shard_map`` the markets axis may be sharded over
+    *axis_name*: each iteration all-gathers the per-market vector
+    (tiled, so positions stay global) and gathers neighbours from the
+    full copy — ``neighbor_idx`` entries index the GLOBAL padded
+    markets axis. ``axis_name=None`` is the single-shard form (values
+    already global).
+    """
+    f32 = jnp.float32
+    values = values.astype(f32)
+    weights = jnp.where(
+        neighbor_idx >= 0, neighbor_w.astype(f32), f32(0.0)
+    )
+    lam = f32(damping)
+    keep = f32(1.0) - lam
+
+    def body(_, v):
+        full = (
+            jax.lax.all_gather(v, axis_name, tiled=True)
+            if axis_name is not None
+            else v
+        )
+        nb = full[jnp.clip(neighbor_idx, 0)]
+        ok = (neighbor_idx >= 0) & jnp.isfinite(nb)
+        w = jnp.where(ok, weights, f32(0.0))
+        wsum = jnp.sum(w, axis=-1)
+        wval = jnp.sum(w * jnp.where(ok, nb, f32(0.0)), axis=-1)
+        mixes = (wsum > 0) & jnp.isfinite(v)
+        blended = keep * v + lam * (
+            wval / jnp.where(wsum > 0, wsum, f32(1.0))
+        )
+        return jnp.where(mixes, blended, v)
+
+    if steps <= 0:
+        return values
+    return jax.lax.fori_loop(0, steps, body, values)

@@ -1,0 +1,248 @@
+"""Per-market uncertainty bands — reliability-weighted dispersion as an
+array program.
+
+The engine's consensus is a reliability-weighted mean; this module turns
+the SPREAD of the same weighted signal population into a credible
+interval per market, batched over the whole (markets × slots) block in
+one pass — the MRF-inference-with-UQ prescription (PAPERS.md): make the
+uncertainty an array program over the state you already hold, not a
+sampler loop beside it. Per market row:
+
+  Σw, Σw·p, Σw·p², Σw²  →  mean, weighted population variance,
+  Kish effective sample size n_eff = (Σw)²/Σw², standard error
+  sqrt(var / n_eff), and the z-scaled band [mean − z·se, mean + z·se]
+  clipped to [0, 1].
+
+The weights are the DECAYED read reliabilities — exactly the weights the
+consensus reduction gives the same slots, read at the same ``now`` — so
+the band is a statement about the consensus the settle reports, not a
+parallel model.
+
+**The accumulation-order contract.** The four weighted sums are computed
+with a FIXED balanced binary tree over the slots axis, padded to the
+next power of two with exact zeros (masked and padded lanes contribute
+``+0.0``, and ``x + 0.0 ≡ x`` in IEEE-754, so padding never moves a
+bit). This buys two bit-level invariances that a bare ``jnp.sum``
+cannot:
+
+* **Chunk invariance, by construction** — ``chunk_slots`` (the
+  ``chunk_agents``-style memory knob: per-step temps drop from
+  O(slots × markets) to O(chunk × markets)) is clamped to a power of two
+  dividing the padded width, so every chunk's tree is an internal
+  subtree of the one global tree and the cross-chunk fold (a balanced
+  tree over the chunk roots, accumulated in fixed chunk order 0..n−1)
+  reproduces the remaining upper levels exactly. Any chunk setting,
+  same bits — not an empirical observation, a structural identity.
+* **Mesh invariance when shards stay power-of-two** — each shard
+  reduces its local slots with the same tree and the per-shard roots
+  are all-gathered and folded in fixed device order with the same
+  balanced tree; when the per-shard slot width is a power of two (the
+  bucketed plan default), shard boundaries land on subtree boundaries
+  and every mesh factorisation reproduces the identical global tree.
+
+Deterministic by contract either way (DT-series rules); the bit matrix
+is pinned by tests/test_analytics.py. Layer 1 (ops): no obs, no clock,
+explicit dtypes — the sharded fused program (parallel/sharded.py) and
+the analytics surfaces (analytics/bands.py) both import from here.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+#: Two-sided 95% normal quantile — the default band width. A plain float
+#: (module import must not touch the JAX backend, lint rule LY302).
+Z_95 = 1.959964
+
+#: Recorded default chunk width for the memory-dieted band accumulation
+#: (the bands twin of ops.tiebreak.DEFAULT_CHUNK_AGENTS): wide enough
+#: that per-chunk overhead vanishes, narrow enough that per-step temps
+#: stay tens of MB at the 2048×10k stress shape. Always rounded DOWN to
+#: a power of two at resolve time — the tree-alignment contract above.
+DEFAULT_CHUNK_SLOTS = 1024
+
+
+class UncertaintyBands(NamedTuple):
+    """Per-market credible-interval outputs, one entry per market row.
+
+    ``mean`` is the band's own reliability-weighted mean, computed with
+    the tree accumulation order — equal to the settle's consensus to
+    float tolerance (the settle's reduction keeps its original
+    expression; the point consensus is NEVER replaced by this value).
+    Rows with zero total weight (no signalling slot) report NaN
+    mean/lo/hi and zeroed dispersion, mirroring the consensus NaN
+    convention for empty markets.
+    """
+
+    mean: Array      # f32[M] tree-ordered weighted mean
+    lo: Array        # f32[M] mean − z·stderr, clipped to [0, 1]
+    hi: Array        # f32[M] mean + z·stderr, clipped to [0, 1]
+    stderr: Array    # f32[M] sqrt(variance / n_eff)
+    n_eff: Array     # f32[M] Kish effective sample size (Σw)²/Σw²
+    count: Array     # i32[M] signalling slots (global across shards)
+
+
+def _pow2_at_most(n: int) -> int:
+    """Largest power of two ≤ *n* (n ≥ 1)."""
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def _pow2_at_least(n: int) -> int:
+    """Smallest power of two ≥ *n* (min 1)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def resolve_chunk_slots(chunk_slots: "int | None", width: int) -> int:
+    """Clamp the chunk knob to a power of two dividing the padded width.
+
+    ``None`` (or anything ≥ the padded width) resolves to one full-width
+    chunk — the unchunked reference. Every resolution divides
+    ``_pow2_at_least(width)`` exactly, which is what keeps chunk trees
+    subtree-aligned (see module docstring).
+    """
+    padded = _pow2_at_least(max(width, 1))
+    if chunk_slots is None:
+        return padded
+    return min(_pow2_at_most(max(int(chunk_slots), 1)), padded)
+
+
+def _tree_sum(x: Array, axis: int) -> Array:
+    """Balanced-binary-tree sum over a POWER-OF-TWO axis width.
+
+    The one reduction expression every band sum goes through: add
+    ADJACENT pairs (leaf 2i with 2i+1), log2(width) times. Adjacent
+    pairing — not fold-in-half — is load-bearing: it makes every
+    aligned power-of-two leaf range reduce to exactly one internal node
+    of the global tree, so a chunk's root (and a power-of-two shard's
+    root) slots into the upper levels unchanged. The pairing depends
+    only on the width, never on the values.
+    """
+    width = x.shape[axis]
+    while width > 1:
+        even = jax.lax.slice_in_dim(x, 0, width, stride=2, axis=axis)
+        odd = jax.lax.slice_in_dim(x, 1, width, stride=2, axis=axis)
+        x = even + odd
+        width //= 2
+    return jnp.squeeze(x, axis=axis)
+
+
+def _pad_pow2(x: Array, axis: int, fill) -> Array:
+    """Zero-pad *axis* up to the next power of two (no-op when aligned)."""
+    width = x.shape[axis]
+    padded = _pow2_at_least(max(width, 1))
+    if padded == width:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis % x.ndim] = (0, padded - width)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def band_math(
+    probs: Array,
+    mask: Array,
+    read_rel: Array,
+    *,
+    axis_name: "str | None",
+    axis_size: int,
+    z: float = Z_95,
+    chunk_slots: "int | None" = None,
+    agents_last: bool = True,
+) -> UncertaintyBands:
+    """Credible intervals for one device shard (shard_map body).
+
+    Blocks are ``(M, K)`` with ``agents_last=True`` or slot-major
+    ``(K, M)`` with ``agents_last=False`` (the fused resident program's
+    layout, where the slots axis is sharded over *axis_name* across
+    *axis_size* devices). ``read_rel`` must be the decayed READ
+    reliability — the same per-slot weight the consensus reduction uses
+    at the same ``now`` (``parallel.sharded.read_phase``).
+
+    ``chunk_slots`` bounds the local working set: the shard's slots are
+    consumed in power-of-two-width chunks, each chunk's four weighted
+    sums tree-reduced and parked in a per-market roots buffer that the
+    same tree folds at the end — outputs bit-identical at every setting
+    (see module docstring). ``None`` is one full-width chunk.
+    """
+    f32 = jnp.float32
+    slots_axis = (probs.ndim - 1) if agents_last else 0
+    local_width = probs.shape[slots_axis]
+
+    probs = _pad_pow2(probs.astype(f32), slots_axis, 0.0)
+    read_rel = _pad_pow2(read_rel.astype(f32), slots_axis, 0.0)
+    mask = _pad_pow2(mask, slots_axis, False)
+    padded_width = probs.shape[slots_axis]
+    chunk = resolve_chunk_slots(chunk_slots, local_width)
+    n_chunks = padded_width // chunk
+
+    def chunk_roots(offset):
+        """The four tree-reduced sums of one slot chunk → (4, M)."""
+        p = jax.lax.dynamic_slice_in_dim(probs, offset, chunk, slots_axis)
+        r = jax.lax.dynamic_slice_in_dim(read_rel, offset, chunk, slots_axis)
+        m = jax.lax.dynamic_slice_in_dim(mask, offset, chunk, slots_axis)
+        w = jnp.where(m, r, f32(0.0))
+        wp = w * p
+        contributions = jnp.stack([w, wp, wp * p, w * w])
+        return _tree_sum(contributions, slots_axis + 1)
+
+    if n_chunks == 1:
+        sums = chunk_roots(0)
+    else:
+        markets = probs.shape[0] if agents_last else probs.shape[1]
+        buf = jnp.zeros((n_chunks, 4, markets), dtype=f32)
+
+        def body(i, acc):
+            return jax.lax.dynamic_update_slice_in_dim(
+                acc, chunk_roots(i * chunk)[None], i, axis=0
+            )
+
+        buf = jax.lax.fori_loop(0, n_chunks, body, buf)
+        # The cross-chunk fold IS the upper levels of the global tree:
+        # chunk i's root sits at leaf i in chunk order, and n_chunks is
+        # a power of two by construction.
+        sums = _tree_sum(buf, 0)
+
+    count = jnp.sum(mask, axis=slots_axis).astype(jnp.int32)
+    if axis_name is not None and axis_size > 1:
+        # Per-shard roots folded in fixed device order with the same
+        # balanced tree (padded to a power-of-two device count with
+        # exact zeros) — shard boundaries land on subtree boundaries
+        # whenever the local width is a power of two.
+        gathered = jax.lax.all_gather(sums, axis_name)  # (n_dev, 4, M)
+        gathered = _pad_pow2(gathered, 0, 0.0)
+        sums = _tree_sum(gathered, 0)
+        count = jax.lax.psum(count, axis_name)
+
+    sw, swp, swp2, sw2 = sums[0], sums[1], sums[2], sums[3]
+    has_weight = sw != 0
+    safe_w = jnp.where(has_weight, sw, f32(1.0))
+    mean = jnp.where(has_weight, swp / safe_w, f32(jnp.nan))
+    # One-pass weighted population variance E[p²] − E[p]²; clamped at 0
+    # against cancellation (the same form as the tie-break's confidence
+    # variance, reference: tiebreak.py:107-110).
+    ex2 = jnp.where(has_weight, swp2 / safe_w, f32(0.0))
+    centered = ex2 - jnp.where(has_weight, mean, f32(0.0)) ** 2
+    variance = jnp.maximum(centered, f32(0.0))
+    n_eff = jnp.where(sw2 > 0, (sw * sw) / jnp.where(sw2 > 0, sw2, f32(1.0)),
+                      f32(0.0))
+    stderr = jnp.where(
+        n_eff > 0,
+        jnp.sqrt(variance / jnp.maximum(n_eff, f32(1e-30))),
+        f32(0.0),
+    )
+    zf = f32(z)
+    lo = jnp.clip(mean - zf * stderr, f32(0.0), f32(1.0))
+    hi = jnp.clip(mean + zf * stderr, f32(0.0), f32(1.0))
+    return UncertaintyBands(
+        mean=mean, lo=lo, hi=hi, stderr=stderr, n_eff=n_eff, count=count
+    )

@@ -548,14 +548,86 @@ def build_cycle_tiebreak_loop(
     ``steps`` is static per compilation; compiled per (steps, exists-ness)
     like the plain loop. Donation covers the state (argnums 3) — the
     tie-break's read happens before the in-place update in program order.
+
+    Since round 12 this is a thin view over
+    :func:`build_cycle_analytics_loop` with the band/sweep stages off —
+    one scaffold owns the fused-program machinery.
     """
+    inner = build_cycle_analytics_loop(
+        mesh, chunk_agents=chunk_agents, donate=donate,
+        precision=precision, with_bands=False,
+    )
+
+    def loop(probs, mask, outcome, state, now0, steps: int):
+        new_state, consensus, tiebreak, _bands, _prop = inner(
+            probs, mask, outcome, state, now0, steps
+        )
+        return new_state, consensus, tiebreak
+
+    return loop
+
+
+def build_cycle_analytics_loop(
+    mesh: Mesh,
+    chunk_agents: int | None = None,
+    chunk_slots: int | None = None,
+    donate: bool = True,
+    precision: int = 6,
+    z: float = 1.959964,
+    damping: float = 0.5,
+    sweep_steps: int = 0,
+    with_tiebreak: bool = True,
+    with_bands: bool = True,
+):
+    """THE fused co-resident scaffold: N cycles + optional tie-break +
+    optional uncertainty bands + optional correlated-market sweep, one
+    jit (round 12; :func:`build_cycle_tiebreak_loop` is now a view onto
+    it with the band/sweep stages off).
+
+    ``loop(probs, mask, outcome, state, now0, steps[, neighbor_idx,
+    neighbor_w]) -> (state', consensus, RingTieBreakResult | None,
+    UncertaintyBands | None, propagated | None)`` — disabled stages
+    return ``None`` and compile to nothing (an online service wanting
+    bands without per-batch tie-break diagnostics sets
+    ``with_tiebreak=False`` and pays for neither the ring pass nor its
+    temps). The analytics stages read the SAME pre-update decayed view
+    the batch's consensus weighs with, at ``now0``: weight = the decayed
+    read reliability per signalling slot. With ``sweep_steps > 0`` the
+    loop takes two extra per-market-row neighbour blocks (``i32/f32
+    (M, D)`` sharded over markets, global row indices, −1 padding —
+    :meth:`~.analytics.graph.MarketGraph.align` builds them) and
+    additionally returns the damped-relaxation ``propagated`` vector
+    (:func:`~.ops.propagate.damped_sweep_math` over the final step's
+    consensus).
+
+    Co-residency is the point: running bands as a separate program
+    after a settle re-sends the probs/mask/state argument list a second
+    time; fused, the block rides once and the bands' marginal argument
+    cost is zero (the ``e2e_analytics`` leg records the ratio).
+    ``chunk_agents`` diets the tie-break (O(chunk × markets) temps),
+    ``chunk_slots`` diets the band accumulation — band outputs are
+    bit-identical at every ``chunk_slots`` setting by the tree-alignment
+    contract (ops/uncertainty.py). Layout, sharding (slot-major (K, M)
+    blocks ``P(sources, markets)``, per-market outputs ``P(markets)``),
+    donation (state, argnums 3 — every analytics read happens before
+    the in-place update in program order), and the loop-half semantics
+    are exactly :func:`build_cycle_loop`'s at ``slot_major=True``.
+    """
+    from bayesian_consensus_engine_tpu.ops.propagate import (
+        damped_sweep_math,
+    )
     from bayesian_consensus_engine_tpu.ops.tiebreak import (
         RingTieBreakResult,
         ring_tiebreak_math,
     )
+    from bayesian_consensus_engine_tpu.ops.uncertainty import (
+        UncertaintyBands,
+        band_math,
+    )
 
     block, market, slots_axis = _specs(slot_major=True)
     n_sources = mesh.shape[SOURCES_AXIS]
+    with_graph = sweep_steps > 0
     compiled: dict[tuple[int, bool], object] = {}
 
     def compile_for(steps: int, has_exists: bool):
@@ -567,40 +639,87 @@ def build_cycle_tiebreak_loop(
         )
         loop_math = make_loop_math(cycle_fn, steps, fast_cycle_fn=fast_fn)
 
-        def fused_math(probs, mask, outcome, state, now0):
-            with jax.named_scope("bce.ring_tiebreak"):
+        def fused_math(probs, mask, outcome, state, now0, *graph_args):
+            out = []
+            if with_tiebreak or with_bands:
                 read_rel, read_conf = read_phase(state, now0)
-                tiebreak = ring_tiebreak_math(
-                    probs, read_rel, read_conf, read_rel, mask,
-                    axis_name=SOURCES_AXIS,
-                    axis_size=n_sources,
-                    precision=precision,
-                    chunk_agents=chunk_agents,
-                    agents_last=False,  # slot-major: agents on axis 0
-                )
+            if with_tiebreak:
+                with jax.named_scope("bce.ring_tiebreak"):
+                    out.append(ring_tiebreak_math(
+                        probs, read_rel, read_conf, read_rel, mask,
+                        axis_name=SOURCES_AXIS,
+                        axis_size=n_sources,
+                        precision=precision,
+                        chunk_agents=chunk_agents,
+                        agents_last=False,  # slot-major: agents on axis 0
+                    ))
+            if with_bands:
+                with jax.named_scope("bce.uncertainty_bands"):
+                    out.append(band_math(
+                        probs, mask, read_rel,
+                        axis_name=SOURCES_AXIS,
+                        axis_size=n_sources,
+                        z=z,
+                        chunk_slots=chunk_slots,
+                        agents_last=False,
+                    ))
             new_state, consensus = loop_math(probs, mask, outcome, state, now0)
-            return new_state, consensus, tiebreak
+            if with_graph:
+                neighbor_idx, neighbor_w = graph_args
+                with jax.named_scope("bce.consensus_sweep"):
+                    out.append(damped_sweep_math(
+                        consensus, neighbor_idx, neighbor_w,
+                        damping=damping, steps=sweep_steps,
+                        axis_name=MARKETS_AXIS,
+                    ))
+            return (new_state, consensus, *out)
 
         state_spec = MarketBlockState(
             block, block, block, block if has_exists else None
         )
+        nb_spec = P(MARKETS_AXIS, None)
+        in_specs = (block, block, market, state_spec, P()) + (
+            (nb_spec, nb_spec) if with_graph else ()
+        )
+        out_specs = (
+            (state_spec, market)
+            + ((RingTieBreakResult(*([market] * 6)),) if with_tiebreak
+               else ())
+            + ((UncertaintyBands(*([market] * 6)),) if with_bands else ())
+            + ((market,) if with_graph else ())
+        )
         fn = shard_map(
             fused_math,
             mesh=mesh,
-            in_specs=(block, block, market, state_spec, P()),
-            out_specs=(
-                state_spec, market, RingTieBreakResult(*([market] * 6))
-            ),
-            check_vma=False,  # ring/top-2 folds defeat the vma checker
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=False,  # ring/top-2/tree folds defeat the checker
         )
         return jax.jit(fn, donate_argnums=(3,) if donate else ())
 
-    def loop(probs, mask, outcome, state, now0, steps: int):
+    def loop(probs, mask, outcome, state, now0, steps: int, *graph_args):
+        if with_graph and len(graph_args) != 2:
+            raise ValueError(
+                "sweep_steps > 0 needs (neighbor_idx, neighbor_w) blocks"
+            )
+        if not with_graph and graph_args:
+            raise ValueError(
+                "neighbour blocks passed to a loop built with "
+                "sweep_steps=0 — rebuild with sweep_steps > 0 to run "
+                "the graph sweep"
+            )
         key = (steps, state.exists is not None)
         fn = compiled.get(key)
         if fn is None:
             fn = compiled[key] = compile_for(*key)
-        return fn(probs, mask, outcome, state, now0)
+        out = list(fn(probs, mask, outcome, state, now0, *graph_args))
+        # Normalise to the 5-slot shape regardless of enabled stages.
+        new_state, consensus = out[0], out[1]
+        rest = out[2:]
+        tiebreak = rest.pop(0) if with_tiebreak else None
+        bands = rest.pop(0) if with_bands else None
+        propagated = rest.pop(0) if with_graph else None
+        return new_state, consensus, tiebreak, bands, propagated
 
     return loop
 

@@ -1094,6 +1094,31 @@ def _cached_fused_tiebreak_loop(mesh, chunk_agents, precision):
     return loop
 
 
+_analytics_loop_cache: dict = {}
+
+
+def _cached_analytics_loop(mesh, chunk_agents, chunk_slots, precision,
+                           z, damping, sweep_steps, with_tiebreak):
+    """One fused cycle(+tiebreak)+bands(+sweep) loop per configuration —
+    shared across sessions like :func:`_cached_cycle_loop` (the jit
+    tracing cache lives on the wrapper instance)."""
+    key = (mesh, chunk_agents, chunk_slots, precision, z, damping,
+           sweep_steps, with_tiebreak)
+    loop = _analytics_loop_cache.get(key)
+    if loop is None:
+        from bayesian_consensus_engine_tpu.parallel.sharded import (
+            build_cycle_analytics_loop,
+        )
+
+        loop = build_cycle_analytics_loop(
+            mesh, chunk_agents=chunk_agents, chunk_slots=chunk_slots,
+            donate=True, precision=precision, z=z, damping=damping,
+            sweep_steps=sweep_steps, with_tiebreak=with_tiebreak,
+        )
+        _analytics_loop_cache[key] = loop
+    return loop
+
+
 class ShardedSettlementSession:
     """Chained, device-resident sharded settlements for one plan — or, via
     :meth:`refresh`/:meth:`adopt`, a long-lived SUCCESSION of plans.
@@ -1393,6 +1418,183 @@ class ShardedSettlementSession:
             ),
             RingTieBreakResult(
                 *(_BandView(x, self._lo, live) for x in tiebreak)
+            ),
+        )
+
+    def _graph_blocks(self, graph) -> tuple:
+        """Device neighbour blocks for *graph* aligned to the CURRENT
+        plan's market rows, cached per (graph, topology, padding).
+
+        The cache key is the graph-side extension of the plan-reuse
+        story: a probability-only refresh keeps ``market_keys`` (and so
+        the alignment) identical, so the blocks — like the mask — ride
+        the session across refreshes; a topology change or a different
+        graph misses and re-aligns. Keyed by
+        :meth:`~.analytics.graph.MarketGraph.extended_fingerprint` over
+        the plan's topology digest when the plan carries one, else by
+        market-key equality.
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from bayesian_consensus_engine_tpu.parallel.mesh import (
+            MARKETS_AXIS,
+        )
+
+        plan = self._plan
+        if plan.fingerprint is not None:
+            key = (
+                graph.extended_fingerprint(plan.fingerprint),
+                self._padded_total,
+            )
+            keys_sig = None
+        else:
+            key = (graph.fingerprint, self._padded_total)
+            keys_sig = plan.market_keys
+        cached = getattr(self, "_graph_cache", None)
+        if (
+            cached is not None
+            and cached[0] == key
+            and (keys_sig is None or cached[1] == keys_sig)
+        ):
+            return cached[2]
+        neighbor_idx, neighbor_w = graph.align(
+            plan.market_keys, self._padded_total
+        )
+        sharding = NamedSharding(
+            self._mesh, PartitionSpec(MARKETS_AXIS, None)
+        )
+        blocks = (
+            jax.device_put(neighbor_idx, sharding),
+            jax.device_put(neighbor_w, sharding),
+        )
+        self._graph_cache = (
+            key, list(keys_sig) if keys_sig is not None else None, blocks
+        )
+        return blocks
+
+    def settle_with_analytics(
+        self,
+        outcomes: Sequence[bool],
+        steps: int = 1,
+        now: Optional[float] = None,
+        analytics=None,
+    ) -> tuple:
+        """Settle AND analyse the batch in ONE compiled program per chip.
+
+        The round-12 extension of :meth:`settle_with_tiebreak`
+        (ROADMAP items 4/5): the fused program
+        (:func:`~.parallel.sharded.build_cycle_analytics_loop`) runs the
+        N-cycle settlement loop, the chunked ring tie-break, the
+        uncertainty bands, and — when *analytics* carries a
+        :class:`~.analytics.graph.MarketGraph` — the damped
+        correlated-market sweep, all against the one resident
+        reliability block. Returns ``(SettlementResult,
+        RingTieBreakResult, UncertaintyBands, propagated-or-None)``
+        where every analytics field is a per-market band view over this
+        process's markets.
+
+        *analytics* is an :class:`~.analytics.bands.AnalyticsOptions`
+        (``None`` → the defaults: recorded chunk sizes, 95% bands, no
+        graph; ``tiebreak=False`` drops the ring stage from the program
+        and returns ``None`` in its slot). Settlement semantics — state
+        merge recipe, confidence
+        replay, journal/export bytes — are exactly :meth:`settle`'s (the
+        shared commit path), and the consensus comes out of the same
+        loop scaffold: bit-equal to :meth:`settle`'s on the tier-1
+        backend (pinned by tests/test_analytics.py — the analytics
+        on/off byte-parity contract the serving layer's ``analytics=``
+        mode rests on). Bands/tie-break/sweep are pure-additive reads of
+        the PRE-update state at *now*; nothing analytics-side is ever
+        written back.
+        """
+        import jax.numpy as jnp
+
+        from bayesian_consensus_engine_tpu.analytics.bands import (
+            AnalyticsOptions,
+        )
+        from bayesian_consensus_engine_tpu.ops.tiebreak import (
+            DEFAULT_CHUNK_AGENTS,
+            RingTieBreakResult,
+        )
+        from bayesian_consensus_engine_tpu.ops.uncertainty import (
+            DEFAULT_CHUNK_SLOTS,
+            UncertaintyBands,
+        )
+        from bayesian_consensus_engine_tpu.parallel.distributed import (
+            global_market,
+        )
+
+        options = analytics if analytics is not None else AnalyticsOptions()
+
+        def resolve(value, recorded, knob):
+            if value == "default":
+                return recorded
+            if isinstance(value, str):
+                raise ValueError(
+                    f"{knob}={value!r}: the session entry takes an int, "
+                    "None (unchunked), or 'default' (the recorded "
+                    "default); measured 'auto' tuning lives on the "
+                    "standalone builders"
+                )
+            return value
+
+        chunk_agents = resolve(
+            options.chunk_agents, DEFAULT_CHUNK_AGENTS, "chunk_agents"
+        )
+        chunk_slots = resolve(
+            options.chunk_slots, DEFAULT_CHUNK_SLOTS, "chunk_slots"
+        )
+        graph = options.graph
+        sweep_steps = graph.steps if graph is not None else 0
+        damping = graph.damping if graph is not None else 0.0
+
+        now_abs, conf_exact, outcome_band = self._settle_preamble(
+            outcomes, now
+        )
+        with active_timeline().span("analytics"):
+            # The analytics tier's OWN overhead only: graph alignment/
+            # upload and program resolution. The shared preamble/commit
+            # stay attributed exactly as settle() leaves them, and the
+            # fused kernel dispatch stays on `settle_dispatch` — an
+            # analytics-on vs -off phase comparison then isolates what
+            # analytics actually added.
+            graph_args = (
+                self._graph_blocks(graph) if sweep_steps > 0 else ()
+            )
+            loop = _cached_analytics_loop(
+                self._mesh, chunk_agents, chunk_slots, options.precision,
+                options.z, damping, sweep_steps, options.tiebreak,
+            )
+        with active_timeline().span("settle_dispatch"):
+            outcome_g = global_market(
+                outcome_band, self._mesh, self._padded_total
+            )
+            new_state, consensus, tiebreak, bands, propagated = loop(
+                self._probs_g, self._mask_g, outcome_g, self._state,
+                jnp.asarray(now_abs - self._epoch0, dtype=self._cdtype),
+                steps,
+                *graph_args,
+            )
+        self._settle_commit(new_state, steps, now_abs, conf_exact)
+        live, keys = self._band_live()
+        return (
+            SettlementResult(
+                market_keys=keys,
+                consensus=_BandView(consensus, self._lo, live),
+            ),
+            (
+                RingTieBreakResult(
+                    *(_BandView(x, self._lo, live) for x in tiebreak)
+                )
+                if tiebreak is not None else None
+            ),
+            UncertaintyBands(
+                *(_BandView(x, self._lo, live) for x in bands)
+            ),
+            (
+                _BandView(propagated, self._lo, live)
+                if propagated is not None else None
             ),
         )
 

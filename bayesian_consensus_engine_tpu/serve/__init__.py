@@ -34,12 +34,14 @@ from bayesian_consensus_engine_tpu.serve.admission import (
     ShedError,
 )
 from bayesian_consensus_engine_tpu.serve.coalesce import (
+    AdaptiveWindow,
     ConsensusService,
     ServeResult,
 )
 from bayesian_consensus_engine_tpu.serve.driver import PlanCache, SessionDriver
 
 __all__ = [
+    "AdaptiveWindow",
     "AdmissionConfig",
     "ConsensusService",
     "Overloaded",

@@ -130,11 +130,109 @@ Signal = Union[Mapping[str, Any], tuple]
 
 @dataclass(frozen=True)
 class ServeResult:
-    """What a settled request's future resolves to."""
+    """What a settled request's future resolves to.
+
+    The analytics fields are populated only under
+    ``ConsensusService(analytics=...)``: ``band_lo``/``band_hi`` bound
+    the credible interval around the point consensus,
+    ``band_stderr`` is its standard error, and ``propagated`` is the
+    graph-relaxed consensus when the options carry a
+    :class:`~.analytics.graph.MarketGraph`. All ``None`` with analytics
+    off — and the point ``consensus`` is byte-identical either way (the
+    analytics on/off parity contract)."""
 
     market_id: str
     consensus: float
     batch_index: int
+    band_lo: Optional[float] = None
+    band_hi: Optional[float] = None
+    band_stderr: Optional[float] = None
+    propagated: Optional[float] = None
+
+
+class AdaptiveWindow:
+    """Deterministic max-delay controller aimed at a latency SLO.
+
+    The round-8 coalescer takes a FIXED ``max_delay_s``; this controller
+    (ROADMAP item 1's seeded follow-up) re-aims the window at a target
+    p99 instead: every completed batch feeds its requests'
+    submit→settled latencies in and nudges the delay multiplicatively —
+    HALVE when the observed p99 overshoots the target (smaller windows,
+    lower queueing delay), grow by 25% when p99 sits below half the
+    target (larger windows, better coalescing), hold in between —
+    clamped to ``[floor_s, cap_s]``.
+
+    The observation window RESETS at every :meth:`step`: each nudge
+    reads the p99 of the latencies observed since the previous nudge
+    (one batch's worth in the service wiring), not a lifetime-
+    cumulative quantile — a cumulative view would freeze the controller
+    as uptime grows (a latency regression is invisible until it
+    outweighs 1% of all history). The p99 itself is EXACT over the
+    window's raw latencies (a sort per nudge, bounded by the batch
+    size), not a log-bucket estimate: the serving histograms' bucket
+    edges overestimate a quantile by up to a half-decade bucket, which
+    against an exact threshold would pin a comfortably-within-SLO
+    service at the window floor forever. The multiplicative ±steps
+    give the smoothing; the window gives the responsiveness.
+
+    Deterministic by construction: the nudge sequence is a pure
+    function of the observed latency sequence and its batching (fixed
+    factors, exact order statistics, reset points at the trace's own
+    batch boundaries, no wall-clock reads of its own) — a fixed trace
+    of latencies yields a fixed window sequence, pinned by
+    tests/test_serve.py.
+    """
+
+    def __init__(
+        self,
+        target_p99_s: float,
+        initial_delay_s: float,
+        floor_s: Optional[float] = None,
+        cap_s: Optional[float] = None,
+    ) -> None:
+        if target_p99_s <= 0:
+            raise ValueError("target_p99_s must be > 0")
+        if initial_delay_s <= 0:
+            raise ValueError(
+                "adaptive windowing needs a positive initial max_delay_s"
+            )
+        self.target_p99_s = float(target_p99_s)
+        self.delay_s = float(initial_delay_s)
+        self.floor_s = (
+            float(floor_s) if floor_s is not None
+            else min(initial_delay_s, self.target_p99_s / 100.0)
+        )
+        self.cap_s = (
+            float(cap_s) if cap_s is not None else 4.0 * initial_delay_s
+        )
+        self._window: list = []
+        #: Every applied delay, in batch order — the window sequence the
+        #: determinism test replays.
+        self.delay_log: list = [self.delay_s]
+
+    def observe(self, latency_s: float) -> None:
+        """Feed one completed request's submit→settled latency."""
+        self._window.append(latency_s)
+
+    def step(self) -> float:
+        """One nudge over the latencies observed since the last nudge
+        (call once per completed batch); returns the new delay, also
+        appended to :attr:`delay_log`. Resets the observation window."""
+        p99 = None
+        if self._window:
+            ordered = sorted(self._window)
+            p99 = ordered[
+                max(0, -(-99 * len(ordered) // 100) - 1)
+            ]
+            self._window = []
+        if p99 is not None:
+            if p99 > self.target_p99_s:
+                self.delay_s *= 0.5
+            elif p99 < 0.5 * self.target_p99_s:
+                self.delay_s *= 1.25
+            self.delay_s = min(max(self.delay_s, self.floor_s), self.cap_s)
+        self.delay_log.append(self.delay_s)
+        return self.delay_s
 
 
 class _Request:
@@ -226,11 +324,20 @@ class ConsensusService:
         admission: Optional[AdmissionConfig] = None,
         slo=None,
         record_batches: bool = False,
+        analytics=None,
+        target_p99_s: Optional[float] = None,
     ) -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if max_delay_s is not None and max_delay_s < 0:
             raise ValueError("max_delay_s must be >= 0 (or None)")
+        if target_p99_s is not None and (
+            max_delay_s is None or max_delay_s <= 0
+        ):
+            raise ValueError(
+                "target_p99_s= adapts the coalescing window, so it needs "
+                "a positive initial max_delay_s"
+            )
         owns_journal = False
         if journal is not None and not hasattr(journal, "append_epoch"):
             from bayesian_consensus_engine_tpu.state.journal import (
@@ -255,6 +362,16 @@ class ConsensusService:
             db_path=db_path,
             checkpoint_every=checkpoint_every,
             sync_checkpoints=sync_checkpoints,
+            analytics=analytics,
+        )
+        self._analytics_mode = self._driver._analytics is not None
+        #: The adaptive coalescing window (ROADMAP item 1 follow-up):
+        #: None runs the fixed max_delay_s; with ``target_p99_s=`` every
+        #: completed batch nudges the delay toward the SLO (see
+        #: :class:`AdaptiveWindow` — delay_log is the window sequence).
+        self.window = (
+            AdaptiveWindow(target_p99_s, max_delay_s)
+            if target_p99_s is not None else None
         )
         self._journal_mode = journal is not None
         self._admission = AdmissionController(
@@ -487,6 +604,12 @@ class ConsensusService:
 
     # -- flushing (event-loop thread) ----------------------------------------
 
+    def _apply_window_delay(self, delay_s: float) -> None:
+        """Adopt the adaptive controller's new max delay (loop thread —
+        the timer owner). Already-armed timers keep their old deadline;
+        the next arm uses the new window."""
+        self._max_delay_s = delay_s
+
     def _arm_timer(self) -> None:
         if (
             self._max_delay_s is None
@@ -641,6 +764,18 @@ class ConsensusService:
                     plan, outcomes, now=batch_now, band=None
                 )
                 consensus = np.asarray(result.consensus)
+                bands = propagated = None
+                if self._analytics_mode:
+                    _tiebreak, band_views, prop_view = (
+                        self._driver.last_analytics
+                    )
+                    bands = {
+                        "lo": np.asarray(band_views.lo),
+                        "hi": np.asarray(band_views.hi),
+                        "stderr": np.asarray(band_views.stderr),
+                    }
+                    if prop_view is not None:
+                        propagated = np.asarray(prop_view)
                 t_settled = _time.perf_counter()
                 self._driver.checkpoint(batch_index)
                 if self._journal_mode:
@@ -667,6 +802,17 @@ class ConsensusService:
             for request in requests:
                 loop.call_soon_threadsafe(self._resolve, request, None, exc)
             return
+        if self.window is not None:
+            # The adaptive window: feed this batch's submit→settled
+            # latencies and apply one deterministic nudge. The new delay
+            # lands on the loop thread (the timer owner); the nudge
+            # sequence itself is a pure function of the observed
+            # latencies (AdaptiveWindow.delay_log records it).
+            for request in requests:
+                self.window.observe(t_settled - request.t_submit)
+            loop.call_soon_threadsafe(
+                self._apply_window_delay, self.window.step()
+            )
         # Resolution happens AFTER the checkpoint — the service analogue
         # of settle_stream yielding after the cadence — so a caller never
         # observes a result whose durability window has silently failed.
@@ -679,7 +825,21 @@ class ConsensusService:
                     args={"batch": batch_index},
                 )
             value = ServeResult(
-                request.market_id, float(consensus[i]), batch_index
+                request.market_id, float(consensus[i]), batch_index,
+                band_lo=(
+                    float(bands["lo"][i]) if bands is not None else None
+                ),
+                band_hi=(
+                    float(bands["hi"][i]) if bands is not None else None
+                ),
+                band_stderr=(
+                    float(bands["stderr"][i]) if bands is not None
+                    else None
+                ),
+                propagated=(
+                    float(propagated[i]) if propagated is not None
+                    else None
+                ),
             )
             if not self._journal_mode:
                 self._hist_total.observe(t_settled - request.t_submit)

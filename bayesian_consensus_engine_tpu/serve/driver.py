@@ -190,9 +190,34 @@ class SessionDriver:
         checkpoint_every: int = 1,
         sync_checkpoints: bool = False,
         lazy_checkpoints: bool = False,
+        analytics=None,
     ) -> None:
         if checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
+        if analytics is not None and analytics is not False:
+            from bayesian_consensus_engine_tpu.analytics.bands import (
+                AnalyticsOptions,
+            )
+
+            if analytics is True:
+                # The shorthand is BANDS-ONLY: the serving surface has
+                # no per-request tie-break field, so the default must
+                # not spend a ring pass per batch on an unreachable
+                # output. Pass AnalyticsOptions(tiebreak=True) to keep
+                # the full tier on `last_analytics`.
+                analytics = AnalyticsOptions(tiebreak=False)
+            if not isinstance(analytics, AnalyticsOptions):
+                raise TypeError(
+                    "analytics= takes True, an AnalyticsOptions, or None"
+                )
+            if mesh is None or not resident_session:
+                raise ValueError(
+                    "analytics= needs the resident sharded session "
+                    "(mesh= with resident_session=True): bands read the "
+                    "device-resident reliability block"
+                )
+        else:
+            analytics = None
         if journal is not None and lazy_checkpoints:
             raise ValueError(
                 "journal= epochs are drained truth by contract; "
@@ -209,6 +234,13 @@ class SessionDriver:
         self._checkpoint_every = checkpoint_every
         self._sync_checkpoints = sync_checkpoints
         self._lazy_checkpoints = lazy_checkpoints
+        self._analytics = analytics
+        #: The last dispatch's analytics tier, when ``analytics=`` is on:
+        #: ``(RingTieBreakResult, UncertaintyBands, propagated-or-None)``
+        #: of per-market band views over the batch's markets. ``None``
+        #: with analytics off. Pure-additive: reading (or ignoring) it
+        #: never moves a settlement byte.
+        self.last_analytics = None
 
         registry = metrics_registry()
         self._adopts_counter = registry.counter("stream.session_adopts")
@@ -305,9 +337,22 @@ class SessionDriver:
                 if self.last_adopt != "refresh":
                     self._adopts_counter.inc()
             self._resident_gauge.set(float(self._session._touched.size))
-            result = self._session.settle(
-                outcomes, steps=self._steps, now=now
-            )
+            if self._analytics is not None:
+                # The fused co-resident program: settlement bytes (and
+                # the consensus itself) equal the plain entry's — the
+                # analytics on/off byte-parity contract — with the
+                # bands (+ optional sweep) riding the same dispatch.
+                result, tiebreak, bands, propagated = (
+                    self._session.settle_with_analytics(
+                        outcomes, steps=self._steps, now=now,
+                        analytics=self._analytics,
+                    )
+                )
+                self.last_analytics = (tiebreak, bands, propagated)
+            else:
+                result = self._session.settle(
+                    outcomes, steps=self._steps, now=now
+                )
         if self._mesh is not None:
             # Phase boundary: the settle just dispatched — sample live
             # device memory into the hbm.* gauges (no-op obs-disabled).

@@ -2316,6 +2316,244 @@ def bench_e2e_ring_memory(markets=2048, agents=10_000, chunk_agents=1024,
     return result
 
 
+def bench_e2e_analytics(markets=1024, slots=512, chunk_slots=256,
+                        graph_degree=4, steps=2, reps=3, trials=2):
+    """ISSUE-10 acceptance leg: the device-resident analytics tier.
+
+    Three variants over ONE slot-major (K, M) workload, min-of-N +
+    loadavg (`_min_of_trials`, alternating rounds), ``hbm_peak_bytes``
+    recorded per variant (the allocator high-water mark AFTER that
+    variant's runs — monotone, so later variants inherit earlier peaks;
+    per-program attribution is the AOT capture's job):
+
+    1. **bands_only** — the standalone band program
+       (analytics/bands.py) dispatched against the resident state: the
+       two-program shape, where every dispatch re-sends the whole
+       probs/mask/state argument list.
+    2. **fused_resident** — ``build_cycle_analytics_loop``: N cycles +
+       chunked tie-break + bands in ONE program per chip against the
+       same block.
+    3. **fused_graph** — the fused program plus the correlated-market
+       sweep over a random ``graph_degree``-regular dependency graph.
+
+    The acceptance number is the CO-RESIDENCY argument ratio, read off
+    AOT ``memory_analysis()`` of the same compiled objects that run:
+    ``fused_arg_bytes`` vs ``separate_arg_bytes`` (= the plain cycle
+    loop's args + the bands program's args — what "run a separate bands
+    program after settle" actually re-sends). Fused, the block rides
+    once and the bands' marginal argument cost is one outcomes vector —
+    the leg records ``coresident_arg_ratio`` (≤ ~0.55 ⇒
+    ``fused_halves_args``) in the leg JSON, the PR 9 co-residency
+    argument applied to the analytics tier.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from bayesian_consensus_engine_tpu.analytics.bands import (
+        build_band_program,
+    )
+    from bayesian_consensus_engine_tpu.parallel.sharded import (
+        build_cycle_analytics_loop,
+        build_cycle_loop,
+        init_block_state,
+    )
+    from bayesian_consensus_engine_tpu.utils.profiling import (
+        device_memory_stats,
+    )
+
+    rng = np.random.default_rng(12)
+    k, m = slots, markets
+    probs = jnp.asarray(rng.random((k, m)), jnp.float32)
+    mask = jnp.asarray(rng.random((k, m)) < 0.9)
+    outcome = jnp.asarray(rng.random(m) < 0.5)
+    state = jax.tree.map(lambda x: x.T, init_block_state(m, k))
+    now0 = jnp.asarray(400.0, jnp.float32)
+    mesh = Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1), ("markets", "sources")
+    )
+    # A random degree-regular dependency graph over the batch's markets.
+    nb_idx = jnp.asarray(
+        rng.integers(0, m, (m, graph_degree)), jnp.int32
+    )
+    nb_w = jnp.asarray(
+        rng.uniform(0.5, 1.5, (m, graph_degree)), jnp.float32
+    )
+
+    chunk = min(chunk_slots, k)
+    bands_prog = build_band_program(mesh, chunk_slots=chunk)
+    fused = build_cycle_analytics_loop(
+        mesh, chunk_slots=chunk, chunk_agents=min(1024, k), donate=False
+    )
+    fused_graph = build_cycle_analytics_loop(
+        mesh, chunk_slots=chunk, chunk_agents=min(1024, k), donate=False,
+        sweep_steps=2,
+    )
+    plain_loop = build_cycle_loop(mesh, donate=False)
+
+    # AOT: compile once per program, run the same executables.
+    bands_exe = bands_prog.lower(probs, mask, state, now0).compile()
+    fused_exe = jax.jit(
+        lambda p, ma, o, s, n: fused(p, ma, o, s, n, steps)
+    ).lower(probs, mask, outcome, state, now0).compile()
+    graph_exe = jax.jit(
+        lambda p, ma, o, s, n, gi, gw: fused_graph(
+            p, ma, o, s, n, steps, gi, gw
+        )
+    ).lower(probs, mask, outcome, state, now0, nb_idx, nb_w).compile()
+    plain_mem = jax.jit(
+        lambda p, ma, o, s, n: plain_loop(p, ma, o, s, n, steps)
+    ).lower(probs, mask, outcome, state, now0).compile().memory_analysis()
+    bands_mem = bands_exe.memory_analysis()
+    fused_mem = fused_exe.memory_analysis()
+    graph_mem = graph_exe.memory_analysis()
+
+    def timed(run_once):
+        best = float("inf")
+        for _ in range(reps):
+            start = time.perf_counter()
+            run_once()
+            best = min(best, time.perf_counter() - start)
+        # Variant-scoped allocator view: a monotone high-water mark, so
+        # ordering matters (documented in the leg docstring).
+        hbm = device_memory_stats()["peak_bytes_in_use"] or None
+        return best, hbm
+
+    runners = {
+        "bands_only": lambda: _fence(
+            bands_exe(probs, mask, state, now0).mean
+        ),
+        "fused_resident": lambda: _fence(
+            fused_exe(probs, mask, outcome, state, now0)[3].mean
+        ),
+        "fused_graph": lambda: _fence(
+            graph_exe(probs, mask, outcome, state, now0, nb_idx, nb_w)[4]
+        ),
+    }
+    memory = {
+        "bands_only": bands_mem,
+        "fused_resident": fused_mem,
+        "fused_graph": graph_mem,
+    }
+
+    def run_variant(name):
+        wall, hbm = timed(runners[name])
+        mem = memory[name]
+        return {
+            "wall_s": round(wall, 4),
+            "markets_per_sec": round(m / wall, 1),
+            "compiled_temp_bytes": int(mem.temp_size_in_bytes),
+            "arg_bytes": int(mem.argument_size_in_bytes),
+            "hbm_peak_bytes": hbm,
+        }
+
+    for run_once in runners.values():  # warm off the clock
+        run_once()
+    best = _min_of_trials(
+        "e2e_analytics",
+        ["bands_only", "fused_resident", "fused_graph"],
+        run_variant,
+        trials,
+    )
+
+    # Live fused-session act: one ShardedSettlementSession serving
+    # settle + tie-break + bands + sweep from its resident block — the
+    # `analytics` phase span (alignment/wrapping overhead, exclusive of
+    # settle_dispatch) lands in the leg's phase breakdown here.
+    from bayesian_consensus_engine_tpu.analytics import (
+        AnalyticsOptions,
+        MarketGraph,
+    )
+    from bayesian_consensus_engine_tpu.pipeline import (
+        ShardedSettlementSession,
+        build_settlement_plan,
+    )
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    sess_markets = min(m, 256)
+    payloads = [
+        (
+            f"market-{i}",
+            [
+                {"sourceId": f"src-{s}", "probability": float(rng.random())}
+                for s in range(8)
+            ],
+        )
+        for i in range(sess_markets)
+    ]
+    graph_live = MarketGraph.from_edges(
+        [
+            (f"market-{i}", f"market-{(i + 1) % sess_markets}", 1.0)
+            for i in range(0, sess_markets, 2)
+        ]
+    )
+    options = AnalyticsOptions(graph=graph_live, chunk_slots=8)
+    store = TensorReliabilityStore()
+    plan = build_settlement_plan(store, payloads, num_slots=8)
+    sess_outcomes = list(rng.random(sess_markets) < 0.5)
+    with ShardedSettlementSession(store, plan, mesh) as session:
+        session.settle_with_analytics(  # warm: state build + compile
+            sess_outcomes, now=21_900.0, analytics=options
+        )
+        session_dispatch = float("inf")
+        for i in range(reps):
+            start = time.perf_counter()
+            _res, _tb, bands_live, _prop = session.settle_with_analytics(
+                sess_outcomes, now=21_901.0 + i, analytics=options
+            )
+            _fence(np.asarray(bands_live.mean))
+            session_dispatch = min(
+                session_dispatch, time.perf_counter() - start
+            )
+
+    plain_args = int(plain_mem.argument_size_in_bytes)
+    bands_args = int(bands_mem.argument_size_in_bytes)
+    separate_args = plain_args + bands_args
+    fused_args = int(fused_mem.argument_size_in_bytes)
+    # The acceptance reading: what does DISPATCHING BANDS cost in
+    # argument bytes? Separate: the standalone program re-sends the
+    # probs/mask/state blocks (bands_args — XLA already drops the
+    # state fields bands never read). Fused: the settle was sending its
+    # argument list anyway, so bands' marginal cost is fused − plain —
+    # zero blocks (the block rides once). ≤ half is the bar; measured,
+    # the marginal is ~0.
+    bands_marginal = fused_args - plain_args
+    ratio = bands_marginal / max(bands_args, 1)
+    hbm_peak = device_memory_stats()["peak_bytes_in_use"] or None
+    _ledger_record(
+        "e2e_analytics", value=best["fused_resident"]["wall_s"], unit="s",
+        extras={"hbm_peak_bytes": hbm_peak},
+    )
+    return {
+        "workload": f"{m} markets x {k} slots, {steps} steps",
+        "chunk_slots": chunk,
+        "graph_degree": graph_degree,
+        "bands_only": best["bands_only"],
+        "fused_resident": best["fused_resident"],
+        "fused_graph": best["fused_graph"],
+        # The co-residency argument, both readings: the whole-pipeline
+        # ratio (fused program vs settle + separate bands programs) and
+        # the bands-dispatch marginal the acceptance bar is about.
+        "separate_arg_bytes": separate_args,
+        "fused_arg_bytes": fused_args,
+        "coresident_arg_ratio": round(fused_args / max(separate_args, 1), 3),
+        "bands_separate_arg_bytes": bands_args,
+        "bands_marginal_arg_bytes": bands_marginal,
+        "bands_dispatch_arg_ratio": round(ratio, 3),
+        "fused_halves_band_args": bool(ratio <= 0.5),
+        "sweep_marginal_arg_bytes": int(
+            graph_mem.argument_size_in_bytes
+            - fused_mem.argument_size_in_bytes
+        ),
+        "session_shape": f"{sess_markets} markets x 8 slots",
+        "session_fused_dispatch_s": round(session_dispatch, 4),
+        "hbm_peak_bytes": hbm_peak,
+    }
+
+
 def _e2e_payloads(markets, mean_slots, seed=7):
     """The e2e legs' shared synthetic payload shape (dict payloads)."""
     import numpy as np
@@ -2882,6 +3120,11 @@ LEGS = {
         dict(markets=64, agents=256, chunk_agents=64, fused_slots=32,
              reps=1, trials=1), 1200,
     ),
+    "e2e_analytics": (
+        bench_e2e_analytics, {},
+        dict(markets=128, slots=64, chunk_slots=16, graph_degree=2,
+             steps=2, reps=1, trials=1), 1200,
+    ),
     "pallas_ab": (
         bench_pallas_ab, {},
         dict(num_markets=1024, slots=8, timed_steps=8,
@@ -2930,6 +3173,7 @@ DEVICE_LEG_ORDER = [
     "obs_overhead",
     "tiebreak_10k_agents",
     "e2e_ring_memory",
+    "e2e_analytics",
     "pallas_ab",
     "dryrun_multichip",
 ]
@@ -3253,6 +3497,7 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         ),
         "tiebreak_10k_agents": _show(results, "tiebreak_10k_agents"),
         "e2e_ring_memory": _show(results, "e2e_ring_memory"),
+        "e2e_analytics": _show(results, "e2e_analytics"),
         "per_slot_throughput": slot_updates,
         "harness": harness,
         "notes": (

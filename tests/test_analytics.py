@@ -1,0 +1,710 @@
+"""analytics/: device-resident uncertainty bands + correlated-market
+consensus (round 12).
+
+The non-negotiable contracts, mirroring tests/test_ring.py's shape:
+
+* **Band bit matrix** — band outputs are BIT-IDENTICAL at every
+  ``chunk_slots`` setting, across mesh factorisations, and across the
+  (M, K)/(K, M) layouts. Structural (the fixed balanced-tree
+  accumulation in ops/uncertainty.py — chunk and shard roots are
+  internal nodes of one global tree); these tests are the empirical pin.
+* **Pure-additive analytics** — ``settle_with_analytics`` and the
+  serving ``analytics=`` mode change NO settlement byte: results, store
+  state, journal epoch payloads (wall_ts masked), and SQLite bytes are
+  identical with analytics on or off — the obs on/off contract, applied
+  to analytics.
+* **Graph semantics** — the CSR MarketGraph is order-sensitive
+  (fingerprints miss on edge reorder, the plan-reuse analogue), and the
+  damped sweep is a bit-stable pure function of its inputs on any mesh.
+"""
+
+import asyncio
+import struct
+from functools import partial
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from bayesian_consensus_engine_tpu.analytics import (
+    AnalyticsOptions,
+    MarketGraph,
+    UncertaintyBands,
+)
+from bayesian_consensus_engine_tpu.analytics.bands import build_band_program
+from bayesian_consensus_engine_tpu.ops.propagate import damped_sweep_math
+from bayesian_consensus_engine_tpu.ops.uncertainty import (
+    band_math,
+    resolve_chunk_slots,
+)
+from bayesian_consensus_engine_tpu.parallel import MarketBlockState
+from bayesian_consensus_engine_tpu.parallel._jax_compat import shard_map
+from bayesian_consensus_engine_tpu.parallel.mesh import (
+    MARKETS_AXIS,
+    SOURCES_AXIS,
+    make_mesh,
+)
+from bayesian_consensus_engine_tpu.parallel.sharded import (
+    build_cycle_analytics_loop,
+    build_cycle_loop,
+    init_block_state,
+)
+from bayesian_consensus_engine_tpu.pipeline import (
+    ShardedSettlementSession,
+    build_settlement_plan,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+M, K = 16, 32
+NOW = 21_900.0
+
+
+def _band_args(m, k, workload, seed=0):
+    """One (M, K) band operand set for a named parity workload."""
+    rng = np.random.default_rng(seed)
+    probs = rng.random((m, k))
+    valid = rng.random((m, k)) < 0.8
+    if workload == "mask_holes":
+        valid = rng.random((m, k)) < 0.5
+        valid[0] = False  # a market with no signalling slot
+    elif workload == "single_agent":
+        valid = np.zeros((m, k), dtype=bool)
+        valid[np.arange(m), rng.integers(0, k, m)] = True
+    elif workload == "uniform":
+        probs = np.full((m, k), 0.625)
+        valid = np.ones((m, k), dtype=bool)
+    else:
+        assert workload == "random"
+    return (
+        jnp.asarray(probs, jnp.float32),
+        jnp.asarray(valid),
+        jnp.asarray(rng.uniform(0.1, 2.0, (m, k)), jnp.float32),
+    )
+
+
+def _sharded_bands(mesh_shape, chunk, args, slot_major=False):
+    """Run band_math under shard_map on *mesh_shape*; numpy outputs."""
+    mesh = make_mesh(mesh_shape)
+    n_src = mesh.shape[SOURCES_AXIS]
+    if slot_major:
+        block = P(SOURCES_AXIS, MARKETS_AXIS)
+        args = tuple(x.T for x in args)
+    else:
+        block = P(MARKETS_AXIS, SOURCES_AXIS)
+    fn = shard_map(
+        partial(
+            band_math,
+            axis_name=SOURCES_AXIS,
+            axis_size=n_src,
+            chunk_slots=chunk,
+            agents_last=not slot_major,
+        ),
+        mesh=mesh,
+        in_specs=(block,) * 3,
+        out_specs=UncertaintyBands(*([P(MARKETS_AXIS)] * 6)),
+        check_vma=False,
+    )
+    return jax.tree.map(np.asarray, jax.jit(fn)(*args))
+
+
+class TestBandParityMatrix:
+    """ISSUE-10 acceptance: bands bit-identical at every chunk setting,
+    across mesh shapes, AND across layouts — for arbitrary float inputs,
+    not just exactly-representable ones (the tree accumulation never
+    changes its pairing; see ops/uncertainty.py)."""
+
+    @pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (8, 1)])
+    @pytest.mark.parametrize(
+        "workload", ["random", "mask_holes", "single_agent", "uniform"]
+    )
+    def test_bit_exact_across_chunks_meshes_layouts(
+        self, mesh_shape, workload
+    ):
+        args = _band_args(M, K, workload)
+        want = _sharded_bands((8, 1), None, args)
+        for chunk in (None, 1, 4, 7, K + 5):
+            for slot_major in (False, True):
+                got = _sharded_bands(mesh_shape, chunk, args, slot_major)
+                for name, g, w in zip(want._fields, got, want):
+                    np.testing.assert_array_equal(
+                        g, w,
+                        err_msg=(
+                            f"{mesh_shape}/{workload}/chunk={chunk}/"
+                            f"slot_major={slot_major}/{name}"
+                        ),
+                    )
+
+    def test_chunk_resolution_is_pow2(self):
+        # Every resolution divides the padded width — the tree-alignment
+        # invariant the bit matrix rests on.
+        assert resolve_chunk_slots(None, 24) == 32
+        assert resolve_chunk_slots(7, 24) == 4
+        assert resolve_chunk_slots(1, 24) == 1
+        assert resolve_chunk_slots(100, 24) == 32
+        assert resolve_chunk_slots(16, 16) == 16
+
+    def test_empty_market_reports_nan_band(self):
+        args = _band_args(M, K, "mask_holes")
+        out = _sharded_bands((1, 8), 4, args)
+        assert np.isnan(out.mean[0]) and np.isnan(out.lo[0])
+        assert out.count[0] == 0 and out.n_eff[0] == 0.0
+
+    def test_bad_chunk_string_rejected(self):
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        prog = build_band_program(mesh, chunk_slots="wide")
+        state = jax.tree.map(lambda x: x.T, init_block_state(M, K))
+        probs, valid, _rel = _band_args(M, K, "random")
+        with pytest.raises(ValueError, match="auto"):
+            prog(probs.T, valid.T, state, jnp.float32(400.0))
+
+
+class TestBandNumerics:
+    def test_matches_float64_reference(self):
+        probs, valid, rel = _band_args(M, K, "random", seed=3)
+        out = jax.jit(
+            partial(band_math, axis_name=None, axis_size=1)
+        )(probs, valid, rel)
+        w = np.where(np.asarray(valid), np.asarray(rel), 0).astype(
+            np.float64
+        )
+        p = np.asarray(probs, np.float64)
+        mean = (w * p).sum(1) / w.sum(1)
+        var = np.maximum((w * p * p).sum(1) / w.sum(1) - mean**2, 0)
+        n_eff = w.sum(1) ** 2 / (w * w).sum(1)
+        stderr = np.sqrt(var / n_eff)
+        np.testing.assert_allclose(np.asarray(out.mean), mean, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(out.stderr), stderr, rtol=1e-4, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.n_eff), n_eff, rtol=1e-5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out.count), np.asarray(valid).sum(1)
+        )
+        # The band brackets its own mean and stays in [0, 1].
+        lo, hi = np.asarray(out.lo), np.asarray(out.hi)
+        assert (lo <= np.asarray(out.mean) + 1e-6).all()
+        assert (hi >= np.asarray(out.mean) - 1e-6).all()
+        assert (lo >= 0).all() and (hi <= 1).all()
+
+    def test_uniform_signals_have_near_zero_width(self):
+        # The one-pass E[p²] − μ² form has a resolution floor of
+        # ~sqrt(eps_f32)·|mean| on the stderr (cancellation under the
+        # sqrt) — unanimous signals read as a band of width ≲ 1e-4, not
+        # exactly zero. That floor is documented in reliability.md; what
+        # must hold exactly is the clamp (no negative variance).
+        probs, valid, rel = _band_args(M, K, "uniform")
+        out = jax.jit(
+            partial(band_math, axis_name=None, axis_size=1)
+        )(probs, valid, rel)
+        assert (np.asarray(out.stderr) >= 0).all()
+        np.testing.assert_allclose(np.asarray(out.stderr), 0.0, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(out.lo), np.asarray(out.hi), atol=5e-4
+        )
+
+
+class TestBandMemoryDiet:
+    """The chunk knob's working-set collapse, read from the same AOT
+    ``memory_analysis()`` the bench leg reports (CPU materialises more
+    than TPU, but the chunked/unchunked ratio shows either way)."""
+
+    def test_chunked_temps_collapse_args_untouched(self):
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        m, k = 64, 1024
+        rng = np.random.default_rng(9)
+        probs = jnp.asarray(rng.random((k, m)), jnp.float32)
+        mask = jnp.asarray(rng.random((k, m)) < 0.9)
+        state = jax.tree.map(lambda x: x.T, init_block_state(m, k))
+        now = jnp.asarray(400.0, jnp.float32)
+
+        def mem(chunk):
+            return build_band_program(mesh, chunk_slots=chunk).lower(
+                probs, mask, state, now
+            ).compile().memory_analysis()
+
+        unchunked = mem(None)
+        chunked = mem(64)
+        assert (
+            chunked.temp_size_in_bytes < unchunked.temp_size_in_bytes / 2
+        ), (chunked.temp_size_in_bytes, unchunked.temp_size_in_bytes)
+        assert (
+            chunked.argument_size_in_bytes
+            == unchunked.argument_size_in_bytes
+        )
+
+
+class TestMarketGraph:
+    EDGES = [
+        ("parent", "leg-a", 2.0),
+        ("parent", "leg-b", 1.0),
+        ("leg-a", "parent", 0.5),
+    ]
+
+    def test_csr_structure(self):
+        graph = MarketGraph.from_edges(self.EDGES)
+        assert graph.num_nodes == 3 and graph.num_edges == 3
+        assert graph.node_ids == ["parent", "leg-a", "leg-b"]
+        assert list(graph.offsets) == [0, 2, 3, 3]
+        assert list(graph.indices) == [1, 2, 0]
+        assert list(graph.weights) == [2.0, 1.0, 0.5]
+
+    def test_fingerprint_order_sensitive(self):
+        a = MarketGraph.from_edges(self.EDGES)
+        b = MarketGraph.from_edges(self.EDGES)
+        assert a.fingerprint == b.fingerprint
+        reordered = MarketGraph.from_edges(
+            [self.EDGES[1], self.EDGES[0], self.EDGES[2]]
+        )
+        assert reordered.fingerprint != a.fingerprint
+        reweighted = MarketGraph.from_edges(
+            [("parent", "leg-a", 2.5)] + self.EDGES[1:]
+        )
+        assert reweighted.fingerprint != a.fingerprint
+        deeper = MarketGraph.from_edges(self.EDGES, steps=5)
+        assert deeper.fingerprint != a.fingerprint
+
+    def test_extended_fingerprint_covers_both_sides(self):
+        graph = MarketGraph.from_edges(self.EDGES)
+        other = MarketGraph.from_edges(self.EDGES[:2])
+        topo_a, topo_b = b"topology-a", b"topology-b"
+        assert graph.extended_fingerprint(topo_a) != (
+            graph.extended_fingerprint(topo_b)
+        )
+        assert graph.extended_fingerprint(topo_a) != (
+            other.extended_fingerprint(topo_a)
+        )
+        assert graph.extended_fingerprint(topo_a) == (
+            graph.extended_fingerprint(topo_a)
+        )
+
+    def test_align_pads_and_drops_absent_markets(self):
+        graph = MarketGraph.from_edges(self.EDGES)
+        # leg-b absent from the batch: parent keeps only its leg-a edge.
+        idx, w = graph.align(["leg-a", "parent"], padded_total=4)
+        assert idx.shape == w.shape == (4, 1)
+        assert idx[1, 0] == 0 and w[1, 0] == 2.0     # parent -> leg-a
+        assert idx[0, 0] == 1 and w[0, 0] == 0.5     # leg-a -> parent
+        assert (idx[2:] == -1).all() and (w[2:] == 0).all()
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="self-edge"):
+            MarketGraph.from_edges([("a", "a", 1.0)])
+        with pytest.raises(ValueError, match="weight"):
+            MarketGraph.from_edges([("a", "b", 0.0)])
+        with pytest.raises(ValueError, match="damping"):
+            MarketGraph.from_edges([("a", "b", 1.0)], damping=1.5)
+        with pytest.raises(ValueError, match="padded_total"):
+            MarketGraph.from_edges([("a", "b", 1.0)]).align(
+                ["a", "b"], padded_total=1
+            )
+
+
+class TestDampedSweep:
+    def test_hand_computed_single_step(self):
+        values = jnp.asarray([0.2, 0.8, 0.5], jnp.float32)
+        idx = jnp.asarray([[1, 2], [-1, -1], [0, -1]], jnp.int32)
+        w = jnp.asarray([[1.0, 3.0], [0.0, 0.0], [2.0, 0.0]], jnp.float32)
+        out = np.asarray(
+            jax.jit(
+                partial(damped_sweep_math, damping=0.5, steps=1)
+            )(values, idx, w)
+        )
+        # row 0: 0.5*0.2 + 0.5*(1*0.8 + 3*0.5)/4 = 0.1 + 0.2875
+        assert out[0] == pytest.approx(0.3875, abs=1e-6)
+        assert out[1] == pytest.approx(0.8)      # no edges: untouched
+        assert out[2] == pytest.approx(0.5 * 0.5 + 0.5 * 0.2, abs=1e-6)
+
+    def test_nan_neighbors_excluded_nan_rows_kept(self):
+        values = jnp.asarray([np.nan, 0.4, 0.6], jnp.float32)
+        idx = jnp.asarray([[1, -1], [0, 2], [-1, -1]], jnp.int32)
+        w = jnp.ones((3, 2), jnp.float32)
+        out = np.asarray(
+            jax.jit(
+                partial(damped_sweep_math, damping=0.5, steps=1)
+            )(values, idx, w)
+        )
+        assert np.isnan(out[0])  # a NaN row never heals from neighbours
+        # row 1's NaN neighbour (row 0) is excluded: only row 2 counts.
+        assert out[1] == pytest.approx(0.5 * 0.4 + 0.5 * 0.6, abs=1e-6)
+
+    def test_zero_steps_identity(self):
+        values = jnp.asarray([0.2, 0.8], jnp.float32)
+        idx = jnp.asarray([[1], [0]], jnp.int32)
+        w = jnp.ones((2, 1), jnp.float32)
+        out = damped_sweep_math(values, idx, w, damping=0.5, steps=0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(values))
+
+    @pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4)])
+    def test_sharded_matches_unsharded_bitwise(self, mesh_shape):
+        rng = np.random.default_rng(4)
+        m, d = 32, 3
+        values = jnp.asarray(rng.random(m), jnp.float32)
+        idx = jnp.asarray(rng.integers(-1, m, (m, d)), jnp.int32)
+        w = jnp.asarray(rng.uniform(0.1, 2.0, (m, d)), jnp.float32)
+        want = np.asarray(
+            jax.jit(
+                partial(damped_sweep_math, damping=0.5, steps=3)
+            )(values, idx, w)
+        )
+        mesh = make_mesh(mesh_shape)
+        fn = shard_map(
+            partial(
+                damped_sweep_math,
+                damping=0.5, steps=3, axis_name=MARKETS_AXIS,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(MARKETS_AXIS), P(MARKETS_AXIS, None),
+                P(MARKETS_AXIS, None),
+            ),
+            out_specs=P(MARKETS_AXIS),
+            check_vma=False,
+        )
+        got = np.asarray(jax.jit(fn)(values, idx, w))
+        np.testing.assert_array_equal(got, want)
+
+
+class TestFusedAnalyticsLoop:
+    """build_cycle_analytics_loop: cycles + tie-break + bands (+ sweep)
+    in ONE program. The loop half must keep the plain loop's bytes —
+    consensus INCLUDED (the analytics on/off parity contract leans on
+    it); the bands half must equal the standalone program bitwise."""
+
+    def _slot_major_inputs(self, seed=5):
+        rng = np.random.default_rng(seed)
+        m, k = 32, 16
+        probs = jnp.asarray(rng.random((k, m)), jnp.float32)
+        mask = jnp.asarray(rng.random((k, m)) < 0.8)
+        outcome = jnp.asarray(rng.random(m) < 0.5)
+        state = MarketBlockState(
+            reliability=jnp.asarray(
+                rng.uniform(0.1, 1.0, (k, m)), jnp.float32
+            ),
+            confidence=jnp.asarray(
+                rng.uniform(0.0, 1.0, (k, m)), jnp.float32
+            ),
+            updated_days=jnp.asarray(
+                rng.choice([0.0, 5.0, 400.0], (k, m)), jnp.float32
+            ),
+            exists=jnp.asarray(rng.random((k, m)) < 0.6),
+        )
+        return probs, mask, outcome, state, jnp.float32(401.0)
+
+    @pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4)])
+    @pytest.mark.parametrize("steps", [1, 3])
+    def test_fused_equals_loop_and_standalone_bands(
+        self, mesh_shape, steps
+    ):
+        mesh = make_mesh(mesh_shape)
+        probs, mask, outcome, state, now0 = self._slot_major_inputs()
+        fused = build_cycle_analytics_loop(
+            mesh, chunk_agents=5, chunk_slots=4, donate=False
+        )
+        st_f, cons_f, _tb, bands, prop = fused(
+            probs, mask, outcome, state, now0, steps
+        )
+        assert prop is None
+        st_p, cons_p = build_cycle_loop(mesh, donate=False)(
+            probs, mask, outcome, state, now0, steps
+        )
+        # Consensus AND state bit-equal to the plain loop: the fused
+        # program reuses the same loop scaffold and the analytics reads
+        # share no reduction with it (pinned here; the serve analytics
+        # byte-parity suite below rests on this).
+        np.testing.assert_array_equal(
+            np.asarray(cons_f), np.asarray(cons_p)
+        )
+        for got, want in zip(st_f, st_p):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+        # Bands == the standalone program fed the same resident state
+        # (bit: both run band_math's tree order at the same chunk).
+        standalone = build_band_program(mesh, chunk_slots=4)(
+            probs, mask, state, now0
+        )
+        for name, got, want in zip(bands._fields, bands, standalone):
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want), err_msg=name
+            )
+
+    def test_fused_sweep_equals_post_hoc_sweep(self):
+        mesh = make_mesh((2, 4))
+        probs, mask, outcome, state, now0 = self._slot_major_inputs(7)
+        m = probs.shape[1]
+        rng = np.random.default_rng(11)
+        nb_idx = jnp.asarray(rng.integers(-1, m, (m, 3)), jnp.int32)
+        nb_w = jnp.asarray(rng.uniform(0.5, 1.5, (m, 3)), jnp.float32)
+        fused = build_cycle_analytics_loop(
+            mesh, chunk_slots=4, donate=False, damping=0.5, sweep_steps=2
+        )
+        _st, cons, _tb, _bands, prop = fused(
+            probs, mask, outcome, state, now0, 2, nb_idx, nb_w
+        )
+        want = jax.jit(
+            partial(damped_sweep_math, damping=0.5, steps=2)
+        )(jnp.asarray(np.asarray(cons)), nb_idx, nb_w)
+        np.testing.assert_allclose(
+            np.asarray(prop), np.asarray(want), rtol=1e-6, equal_nan=True
+        )
+
+    def test_tiebreak_stage_optional(self):
+        # with_tiebreak=False drops the ring stage from the program:
+        # None in its slot, bands and the loop bytes untouched.
+        mesh = make_mesh((2, 4))
+        probs, mask, outcome, state, now0 = self._slot_major_inputs()
+        bands_only = build_cycle_analytics_loop(
+            mesh, chunk_slots=4, donate=False, with_tiebreak=False
+        )
+        st_b, cons_b, tb, bands, _prop = bands_only(
+            probs, mask, outcome, state, now0, 2
+        )
+        assert tb is None
+        full = build_cycle_analytics_loop(
+            mesh, chunk_agents=5, chunk_slots=4, donate=False
+        )
+        st_f, cons_f, tb_f, bands_f, _ = full(
+            probs, mask, outcome, state, now0, 2
+        )
+        assert tb_f is not None
+        np.testing.assert_array_equal(np.asarray(cons_b), np.asarray(cons_f))
+        for got, want in zip(bands, bands_f):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        for got, want in zip(st_b, st_f):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_missing_graph_blocks_rejected(self):
+        mesh = make_mesh((8, 1))
+        probs, mask, outcome, state, now0 = self._slot_major_inputs()
+        fused = build_cycle_analytics_loop(
+            mesh, donate=False, sweep_steps=2
+        )
+        with pytest.raises(ValueError, match="neighbor"):
+            fused(probs, mask, outcome, state, now0, 1)
+
+    def test_unexpected_graph_blocks_rejected(self):
+        # The symmetric mistake — neighbour blocks against a sweepless
+        # program — must fail with the clear message, not a jax
+        # arity/spec error from inside shard_map.
+        mesh = make_mesh((8, 1))
+        probs, mask, outcome, state, now0 = self._slot_major_inputs()
+        sweepless = build_cycle_analytics_loop(mesh, donate=False)
+        nb = jnp.zeros((probs.shape[1], 2), jnp.int32)
+        with pytest.raises(ValueError, match="sweep_steps=0"):
+            sweepless(
+                probs, mask, outcome, state, now0, 1, nb,
+                nb.astype(jnp.float32),
+            )
+
+
+def _market_payloads(markets=12, srcs=5, seed=7):
+    rng = np.random.default_rng(seed)
+    payloads = [
+        (
+            f"m-{i}",
+            [
+                {"sourceId": f"s-{j}", "probability": float(rng.random())}
+                for j in range(srcs)
+            ],
+        )
+        for i in range(markets)
+    ]
+    return payloads, list(rng.random(markets) < 0.5)
+
+
+class TestSessionAnalytics:
+    def test_settlement_bytes_equal_plain_settle(self):
+        payloads, outcomes = _market_payloads()
+        mesh = make_mesh()
+        graph = MarketGraph.from_edges(
+            [("m-0", "m-1", 1.0), ("m-1", "m-2", 0.5), ("m-3", "m-0", 2.0)]
+        )
+        stores = [TensorReliabilityStore() for _ in range(2)]
+        plans = [
+            build_settlement_plan(s, payloads, num_slots=8) for s in stores
+        ]
+        with ShardedSettlementSession(stores[0], plans[0], mesh) as plain:
+            plain_result = plain.settle(outcomes, steps=2, now=NOW)
+        with ShardedSettlementSession(stores[1], plans[1], mesh) as fused:
+            result, tiebreak, bands, prop = fused.settle_with_analytics(
+                outcomes, steps=2, now=NOW,
+                analytics=AnalyticsOptions(graph=graph, chunk_slots=4),
+            )
+        # Point consensus BIT-equal (not tolerance): analytics must be
+        # invisible to the settlement surface.
+        np.testing.assert_array_equal(
+            np.asarray(result.consensus), np.asarray(plain_result.consensus)
+        )
+        rows = np.arange(stores[0].live_row_count())
+        for got, want in zip(
+            stores[1].host_rows(rows), stores[0].host_rows(rows)
+        ):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # Analytics fields are populated and coherent.
+        lo, mean, hi = (
+            np.asarray(bands.lo), np.asarray(bands.mean), np.asarray(bands.hi)
+        )
+        assert (lo <= mean + 1e-6).all() and (mean <= hi + 1e-6).all()
+        assert np.isfinite(np.asarray(prop)).all()
+        assert np.asarray(tiebreak.prediction).shape == mean.shape
+
+    def test_graph_blocks_cached_across_settles(self, monkeypatch):
+        payloads, outcomes = _market_payloads()
+        mesh = make_mesh()
+        graph = MarketGraph.from_edges([("m-0", "m-1", 1.0)])
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads, num_slots=8)
+        calls = []
+        original = MarketGraph.align
+
+        def counting_align(self, *args, **kwargs):
+            calls.append(1)
+            return original(self, *args, **kwargs)
+
+        monkeypatch.setattr(MarketGraph, "align", counting_align)
+        options = AnalyticsOptions(graph=graph)
+        with ShardedSettlementSession(store, plan, mesh) as session:
+            session.settle_with_analytics(
+                outcomes, now=NOW, analytics=options
+            )
+            session.settle_with_analytics(
+                outcomes, now=NOW + 1, analytics=options
+            )
+        # Same plan topology + same graph: aligned once, reused after.
+        assert len(calls) == 1
+
+    def test_rejects_unknown_chunk_string(self):
+        payloads, outcomes = _market_payloads(markets=2, srcs=2)
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads, num_slots=4)
+        with ShardedSettlementSession(store, plan, make_mesh()) as session:
+            with pytest.raises(ValueError, match="standalone"):
+                session.settle_with_analytics(
+                    outcomes, now=NOW,
+                    analytics=AnalyticsOptions(chunk_slots="auto"),
+                )
+
+
+def _journal_epochs_sans_clock(path):
+    """Decoded epoch frames with the wall-clock field masked (same
+    helper as test_serve/test_overlap)."""
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = hdr.unpack_from(blob, off)
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4  # + crc32
+    return epochs
+
+
+def _serve_trace(width=6):
+    """Hits + drift + growth, every round *width* distinct markets."""
+    trace = []
+    for rnd in range(2):
+        for m in range(width):
+            trace.append((
+                f"m-{m}",
+                [(f"s-{m}", 0.55 + 0.01 * rnd), (f"s-{(m + 1) % 3}", 0.4)],
+                (m + rnd) % 2 == 0,
+            ))
+    for m in range(width):
+        trace.append((
+            f"fresh-{m}", [(f"s-{m % 3}", 0.62), (f"g-{m}", 0.48)],
+            m % 2 == 1,
+        ))
+    return trace
+
+
+def _run_service(tmp_path, name, analytics):
+    """Submit the trace, drain, close; return (service, results)."""
+    from bayesian_consensus_engine_tpu.serve import ConsensusService
+
+    store = TensorReliabilityStore()
+    trace = _serve_trace()
+
+    async def main():
+        service = ConsensusService(
+            store,
+            steps=2,
+            now=NOW,
+            mesh=make_mesh(),
+            journal=tmp_path / f"{name}.jrnl",
+            db_path=tmp_path / f"{name}.db",
+            checkpoint_every=2,
+            max_batch=6,
+            max_delay_s=None,
+            record_batches=True,
+            analytics=analytics,
+        )
+        futures = []
+        async with service:
+            for market_id, signals, outcome in trace:
+                futures.append(service.submit(market_id, signals, outcome))
+            await service.drain()
+        return service, [f.result() for f in futures]
+
+    service, results = asyncio.run(main())
+    store.sync()
+    return service, results
+
+
+class TestServeAnalyticsByteParity:
+    """The acceptance contract: ``ConsensusService(analytics=...)`` on vs
+    off over the same trace — batch sequence, per-request consensus,
+    journal epoch payloads (wall_ts masked), and SQLite bytes all
+    IDENTICAL; only the additive band fields differ (None vs values)."""
+
+    def test_analytics_on_off_byte_parity(self, tmp_path):
+        graph = MarketGraph.from_edges(
+            [("m-0", "m-1", 1.0), ("m-2", "m-0", 0.5)]
+        )
+        svc_on, res_on = _run_service(
+            tmp_path, "on", AnalyticsOptions(graph=graph)
+        )
+        svc_off, res_off = _run_service(tmp_path, "off", None)
+
+        assert len(svc_on.batch_log) == len(svc_off.batch_log)
+        for (cols_a, out_a), (cols_b, out_b) in zip(
+            svc_on.batch_log, svc_off.batch_log
+        ):
+            assert cols_a[0] == cols_b[0] and out_a == out_b
+        for a, b in zip(res_on, res_off):
+            assert a.market_id == b.market_id
+            assert a.consensus == b.consensus  # bit-equal floats
+            assert a.batch_index == b.batch_index
+            assert a.band_lo is not None and a.band_hi is not None
+            assert a.band_lo <= a.consensus + 1e-6
+            assert a.band_hi >= a.consensus - 1e-6
+            assert b.band_lo is None and b.propagated is None
+        assert _journal_epochs_sans_clock(tmp_path / "on.jrnl") == (
+            _journal_epochs_sans_clock(tmp_path / "off.jrnl")
+        )
+        assert (tmp_path / "on.db").read_bytes() == (
+            tmp_path / "off.db"
+        ).read_bytes()
+
+    def test_analytics_requires_resident_mesh(self):
+        from bayesian_consensus_engine_tpu.serve import SessionDriver
+
+        with pytest.raises(ValueError, match="resident"):
+            SessionDriver(TensorReliabilityStore(), analytics=True)
+        with pytest.raises(TypeError, match="AnalyticsOptions"):
+            SessionDriver(
+                TensorReliabilityStore(), mesh=make_mesh(),
+                analytics="bands",
+            )

@@ -252,6 +252,53 @@ class TestRingChunkWiring:
         assert chunk == ring.DEFAULT_CHUNK_AGENTS
         assert not (tmp_path / "never.json").exists()
 
+
+class TestBandChunkWiring:
+    """chunk_slots="auto" (analytics/bands.py) rides the same ShapeTuner
+    contract as the ring chunk: its own knob and shape key, candidates
+    clamped to the shard's slot width, the recorded default raced by the
+    honesty guard."""
+
+    def test_auto_resolves_through_tuner(self, monkeypatch):
+        from bayesian_consensus_engine_tpu.analytics import bands
+        from bayesian_consensus_engine_tpu.ops.uncertainty import (
+            DEFAULT_CHUNK_SLOTS,
+        )
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        seen = {}
+
+        class FakeTuner:
+            def tune(self, knob, shape_key, candidates, measure, default):
+                seen.update(
+                    knob=knob, shape_key=shape_key,
+                    candidates=candidates, default=default,
+                )
+                return 8
+
+        monkeypatch.setattr(autotune, "default_tuner", lambda: FakeTuner())
+        mesh = make_mesh((1, 8))
+        chunk = bands._tuned_chunk_slots(mesh, 1.96, (80_000, 16))
+        assert chunk == 8
+        assert seen["knob"] == "band_chunk_slots"
+        assert seen["shape_key"] == (80_000, 16, 1, 8)
+        assert seen["default"] == DEFAULT_CHUNK_SLOTS
+        assert seen["candidates"] == [128, 256, 512, 2048, 10_000]
+
+    def test_tiny_shard_short_circuits_to_default(self, monkeypatch):
+        from bayesian_consensus_engine_tpu.analytics import bands
+        from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        def boom():
+            raise AssertionError("tuner must not be constructed")
+
+        monkeypatch.setattr(autotune, "default_tuner", boom)
+        assert bands._tuned_chunk_slots(
+            make_mesh((1, 8)), 1.96, (32, 16)
+        ) == 4
+
     def test_enabled_tunes_races_default_and_runs(self, monkeypatch,
                                                   tmp_path):
         """End-to-end: a real (tiny) measured tune through the honesty

@@ -580,6 +580,50 @@ class TestRingMemoryLeg:
         assert "e2e_ring_memory" in bench.DEVICE_LEG_ORDER
 
 
+class TestAnalyticsLeg:
+    """ISSUE-10's ``e2e_analytics`` at --fast shapes: bands-only vs
+    fused-resident vs +graph-sweep with the AOT co-residency argument
+    capture. Bit-parity of the paths is pinned by tests/test_analytics.py;
+    this pins the LEG contract."""
+
+    def test_fast_leg_reports_coresidency_ab(self):
+        result = bench.run_leg_inprocess("e2e_analytics", fast=True)
+        for variant in ("bands_only", "fused_resident", "fused_graph"):
+            for key in ("wall_s", "markets_per_sec", "compiled_temp_bytes",
+                        "arg_bytes", "wall_s_band", "repeats"):
+                assert key in result[variant], (variant, key)
+        # The acceptance bar: dispatching bands inside the fused
+        # resident program costs ≤ half the arg bytes of a separate
+        # bands program after settle (measured marginal ≈ an outcomes
+        # vector — the block rides once).
+        assert result["fused_halves_band_args"] is True
+        assert (
+            result["bands_marginal_arg_bytes"]
+            <= result["bands_separate_arg_bytes"] / 2
+        )
+        # Whole-pipeline reading recorded alongside (fused program vs
+        # settle + separate bands programs).
+        assert result["fused_arg_bytes"] < result["separate_arg_bytes"]
+        assert 0 < result["coresident_arg_ratio"] < 1
+        # The graph sweep's marginal arguments are the tiny neighbour
+        # blocks, never a second copy of the state.
+        assert (
+            result["sweep_marginal_arg_bytes"]
+            < result["fused_arg_bytes"] / 10
+        )
+        # The live co-resident session act ran (it is what records the
+        # `analytics` phase span into the leg's breakdown).
+        assert result["session_fused_dispatch_s"] > 0
+        json.dumps(result)
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_analytics" in bench.LEGS
+        assert "e2e_analytics" in bench.DEVICE_LEG_ORDER
+        assert "e2e_analytics" in bench.compose(
+            {}, [], None, 0.0
+        )[0]["extras"]
+
+
 class TestOverlapAdjudication:
     """The re-adjudicated e2e_overlap leg (VERDICT r5 #2): min-of-N
     alternating repeats, per-repeat load, a band, and a documented

@@ -144,6 +144,17 @@ _CASES = [
         f"from {PKG}.utils import config\n",
     ),
     (
+        # Round 12: analytics sits above ops/parallel and below
+        # pipeline/serve — reaching up into the serving tier from an
+        # analytics module is an upward import; building on the mesh
+        # machinery below is the designed direction.
+        "LY301",
+        f"{PKG}/analytics/case.py",
+        f"from {PKG}.serve.driver import SessionDriver\n",
+        f"from {PKG}.parallel.sharded import read_phase\n"
+        f"from {PKG}.ops.uncertainty import band_math\n",
+    ),
+    (
         "LY302",
         f"{PKG}/core/case.py",
         "import jax.numpy as jnp\n\nSENTINEL = jnp.int32(0)\n",
@@ -319,6 +330,10 @@ class TestLayeringResolution:
                 f"{PKG}/serve/coalesce.py",
                 f"{PKG}/state/journal.py",
                 f"{PKG}/cli.py",
+                # Round 12: analytics surfaces are orchestration-
+                # adjacent (graph alignment, tuner resolution) — allowed;
+                # the analytics KERNELS live in ops/ and stay flagged.
+                f"{PKG}/analytics/bands.py",
             ):
                 assert _codes(src, rel, select=["LY303"]) == [], (src, rel)
 

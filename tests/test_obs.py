@@ -305,9 +305,9 @@ class TestTimeline:
 
     def test_canonical_phase_vocabulary(self):
         assert obs.PHASES == (
-            "pack", "upload", "state_adopt", "settle_dispatch", "fetch",
-            "journal_fsync", "journal_async_wait", "checkpoint",
-            "interchange_export",
+            "pack", "upload", "state_adopt", "settle_dispatch",
+            "analytics", "fetch", "journal_fsync", "journal_async_wait",
+            "checkpoint", "interchange_export",
         )
 
 

@@ -560,6 +560,110 @@ class TestLatencyAccounting:
         assert export["gauges"]["serve.pending_requests"] == 0.0
 
 
+class TestAdaptiveWindow:
+    """ROADMAP item 1 follow-up: aim the coalescer at a latency SLO.
+    The controller's nudge sequence must be a pure function of the
+    observed latency trace — a fixed trace yields a fixed window
+    sequence (fixed factors, fixed log buckets, no clock of its own)."""
+
+    def _controller(self):
+        from bayesian_consensus_engine_tpu.serve import AdaptiveWindow
+
+        return AdaptiveWindow(target_p99_s=0.1, initial_delay_s=0.005)
+
+    def test_fixed_trace_yields_fixed_window_sequence(self):
+        # Three batches of synthetic latencies: comfortably fast (grow),
+        # over-target (halve), then mixed-but-dominated-by-slow (halve).
+        batches = [
+            [0.01, 0.02, 0.015],
+            [0.3, 0.25, 0.4],
+            [0.05, 0.5],
+        ]
+
+        def run():
+            window = self._controller()
+            for latencies in batches:
+                for latency in latencies:
+                    window.observe(latency)
+                window.step()
+            return window.delay_log
+
+        first, second = run(), run()
+        assert first == second, "window sequence must be trace-pure"
+        assert len(first) == 1 + len(batches)
+        # Batch 1: p99 ≪ target/2 → grow 25%. Batches 2-3: p99 over
+        # target → halve, clamped at the floor.
+        assert first[1] == pytest.approx(0.005 * 1.25)
+        assert first[2] == pytest.approx(first[1] * 0.5)
+        assert first[3] >= self._controller().floor_s
+
+    def test_nudges_clamp_to_floor_and_cap(self):
+        window = self._controller()
+        for _ in range(40):  # relentless overshoot: pin to the floor
+            window.observe(10.0)
+            window.step()
+        assert window.delay_s == window.floor_s
+        fast = self._controller()
+        for _ in range(40):  # relentless headroom: pin to the cap
+            fast.observe(1e-4)
+            fast.step()
+        assert fast.delay_s == fast.cap_s
+        assert fast.cap_s == pytest.approx(4 * 0.005)
+
+    def test_holds_between_half_and_full_target(self):
+        window = self._controller()
+        window.observe(0.08)  # between target/2 and target: hold
+        assert window.step() == pytest.approx(0.005)
+
+    def test_exact_p99_has_no_bucket_bias(self):
+        # The p99 is an exact order statistic, not a log-bucket
+        # estimate: a true p99 just UNDER the target must never read as
+        # over it (a bucket edge's upward bias would halve the window
+        # forever for a service comfortably inside its SLO).
+        from bayesian_consensus_engine_tpu.serve import AdaptiveWindow
+
+        window = AdaptiveWindow(target_p99_s=0.03, initial_delay_s=0.002)
+        for _ in range(5):
+            for _ in range(20):
+                window.observe(0.02)  # true p99 = 0.02 < 0.03
+            window.step()
+        assert all(d >= 0.002 for d in window.delay_log), window.delay_log
+
+    def test_empty_window_holds(self):
+        window = self._controller()
+        assert window.step() == pytest.approx(0.005)  # nothing observed
+
+    def test_service_wiring_and_validation(self, tmp_path):
+        from bayesian_consensus_engine_tpu.serve import ConsensusService
+
+        store = TensorReliabilityStore()
+        with pytest.raises(ValueError, match="max_delay_s"):
+            ConsensusService(store, max_delay_s=None, target_p99_s=0.05)
+
+        async def main():
+            service = ConsensusService(
+                store, now=NOW, max_batch=4, max_delay_s=0.002,
+                target_p99_s=5.0, record_batches=True,
+            )
+            futures = []
+            async with service:
+                for i in range(8):
+                    futures.append(service.submit(
+                        f"m-{i}", [("s", 0.5)], True
+                    ))
+                await service.drain()
+            return service, [f.result() for f in futures]
+
+        service, results = asyncio.run(main())
+        assert all(r.consensus == results[0].consensus for r in results)
+        # One nudge per completed batch, logged in batch order; the
+        # giant target means every nudge grew or held the window.
+        assert len(service.window.delay_log) == len(service.batch_log) + 1
+        assert all(
+            d >= 0.002 for d in service.window.delay_log
+        )
+
+
 class TestSessionDriverApi:
     """The tentpole's refactor contract: SessionDriver driven directly
     (the serving worker's shape) equals settle_stream on the same
