@@ -16,6 +16,8 @@ Layers (bottom → top), mirroring the reference's layer map but TPU-first:
   parallel/  device mesh + shard_map sharded consensus/update step
   analytics/ additive device-resident analytics: uncertainty bands +
              correlated-market consensus (graph-propagated)
+  cluster/   multi-host membership views (epoch-tagged, coordinator-free)
+             + journal-driven degraded-mesh recovery
   pipeline   payloads → plan → device settle → store → SQLite, end to end
              (sessions, the streamed service loop, mesh/band sharding)
   serve/     online micro-batch coalescing front end over the session

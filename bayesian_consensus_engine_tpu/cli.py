@@ -224,8 +224,11 @@ def _run_stats(args: argparse.Namespace) -> None:
     device allocator's high-water mark into ``extras.hbm_peak_bytes``
     (the ring-memory leg, on devices exposing allocator stats) render
     the ``peak_mem`` column (min across repeats), so a memory regression
-    shows up in the same table as a wall-time one. ``--json`` emits the
-    machine-shaped summary instead of the table.
+    shows up in the same table as a wall-time one; legs carrying
+    recovery accounting (``extras.recovery_s`` + ``extras.slo`` — the
+    kill-soak leg) render the ``recovery`` column beside ``goodput``,
+    the failure story in one row. ``--json`` emits the machine-shaped
+    summary instead of the table.
 
     ``--against OLD.jsonl`` switches to cross-round diffing: each leg's
     band is compared against the old ledger's and flagged when the bands

@@ -1,0 +1,51 @@
+"""cluster/ — multi-host membership, degraded-mesh views, and recovery.
+
+The round-13 subsystem (ROADMAP item 1): make the resident settlement
+session a multi-host citizen and make host loss a recoverable event
+rather than a process death. Three coordinated pieces:
+
+* :mod:`~.cluster.membership` — deterministic, epoch-tagged
+  :class:`MeshView` layouts over a host set: every host computes the
+  SAME canonical mesh factorisation and band assignment from the same
+  membership epoch, with no coordinator (agreement is by construction —
+  the view is a pure function of the sorted host set), plus the
+  *degraded* view derived from any surviving subset.
+* :mod:`~.cluster.recover` — journal-driven recovery: merge the
+  surviving per-band durability journals (:mod:`~.state.journal`) into
+  one store deterministically, adopt a dead band's journal into a LIVE
+  surviving stream, and pin the degraded-mesh byte contract (a merge of
+  one journal is bit-equal to ``replay_journal`` of it).
+* the session side lives where the session lives: round 13 extended
+  ``ShardedSettlementSession.adopt`` (pipeline.py) past the PR-5
+  teardown+rebuild fallback, so band-mode and cluster deployments keep
+  their reliability block resident across topology drift — the
+  ``stream.resident_fallbacks`` counter measures the retirement.
+
+Failure is steady state here (PAPERS.md: SIGMA's early-life-hardware
+stack; the TPU v2→Ironwood goodput framing): the reported health metric
+of a kill is **recovered ``goodput_within_slo``** (obs/slo.py — refused
+and crash-eaten traffic counting against), measured end to end by
+``scripts/kill_soak.py`` and the ``e2e_kill_soak`` bench leg.
+"""
+
+from bayesian_consensus_engine_tpu.cluster.membership import (
+    MeshView,
+    runtime_view,
+)
+from bayesian_consensus_engine_tpu.cluster.recover import (
+    ClusterModeUnsupported,
+    ClusterReplay,
+    adopt_journal,
+    replay_cluster_journals,
+    store_digest,
+)
+
+__all__ = [
+    "ClusterModeUnsupported",
+    "ClusterReplay",
+    "MeshView",
+    "adopt_journal",
+    "replay_cluster_journals",
+    "runtime_view",
+    "store_digest",
+]

@@ -1,0 +1,199 @@
+"""Journal-driven recovery onto a degraded membership view.
+
+Host loss must cost one membership epoch and a journal replay — never
+the service (ROADMAP item 1; PAPERS.md's failure-as-steady-state
+framing). The durable truth of a band is its append-only journal
+(:mod:`~.state.journal`): each host's journal replays to exactly its
+band's store, independent of which mesh factorisation wrote it (replay
+is pure host-side row arithmetic — the degraded-mesh byte contract the
+crash-resume suite pins). Recovery is therefore composition:
+
+* :func:`replay_cluster_journals` — merge N band journals into ONE
+  fresh store, deterministically: journals in the given order, each
+  journal's pairs interned in its own replay order. A single-journal
+  merge is **bit-equal** to :func:`~.state.journal.replay_journal` by
+  construction (same frame walk, same intern order, same value writes),
+  which is the contract that makes "replay onto the surviving-host
+  mesh" and "single-host replay" the same bytes.
+* :func:`adopt_journal` — replay a dead band's journal INTO a live
+  surviving store mid-stream: the survivor interns the orphan pairs
+  (disjoint from its own by the band partition — enforced), absorbs the
+  replayed values, and its resident session picks the rows up on the
+  next batch's adopt (the round-13 relayout path: orphan rows enter the
+  device block as host-exact uploads, the surviving rows never leave
+  HBM). The survivor's own NEXT journal epoch then carries the adopted
+  band — the dead journal is read once and never needed again.
+
+Split-brain is refused, not merged: a (source, market) pair appearing in
+two journals means two hosts both claimed its band — recovery raises
+rather than guess which history wins.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu.state.journal import (
+    MAGIC,
+    _iter_frames,
+    _read_exact,
+)
+
+
+class ClusterModeUnsupported(RuntimeError):
+    """A cluster/band-mode deployment asked for a route that is not
+    served on this topology yet. The message names the supported route
+    (the shared-nothing band membership of
+    :class:`~.cluster.membership.MeshView`) and what remains open."""
+
+
+@dataclass
+class ClusterReplay:
+    """The result of merging band journals onto one store.
+
+    ``tags[i]`` is journal *i*'s last complete epoch watermark (``None``
+    for a journal with no complete epoch) — with ``settle_stream``'s
+    ``journal=`` mode, the last durably-covered settled batch index of
+    that band; the band resumes from ``tags[i] + 1``. ``rows[i]`` is how
+    many interned rows journal *i* contributed.
+    """
+
+    store: object
+    paths: Tuple[str, ...]
+    tags: Tuple[Optional[int], ...]
+    rows: Tuple[int, ...]
+
+    def resume_index(self, which: int) -> int:
+        """First un-durable batch index of band *which* (0 when the
+        journal held no complete epoch)."""
+        tag = self.tags[which]
+        return 0 if tag is None else tag + 1
+
+
+def _replay_into(store, path: str) -> Tuple[Optional[int], int]:
+    """Replay *path*'s epochs into *store*, remapping journal-local rows
+    onto the store's interner. Returns ``(last_tag, rows_contributed)``.
+
+    The journal's pairs intern in replay order — for a fresh store and a
+    single journal that reproduces :func:`~.state.journal.replay_journal`
+    row for row; for a merge, each journal's block appends after the
+    previous journals' rows, and any pair already interned (an
+    overlapping band) is a split-brain refusal.
+    """
+    row_map: List[int] = []
+    last_tag: Optional[int] = None
+    with open(path, "rb") as f:
+        if _read_exact(f, len(MAGIC)) != MAGIC:
+            raise ValueError(f"{path}: not a BCE journal (bad magic)")
+        for fields, decoded, _off in _iter_frames(f):
+            tag = fields[6]
+            pairs, idx, rel, conf, days, exists, iso_values = decoded
+            if pairs:
+                before = len(store)
+                rows = store.rows_for_pairs(pairs, allocate=True)
+                if list(rows) != list(range(before, before + len(pairs))):
+                    raise ValueError(
+                        f"{path}: journal pairs overlap rows already "
+                        "replayed from another journal — two hosts "
+                        "claimed the same band (split-brain); refusing "
+                        "to merge"
+                    )
+                row_map.extend(int(r) for r in rows)
+            mapped = np.asarray(row_map, dtype=np.int64)[
+                idx.astype(np.int64)
+            ]
+            store.absorb_replayed_rows(
+                mapped, rel, conf, days, exists.astype(bool), iso_values
+            )
+            last_tag = int(tag)
+    return last_tag, len(row_map)
+
+
+def replay_cluster_journals(
+    paths: Sequence[Union[str, Path]]
+) -> ClusterReplay:
+    """Rebuild ONE store from N band journals, deterministically.
+
+    Journals replay in the order given (callers pass a sorted list —
+    every surviving host must pass the SAME order to agree on row
+    assignment; sorting by path is the convention the kill soak and the
+    recovery example use). Each journal's contribution is exactly what
+    :func:`~.state.journal.replay_journal` would rebuild from it alone —
+    a one-journal call is bit-equal to ``replay_journal`` (store arrays,
+    pair order, ISO sidecars), pinned by tests/test_cluster.py.
+    """
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    if not paths:
+        raise ValueError("no journals to replay")
+    store = TensorReliabilityStore()
+    tags: List[Optional[int]] = []
+    rows: List[int] = []
+    for path in paths:
+        tag, contributed = _replay_into(store, str(path))
+        tags.append(tag)
+        rows.append(contributed)
+    return ClusterReplay(
+        store=store,
+        paths=tuple(str(p) for p in paths),
+        tags=tuple(tags),
+        rows=tuple(rows),
+    )
+
+
+def adopt_journal(store, path: Union[str, Path]) -> Tuple[Optional[int], int]:
+    """Replay a dead band's journal INTO a live surviving *store*.
+
+    The survivor-side half of degraded-mesh recovery: called between
+    batches (the stream's batch generator is the natural site — see
+    scripts/kill_soak.py), it appends the orphan band's pairs to the
+    live interner and absorbs its replayed values, so the very next
+    batch that covers the orphan markets finds host-exact state and the
+    resident session's adopt carries it onto the device block as
+    entering rows. Returns ``(last_tag, rows_adopted)`` — the orphan
+    band's workload resumes from ``last_tag + 1``.
+
+    The adopted rows are fresh by the band partition (an overlap raises
+    — split-brain), hence disjoint from every pending device recipe of
+    the live stream: the adoption never stalls on, nor perturbs, the
+    survivor's own deferred settlements.
+    """
+    return _replay_into(store, str(path))
+
+
+def store_digest(store) -> str:
+    """Order-sensitive content digest of a store's replayable state.
+
+    Hashes the interned pair list (in row order), the four value
+    columns, and the ISO sidecars — everything journal replay
+    reproduces — length-delimited. Two stores with equal digests hold
+    bit-identical state in identical row order; the kill soak and the
+    recovery example use it as the byte-exactness coda's witness.
+    Deferred device settlements are synced first (the digest is of the
+    durable truth, not a racing snapshot).
+    """
+    store.sync()
+    used = len(store)
+    h = hashlib.blake2b(digest_size=16)
+
+    def put(raw: bytes) -> None:
+        h.update(len(raw).to_bytes(8, "little"))
+        h.update(raw)
+
+    for source_id, market_id in store._pairs.ids():
+        put(source_id.encode())
+        put(market_id.encode())
+    put(np.ascontiguousarray(store._rel[:used]).tobytes())
+    put(np.ascontiguousarray(store._conf[:used]).tobytes())
+    put(np.ascontiguousarray(store._days[:used]).tobytes())
+    put(np.ascontiguousarray(store._exists[:used]).tobytes())
+    for value in store._iso[:used]:
+        put(value.encode())
+    return h.hexdigest()

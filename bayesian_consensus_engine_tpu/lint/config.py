@@ -47,6 +47,11 @@ LAYERS: dict[str, int] = {
     # session entry, the service's analytics= mode) — so it must be
     # importable from above and must never import upward.
     "analytics": 6,
+    # cluster (membership views + journal recovery) sits beside
+    # analytics: it builds on parallel's mesh machinery and state's
+    # journal, and is orchestrated by pipeline/serve and the soak
+    # scripts — importable from above, never importing upward.
+    "cluster": 6,
     # pipeline and serve share a layer: settle_stream runs on the serve
     # layer's SessionDriver while serve's coalescer builds plans through
     # pipeline — one orchestration tier, two faces (batch and online).

@@ -199,7 +199,50 @@ def min_of_repeats(
     band.update(_slo_summary(records, leg))
     band.update(_ingest_wait_summary(records, leg))
     band.update(_peak_mem_summary(records, leg))
+    band.update(_recovery_summary(records, leg))
     return band
+
+
+def _min_extras_summary(
+    records: List[Dict[str, object]],
+    leg: str,
+    key: str,
+    positive_only: bool = False,
+) -> Dict[str, object]:
+    """``{key: min over the leg's extras[key]}`` — the shared fold under
+    every per-metric summary below (the min-of-N reading the wall band
+    uses). Legs without the extra contribute nothing, so the stats table
+    renders a dash. ``positive_only`` additionally drops zeros (sampled
+    metrics whose backends report 0 for "no data", e.g. CPU allocator
+    stats)."""
+    values = [
+        (rec.get("extras") or {}).get(key)
+        for rec in records
+        if rec.get("leg") == leg
+    ]
+    values = [
+        v for v in values
+        if isinstance(v, (int, float)) and (not positive_only or v > 0)
+    ]
+    if not values:
+        return {}
+    return {key: min(values)}
+
+
+def _recovery_summary(
+    records: List[Dict[str, object]], leg: str
+) -> Dict[str, object]:
+    """Best-case recovery latency over a leg's records.
+
+    Records carrying ``extras["recovery_s"]`` (the round-13 kill-soak
+    leg: seconds from the worker kill to the first re-settled dead-band
+    batch on the degraded membership) fold to their MINIMUM across
+    repeats. Next to the merged ``goodput_within_slo`` (``extras.slo``)
+    this is the whole failure story in one stats row: how much offered
+    traffic survived the objective, and how long the stream was
+    degraded.
+    """
+    return _min_extras_summary(records, leg, "recovery_s")
 
 
 def _peak_mem_summary(
@@ -217,15 +260,9 @@ def _peak_mem_summary(
     up in the same ``bce-tpu stats``/``--against`` workflow as a wall-time
     regression (ISSUE 9).
     """
-    peaks = [
-        (rec.get("extras") or {}).get("hbm_peak_bytes")
-        for rec in records
-        if rec.get("leg") == leg
-    ]
-    peaks = [p for p in peaks if isinstance(p, (int, float)) and p > 0]
-    if not peaks:
-        return {}
-    return {"hbm_peak_bytes": min(peaks)}
+    return _min_extras_summary(
+        records, leg, "hbm_peak_bytes", positive_only=True
+    )
 
 
 def _ingest_wait_summary(
@@ -240,15 +277,7 @@ def _ingest_wait_summary(
     the machine's capability). Legs without the extra contribute
     nothing, so the stats table renders a dash.
     """
-    waits = [
-        (rec.get("extras") or {}).get("ingest_wait_s")
-        for rec in records
-        if rec.get("leg") == leg
-    ]
-    waits = [w for w in waits if isinstance(w, (int, float))]
-    if not waits:
-        return {}
-    return {"ingest_wait_s": min(waits)}
+    return _min_extras_summary(records, leg, "ingest_wait_s")
 
 
 def _latency_quantiles(
@@ -409,7 +438,7 @@ def diff_bands(
                                     "old": old_band, "new": new_band}
         metrics: Dict[str, Dict[str, object]] = {}
         for name in ("p50", "p99", "goodput_within_slo", "ingest_wait_s",
-                     "hbm_peak_bytes"):
+                     "hbm_peak_bytes", "recovery_s"):
             old_value = (old_band or {}).get(name)
             new_value = (new_band or {}).get(name)
             if old_value is not None or new_value is not None:
@@ -445,6 +474,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             "goodput_within_slo": "goodput",
             "ingest_wait_s": "ingest_wait",
             "hbm_peak_bytes": "peak_mem",
+            "recovery_s": "recovery",
         }.get(name, name)
         return f"  {label} {num(metric['old'])}->{num(metric['new'])}"
 
@@ -460,7 +490,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
         trailer = "".join(
             metric_str(entry, name)
             for name in ("p99", "goodput_within_slo", "ingest_wait_s",
-                         "hbm_peak_bytes")
+                         "hbm_peak_bytes", "recovery_s")
         )
         lines.append(
             f"{leg:<34} {band_str(entry['old']):>16} "
@@ -495,7 +525,8 @@ def render(records: List[Dict[str, object]]) -> str:
     lines = [
         f"{'leg':<34} {'n':>3} {'min':>12} {'max':>12} "
         f"{'spread':>7} {'p50':>9} {'p99':>9} {'goodput':>8} "
-        f"{'ingest_w':>9} {'peak_mem':>9} {'load(1m)':>12} unit"
+        f"{'ingest_w':>9} {'peak_mem':>9} {'recovery':>9} "
+        f"{'load(1m)':>12} unit"
     ]
     for leg, band in summary.items():
 
@@ -530,6 +561,7 @@ def render(records: List[Dict[str, object]]) -> str:
             f"{num(band['max']):>12} {spread:>7} "
             f"{num(band.get('p50')):>9} {num(band.get('p99')):>9} "
             f"{goodput_str:>8} {num(band.get('ingest_wait_s')):>9} "
-            f"{peak_str:>9} {load:>12} {band['unit'] or '-'}"
+            f"{peak_str:>9} {num(band.get('recovery_s')):>9} "
+            f"{load:>12} {band['unit'] or '-'}"
         )
     return "\n".join(lines)

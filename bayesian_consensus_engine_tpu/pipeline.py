@@ -1056,6 +1056,20 @@ class _BandView:
         return values.astype(dtype) if dtype is not None else values
 
 
+def _process_count() -> int:
+    """The runtime's controller count, as a patchable indirection.
+
+    The session's adopt path branches on it (single-controller adopts
+    relayout in HBM; cluster adopts stage through process-local host
+    buffers), and tests force the cluster branch on a single-process
+    backend by patching this function — the only seam that does not
+    require a real multi-controller runtime.
+    """
+    import jax
+
+    return jax.process_count()
+
+
 _cycle_loop_cache: dict = {}
 
 
@@ -1546,6 +1560,35 @@ class ShardedSettlementSession:
             options.chunk_slots, DEFAULT_CHUNK_SLOTS, "chunk_slots"
         )
         graph = options.graph
+        # Cluster posture (round 13): bands and the tie-break are
+        # per-market reductions over the sources axis, so they serve a
+        # banded session unchanged (pinned by tests/test_cluster.py).
+        # What cannot be served yet is named, not left to fail deep in
+        # alignment or collectives with a generic shape error.
+        from bayesian_consensus_engine_tpu.cluster.recover import (
+            ClusterModeUnsupported,
+        )
+
+        if _process_count() > 1:
+            raise ClusterModeUnsupported(
+                "settle_with_analytics on a multi-controller runtime is "
+                "not served yet: route cluster deployments through the "
+                "shared-nothing band membership (cluster.membership."
+                "MeshView — each host serves its band on its local mesh, "
+                "where the full analytics tier runs unchanged); the "
+                "hybrid DCN×ICI analytics program is the ROADMAP "
+                "follow-up this error names"
+            )
+        if self._band is not None and graph is not None:
+            raise ClusterModeUnsupported(
+                "the correlated-market sweep needs the GLOBAL market "
+                "axis, but this session serves a band plan covering only "
+                "rows [{}, {}) of it — cross-band neighbour pulls would "
+                "silently drop. Serve graph analytics from a whole-axis "
+                "session, or partition the MarketGraph by the same "
+                "cluster.membership.MeshView bands so every edge stays "
+                "in-band".format(self._lo, self._lo + self._plan.num_markets)
+            )
         sweep_steps = graph.steps if graph is not None else 0
         damping = graph.damping if graph is not None else 0.0
 
@@ -1649,24 +1692,38 @@ class ShardedSettlementSession:
 
         * ``"refresh"`` — *plan* shares the current plan's topology
           arrays (the fingerprint-hit fast path): probs-only upload.
-        * ``"relayout"`` — the resident block was re-laid-out on device
-          for the new plan (:func:`~.parallel.sharded.relayout_slot_state`):
-          rows STAYING in the active set move with it (zero host
-          traffic), rows ENTERING upload their host values (O(entering)
-          — fresh markets enter as cold defaults), and rows LEAVING stay
+        * ``"relayout"`` — the resident block was carried across the
+          swap. Single-controller sessions whose band spans the whole
+          axis relayout IN HBM
+          (:func:`~.parallel.sharded.relayout_slot_state`): rows
+          STAYING in the active set move with it (zero host traffic),
+          rows ENTERING upload their host values (O(entering) — fresh
+          markets enter as cold defaults), and rows LEAVING stay
           covered by the standing sync recipe, reaching the host store
           lazily at the next checkpoint/sync exactly as any deferred
-          band gather does. Capacity-ladder growth of the padded extents
-          re-pads the block in place (the relayout's output shape is the
-          new plan's). Bit-equal to tearing the session down and
-          rebuilding (pinned by tests/test_overlap.py).
-        * ``"rebuild"`` — the resident state was dropped; the next
-          :meth:`settle` rebuilds from host (the per-batch-session
-          cost). Taken when there is no resident state yet, in ``band=``
-          / multi-process mode (the relayout mapping is process-local —
-          each process would need its peers' layouts), or when an
-          entering row's host stamp cannot be re-expressed against the
-          session epoch (backdated settlements).
+          band gather does. Cluster sessions (``band=`` partial bands,
+          or a multi-controller runtime) stage the SAME permutation
+          through process-local host buffers instead (round 13,
+          retiring the PR-5 teardown+rebuild fallback): per-band
+          topology drift is a process-local event, so a collective
+          device relayout would force every host to synchronise its
+          misses — the staged path touches only this process's band,
+          dispatches no collective, and keeps the session (plan cache,
+          standing recipe, store deferrals) fully resident. Either
+          flavour: capacity-ladder growth of the padded extents re-pads
+          the block in place, and the result is bit-equal to tearing
+          the session down and rebuilding (pinned by
+          tests/test_overlap.py and tests/test_cluster.py).
+        * ``"rebuild:<reason>"`` — the resident state was dropped; the
+          next :meth:`settle` rebuilds from host (the per-batch-session
+          cost). The reason names why — the observability hook the
+          ``stream.resident_fallbacks`` counter and the per-batch
+          ``stats["session_adopt"]`` field surface: ``no-resident-state``
+          (nothing to carry yet), ``band-change`` (the global axis was
+          re-partitioned — a membership-epoch event; recovery rebuilds
+          through journal replay, :mod:`~.cluster.recover`), or
+          ``backdated-stamps`` (an entering row's host stamp cannot be
+          re-expressed against the session epoch).
         """
         if band == self._band:
             # The hit shortcut only applies within the SAME band: a band
@@ -1688,9 +1745,8 @@ class ShardedSettlementSession:
             return self._adopt_miss(plan, band)
 
     def _adopt_miss(self, plan: SettlementPlan, band) -> str:
-        import jax
-
         from bayesian_consensus_engine_tpu.parallel.sharded import (
+            MarketBlockState,
             relayout_slot_state,
         )
         from bayesian_consensus_engine_tpu.utils.timeconv import NEVER
@@ -1699,12 +1755,7 @@ class ShardedSettlementSession:
         old_state = self._state
         old_band_rows, old_band_mask = self._band_rows, self._band_mask
         old_lo, old_hi, old_total = self._lo, self._hi, self._padded_total
-        resident = (
-            old_state is not None
-            and self._band is None
-            and band is None
-            and jax.process_count() == 1
-        )
+        band_changed = band != self._band
         self._band = band
         with active_timeline().span("upload"):
             (self._padded_total, self._lo, self._hi,
@@ -1714,12 +1765,6 @@ class ShardedSettlementSession:
             )
         self._plan = plan
         self._touched = self._band_rows[self._band_mask]
-        # Single-process bands span the whole axis; anything else means the
-        # flat position maps below would be band-local, not global.
-        resident = resident and (
-            old_lo == 0 and old_hi == old_total
-            and self._lo == 0 and self._hi == self._padded_total
-        )
         # The session is mid-swap from here: drop the resident binding
         # FIRST, so an exception anywhere below (a sync failure, a device
         # error in the relayout) leaves a clean rebuild posture — never
@@ -1729,11 +1774,18 @@ class ShardedSettlementSession:
         # local reference) for the relayout/recipe resolution.
         self._release_standing()
         self._state = None
-        if not resident:
-            return "rebuild"
+        if old_state is None:
+            return "rebuild:no-resident-state"
+        if band_changed:
+            # A band change re-partitions the global axis — a membership
+            # epoch event, not in-band drift: the row universe itself
+            # moved, and state crossing bands travels through journal
+            # replay (cluster/recover.py), never through a live block.
+            return "rebuild:band-change"
 
         # Row-set delta between the outgoing and incoming layout, as flat
-        # slot-major positions (each plan maps a row to exactly one slot).
+        # BAND-LOCAL slot-major positions (each plan maps a row to exactly
+        # one slot; a whole-axis band's local positions ARE global).
         old_pos = np.flatnonzero(old_band_mask.ravel())
         old_rows = old_band_rows.ravel()[old_pos]
         new_pos = np.flatnonzero(self._band_mask.ravel())
@@ -1769,21 +1821,87 @@ class ShardedSettlementSession:
             # A host stamp at/below the session epoch has no positive
             # relative expression (backdated writes): stay in the rebuild
             # posture; the next settle rebuilds at a fresh epoch.
-            return "rebuild"
+            return "rebuild:backdated-stamps"
 
-        src = np.full(self._band_mask.size, -1, dtype=np.int64)
-        src[new_pos[staying]] = sorted_pos[idx[staying]]
         np_cdtype = np.dtype(self._cdtype)
-        self._state = relayout_slot_state(
-            old_state,
-            src,
-            entering_pos,
-            host_rel.astype(np_cdtype),
-            host_conf.astype(np_cdtype),
-            rel_days.astype(np_cdtype),
-            host_exists.astype(bool),
-            self._band_mask.shape,
-            mesh=self._mesh,
+        whole_axis = (
+            old_lo == 0 and old_hi == old_total
+            and self._lo == 0 and self._hi == self._padded_total
+        )
+        if whole_axis and _process_count() == 1:
+            # Single-controller, whole-axis band: the local position maps
+            # are global, so the permutation runs IN HBM — zero host
+            # traffic for staying rows.
+            src = np.full(self._band_mask.size, -1, dtype=np.int64)
+            src[new_pos[staying]] = sorted_pos[idx[staying]]
+            self._state = relayout_slot_state(
+                old_state,
+                src,
+                entering_pos,
+                host_rel.astype(np_cdtype),
+                host_conf.astype(np_cdtype),
+                rel_days.astype(np_cdtype),
+                host_exists.astype(bool),
+                self._band_mask.shape,
+                mesh=self._mesh,
+            )
+            return "relayout"
+
+        # Cluster posture (multi-controller runtime, or a band covering
+        # part of the global axis): stage the SAME permutation through
+        # process-local host buffers. Per-band topology drift is a
+        # process-local event — host A's batch can be a fingerprint hit
+        # while host B's drifts — so a device-side relayout (whose gather
+        # lowers to collectives on the markets axis) would require every
+        # host to synchronise its misses; the staged path touches only
+        # this process's band columns and dispatches nothing collective.
+        # Same value sources as the device path (staying rows from the
+        # live block, entering rows host-exact), so byte-parity with
+        # teardown+rebuild holds by the same argument — pinned by
+        # tests/test_cluster.py::TestClusterAdopt.
+        from bayesian_consensus_engine_tpu.parallel.distributed import (
+            global_slot_block,
+            local_view,
+        )
+
+        old_local = tuple(
+            local_view(x)
+            for x in (
+                old_state.reliability, old_state.confidence,
+                old_state.updated_days, old_state.exists,
+            )
+        )
+
+        def relaid(old_arr, fill, entered, dtype):
+            flat = np.full(self._band_mask.size, fill, dtype=dtype)
+            flat[new_pos[staying]] = old_arr.ravel()[
+                sorted_pos[idx[staying]]
+            ].astype(dtype, copy=False)
+            if entering_pos.size:
+                flat[entering_pos] = entered
+            return flat.reshape(self._band_mask.shape)
+
+        self._state = MarketBlockState(
+            reliability=global_slot_block(
+                relaid(old_local[0], DEFAULT_RELIABILITY,
+                       host_rel.astype(np_cdtype), np_cdtype),
+                self._mesh, self._padded_total,
+            ),
+            confidence=global_slot_block(
+                relaid(old_local[1], DEFAULT_CONFIDENCE,
+                       host_conf.astype(np_cdtype), np_cdtype),
+                self._mesh, self._padded_total,
+            ),
+            updated_days=global_slot_block(
+                relaid(old_local[2], 0.0,
+                       rel_days.astype(np_cdtype), np_cdtype),
+                self._mesh, self._padded_total,
+            ),
+            exists=global_slot_block(
+                relaid(old_local[3], False,
+                       host_exists.astype(bool), np.dtype(bool)),
+                self._mesh, self._padded_total,
+            ),
         )
         return "relayout"
 
@@ -2132,8 +2250,9 @@ def settle_stream(
     would double its updates — see examples/fault_tolerant_service.py).
     Under ``mesh=`` each dict also carries ``"session_adopt"``: how the
     resident session served the batch (``"start"``/``"refresh"``/
-    ``"relayout"``/``"rebuild"`` — ``None`` on the flat path and with
-    *resident_session* off). The dispatch-only reading of
+    ``"relayout"``/``"rebuild:<reason>"`` — the reason names the
+    remaining fallback, see :meth:`ShardedSettlementSession.adopt`;
+    ``None`` on the flat path and with *resident_session* off). The dispatch-only reading of
     ``settle_dispatch_s`` holds under ``mesh=`` too since round 7: the
     persistent session keeps the reliability block in HBM across
     batches, so nothing drains or re-uploads inside the settle window on
@@ -2172,13 +2291,17 @@ def settle_stream(
     refresh`) → in-jit donated cycle loop → register the deferred
     band-gather recipe — ZERO reliability-state host traffic. On a miss
     the session is NOT torn down: :meth:`~ShardedSettlementSession.
-    adopt` re-lays the resident block out for the new plan on device,
-    uploading only rows entering the active set (rows leaving reach the
-    host lazily through the standing recipe; capacity-ladder growth
-    re-pads in place). ``resident_session=False`` restores the
-    per-batch-session legacy shape (one session per batch, state rebuilt
-    from host each time) — kept for A/B benches and as the
-    multi-process ``band=`` fallback the resident path itself takes.
+    adopt` re-lays the resident block out for the new plan — in HBM on
+    a whole-axis single-controller session, via the process-local
+    host-staged permutation in the cluster posture (``band=`` partial
+    bands / multi-controller runtimes, round 13) — uploading only rows
+    entering the active set (rows leaving reach the host lazily
+    through the standing recipe; capacity-ladder growth re-pads in
+    place). ``resident_session=False`` restores the per-batch-session
+    legacy shape (one session per batch, state rebuilt from host each
+    time) — kept for A/B benches; the resident path no longer falls
+    back to it anywhere (``stream.resident_fallbacks`` counts the
+    named ``rebuild:<reason>`` cases that remain).
     Either way the session's host-merge recipe resolves at the next
     checkpoint or the first later batch that OVERLAPS its rows (batches
     of fresh markets never stall on their predecessors' device→host

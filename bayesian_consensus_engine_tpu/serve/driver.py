@@ -171,7 +171,9 @@ class SessionDriver:
        shutdown leans on.
 
     ``last_adopt`` after a dispatch is how the session served it
-    (``"start"``/``"refresh"``/``"relayout"``/``"rebuild"``; ``None`` on
+    (``"start"``/``"refresh"``/``"relayout"``/``"rebuild:<reason>"`` —
+    the reason names the remaining fallback, see
+    :meth:`~.pipeline.ShardedSettlementSession.adopt`; ``None`` on
     the flat path and with ``resident_session=False``), and
     ``durable_through`` is the highest batch index whose journal epoch is
     known fsynced — the watermark per-request durability accounting reads.
@@ -245,6 +247,17 @@ class SessionDriver:
         registry = metrics_registry()
         self._adopts_counter = registry.counter("stream.session_adopts")
         self._resident_gauge = registry.gauge("stream.resident_rows")
+        #: Counts every batch the resident session could NOT serve
+        #: resident — an adopt that fell back to dropping the block
+        #: (``rebuild:<reason>``) or a mid-stream session replacement
+        #: (band change). The round-13 retirement metric: a healthy
+        #: cluster stream holds this at 0 through its steady phase
+        #: (the ``e2e_kill_soak`` acceptance), and any ledger where it
+        #: moves names the remaining fallback via
+        #: ``stats["session_adopt"]``'s reason suffix.
+        self._fallback_counter = registry.counter(
+            "stream.resident_fallbacks"
+        )
 
         self._session = None  # the mesh path's long-lived resident session
         self._session_band = None
@@ -325,8 +338,12 @@ class SessionDriver:
                 if self._session is not None:
                     # The replaced session's standing gather is no longer
                     # session-pinned: let its bytes count against the
-                    # deferral budget again.
+                    # deferral budget again. Dropping a LIVE session is a
+                    # resident fallback (the block did not survive the
+                    # band change) — counted so the retirement of every
+                    # teardown path stays measurable in ledgers.
                     self._session._release_standing()
+                    self._fallback_counter.inc()
                 self._session = ShardedSettlementSession(
                     store, plan, self._mesh, dtype=self._dtype, band=band
                 )
@@ -336,6 +353,8 @@ class SessionDriver:
                 self.last_adopt = self._session.adopt(plan, band=band)
                 if self.last_adopt != "refresh":
                     self._adopts_counter.inc()
+                if self.last_adopt.startswith("rebuild"):
+                    self._fallback_counter.inc()
             self._resident_gauge.set(float(self._session._touched.size))
             if self._analytics is not None:
                 # The fused co-resident program: settlement bytes (and

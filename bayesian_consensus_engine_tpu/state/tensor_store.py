@@ -1805,6 +1805,47 @@ class TensorReliabilityStore:
         self._journal_inflight = handle
         return handle
 
+    def absorb_replayed_rows(
+        self, rows, rel, conf, days, exists, iso_values
+    ) -> None:
+        """Overwrite *rows* with journal-replayed values (cluster merge).
+
+        The remapped twin of :meth:`_apply_journal_epoch`'s value half,
+        for :func:`~.cluster.recover.replay_cluster_journals` /
+        :func:`~.cluster.recover.adopt_journal`: the caller has already
+        interned the epoch's pairs (obtaining *rows* — this store's
+        assignment, not the journal's) and replays the dirty columns
+        onto them verbatim. Values land exactly as written (f64 host
+        truth, ISO sidecars included) and the rows are marked dirty for
+        both durability tiers, so the adopting store's NEXT journal
+        epoch and SQLite flush carry the adopted band — the journal of
+        a dead host is needed once, at adoption, never again.
+
+        Callers adopting into a LIVE store must hand rows disjoint from
+        any pending device settlement (band journals are disjoint by
+        construction; :func:`~.cluster.recover.adopt_journal` asserts
+        it) — this method does not resolve deferrals.
+        """
+        rows = np.ascontiguousarray(rows, dtype=np.int64)
+        with self._host_lock:
+            if rows.size and int(rows.max()) >= len(self._pairs):
+                raise ValueError(
+                    f"row {int(rows.max())} is beyond this store's "
+                    f"{len(self._pairs)} interned pairs"
+                )
+            self._ensure_capacity(max(len(self._pairs), 1))
+            self._resync_sidecars()
+            self._rel[rows] = rel
+            self._conf[rows] = conf
+            self._days[rows] = days
+            self._exists[rows] = exists
+            iso = self._iso
+            for row, value in zip(rows.tolist(), iso_values):
+                iso[row] = value
+            self._dirty[rows] = True
+            self._journal_dirty[rows] = True
+            self._invalidate()
+
     def _apply_journal_epoch(
         self, used_after, pairs, idx, rel, conf, days, exists, iso_values
     ) -> None:

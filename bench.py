@@ -1443,7 +1443,8 @@ def bench_e2e_stream_resident(markets=NUM_MARKETS, batches=6, mean_slots=4,
                 "dispatch_s_per_batch_act2": act_dispatch(half + 1, batches),
                 "adopt_s": phases.get("state_adopt", 0.0),
                 "session_adopts": sum(
-                    s["session_adopt"] in ("relayout", "rebuild")
+                    s["session_adopt"] == "relayout"
+                    or (s["session_adopt"] or "").startswith("rebuild")
                     for s in stats
                 ),
                 "session_modes": [s["session_adopt"] for s in stats],
@@ -3049,6 +3050,99 @@ def leg_probe():
 # name -> (callable, production kwargs, --fast kwargs, timeout seconds).
 # Order below is NOT priority order; see DEVICE_LEG_ORDER.
 _FAST_SHAPE = dict(num_markets=4096, slots=8)
+def bench_e2e_kill_soak(markets=64, batches=12, kill_after=3,
+                        interval=0.15, slo_s=0.3, hosts=2, steps=1,
+                        sources=40, num_slots=8):
+    """Round-13 failure-as-steady-state leg: a REAL worker kill mid-stream.
+
+    Drives ``scripts/kill_soak.py`` — a shared-nothing banded cluster of
+    *hosts* worker processes (cluster/membership.py views, per-band
+    journals, resident sessions on local meshes), one of which is
+    ``os.kill``-ed with SIGKILL after *kill_after* durable batches. The
+    headline is the PR-7 metric the ROADMAP demands for this leg:
+    **recovered ``goodput_within_slo``** — every offered request in the
+    denominator, the crash-eaten batches re-driven by the survivor
+    landing as SLO violations — next to ``recovery_s`` (kill → first
+    re-settled dead-band batch). Acceptance rides in the leg JSON:
+    ``resident_fallbacks_steady``/``_survivor`` must be 0 (the stream
+    never fell back to teardown+rebuild, before OR during recovery) and
+    the three byte-coda fields must be True (adoption-time store +
+    SQLite bytes equal the merged journal replay; the survivor's own
+    journal ends self-contained).
+    """
+    import subprocess as _subprocess
+
+    script = os.path.join(os.path.dirname(_SELF), "scripts", "kill_soak.py")
+    cmd = [
+        sys.executable, script, "--json",
+        "--hosts", str(hosts),
+        "--markets", str(markets),
+        "--batches", str(batches),
+        "--kill-after", str(kill_after),
+        "--interval", str(interval),
+        "--slo", str(slo_s),
+        "--steps", str(steps),
+        "--sources", str(sources),
+        "--num-slots", str(num_slots),
+    ]
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # workers pin CPU themselves
+    start = time.perf_counter()
+    proc = _subprocess.run(
+        cmd, capture_output=True, text=True, env=env,
+    )
+    wall_s = time.perf_counter() - start
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"kill soak failed rc={proc.returncode}:\n"
+            f"{(proc.stdout or '')[-2000:]}\n{(proc.stderr or '')[-2000:]}"
+        )
+    soak = json.loads(proc.stdout.strip().splitlines()[-1])
+    result = {
+        "wall_s": wall_s,
+        "goodput_within_slo": soak["goodput_within_slo"],
+        "recovery_s": soak["recovery_s"],
+        "adopt_s": soak["adopt_s"],
+        "rows_adopted": soak["rows_adopted"],
+        "requests_offered": soak["requests_offered"],
+        "slo": soak["slo"],
+        "resident_fallbacks_steady": soak["resident_fallbacks_steady"],
+        "resident_fallbacks_survivor": soak["resident_fallbacks_survivor"],
+        "survivor_adopt_modes": soak["survivor_adopt_modes"],
+        "byte_equal_store": soak["byte_equal_store"],
+        "byte_equal_sqlite": soak["byte_equal_sqlite"],
+        "survivor_journal_self_contained": soak[
+            "survivor_journal_self_contained"
+        ],
+        "every_batch_durable": soak["every_batch_durable"],
+        "killed_host": soak["killed_host"],
+        "hosts": hosts,
+        "batches_per_band": batches,
+        "soak_ok": soak["ok"],
+    }
+    # One ledger record carrying the recovery story: value = recovery_s,
+    # extras.slo feeds the stats goodput column, extras.recovery_s the
+    # round-13 recovery column/trailer.
+    _ledger_record(
+        "e2e_kill_soak", value=round(soak["recovery_s"], 4), unit="s",
+        extras={
+            "loadavg_1m_before": _loadavg_1m(),
+            "slo": soak["slo"],
+            "recovery_s": soak["recovery_s"],
+            "goodput_within_slo": soak["goodput_within_slo"],
+            "resident_fallbacks": soak["resident_fallbacks_survivor"],
+        },
+    )
+    print(
+        f"e2e_kill_soak: recovered goodput_within_slo="
+        f"{soak['goodput_within_slo']:.3f} over "
+        f"{soak['requests_offered']} offered, recovery_s="
+        f"{soak['recovery_s']:.3f}, fallbacks="
+        f"{soak['resident_fallbacks_survivor']}"
+    )
+    return result
+
+
 LEGS = {
     "probe": (leg_probe, {}, {}, 240),
     "headline_f32": (
@@ -3125,6 +3219,11 @@ LEGS = {
         dict(markets=128, slots=64, chunk_slots=16, graph_degree=2,
              steps=2, reps=1, trials=1), 1200,
     ),
+    "e2e_kill_soak": (
+        bench_e2e_kill_soak, {},
+        dict(markets=32, batches=8, kill_after=2, interval=0.08,
+             slo_s=0.25), 600,
+    ),
     "pallas_ab": (
         bench_pallas_ab, {},
         dict(num_markets=1024, slots=8, timed_steps=8,
@@ -3174,6 +3273,7 @@ DEVICE_LEG_ORDER = [
     "tiebreak_10k_agents",
     "e2e_ring_memory",
     "e2e_analytics",
+    "e2e_kill_soak",
     "pallas_ab",
     "dryrun_multichip",
 ]
@@ -3498,6 +3598,7 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         "tiebreak_10k_agents": _show(results, "tiebreak_10k_agents"),
         "e2e_ring_memory": _show(results, "e2e_ring_memory"),
         "e2e_analytics": _show(results, "e2e_analytics"),
+        "e2e_kill_soak": _show(results, "e2e_kill_soak"),
         "per_slot_throughput": slot_updates,
         "harness": harness,
         "notes": (

@@ -1,0 +1,222 @@
+"""Host loss as a steady-state event: kill → replay → resume → same bytes.
+
+The round-13 recovery walkthrough (cluster/), in one process: two
+"hosts" of a shared-nothing banded cluster (cluster.membership.MeshView
+— each owns a deterministic band of the global markets axis, settles it
+through a resident session on its own mesh, and journals every batch),
+then host B dies mid-stream with offered-but-undurable work in flight.
+The survivor:
+
+  1. derives the DEGRADED view (epoch 1 over {A}) — a pure function of
+     the surviving host set, no coordinator anywhere;
+  2. replays B's journal INTO its live store between batches
+     (``cluster.recover.adopt_journal``): B's rows appear host-exact,
+     durable through B's last fsynced epoch — the crash-eaten tail is
+     exactly what re-drives;
+  3. keeps streaming: the next batch covers BOTH bands, and the resident
+     session carries the merge through the adopt RELAYOUT (B's rows
+     enter the device block as host uploads, A's rows never leave HBM —
+     ``stream.resident_fallbacks`` stays 0);
+  4. proves the byte contract: the live merged store is bit-identical
+     (store digest AND SQLite export bytes) to an offline
+     ``replay_cluster_journals`` over the two journals, and after one
+     more epoch the survivor's OWN journal replays to the full store —
+     the dead journal is needed once, then never again.
+
+``scripts/kill_soak.py`` runs the same story with real worker processes,
+a real SIGKILL, and recovered ``goodput_within_slo`` as the headline;
+tests/test_cluster.py pins every contract used here.
+
+Run from the repo root:  python examples/degraded_mesh_recovery.py
+"""
+
+import os
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FLAG
+    ).strip()
+
+import numpy as np  # noqa: E402
+
+from bayesian_consensus_engine_tpu.cluster import (  # noqa: E402
+    MeshView,
+    adopt_journal,
+    replay_cluster_journals,
+    store_digest,
+)
+from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh  # noqa: E402
+from bayesian_consensus_engine_tpu.serve.driver import (  # noqa: E402
+    PlanCache,
+    SessionDriver,
+)
+from bayesian_consensus_engine_tpu.state.journal import (  # noqa: E402
+    JournalWriter,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (  # noqa: E402
+    TensorReliabilityStore,
+)
+
+MARKETS = 32          # global axis; the epoch-0 view bands it 16/16
+BATCHES = 6           # per band
+B_DIES_AFTER = 3      # B's durable batches when it "dies"
+NOW0 = 21_800.0
+SEED = 31
+
+
+def global_batch(index):
+    """Deterministic global batch: both hosts derive the SAME columns
+    from the seed, then slice their band — the property that lets the
+    survivor re-drive the dead band bit-for-bit."""
+    rng = np.random.default_rng((SEED, index))
+    drift = index // 2  # topology drifts every two batches
+    counts = np.random.default_rng((SEED, 1, drift)).integers(1, 4, MARKETS)
+    keys = [f"m{g}" for g in range(MARKETS)]
+    sids = [
+        f"s{(g * 3 + j * 7 + drift) % 20}"
+        for g in range(MARKETS)
+        for j in range(counts[g])
+    ]
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    probs = rng.random(int(counts.sum()))
+    outcomes = (rng.random(MARKETS) < 0.5).tolist()
+    return keys, sids, probs, offsets, outcomes
+
+
+def band_slice(batch, rows):
+    keys, sids, probs, offsets, outcomes = batch
+    out_k, out_s, out_p, out_c, out_o = [], [], [], [], []
+    for g in rows:
+        lo, hi = int(offsets[g]), int(offsets[g + 1])
+        out_k.append(keys[g])
+        out_s.extend(sids[lo:hi])
+        out_p.append(probs[lo:hi])
+        out_c.append(hi - lo)
+        out_o.append(outcomes[g])
+    return (
+        out_k, out_s,
+        np.concatenate(out_p),
+        np.concatenate([[0], np.cumsum(out_c)]).astype(np.int64),
+        out_o,
+    )
+
+
+class BandHost:
+    """One shared-nothing host: store + journal + resident driver."""
+
+    def __init__(self, host_id, view, shared):
+        self.id = host_id
+        self.rows = list(view.owned_markets(host_id, MARKETS))
+        self.store = TensorReliabilityStore()
+        self.journal_path = os.path.join(shared, f"band{host_id}.jrnl")
+        self.driver = SessionDriver(
+            self.store, steps=1, mesh=make_mesh(),
+            journal=JournalWriter(self.journal_path), owns_journal=True,
+            checkpoint_every=1, sync_checkpoints=True,
+        )
+        self.cache = PlanCache(self.store, num_slots=8)
+        self.index = 0
+
+    def settle(self, parts):
+        """One batch over [(band_rows, batch_index), ...] merged."""
+        columns = [band_slice(global_batch(i), rows) for rows, i in parts]
+        keys = sum((c[0] for c in columns), [])
+        sids = sum((c[1] for c in columns), [])
+        probs = np.concatenate([c[2] for c in columns])
+        offsets = np.cumsum(
+            np.concatenate([[0]] + [np.diff(c[3]) for c in columns])
+        ).astype(np.int64)
+        outcomes = sum((c[4] for c in columns), [])
+        plan = self.cache.plan_for(keys, sids, probs, offsets)
+        self.driver.dispatch(plan, outcomes, now=NOW0 + self.index)
+        self.driver.checkpoint(self.index)
+        self.index += 1
+        return self.driver.last_adopt
+
+
+def main():
+    shared = tempfile.mkdtemp(prefix="bce_degraded_")
+    view0 = MeshView(epoch=0, hosts=(0, 1), devices_per_host=4)
+    print(f"epoch 0: hosts {view0.hosts}, bands "
+          f"{[view0.band(h, MARKETS) for h in view0.hosts]}")
+
+    # --- Act 1: the steady cluster — both bands stream and journal.
+    a = BandHost(0, view0, shared)
+    b = BandHost(1, view0, shared)
+    for i in range(B_DIES_AFTER):
+        a.settle([(a.rows, i)])
+        b.settle([(b.rows, i)])
+    print(f"steady phase: {B_DIES_AFTER} durable batches per band "
+          f"(adopt modes stay refresh/relayout — resident)")
+
+    # --- Act 2: host B dies. Its journal survives (durable storage);
+    # everything after its last fsynced epoch is crash-eaten.
+    del b  # the process is gone; only band1.jrnl remains
+    print(f"host 1 died after batch {B_DIES_AFTER - 1} "
+          f"(journal durable through tag {B_DIES_AFTER - 1})")
+
+    # --- Act 3: the survivor derives the degraded view and adopts.
+    view1 = view0.degraded([0])
+    print(f"epoch 1: hosts {view1.hosts} — survivor owns "
+          f"{len(list(view1.owned_markets(0, MARKETS)))}/{MARKETS} markets")
+    dead_rows = list(view0.owned_markets(1, MARKETS))
+    tag, rows_adopted = adopt_journal(
+        a.store, os.path.join(shared, "band1.jrnl")
+    )
+    print(f"adopted band 1: {rows_adopted} rows, durable tag {tag} → "
+          f"re-driving batches {tag + 1}..{BATCHES - 1}")
+
+    # The byte coda, live at the adoption point: the merged store equals
+    # the offline replay of the two journals — digest and SQLite bytes.
+    merged = replay_cluster_journals(
+        [os.path.join(shared, "band0.jrnl"),
+         os.path.join(shared, "band1.jrnl")]
+    )
+    assert store_digest(a.store) == store_digest(merged.store)
+    a.store.flush_to_sqlite(os.path.join(shared, "live.db"))
+    merged.store.flush_to_sqlite(os.path.join(shared, "replay.db"))
+    with open(os.path.join(shared, "live.db"), "rb") as fa, \
+            open(os.path.join(shared, "replay.db"), "rb") as fb:
+        assert fa.read() == fb.read()
+    print("byte coda: live merged store == replay_cluster_journals "
+          "(store digest AND SQLite bytes)")
+
+    # --- Act 4: the stream resumes on the degraded view — merged
+    # batches, the resident session relaying B's rows in as entering
+    # uploads. No rebuild, no teardown.
+    adopts = []
+    b_next = tag + 1
+    for i in range(B_DIES_AFTER, BATCHES):
+        parts = [(a.rows, i)]
+        if b_next < BATCHES:
+            parts.append((dead_rows, b_next))
+            b_next += 1
+        adopts.append(a.settle(parts))
+    while b_next < BATCHES:
+        adopts.append(a.settle([(dead_rows, b_next)]))
+        b_next += 1
+    assert not any(m is not None and m.startswith("rebuild")
+                   for m in adopts), adopts
+    print(f"resumed through batch {BATCHES - 1} of both bands; adopt "
+          f"modes {adopts} — the merge itself rode the relayout")
+
+    # --- Coda: the survivor's own journal is now self-contained — the
+    # adopted band rode its post-adoption epochs, so IT ALONE replays to
+    # the full final store. The dead journal was needed exactly once.
+    a.driver.finalize()
+    solo = replay_cluster_journals([os.path.join(shared, "band0.jrnl")])
+    assert store_digest(solo.store) == store_digest(a.store)
+    print("survivor journal self-contained: replay(band0.jrnl) == final "
+          "store — the dead journal is history")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,704 @@
+"""Real-kill cluster soak: failure as steady state, goodput as the verdict.
+
+Runs a shared-nothing banded cluster (cluster/membership.py: N worker
+PROCESSES, each settling its deterministic band of the global markets
+axis on its own local mesh, journaling every batch), then — mid-stream —
+``os.kill``\\ s one live worker with SIGKILL and proves the round-13
+recovery story end to end:
+
+  1. the supervisor observes the death and publishes the DEGRADED
+     membership view (epoch+1 over the survivors — every survivor would
+     derive the same view; the supervisor here is a failure *detector*,
+     never a layout coordinator);
+  2. the surviving worker picks the epoch bump up between batches,
+     replays the dead band's journal INTO its live store
+     (``cluster.recover.adopt_journal`` — reading state/journal.py's
+     frame walk), and its resident session carries the orphan rows onto
+     the device block through the round-13 adopt relayout — the stream
+     RESUMES on the degraded view without a process restart, a session
+     teardown, or a single ``rebuild`` fallback;
+  3. the headline is **recovered ``goodput_within_slo``** (obs/slo.py):
+     every offered request counts in the denominator — the crash-eaten
+     batches the dead worker offered but never made durable re-drive on
+     the survivor and land as SLO *violations*, exactly the PR-7
+     accounting under which a recovery that loses traffic cannot look
+     healthy;
+  4. the byte coda: at adoption the survivor's live store must be
+     bit-equal (store digest AND SQLite export bytes) to
+     ``replay_cluster_journals`` over the surviving journals, and at
+     exit the survivor's OWN journal must replay to its final store —
+     the adopted band rides its next epochs, so the dead journal is
+     needed once and never again.
+
+Run from the repo root::
+
+    python scripts/kill_soak.py [--markets 64] [--batches 12]
+                                [--kill-after 3] [--interval 0.15]
+                                [--slo 0.5] [--json] [--ledger soak.jsonl]
+
+Exit code 0 iff every assertion holds; the final stdout line is one JSON
+object (the ``e2e_kill_soak`` bench leg parses it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SOAK_SEED = 20260803
+
+
+def _membership_path(shared: str) -> str:
+    return os.path.join(shared, "membership.json")
+
+
+def _write_membership(shared: str, view, kill_ts=None) -> None:
+    payload = {
+        "epoch": view.epoch,
+        "hosts": list(view.hosts),
+        "devices_per_host": view.devices_per_host,
+        "fingerprint": view.fingerprint.hex(),
+        "kill_ts": kill_ts,
+    }
+    tmp = _membership_path(shared) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, sort_keys=True)
+    os.replace(tmp, _membership_path(shared))
+
+
+def _read_membership(shared: str) -> dict:
+    with open(_membership_path(shared)) as f:
+        return json.load(f)
+
+
+def _global_batch(args, index: int):
+    """Deterministic global batch *index*: every host regenerates the
+    SAME columnar (keys, sids, probs, offsets, outcomes) from the seed
+    alone, then slices its band — which is what lets a survivor re-drive
+    a dead band's remaining batches bit-for-bit. Topology drifts every
+    two batches (``index // 2`` rotates the source assignment), so the
+    steady phase exercises both the fingerprint-hit refresh and the
+    topology-miss adopt relayout."""
+    import numpy as np
+
+    markets = args.markets
+    drift = index // 2
+    # Topology (signal counts + source assignment) is a function of the
+    # DRIFT PERIOD; values (probs, outcomes) of the batch. Consecutive
+    # batches inside a period are fingerprint hits (probs-only refresh),
+    # period boundaries are topology misses (adopt relayout) — the
+    # steady phase exercises both resident moves.
+    rng_topo = np.random.default_rng((SOAK_SEED, 1, drift))
+    rng_vals = np.random.default_rng((SOAK_SEED, 2, index))
+    counts = rng_topo.integers(1, 4, markets)
+    keys = [f"m{g}" for g in range(markets)]
+    sids = []
+    for g in range(markets):
+        for j in range(counts[g]):
+            sids.append(f"s{(g * 3 + j * 7 + drift) % args.sources}")
+    probs = rng_vals.random(int(counts.sum()))
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    outcomes = (rng_vals.random(markets) < 0.5).tolist()
+    return keys, sids, probs, offsets, outcomes
+
+
+def _band_slice(args, batch, rows):
+    """One band's columnar slice of a global batch (rows = global ids)."""
+    import numpy as np
+
+    keys, sids, probs, offsets, outcomes = batch
+    rows = list(rows)
+    out_keys, out_sids, out_probs, out_counts, out_outcomes = [], [], [], [], []
+    for g in rows:
+        lo, hi = int(offsets[g]), int(offsets[g + 1])
+        out_keys.append(keys[g])
+        out_sids.extend(sids[lo:hi])
+        out_probs.append(probs[lo:hi])
+        out_counts.append(hi - lo)
+        out_outcomes.append(outcomes[g])
+    merged_probs = (
+        np.concatenate(out_probs) if out_probs else np.empty(0, np.float64)
+    )
+    merged_offsets = np.concatenate(
+        [[0], np.cumsum(out_counts)]
+    ).astype(np.int64)
+    return out_keys, out_sids, merged_probs, merged_offsets, out_outcomes
+
+
+# --------------------------------------------------------------------------
+# worker
+# --------------------------------------------------------------------------
+
+
+def run_worker(args) -> int:
+    flag = f"--xla_force_host_platform_device_count={args.devices_per_host}"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", args.devices_per_host)
+    except AttributeError:
+        pass  # old JAX: the XLA_FLAGS fallback above covers it
+
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.cluster.membership import MeshView
+    from bayesian_consensus_engine_tpu.cluster.recover import (
+        adopt_journal,
+        replay_cluster_journals,
+        store_digest,
+    )
+    from bayesian_consensus_engine_tpu.obs.metrics import (
+        MetricsRegistry,
+        set_metrics_registry,
+    )
+    from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+    from bayesian_consensus_engine_tpu.serve.driver import (
+        PlanCache,
+        SessionDriver,
+    )
+    from bayesian_consensus_engine_tpu.state.journal import JournalWriter
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    me = args.worker
+    shared = args.dir
+    view = MeshView(
+        epoch=0,
+        hosts=tuple(range(args.hosts)),
+        devices_per_host=args.devices_per_host,
+    )
+    mesh = make_mesh()  # shared-nothing: this host's LOCAL mesh
+    registry = MetricsRegistry()
+    set_metrics_registry(registry)
+
+    store = TensorReliabilityStore()
+    journal = JournalWriter(os.path.join(shared, f"band{me}.jrnl"))
+    # Strict durability (sync epochs, every batch): a yielded batch IS
+    # fsynced, which is both the honest SLO endpoint (submit→durable)
+    # and what makes the adoption-time byte coda exact.
+    driver = SessionDriver(
+        store, steps=args.steps, mesh=mesh, journal=journal,
+        owns_journal=True, checkpoint_every=1, sync_checkpoints=True,
+    )
+    cache = PlanCache(store, num_slots=args.num_slots)
+    progress = open(os.path.join(shared, f"progress_{me}.jsonl"), "w")
+
+    def log(kind: str, **payload) -> None:
+        payload.update(kind=kind, ts=time.time())
+        progress.write(json.dumps(payload, sort_keys=True) + "\n")
+        progress.flush()
+
+    def fallbacks() -> int:
+        counters = registry.export().get("counters", {})
+        return int(counters.get("stream.resident_fallbacks", 0))
+
+    own_next = 0
+    orphans: list = []  # [host, next_index] bands adopted from the dead
+    adoption_report = None
+    result: dict = {"ok": False, "host": me}
+    dispatch_index = 0
+    now0 = 20_950.0
+    drain_deadline = None
+
+    try:
+        while True:
+            # Membership poll — the coordinator-free agreement point:
+            # the view file names the epoch and survivors; this worker
+            # derives everything else (who died, which bands are orphan)
+            # from the view alone.
+            member = _read_membership(shared)
+            if member["epoch"] > view.epoch:
+                survivors = member["hosts"]
+                dead = [h for h in view.hosts if h not in survivors]
+                view = view.degraded(survivors)
+                if me not in view.hosts:
+                    break  # not our story: this worker was voted dead
+                for host in dead:
+                    # Exactly ONE survivor owns each orphan band — a pure
+                    # function of (dead host, degraded view), so every
+                    # survivor derives the same owner with no
+                    # coordination. A band adopted twice would put its
+                    # pairs in two journals: the split-brain state
+                    # replay_cluster_journals exists to refuse.
+                    owner = view.hosts[host % view.num_hosts]
+                    if owner != me:
+                        log("orphan_assigned", dead_host=host,
+                            owner=owner)
+                        continue
+                    dead_path = os.path.join(shared, f"band{host}.jrnl")
+                    adopt_start = time.perf_counter()
+                    tag, rows_adopted = adopt_journal(store, dead_path)
+                    adopt_s = time.perf_counter() - adopt_start
+                    resume_at = 0 if tag is None else tag + 1
+                    orphans.append([host, resume_at])
+                    # Byte coda at the adoption point: the live store
+                    # (own band synced through the last durable epoch +
+                    # the adopted band) must be bit-equal to the merged
+                    # replay of the surviving journals — the degraded-
+                    # mesh byte contract, live.
+                    merged = replay_cluster_journals(
+                        [os.path.join(shared, f"band{me}.jrnl"), dead_path]
+                    )
+                    live_digest = store_digest(store)
+                    byte_equal_store = live_digest == store_digest(
+                        merged.store
+                    )
+                    live_db = os.path.join(shared, f"coda_live_{me}.db")
+                    replay_db = os.path.join(shared, f"coda_replay_{me}.db")
+                    store.flush_to_sqlite(live_db)
+                    merged.store.flush_to_sqlite(replay_db)
+                    with open(live_db, "rb") as fa, open(replay_db, "rb") as fb:
+                        byte_equal_sqlite = fa.read() == fb.read()
+                    adoption_report = {
+                        "dead_host": host,
+                        "journal_tag": tag,
+                        "resume_at": resume_at,
+                        "rows_adopted": rows_adopted,
+                        "adopt_s": adopt_s,
+                        "byte_equal_store": byte_equal_store,
+                        "byte_equal_sqlite": byte_equal_sqlite,
+                    }
+                    log("adopt", epoch=view.epoch, **adoption_report)
+
+            own_done = own_next >= args.batches
+            live_orphans = [o for o in orphans if o[1] < args.batches]
+            if own_done and not live_orphans:
+                if orphans or args.hosts == 1:
+                    break
+                # Own batches are done but no epoch bump arrived yet: a
+                # surviving worker drains briefly in case it is about to
+                # inherit a band, then exits clean.
+                if drain_deadline is None:
+                    drain_deadline = time.time() + args.drain_wait
+                if time.time() >= drain_deadline:
+                    break
+                time.sleep(0.05)
+                continue
+
+            parts = []
+            if not own_done:
+                parts.append((me, own_next))
+                own_next += 1
+            for entry in live_orphans:
+                parts.append((entry[0], entry[1]))
+                entry[1] += 1
+
+            columns = [
+                _band_slice(
+                    args, _global_batch(args, index),
+                    view0_rows(args, host),
+                )
+                for host, index in parts
+            ]
+            keys = sum((c[0] for c in columns), [])
+            sids = sum((c[1] for c in columns), [])
+            probs = np.concatenate([c[2] for c in columns])
+            offsets = np.concatenate(
+                [[0]] + [np.diff(c[3]) for c in columns]
+            )
+            offsets = np.cumsum(offsets).astype(np.int64)
+            outcomes = sum((c[4] for c in columns), [])
+
+            log("offered", parts=parts, requests=len(keys))
+            time.sleep(args.interval)
+            plan = cache.plan_for(keys, sids, probs, offsets)
+            driver.dispatch(plan, outcomes, now=now0 + dispatch_index)
+            driver.checkpoint(dispatch_index)
+            log(
+                "durable", parts=parts, adopt=driver.last_adopt,
+                fallbacks=fallbacks(), batch=dispatch_index,
+            )
+            dispatch_index += 1
+
+        result.update(
+            ok=True,
+            batches_settled=dispatch_index,
+            fallbacks=fallbacks(),
+            adoption=adoption_report,
+            final_store_digest=None,
+            final_rows=len(store),
+        )
+    finally:
+        driver.finalize()
+        try:
+            from bayesian_consensus_engine_tpu.cluster.recover import (
+                store_digest as _digest,
+            )
+
+            result["final_store_digest"] = _digest(store)
+        except Exception:
+            pass
+        with open(os.path.join(shared, f"result_{me}.json"), "w") as f:
+            json.dump(result, f, sort_keys=True)
+        progress.close()
+    return 0
+
+
+def view0_rows(args, host: int):
+    """Epoch-0 band rows of *host* — the ownership the workload is keyed
+    by (orphan bands keep their original rows; only the SERVING host
+    changes across epochs)."""
+    from bayesian_consensus_engine_tpu.cluster.membership import MeshView
+
+    view = MeshView(
+        epoch=0,
+        hosts=tuple(range(args.hosts)),
+        devices_per_host=args.devices_per_host,
+    )
+    return view.owned_markets(host, args.markets)
+
+
+# --------------------------------------------------------------------------
+# supervisor
+# --------------------------------------------------------------------------
+
+
+def _read_lines(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail of a killed worker
+    return out
+
+
+def run_supervisor(args) -> int:
+    from bayesian_consensus_engine_tpu.cluster.membership import MeshView
+    from bayesian_consensus_engine_tpu.cluster.recover import (
+        replay_cluster_journals,
+        store_digest,
+    )
+    from bayesian_consensus_engine_tpu.obs.ledger import RunLedger
+    from bayesian_consensus_engine_tpu.obs.slo import (
+        SloTracker,
+        goodput_from_counts,
+    )
+
+    wall_start = time.perf_counter()
+    shared = args.dir or tempfile.mkdtemp(prefix="bce_kill_soak_")
+    os.makedirs(shared, exist_ok=True)
+    view = MeshView(
+        epoch=0,
+        hosts=tuple(range(args.hosts)),
+        devices_per_host=args.devices_per_host,
+    )
+    _write_membership(shared, view)
+    victim = args.hosts - 1
+    survivor_hosts = [h for h in view.hosts if h != victim]
+
+    script = os.path.abspath(__file__)
+    base_cmd = [
+        sys.executable, script,
+        "--dir", shared,
+        "--hosts", str(args.hosts),
+        "--markets", str(args.markets),
+        "--batches", str(args.batches),
+        "--sources", str(args.sources),
+        "--steps", str(args.steps),
+        "--num-slots", str(args.num_slots),
+        "--interval", str(args.interval),
+        "--devices-per-host", str(args.devices_per_host),
+        "--drain-wait", str(args.drain_wait),
+    ]
+    procs = {}
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    for host in view.hosts:
+        procs[host] = subprocess.Popen(
+            base_cmd + ["--worker", str(host)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        )
+
+    def durable_lines(host):
+        return [
+            line
+            for line in _read_lines(
+                os.path.join(shared, f"progress_{host}.jsonl")
+            )
+            if line["kind"] == "durable"
+        ]
+
+    # Phase 1: steady stream until the victim has made kill_after
+    # batches durable — then the REAL kill, mid-stream, no warning.
+    deadline = time.time() + args.phase_timeout
+    while len(durable_lines(victim)) < args.kill_after:
+        if time.time() > deadline:
+            for p in procs.values():
+                p.kill()
+            raise RuntimeError(
+                f"victim never reached {args.kill_after} durable batches "
+                f"within {args.phase_timeout}s"
+            )
+        if procs[victim].poll() is not None:
+            raise RuntimeError("victim exited before the kill")
+        time.sleep(0.03)
+
+    kill_ts = time.time()
+    os.kill(procs[victim].pid, signal.SIGKILL)
+    procs[victim].wait(timeout=30)
+
+    # Phase 2: the failure detector publishes the degraded view; the
+    # survivors do the rest (replay, adopt, resume) on their own.
+    degraded = view.degraded(survivor_hosts)
+    _write_membership(shared, degraded, kill_ts=kill_ts)
+
+    deadline = time.time() + args.phase_timeout
+    for host in survivor_hosts:
+        remaining = max(5.0, deadline - time.time())
+        try:
+            out, _ = procs[host].communicate(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            procs[host].kill()
+            out, _ = procs[host].communicate()
+            raise RuntimeError(
+                f"survivor {host} did not finish within {remaining:.0f}s:"
+                f"\n{out[-4000:]}"
+            )
+        if procs[host].returncode != 0:
+            raise RuntimeError(
+                f"survivor {host} failed rc={procs[host].returncode}:"
+                f"\n{out[-4000:]}"
+            )
+
+    # -- adjudication ------------------------------------------------------
+    # The orphan band's OWNER (the one survivor that adopted it — the
+    # worker-side deterministic assignment) is the story's protagonist;
+    # other survivors just keep streaming their own bands.
+    survivor_results = {}
+    for host in survivor_hosts:
+        with open(os.path.join(shared, f"result_{host}.json")) as f:
+            survivor_results[host] = json.load(f)
+        assert survivor_results[host]["ok"], survivor_results[host]
+    adopters = [
+        h for h, res in survivor_results.items()
+        if res["adoption"] is not None
+    ]
+    assert len(adopters) == 1, (
+        f"exactly one survivor must adopt the dead band; got {adopters}"
+    )
+    survivor = adopters[0]
+    survivor_result = survivor_results[survivor]
+    adoption = survivor_result["adoption"]
+
+    # Offer/durable bookkeeping per (band, batch): offered at the FIRST
+    # offer anywhere (the dead worker's offers count — crash-eaten
+    # traffic stays in the denominator), durable at the first journal-
+    # fsynced settle covering the part.
+    offered: dict = {}
+    durable: dict = {}
+    requests_per_band: dict = {}
+    for host in view.hosts:
+        for line in _read_lines(
+            os.path.join(shared, f"progress_{host}.jsonl")
+        ):
+            if line["kind"] not in ("offered", "durable"):
+                continue
+            for band, index in line["parts"]:
+                key = (band, index)
+                rows = len(list(view0_rows(args, band)))
+                requests_per_band[band] = rows
+                if line["kind"] == "offered":
+                    offered[key] = min(
+                        offered.get(key, line["ts"]), line["ts"]
+                    )
+                else:
+                    durable[key] = min(
+                        durable.get(key, line["ts"]), line["ts"]
+                    )
+
+    # Durable authority for the victim's final pre-kill batch: the
+    # journal, not the progress log. A SIGKILL can land between the
+    # epoch fsync and the worker's own durable line — the batch IS
+    # durable (the survivor resumes after it, trusting the journal tag),
+    # so count it durable no later than the kill instant rather than
+    # failing the soak on a lost log write.
+    dead_tag = adoption["journal_tag"]
+    if dead_tag is not None:
+        for index in range(dead_tag + 1):
+            durable.setdefault((victim, index), kill_ts)
+
+    tracker = SloTracker(args.slo)
+    for key, offer_ts in sorted(offered.items()):
+        n = requests_per_band[key[0]]
+        if key not in durable:
+            for _ in range(n):
+                tracker.record("failed")
+            continue
+        latency = durable[key] - offer_ts
+        for _ in range(n):
+            tracker.record_latency(latency)
+    snapshot = tracker.snapshot()
+    goodput = goodput_from_counts(snapshot["counts"])
+
+    # Recovery latency: kill → the first durable batch that covers an
+    # orphan (dead-band) part on the survivor.
+    orphan_durable = [
+        ts for (band, _idx), ts in durable.items()
+        if band == victim and ts > kill_ts
+    ]
+    assert orphan_durable, "no dead-band batch ever re-settled"
+    recovery_s = min(orphan_durable) - kill_ts
+
+    # Steady-state residency: zero fallbacks before the kill on every
+    # worker, zero overall on the survivor (adoption itself rides the
+    # relayout, not a rebuild).
+    pre_kill_fallbacks = max(
+        (
+            line["fallbacks"]
+            for host in view.hosts
+            for line in durable_lines(host)
+            if line["ts"] <= kill_ts
+        ),
+        default=0,
+    )
+    survivor_fallbacks = max(
+        res["fallbacks"] for res in survivor_results.values()
+    )
+    adopt_modes = sorted(
+        {line["adopt"] for line in durable_lines(survivor)}
+    )
+
+    # Final self-containment: the survivor's OWN journal now carries the
+    # adopted band — it alone must replay to the survivor's final store.
+    survivor_journal = os.path.join(shared, f"band{survivor}.jrnl")
+    final_replay = replay_cluster_journals([survivor_journal])
+    journal_self_contained = (
+        store_digest(final_replay.store)
+        == survivor_result["final_store_digest"]
+    )
+
+    wall_s = time.perf_counter() - wall_start
+    every_batch_durable = all(
+        (band, index) in durable
+        for band in view.hosts
+        for index in range(args.batches)
+    )
+    payload = {
+        "ok": bool(
+            adoption["byte_equal_store"]
+            and adoption["byte_equal_sqlite"]
+            and journal_self_contained
+            and every_batch_durable
+            and pre_kill_fallbacks == 0
+            and survivor_fallbacks == 0
+        ),
+        "hosts": args.hosts,
+        "killed_host": victim,
+        "kill_after_durable": args.kill_after,
+        "batches_per_band": args.batches,
+        "requests_offered": snapshot["offered"],
+        "goodput_within_slo": goodput,
+        "slo": snapshot,
+        "recovery_s": recovery_s,
+        "adopt_s": adoption["adopt_s"],
+        "rows_adopted": adoption["rows_adopted"],
+        "dead_journal_tag": adoption["journal_tag"],
+        "resident_fallbacks_steady": pre_kill_fallbacks,
+        "resident_fallbacks_survivor": survivor_fallbacks,
+        "survivor_adopt_modes": adopt_modes,
+        "byte_equal_store": adoption["byte_equal_store"],
+        "byte_equal_sqlite": adoption["byte_equal_sqlite"],
+        "survivor_journal_self_contained": journal_self_contained,
+        "every_batch_durable": every_batch_durable,
+        "wall_s": wall_s,
+    }
+
+    if args.ledger:
+        ledger = RunLedger(args.ledger, backend="cpu")
+        ledger.record(
+            "soak.kill.recovery", value=round(recovery_s, 4), unit="s",
+            extras={
+                "slo": snapshot,
+                "recovery_s": recovery_s,
+                "goodput_within_slo": goodput,
+                "resident_fallbacks": survivor_fallbacks,
+            },
+        )
+        ledger.close()
+
+    if not args.json:
+        print(
+            f"kill soak: {args.hosts} hosts, killed host {victim} after "
+            f"{args.kill_after} durable batches"
+        )
+        print(
+            f"  recovered goodput_within_slo = {goodput:.3f} over "
+            f"{snapshot['offered']} offered requests "
+            f"(counts {snapshot['counts']})"
+        )
+        print(
+            f"  recovery_s = {recovery_s:.3f}  adopt_s = "
+            f"{adoption['adopt_s']:.3f}  rows_adopted = "
+            f"{adoption['rows_adopted']}"
+        )
+        print(
+            f"  byte coda: store={adoption['byte_equal_store']} "
+            f"sqlite={adoption['byte_equal_sqlite']} "
+            f"self_contained={journal_self_contained} "
+            f"fallbacks={survivor_fallbacks}"
+        )
+    print(json.dumps(payload, sort_keys=True))
+    return 0 if payload["ok"] else 1
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worker", type=int, default=None,
+                        help="internal: run as worker RANK")
+    parser.add_argument("--dir", default=None,
+                        help="shared soak directory (default: mkdtemp)")
+    parser.add_argument("--hosts", type=int, default=2)
+    parser.add_argument("--markets", type=int, default=64,
+                        help="GLOBAL market count (bands split it)")
+    parser.add_argument("--batches", type=int, default=12,
+                        help="batches per band")
+    parser.add_argument("--kill-after", type=int, default=3,
+                        help="victim durable batches before the SIGKILL")
+    parser.add_argument("--sources", type=int, default=40)
+    parser.add_argument("--steps", type=int, default=1)
+    parser.add_argument("--num-slots", type=int, default=8)
+    parser.add_argument("--interval", type=float, default=0.15,
+                        help="per-batch arrival pacing, seconds")
+    parser.add_argument("--slo", type=float, default=0.5,
+                        help="submit→durable objective, seconds")
+    parser.add_argument("--devices-per-host", type=int, default=2)
+    parser.add_argument("--drain-wait", type=float, default=8.0)
+    parser.add_argument("--phase-timeout", type=float, default=240.0)
+    parser.add_argument("--json", action="store_true",
+                        help="suppress the prose; emit only the JSON line")
+    parser.add_argument("--ledger",
+                        help="append obs run-ledger records here "
+                             "(render: bce-tpu stats)")
+    args = parser.parse_args()
+    if args.hosts < 2:
+        parser.error("--hosts must be >= 2 (someone has to die)")
+    if args.worker is not None:
+        return run_worker(args)
+    return run_supervisor(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

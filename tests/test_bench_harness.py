@@ -906,6 +906,81 @@ class TestStreamLegBands:
             assert "trials" in inspect.signature(fn).parameters, leg
 
 
+class TestKillSoakLeg:
+    """The round-13 failure-as-steady-state leg (``e2e_kill_soak``) at
+    --fast shapes: a REAL worker SIGKILL mid-stream over the shared-
+    nothing banded cluster, adjudicated on recovered goodput. The
+    in-process recovery contracts are pinned by tests/test_cluster.py;
+    this pins the LEG contract (JSON shape, the acceptance fields, the
+    ledger recovery/goodput records the stats table reads)."""
+
+    def test_fast_leg_reports_recovered_goodput(self, tmp_path):
+        from bayesian_consensus_engine_tpu.obs.ledger import (
+            RunLedger,
+            read_ledger,
+            summarize,
+        )
+
+        ledger_path = tmp_path / "kill.jsonl"
+        old = bench._LEDGER
+        bench._LEDGER = RunLedger(ledger_path, backend="cpu")
+        try:
+            result = bench.run_leg_inprocess("e2e_kill_soak", fast=True)
+        finally:
+            bench._LEDGER.close()
+            bench._LEDGER = old
+        for key in (
+            "wall_s", "goodput_within_slo", "recovery_s", "adopt_s",
+            "rows_adopted", "requests_offered", "slo",
+            "resident_fallbacks_steady", "resident_fallbacks_survivor",
+            "survivor_adopt_modes", "byte_equal_store",
+            "byte_equal_sqlite", "survivor_journal_self_contained",
+            "every_batch_durable", "soak_ok",
+        ):
+            assert key in result, key
+        # The acceptance bars: the kill was recovered (a dead-band batch
+        # re-settled), every offered batch eventually made durable, the
+        # stream NEVER fell back to teardown+rebuild — before or during
+        # recovery — and the degraded-mesh byte contract held live.
+        assert result["soak_ok"] is True
+        assert result["recovery_s"] > 0
+        assert result["every_batch_durable"] is True
+        assert result["resident_fallbacks_steady"] == 0
+        assert result["resident_fallbacks_survivor"] == 0
+        assert result["byte_equal_store"] is True
+        assert result["byte_equal_sqlite"] is True
+        assert result["survivor_journal_self_contained"] is True
+        # Goodput is the honest fraction: met / offered with the crash-
+        # eaten traffic counting against.
+        assert 0.0 < result["goodput_within_slo"] <= 1.0
+        assert sum(result["slo"]["counts"].values()) == (
+            result["requests_offered"]
+        )
+        # Recovery rode the resident adopt, not a rebuild.
+        assert "relayout" in result["survivor_adopt_modes"]
+        assert not any(
+            m.startswith("rebuild") for m in result["survivor_adopt_modes"]
+        )
+        json.dumps(result)
+        # The ledger record carries the recovery story the stats table
+        # renders: goodput (extras.slo) + the recovery_s fold.
+        records = read_ledger(ledger_path)
+        band = summarize(records)["e2e_kill_soak"]
+        assert band["recovery_s"] == pytest.approx(
+            result["recovery_s"], rel=1e-6
+        )
+        assert band["goodput_within_slo"] == pytest.approx(
+            result["goodput_within_slo"], rel=1e-6
+        )
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_kill_soak" in bench.LEGS
+        assert "e2e_kill_soak" in bench.DEVICE_LEG_ORDER
+        assert "e2e_kill_soak" in bench.compose(
+            {}, [], None, 0.0
+        )[0]["extras"]
+
+
 class TestServeLeg:
     """The round-8 serving-latency leg (``e2e_serve``) at --fast shapes:
     closed-loop, open-loop (Poisson), and bounded-overload acts over the

@@ -1,0 +1,576 @@
+"""cluster/ — membership views, journal merge recovery, and the round-13
+resident adopt extension (band mode + the cluster host-staged relayout).
+
+The real-kill multi-process soak lives in scripts/kill_soak.py (smoked by
+tests/test_bench_harness.py::TestKillSoakLeg); this suite pins the
+in-process contracts it rides on:
+
+* :class:`~.cluster.membership.MeshView` — coordinator-free agreement:
+  identical views from identical host sets, bands that tile the padded
+  axis, degraded views as pure epoch bumps.
+* :mod:`~.cluster.recover` — the degraded-mesh byte contract: a
+  one-journal merge is bit-equal to ``replay_journal``; band journals
+  merge deterministically and refuse split-brain; a live adoption equals
+  the offline merge.
+* the session side — ``band=`` and forced-cluster adopts take the
+  RELAYOUT path (never the PR-5 teardown+rebuild) with byte parity
+  against the per-batch-session stream, rebuild reasons are named, and
+  ``stream.resident_fallbacks`` counts exactly the falls.
+* the crash-resume degraded-factorisation contract: a journal written on
+  an (A, B) mesh resumes bit-equal on a DIFFERENT factorisation of the
+  surviving devices — store arrays, appended journal epochs (wall_ts
+  masked), and SQLite export bytes.
+"""
+
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from bayesian_consensus_engine_tpu.cluster import (
+    ClusterModeUnsupported,
+    MeshView,
+    adopt_journal,
+    replay_cluster_journals,
+    store_digest,
+)
+from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+from bayesian_consensus_engine_tpu.pipeline import settle_stream
+from bayesian_consensus_engine_tpu.state.journal import (
+    JournalWriter,
+    replay_journal,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 21_400.0
+
+
+def _payloads(rng, markets, universe, tag=""):
+    out = []
+    for m in range(markets):
+        n = rng.randint(1, 3)
+        out.append((
+            f"m{tag}-{m}",
+            [
+                {
+                    "sourceId": f"s{rng.randrange(universe)}",
+                    "probability": round(rng.random(), 6),
+                }
+                for _ in range(n)
+            ],
+        ))
+    return out
+
+
+def _mixed_batches(markets=24, batches=5, seed=11, tag=""):
+    """Stable pairs, drift, then pair growth — refresh, relayout, ladder.
+
+    The market COUNT stays fixed (band plans must cover exactly their
+    band every batch); drift and growth happen in the (source, market)
+    pair universe, which is what moves rows through the store."""
+    rng = random.Random(seed)
+    stable = _payloads(rng, markets, 12, tag=tag)
+    out = []
+    for b in range(batches):
+        if b < 2:
+            pays = [
+                (k, [dict(s, probability=round(rng.random(), 6))
+                     for s in sigs])
+                for k, sigs in stable
+            ]
+        elif b < 4:
+            pays = _payloads(rng, markets, 16, tag=tag)
+        else:
+            pays = [
+                (
+                    f"m{tag}-{m}",
+                    [
+                        {
+                            "sourceId": f"s{rng.randrange(40)}",
+                            "probability": round(rng.random(), 6),
+                        }
+                        for _ in range(rng.randint(3, 6))
+                    ],
+                )
+                for m in range(markets)
+            ]
+        outs = [rng.random() < 0.5 for _ in pays]
+        out.append((pays, outs))
+    return out
+
+
+def _journal_epochs_sans_clock(path):
+    """Frame payloads with the wall-clock stamp (and its CRC) masked."""
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        fields = hdr.unpack_from(blob, off)
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = fields
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4  # + crc32
+    return epochs
+
+
+class TestMeshView:
+    def test_identical_host_sets_agree(self):
+        a = MeshView(epoch=3, hosts=(2, 0, 5), devices_per_host=4)
+        b = MeshView(epoch=3, hosts=(5, 2, 0), devices_per_host=4)
+        assert a == b
+        assert a.hosts == (0, 2, 5)
+        assert a.fingerprint == b.fingerprint
+        assert a.shape == (12, 1)
+
+    def test_bands_tile_the_padded_axis(self):
+        view = MeshView(epoch=0, hosts=(0, 1, 2), devices_per_host=2)
+        markets = 17
+        padded = view.padded_markets(markets)
+        assert padded % view.markets_extent == 0
+        spans = [view.band(h, markets) for h in view.hosts]
+        assert [lo for lo, _ in spans] == [0, padded // 3, 2 * padded // 3]
+        assert all(gm == markets for _, gm in spans)
+        owned = [list(view.owned_markets(h, markets)) for h in view.hosts]
+        flat = sum(owned, [])
+        assert flat == list(range(markets))  # live rows, no gaps/overlap
+
+    def test_degraded_is_an_epoch_bump_over_survivors(self):
+        view = MeshView(epoch=0, hosts=(0, 1, 2), devices_per_host=2)
+        degraded = view.degraded([2, 0])
+        assert degraded.epoch == 1
+        assert degraded.hosts == (0, 2)
+        assert degraded.fingerprint != view.fingerprint
+        # Survivors re-tile the whole axis between them.
+        assert list(degraded.owned_markets(0, 10)) + list(
+            degraded.owned_markets(2, 10)
+        ) == list(range(10))
+        with pytest.raises(ValueError, match="not members"):
+            view.degraded([0, 7])
+        with pytest.raises(ValueError, match="empty"):
+            view.degraded([])
+
+    def test_ici_shape_validation(self):
+        with pytest.raises(ValueError, match="devices per"):
+            MeshView(epoch=0, hosts=(0,), devices_per_host=4,
+                     ici_shape=(3, 1))
+        with pytest.raises(ValueError, match="duplicate"):
+            MeshView(epoch=0, hosts=(1, 1), devices_per_host=1)
+
+    def test_build_mesh_matches_view_shape(self):
+        # Single-host: the local mesh over this host's devices.
+        local = MeshView(epoch=0, hosts=(0,), devices_per_host=4)
+        mesh = local.build_mesh()
+        assert dict(mesh.shape) == {"markets": 4, "sources": 1}
+        # Multi-host on one process (explicit granules over the 8 CPU
+        # devices): the hybrid DCN-outer mesh, granules in sorted-host
+        # order — the same factorisation MeshView.shape promises.
+        multi = MeshView(epoch=0, hosts=(0, 1), devices_per_host=4,
+                         ici_shape=(2, 2))
+        mesh = multi.build_mesh()
+        assert dict(mesh.shape) == {"markets": 4, "sources": 2}
+        assert (multi.markets_extent, multi.sources_extent) == (4, 2)
+
+
+def _band_stream_to_journal(tmp_path, name, tag, markets=10, batches=3,
+                            seed=5):
+    """One shared-nothing band: stream → journal → synced store."""
+    rng = random.Random(seed)
+    store = TensorReliabilityStore()
+    jrnl = tmp_path / f"{name}.jrnl"
+    bs = []
+    for _ in range(batches):
+        pays = _payloads(rng, markets, 8, tag=tag)
+        bs.append((pays, [rng.random() < 0.5 for _ in pays]))
+    list(settle_stream(store, bs, steps=1, now=NOW, journal=str(jrnl),
+                       sync_checkpoints=True))
+    store.sync()
+    return store, jrnl
+
+
+class TestClusterReplay:
+    def test_single_journal_merge_is_bit_equal_to_replay(self, tmp_path):
+        _, jrnl = _band_stream_to_journal(tmp_path, "solo", "a")
+        merged = replay_cluster_journals([jrnl])
+        ref, tag = replay_journal(jrnl)
+        assert merged.tags == (tag,)
+        assert merged.resume_index(0) == tag + 1
+        # Bit-for-bit: same digest means same pair order, same value
+        # columns, same ISO sidecars — the degraded-mesh byte contract's
+        # foundation.
+        assert store_digest(merged.store) == store_digest(ref)
+        used = len(ref)
+        np.testing.assert_array_equal(
+            merged.store._rel[:used], ref._rel[:used]
+        )
+        np.testing.assert_array_equal(
+            merged.store._days[:used], ref._days[:used]
+        )
+
+    def test_band_journals_merge_deterministically(self, tmp_path):
+        s_a, j_a = _band_stream_to_journal(tmp_path, "a", "a", seed=5)
+        s_b, j_b = _band_stream_to_journal(tmp_path, "b", "b", seed=6)
+        merged = replay_cluster_journals([j_a, j_b])
+        assert merged.tags == (2, 2)
+        assert merged.rows == (len(s_a), len(s_b))
+        got = {(r.source_id, r.market_id) for r in
+               merged.store.list_sources()}
+        want = {
+            (r.source_id, r.market_id)
+            for s in (s_a, s_b) for r in s.list_sources()
+        }
+        assert got == want
+        again = replay_cluster_journals([j_a, j_b])
+        assert store_digest(again.store) == store_digest(merged.store)
+        # Order is part of the contract: callers must agree on it.
+        flipped = replay_cluster_journals([j_b, j_a])
+        assert store_digest(flipped.store) != store_digest(merged.store)
+
+    def test_adopt_journal_equals_offline_merge(self, tmp_path):
+        _, j_a = _band_stream_to_journal(tmp_path, "a2", "a", seed=5)
+        s_b, j_b = _band_stream_to_journal(tmp_path, "b2", "b", seed=6)
+        live, _ = replay_journal(j_a)
+        tag, rows = adopt_journal(live, j_b)
+        assert (tag, rows) == (2, len(s_b))
+        merged = replay_cluster_journals([j_a, j_b])
+        assert store_digest(live) == store_digest(merged.store)
+        # SQLite bytes too — identical stores must export identical files.
+        live.flush_to_sqlite(tmp_path / "live.db")
+        merged.store.flush_to_sqlite(tmp_path / "merged.db")
+        assert (tmp_path / "live.db").read_bytes() == (
+            tmp_path / "merged.db"
+        ).read_bytes()
+
+    def test_overlapping_journals_are_split_brain(self, tmp_path):
+        _, jrnl = _band_stream_to_journal(tmp_path, "dup", "a")
+        with pytest.raises(ValueError, match="split-brain"):
+            replay_cluster_journals([jrnl, jrnl])
+
+    def test_adopted_rows_ride_the_next_epoch(self, tmp_path):
+        """After adoption the survivor's own journal is self-contained:
+        one more settle + epoch, and IT ALONE replays to the full store."""
+        _, j_a = _band_stream_to_journal(tmp_path, "a3", "a", seed=5)
+        _, j_b = _band_stream_to_journal(tmp_path, "b3", "b", seed=6)
+        live, _ = replay_journal(j_a)
+        adopt_journal(live, j_b)
+        writer = JournalWriter(j_a, resume=True)
+        rng = random.Random(9)
+        pays = _payloads(rng, 6, 8, tag="a")
+        list(settle_stream(
+            live, [(pays, [True] * len(pays))], steps=1, now=NOW + 9,
+            journal=writer, sync_checkpoints=True,
+        ))
+        live.sync()
+        solo = replay_cluster_journals([j_a])
+        assert store_digest(solo.store) == store_digest(live)
+
+
+class TestClusterAdopt:
+    """The round-13 retirement of the PR-5 fallback: band mode and the
+    cluster (host-staged) posture adopt by RELAYOUT, byte-equal to the
+    per-batch-session stream; the remaining rebuilds carry reasons."""
+
+    def _stream(self, batches, mesh, band=None, resident=True,
+                num_slots=8, monkey=None, stats=None):
+        store = TensorReliabilityStore()
+        stats = stats if stats is not None else []
+        results = list(settle_stream(
+            store, batches, steps=2, now=NOW, stats=stats,
+            reuse_plans=True, mesh=mesh, band=band, num_slots=num_slots,
+            resident_session=resident,
+        ))
+        store.sync()
+        records = [
+            (r.source_id, r.market_id, r.reliability, r.confidence,
+             r.updated_at)
+            for r in store.list_sources()
+        ]
+        return records, results, stats
+
+    def test_band_mode_adopts_resident_and_matches_per_batch(self):
+        batches = _mixed_batches()
+        markets = max(len(p) for p, _ in batches)
+        mesh = make_mesh((4, 2))
+        rec_on, res_on, stats_on = self._stream(
+            batches, mesh, band=(0, markets)
+        )
+        modes = [s["session_adopt"] for s in stats_on]
+        assert modes[0] == "start"
+        assert set(modes[1:]) <= {"refresh", "relayout"}  # NO rebuilds
+        rec_off, res_off, _ = self._stream(
+            batches, mesh, band=(0, markets), resident=False
+        )
+        assert rec_on == rec_off
+        for a, b in zip(res_on, res_off):
+            assert a.market_keys == b.market_keys
+            np.testing.assert_array_equal(
+                np.asarray(a.consensus), np.asarray(b.consensus)
+            )
+
+    def test_forced_cluster_path_is_byte_equal(self, monkeypatch):
+        """The host-staged cluster relayout (multi-controller posture,
+        forced via the _process_count seam) must produce the same bytes
+        as the in-HBM device relayout AND the per-batch rebuild."""
+        import bayesian_consensus_engine_tpu.pipeline as pipeline_mod
+
+        batches = _mixed_batches(seed=13)
+        markets = max(len(p) for p, _ in batches)
+        mesh = make_mesh()
+        rec_device, res_device, _ = self._stream(
+            batches, mesh, band=(0, markets)
+        )
+        monkeypatch.setattr(pipeline_mod, "_process_count", lambda: 2)
+        rec_cluster, res_cluster, stats = self._stream(
+            batches, mesh, band=(0, markets)
+        )
+        modes = [s["session_adopt"] for s in stats]
+        assert "relayout" in modes
+        assert not any(m.startswith("rebuild") for m in modes[1:])
+        assert rec_cluster == rec_device
+        for a, b in zip(res_cluster, res_device):
+            np.testing.assert_array_equal(
+                np.asarray(a.consensus), np.asarray(b.consensus)
+            )
+
+    def test_band_change_rebuilds_with_reason(self):
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+            build_settlement_plan,
+        )
+
+        rng = random.Random(3)
+        store = TensorReliabilityStore()
+        mesh = make_mesh()
+        pays = _payloads(rng, 10, 8)
+        plan = build_settlement_plan(store, pays, num_slots=4,
+                                     fingerprint=True)
+        session = ShardedSettlementSession(
+            store, plan, mesh, band=(0, 10)
+        )
+        session.settle([True] * 10, steps=1, now=NOW)
+        pays2 = _payloads(rng, 12, 8, tag="x")
+        plan2 = build_settlement_plan(store, pays2, num_slots=4,
+                                      fingerprint=True)
+        assert session.adopt(plan2, band=(0, 12)) == "rebuild:band-change"
+        session.close()
+
+    def test_backdated_entering_stamps_rebuild_with_reason(self):
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+            build_settlement_plan,
+            settle,
+        )
+
+        rng = random.Random(4)
+        store = TensorReliabilityStore()
+        mesh = make_mesh()
+        # Rows settled at an OLD day, then a session whose epoch sits
+        # above it: those rows entering the resident block cannot be
+        # re-expressed against the session epoch.
+        old_pays = _payloads(rng, 4, 6, tag="old")
+        old_plan = build_settlement_plan(store, old_pays, num_slots=4)
+        settle(store, old_plan, [True] * 4, steps=1, now=NOW - 500.0)
+        store.sync()
+        pays = _payloads(rng, 6, 6, tag="live")
+        plan = build_settlement_plan(store, pays, num_slots=4)
+        session = ShardedSettlementSession(store, plan, mesh)
+        session.settle([True] * 6, steps=1, now=NOW)
+        # Force the session's epoch ABOVE the old stamps so the entering
+        # re-expression goes non-positive.
+        session._epoch0 = NOW - 0.5
+        merged = build_settlement_plan(
+            store, pays + old_pays, num_slots=4
+        )
+        assert session.adopt(merged) == "rebuild:backdated-stamps"
+        session.close()
+
+    def test_resident_fallbacks_counter(self, tmp_path):
+        from bayesian_consensus_engine_tpu import obs
+
+        batches = _mixed_batches(seed=17)
+        markets = max(len(p) for p, _ in batches)
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            self._stream(batches, make_mesh(), band=(0, markets))
+        finally:
+            obs.set_metrics_registry(previous)
+        counters = registry.export()["counters"]
+        # The whole drift/growth stream stayed resident: the retirement
+        # metric reads zero.
+        assert counters.get("stream.resident_fallbacks", 0) == 0
+        assert counters["stream.session_adopts"] >= 1
+
+
+class TestAnalyticsClusterGate:
+    def _session(self, band=None):
+        from bayesian_consensus_engine_tpu.pipeline import (
+            ShardedSettlementSession,
+            build_settlement_plan,
+        )
+
+        rng = random.Random(8)
+        store = TensorReliabilityStore()
+        pays = _payloads(rng, 12, 8)
+        plan = build_settlement_plan(store, pays, num_slots=4,
+                                     fingerprint=True)
+        return ShardedSettlementSession(
+            store, plan, make_mesh((4, 2)), band=band
+        ), [True] * 12
+
+    def test_band_session_serves_bands(self):
+        """The PR-10 band tree extended to the banded session: same
+        program, same bits as the whole-axis session on the same plan."""
+        banded, outcomes = self._session(band=(0, 12))
+        with banded:
+            _, tb_b, bands_b, prop = banded.settle_with_analytics(
+                outcomes, steps=1, now=NOW
+            )
+        assert prop is None
+        plain, _ = self._session()
+        with plain:
+            _, tb_p, bands_p, _ = plain.settle_with_analytics(
+                outcomes, steps=1, now=NOW
+            )
+        for field in ("mean", "lo", "hi", "stderr"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(bands_b, field)),
+                np.asarray(getattr(bands_p, field)),
+            )
+        np.testing.assert_array_equal(
+            np.asarray(tb_b.prediction), np.asarray(tb_p.prediction)
+        )
+
+    def test_graph_sweep_on_band_session_names_the_route(self):
+        from bayesian_consensus_engine_tpu.analytics.bands import (
+            AnalyticsOptions,
+        )
+        from bayesian_consensus_engine_tpu.analytics.graph import (
+            MarketGraph,
+        )
+
+        session, outcomes = self._session(band=(0, 12))
+        graph = MarketGraph.from_edges([("m-0", "m-1", 0.5)])
+        with session:
+            with pytest.raises(
+                ClusterModeUnsupported, match="cluster.membership"
+            ):
+                session.settle_with_analytics(
+                    outcomes, steps=1, now=NOW,
+                    analytics=AnalyticsOptions(graph=graph),
+                )
+
+    def test_multi_controller_names_the_route(self, monkeypatch):
+        import bayesian_consensus_engine_tpu.pipeline as pipeline_mod
+
+        session, outcomes = self._session()
+        monkeypatch.setattr(pipeline_mod, "_process_count", lambda: 2)
+        with session:
+            with pytest.raises(
+                ClusterModeUnsupported, match="MeshView"
+            ):
+                session.settle_with_analytics(outcomes, steps=1, now=NOW)
+
+
+class TestDegradedFactorisationResume:
+    """The crash-resume satellite: a journal written on an (A, B) mesh
+    replays bit-equal onto a DIFFERENT degraded factorisation — final
+    store arrays, the journal epochs appended during the resume
+    (wall_ts masked), and SQLite export bytes."""
+
+    def _crash_stream(self, tmp_path, monkeypatch, batches, mesh):
+        real_flush = TensorReliabilityStore.flush_to_journal
+        calls = {"n": 0}
+
+        def broken_third(self, journal, tag=0):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("journal disk gone")
+            return real_flush(self, journal, tag=tag)
+
+        monkeypatch.setattr(
+            TensorReliabilityStore, "flush_to_journal", broken_third
+        )
+        store = TensorReliabilityStore()
+        jrnl = tmp_path / "cluster_crash.jrnl"
+        stats: list = []
+        writer = JournalWriter(jrnl)
+        with pytest.raises(RuntimeError, match="journal disk gone"):
+            for _r in settle_stream(
+                store, batches, steps=2, now=NOW, checkpoint_every=1,
+                stats=stats, reuse_plans=True, mesh=mesh, journal=writer,
+                sync_checkpoints=True,
+            ):
+                pass
+        writer.close()
+        monkeypatch.setattr(
+            TensorReliabilityStore, "flush_to_journal", real_flush
+        )
+        return jrnl
+
+    def _resume(self, tmp_path, jrnl_src, name, batches, mesh):
+        """Replay the crashed journal, resume the remaining batches on
+        *mesh*, and return (store, journal copy, sqlite path)."""
+        import shutil
+
+        jrnl = tmp_path / f"resume_{name}.jrnl"
+        shutil.copy(jrnl_src, jrnl)
+        store, tag = replay_journal(jrnl)
+        resume_from = tag + 1
+        stats: list = []
+        for _r in settle_stream(
+            store, batches[resume_from:], steps=2, now=NOW + resume_from,
+            checkpoint_every=1, stats=stats, reuse_plans=True, mesh=mesh,
+            journal=JournalWriter(jrnl, resume=True),
+            sync_checkpoints=True,
+        ):
+            pass
+        store.sync()
+        db = tmp_path / f"resume_{name}.db"
+        store.flush_to_sqlite(db)
+        return store, jrnl, db
+
+    def test_degraded_resume_is_bit_equal_to_single_host(
+        self, tmp_path, monkeypatch
+    ):
+        batches = _mixed_batches(seed=29)
+        written_mesh = make_mesh()  # (8, 1): the full "cluster"
+        jrnl = self._crash_stream(
+            tmp_path, monkeypatch, batches, written_mesh
+        )
+        _store, _j, _db = None, None, None
+        # Degraded factorisation: HALF the devices (the survivors),
+        # markets-only — the bit-exact regime the contract is pinned in.
+        import jax
+
+        degraded_mesh = make_mesh(
+            (4, 1), devices=jax.devices()[:4]
+        )
+        s_deg, j_deg, db_deg = self._resume(
+            tmp_path, jrnl, "degraded", batches, degraded_mesh
+        )
+        # Single-host replay of the same journal: the flat resume.
+        s_one, j_one, db_one = self._resume(
+            tmp_path, jrnl, "flat", batches, None
+        )
+        assert s_deg.list_sources() == s_one.list_sources()
+        used = len(s_deg)
+        for column in ("_rel", "_conf", "_days", "_exists"):
+            np.testing.assert_array_equal(
+                getattr(s_deg, column)[:used],
+                getattr(s_one, column)[:used],
+            )
+        assert _journal_epochs_sans_clock(j_deg) == (
+            _journal_epochs_sans_clock(j_one)
+        )
+        assert db_deg.read_bytes() == db_one.read_bytes()
+        assert store_digest(s_deg) == store_digest(s_one)

@@ -217,9 +217,11 @@ stream_batches = [
 from bayesian_consensus_engine_tpu.state.journal import replay_journal
 
 stream_jrnl = str(pathlib.Path(outdir, f"stream_{{pid}}.jrnl"))
+stream_stats = []
 stream_results = list(settle_stream(
     stream_store, stream_batches, steps=2, now=20760.0,
     mesh=mesh, band=(blo, M), num_slots=4, journal=stream_jrnl,
+    stats=stream_stats,
 ))
 stream_store.sync()
 replayed_store, stream_journal_tag = replay_journal(stream_jrnl)
@@ -241,6 +243,7 @@ band = {{
     ],
     "stream_journal_ok": stream_journal_ok,
     "stream_journal_tag": stream_journal_tag,
+    "stream_adopt_modes": [s["session_adopt"] for s in stream_stats],
     "consensus": np.asarray(local_view(result.consensus)).tolist(),
     "reliability": np.asarray(local_view(result.state.reliability)).tolist(),
     "loop_consensus": np.asarray(local_view(loop_consensus)).tolist(),
@@ -714,6 +717,15 @@ class TestTwoProcessCluster:
             # inside the worker, watermarked at the last batch.
             assert band["stream_journal_ok"] is True
             assert band["stream_journal_tag"] == 2
+            # Round 13: the multi-process band stream is served RESIDENT
+            # — the PR-5 teardown+rebuild fallback is retired. Fresh-
+            # market drift batches adopt through the process-local
+            # staged relayout, never by dropping the block.
+            modes = band["stream_adopt_modes"]
+            assert modes[0] == "start"
+            assert not any(m.startswith("rebuild") for m in modes[1:]), (
+                modes
+            )
             for sid, mid, rel, conf, iso in band["stream_records"]:
                 assert (sid, mid) not in union, "band stream stores overlap"
                 union[(sid, mid)] = (rel, conf, iso)

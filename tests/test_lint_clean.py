@@ -155,6 +155,18 @@ _CASES = [
         f"from {PKG}.ops.uncertainty import band_math\n",
     ),
     (
+        # Round 13: cluster (membership views + journal recovery) sits
+        # beside analytics — built on parallel's mesh machinery and
+        # state's journal, orchestrated BY pipeline/serve; a cluster
+        # module reaching up into the orchestration tier is an upward
+        # import.
+        "LY301",
+        f"{PKG}/cluster/case.py",
+        f"from {PKG}.pipeline import settle_stream\n",
+        f"from {PKG}.parallel.distributed import make_hybrid_mesh\n"
+        f"from {PKG}.state.journal import replay_journal\n",
+    ),
+    (
         "LY302",
         f"{PKG}/core/case.py",
         "import jax.numpy as jnp\n\nSENTINEL = jnp.int32(0)\n",

@@ -176,6 +176,44 @@ class TestHistogramQuantile:
         assert "peak_mem" in rendered.splitlines()[0]
         assert "120MB" in rendered
 
+    def test_ledger_recovery_min_across_repeats(self, tmp_path):
+        # extras.recovery_s (the round-13 kill-soak leg: kill → first
+        # re-settled dead-band batch) folds to the MIN across repeats and
+        # renders as the stats table's recovery column; legs without it
+        # contribute nothing.
+        path = tmp_path / "recovery.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            for value in (1.8, 0.42):
+                ledger.record(
+                    "e2e_kill_soak", value=value, unit="s",
+                    extras={"recovery_s": value},
+                )
+            ledger.record("plain_leg", value=2.0, unit="s")
+        records = obs.read_ledger(path)
+        summary = obs.summarize(records)
+        assert summary["e2e_kill_soak"]["recovery_s"] == 0.42
+        assert "recovery_s" not in summary["plain_leg"]
+        rendered = obs_ledger.render(records)
+        assert "recovery" in rendered.splitlines()[0]
+
+    def test_diff_bands_carries_recovery_metric(self, tmp_path):
+        def ledger_records(path, value):
+            with obs.RunLedger(path, run_id="r") as ledger:
+                ledger.record(
+                    "e2e_kill_soak", value=value, unit="s",
+                    extras={"recovery_s": value},
+                )
+            return obs.read_ledger(path)
+
+        old = ledger_records(tmp_path / "old.jsonl", 1.5)
+        new = ledger_records(tmp_path / "new.jsonl", 0.5)
+        diff = obs.diff_bands(old, new)
+        assert diff["e2e_kill_soak"]["metrics"]["recovery_s"] == {
+            "old": 1.5, "new": 0.5
+        }
+        rendered = obs.render_diff(diff)
+        assert "recovery 1.5->0.5" in rendered
+
     def test_diff_bands_carries_peak_mem_metric(self, tmp_path):
         def ledger_records(path, peak):
             with obs.RunLedger(path, run_id="r") as ledger:
