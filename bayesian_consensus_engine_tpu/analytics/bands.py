@@ -67,8 +67,19 @@ class AnalyticsOptions:
     propagation sweep entirely (no neighbour arguments enter the fused
     program); ``tiebreak=False`` likewise drops the ring tie-break
     stage from the compiled program — a service that only wants bands
-    pays for neither the ring pass nor its temps. ``z`` scales the
-    credible interval (default two-sided 95%).
+    pays for neither the ring pass nor its temps — and
+    ``tiebreak="sorted"`` swaps the ring fold for the sort-based
+    grouping kernel (:func:`~.ops.tiebreak.batched_tiebreak` — the
+    CPU-heavy-deployment shape; needs the sources axis unsharded).
+    ``z`` scales the credible interval (default two-sided 95%).
+
+    ``kernel`` picks the fused program's execution route (round 14):
+    ``"xla"`` (default) is the multi-pass XLA program, ``"pallas"`` the
+    one-pass settlement kernel (``ops/pallas_settle.py`` — cycles +
+    tie-break + bands in a single HBM sweep per tile, bit-identical
+    outputs, sources axis unsharded), ``"auto"`` the honesty-guarded
+    shape tuner (knob ``settle_kernel``; the kernel ships per shape
+    only when it strictly beat XLA on the same clock).
     """
 
     z: float = Z_95
@@ -76,7 +87,8 @@ class AnalyticsOptions:
     chunk_agents: "int | str | None" = "default"
     graph: Optional[MarketGraph] = None
     precision: int = 6
-    tiebreak: bool = True
+    tiebreak: "bool | str" = True
+    kernel: str = "xla"
 
 
 def _tuned_chunk_slots(mesh: Mesh, z: float, shape: tuple) -> "int | None":

@@ -224,7 +224,10 @@ def _run_stats(args: argparse.Namespace) -> None:
     device allocator's high-water mark into ``extras.hbm_peak_bytes``
     (the ring-memory leg, on devices exposing allocator stats) render
     the ``peak_mem`` column (min across repeats), so a memory regression
-    shows up in the same table as a wall-time one; legs carrying
+    shows up in the same table as a wall-time one; legs carrying the
+    per-settle bytes-read capture (``extras.hbm_read_bytes`` — the
+    round-14 one-pass legs: args + temps of the AOT settle executable
+    that ran) render the ``hbm_read`` column the same way; legs carrying
     recovery accounting (``extras.recovery_s`` + ``extras.slo`` — the
     kill-soak leg) render the ``recovery`` column beside ``goodput``,
     the failure story in one row. ``--json`` emits the machine-shaped

@@ -16,9 +16,13 @@ they are decidable statically:
   resolves to a literal int (directly or through a module-level
   constant), the summed f32 block footprint — double-buffered, the
   pipelined launch's working set — must stay under the 16 MB scoped-VMEM
-  budget. Symbolic shapes are skipped: the runtime guard and the
-  autotuner's measured ineligibility (a candidate tile whose compile
-  raises) own the dynamic case.
+  budget. Outputs aliased onto inputs via a LITERAL
+  ``input_output_aliases={in: out}`` dict (the one-pass settlement
+  kernel's in-place state idiom, ``ops/pallas_settle.py``) share the
+  input's buffer and are counted ONCE. Symbolic shapes — and computed
+  alias maps — are skipped: the runtime guard and the autotuner's
+  measured ineligibility (a candidate tile whose compile raises) own
+  the dynamic case.
 
 Local names are resolved through simple same-function assignments
 (``grid = (m // tile,)``; ``block = pl.BlockSpec(...)``), matching the
@@ -121,23 +125,63 @@ def _resolve_dim(entry: ast.AST, module_consts: dict):
     return None
 
 
+def _aliased_output_indices(call: ast.Call):
+    """Output indices aliased onto inputs, when statically decidable.
+
+    Reads a LITERAL ``input_output_aliases={in: out, ...}`` dict — the
+    dict's VALUES are output positions whose HBM buffers are the
+    aliased inputs' buffers, so the one-pass settlement idiom (state
+    tensors updated in place) is not double-billed by this rule. This
+    makes the lint the PERMISSIVE side of a deliberate asymmetry: the
+    pipelined launch may still hold separate VMEM windows for an
+    aliased pair, which is why the runtime tile resolver
+    (``ops.pallas_settle.resolve_tile_markets``) counts them separately
+    — the static rule flags only unambiguous overshoot, and the
+    conservative resolver plus the autotuner's measured ineligibility
+    own the margin between the two models. A computed alias map
+    (comprehension, Name) returns ``None`` — undecidable, counted
+    conservatively.
+    """
+    for kw in call.keywords:
+        if kw.arg != "input_output_aliases":
+            continue
+        value = kw.value
+        if not isinstance(value, ast.Dict):
+            return None
+        out: set[int] = set()
+        for v in value.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                out.add(v.value)
+            else:
+                return None
+        return out
+    return set()
+
+
 def _block_shapes(ctx, call: ast.Call, local, module_consts):
     """Every BlockSpec block-shape tuple reachable from *call*'s specs.
 
-    Yields ``(lineno, [dim-or-None, ...])`` per spec that carries a
-    positional block shape; memory-space-only specs (scalars) are skipped.
+    Yields ``(lineno, [dim-or-None, ...], out_index)`` per spec that
+    carries a positional block shape — ``out_index`` is the spec's
+    position within ``out_specs`` (``None`` for inputs), so the caller
+    can skip outputs aliased onto inputs; memory-space-only specs
+    (scalars) are skipped.
     """
-    specs: list[ast.AST] = []
+    specs: list[tuple[ast.AST, "int | None"]] = []
     for kw in call.keywords:
         if kw.arg in ("in_specs", "out_specs"):
+            is_out = kw.arg == "out_specs"
             value = kw.value
             if isinstance(value, ast.Name):
                 value = local.get(value.id, value)
             if isinstance(value, (ast.List, ast.Tuple)):
-                specs.extend(value.elts)
+                specs.extend(
+                    (elt, i if is_out else None)
+                    for i, elt in enumerate(value.elts)
+                )
             else:
-                specs.append(value)
-    for spec in specs:
+                specs.append((value, 0 if is_out else None))
+    for spec, out_index in specs:
         if isinstance(spec, ast.Name):
             spec = local.get(spec.id, spec)
         if not (
@@ -149,7 +193,7 @@ def _block_shapes(ctx, call: ast.Call, local, module_consts):
         dims = [
             _resolve_dim(d, module_consts) for d in spec.args[0].elts
         ]
-        yield spec.lineno, dims
+        yield spec.lineno, dims, out_index
 
 
 @rule(
@@ -199,14 +243,23 @@ def check_pallas_grid_shape(ctx):
                             "dropped; guard and raise (see "
                             "ops/pallas_cycle.py)"
                         )
+            aliased = _aliased_output_indices(node)
             total = 0
             decidable = True
-            for _lineno, dims in _block_shapes(
+            for _lineno, dims, out_index in _block_shapes(
                 ctx, node, local, module_consts
             ):
                 if any(d is None for d in dims):
                     decidable = False
                     break
+                if (
+                    out_index is not None
+                    and aliased is not None
+                    and out_index in aliased
+                ):
+                    # Aliased output: its HBM buffer IS the input's
+                    # (input_output_aliases) — count the pair once.
+                    continue
                 bytes_ = _F32_BYTES
                 for d in dims:
                     bytes_ *= d

@@ -199,6 +199,7 @@ def min_of_repeats(
     band.update(_slo_summary(records, leg))
     band.update(_ingest_wait_summary(records, leg))
     band.update(_peak_mem_summary(records, leg))
+    band.update(_hbm_read_summary(records, leg))
     band.update(_recovery_summary(records, leg))
     return band
 
@@ -262,6 +263,26 @@ def _peak_mem_summary(
     """
     return _min_extras_summary(
         records, leg, "hbm_peak_bytes", positive_only=True
+    )
+
+
+def _hbm_read_summary(
+    records: List[Dict[str, object]], leg: str
+) -> Dict[str, object]:
+    """Best-case per-settle HBM read bytes over a leg's records.
+
+    Records carrying ``extras["hbm_read_bytes"]`` (the round-14 one-pass
+    legs: argument + temp bytes of the AOT-compiled settle program that
+    actually ran — every argument byte is read at least once and every
+    temp byte written then read, so the sum is the program's
+    bytes-read-per-settle floor) fold to their MINIMUM across repeats.
+    This is the single-pass vs multi-pass sweep story in the same
+    ``bce-tpu stats``/``--against`` workflow as peak_mem: a kernel
+    regression that re-grows the read traffic shows up as the hbm_read
+    column shifting up.
+    """
+    return _min_extras_summary(
+        records, leg, "hbm_read_bytes", positive_only=True
     )
 
 
@@ -438,7 +459,7 @@ def diff_bands(
                                     "old": old_band, "new": new_band}
         metrics: Dict[str, Dict[str, object]] = {}
         for name in ("p50", "p99", "goodput_within_slo", "ingest_wait_s",
-                     "hbm_peak_bytes", "recovery_s"):
+                     "hbm_peak_bytes", "hbm_read_bytes", "recovery_s"):
             old_value = (old_band or {}).get(name)
             new_value = (new_band or {}).get(name)
             if old_value is not None or new_value is not None:
@@ -474,6 +495,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             "goodput_within_slo": "goodput",
             "ingest_wait_s": "ingest_wait",
             "hbm_peak_bytes": "peak_mem",
+            "hbm_read_bytes": "hbm_read",
             "recovery_s": "recovery",
         }.get(name, name)
         return f"  {label} {num(metric['old'])}->{num(metric['new'])}"
@@ -490,7 +512,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
         trailer = "".join(
             metric_str(entry, name)
             for name in ("p99", "goodput_within_slo", "ingest_wait_s",
-                         "hbm_peak_bytes", "recovery_s")
+                         "hbm_peak_bytes", "hbm_read_bytes", "recovery_s")
         )
         lines.append(
             f"{leg:<34} {band_str(entry['old']):>16} "
@@ -517,7 +539,9 @@ def render(records: List[Dict[str, object]]) -> str:
     legs; ≈ 0 means packing fully overlapped behind device compute), and
     ``peak_mem`` for legs carrying the device allocator's high-water mark
     (``extras.hbm_peak_bytes``, min across repeats — the memory-diet
-    regression signal); every other leg shows dashes.
+    regression signal), and ``hbm_read`` for legs carrying per-settle
+    bytes-read captures (``extras.hbm_read_bytes`` — the round-14
+    one-pass sweep signal); every other leg shows dashes.
     """
     summary = summarize(records)
     if not summary:
@@ -525,7 +549,7 @@ def render(records: List[Dict[str, object]]) -> str:
     lines = [
         f"{'leg':<34} {'n':>3} {'min':>12} {'max':>12} "
         f"{'spread':>7} {'p50':>9} {'p99':>9} {'goodput':>8} "
-        f"{'ingest_w':>9} {'peak_mem':>9} {'recovery':>9} "
+        f"{'ingest_w':>9} {'peak_mem':>9} {'hbm_read':>9} {'recovery':>9} "
         f"{'load(1m)':>12} unit"
     ]
     for leg, band in summary.items():
@@ -550,18 +574,21 @@ def render(records: List[Dict[str, object]]) -> str:
             if isinstance(goodput, (int, float))
             else "-"
         )
-        peak = band.get("hbm_peak_bytes")
-        peak_str = (
-            f"{peak / 1e6:.0f}MB"
-            if isinstance(peak, (int, float))
-            else "-"
-        )
+        def mb(value):
+            return (
+                f"{value / 1e6:.0f}MB"
+                if isinstance(value, (int, float))
+                else "-"
+            )
+
+        peak_str = mb(band.get("hbm_peak_bytes"))
+        read_str = mb(band.get("hbm_read_bytes"))
         lines.append(
             f"{leg:<34} {band['n']:>3} {num(band['min']):>12} "
             f"{num(band['max']):>12} {spread:>7} "
             f"{num(band.get('p50')):>9} {num(band.get('p99')):>9} "
             f"{goodput_str:>8} {num(band.get('ingest_wait_s')):>9} "
-            f"{peak_str:>9} {num(band.get('recovery_s')):>9} "
+            f"{peak_str:>9} {read_str:>9} {num(band.get('recovery_s')):>9} "
             f"{load:>12} {band['unit'] or '-'}"
         )
     return "\n".join(lines)

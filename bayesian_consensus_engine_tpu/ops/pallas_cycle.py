@@ -38,10 +38,22 @@ kernel losing to the XLA loop at 1M×16 — r02: 620 vs 887 cycles/sec;
 r03: 1,173 vs 7,226 (1600-step amortised) — and the 16k×10k regime is
 VMEM-infeasible for this design (a (10k, 128) f32 block is 5.1 MB and
 the kernel holds ~10 such blocks against a 16 MB budget). No production
-path dispatches it; ``bench.py --leg pallas_ab`` remains the standing
-re-adjudication (same-process XLA/Pallas bracket with the autotuned
-tile) — a future hardware run where Pallas wins reopens the decision
-with data, not argument.
+path dispatches it.
+
+Reopened (round 14, 2026-08-03): the "future op that XLA fusion handles
+badly" this scaffold was kept for now EXISTS — ``ops/pallas_settle.py``,
+the one-pass settlement kernel, reuses this module's slot-major
+(K, TILE_M) layout and ``input_output_aliases`` in-place discipline to
+compute consensus + tie-break + band moments in a single HBM sweep (a
+hand-fused multi-output reduction, not the elementwise-plus-short-sum
+shape XLA already fuses optimally). The standing re-adjudication is now
+TWO legs: ``bench.py --leg pallas_ab`` grew the three-way bracket (XLA
+fused / this retired cycle kernel / the one-pass kernel, one process),
+and ``bench.py --leg e2e_onepass`` is the apples-to-apples single-pass
+vs multi-pass A/B with the HBM-bytes-read capture. This plain-cycle
+kernel itself stays retired — the XLA loop still wins its shape — but
+the decision is live again per shape through the honesty-guarded
+``settle_kernel`` autotune knob (``kernel="auto"``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,326 @@
+"""One-pass settlement: consensus + tie-break + band moments in a single
+HBM sweep per tile (Pallas TPU kernel, round 14).
+
+The fused XLA program (``parallel.sharded.build_cycle_analytics_loop``)
+co-residences N cycles + the chunked ring tie-break + the uncertainty
+bands as ONE program per chip, but they remain 2–3 separate reduce
+passes over the same resident (slots × markets) state: read-decay for
+the analytics view, the tie-break's group fold, the band tree, and the
+cycle's own read each stream the block from HBM again. At the 1M-market
+regime the block is read-bandwidth-bound, and a hand-fused multi-output
+reduction is exactly the shape XLA's fusion handles badly (the
+documented reason the plain-cycle kernel in ``ops/pallas_cycle.py`` was
+retired: the *plain* cycle is a shape XLA fuses optimally — this one is
+not).
+
+This kernel grids over slot-major (K, TILE_M) market tiles (markets on
+the 128-wide lane dimension, the retired scaffold's measured layout) and
+computes, in ONE VMEM sweep per tile:
+
+  (a) the decay-on-read weighted consensus + capped N-step state update
+      of ``ops.cycle_math`` (``input_output_aliases`` keeps the state
+      in-place in HBM — read once, written once);
+  (b) the top-2 tie-break fold of ``ops.tiebreak.ring_tiebreak_math``
+      (the ``_merge_top2`` carry over fixed-width chunks, same total
+      order, called with ``axis_name=None``);
+  (c) the balanced-tree band moments of ``ops.uncertainty.band_math``
+      (Σw / Σw·p / Σw·p² / Σw² with the fixed adjacent-pair tree).
+
+**Bit parity is structural, not empirical**: the kernel body calls the
+SAME layer-1 functions the XLA fused program traces under ``shard_map``
+— ``read_phase``, ``ring_tiebreak_math``, ``band_math``, and the
+``make_loop_math`` N-step scaffold — so per (K, TILE_M) tile the jaxpr
+is the XLA program's jaxpr with the size-1 sources psums dropped
+(bit-identity) and the markets axis tiled (every reduction runs over
+the K axis only, so tiling cannot move a bit). The acceptance oracle is
+interpret mode on the tier-1 CPU backend: store bytes, tie-break, bands
+— at every ``chunk_agents``/``chunk_slots`` setting
+(tests/test_pallas_settle.py).
+
+Scope: the kernel serves meshes whose SOURCES axis is unsharded (the
+1M-market north-star regime — markets sharded, K source slots local);
+``parallel.sharded.build_cycle_analytics_loop(kernel="pallas")`` owns
+the routing and raises for sources-sharded meshes. XLA stays the
+production default; the kernel ships per-shape only when the
+honesty-guarded A/B says it wins (``ShapeTuner`` knob ``settle_kernel``,
+``kernel="auto"``). ``bench.py --leg e2e_onepass`` is the standing
+re-adjudication.
+
+Masks ride as float32 0/1 (uniform (8, 128) tiling, the retired
+scaffold's discipline) and are converted to bool at the kernel boundary;
+``resolved_by``/``num_groups``/``count`` come back as real int32 blocks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bayesian_consensus_engine_tpu.ops.cycle_math import (
+    MarketBlockState,
+    _cycle_math,
+    _fast_cycle_math,
+    make_loop_math,
+)
+from bayesian_consensus_engine_tpu.ops.tiebreak import (
+    RingTieBreakResult,
+    ring_tiebreak_math,
+)
+from bayesian_consensus_engine_tpu.ops.uncertainty import (
+    Z_95,
+    band_epilogue,
+    band_sums,
+)
+
+#: VMEM-side working set per (K, TILE_M) f32 block held by the launch:
+#: 7 input blocks + 4 output state blocks, double-buffered by the
+#: pipelined grid. Aliased state outputs share their input's HBM buffer
+#: but are counted as SEPARATE VMEM windows here — this resolver is
+#: deliberately the conservative side of the budget asymmetry (PL501's
+#: static check counts an aliased pair once and flags only unambiguous
+#: overshoot; a tile this model admits should never fail the Mosaic
+#: scoped-VMEM check, and the autotuner records any residual failure as
+#: ineligible).
+_BLOCKS_PER_TILE = 11
+_VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+_TILE_CANDIDATES = (2048, 1024, 512, 256, 128)
+
+
+def resolve_tile_markets(num_markets: int, num_slots: int) -> int:
+    """The largest standard tile dividing *num_markets* that keeps the
+    double-buffered block set inside the 16 MB scoped-VMEM budget.
+
+    Falls back to ``num_markets`` itself (one tile) when no standard
+    tile divides it — the ragged case never reaches the kernel grid
+    (the divisibility guard in :func:`build_onepass_settle` is the PL501
+    contract), and a one-tile launch over the VMEM budget fails at TPU
+    compile time, which the autotuned A/B records as "ineligible" rather
+    than shipping.
+    """
+    for tile in _TILE_CANDIDATES:
+        if num_markets % tile:
+            continue
+        bytes_ = num_slots * tile * 4 * _BLOCKS_PER_TILE * 2
+        if bytes_ <= _VMEM_BUDGET_BYTES:
+            return tile
+    return num_markets
+
+
+def _onepass_kernel(
+    now_ref,        # SMEM (1, 1)
+    probs_ref,      # VMEM (K, TM) f32
+    mask_ref,       # VMEM (K, TM) f32 0/1
+    outcome_ref,    # VMEM (1, TM) f32 0/1
+    rel_ref,        # VMEM (K, TM) f32
+    conf_ref,       # VMEM (K, TM) f32
+    upd_ref,        # VMEM (K, TM) f32
+    *refs,          # [ex_ref] + output refs (see build_onepass_settle)
+    steps: int,
+    has_exists: bool,
+    precision: int,
+    chunk_agents,
+    chunk_slots,
+):
+    if has_exists:
+        ex_ref, refs = refs[0], refs[1:]
+        exists = ex_ref[...] > 0.0
+        state_out_refs, refs = refs[:4], refs[4:]
+    else:
+        exists = None
+        state_out_refs, refs = refs[:3], refs[3:]
+    (consensus_ref,
+     tb_pred_ref, tb_wd_ref, tb_mr_ref, tb_rb_ref, tb_ng_ref, tb_cv_ref,
+     b_sw_ref, b_swp_ref, b_swp2_ref, b_sw2_ref, b_count_ref) = refs
+
+    now = now_ref[0, 0]
+    probs = probs_ref[...]
+    mask = mask_ref[...] > 0.0
+    outcome = outcome_ref[...][0] > 0.0          # (TM,)
+    state = MarketBlockState(
+        reliability=rel_ref[...],
+        confidence=conf_ref[...],
+        updated_days=upd_ref[...],
+        exists=exists,
+    )
+
+    # -- the ONE decayed read both analytics stages share (the XLA fused
+    # program's exact structure: read_phase happens inside the stages'
+    # shared preamble, the cycle re-reads through its own sanitised view).
+    from bayesian_consensus_engine_tpu.ops.cycle_math import read_phase
+
+    read_rel, read_conf = read_phase(state, now)
+
+    with jax.named_scope("bce.ring_tiebreak"):
+        tb = ring_tiebreak_math(
+            probs, read_rel, read_conf, read_rel, mask,
+            axis_name=None,
+            axis_size=1,
+            precision=precision,
+            chunk_agents=chunk_agents,
+            agents_last=False,       # slot-major: agents on axis 0
+        )
+    with jax.named_scope("bce.uncertainty_bands"):
+        # The RAW moments only: the division/z epilogue runs OUTSIDE the
+        # kernel (band_epilogue in the wrapper) because its optimization
+        # barriers — the cross-program rounding pins — are stripped
+        # inside Pallas kernel bodies (ops/uncertainty.py).
+        sums, count = band_sums(
+            probs, mask, read_rel,
+            axis_name=None,
+            axis_size=1,
+            chunk_slots=chunk_slots,
+            agents_last=False,
+        )
+    loop_math = make_loop_math(
+        partial(_cycle_math, axis_name=None, slots_axis=0),
+        steps,
+        fast_cycle_fn=partial(_fast_cycle_math, axis_name=None, slots_axis=0),
+    )
+    new_state, consensus = loop_math(probs, mask, outcome, state, now)
+
+    f32 = jnp.float32
+    state_out_refs[0][...] = new_state.reliability
+    state_out_refs[1][...] = new_state.confidence
+    state_out_refs[2][...] = new_state.updated_days
+    if has_exists:
+        state_out_refs[3][...] = new_state.exists.astype(f32)
+    consensus_ref[...] = consensus[None, :]
+    tb_pred_ref[...] = tb.prediction[None, :]
+    tb_wd_ref[...] = tb.weight_density[None, :]
+    tb_mr_ref[...] = tb.max_reliability[None, :]
+    tb_rb_ref[...] = tb.resolved_by[None, :]
+    tb_ng_ref[...] = tb.num_groups[None, :]
+    tb_cv_ref[...] = tb.confidence_variance[None, :]
+    b_sw_ref[...] = sums[0][None, :]
+    b_swp_ref[...] = sums[1][None, :]
+    b_swp2_ref[...] = sums[2][None, :]
+    b_sw2_ref[...] = sums[3][None, :]
+    b_count_ref[...] = count[None, :]
+
+
+def build_onepass_settle(
+    num_markets: int,
+    num_slots: int,
+    steps: int,
+    *,
+    has_exists: bool = True,
+    tile_markets: "int | None" = None,
+    precision: int = 6,
+    chunk_agents: "int | None" = None,
+    chunk_slots: "int | None" = None,
+    z: float = Z_95,
+    interpret: bool = False,
+):
+    """The one-pass settlement launch for fixed (K=num_slots, M=num_markets).
+
+    Returns ``onepass(probs, mask, outcome, state, now) ->
+    (MarketBlockState, consensus, RingTieBreakResult, UncertaintyBands)``
+    over slot-major float32 (K, M) blocks: ``mask``/``outcome`` are bool,
+    ``state`` a float32 :class:`~.ops.cycle_math.MarketBlockState` (bool
+    ``exists``, or ``None`` with ``has_exists=False``); per-market
+    outputs are (M,). All three result families read the PRE-update
+    decayed state at ``now``; the returned state is the N-step loop's —
+    bit-identical to the XLA fused program's at every chunk setting.
+
+    The callable is meant to be traced inside a surrounding jit /
+    ``shard_map`` body (``parallel.sharded`` builds it at trace time per
+    local shard shape); it is not jitted here. ``num_markets`` must be a
+    multiple of the resolved ``tile_markets`` (``None`` →
+    :func:`resolve_tile_markets`).
+    """
+    tile = (
+        resolve_tile_markets(num_markets, num_slots)
+        if tile_markets is None
+        else int(tile_markets)
+    )
+    if num_markets % tile:
+        raise ValueError(
+            f"num_markets={num_markets} not a multiple of "
+            f"tile_markets={tile} — pad the markets axis (pad_markets) "
+            "before the kernel; a ragged tail tile would be dropped"
+        )
+    grid = (num_markets // tile,)
+
+    block = pl.BlockSpec(
+        (num_slots, tile), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    row = pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    scalar = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    f32 = jnp.float32
+    km = jax.ShapeDtypeStruct((num_slots, num_markets), f32)
+    m1 = jax.ShapeDtypeStruct((1, num_markets), f32)
+    m1_i32 = jax.ShapeDtypeStruct((1, num_markets), jnp.int32)
+
+    n_state = 4 if has_exists else 3
+    in_specs = [scalar, block, block, row] + [block] * n_state
+    out_specs = [block] * n_state + [row] * 12
+    out_shape = (
+        [km] * n_state
+        + [m1]                          # consensus
+        + [m1, m1, m1, m1_i32, m1_i32, m1]   # tie-break
+        + [m1, m1, m1, m1, m1_i32]           # band moments + count
+    )
+    # State tensors update in place: state inputs alias the state outputs
+    # (input 4+j -> output j), so the resident block is read from HBM
+    # once and written once — the single-sweep contract.
+    aliases = {4 + j: j for j in range(n_state)}
+
+    call = pl.pallas_call(
+        partial(
+            _onepass_kernel,
+            steps=steps,
+            has_exists=has_exists,
+            precision=precision,
+            chunk_agents=chunk_agents,
+            chunk_slots=chunk_slots,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )
+
+    def onepass(probs, mask, outcome, state: MarketBlockState, now):
+        if state.reliability.dtype != f32:
+            raise ValueError(
+                "the one-pass kernel serves float32 state blocks only "
+                f"(got {state.reliability.dtype}); keep kernel='xla' for "
+                "other compute dtypes"
+            )
+        now_arr = jnp.reshape(jnp.asarray(now, f32), (1, 1))
+        args = [
+            now_arr,
+            probs.astype(f32),
+            mask.astype(f32),
+            outcome.astype(f32)[None, :],
+            state.reliability,
+            state.confidence,
+            state.updated_days,
+        ]
+        if has_exists:
+            args.append(state.exists.astype(f32))
+        out = call(*args)
+        state_out, rest = out[:n_state], out[n_state:]
+        new_state = MarketBlockState(
+            reliability=state_out[0],
+            confidence=state_out[1],
+            updated_days=state_out[2],
+            exists=state_out[3] > 0.0 if has_exists else None,
+        )
+        consensus = rest[0][0]
+        tb = RingTieBreakResult(*(x[0] for x in rest[1:7]))
+        # Moments → intervals in plain XLA: the epilogue's optimization
+        # barriers survive here, so lo/hi round exactly as the fused XLA
+        # program's band_math does.
+        sums = jnp.stack([x[0] for x in rest[7:11]])
+        bands = band_epilogue(sums, rest[11][0], z)
+        return new_state, consensus, tb, bands
+
+    return onepass

@@ -334,7 +334,7 @@ def ring_tiebreak_math(
     rel: Array,
     valid: Array,
     *,
-    axis_name: str,
+    axis_name: "str | None",
     axis_size: int,
     precision: int = 6,
     chunk_agents: "int | None" = None,
@@ -368,6 +368,11 @@ def ring_tiebreak_math(
     see bit-identical f32 group sums (the exact-equality tie compares).
     """
     f32 = jnp.float32
+    if axis_name is None and axis_size > 1:
+        raise ValueError(
+            "axis_size > 1 needs axis_name: the ring rotation and the "
+            "cross-device folds are collectives over a named axis"
+        )
     pred = pred.astype(f32)
     weight = weight.astype(f32)
     conf = conf.astype(f32)
@@ -537,8 +542,12 @@ def ring_tiebreak_math(
         any_member, k1.astype(f32) / f32(scale), f32(jnp.inf)
     )
 
+    # axis_name=None (the one-pass Pallas kernel's in-kernel call — no
+    # named axis exists inside a kernel body) skips the psums entirely;
+    # a size-1 psum is the identity bit-wise, so existing axis_size==1
+    # callers that do pass axis_name are unchanged.
     num_groups = jnp.round(
-        jax.lax.psum(sum_inv, axis_name)
+        sum_inv if axis_name is None else jax.lax.psum(sum_inv, axis_name)
     ).astype(jnp.int32)
 
     # Population confidence variance over valid agents
@@ -546,13 +555,13 @@ def ring_tiebreak_math(
     # OUTSIDE the chunk loop: the expression (and so its float summation
     # order) must not change with the chunk knob.
     agg_axis = -1 if agents_last else 0
-    n = jax.lax.psum(jnp.sum(valid, axis=agg_axis), axis_name)
-    s1 = jax.lax.psum(
-        jnp.sum(jnp.where(valid, conf, 0.0), axis=agg_axis), axis_name
-    )
-    s2 = jax.lax.psum(
-        jnp.sum(jnp.where(valid, conf * conf, 0.0), axis=agg_axis), axis_name
-    )
+    n = jnp.sum(valid, axis=agg_axis)
+    s1 = jnp.sum(jnp.where(valid, conf, 0.0), axis=agg_axis)
+    s2 = jnp.sum(jnp.where(valid, conf * conf, 0.0), axis=agg_axis)
+    if axis_name is not None:
+        n = jax.lax.psum(n, axis_name)
+        s1 = jax.lax.psum(s1, axis_name)
+        s2 = jax.lax.psum(s2, axis_name)
     nf = jnp.maximum(n, 1).astype(f32)
     variance = jnp.maximum(s2 / nf - (s1 / nf) ** 2, 0.0)
 

@@ -148,31 +148,30 @@ def _pad_pow2(x: Array, axis: int, fill) -> Array:
     return jnp.pad(x, widths, constant_values=fill)
 
 
-def band_math(
+def band_sums(
     probs: Array,
     mask: Array,
     read_rel: Array,
     *,
     axis_name: "str | None",
     axis_size: int,
-    z: float = Z_95,
     chunk_slots: "int | None" = None,
     agents_last: bool = True,
-) -> UncertaintyBands:
-    """Credible intervals for one device shard (shard_map body).
+) -> tuple:
+    """The four tree-accumulated band moments of one device shard.
 
-    Blocks are ``(M, K)`` with ``agents_last=True`` or slot-major
-    ``(K, M)`` with ``agents_last=False`` (the fused resident program's
-    layout, where the slots axis is sharded over *axis_name* across
-    *axis_size* devices). ``read_rel`` must be the decayed READ
-    reliability — the same per-slot weight the consensus reduction uses
-    at the same ``now`` (``parallel.sharded.read_phase``).
-
-    ``chunk_slots`` bounds the local working set: the shard's slots are
-    consumed in power-of-two-width chunks, each chunk's four weighted
-    sums tree-reduced and parked in a per-market roots buffer that the
-    same tree folds at the end — outputs bit-identical at every setting
-    (see module docstring). ``None`` is one full-width chunk.
+    Returns ``(sums, count)`` where ``sums`` is the (4, M) stack
+    Σw / Σw·p / Σw·p² / Σw² reduced with the fixed balanced tree (the
+    whole accumulation-order contract lives here) and ``count`` the i32
+    per-market signalling-slot count. Split out of :func:`band_math` in
+    round 14 so the one-pass Pallas settlement kernel can emit the RAW
+    moments from inside its VMEM sweep and leave the epilogue
+    (:func:`band_epilogue` — divisions, the variance square, the z
+    scaling) to plain XLA outside the kernel, where the epilogue's
+    optimization barriers are preserved and every program rounds lo/hi
+    identically (Pallas interpret mode strips ``optimization_barrier``
+    from kernel bodies, so an in-kernel epilogue could FMA-contract
+    differently from the fused XLA program's).
     """
     f32 = jnp.float32
     slots_axis = (probs.ndim - 1) if agents_last else 0
@@ -222,7 +221,18 @@ def band_math(
         gathered = _pad_pow2(gathered, 0, 0.0)
         sums = _tree_sum(gathered, 0)
         count = jax.lax.psum(count, axis_name)
+    return sums, count
 
+
+def band_epilogue(sums: Array, count: Array, z: float = Z_95) -> UncertaintyBands:
+    """Moments → credible intervals (the division/normalisation half).
+
+    Pure elementwise (M,)-vector work on :func:`band_sums` outputs; runs
+    in plain XLA in EVERY path (the fused XLA program and the one-pass
+    kernel's wrapper alike) so its optimization barriers pin the
+    roundings — see :func:`band_sums`.
+    """
+    f32 = jnp.float32
     sw, swp, swp2, sw2 = sums[0], sums[1], sums[2], sums[3]
     has_weight = sw != 0
     safe_w = jnp.where(has_weight, sw, f32(1.0))
@@ -231,7 +241,19 @@ def band_math(
     # against cancellation (the same form as the tie-break's confidence
     # variance, reference: tiebreak.py:107-110).
     ex2 = jnp.where(has_weight, swp2 / safe_w, f32(0.0))
-    centered = ex2 - jnp.where(has_weight, mean, f32(0.0)) ** 2
+    # Optimization barriers pin the two mul→add/sub sites an XLA backend
+    # may otherwise FMA-contract DIFFERENTLY in different surrounding
+    # programs (the fused XLA program's shard_map body vs the one-pass
+    # kernel wrapper's straight-line epilogue) — μ·μ feeding the
+    # variance subtraction and z·stderr feeding the lo/hi add/sub. The
+    # epilogue deliberately runs in plain XLA in every path (barriers
+    # are stripped inside Pallas kernel bodies — see band_sums), so the
+    # pins hold and lo/hi agree bit-for-bit across programs
+    # (tests/test_pallas_settle.py). Cost: fusion breaks on (M,) vectors.
+    mean_sq = jax.lax.optimization_barrier(
+        jnp.where(has_weight, mean, f32(0.0)) ** 2
+    )
+    centered = ex2 - mean_sq
     variance = jnp.maximum(centered, f32(0.0))
     n_eff = jnp.where(sw2 > 0, (sw * sw) / jnp.where(sw2 > 0, sw2, f32(1.0)),
                       f32(0.0))
@@ -240,9 +262,48 @@ def band_math(
         jnp.sqrt(variance / jnp.maximum(n_eff, f32(1e-30))),
         f32(0.0),
     )
-    zf = f32(z)
-    lo = jnp.clip(mean - zf * stderr, f32(0.0), f32(1.0))
-    hi = jnp.clip(mean + zf * stderr, f32(0.0), f32(1.0))
+    stderr = jax.lax.optimization_barrier(stderr)
+    half = jax.lax.optimization_barrier(f32(z) * stderr)
+    lo = jnp.clip(mean - half, f32(0.0), f32(1.0))
+    hi = jnp.clip(mean + half, f32(0.0), f32(1.0))
     return UncertaintyBands(
         mean=mean, lo=lo, hi=hi, stderr=stderr, n_eff=n_eff, count=count
     )
+
+
+def band_math(
+    probs: Array,
+    mask: Array,
+    read_rel: Array,
+    *,
+    axis_name: "str | None",
+    axis_size: int,
+    z: float = Z_95,
+    chunk_slots: "int | None" = None,
+    agents_last: bool = True,
+) -> UncertaintyBands:
+    """Credible intervals for one device shard (shard_map body).
+
+    Blocks are ``(M, K)`` with ``agents_last=True`` or slot-major
+    ``(K, M)`` with ``agents_last=False`` (the fused resident program's
+    layout, where the slots axis is sharded over *axis_name* across
+    *axis_size* devices). ``read_rel`` must be the decayed READ
+    reliability — the same per-slot weight the consensus reduction uses
+    at the same ``now`` (``parallel.sharded.read_phase``).
+
+    ``chunk_slots`` bounds the local working set: the shard's slots are
+    consumed in power-of-two-width chunks, each chunk's four weighted
+    sums tree-reduced and parked in a per-market roots buffer that the
+    same tree folds at the end — outputs bit-identical at every setting
+    (see module docstring). ``None`` is one full-width chunk.
+    :func:`band_sums` + :func:`band_epilogue` composed; the one-pass
+    kernel calls the halves separately (sums in-kernel, epilogue out).
+    """
+    sums, count = band_sums(
+        probs, mask, read_rel,
+        axis_name=axis_name,
+        axis_size=axis_size,
+        chunk_slots=chunk_slots,
+        agents_last=agents_last,
+    )
+    return band_epilogue(sums, count, z)

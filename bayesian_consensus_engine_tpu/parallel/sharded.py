@@ -12,9 +12,18 @@ reference: market.py:200-221 / reliability.py:185-231):
 
 State is an (M, K)-blocked :class:`MarketBlockState` pytree resident in HBM;
 ``donate=True`` lets XLA update it in place. Under ``shard_map`` the blocks
-shard over a ``(markets, sources)`` mesh; the only communication is one
+shard over a ``(markets, sources)`` mesh and the only communication is one
 ``psum`` over the sources axis for the three weight sums — everything else is
 embarrassingly parallel over ICI-free elementwise work.
+
+Since round 14 the cycle math itself (``MarketBlockState``, the
+read/reduce/update phases, the N-step loop scaffold) lives in
+``ops/cycle_math.py`` — layer 1, so the one-pass Pallas settlement kernel
+(``ops/pallas_settle.py``) can trace the SAME functions inside its kernel
+body. This module re-exports every moved name and keeps the mesh-level
+builders: ``shard_map`` wiring, donation, the fused co-resident programs,
+and the ``kernel=`` routing between the XLA multi-pass program and the
+Pallas one-pass kernel.
 
 Cold-start semantics: slots that signal but have no stored state weigh in at
 the cold-start defaults (reference: core.py:110-112) and get their first
@@ -24,235 +33,49 @@ stored values from the update, matching scalar behaviour.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from bayesian_consensus_engine_tpu.parallel._jax_compat import shard_map, pcast_varying
 
-from bayesian_consensus_engine_tpu.ops.decay import decayed_reliability_at
-from bayesian_consensus_engine_tpu.ops.update import outcome_update
+from bayesian_consensus_engine_tpu.ops.cycle_math import (
+    CycleResult,
+    MarketBlockState,
+    _cycle_math,
+    _fast_cycle_math,
+    consensus_epilogue,
+    consensus_reduce,
+    make_loop_math,
+    read_phase,
+    run_fast_loop,
+    update_phase,
+)
 from bayesian_consensus_engine_tpu.parallel.mesh import MARKETS_AXIS, SOURCES_AXIS
 from bayesian_consensus_engine_tpu.utils.config import (
     DEFAULT_CONFIDENCE,
     DEFAULT_RELIABILITY,
 )
 
-
-class MarketBlockState(NamedTuple):
-    """HBM-resident per-(market, source-slot) reliability state, (M, K).
-
-    ``exists`` may be ``None`` inside the cycle loop's carried state: the
-    mask is monotone (``exists | mask`` every step), so the loop tracks it
-    outside the carry and saves one full HBM tensor of read+write traffic
-    per cycle. A ``None``-exists state promises that cold slots already hold
-    the cold-start defaults (which :func:`init_block_state` guarantees and
-    the loop enforces with a one-time sanitise).
-    """
-
-    reliability: jax.Array   # f[M, K] stored (undecayed) reliability
-    confidence: jax.Array    # f[M, K]
-    updated_days: jax.Array  # f[M, K] relative epoch-days of last update (0 ⇒ never)
-    exists: jax.Array | None  # bool[M, K] row-exists mask
-
-
-class CycleResult(NamedTuple):
-    state: MarketBlockState
-    consensus: jax.Array      # f[M] (NaN where total weight is 0)
-    confidence: jax.Array     # f[M]
-    total_weight: jax.Array   # f[M]
-
-
-def read_phase(
-    state: MarketBlockState, now_days: jax.Array
-) -> tuple[jax.Array, jax.Array]:
-    """Decay-on-read with cold-start defaults; returns (read_rel, read_conf).
-
-    Decay is a pure read transform; cold slots read the cold-start prior
-    (reference: core.py:110-112). With ``exists=None`` cold slots hold the
-    defaults by contract (see MarketBlockState), so gating decay on "ever
-    updated" alone reproduces the masked reads.
-    """
-    if state.exists is None:
-        read_rel = decayed_reliability_at(
-            state.reliability, state.updated_days, now_days, jnp.asarray(True)
-        )
-        read_conf = state.confidence
-    else:
-        stored = decayed_reliability_at(
-            state.reliability, state.updated_days, now_days, state.exists
-        )
-        read_rel = jnp.where(state.exists, stored, DEFAULT_RELIABILITY)
-        read_conf = jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE)
-    return read_rel, read_conf
-
-
-def consensus_reduce(
-    probs: jax.Array,
-    mask: jax.Array,
-    read_rel: jax.Array,
-    read_conf: jax.Array,
-    axis_name: str | None,
-    slots_axis: int,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Masked weighted sums over the (possibly sharded) sources axis.
-
-    THE consensus reduction — shared by the slow, fast, and compact cycle
-    paths so the reduction semantics (masking, psum axis, epilogue) exist
-    exactly once. Returns (consensus, confidence_out, total_weight).
-    """
-    w = jnp.where(mask, read_rel, 0.0)
-    total_weight = jnp.sum(w, axis=slots_axis)
-    weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=slots_axis)
-    weighted_conf = jnp.sum(jnp.where(mask, read_conf, 0.0) * w, axis=slots_axis)
-    if axis_name is not None:
-        total_weight = jax.lax.psum(total_weight, axis_name)
-        weighted_prob = jax.lax.psum(weighted_prob, axis_name)
-        weighted_conf = jax.lax.psum(weighted_conf, axis_name)
-    consensus, confidence_out = consensus_epilogue(
-        total_weight, weighted_prob, weighted_conf
-    )
-    return consensus, confidence_out, total_weight
-
-
-def consensus_epilogue(
-    total_weight: jax.Array,
-    weighted_prob: jax.Array,
-    weighted_conf: jax.Array,
-) -> tuple[jax.Array, jax.Array]:
-    """Normalise the weighted sums; NaN consensus when total weight is 0.
-
-    Scalar parity: the reference tests ``total_weight == 0`` exactly
-    (core.py:131) and reports consensus ``None`` — NaN device-side.
-    """
-    has_weight = total_weight != 0
-    safe_total = jnp.where(has_weight, total_weight, 1.0)
-    consensus = jnp.where(has_weight, weighted_prob / safe_total, jnp.nan)
-    confidence_out = jnp.where(has_weight, weighted_conf / safe_total, 0.0)
-    return consensus, confidence_out
-
-
-def update_phase(
-    probs: jax.Array,
-    mask: jax.Array,
-    outcome: jax.Array,
-    state: MarketBlockState,
-    read_conf: jax.Array,
-    now_days: jax.Array,
-    slots_axis: int = -1,
-) -> MarketBlockState:
-    """Outcome correctness + capped update on the UNDECAYED stored state.
-
-    Correctness is predicted-true iff p >= 0.5 (reference: market.py:296-303)
-    judged against the market outcome. A cold slot's update base is the
-    cold-start prior (the reference's compute_update reads the defaulted
-    record for missing rows, reference: reliability.py:161), not whatever
-    the raw buffer holds; untouched slots pass through bit-identical (the
-    reference never writes rows it wasn't asked to settle).
-    """
-    correct = (probs >= 0.5) == jnp.expand_dims(outcome, slots_axis)
-    if state.exists is None:
-        update_base = state.reliability
-    else:
-        update_base = jnp.where(state.exists, state.reliability, DEFAULT_RELIABILITY)
-    updated_rel, updated_conf = outcome_update(update_base, read_conf, correct)
-    return MarketBlockState(
-        reliability=jnp.where(mask, updated_rel, state.reliability),
-        confidence=jnp.where(mask, updated_conf, state.confidence),
-        updated_days=jnp.where(mask, now_days, state.updated_days),
-        exists=None if state.exists is None else state.exists | mask,
-    )
-
-
-def _cycle_math(
-    probs: jax.Array,        # f[M, K] per-slot mean probability ((K, M) if slots_axis=0)
-    mask: jax.Array,         # bool[M, K] slot has a signal
-    outcome: jax.Array,      # bool[M] resolved market outcome
-    state: MarketBlockState,
-    now_days: jax.Array,     # scalar, relative epoch-days
-    axis_name: str | None,
-    slots_axis: int = -1,
-) -> CycleResult:
-    """The full cycle on one shard; psum over *axis_name* if sharded.
-
-    ``slots_axis=0`` selects the slot-major (K, M) layout: markets ride the
-    128-wide lane dimension, which measures ~25% faster on TPU than (M, K)
-    with small K (the reduction becomes a K-deep sublane sum).
-    """
-    # named_scope: phase labels land in the HLO → profiler attribution
-    # (utils/profiling.trace / auto_trace show per-phase time, not one
-    # opaque fused blob). Zero runtime cost — names only.
-    with jax.named_scope("bce.read_decay"):
-        read_rel, read_conf = read_phase(state, now_days)
-
-    with jax.named_scope("bce.consensus_reduce"):
-        consensus, confidence_out, total_weight = consensus_reduce(
-            probs, mask, read_rel, read_conf, axis_name, slots_axis
-        )
-    with jax.named_scope("bce.outcome_update"):
-        new_state = update_phase(
-            probs, mask, outcome, state, read_conf, now_days, slots_axis
-        )
-    return CycleResult(new_state, consensus, confidence_out, total_weight)
-
-
-def _fast_cycle_math(
-    probs: jax.Array,
-    mask: jax.Array,
-    outcome: jax.Array,
-    reliability: jax.Array,
-    confidence: jax.Array,
-    now_days: jax.Array,     # scalar: this step's day
-    prev_now: jax.Array,     # scalar: the previous step's day
-    axis_name: str | None,
-    slots_axis: int = -1,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One mid-loop cycle with the decay read driven by SCALAR time.
-
-    Valid only inside the N-step loop after step 0: every masked slot was
-    stamped ``prev_now`` by the previous step, so its elapsed time and
-    decay eligibility are the same scalars for the whole block — the
-    per-slot ``updated_days`` tensor (a full HBM read+write per cycle,
-    ~8 of the flat loop's ~29 bytes/slot/step at 1M×16) drops out of the
-    loop carry entirely and is reconstructed once on exit. Unmasked slots
-    see a wrong scalar elapsed, but their weights are zeroed before every
-    reduction and their state passes through untouched, exactly as in
-    :func:`_cycle_math`.
-
-    Bit-compatibility with chained single cycles: elapsed and eligibility
-    are computed with the same f32 arithmetic on the same values the
-    chained path reads back from the stamped tensor
-    (``(now0+i) − (now0+i−1)``, gate ``prev_now > 0``), and the decay/
-    update elementwise ops are shared (ops/decay.py, ops/update.py), so
-    results are equal bit-for-bit (asserted by tests/test_sharding.py).
-
-    Returns ``(reliability', confidence', consensus)``.
-    """
-    with jax.named_scope("bce.read_decay"):
-        # Broadcast the scalar stamp through the SAME ops the per-slot path
-        # runs (decayed_reliability_at on a full-shape tensor): XLA then
-        # makes identical fusion/FMA-contraction choices and the read is
-        # bit-identical to the slow path — a scalar-elapsed shortcut
-        # compiles to different roundings (caught by the checkpoint-resume
-        # bit-identity tests). The broadcast costs no HBM traffic.
-        stamps = jnp.broadcast_to(prev_now, reliability.shape)
-        read_rel = decayed_reliability_at(
-            reliability, stamps, now_days, jnp.asarray(True)
-        )
-
-    with jax.named_scope("bce.consensus_reduce"):
-        consensus, _, _ = consensus_reduce(
-            probs, mask, read_rel, confidence, axis_name, slots_axis
-        )
-
-    with jax.named_scope("bce.outcome_update"):
-        correct = (probs >= 0.5) == jnp.expand_dims(outcome, slots_axis)
-        new_rel, new_conf = outcome_update(reliability, confidence, correct)
-        reliability = jnp.where(mask, new_rel, reliability)
-        confidence = jnp.where(mask, new_conf, confidence)
-    return reliability, confidence, consensus
-
+__all__ = [
+    # re-exports from ops/cycle_math.py (the pre-round-14 home)
+    "CycleResult",
+    "MarketBlockState",
+    "consensus_epilogue",
+    "consensus_reduce",
+    "make_loop_math",
+    "read_phase",
+    "run_fast_loop",
+    "update_phase",
+    # mesh-level builders
+    "build_cycle",
+    "build_cycle_loop",
+    "build_cycle_tiebreak_loop",
+    "build_cycle_analytics_loop",
+    "relayout_slot_state",
+    "pad_markets",
+    "init_block_state",
+]
 
 def _specs(slot_major: bool):
     """(block, market, slots_axis) partition specs for the chosen layout."""
@@ -303,150 +126,6 @@ def build_cycle(
         return fn(probs, mask, outcome, state, now_days)
 
     return cycle
-
-
-def run_fast_loop(state_carry, consensus0, fast_step, steps: int, now0):
-    """The fast N-step scaffold: fori over middle steps, LAST step outside.
-
-    ``fast_step(state_carry, now_i, prev_now) -> (state_carry, consensus)``.
-    Shared by the f32 and compact loops so the two structural invariants
-    live exactly once:
-
-      * mid-loop consensus is unobservable and NOT carried — the fori body
-        discards it, so XLA dead-code-eliminates the whole consensus
-        reduction from the loop;
-      * the last step runs OUTSIDE the fori, keeping the final consensus
-        in straight-line code for every step count: a single-trip fori
-        gets inlined and re-fused by XLA, which contracts FMAs differently
-        and wobbles consensus one ulp between programs of different step
-        counts — breaking checkpoint-resume bit-identity
-        (tests/test_checkpoint.py).
-    """
-    if steps == 1:
-        return state_carry, consensus0
-
-    def body(i, carry):
-        new_carry, _ = fast_step(carry, now0 + i, now0 + (i - 1))
-        return new_carry
-
-    carry = jax.lax.fori_loop(1, steps - 1, body, state_carry)
-    return fast_step(carry, now0 + (steps - 1), now0 + (steps - 2))
-
-
-def make_loop_math(cycle_fn, steps: int, cast_consensus=None, fast_cycle_fn=None):
-    """The N-cycle loop scaffold shared by the flat and ring loops.
-
-    Returns ``loop_math(probs, mask, outcome, state, now0) ->
-    (state', consensus)`` running ``steps`` cycles of
-    ``cycle_fn(probs, mask, outcome, state, now_days) -> CycleResult``
-    with the state carried on device. ``cast_consensus`` (optional)
-    adjusts the initial consensus carry's type (e.g. ``pcast`` to varying
-    under shard_map with vma checking on).
-
-    The scaffold owns the ``exists``-carry optimisation: ``exists`` is
-    monotone under the fixed per-loop mask (``exists | mask`` every step),
-    so carrying it would re-read and re-write a full HBM tensor every cycle
-    for a value reconstructible at the end (measured ~64 MiB/cycle at
-    1M×16). Cold slots are sanitised to the cold-start defaults once on
-    entry, and slots that never existed and never signalled are restored
-    bit-identical on exit — exactly as a chain of single cycles leaves them.
-    An ``exists=None`` input already promises defaulted cold slots.
-
-    ``fast_cycle_fn`` (optional,
-    ``(probs, mask, outcome, rel, conf, now, prev_now) -> (rel', conf',
-    consensus)``) additionally drops ``updated_days`` from the carry: step 0
-    runs ``cycle_fn`` against the real per-slot stamps, every later step
-    decays by scalar time (see :func:`_fast_cycle_math`), and the stamp
-    tensor is reconstructed once on exit — bit-identical to the chained
-    result, one less HBM tensor of read+write per cycle.
-    """
-
-    def loop_math(probs, mask, outcome, state, now0):
-        if state.exists is None:
-            sanitised = state
-        else:
-            sanitised = MarketBlockState(
-                reliability=jnp.where(
-                    state.exists, state.reliability, DEFAULT_RELIABILITY
-                ),
-                confidence=jnp.where(
-                    state.exists, state.confidence, DEFAULT_CONFIDENCE
-                ),
-                updated_days=jnp.where(state.exists, state.updated_days, 0.0),
-                exists=None,
-            )
-
-        init_consensus = jnp.zeros(outcome.shape[0], probs.dtype)
-        if cast_consensus is not None:
-            init_consensus = cast_consensus(init_consensus)
-
-        if steps == 0:
-            return state, init_consensus
-
-        if fast_cycle_fn is not None:
-            first = cycle_fn(probs, mask, outcome, sanitised, now0 + 0)
-
-            def fast_step(carry, now_i, prev_now):
-                rel, conf, consensus = fast_cycle_fn(
-                    probs, mask, outcome, carry[0], carry[1], now_i, prev_now
-                )
-                return (rel, conf), consensus
-
-            (rel, conf), consensus = run_fast_loop(
-                (first.state.reliability, first.state.confidence),
-                first.consensus,
-                fast_step,
-                steps,
-                now0,
-            )
-            # Chained cycles stamp masked slots with now0+i every step; the
-            # final tensor is the last stamp, reconstructed in one pass.
-            upd = jnp.where(
-                mask,
-                jnp.asarray(now0 + (steps - 1), sanitised.updated_days.dtype),
-                sanitised.updated_days,
-            )
-        else:
-            def body(i, carry):
-                rel, conf, upd, _ = carry
-                result = cycle_fn(
-                    probs, mask, outcome,
-                    MarketBlockState(rel, conf, upd, None),
-                    now0 + i,
-                )
-                st = result.state
-                return (
-                    st.reliability,
-                    st.confidence,
-                    st.updated_days,
-                    result.consensus,
-                )
-
-            rel, conf, upd, consensus = jax.lax.fori_loop(
-                0,
-                steps,
-                body,
-                (
-                    sanitised.reliability,
-                    sanitised.confidence,
-                    sanitised.updated_days,
-                    init_consensus,
-                ),
-            )
-        if state.exists is None:
-            return MarketBlockState(rel, conf, upd, None), consensus
-        keep = state.exists | mask
-        return (
-            MarketBlockState(
-                reliability=jnp.where(keep, rel, state.reliability),
-                confidence=jnp.where(keep, conf, state.confidence),
-                updated_days=jnp.where(keep, upd, state.updated_days),
-                exists=keep,
-            ),
-            consensus,
-        )
-
-    return loop_math
 
 
 def build_cycle_loop(
@@ -567,6 +246,82 @@ def build_cycle_tiebreak_loop(
     return loop
 
 
+def _tuned_settle_kernel(
+    mesh: Mesh,
+    num_slots: int,
+    num_markets: int,
+    steps: int,
+    chunk_agents,
+    chunk_slots,
+    precision: int,
+    z: float,
+) -> str:
+    """Resolve ``kernel="auto"`` for one slot-major (K, M) shape.
+
+    Races the one-pass Pallas kernel against the recorded default
+    (``"xla"`` — the multi-pass fused program) on the same clock through
+    the process :class:`~.utils.autotune.ShapeTuner` (knob
+    ``settle_kernel``): the kernel ships for this shape ONLY when it
+    strictly beat the XLA program (the honesty guard), and a Pallas
+    candidate that fails to compile (VMEM-infeasible tile, unsupported
+    op on this backend) records as ineligible rather than shipping.
+    Disabled (the default, ``BCE_AUTOTUNE`` unset) it resolves straight
+    to ``"xla"``.
+    """
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.utils.autotune import (
+        default_tuner,
+        time_best_of,
+    )
+
+    def measure(kind: str) -> float:
+        import jax.numpy as jnp
+
+        loop = build_cycle_analytics_loop(
+            mesh, chunk_agents=chunk_agents, chunk_slots=chunk_slots,
+            donate=False, precision=precision, z=z, kernel=kind,
+        )
+        rng = np.random.default_rng(31)
+        k, m = num_slots, num_markets
+        probs = jnp.asarray(rng.random((k, m)), jnp.float32)
+        mask = jnp.asarray(rng.random((k, m)) < 0.9)
+        outcome = jnp.asarray(rng.random(m) < 0.5)
+        state = MarketBlockState(
+            reliability=jnp.asarray(
+                rng.uniform(0.1, 1.0, (k, m)), jnp.float32
+            ),
+            confidence=jnp.asarray(
+                rng.uniform(0.0, 1.0, (k, m)), jnp.float32
+            ),
+            updated_days=jnp.zeros((k, m), jnp.float32),
+            exists=jnp.asarray(rng.random((k, m)) < 0.7),
+        )
+        now = jnp.asarray(400.0, jnp.float32)
+
+        def run() -> None:
+            out = loop(probs, mask, outcome, state, now, steps)
+            np.asarray(out[1])  # fence: force the consensus to host
+
+        return time_best_of(run, repeats=2, warmup=1)
+
+    # The chunk knobs are part of the key: they change BOTH compiled
+    # programs structurally (the ring fold's per-chunk temps, the band
+    # tree's buffer), so a verdict raced at one chunk config must never
+    # answer for another — the honesty guard's "strict win on the same
+    # clock" promise is per program pair, not per shape.
+    return default_tuner().tune(
+        "settle_kernel",
+        (num_slots, num_markets, steps,
+         None if chunk_agents is None else int(chunk_agents),
+         None if chunk_slots is None else int(chunk_slots),
+         *(int(s) for s in mesh.devices.shape)),
+        ["pallas"],
+        measure,
+        "xla",
+    )
+
+
 def build_cycle_analytics_loop(
     mesh: Mesh,
     chunk_agents: int | None = None,
@@ -578,6 +333,9 @@ def build_cycle_analytics_loop(
     sweep_steps: int = 0,
     with_tiebreak: bool = True,
     with_bands: bool = True,
+    tiebreak_kind: str = "ring",
+    kernel: str = "xla",
+    interpret: bool | None = None,
 ):
     """THE fused co-resident scaffold: N cycles + optional tie-break +
     optional uncertainty bands + optional correlated-market sweep, one
@@ -612,12 +370,33 @@ def build_cycle_analytics_loop(
     donation (state, argnums 3 — every analytics read happens before
     the in-place update in program order), and the loop-half semantics
     are exactly :func:`build_cycle_loop`'s at ``slot_major=True``.
+
+    **Round 14 knobs.** ``tiebreak_kind="sorted"`` swaps the ring fold
+    for the O(A log A) sort-based grouping kernel
+    (:func:`~.ops.tiebreak.batched_tiebreak` — the CPU-heavy-deployment
+    shape, where XLA's TPU sort penalty does not apply); it needs the
+    full agent row local, so the sources axis must be unsharded. Empty
+    rows keep each kernel's own convention (NaN/0 sorted vs ±inf ring);
+    group metrics are byte-equal to the ring path on
+    exactly-representable weights (the cumsum-difference caveat,
+    ops/tiebreak.py). ``kernel="pallas"`` routes the whole program —
+    cycles, tie-break, bands — through the one-pass settlement kernel
+    (``ops/pallas_settle.py``): one HBM sweep per tile instead of 2–3
+    reduce passes, bit-identical outputs, sources axis unsharded and
+    ring tie-break + bands required (that trio IS the kernel).
+    ``kernel="auto"`` asks the honesty-guarded shape tuner
+    (:func:`_tuned_settle_kernel`, knob ``settle_kernel``): XLA ships
+    unless the kernel strictly won this shape's A/B — XLA stays the
+    production default. ``interpret=None`` resolves to interpret mode
+    off-TPU (the tier-1 CPU oracle); pass ``False`` to force a real
+    Mosaic compile.
     """
     from bayesian_consensus_engine_tpu.ops.propagate import (
         damped_sweep_math,
     )
     from bayesian_consensus_engine_tpu.ops.tiebreak import (
         RingTieBreakResult,
+        batched_tiebreak,
         ring_tiebreak_math,
     )
     from bayesian_consensus_engine_tpu.ops.uncertainty import (
@@ -628,9 +407,45 @@ def build_cycle_analytics_loop(
     block, market, slots_axis = _specs(slot_major=True)
     n_sources = mesh.shape[SOURCES_AXIS]
     with_graph = sweep_steps > 0
-    compiled: dict[tuple[int, bool], object] = {}
+    if tiebreak_kind not in ("ring", "sorted"):
+        raise ValueError(
+            f"tiebreak_kind={tiebreak_kind!r}: 'ring' (the chunked "
+            "top-2 fold) or 'sorted' (the sort-based grouping kernel)"
+        )
+    if kernel not in ("xla", "pallas", "auto"):
+        raise ValueError(
+            f"kernel={kernel!r}: 'xla' (the multi-pass fused program, "
+            "the default), 'pallas' (the one-pass settlement kernel), "
+            "or 'auto' (the honesty-guarded shape tuner)"
+        )
+    if tiebreak_kind == "sorted" and with_tiebreak and n_sources > 1:
+        raise ValueError(
+            "tiebreak_kind='sorted' needs the full agent row on one "
+            "device (row-local sort), but this mesh shards the sources "
+            f"axis {n_sources} ways — keep the ring tie-break for "
+            "sources-sharded meshes"
+        )
+    pallas_ineligible = None
+    if n_sources > 1:
+        pallas_ineligible = (
+            "the one-pass kernel holds the full K slot axis per tile, "
+            f"but this mesh shards the sources axis {n_sources} ways"
+        )
+    elif not (with_tiebreak and with_bands) or tiebreak_kind != "ring":
+        pallas_ineligible = (
+            "the one-pass kernel IS cycles + ring tie-break + bands in "
+            "one sweep; disabling a stage (or tiebreak_kind='sorted') "
+            "needs the stage-selective XLA program"
+        )
+    if kernel == "pallas" and pallas_ineligible is not None:
+        raise ValueError(f"kernel='pallas' unavailable: {pallas_ineligible}")
+    if kernel == "auto" and pallas_ineligible is not None:
+        kernel = "xla"
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    compiled: dict[tuple[int, bool, bool], object] = {}
 
-    def compile_for(steps: int, has_exists: bool):
+    def compile_for(steps: int, has_exists: bool, use_pallas: bool):
         cycle_fn = partial(
             _cycle_math, axis_name=SOURCES_AXIS, slots_axis=slots_axis
         )
@@ -639,20 +454,38 @@ def build_cycle_analytics_loop(
         )
         loop_math = make_loop_math(cycle_fn, steps, fast_cycle_fn=fast_fn)
 
+        def sweep(consensus, graph_args):
+            neighbor_idx, neighbor_w = graph_args
+            with jax.named_scope("bce.consensus_sweep"):
+                return damped_sweep_math(
+                    consensus, neighbor_idx, neighbor_w,
+                    damping=damping, steps=sweep_steps,
+                    axis_name=MARKETS_AXIS,
+                )
+
         def fused_math(probs, mask, outcome, state, now0, *graph_args):
             out = []
             if with_tiebreak or with_bands:
                 read_rel, read_conf = read_phase(state, now0)
             if with_tiebreak:
-                with jax.named_scope("bce.ring_tiebreak"):
-                    out.append(ring_tiebreak_math(
-                        probs, read_rel, read_conf, read_rel, mask,
-                        axis_name=SOURCES_AXIS,
-                        axis_size=n_sources,
-                        precision=precision,
-                        chunk_agents=chunk_agents,
-                        agents_last=False,  # slot-major: agents on axis 0
-                    ))
+                if tiebreak_kind == "sorted":
+                    with jax.named_scope("bce.sorted_tiebreak"):
+                        # Row-local over the full (transposed) agent
+                        # width — the sources axis is unsharded here.
+                        out.append(RingTieBreakResult(*batched_tiebreak(
+                            probs.T, read_rel.T, read_conf.T, read_rel.T,
+                            mask.T, precision,
+                        )))
+                else:
+                    with jax.named_scope("bce.ring_tiebreak"):
+                        out.append(ring_tiebreak_math(
+                            probs, read_rel, read_conf, read_rel, mask,
+                            axis_name=SOURCES_AXIS,
+                            axis_size=n_sources,
+                            precision=precision,
+                            chunk_agents=chunk_agents,
+                            agents_last=False,  # slot-major: agents on axis 0
+                        ))
             if with_bands:
                 with jax.named_scope("bce.uncertainty_bands"):
                     out.append(band_math(
@@ -665,13 +498,34 @@ def build_cycle_analytics_loop(
                     ))
             new_state, consensus = loop_math(probs, mask, outcome, state, now0)
             if with_graph:
-                neighbor_idx, neighbor_w = graph_args
-                with jax.named_scope("bce.consensus_sweep"):
-                    out.append(damped_sweep_math(
-                        consensus, neighbor_idx, neighbor_w,
-                        damping=damping, steps=sweep_steps,
-                        axis_name=MARKETS_AXIS,
-                    ))
+                out.append(sweep(consensus, graph_args))
+            return (new_state, consensus, *out)
+
+        def onepass_math(probs, mask, outcome, state, now0, *graph_args):
+            # The one-pass route: the kernel is built at TRACE time from
+            # the local shard's concrete (K, M_loc) shape — everything
+            # the XLA body does in 2-3 passes happens in its one sweep.
+            from bayesian_consensus_engine_tpu.ops.pallas_settle import (
+                build_onepass_settle,
+            )
+
+            k_loc, m_loc = probs.shape
+            onepass = build_onepass_settle(
+                m_loc, k_loc, steps,
+                has_exists=has_exists,
+                precision=precision,
+                chunk_agents=chunk_agents,
+                chunk_slots=chunk_slots,
+                z=z,
+                interpret=interpret,
+            )
+            with jax.named_scope("bce.onepass_settle"):
+                new_state, consensus, tiebreak, bands = onepass(
+                    probs, mask, outcome, state, now0
+                )
+            out = [tiebreak, bands]
+            if with_graph:
+                out.append(sweep(consensus, graph_args))
             return (new_state, consensus, *out)
 
         state_spec = MarketBlockState(
@@ -689,13 +543,23 @@ def build_cycle_analytics_loop(
             + ((market,) if with_graph else ())
         )
         fn = shard_map(
-            fused_math,
+            onepass_math if use_pallas else fused_math,
             mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs,
             check_vma=False,  # ring/top-2/tree folds defeat the checker
         )
         return jax.jit(fn, donate_argnums=(3,) if donate else ())
+
+    def resolve_kernel(probs, steps: int) -> bool:
+        if kernel == "pallas":
+            return True
+        if kernel == "xla":
+            return False
+        return _tuned_settle_kernel(
+            mesh, int(probs.shape[0]), int(probs.shape[1]), steps,
+            chunk_agents, chunk_slots, precision, z,
+        ) == "pallas"
 
     def loop(probs, mask, outcome, state, now0, steps: int, *graph_args):
         if with_graph and len(graph_args) != 2:
@@ -708,7 +572,7 @@ def build_cycle_analytics_loop(
                 "sweep_steps=0 — rebuild with sweep_steps > 0 to run "
                 "the graph sweep"
             )
-        key = (steps, state.exists is not None)
+        key = (steps, state.exists is not None, resolve_kernel(probs, steps))
         fn = compiled.get(key)
         if fn is None:
             fn = compiled[key] = compile_for(*key)

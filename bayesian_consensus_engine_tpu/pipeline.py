@@ -1112,12 +1112,13 @@ _analytics_loop_cache: dict = {}
 
 
 def _cached_analytics_loop(mesh, chunk_agents, chunk_slots, precision,
-                           z, damping, sweep_steps, with_tiebreak):
+                           z, damping, sweep_steps, with_tiebreak,
+                           tiebreak_kind="ring", kernel="xla"):
     """One fused cycle(+tiebreak)+bands(+sweep) loop per configuration —
     shared across sessions like :func:`_cached_cycle_loop` (the jit
     tracing cache lives on the wrapper instance)."""
     key = (mesh, chunk_agents, chunk_slots, precision, z, damping,
-           sweep_steps, with_tiebreak)
+           sweep_steps, with_tiebreak, tiebreak_kind, kernel)
     loop = _analytics_loop_cache.get(key)
     if loop is None:
         from bayesian_consensus_engine_tpu.parallel.sharded import (
@@ -1128,6 +1129,7 @@ def _cached_analytics_loop(mesh, chunk_agents, chunk_slots, precision,
             mesh, chunk_agents=chunk_agents, chunk_slots=chunk_slots,
             donate=True, precision=precision, z=z, damping=damping,
             sweep_steps=sweep_steps, with_tiebreak=with_tiebreak,
+            tiebreak_kind=tiebreak_kind, kernel=kernel,
         )
         _analytics_loop_cache[key] = loop
     return loop
@@ -1493,6 +1495,7 @@ class ShardedSettlementSession:
         steps: int = 1,
         now: Optional[float] = None,
         analytics=None,
+        kernel: Optional[str] = None,
     ) -> tuple:
         """Settle AND analyse the batch in ONE compiled program per chip.
 
@@ -1511,7 +1514,11 @@ class ShardedSettlementSession:
         *analytics* is an :class:`~.analytics.bands.AnalyticsOptions`
         (``None`` → the defaults: recorded chunk sizes, 95% bands, no
         graph; ``tiebreak=False`` drops the ring stage from the program
-        and returns ``None`` in its slot). Settlement semantics — state
+        and returns ``None`` in its slot; ``tiebreak="sorted"`` swaps in
+        the sort-based grouping kernel for CPU-heavy deployments —
+        group metrics byte-equal to the ring path on
+        exactly-representable weights, empty rows keep each kernel's
+        own convention). Settlement semantics — state
         merge recipe, confidence
         replay, journal/export bytes — are exactly :meth:`settle`'s (the
         shared commit path), and the consensus comes out of the same
@@ -1521,6 +1528,16 @@ class ShardedSettlementSession:
         mode rests on). Bands/tie-break/sweep are pure-additive reads of
         the PRE-update state at *now*; nothing analytics-side is ever
         written back.
+
+        *kernel* (round 14; ``None`` defers to ``analytics.kernel``)
+        routes the whole fused program: ``"xla"`` — the multi-pass
+        program, the production default; ``"pallas"`` — the one-pass
+        settlement kernel (``ops/pallas_settle.py``: consensus,
+        tie-break, and band moments in a single HBM sweep per tile,
+        outputs AND store bytes bit-identical to the XLA program,
+        pinned by tests/test_pallas_settle.py); ``"auto"`` — the
+        honesty-guarded shape tuner (knob ``settle_kernel``): XLA ships
+        unless the kernel strictly won this shape's A/B.
         """
         import jax.numpy as jnp
 
@@ -1559,6 +1576,14 @@ class ShardedSettlementSession:
         chunk_slots = resolve(
             options.chunk_slots, DEFAULT_CHUNK_SLOTS, "chunk_slots"
         )
+        tiebreak_opt = options.tiebreak
+        if tiebreak_opt not in (True, False, "sorted"):
+            raise ValueError(
+                f"tiebreak={tiebreak_opt!r}: True (ring), False (off), "
+                "or 'sorted' (the sort-based grouping kernel)"
+            )
+        tiebreak_kind = "sorted" if tiebreak_opt == "sorted" else "ring"
+        kernel = kernel if kernel is not None else options.kernel
         graph = options.graph
         # Cluster posture (round 13): bands and the tie-break are
         # per-market reductions over the sources axis, so they serve a
@@ -1607,7 +1632,8 @@ class ShardedSettlementSession:
             )
             loop = _cached_analytics_loop(
                 self._mesh, chunk_agents, chunk_slots, options.precision,
-                options.z, damping, sweep_steps, options.tiebreak,
+                options.z, damping, sweep_steps, bool(tiebreak_opt),
+                tiebreak_kind, kernel,
             )
         with active_timeline().span("settle_dispatch"):
             outcome_g = global_market(

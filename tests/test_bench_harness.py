@@ -306,7 +306,8 @@ class TestPallasAdjudication:
     stubbed (the real kernels need the TPU backend)."""
 
     def _run(self, monkeypatch, xla=(887.0, 900.0), pallas2048=620.0,
-             auto_tile=1024, pallas_auto=700.0, large_k_error=None):
+             auto_tile=1024, pallas_auto=700.0, large_k_error=None,
+             onepass=720.0, onepass_error=None):
         xla_values = iter(xla)
         monkeypatch.setattr(
             bench, "bench_headline", lambda *a, **k: next(xla_values)
@@ -319,7 +320,13 @@ class TestPallasAdjudication:
                 return 50.0
             return pallas2048 if tile == 2048 else pallas_auto
 
+        def fake_onepass_rate(markets, slots, steps):
+            if onepass_error is not None:
+                raise onepass_error
+            return onepass
+
         monkeypatch.setattr(bench, "_pallas_rate", fake_rate)
+        monkeypatch.setattr(bench, "_onepass_rate", fake_onepass_rate)
         monkeypatch.setattr(
             "bayesian_consensus_engine_tpu.ops.pallas_cycle._tuned_tile",
             lambda m, k: auto_tile,
@@ -350,6 +357,29 @@ class TestPallasAdjudication:
         assert "pallas_16k10k_cycles_per_sec" not in out
         assert out["pallas_16k10k"].startswith("infeasible: RuntimeError")
         assert out["verdict"]  # the 1M×16 verdict still renders
+
+    def test_onepass_arm_adjudicated_against_best_xla(self, monkeypatch):
+        # Round 14: the third bracket arm. A one-pass rate above the
+        # best XLA pass is a decisive win (the kernel computes MORE per
+        # sweep); below, XLA keeps the verdict.
+        out = self._run(monkeypatch, onepass=950.0)
+        assert out["onepass_settle_cycles_per_sec"] == 950.0
+        assert out["onepass_verdict"].startswith(
+            "onepass_wins_1m16 (950.0 vs 900.0"
+        )
+        out = self._run(monkeypatch, onepass=720.0)
+        assert out["onepass_verdict"].startswith(
+            "xla_wins_onepass_1m16 (900.0 vs 720.0"
+        )
+
+    def test_onepass_infeasibility_is_data_not_a_crash(self, monkeypatch):
+        out = self._run(
+            monkeypatch, onepass_error=RuntimeError("Mosaic lowering")
+        )
+        assert "onepass_settle_cycles_per_sec" not in out
+        assert out["onepass_settle"].startswith("infeasible: RuntimeError")
+        assert "onepass_verdict" not in out
+        assert out["verdict"]
 
 
 class TestOrchestrate:
@@ -573,6 +603,14 @@ class TestRingMemoryLeg:
         # One program per chip: the fused program takes the block ONCE —
         # its argument footprint undercuts the two separate programs'.
         assert fused["fused_arg_bytes"] < fused["separate_arg_bytes"]
+        # Round 14: the one-pass read capture rides the leg (the ≤0.5×
+        # acceptance engages at the full co-resident shape, where the
+        # kernel grid tiles the markets axis).
+        onepass = result["onepass"]
+        for key in ("multi_pass_read_bytes", "one_pass_read_bytes",
+                    "read_ratio", "single_pass_halves_reads",
+                    "grid_tiles"):
+            assert key in onepass, key
         json.dumps(result)
 
     def test_leg_is_registered_for_device_runs(self):
@@ -611,6 +649,18 @@ class TestAnalyticsLeg:
             result["sweep_marginal_arg_bytes"]
             < result["fused_arg_bytes"] / 10
         )
+        # Round 14: the one-pass read capture rides the leg (the ≤0.5×
+        # acceptance engages at the full shape, where the kernel grid
+        # tiles the markets axis — grid_tiles is recorded so the reader
+        # can tell which regime the ratio came from).
+        onepass = result["onepass"]
+        for key in ("multi_pass_read_bytes", "one_pass_read_bytes",
+                    "read_ratio", "single_pass_halves_reads",
+                    "tile_markets", "grid_tiles"):
+            assert key in onepass, key
+        assert onepass["one_pass_read_bytes"] <= (
+            onepass["multi_pass_read_bytes"] * 1.05
+        )
         # The live co-resident session act ran (it is what records the
         # `analytics` phase span into the leg's breakdown).
         assert result["session_fused_dispatch_s"] > 0
@@ -620,6 +670,44 @@ class TestAnalyticsLeg:
         assert "e2e_analytics" in bench.LEGS
         assert "e2e_analytics" in bench.DEVICE_LEG_ORDER
         assert "e2e_analytics" in bench.compose(
+            {}, [], None, 0.0
+        )[0]["extras"]
+
+
+class TestOnepassLeg:
+    """ISSUE-12's ``e2e_onepass`` at --fast shapes: the multi-pass XLA
+    fused program vs the one-pass settlement kernel on identical
+    operands, with the per-settle HBM bytes-read capture off the AOT
+    executables that ran. Bit-parity of the two routes is pinned by
+    tests/test_pallas_settle.py; this pins the LEG contract."""
+
+    def test_fast_leg_reports_read_ab(self):
+        result = bench.run_leg_inprocess("e2e_onepass", fast=True)
+        for side in ("multi_pass", "one_pass"):
+            for key in ("wall_s", "markets_per_sec", "arg_bytes",
+                        "compiled_temp_bytes", "hbm_read_bytes",
+                        "wall_s_band", "repeats"):
+                assert key in result[side], (side, key)
+        # Identical operands → identical argument bytes; the read story
+        # is in the temps. At the --fast one-tile shape the interpret
+        # program degenerates to the XLA program (ratio ~1, recorded as
+        # onepass_tiled=False); the ≤0.5× acceptance engages at the
+        # full tiled shapes (onepass_tiled=True — the ring/analytics
+        # legs' full captures measure 0.146/0.271).
+        assert (
+            result["one_pass"]["arg_bytes"]
+            == result["multi_pass"]["arg_bytes"]
+        )
+        assert result["read_ratio"] > 0
+        assert isinstance(result["single_pass_halves_reads"], bool)
+        assert result["onepass_tiled"] == (result["grid_tiles"] > 1)
+        assert result["grid_tiles"] * result["tile_markets"] >= 256
+        json.dumps(result)
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_onepass" in bench.LEGS
+        assert "e2e_onepass" in bench.DEVICE_LEG_ORDER
+        assert "e2e_onepass" in bench.compose(
             {}, [], None, 0.0
         )[0]["extras"]
 

@@ -5,7 +5,7 @@ examples/*.py); only the fast scalar examples run here — the device-loop
 examples (settlement_cycle, compact_settlement, distributed_settlement,
 settlement_service, streaming_settlement, batched_consensus,
 fault_tolerant_service, columnar_ingest, coresident_tiebreak,
-uncertainty_bands, degraded_mesh_recovery — the
+uncertainty_bands, degraded_mesh_recovery, onepass_settlement — the
 ingest example's packer parity lives in tests/test_fastpack.py and
 tests/test_serve.py; the co-resident tie-break's chunk parity and fused
 session in tests/test_ring.py; the uncertainty-band/graph-sweep
@@ -13,7 +13,9 @@ example's bit matrix, fused-program parity, and analytics on/off
 byte-exactness coda in tests/test_analytics.py; the degraded-mesh
 recovery example's membership/replay/adopt contracts and byte coda in
 tests/test_cluster.py, with the real-kill multi-process version smoked
-through tests/test_bench_harness.py::TestKillSoakLeg) each pay tens of
+through tests/test_bench_harness.py::TestKillSoakLeg; the one-pass
+settlement example's kernel/XLA bit matrix, session byte parity, and
+sorted-tiebreak pins in tests/test_pallas_settle.py) each pay tens of
 seconds of XLA
 compilation and
 are exercised through the library tests instead (streaming_settlement's

@@ -226,6 +226,28 @@ _CASES = [
         "                          out_specs=[block])\n",
     ),
     (
+        # Round 14 (one-pass settlement): an output aliased onto an
+        # input (``input_output_aliases``) shares the input's HBM buffer
+        # and counts ONCE against the 16 MB scoped-VMEM budget. The bad
+        # twin double-bills the aliased pair past the budget; the good
+        # twin declares the alias and fits exactly.
+        "PL501",
+        f"{PKG}/ops/case.py",
+        "from jax.experimental import pallas as pl\n\n\n"
+        "def build():\n"
+        "    grid = (4,)\n"
+        "    big = pl.BlockSpec((1024, 1024), lambda i: (0, i))\n"
+        "    return pl.pallas_call(None, grid=grid, in_specs=[big],\n"
+        "                          out_specs=[big, big])\n",
+        "from jax.experimental import pallas as pl\n\n\n"
+        "def build():\n"
+        "    grid = (4,)\n"
+        "    big = pl.BlockSpec((1024, 1024), lambda i: (0, i))\n"
+        "    return pl.pallas_call(None, grid=grid, in_specs=[big],\n"
+        "                          out_specs=[big, big],\n"
+        "                          input_output_aliases={0: 0})\n",
+    ),
+    (
         "F401",
         "tests/case.py",
         "import os\n\n\ndef f():\n    return 1\n",

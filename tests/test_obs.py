@@ -176,6 +176,45 @@ class TestHistogramQuantile:
         assert "peak_mem" in rendered.splitlines()[0]
         assert "120MB" in rendered
 
+    def test_ledger_hbm_read_min_across_repeats(self, tmp_path):
+        # extras.hbm_read_bytes (the round-14 one-pass legs: arg + temp
+        # bytes of the AOT settle program that ran — per-settle
+        # bytes-read floor) folds to the MIN across repeats and renders
+        # as the stats table's hbm_read column; zero/absent samples
+        # contribute nothing.
+        path = tmp_path / "read.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            for read in (96_000_000, 48_000_000, 0):
+                ledger.record(
+                    "e2e_onepass.onepass", value=1.0, unit="s",
+                    extras={"hbm_read_bytes": read},
+                )
+            ledger.record("plain_leg", value=2.0, unit="s")
+        records = obs.read_ledger(path)
+        summary = obs.summarize(records)
+        assert summary["e2e_onepass.onepass"]["hbm_read_bytes"] == 48_000_000
+        assert "hbm_read_bytes" not in summary["plain_leg"]
+        rendered = obs_ledger.render(records)
+        assert "hbm_read" in rendered.splitlines()[0]
+        assert "48MB" in rendered
+
+    def test_diff_bands_carries_hbm_read_metric(self, tmp_path):
+        def ledger_records(path, read):
+            with obs.RunLedger(path, run_id="r") as ledger:
+                ledger.record(
+                    "e2e_onepass", value=1.0, unit="s",
+                    extras={"hbm_read_bytes": read},
+                )
+            return obs.read_ledger(path)
+
+        old = ledger_records(tmp_path / "old.jsonl", 200_000_000)
+        new = ledger_records(tmp_path / "new.jsonl", 80_000_000)
+        diff = obs.diff_bands(old, new)
+        metric = diff["e2e_onepass"]["metrics"]["hbm_read_bytes"]
+        assert metric == {"old": 200_000_000, "new": 80_000_000}
+        rendered = obs.render_diff(diff)
+        assert "hbm_read 2e+08->8e+07" in rendered
+
     def test_ledger_recovery_min_across_repeats(self, tmp_path):
         # extras.recovery_s (the round-13 kill-soak leg: kill → first
         # re-settled dead-band batch) folds to the MIN across repeats and

@@ -1,0 +1,512 @@
+"""One-pass settlement kernel (round 14): the interpret-mode bit oracle.
+
+The non-negotiable contract: ``build_cycle_analytics_loop(kernel="pallas")``
+— the Pallas kernel computing consensus + tie-break + band moments in one
+HBM sweep per tile — is BIT-IDENTICAL to the multi-pass XLA fused program
+on the tier-1 CPU backend, across chunk settings, mesh factorisations
+(markets-sharded; the kernel serves unsharded-sources meshes only),
+workloads, and step counts. The parity is structural (the kernel body
+traces the same layer-1 functions — ops/cycle_math, ring_tiebreak_math,
+band_sums — the XLA program traces under shard_map); these tests are the
+empirical pin, mirroring tests/test_ring.py / test_analytics.py.
+
+Also here: the sorted tie-break through the fused session surface
+(``settle_with_analytics(tiebreak="sorted")``, the PR-9 follow-up) pinned
+byte-equal to the ring path on exactly-representable weights, and the
+``settle_kernel`` honesty-guard wiring (``kernel="auto"``).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from bayesian_consensus_engine_tpu.analytics import AnalyticsOptions
+from bayesian_consensus_engine_tpu.ops.cycle_math import MarketBlockState
+from bayesian_consensus_engine_tpu.ops.pallas_settle import (
+    build_onepass_settle,
+    resolve_tile_markets,
+)
+from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+from bayesian_consensus_engine_tpu.parallel.sharded import (
+    build_cycle_analytics_loop,
+    init_block_state,
+)
+from bayesian_consensus_engine_tpu.pipeline import (
+    ShardedSettlementSession,
+    build_settlement_plan,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+M, K = 64, 16
+NOW = 21_900.0
+
+
+def _inputs(workload, seed=0, m=M, k=K):
+    """Slot-major (K, M) operand set for a named parity workload."""
+    rng = np.random.default_rng(seed)
+    probs = rng.random((k, m))
+    valid = rng.random((k, m)) < 0.8
+    if workload == "mask_holes":
+        valid = rng.random((k, m)) < 0.5
+        valid[:, 0] = False  # a market with no signalling slot
+    elif workload == "single_agent":
+        valid = np.zeros((k, m), dtype=bool)
+        valid[rng.integers(0, k, m), np.arange(m)] = True
+    elif workload == "all_tied":
+        # Every agent lands in one quantised group per market.
+        probs = np.full((k, m), 0.625)
+        valid = np.ones((k, m), dtype=bool)
+    else:
+        assert workload == "random"
+    state = MarketBlockState(
+        reliability=jnp.asarray(rng.uniform(0.1, 1.0, (k, m)), jnp.float32),
+        confidence=jnp.asarray(rng.uniform(0.0, 1.0, (k, m)), jnp.float32),
+        updated_days=jnp.asarray(
+            rng.choice([0.0, 5.0, 400.0], (k, m)), jnp.float32
+        ),
+        exists=jnp.asarray(rng.random((k, m)) < 0.6),
+    )
+    return (
+        jnp.asarray(probs, jnp.float32),
+        jnp.asarray(valid),
+        jnp.asarray(rng.random(m) < 0.5),
+        state,
+        jnp.float32(401.0),
+    )
+
+
+def _assert_all_equal(got, want, label=""):
+    """Bit-equality over the full 4-tuple (state, consensus, tb, bands)."""
+    st_g, cons_g, tb_g, bands_g = got
+    st_w, cons_w, tb_w, bands_w = want
+    pairs = [("consensus", cons_g, cons_w)]
+    pairs += [
+        (f"state.{n}", getattr(st_g, n), getattr(st_w, n))
+        for n in st_w._fields
+    ]
+    pairs += [
+        (f"tb.{n}", getattr(tb_g, n), getattr(tb_w, n))
+        for n in tb_w._fields
+    ]
+    pairs += [
+        (f"bands.{n}", getattr(bands_g, n), getattr(bands_w, n))
+        for n in bands_w._fields
+    ]
+    for name, a, b in pairs:
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.array_equal(
+            a, b, equal_nan=(a.dtype.kind == "f")
+        ), f"{label}/{name} not bit-equal"
+
+
+def _run(mesh, kernel, args, steps, chunk_agents, chunk_slots):
+    loop = build_cycle_analytics_loop(
+        mesh, chunk_agents=chunk_agents, chunk_slots=chunk_slots,
+        donate=False, kernel=kernel,
+    )
+    st, cons, tb, bands, _ = loop(*args, steps)
+    return st, cons, tb, bands
+
+
+class TestOnepassParityMatrix:
+    """ISSUE-12 acceptance: the one-pass kernel bit-identical to the
+    multi-pass XLA fused program — store tensors, consensus, tie-break,
+    bands — at every chunk setting, across markets-mesh factorisations
+    and step counts, in interpret mode on the tier-1 backend."""
+
+    @pytest.mark.parametrize("mesh_shape", [(1, 1), (8, 1)])
+    @pytest.mark.parametrize(
+        "workload", ["random", "mask_holes", "all_tied", "single_agent"]
+    )
+    def test_bit_exact_vs_xla_program(self, mesh_shape, workload):
+        args = _inputs(workload)
+        mesh = make_mesh(
+            mesh_shape, devices=jax.devices()[: mesh_shape[0] * mesh_shape[1]]
+        )
+        for steps, chunks in [(1, (5, 4)), (3, (None, None)), (3, (5, 4))]:
+            want = _run(mesh, "xla", args, steps, *chunks)
+            got = _run(mesh, "pallas", args, steps, *chunks)
+            _assert_all_equal(
+                got, want,
+                label=f"{mesh_shape}/{workload}/steps={steps}/chunks={chunks}",
+            )
+
+    def test_multi_tile_grid_bit_exact(self):
+        # The standalone builder at an explicit sub-shape tile: tiling
+        # the markets axis must not move a bit (every reduction runs
+        # over the K axis only).
+        m, k = 256, 16
+        probs, mask, outcome, state, now0 = _inputs("random", seed=3, m=m)
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        want = _run(
+            mesh, "xla", (probs, mask, outcome, state, now0), 2, 5, 4
+        )
+        onepass = build_onepass_settle(
+            m, k, 2, chunk_agents=5, chunk_slots=4, tile_markets=64,
+            interpret=True,
+        )
+        got = jax.jit(lambda *a: onepass(*a))(
+            probs, mask, outcome, state, now0
+        )
+        _assert_all_equal(got, want, label="tile=64")
+
+    def test_empty_market_rows_pin(self):
+        # RingTieBreakResult's empty-row convention survives the kernel:
+        # prediction=+inf, group metrics -inf; bands report NaN/0.
+        args = _inputs("mask_holes", seed=1)
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        _st, _cons, tb, bands = _run(mesh, "pallas", args, 1, None, None)
+        assert np.asarray(tb.prediction)[0] == np.inf
+        assert np.asarray(tb.weight_density)[0] == -np.inf
+        assert np.asarray(tb.max_reliability)[0] == -np.inf
+        assert np.isnan(np.asarray(bands.mean)[0])
+        assert np.asarray(bands.count)[0] == 0
+        assert np.asarray(bands.n_eff)[0] == 0.0
+
+    def test_masked_pad_lanes_exact_passthrough(self):
+        # Fully-masked markets (the lane-padding shape) keep their state
+        # bit-identical — padded columns must stay cold through the
+        # in-place aliased update.
+        probs, mask, outcome, state, now0 = _inputs("random", seed=9)
+        mask = np.array(mask)
+        mask[:, M // 2:] = False  # the pad half
+        mask = jnp.asarray(mask)
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        st, _cons, _tb, _bands = _run(
+            mesh, "pallas", (probs, mask, outcome, state, now0), 2, None,
+            None,
+        )
+        for name in ("reliability", "confidence", "updated_days", "exists"):
+            got = np.asarray(getattr(st, name))[:, M // 2:]
+            want = np.asarray(getattr(state, name))[:, M // 2:]
+            assert np.array_equal(got, want), name
+
+    def test_graph_sweep_rides_the_kernel_path(self):
+        m, k = 128, 8
+        probs, mask, outcome, state, now0 = _inputs(
+            "random", seed=4, m=m, k=k
+        )
+        rng = np.random.default_rng(9)
+        nb_idx = jnp.asarray(rng.integers(-1, m, (m, 3)), jnp.int32)
+        nb_w = jnp.asarray(rng.uniform(0.5, 1.5, (m, 3)), jnp.float32)
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        want = build_cycle_analytics_loop(
+            mesh, donate=False, sweep_steps=2
+        )(probs, mask, outcome, state, now0, 2, nb_idx, nb_w)
+        got = build_cycle_analytics_loop(
+            mesh, donate=False, sweep_steps=2, kernel="pallas"
+        )(probs, mask, outcome, state, now0, 2, nb_idx, nb_w)
+        np.testing.assert_array_equal(
+            np.asarray(got[4]), np.asarray(want[4])
+        )
+
+
+class TestOnepassRouting:
+    """The kernel routing contract: clear errors where the kernel cannot
+    serve, silent XLA fallback only for kernel='auto'."""
+
+    def test_sources_sharded_mesh_rejected(self):
+        mesh = make_mesh((1, 8))
+        with pytest.raises(ValueError, match="sources axis"):
+            build_cycle_analytics_loop(mesh, kernel="pallas")
+
+    def test_stage_off_rejected(self):
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        with pytest.raises(ValueError, match="one sweep"):
+            build_cycle_analytics_loop(
+                mesh, kernel="pallas", with_bands=False
+            )
+        with pytest.raises(ValueError, match="one sweep"):
+            build_cycle_analytics_loop(
+                mesh, kernel="pallas", tiebreak_kind="sorted"
+            )
+
+    def test_auto_falls_back_where_ineligible(self):
+        # auto on a sources-sharded mesh resolves to XLA without a
+        # tuner race (there is nothing to race).
+        mesh = make_mesh((1, 8))
+        loop = build_cycle_analytics_loop(mesh, kernel="auto", donate=False)
+        args = _inputs("random", seed=2)
+        st, cons, tb, bands, _ = loop(*args, 1)
+        assert np.isfinite(np.asarray(cons)).all()
+
+    def test_unknown_kernel_rejected(self):
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        with pytest.raises(ValueError, match="kernel="):
+            build_cycle_analytics_loop(mesh, kernel="mosaic")
+
+    def test_non_f32_state_rejected(self):
+        onepass = build_onepass_settle(8, 2, 1, interpret=True)
+        state = MarketBlockState(
+            reliability=jnp.zeros((2, 8), jnp.float16),
+            confidence=jnp.zeros((2, 8), jnp.float16),
+            updated_days=jnp.zeros((2, 8), jnp.float16),
+            exists=jnp.zeros((2, 8), bool),
+        )
+        with pytest.raises(ValueError, match="float32"):
+            onepass(
+                jnp.zeros((2, 8), jnp.float32),
+                jnp.ones((2, 8), bool),
+                jnp.zeros(8, bool),
+                state,
+                1.0,
+            )
+
+    def test_ragged_tile_rejected(self):
+        with pytest.raises(ValueError, match="not a multiple"):
+            build_onepass_settle(100, 4, 1, tile_markets=64)
+
+    def test_tile_resolution_respects_vmem_budget(self):
+        # Small K: big tiles fit. Large K: the tile shrinks so the
+        # double-buffered block set stays inside the 16 MB budget.
+        assert resolve_tile_markets(1_048_576, 16) == 2048
+        tile = resolve_tile_markets(16_384, 10_000)
+        assert tile * 10_000 * 4 * 11 * 2 <= 16 * 1024 * 1024 or (
+            tile == 16_384
+        )
+
+
+def _grid_payloads(markets=12, srcs=5, seed=7):
+    """Exactly-representable probabilities on the tie-break's quantised
+    grid; a cold store reads uniform default weights — the byte-parity
+    regime the ring/sorted comparison is pinned on."""
+    rng = np.random.default_rng(seed)
+    grid = np.round(np.linspace(0.05, 0.95, 19), 6)
+    payloads = [
+        (
+            f"m-{i}",
+            [
+                {"sourceId": f"s-{j}", "probability": float(rng.choice(grid))}
+                for j in range(srcs)
+            ],
+        )
+        for i in range(markets)
+    ]
+    return payloads, list(rng.random(markets) < 0.5)
+
+
+class TestSortedTiebreak:
+    """The PR-9 follow-up: the sort-based grouping kernel through the
+    same fused session surface, byte-parity-pinned against the ring
+    path on exactly-representable weights."""
+
+    def test_fused_sorted_equals_ring_on_representable_weights(self):
+        rng = np.random.default_rng(2)
+        grid = np.round(np.linspace(0.05, 0.95, 19), 6)
+        m, k = 64, 8
+        probs = jnp.asarray(rng.choice(grid, (k, m)), jnp.float32)
+        mask = jnp.asarray(rng.random((k, m)) < 0.8)
+        outcome = jnp.asarray(rng.random(m) < 0.5)
+        # Cold state: every slot reads the default reliability and
+        # confidence — exactly-representable weights, uniform conf (so
+        # even the two variance expressions agree exactly).
+        state = jax.tree.map(lambda x: x.T, init_block_state(m, k))
+        mesh = make_mesh((2, 1), devices=jax.devices()[:2])
+        now0 = jnp.float32(400.0)
+        ring = build_cycle_analytics_loop(mesh, donate=False)
+        srt = build_cycle_analytics_loop(
+            mesh, donate=False, tiebreak_kind="sorted"
+        )
+        tb_r = ring(probs, mask, outcome, state, now0, 1)[2]
+        tb_s = srt(probs, mask, outcome, state, now0, 1)[2]
+        for name in tb_r._fields:
+            a = np.asarray(getattr(tb_s, name))
+            b = np.asarray(getattr(tb_r, name))
+            np.testing.assert_array_equal(a, b, err_msg=name)
+
+    def test_empty_rows_keep_each_kernels_convention(self):
+        # Documented divergence: batched reports NaN/0 for empty rows,
+        # the ring path ±inf — conventions, not disagreements.
+        rng = np.random.default_rng(3)
+        m, k = 16, 4
+        probs = jnp.asarray(rng.random((k, m)), jnp.float32)
+        mask_np = rng.random((k, m)) < 0.7
+        mask_np[:, 0] = False
+        mask = jnp.asarray(mask_np)
+        outcome = jnp.asarray(rng.random(m) < 0.5)
+        state = jax.tree.map(lambda x: x.T, init_block_state(m, k))
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        now0 = jnp.float32(400.0)
+        tb_r = build_cycle_analytics_loop(mesh, donate=False)(
+            probs, mask, outcome, state, now0, 1
+        )[2]
+        tb_s = build_cycle_analytics_loop(
+            mesh, donate=False, tiebreak_kind="sorted"
+        )(probs, mask, outcome, state, now0, 1)[2]
+        assert np.asarray(tb_r.prediction)[0] == np.inf
+        assert np.isnan(np.asarray(tb_s.prediction)[0])
+        assert np.asarray(tb_s.weight_density)[0] == 0.0
+
+    def test_sorted_rejected_on_sources_sharded_mesh(self):
+        mesh = make_mesh((1, 8))
+        with pytest.raises(ValueError, match="sorted"):
+            build_cycle_analytics_loop(mesh, tiebreak_kind="sorted")
+
+    def test_session_surface_sorted(self):
+        payloads, outcomes = _grid_payloads()
+        stores = [TensorReliabilityStore() for _ in range(2)]
+        plans = [
+            build_settlement_plan(s, payloads, num_slots=8) for s in stores
+        ]
+        mesh = make_mesh()
+        with ShardedSettlementSession(stores[0], plans[0], mesh) as ring:
+            _res_r, tb_r, _b, _p = ring.settle_with_analytics(
+                outcomes, now=NOW, analytics=AnalyticsOptions(chunk_slots=4)
+            )
+        with ShardedSettlementSession(stores[1], plans[1], mesh) as srt:
+            _res_s, tb_s, _b, _p = srt.settle_with_analytics(
+                outcomes, now=NOW,
+                analytics=AnalyticsOptions(chunk_slots=4, tiebreak="sorted"),
+            )
+        for name in ("prediction", "weight_density", "max_reliability",
+                     "resolved_by", "num_groups", "confidence_variance"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(tb_s, name)),
+                np.asarray(getattr(tb_r, name)),
+                err_msg=name,
+            )
+        # Settlement bytes untouched by the tie-break flavour.
+        rows = np.arange(stores[0].live_row_count())
+        for got, want in zip(
+            stores[1].host_rows(rows), stores[0].host_rows(rows)
+        ):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_unknown_tiebreak_option_rejected(self):
+        payloads, outcomes = _grid_payloads(markets=2, srcs=2)
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads, num_slots=4)
+        with ShardedSettlementSession(store, plan, make_mesh()) as session:
+            with pytest.raises(ValueError, match="sorted"):
+                session.settle_with_analytics(
+                    outcomes, now=NOW,
+                    analytics=AnalyticsOptions(tiebreak="quantised"),
+                )
+
+
+class TestSessionKernelParity:
+    """``settle_with_analytics(kernel="pallas")`` byte-equal to the XLA
+    default over CHAINED settles on the resident session — store rows,
+    consensus, tie-break, bands (the donation/aliasing path included)."""
+
+    def _run(self, kernel):
+        payloads, outcomes = _grid_payloads(markets=10, srcs=4, seed=5)
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads, num_slots=8)
+        options = AnalyticsOptions(chunk_slots=4, chunk_agents=3)
+        with ShardedSettlementSession(store, plan, make_mesh()) as session:
+            session.settle_with_analytics(
+                outcomes, steps=2, now=NOW, analytics=options, kernel=kernel
+            )
+            out = session.settle_with_analytics(
+                outcomes, steps=2, now=NOW + 1, analytics=options,
+                kernel=kernel,
+            )
+        rows = np.arange(store.live_row_count())
+        return out, [np.asarray(x) for x in store.host_rows(rows)]
+
+    def test_store_and_outputs_bit_equal(self):
+        (res_x, tb_x, bands_x, _), rows_x = self._run("xla")
+        (res_p, tb_p, bands_p, _), rows_p = self._run("pallas")
+        for i, (a, b) in enumerate(zip(rows_p, rows_x)):
+            np.testing.assert_array_equal(a, b, err_msg=f"store array {i}")
+        np.testing.assert_array_equal(
+            np.asarray(res_p.consensus), np.asarray(res_x.consensus)
+        )
+        for name in tb_x._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(tb_p, name)),
+                np.asarray(getattr(tb_x, name)),
+                err_msg=f"tb.{name}",
+            )
+        for name in bands_x._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(bands_p, name)),
+                np.asarray(getattr(bands_x, name)),
+                err_msg=f"bands.{name}",
+            )
+
+
+class TestSettleKernelAutotune:
+    """kernel="auto" rides the ShapeTuner contract (knob
+    ``settle_kernel``): off → XLA without measuring; on → the honesty
+    guard races the kernel against the XLA default on the same clock."""
+
+    def test_auto_resolves_through_tuner(self, monkeypatch):
+        from bayesian_consensus_engine_tpu.parallel import sharded
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        seen = {}
+
+        class FakeTuner:
+            def tune(self, knob, shape_key, candidates, measure, default):
+                seen.update(
+                    knob=knob, shape_key=shape_key,
+                    candidates=candidates, default=default,
+                )
+                return "pallas"
+
+        monkeypatch.setattr(autotune, "default_tuner", lambda: FakeTuner())
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        choice = sharded._tuned_settle_kernel(
+            mesh, 16, 256, 2, None, None, 6, 1.959964
+        )
+        assert choice == "pallas"
+        assert seen["knob"] == "settle_kernel"
+        # Chunk knobs ride the key: a verdict raced at one chunk config
+        # must never answer for another (the programs differ).
+        assert seen["shape_key"] == (16, 256, 2, None, None, 1, 1)
+        assert seen["candidates"] == ["pallas"]
+        assert seen["default"] == "xla"
+
+    def test_default_off_resolves_xla_without_measuring(
+        self, monkeypatch, tmp_path
+    ):
+        from bayesian_consensus_engine_tpu.parallel import sharded
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        monkeypatch.delenv("BCE_AUTOTUNE", raising=False)
+        monkeypatch.setattr(autotune, "_default_tuner", None)
+        monkeypatch.setattr(
+            autotune, "_default_cache_path",
+            lambda: str(tmp_path / "never.json"),
+        )
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        choice = sharded._tuned_settle_kernel(
+            mesh, 16, 256, 2, None, None, 6, 1.959964
+        )
+        assert choice == "xla"
+        assert not (tmp_path / "never.json").exists()
+
+    def test_real_race_records_honesty_verdict(self, tmp_path):
+        # A REAL (tiny-shape) race through an enabled tuner: whatever
+        # wins, the cache entry must carry the default and the verdict —
+        # a tuned "pallas" may only ship with beat_default=True.
+        from bayesian_consensus_engine_tpu.parallel import sharded
+        from bayesian_consensus_engine_tpu.utils.autotune import ShapeTuner
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        tuner = ShapeTuner(
+            cache_path=str(tmp_path / "cache.json"), enabled=True
+        )
+        orig = autotune.default_tuner
+        autotune.default_tuner = lambda: tuner
+        try:
+            mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+            choice = sharded._tuned_settle_kernel(
+                mesh, 4, 16, 1, None, None, 6, 1.959964
+            )
+            decision = tuner.decision(
+                "settle_kernel", (4, 16, 1, None, None, 1, 1)
+            )
+        finally:
+            autotune.default_tuner = orig
+        assert decision is not None
+        assert decision["default"] == "xla"
+        assert decision["choice"] == choice
+        if choice == "pallas":
+            assert decision["beat_default"] is True
