@@ -228,6 +228,10 @@ def _run_stats(args: argparse.Namespace) -> None:
     per-settle bytes-read capture (``extras.hbm_read_bytes`` — the
     round-14 one-pass legs: args + temps of the AOT settle executable
     that ran) render the ``hbm_read`` column the same way; legs carrying
+    pair-interning seconds (``extras.intern_s`` — the round-15 ingest/
+    stream/serve legs) render the ``intern`` column beside ``ingest_w``
+    (the delta-interning signal: the slice of ingest that cannot
+    overlap, driven toward zero for drifting topologies); legs carrying
     recovery accounting (``extras.recovery_s`` + ``extras.slo`` — the
     kill-soak leg) render the ``recovery`` column beside ``goodput``,
     the failure story in one row. ``--json`` emits the machine-shaped

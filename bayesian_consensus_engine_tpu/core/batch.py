@@ -257,6 +257,58 @@ def topology_fingerprint(
     return digest.digest()
 
 
+def pair_fingerprint(
+    market_keys: Sequence[str],
+    sid_of_rank: Sequence[str],
+    pair_market,
+    pair_rank,
+    pair_offsets,
+) -> bytes:
+    """Order-sensitive fingerprint of a batch's RESOLVED PAIR SET.
+
+    The second link of the plan-reuse fingerprint chain (round 15): where
+    :func:`topology_fingerprint` digests the raw signal columns
+    (duplicates and all), this digests exactly what pair interning
+    consumes — the market table in payload order, the code-point-sorted
+    source table, and the grouped (market, source-rank) pair list with
+    its CSR offsets. Equal digests ⇒ the identical ordered pair list ⇒
+    the previous epoch's resolved rows apply verbatim (the
+    epoch-persistent pair table's O(1) tier in
+    :class:`~.state.tensor_store.TensorReliabilityStore`). A batch whose
+    signals changed but whose pair set did not — reordered duplicates,
+    different per-pair signal counts — misses the topology digest yet
+    hits here, paying zero interning.
+
+    Same injectivity posture as the topology digest: ids are
+    length-delimited, table sizes are part of the digest, and the pair
+    arrays are hashed as raw little-endian bytes, so distinct pair sets
+    collide only with blake2b itself.
+    """
+    pair_market = np.ascontiguousarray(pair_market, dtype=np.int32)
+    pair_rank = np.ascontiguousarray(pair_rank, dtype=np.int32)
+    pair_offsets = np.ascontiguousarray(pair_offsets, dtype=np.int64)
+    digest = hashlib.blake2b(digest_size=16)
+    digest.update(
+        np.asarray(
+            [len(market_keys), len(sid_of_rank), len(pair_market)], np.int64
+        ).tobytes()
+    )
+    digest.update(
+        np.fromiter(map(len, market_keys), np.int64, len(market_keys))
+        .tobytes()
+    )
+    digest.update("".join(market_keys).encode("utf-8"))
+    digest.update(
+        np.fromiter(map(len, sid_of_rank), np.int64, len(sid_of_rank))
+        .tobytes()
+    )
+    digest.update("".join(sid_of_rank).encode("utf-8"))
+    digest.update(pair_market.tobytes())
+    digest.update(pair_rank.tobytes())
+    digest.update(pair_offsets.tobytes())
+    return digest.digest()
+
+
 def columns_from_payloads(payloads, native: "bool | None" = None):
     """Flatten dict payloads to ``(market_keys, source_ids, probs, offsets)``.
 

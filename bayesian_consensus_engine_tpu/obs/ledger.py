@@ -198,6 +198,7 @@ def min_of_repeats(
     band.update(_latency_quantiles(records, leg))
     band.update(_slo_summary(records, leg))
     band.update(_ingest_wait_summary(records, leg))
+    band.update(_intern_summary(records, leg))
     band.update(_peak_mem_summary(records, leg))
     band.update(_hbm_read_summary(records, leg))
     band.update(_recovery_summary(records, leg))
@@ -299,6 +300,22 @@ def _ingest_wait_summary(
     nothing, so the stats table renders a dash.
     """
     return _min_extras_summary(records, leg, "ingest_wait_s")
+
+
+def _intern_summary(
+    records: List[Dict[str, object]], leg: str
+) -> Dict[str, object]:
+    """Best-case pair-interning seconds over a leg's records.
+
+    Records carrying ``extras["intern_s"]`` (the round-15 ingest/stream/
+    serve legs: seconds inside the pair-interning pass — the slice of
+    ingest that cannot overlap onto a pack thread because interning
+    order IS row assignment) fold to their MINIMUM across repeats. The
+    delta-interning path's whole point is driving this column toward
+    zero for drifting topologies; a regression shows up here in the same
+    ``bce-tpu stats``/``--against`` workflow as ingest_wait.
+    """
+    return _min_extras_summary(records, leg, "intern_s")
 
 
 def _latency_quantiles(
@@ -459,7 +476,8 @@ def diff_bands(
                                     "old": old_band, "new": new_band}
         metrics: Dict[str, Dict[str, object]] = {}
         for name in ("p50", "p99", "goodput_within_slo", "ingest_wait_s",
-                     "hbm_peak_bytes", "hbm_read_bytes", "recovery_s"):
+                     "intern_s", "hbm_peak_bytes", "hbm_read_bytes",
+                     "recovery_s"):
             old_value = (old_band or {}).get(name)
             new_value = (new_band or {}).get(name)
             if old_value is not None or new_value is not None:
@@ -494,6 +512,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
         label = {
             "goodput_within_slo": "goodput",
             "ingest_wait_s": "ingest_wait",
+            "intern_s": "intern",
             "hbm_peak_bytes": "peak_mem",
             "hbm_read_bytes": "hbm_read",
             "recovery_s": "recovery",
@@ -512,7 +531,8 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
         trailer = "".join(
             metric_str(entry, name)
             for name in ("p99", "goodput_within_slo", "ingest_wait_s",
-                         "hbm_peak_bytes", "hbm_read_bytes", "recovery_s")
+                         "intern_s", "hbm_peak_bytes", "hbm_read_bytes",
+                         "recovery_s")
         )
         lines.append(
             f"{leg:<34} {band_str(entry['old']):>16} "
@@ -536,12 +556,15 @@ def render(records: List[Dict[str, object]]) -> str:
     (``extras.slo`` — the fraction of offered requests that completed
     within the objective), ``ingest_w`` for legs carrying consumer
     ingest-wait seconds (``extras.ingest_wait_s`` — the stream/serve
-    legs; ≈ 0 means packing fully overlapped behind device compute), and
-    ``peak_mem`` for legs carrying the device allocator's high-water mark
-    (``extras.hbm_peak_bytes``, min across repeats — the memory-diet
-    regression signal), and ``hbm_read`` for legs carrying per-settle
-    bytes-read captures (``extras.hbm_read_bytes`` — the round-14
-    one-pass sweep signal); every other leg shows dashes.
+    legs; ≈ 0 means packing fully overlapped behind device compute),
+    ``intern`` for legs carrying pair-interning seconds
+    (``extras.intern_s`` — the round-15 delta-interning signal: the
+    slice of ingest that cannot overlap because interning order IS row
+    assignment), ``peak_mem`` for legs carrying the device allocator's
+    high-water mark (``extras.hbm_peak_bytes``, min across repeats — the
+    memory-diet regression signal), and ``hbm_read`` for legs carrying
+    per-settle bytes-read captures (``extras.hbm_read_bytes`` — the
+    round-14 one-pass sweep signal); every other leg shows dashes.
     """
     summary = summarize(records)
     if not summary:
@@ -549,8 +572,8 @@ def render(records: List[Dict[str, object]]) -> str:
     lines = [
         f"{'leg':<34} {'n':>3} {'min':>12} {'max':>12} "
         f"{'spread':>7} {'p50':>9} {'p99':>9} {'goodput':>8} "
-        f"{'ingest_w':>9} {'peak_mem':>9} {'hbm_read':>9} {'recovery':>9} "
-        f"{'load(1m)':>12} unit"
+        f"{'ingest_w':>9} {'intern':>9} {'peak_mem':>9} {'hbm_read':>9} "
+        f"{'recovery':>9} {'load(1m)':>12} unit"
     ]
     for leg, band in summary.items():
 
@@ -588,6 +611,7 @@ def render(records: List[Dict[str, object]]) -> str:
             f"{num(band['max']):>12} {spread:>7} "
             f"{num(band.get('p50')):>9} {num(band.get('p99')):>9} "
             f"{goodput_str:>8} {num(band.get('ingest_wait_s')):>9} "
+            f"{num(band.get('intern_s')):>9} "
             f"{peak_str:>9} {read_str:>9} {num(band.get('recovery_s')):>9} "
             f"{load:>12} {band['unit'] or '-'}"
         )

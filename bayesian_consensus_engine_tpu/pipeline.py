@@ -54,6 +54,7 @@ from bayesian_consensus_engine_tpu.core.batch import (
     group_columns,
     pack_markets,
     pair_accumulate,
+    pair_fingerprint,
     topology_fingerprint,
 )
 from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
@@ -278,19 +279,64 @@ class StagedColumnarPlan:
     num_slots: "int | str | None"
     fingerprint: "bytes | None"
     used_native: bool
+    #: ``"full" | "delta" | "auto"`` — how :meth:`bind` interns (round
+    #: 15). ``full`` is the legacy one-pass walk over every pair;
+    #: ``delta``/``auto`` (no behavioural difference today — ``auto`` is
+    #: the forward-compatible spelling callers should use) consult the
+    #: store's epoch-persistent pair table so only the batch's pair-delta
+    #: walks the interner. All modes produce byte-identical plans, row
+    #: assignment, and durability bytes — the mode only moves time.
+    intern_mode: str = "auto"
+    #: pair-set digest (:func:`~.core.batch.pair_fingerprint`) — the
+    #: epoch table's O(1) reuse key; ``None`` skips that tier only.
+    pair_fingerprint: "bytes | None" = None
 
     def bind(self, store) -> SettlementPlan:
-        """Intern this stage's pairs into *store* and assemble the plan."""
-        # Interning by (table, code): no per-pair string list is ever
-        # built — the binding probes rehydrate the handful they sample.
-        rows = store.rows_for_indexed(
-            self.sid_of_rank, self.pair_rank,
-            self.market_keys, self.pair_market,
+        """Intern this stage's pairs into *store* and assemble the plan.
+
+        The interning pass routes by ``intern_mode``: the delta path asks
+        the store to resolve against its epoch-persistent pair table
+        (fingerprint hit → O(1); per-market match → memcmp; remainder →
+        the ordered intern walk), the full path walks every pair. The
+        bound plan carries ``plan.intern_stats`` — ``{"intern_s",
+        "mode", "pairs", "matched_pairs", "interned_pairs",
+        "fingerprint_hit"}`` — which the stream/serve layers fold into
+        the ``intern.delta_pairs``/``intern.full_pairs`` counters and the
+        ``stream.intern_wait_s`` gauge.
+        """
+        import time as _time
+
+        intern_start = _time.perf_counter()
+        use_delta = self.intern_mode != "full" and hasattr(
+            store, "rows_for_pairs_delta"
         )
+        if use_delta:
+            rows, resolve_stats = store.rows_for_pairs_delta(
+                self.sid_of_rank, self.pair_rank,
+                self.market_keys, self.pair_market,
+                self.pair_offsets, self.pair_fingerprint,
+            )
+        else:
+            rows = store.rows_for_indexed(
+                self.sid_of_rank, self.pair_rank,
+                self.market_keys, self.pair_market,
+            )
+            resolve_stats = {
+                "pairs": len(self.pair_market),
+                "matched_pairs": 0,
+                "interned_pairs": len(self.pair_market),
+                "fingerprint_hit": False,
+            }
+        intern_stats = {
+            "intern_s": _time.perf_counter() - intern_start,
+            "mode": "delta" if use_delta else "full",
+            **resolve_stats,
+        }
+        _count_intern(intern_stats)
         _count_pack(self.used_native)
         sid_of_rank, pair_rank = self.sid_of_rank, self.pair_rank
         market_keys, pair_market = self.market_keys, self.pair_market
-        return _assemble_plan(
+        plan = _assemble_plan(
             market_keys,
             rows,
             pair_market,
@@ -303,6 +349,8 @@ class StagedColumnarPlan:
             signal_pairs=self.signal_pairs,
             fingerprint=self.fingerprint,
         )
+        object.__setattr__(plan, "intern_stats", intern_stats)
+        return plan
 
 
 def _count_pack(used_native: bool) -> None:
@@ -313,6 +361,21 @@ def _count_pack(used_native: bool) -> None:
     registry.counter(name).inc()
 
 
+def _count_intern(stats: dict) -> None:
+    """intern.delta_pairs / intern.full_pairs — how many pairs this bind
+    actually walked through the interner, split by route (the delta
+    path's count IS the pair-delta; matched pairs never touch the
+    interner). Counted here per LY303 — the store produces the stats,
+    pipeline/serve own the instrumentation. No-ops unless obs enabled a
+    registry."""
+    registry = metrics_registry()
+    name = (
+        "intern.delta_pairs" if stats["mode"] == "delta"
+        else "intern.full_pairs"
+    )
+    registry.counter(name).inc(int(stats["interned_pairs"]))
+
+
 def stage_settlement_plan_columnar(
     market_keys: Sequence[str],
     source_ids: "Sequence[str] | SourceCodes",
@@ -321,6 +384,7 @@ def stage_settlement_plan_columnar(
     num_slots: "int | str | None" = None,
     fingerprint: "bool | bytes" = False,
     native: Optional[bool] = None,
+    intern_mode: str = "auto",
 ) -> StagedColumnarPlan:
     """Validate + group one columnar batch without touching any store.
 
@@ -330,7 +394,19 @@ def stage_settlement_plan_columnar(
     into the native grouping pass). ``native`` forces the C grouping
     (True), the numpy twin (False), or auto-detects (None); outputs are
     bit-identical either way.
+
+    ``intern_mode`` routes :meth:`StagedColumnarPlan.bind`'s interning:
+    ``"auto"``/``"delta"`` consult the store's epoch-persistent pair
+    table so a drifted batch interns only its pair-delta (the pair-set
+    digest is computed HERE, on the staging thread, so the bind pays
+    only the resolve); ``"full"`` is the legacy every-pair walk. All
+    modes are byte-identical downstream.
     """
+    if intern_mode not in ("full", "delta", "auto"):
+        raise ValueError(
+            f'intern_mode={intern_mode!r}: expected "full", "delta" or '
+            '"auto"'
+        )
     market_keys = list(market_keys)
     if len(set(market_keys)) != len(market_keys):
         raise ValueError("duplicate market ids in one settlement plan")
@@ -384,6 +460,13 @@ def stage_settlement_plan_columnar(
 
     if fingerprint is True:
         fingerprint = topology_fingerprint(market_keys, source_ids, offsets)
+    pair_fp = (
+        pair_fingerprint(
+            market_keys, sid_of_rank, pair_market, pair_rank, pair_offsets
+        )
+        if intern_mode != "full"
+        else None
+    )
     return StagedColumnarPlan(
         market_keys=market_keys,
         sid_of_rank=sid_of_rank,
@@ -396,6 +479,8 @@ def stage_settlement_plan_columnar(
         num_slots=num_slots,
         fingerprint=fingerprint or None,
         used_native=used_native,
+        intern_mode=intern_mode,
+        pair_fingerprint=pair_fp,
     )
 
 
@@ -408,6 +493,7 @@ def build_settlement_plan_columnar(
     num_slots: "int | str | None" = None,
     fingerprint: "bool | bytes" = False,
     native: Optional[bool] = None,
+    intern_mode: str = "auto",
 ) -> SettlementPlan:
     """Vectorised twin of :func:`build_settlement_plan` for columnar input.
 
@@ -433,7 +519,11 @@ def build_settlement_plan_columnar(
 
     ``num_slots`` pins the block's slot height K and ``fingerprint``
     stamps the topology digest (see :func:`build_settlement_plan`);
-    ``native`` forces/forbids the C grouping pass. The build is the
+    ``native`` forces/forbids the C grouping pass; ``intern_mode``
+    routes the pair-interning pass through the store's epoch-persistent
+    pair table (``"auto"``/``"delta"``) or the legacy every-pair walk
+    (``"full"``) — byte-identical either way, see
+    :func:`stage_settlement_plan_columnar`. The build is the
     composition ``stage_settlement_plan_columnar(...).bind(store)`` —
     callers that need the store-free half on its own schedule (the
     serving front end's pack thread) use the two halves directly.
@@ -441,6 +531,7 @@ def build_settlement_plan_columnar(
     return stage_settlement_plan_columnar(
         market_keys, source_ids, probabilities, offsets,
         num_slots=num_slots, fingerprint=fingerprint, native=native,
+        intern_mode=intern_mode,
     ).bind(store)
 
 
@@ -2035,6 +2126,13 @@ class PlanPrefetcher:
     columnar path, which is bit-identical to the dict path by contract.
     Refreshed plans settle bit-identically to rebuilt ones (pinned by
     tests/test_overlap.py).
+
+    ``intern_mode`` (``"auto"`` default) routes every columnar build's
+    pair interning through the store's epoch-persistent pair table, so
+    the batches that DON'T hit the topology fingerprint — drifted
+    topologies, the very case ``reuse_plans`` cannot help — intern only
+    their pair-delta; ``"full"`` restores the legacy every-pair walk.
+    Byte-identical plans either way.
     """
 
     def __init__(
@@ -2046,6 +2144,7 @@ class PlanPrefetcher:
         native: Optional[bool] = None,
         depth: int = 1,
         reuse_plans: bool = False,
+        intern_mode: str = "auto",
     ) -> None:
         if depth < 1:
             raise ValueError("depth must be >= 1")
@@ -2061,6 +2160,7 @@ class PlanPrefetcher:
                     return build_settlement_plan_columnar(
                         store, keys, source_ids, probabilities, offsets,
                         num_slots=num_slots, native=native,
+                        intern_mode=intern_mode,
                     )
                 return build_settlement_plan(
                     store, batch, native=native, num_slots=num_slots
@@ -2085,6 +2185,7 @@ class PlanPrefetcher:
                 plan = build_settlement_plan_columnar(
                     store, keys, source_ids, probabilities, offsets,
                     num_slots=num_slots, fingerprint=digest, native=native,
+                    intern_mode=intern_mode,
                 )
             last_plan[0] = plan
             return plan
@@ -2199,6 +2300,7 @@ def settle_stream(
     reuse_plans: bool = False,
     sync_checkpoints: bool = False,
     resident_session: bool = True,
+    intern_mode: str = "auto",
 ):
     """The streamed settle-and-checkpoint service loop, fully overlapped.
 
@@ -2258,7 +2360,11 @@ def settle_stream(
 
     *stats*, if given, is a mutable list the service appends one dict per
     batch to: ``{"batch", "markets", "plan_wait_s", "settle_dispatch_s",
-    "checkpoint_s", "plan_reused"}``. ``plan_wait_s`` is how long the consumer waited on
+    "checkpoint_s", "plan_reused", "intern_s", "interned_pairs"}``
+    (``intern_s``/``interned_pairs`` are the batch's pair-interning
+    seconds and the pairs that actually walked the interner — zero on a
+    plan-reuse refresh, the pair-DELTA under ``intern_mode="auto"``).
+    ``plan_wait_s`` is how long the consumer waited on
     the prefetch thread (near zero once the pipeline fills; large values
     mean ingest is the bottleneck). ``settle_dispatch_s`` is the HOST
     cost of dispatching the settle — deliberately unfenced: the kernel
@@ -2474,6 +2580,14 @@ def settle_stream(
     # the steady state now that packing is native + overlapped).
     ingest_wait_gauge = registry.gauge("stream.ingest_wait_s")
     total_plan_wait = 0.0
+    # Cumulative seconds the stream's plan builds spent in the pair-
+    # interning pass (plan.intern_stats, measured where the intern runs —
+    # the prefetch worker). Refreshed plans never intern, so in the
+    # reuse_plans steady state this stays flat; under drift it is the
+    # number the delta path shrinks (the e2e_ingest drift act's
+    # acceptance, reported by e2e_stream_resident next to ingest_wait).
+    intern_wait_gauge = registry.gauge("stream.intern_wait_s")
+    total_intern_wait = 0.0
 
     driver = SessionDriver(
         store,
@@ -2497,6 +2611,7 @@ def settle_stream(
             num_slots=num_slots,
             native=native,
             reuse_plans=reuse_plans,
+            intern_mode=intern_mode,
         ) as plans:
             plan_iter = iter(plans)
             while True:
@@ -2510,6 +2625,10 @@ def settle_stream(
                 plan_wait_s = _time.perf_counter() - wait_start
                 total_plan_wait += plan_wait_s
                 ingest_wait_gauge.set(total_plan_wait)
+                intern_stats = getattr(plan, "intern_stats", None)
+                intern_s = intern_stats["intern_s"] if intern_stats else 0.0
+                total_intern_wait += intern_s
+                intern_wait_gauge.set(total_intern_wait)
                 index += 1
                 outcomes = outcome_queue.popleft()
                 batch_now = None if now is None else now + index
@@ -2559,6 +2678,11 @@ def settle_stream(
                                 "checkpoint_s": None,
                                 "plan_reused": plan_reused,
                                 "session_adopt": session_adopt,
+                                "intern_s": intern_s,
+                                "interned_pairs": (
+                                    intern_stats["interned_pairs"]
+                                    if intern_stats else 0
+                                ),
                             }
                         )
                     # Rolling durability rides the driver: journal mode

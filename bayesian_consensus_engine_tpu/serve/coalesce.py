@@ -326,6 +326,7 @@ class ConsensusService:
         record_batches: bool = False,
         analytics=None,
         target_p99_s: Optional[float] = None,
+        intern_mode: str = "auto",
     ) -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -351,7 +352,9 @@ class ConsensusService:
         self._max_batch = max_batch
         self._max_delay_s = max_delay_s
         self._record_batches = record_batches
-        self._plans = PlanCache(store, num_slots=num_slots)
+        self._plans = PlanCache(
+            store, num_slots=num_slots, intern_mode=intern_mode
+        )
         self._driver = SessionDriver(
             store,
             steps=steps,
@@ -433,6 +436,13 @@ class ConsensusService:
         #: finishing) plan builds — the served path's ingest wait.
         self._ingest_wait_s = 0.0
         self._ingest_wait_gauge = registry.gauge("serve.ingest_wait_s")
+        #: Dispatch-worker seconds inside the pair-interning pass — the
+        #: component of the ingest wait the epoch-persistent pair table
+        #: shrinks (zero on fingerprint hits; the pair-delta's walk on a
+        #: drifted miss). Same gauge name as the stream side so ledgers
+        #: read one number (LY303: wired here, not in state/).
+        self._intern_wait_s = 0.0
+        self._intern_wait_gauge = registry.gauge("stream.intern_wait_s")
 
     # -- submission (event-loop thread) --------------------------------------
 
@@ -453,6 +463,16 @@ class ConsensusService:
         gauge). ≈ 0 in the steady state: staging overlaps the previous
         batch's device window on the pack thread."""
         return self._ingest_wait_s
+
+    @property
+    def intern_wait_s(self) -> float:
+        """Cumulative dispatch-worker seconds inside the pair-interning
+        pass (the ``stream.intern_wait_s`` gauge) — the slice of
+        :attr:`ingest_wait_s` that CANNOT overlap onto the pack thread,
+        because interning order decides row assignment and journal
+        epoch membership. The epoch-persistent pair table is what keeps
+        it near zero under drift (round 15)."""
+        return self._intern_wait_s
 
     def submit(self, market_id: str, signals: Sequence[Signal],
                outcome: bool) -> "asyncio.Future[ServeResult]":
@@ -757,6 +777,10 @@ class ConsensusService:
                         bound.set()
                 self._ingest_wait_s += _time.perf_counter() - t_pack
                 self._ingest_wait_gauge.set(self._ingest_wait_s)
+                intern_stats = getattr(plan, "intern_stats", None)
+                if intern_stats is not None:
+                    self._intern_wait_s += intern_stats["intern_s"]
+                    self._intern_wait_gauge.set(self._intern_wait_s)
                 batch_now = (
                     None if self._now is None else self._now + batch_index
                 )

@@ -89,11 +89,19 @@ class PlanCache:
     journal epoch a new pair's table row lands in) stays a deterministic
     function of the batch sequence. ``bind(stage(...)) ≡ plan_for(...)``
     bit-for-bit.
+
+    ``intern_mode`` (``"auto"`` default) routes a miss's interning pass
+    through the store's epoch-persistent pair table, so a drifted batch
+    interns only its pair-delta on the dispatch thread; ``"full"`` is
+    the legacy every-pair walk. Byte-identical plans and durability
+    bytes either way — the mode only moves time (round 15).
     """
 
-    def __init__(self, store, num_slots: "int | str | None" = "bucket"):
+    def __init__(self, store, num_slots: "int | str | None" = "bucket",
+                 intern_mode: str = "auto"):
         self._store = store
         self._num_slots = num_slots
+        self._intern_mode = intern_mode
         self._last = None
 
     @property
@@ -124,6 +132,7 @@ class PlanCache:
         return stage_settlement_plan_columnar(
             market_keys, source_ids, probabilities, offsets,
             num_slots=self._num_slots, fingerprint=digest,
+            intern_mode=self._intern_mode,
         )
 
     def bind(self, staged):

@@ -55,6 +55,7 @@ from bayesian_consensus_engine_tpu.state.update_math import (
     apply_outcome,
     apply_outcome_batch,
 )
+from bayesian_consensus_engine_tpu.utils import interning as _interning
 from bayesian_consensus_engine_tpu.utils.interning import make_pair_interner
 from bayesian_consensus_engine_tpu.utils.timeconv import (
     NEVER,
@@ -252,6 +253,61 @@ class JournalFlushHandle:
         return self._rows
 
 
+class _PairEpochTable:
+    """One epoch of resolved pair interning — the delta-interning cache.
+
+    Holds the LAST bound batch's pair columns (market table in payload
+    order, code-point-sorted source table, grouped (rank, market) pair
+    arrays with CSR offsets) plus the store rows that batch resolved to,
+    and the batch's pair-set fingerprint
+    (:func:`~.core.batch.pair_fingerprint`). A later batch interns only
+    its delta against this table: an equal fingerprint reuses ``rows``
+    outright (O(1)); otherwise unchanged markets match per-market
+    (:func:`~.utils.interning.delta_match_rows`) and only the mismatched
+    markets' pairs walk the interner. Every claim in here was WITNESSED
+    by a real intern pass against this store, and the store's interner is
+    append-only, so a cached (pair → row) mapping can never go stale
+    within one store instance — the recovery paths
+    (``absorb_replayed_rows`` / journal replay) still drop the table
+    outright, so a post-recovery resolve re-witnesses everything.
+    """
+
+    __slots__ = (
+        "fingerprint", "market_keys", "src_table", "pair_rank",
+        "pair_market", "pair_offsets", "rows", "_src_index", "_mkt_index",
+    )
+
+    def __init__(self, fingerprint, market_keys, src_table, pair_rank,
+                 pair_market, pair_offsets, rows) -> None:
+        self.fingerprint = fingerprint
+        self.market_keys = market_keys
+        self.src_table = src_table
+        self.pair_rank = pair_rank
+        self.pair_market = pair_market
+        self.pair_offsets = pair_offsets
+        self.rows = rows
+        self._src_index = None
+        self._mkt_index = None
+
+    def src_index(self) -> dict:
+        """source id → rank in this epoch's table (built lazily: the
+        same-table fast path never needs it)."""
+        if self._src_index is None:
+            self._src_index = {
+                s: i for i, s in enumerate(self.src_table)
+            }
+        return self._src_index
+
+    def market_index(self) -> dict:
+        """market id → position in this epoch's market table (lazy — a
+        drifting stream with a stable market list never builds it)."""
+        if self._mkt_index is None:
+            self._mkt_index = {
+                k: i for i, k in enumerate(self.market_keys)
+            }
+        return self._mkt_index
+
+
 class DeviceReliabilityState(NamedTuple):
     """Pytree of device arrays — the HBM-resident state the kernels consume.
 
@@ -319,6 +375,11 @@ class TensorReliabilityStore:
         self._host_lock = threading.RLock()
         self._flush_inflight: Optional[FlushHandle] = None
         self._journal_inflight: Optional[JournalFlushHandle] = None
+        # Epoch-persistent pair table (round 15): the last bound batch's
+        # resolved pair columns + rows, consulted by rows_for_pairs_delta
+        # so a drifted batch interns only its pair-delta. Dropped by the
+        # recovery paths (absorb_replayed_rows / journal replay).
+        self._pair_epoch: Optional[_PairEpochTable] = None
 
     # -- row management ------------------------------------------------------
 
@@ -701,16 +762,27 @@ class TensorReliabilityStore:
 
     @_locked
     def rows_for_pairs(
-        self, pairs: Sequence[tuple[str, str]], allocate: bool = True
+        self,
+        pairs: Sequence[tuple[str, str]],
+        allocate: bool = True,
+        known_rows=None,
     ) -> np.ndarray:
         """Intern pairs → int32 rows (−1 for unknown when not allocating).
 
         Runs as one batch pass through the interner (a single C call with
         the native extension); newly allocated rows get sidecar slots but
         are NOT marked existing — same contract as :meth:`_row_for`.
+
+        ``known_rows`` is the delta-interning fast path: an int32 array
+        (−1 = unknown) of rows the caller already holds a witness for —
+        e.g. the epoch-persistent pair table's per-market matches. Only
+        the −1 positions walk the interner, in position order, so row
+        assignment equals the full pass's; known positions are trusted
+        verbatim (they must be this store's rows). Requires ``allocate``.
         """
         return self.rows_for_arrays(
-            [p[0] for p in pairs], [p[1] for p in pairs], allocate=allocate
+            [p[0] for p in pairs], [p[1] for p in pairs],
+            allocate=allocate, known_rows=known_rows,
         )
 
     @_locked
@@ -719,14 +791,39 @@ class TensorReliabilityStore:
         sources: Sequence[str],
         markets: Sequence[str],
         allocate: bool = True,
+        known_rows=None,
     ) -> np.ndarray:
         """Column-form twin of :meth:`rows_for_pairs`.
 
         Takes the source and market id columns separately so bulk callers
         (the settlement planner packs hundreds of thousands of pairs) feed
         the interner's C pass directly without materialising a tuple per
-        pair first.
+        pair first. ``known_rows`` as in :meth:`rows_for_pairs`.
         """
+        if known_rows is not None:
+            if not allocate:
+                raise ValueError(
+                    "known_rows= is an interning fast path; it cannot "
+                    "combine with allocate=False"
+                )
+            known = np.array(known_rows, dtype=np.int32, copy=True)
+            if len(known) != len(sources):
+                raise ValueError(
+                    f"known_rows has {len(known)} entries for "
+                    f"{len(sources)} pairs"
+                )
+            miss = np.flatnonzero(known < 0)
+            if miss.size:
+                miss_list = miss.tolist()
+                try:
+                    interned = self._pairs.intern_arrays(
+                        [sources[i] for i in miss_list],
+                        [markets[i] for i in miss_list],
+                    )
+                finally:
+                    self._resync_sidecars()
+                known[miss] = interned
+            return known
         if not allocate:
             return self._pairs.lookup_arrays(sources, markets)
         try:
@@ -765,8 +862,37 @@ class TensorReliabilityStore:
         per-pair string traffic. Falls back to materialising the columns
         when the C extension is absent. Always allocates.
         """
+        return self._intern_indexed(
+            source_table, source_codes, market_table, market_codes,
+            sharded=False,
+        )
+
+    def _intern_indexed(
+        self, source_table, source_codes, market_table, market_codes,
+        sharded: bool = True,
+    ) -> np.ndarray:
+        """One interning pass over (table, code) pair columns, in batch
+        order (caller holds the lock). ``sharded=True`` lets the pass
+        split its probes across worker threads when that pays: the miss
+        set is large AND the table already holds a comparable key count
+        (probing an essentially-empty table just re-walks what the
+        serial insert would; measured a wash at best). The commit stays
+        serial and ordered either way — rows are identical bit for bit
+        (tests/test_internmap.py, tests/test_interning_delta.py).
+        """
         interner = self._pairs
+        count = len(source_codes)
         try:
+            if (
+                sharded
+                and count >= _interning.SHARD_MIN_PAIRS
+                and len(interner) * 2 >= count
+                and _interning.probe_supported(interner)
+                and _interning.intern_workers() > 1
+            ):
+                return interner.intern_indexed_sharded(
+                    source_table, source_codes, market_table, market_codes
+                )
             if hasattr(interner, "intern_arrays_indexed"):
                 return interner.intern_arrays_indexed(
                     source_table, source_codes, market_table, market_codes
@@ -777,6 +903,110 @@ class TensorReliabilityStore:
             )
         finally:
             self._resync_sidecars()
+
+    @_locked
+    def rows_for_pairs_delta(
+        self,
+        source_table: Sequence[str],
+        source_codes: np.ndarray,
+        market_table: Sequence[str],
+        market_codes: np.ndarray,
+        pair_offsets: np.ndarray,
+        fingerprint: "bytes | None" = None,
+    ) -> "tuple[np.ndarray, dict]":
+        """Delta-interning twin of :meth:`rows_for_indexed` — consult the
+        epoch-persistent pair table so only the batch's pair-DELTA walks
+        the interner. Returns ``(rows, stats)``.
+
+        Three tiers, cheapest first:
+
+        1. *fingerprint hit* — the batch's pair-set fingerprint
+           (:func:`~.core.batch.pair_fingerprint`) equals the table's:
+           the previous epoch's resolved rows apply verbatim, O(1).
+        2. *per-market match* — unchanged markets (same id, same ordered
+           source set) copy their rows from the table at memcmp speed
+           (:func:`~.utils.interning.delta_match_rows`); only mismatched
+           markets' pairs remain.
+        3. *miss intern* — the remaining pairs walk the interner IN
+           BATCH ORDER (sharded probe + serial ordered commit when the
+           miss set is large and mostly re-probes known keys).
+
+        Byte-parity contract: because every matched row was witnessed by
+        a real intern against this store's append-only interner, and
+        misses intern in ascending batch position, the returned rows —
+        and therefore row assignment, journal epoch membership, and
+        SQLite bytes downstream — are identical to one full
+        :meth:`rows_for_indexed` pass over the same columns (pinned by
+        tests/test_interning_delta.py across stable / drifting /
+        reordered / shrinking / growing workloads, native and
+        forced-fallback). The resolve then becomes the new epoch table.
+
+        ``stats``: ``pairs`` (batch total), ``matched_pairs`` (served
+        from the table), ``interned_pairs`` (walked the interner),
+        ``fingerprint_hit``. The caller owns observability (LY303 —
+        state stays a stats producer).
+        """
+        source_codes = np.ascontiguousarray(source_codes, dtype=np.int32)
+        market_codes = np.ascontiguousarray(market_codes, dtype=np.int32)
+        pair_offsets = np.ascontiguousarray(pair_offsets, dtype=np.int64)
+        total = len(source_codes)
+        cache = self._pair_epoch
+        if (
+            cache is not None
+            and fingerprint is not None
+            and cache.fingerprint == fingerprint
+        ):
+            return cache.rows, {
+                "pairs": total,
+                "matched_pairs": total,
+                "interned_pairs": 0,
+                "fingerprint_hit": True,
+            }
+        if cache is None:
+            rows = self._intern_indexed(
+                source_table, source_codes, market_table, market_codes
+            )
+            rows = np.asarray(rows)
+        else:
+            if market_table == cache.market_keys:
+                prev_of = None
+            else:
+                index = cache.market_index()
+                prev_of = np.fromiter(
+                    (index.get(k, -1) for k in market_table),
+                    np.int64, len(market_table),
+                )
+            if source_table == cache.src_table:
+                rank_map = None
+            else:
+                index = cache.src_index()
+                rank_map = np.fromiter(
+                    (index.get(s, -1) for s in source_table),
+                    np.int32, len(source_table),
+                )
+            rows = _interning.delta_match_rows(
+                rank_map, source_codes, pair_offsets,
+                cache.pair_rank, cache.pair_offsets, prev_of, cache.rows,
+            )
+            miss = np.flatnonzero(rows < 0)
+            if miss.size:
+                rows[miss] = self._intern_indexed(
+                    source_table, source_codes[miss],
+                    market_table, market_codes[miss],
+                )
+        interned = total if cache is None else int(miss.size)
+        rows = np.ascontiguousarray(rows, dtype=np.int32)
+        rows.setflags(write=False)
+        self._pair_epoch = _PairEpochTable(
+            fingerprint, market_table, source_table,
+            source_codes, market_codes, pair_offsets, rows,
+        )
+        return rows, {
+            "pairs": total,
+            "matched_pairs": total - interned,
+            "interned_pairs": interned,
+            "fingerprint_hit": False,
+        }
 
     @_locked
     def batch_get_reliability(
@@ -1833,6 +2063,13 @@ class TensorReliabilityStore:
                     f"row {int(rows.max())} is beyond this store's "
                     f"{len(self._pairs)} interned pairs"
                 )
+            # Recovery invalidates the epoch-persistent pair table: the
+            # adopted rows were interned outside the bind trace, so the
+            # next delta resolve must re-witness against the post-
+            # adoption interner — a stale table must MISS, never serve
+            # rows the recovery re-shaped (tests/test_interning_delta.py
+            # pins the post-adopt byte parity).
+            self._pair_epoch = None
             self._ensure_capacity(max(len(self._pairs), 1))
             self._resync_sidecars()
             self._rel[rows] = rel
@@ -1861,6 +2098,9 @@ class TensorReliabilityStore:
                     "journal pairs do not extend the store contiguously "
                     f"(rows {before}..{used_after} expected)"
                 )
+            # Same recovery rule as absorb_replayed_rows: replayed epochs
+            # intern outside the bind trace — drop the pair table.
+            self._pair_epoch = None
             self._ensure_capacity(max(used_after, 1))
             self._resync_sidecars()
             self._rel[idx] = rel

@@ -252,6 +252,110 @@ class NativePairInterner:
             raise RuntimeError("native internmap extension not built")
         return module.flush_snapshot(str(db_path), blob)
 
+    def probe_pairs_sharded(
+        self,
+        source_table: Sequence[str],
+        source_codes: np.ndarray,
+        market_table: Sequence[str],
+        market_codes: np.ndarray,
+        workers: "int | None" = None,
+    ):
+        """Parallel lookup-only pass over (table, code) pair columns.
+
+        Returns ``(rows, hashes, slots, capacity_token)``: ``rows`` holds
+        the existing store row per pair or −1, ``hashes``/``slots`` the
+        per-miss hash and first-empty-slot the commit resumes from, and
+        the capacity token pins the table geometry the probe saw. The
+        probe shards ``[0, n)`` across *workers* threads — the C loop
+        releases the GIL, so the overlap is real — and the map is only
+        READ: nothing about the table changes until
+        :meth:`commit_probed`. The caller must prevent interleaved
+        interning between the two halves (the tensor store's host lock).
+        """
+        if not hasattr(self._map, "probe_pairs_indexed"):
+            raise RuntimeError(
+                "internmap extension predates probe_pairs_indexed; "
+                "rebuild with python native/build.py"
+            )
+        source_codes = np.ascontiguousarray(source_codes, dtype=np.int32)
+        market_codes = np.ascontiguousarray(market_codes, dtype=np.int32)
+        n = len(source_codes)
+        rows = np.empty(n, dtype=np.int32)
+        hashes = np.empty(n, dtype=np.uint64)
+        slots = np.empty(n, dtype=np.int64)
+        capacity = self._map.reserve_pairs(n)
+        count = max(1, min(workers or intern_workers(), n or 1))
+        if count == 1 or n < 2:
+            self._map.probe_pairs_indexed(
+                source_table, source_codes, market_table, market_codes,
+                rows, hashes, slots, 0, n,
+            )
+            return rows, hashes, slots, capacity
+        import concurrent.futures
+
+        bounds = np.linspace(0, n, count + 1).astype(np.int64)
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=count, thread_name_prefix="bce-intern-probe"
+        ) as pool:
+            futures = [
+                pool.submit(
+                    self._map.probe_pairs_indexed,
+                    source_table, source_codes, market_table, market_codes,
+                    rows, hashes, slots, int(bounds[i]), int(bounds[i + 1]),
+                )
+                for i in range(count)
+            ]
+            for future in futures:
+                future.result()
+        return rows, hashes, slots, capacity
+
+    def commit_probed(
+        self,
+        source_table: Sequence[str],
+        source_codes: np.ndarray,
+        market_table: Sequence[str],
+        market_codes: np.ndarray,
+        rows: np.ndarray,
+        hashes: np.ndarray,
+        slots: np.ndarray,
+        capacity_token: int,
+    ) -> int:
+        """Serial deterministic commit of a probe's miss set, in batch
+        order — the ONE place row numbers are assigned, so the sharded
+        pass's assignment equals the serial pass's key for key. Fills the
+        probed −1 entries of *rows* in place; returns the miss count."""
+        return self._map.commit_probed(
+            source_table,
+            np.ascontiguousarray(source_codes, dtype=np.int32),
+            market_table,
+            np.ascontiguousarray(market_codes, dtype=np.int32),
+            rows, hashes, slots, int(capacity_token),
+        )
+
+    def intern_indexed_sharded(
+        self,
+        source_table: Sequence[str],
+        source_codes: np.ndarray,
+        market_table: Sequence[str],
+        market_codes: np.ndarray,
+        workers: "int | None" = None,
+    ) -> np.ndarray:
+        """Probe (parallel) + commit (serial, batch order) in one call.
+
+        Byte-identical rows to :meth:`intern_arrays_indexed` on the same
+        columns — pinned by tests/test_internmap.py — with the hash and
+        chain-walk halves of every pair paid on worker threads.
+        """
+        rows, hashes, slots, capacity = self.probe_pairs_sharded(
+            source_table, source_codes, market_table, market_codes,
+            workers=workers,
+        )
+        self.commit_probed(
+            source_table, source_codes, market_table, market_codes,
+            rows, hashes, slots, capacity,
+        )
+        return rows
+
     def lookup_arrays(
         self, sources: Sequence[str], markets: Sequence[str]
     ) -> np.ndarray:
@@ -274,6 +378,153 @@ def make_pair_interner():
     if module is None:
         return IdInterner()
     return NativePairInterner(module)
+
+
+# -- sharded intern pass (round 15) -----------------------------------------
+#
+# The delta-interning miss set splits across worker threads for the PROBE
+# half (hash + chain walk, GIL released in C), then commits serially in
+# batch order — so row assignment stays first-occurrence-in-batch, byte-
+# identical to one serial intern pass. The probe records each miss's hash
+# and first-empty slot, so the commit resumes each insert from its probed
+# position instead of re-walking the chain.
+
+#: Miss sets below this size always intern serially — thread spin-up and
+#: the probe's output traffic cost more than they hide. Tests lower it to
+#: force the sharded route at toy sizes.
+SHARD_MIN_PAIRS = 1 << 18
+
+
+def intern_workers() -> int:
+    """Worker threads for the sharded probe (``BCE_INTERN_WORKERS``
+    overrides; default = the machine's cores capped at 4; 1 disables
+    sharding). The commit stays serial regardless — determinism is the
+    commit's job, the workers only probe."""
+    import os
+
+    value = os.environ.get("BCE_INTERN_WORKERS", "")
+    if value:
+        try:
+            return max(1, int(value))
+        except ValueError:
+            pass
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+def probe_supported(interner) -> bool:
+    """Whether *interner* carries the native probe/commit entry points
+    (a NativePairInterner over a current-build extension — an older
+    ``internmap.so`` degrades to the serial pass instead of erroring)."""
+    return hasattr(interner, "probe_pairs_sharded") and hasattr(
+        getattr(interner, "_map", None), "probe_pairs_indexed"
+    )
+
+
+def delta_match_rows(
+    rank_map,
+    pair_rank_new: np.ndarray,
+    pair_offsets_new: np.ndarray,
+    pair_rank_old: np.ndarray,
+    pair_offsets_old: np.ndarray,
+    prev_of,
+    rows_old: np.ndarray,
+    native: "bool | None" = None,
+) -> np.ndarray:
+    """Per-market match against an epoch-persistent pair table.
+
+    Market ``m`` of the new batch matches old market ``prev_of[m]``
+    (``None`` ⇒ identity) iff the pair counts are equal and every pair's
+    source rank maps elementwise (``rank_map`` translates new ranks to
+    old; ``None`` ⇒ identical source tables, raw comparison). Returns
+    int32 rows: matched positions copy ``rows_old``, everything else is
+    −1 — the miss set the interner then walks. The C pass
+    (internmap.delta_match_rows) and the numpy twin are identical
+    output-for-output; ``native=None`` auto-detects. Caller guarantees
+    ``pair_rank_new`` values index ``rank_map`` when one is given (the
+    staged plan's ranks always do).
+    """
+    pair_rank_new = np.ascontiguousarray(pair_rank_new, dtype=np.int32)
+    pair_offsets_new = np.ascontiguousarray(pair_offsets_new, dtype=np.int64)
+    pair_rank_old = np.ascontiguousarray(pair_rank_old, dtype=np.int32)
+    pair_offsets_old = np.ascontiguousarray(pair_offsets_old, dtype=np.int64)
+    rows_old = np.ascontiguousarray(rows_old, dtype=np.int32)
+    if rank_map is not None:
+        rank_map = np.ascontiguousarray(rank_map, dtype=np.int32)
+    if prev_of is not None:
+        prev_of = np.ascontiguousarray(prev_of, dtype=np.int64)
+
+    module = _load_internmap() if native is None else (
+        _load_internmap() if native else None
+    )
+    if native and module is None:
+        raise RuntimeError(
+            "native internmap requested but not built; "
+            "run python native/build.py"
+        )
+    if module is not None and hasattr(module, "delta_match_rows"):
+        rows_out = np.empty(len(pair_rank_new), dtype=np.int32)
+        module.delta_match_rows(
+            rank_map, pair_rank_new, pair_offsets_new,
+            pair_rank_old, pair_offsets_old, prev_of, rows_old, rows_out,
+        )
+        return rows_out
+
+    # Numpy twin — identical output. Alignment is per-market shifts: a
+    # candidate market's pairs sit at new positions + (old_lo - new_lo).
+    m_new = len(pair_offsets_new) - 1
+    p_new = len(pair_rank_new)
+    counts_new = np.diff(pair_offsets_new)
+    if int(counts_new.sum()) != p_new or (
+        m_new and (counts_new < 0).any()
+    ):
+        raise ValueError("delta_match_rows: malformed new offsets")
+    prev_arr = (
+        np.arange(m_new, dtype=np.int64) if prev_of is None else prev_of
+    )
+    m_old = len(pair_offsets_old) - 1
+    if prev_of is None and m_new > m_old:
+        raise ValueError("delta_match_rows: table sizes do not line up")
+    if not p_new:
+        return np.empty(0, dtype=np.int32)
+    if m_old == 0:
+        # An empty epoch table matches nothing — the C pass's all-miss;
+        # guarded HERE because the safe_prev gather below would index
+        # the empty counts_old array.
+        return np.full(p_new, -1, dtype=np.int32)
+    valid = (prev_arr >= 0) & (prev_arr < m_old)
+    safe_prev = np.where(valid, prev_arr, 0)
+    counts_old = (pair_offsets_old[1:] - pair_offsets_old[:-1])[safe_prev]
+    cand = valid & (counts_new == counts_old)
+    shift = np.where(
+        cand, pair_offsets_old[:-1][safe_prev] - pair_offsets_new[:-1], 0
+    )
+    cand_rep = np.repeat(cand, counts_new)
+    prev_idx = np.arange(p_new, dtype=np.int64) + np.repeat(
+        shift, counts_new
+    )
+    prev_idx = np.where(cand_rep, prev_idx, 0)
+    mapped = (
+        rank_map[pair_rank_new] if rank_map is not None else pair_rank_new
+    )
+    ok = cand_rep & (mapped == pair_rank_old[prev_idx])
+    # Per-market AND, reduced over the NON-EMPTY markets' segment starts
+    # only: zero-pair markets must not contribute reduceat boundaries —
+    # a trailing empty market's start equals p_new (out of range), and
+    # clamping it would SPLIT the previous market's segment, dropping
+    # its final pair from the match check. Consecutive non-empty starts
+    # delimit exactly one market's pairs (empty markets between them
+    # contribute none), and an empty market trivially matches whenever
+    # it is a candidate (0 == 0 pairs), gating no output either way.
+    nonempty = counts_new > 0
+    seg = pair_offsets_new[:-1][nonempty]
+    market_ok = np.ones(m_new, dtype=bool)
+    if seg.size:
+        market_ok[nonempty] = np.logical_and.reduceat(ok, seg)
+    matched = cand & market_ok
+    matched_rep = np.repeat(matched, counts_new)
+    return np.where(
+        matched_rep, rows_old[prev_idx], np.int32(-1)
+    ).astype(np.int32)
 
 
 def pack_strings_native(values: List[str]) -> "bytes | None":

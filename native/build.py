@@ -78,5 +78,32 @@ if __name__ == "__main__":
     )
     assert m.lookup("gone") == -1 and m.id_of(2) == ("s", "mkt")
 
+    # Delta-interning probe/commit round trip (round 15): probe finds the
+    # existing pair, the miss commits to the next row in batch order.
+    import numpy as np
+
+    codes = np.asarray([0, 1], dtype=np.int32)
+    mkts = np.asarray([0, 0], dtype=np.int32)
+    rows = np.empty(2, dtype=np.int32)
+    hashes = np.empty(2, dtype=np.uint64)
+    slots = np.empty(2, dtype=np.int64)
+    cap = m.reserve_pairs(2)
+    misses = m.probe_pairs_indexed(
+        ["s", "u"], codes, ["mkt"], mkts, rows, hashes, slots, 0, 2
+    )
+    assert misses == 1 and rows[0] == 2 and rows[1] == -1
+    assert m.commit_probed(
+        ["s", "u"], codes, ["mkt"], mkts, rows, hashes, slots, cap
+    ) == 1
+    assert rows[1] == 4 and m.id_of(4) == ("u", "mkt")
+    out = np.empty(2, dtype=np.int32)
+    matched = internmap.delta_match_rows(
+        None,
+        codes, np.asarray([0, 2], dtype=np.int64),
+        codes, np.asarray([0, 2], dtype=np.int64),
+        None, rows, out,
+    )
+    assert matched == 2 and list(out) == list(rows)
+
     for path in paths:
         print(f"built + smoke-tested: {path}")

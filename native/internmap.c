@@ -128,22 +128,14 @@ map_reserve_cold(InternMap *self, size_t n)
     return map_resize(self, cap);
 }
 
-/* Find or insert the key; returns the row, or -1 on error. */
+/* Append the key at the already-located EMPTY slot *i*; returns the new
+ * row or -1 on error. The probe that found slot *i* is the caller's job
+ * (map_intern_hashed's walk, or commit_probed resuming from a recorded
+ * probe-phase slot). */
 static int32_t
-map_intern_hashed(InternMap *self, const char *key, size_t len, uint64_t h)
+map_insert_at(InternMap *self, size_t i, const char *key, size_t len,
+              uint64_t h)
 {
-    if (self->used * 3 >= self->capacity * 2) {
-        if (map_resize(self, self->capacity * 2) < 0) return -1;
-    }
-    size_t mask = self->capacity - 1;
-    size_t i = h & mask;
-    while (self->slots[i].hash) {
-        slot_t *s = &self->slots[i];
-        if (s->hash == h && s->key_len == len &&
-            memcmp(self->arena + s->key_off, key, len) == 0)
-            return s->row;
-        i = (i + 1) & mask;
-    }
     if (self->used >= (size_t)INT32_MAX) {
         PyErr_SetString(PyExc_OverflowError, "more than 2^31-1 interned ids");
         return -1;
@@ -182,6 +174,25 @@ map_intern_hashed(InternMap *self, const char *key, size_t len, uint64_t h)
     self->slots[i].key_off = off;
     self->used++;
     return row;
+}
+
+/* Find or insert the key; returns the row, or -1 on error. */
+static int32_t
+map_intern_hashed(InternMap *self, const char *key, size_t len, uint64_t h)
+{
+    if (self->used * 3 >= self->capacity * 2) {
+        if (map_resize(self, self->capacity * 2) < 0) return -1;
+    }
+    size_t mask = self->capacity - 1;
+    size_t i = h & mask;
+    while (self->slots[i].hash) {
+        slot_t *s = &self->slots[i];
+        if (s->hash == h && s->key_len == len &&
+            memcmp(self->arena + s->key_off, key, len) == 0)
+            return s->row;
+        i = (i + 1) & mask;
+    }
+    return map_insert_at(self, i, key, len, h);
 }
 
 static int32_t
@@ -627,6 +638,424 @@ fail:
     Py_XDECREF(fast_b);
     Py_XDECREF(out);
     return NULL;
+}
+
+/* ---- delta interning: batch probe + deterministic commit ----------------- */
+
+typedef struct { const char *buf; Py_ssize_t len; } ff_view_t;
+
+/* Resolve every table entry's UTF-8 view up front (GIL held, cached on
+ * the str objects the caller's table keeps alive) so a probe loop can
+ * run with the GIL released. NUL-rejects every entry — the probe
+ * validates the whole table, unlike the lazy per-use resolution of the
+ * insert paths. *max_len gets the longest entry. Returns NULL on error. */
+static ff_view_t *
+resolve_table_views(PyObject *fast, Py_ssize_t n, Py_ssize_t *max_len)
+{
+    ff_view_t *views = PyMem_Malloc((size_t)(n ? n : 1) * sizeof(ff_view_t));
+    if (!views) {
+        PyErr_NoMemory();
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        views[i].buf = utf8_of(PySequence_Fast_GET_ITEM(fast, i),
+                               &views[i].len);
+        if (!views[i].buf || reject_nul(views[i].buf, views[i].len) < 0) {
+            PyMem_Free(views);
+            return NULL;
+        }
+        if (views[i].len > *max_len) *max_len = views[i].len;
+    }
+    return views;
+}
+
+/* probe_pairs_indexed(a_table, a_codes, b_table, b_codes,
+ *                     rows_out, hashes_out, slots_out, start, stop)
+ *     -> miss count in [start, stop)
+ *
+ * The batch-probe half of the delta interning pass (round 15): for every
+ * pair in [start, stop) — (unique table, int32 codes) halves exactly as
+ * intern_pairs_indexed takes them — LOOK UP the joined key without
+ * inserting, writing into caller-preallocated full-batch-length buffers
+ *
+ *   rows_out[i]   int32  the existing row, or -1 when absent
+ *   hashes_out[i] uint64 the key's hash (valid for every probed i)
+ *   slots_out[i]  int64  the first EMPTY slot on the key's probe chain —
+ *                        the insertion point commit_probed resumes from
+ *                        (only meaningful where rows_out[i] < 0)
+ *
+ * The main loop runs with the GIL RELEASED (table views are resolved up
+ * front and the map is only read), so worker threads probing disjoint
+ * [start, stop) ranges of one batch truly overlap — the sharded intern
+ * pass in utils/interning.py. The map must not be mutated between a
+ * probe and its commit (the tensor store's host lock guarantees that);
+ * commit_probed re-verifies the capacity it was probed against.
+ */
+static PyObject *
+InternMap_probe_pairs_indexed(InternMap *self, PyObject *args)
+{
+    PyObject *a_table_obj, *b_table_obj, *a_codes_obj, *b_codes_obj;
+    PyObject *rows_obj, *hashes_obj, *slots_obj;
+    Py_ssize_t start, stop;
+    if (!PyArg_ParseTuple(args, "OOOOOOOnn", &a_table_obj, &a_codes_obj,
+                          &b_table_obj, &b_codes_obj, &rows_obj,
+                          &hashes_obj, &slots_obj, &start, &stop))
+        return NULL;
+
+    PyObject *fast_a = NULL, *fast_b = NULL;
+    ff_view_t *views_a = NULL, *views_b = NULL;
+    char *scratch = NULL;
+    Py_buffer codes_a, codes_b, rows_v, hashes_v, slots_v;
+    codes_a.obj = codes_b.obj = rows_v.obj = hashes_v.obj = slots_v.obj =
+        NULL;
+
+    fast_a = PySequence_Fast(a_table_obj, "expected a sequence of str");
+    if (!fast_a) goto fail;
+    fast_b = PySequence_Fast(b_table_obj, "expected a sequence of str");
+    if (!fast_b) goto fail;
+    if (PyObject_GetBuffer(a_codes_obj, &codes_a, PyBUF_CONTIG_RO) < 0 ||
+        PyObject_GetBuffer(b_codes_obj, &codes_b, PyBUF_CONTIG_RO) < 0 ||
+        PyObject_GetBuffer(rows_obj, &rows_v, PyBUF_CONTIG) < 0 ||
+        PyObject_GetBuffer(hashes_obj, &hashes_v, PyBUF_CONTIG) < 0 ||
+        PyObject_GetBuffer(slots_obj, &slots_v, PyBUF_CONTIG) < 0)
+        goto fail;
+    if (codes_a.len != codes_b.len || codes_a.len % 4 != 0 ||
+        rows_v.len != codes_a.len || hashes_v.len != codes_a.len * 2 ||
+        slots_v.len != codes_a.len * 2) {
+        PyErr_SetString(PyExc_ValueError,
+                        "buffers must be equal-count int32 codes/rows with "
+                        "uint64 hashes and int64 slots");
+        goto fail;
+    }
+    Py_ssize_t n = codes_a.len / 4;
+    if (start < 0 || stop < start || stop > n) {
+        PyErr_SetString(PyExc_IndexError, "probe range out of bounds");
+        goto fail;
+    }
+    Py_ssize_t na = PySequence_Fast_GET_SIZE(fast_a);
+    Py_ssize_t nb = PySequence_Fast_GET_SIZE(fast_b);
+    Py_ssize_t max_a = 0, max_b = 0;
+    views_a = resolve_table_views(fast_a, na, &max_a);
+    if (!views_a) goto fail;
+    views_b = resolve_table_views(fast_b, nb, &max_b);
+    if (!views_b) goto fail;
+    /* Same chunked assemble→hash→prefetch→lookup pipeline as the insert
+     * paths: the lookups are random-miss-bound on the slots table, and
+     * prefetching each key's home slot while the rest of the chunk
+     * assembles hides part of the latency (the probe is the phase the
+     * sharded pass parallelises, so its per-pair cost IS the floor). */
+    enum { FF_QCHUNK = 1024 };
+    Py_ssize_t max_key = max_a + 1 + max_b + 1;
+    scratch = PyMem_Malloc((size_t)(FF_QCHUNK * max_key));
+    if (!scratch) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+
+    const int32_t *ca = (const int32_t *)codes_a.buf;
+    const int32_t *cb = (const int32_t *)codes_b.buf;
+    int32_t *rows = (int32_t *)rows_v.buf;
+    uint64_t *hashes = (uint64_t *)hashes_v.buf;
+    int64_t *slot_out = (int64_t *)slots_v.buf;
+    Py_ssize_t misses = 0;
+    Py_ssize_t bad_index = -1;
+    Py_BEGIN_ALLOW_THREADS
+    size_t mask = self->capacity - 1;
+    size_t offs[FF_QCHUNK];
+    uint32_t lens[FF_QCHUNK];
+    for (Py_ssize_t cstart = start; cstart < stop && bad_index < 0;
+         cstart += FF_QCHUNK) {
+        Py_ssize_t m = stop - cstart < FF_QCHUNK ? stop - cstart
+                                                 : FF_QCHUNK;
+        size_t kused = 0;
+        Py_ssize_t assembled = m;
+        for (Py_ssize_t j = 0; j < m; j++) {
+            Py_ssize_t i = cstart + j;
+            int32_t ia = ca[i], ib = cb[i];
+            if (ia < 0 || ia >= na || ib < 0 || ib >= nb) {
+                bad_index = i;
+                assembled = j;
+                break;
+            }
+            size_t alen = (size_t)views_a[ia].len;
+            size_t blen = (size_t)views_b[ib].len;
+            size_t len = alen + 1 + blen;
+            memcpy(scratch + kused, views_a[ia].buf, alen);
+            scratch[kused + alen] = '\0';
+            memcpy(scratch + kused + alen + 1, views_b[ib].buf, blen);
+            offs[j] = kused;
+            lens[j] = (uint32_t)len;
+            uint64_t h = fnv1a(scratch + kused, len);
+            hashes[i] = h;
+            FF_PREFETCH(&self->slots[h & mask]);
+            kused += len;
+        }
+        for (Py_ssize_t j = 0; j < assembled; j++) {
+            Py_ssize_t i = cstart + j;
+            const char *key = scratch + offs[j];
+            size_t len = lens[j];
+            uint64_t h = hashes[i];
+            size_t k = h & mask;
+            int32_t row = -1;
+            while (self->slots[k].hash) {
+                slot_t *s = &self->slots[k];
+                if (s->hash == h && s->key_len == len &&
+                    memcmp(self->arena + s->key_off, key, len) == 0) {
+                    row = s->row;
+                    break;
+                }
+                k = (k + 1) & mask;
+            }
+            rows[i] = row;
+            if (row < 0) {
+                slot_out[i] = (int64_t)k;  /* the first empty slot found */
+                misses++;
+            } else {
+                slot_out[i] = -1;
+            }
+        }
+    }
+    Py_END_ALLOW_THREADS
+    if (bad_index >= 0) {
+        PyErr_Format(PyExc_IndexError,
+                     "pair %zd: code (%d, %d) out of table range",
+                     bad_index, (int)ca[bad_index], (int)cb[bad_index]);
+        goto fail;
+    }
+
+    PyMem_Free(scratch);
+    PyMem_Free(views_a);
+    PyMem_Free(views_b);
+    PyBuffer_Release(&codes_a);
+    PyBuffer_Release(&codes_b);
+    PyBuffer_Release(&rows_v);
+    PyBuffer_Release(&hashes_v);
+    PyBuffer_Release(&slots_v);
+    Py_DECREF(fast_a);
+    Py_DECREF(fast_b);
+    return PyLong_FromSsize_t(misses);
+
+fail:
+    PyMem_Free(scratch);
+    PyMem_Free(views_a);
+    PyMem_Free(views_b);
+    if (codes_a.obj) PyBuffer_Release(&codes_a);
+    if (codes_b.obj) PyBuffer_Release(&codes_b);
+    if (rows_v.obj) PyBuffer_Release(&rows_v);
+    if (hashes_v.obj) PyBuffer_Release(&hashes_v);
+    if (slots_v.obj) PyBuffer_Release(&slots_v);
+    Py_XDECREF(fast_a);
+    Py_XDECREF(fast_b);
+    return NULL;
+}
+
+/* commit_probed(a_table, a_codes, b_table, b_codes, rows, hashes, slots,
+ *               probed_capacity) -> newly probed-in (miss) count committed
+ *
+ * The deterministic serial half of the sharded intern pass: walk the
+ * batch IN INDEX ORDER and intern exactly the pairs the probe phase left
+ * at rows[i] < 0, writing each assigned row back into rows[i] in place.
+ * Row assignment is therefore first-occurrence-in-batch order —
+ * identical, key for key, to one intern_pairs_indexed pass over the
+ * whole batch (existing pairs never allocate in either path), which is
+ * the byte-parity contract the delta-interning path rests on.
+ *
+ * probed_capacity is the slots-table capacity the probe ran against:
+ * while it still holds (no resize since), each insert RESUMES from the
+ * recorded first-empty slot — the hash and the walk to the insertion
+ * point were already paid in the (parallel) probe phase, so the commit
+ * does no full-chain re-probe. Equality is still checked from the
+ * resumed slot (keys inserted since the probe live at or past it), so
+ * duplicate keys in one batch commit to one row. The first internal
+ * resize — or a capacity mismatch at entry, i.e. the map was mutated
+ * between probe and commit — falls back to standard hash-based probing
+ * for the remaining keys; results are identical either way.
+ */
+static PyObject *
+InternMap_commit_probed(InternMap *self, PyObject *args)
+{
+    PyObject *a_table_obj, *b_table_obj, *a_codes_obj, *b_codes_obj;
+    PyObject *rows_obj, *hashes_obj, *slots_obj;
+    Py_ssize_t probed_capacity;
+    if (!PyArg_ParseTuple(args, "OOOOOOOn", &a_table_obj, &a_codes_obj,
+                          &b_table_obj, &b_codes_obj, &rows_obj,
+                          &hashes_obj, &slots_obj, &probed_capacity))
+        return NULL;
+
+    PyObject *fast_a = NULL, *fast_b = NULL;
+    ff_view_t *views_a = NULL, *views_b = NULL;
+    char *scratch = NULL;
+    Py_ssize_t scratch_cap = 0;
+    Py_buffer codes_a, codes_b, rows_v, hashes_v, slots_v;
+    codes_a.obj = codes_b.obj = rows_v.obj = hashes_v.obj = slots_v.obj =
+        NULL;
+
+    fast_a = PySequence_Fast(a_table_obj, "expected a sequence of str");
+    if (!fast_a) goto fail;
+    fast_b = PySequence_Fast(b_table_obj, "expected a sequence of str");
+    if (!fast_b) goto fail;
+    if (PyObject_GetBuffer(a_codes_obj, &codes_a, PyBUF_CONTIG_RO) < 0 ||
+        PyObject_GetBuffer(b_codes_obj, &codes_b, PyBUF_CONTIG_RO) < 0 ||
+        PyObject_GetBuffer(rows_obj, &rows_v, PyBUF_CONTIG) < 0 ||
+        PyObject_GetBuffer(hashes_obj, &hashes_v, PyBUF_CONTIG_RO) < 0 ||
+        PyObject_GetBuffer(slots_obj, &slots_v, PyBUF_CONTIG_RO) < 0)
+        goto fail;
+    if (codes_a.len != codes_b.len || codes_a.len % 4 != 0 ||
+        rows_v.len != codes_a.len || hashes_v.len != codes_a.len * 2 ||
+        slots_v.len != codes_a.len * 2) {
+        PyErr_SetString(PyExc_ValueError,
+                        "buffers must be equal-count int32 codes/rows with "
+                        "uint64 hashes and int64 slots");
+        goto fail;
+    }
+    Py_ssize_t n = codes_a.len / 4;
+    Py_ssize_t na = PySequence_Fast_GET_SIZE(fast_a);
+    Py_ssize_t nb = PySequence_Fast_GET_SIZE(fast_b);
+
+    /* Lazy views, like intern_pairs_indexed: only entries a MISS
+     * references resolve (hits never re-assemble their key). */
+    views_a = PyMem_Calloc((size_t)(na ? na : 1), sizeof(ff_view_t));
+    views_b = PyMem_Calloc((size_t)(nb ? nb : 1), sizeof(ff_view_t));
+    if (!views_a || !views_b) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+    scratch_cap = 256;
+    scratch = PyMem_Malloc((size_t)scratch_cap);
+    if (!scratch) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+
+    const int32_t *ca = (const int32_t *)codes_a.buf;
+    const int32_t *cb = (const int32_t *)codes_b.buf;
+    int32_t *rows = (int32_t *)rows_v.buf;
+    const uint64_t *hashes = (const uint64_t *)hashes_v.buf;
+    const int64_t *slot_in = (const int64_t *)slots_v.buf;
+    int use_slots = (size_t)probed_capacity == self->capacity;
+    Py_ssize_t committed = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (rows[i] >= 0) continue;
+        if (use_slots && i + 24 < n && rows[i + 24] < 0) {
+            /* Look-ahead prefetch of an upcoming miss's recorded slot —
+             * the insert's one random access. */
+            int64_t ahead = slot_in[i + 24];
+            if ((size_t)ahead < self->capacity)
+                FF_PREFETCH(&self->slots[ahead]);
+        }
+        int32_t ia = ca[i], ib = cb[i];
+        if (ia < 0 || ia >= na || ib < 0 || ib >= nb) {
+            PyErr_Format(PyExc_IndexError,
+                         "pair %zd: code (%d, %d) out of table range",
+                         i, ia, ib);
+            goto fail;
+        }
+        if (!views_a[ia].buf) {
+            views_a[ia].buf = utf8_of(
+                PySequence_Fast_GET_ITEM(fast_a, ia), &views_a[ia].len);
+            if (!views_a[ia].buf ||
+                reject_nul(views_a[ia].buf, views_a[ia].len) < 0)
+                goto fail;
+        }
+        if (!views_b[ib].buf) {
+            views_b[ib].buf = utf8_of(
+                PySequence_Fast_GET_ITEM(fast_b, ib), &views_b[ib].len);
+            if (!views_b[ib].buf ||
+                reject_nul(views_b[ib].buf, views_b[ib].len) < 0)
+                goto fail;
+        }
+        Py_ssize_t alen = views_a[ia].len, blen = views_b[ib].len;
+        Py_ssize_t need = alen + 1 + blen;
+        if (need > scratch_cap) {
+            scratch_cap = need * 2;
+            char *grown = PyMem_Realloc(scratch, (size_t)scratch_cap);
+            if (!grown) {
+                PyErr_NoMemory();
+                goto fail;
+            }
+            scratch = grown;
+        }
+        memcpy(scratch, views_a[ia].buf, (size_t)alen);
+        scratch[alen] = '\0';
+        memcpy(scratch + alen + 1, views_b[ib].buf, (size_t)blen);
+        uint64_t h = hashes[i];
+        int32_t row = -1;
+        if (use_slots && self->used * 3 >= self->capacity * 2) {
+            if (map_resize(self, self->capacity * 2) < 0) goto fail;
+            use_slots = 0;  /* recorded slots are stale from here on */
+        }
+        if (use_slots) {
+            size_t mask = self->capacity - 1;
+            size_t j = (size_t)slot_in[i];
+            if (j >= self->capacity) {
+                use_slots = 0;
+                row = map_intern_hashed(self, scratch, (size_t)need, h);
+            } else {
+                while (self->slots[j].hash) {
+                    slot_t *s = &self->slots[j];
+                    if (s->hash == h && s->key_len == (uint32_t)need &&
+                        memcmp(self->arena + s->key_off, scratch,
+                               (size_t)need) == 0) {
+                        row = s->row;
+                        break;
+                    }
+                    j = (j + 1) & mask;
+                }
+                if (row < 0)
+                    row = map_insert_at(self, j, scratch, (size_t)need, h);
+            }
+        } else {
+            row = map_intern_hashed(self, scratch, (size_t)need, h);
+        }
+        if (row < 0) goto fail;
+        rows[i] = row;
+        committed++;
+    }
+
+    PyMem_Free(scratch);
+    PyMem_Free(views_a);
+    PyMem_Free(views_b);
+    PyBuffer_Release(&codes_a);
+    PyBuffer_Release(&codes_b);
+    PyBuffer_Release(&rows_v);
+    PyBuffer_Release(&hashes_v);
+    PyBuffer_Release(&slots_v);
+    Py_DECREF(fast_a);
+    Py_DECREF(fast_b);
+    return PyLong_FromSsize_t(committed);
+
+fail:
+    PyMem_Free(scratch);
+    PyMem_Free(views_a);
+    PyMem_Free(views_b);
+    if (codes_a.obj) PyBuffer_Release(&codes_a);
+    if (codes_b.obj) PyBuffer_Release(&codes_b);
+    if (rows_v.obj) PyBuffer_Release(&rows_v);
+    if (hashes_v.obj) PyBuffer_Release(&hashes_v);
+    if (slots_v.obj) PyBuffer_Release(&slots_v);
+    Py_XDECREF(fast_a);
+    Py_XDECREF(fast_b);
+    return NULL;
+}
+
+/* reserve_pairs(n) -> capacity token
+ *
+ * Pre-size the table for an incoming batch of *n* keys (the same
+ * cold-load heuristic every batch insert runs) BEFORE a probe pass, so
+ * the probe's recorded slots stay valid through the commit. Returns the
+ * post-reserve capacity — the token commit_probed verifies.
+ */
+static PyObject *
+InternMap_reserve_pairs(InternMap *self, PyObject *arg)
+{
+    Py_ssize_t n = PyLong_AsSsize_t(arg);
+    if (n == -1 && PyErr_Occurred()) return NULL;
+    if (n < 0) {
+        PyErr_SetString(PyExc_ValueError, "reserve size must be >= 0");
+        return NULL;
+    }
+    if (map_reserve_cold(self, (size_t)n) < 0) return NULL;
+    return PyLong_FromSize_t(self->capacity);
 }
 
 static PyObject *
@@ -1481,6 +1910,16 @@ static PyMethodDef InternMap_methods[] = {
     {"intern_pairs_indexed",
      (PyCFunction)InternMap_intern_pairs_indexed, METH_VARARGS,
      "intern_pairs_indexed(a_table, a_codes, b_table, b_codes) -> rows"},
+    {"probe_pairs_indexed",
+     (PyCFunction)InternMap_probe_pairs_indexed, METH_VARARGS,
+     "probe_pairs_indexed(a_table, a_codes, b_table, b_codes, rows, "
+     "hashes, slots, start, stop) -> miss count (lookup only, GIL "
+     "released)"},
+    {"commit_probed", (PyCFunction)InternMap_commit_probed, METH_VARARGS,
+     "commit_probed(a_table, a_codes, b_table, b_codes, rows, hashes, "
+     "slots, probed_capacity) -> interned miss count (in batch order)"},
+    {"reserve_pairs", (PyCFunction)InternMap_reserve_pairs, METH_O,
+     "reserve_pairs(n) -> capacity token (pre-size before a probe pass)"},
     {"lookup", (PyCFunction)InternMap_lookup, METH_O,
      "lookup(id) -> row or -1 (no insertion)"},
     {"lookup_pair", (PyCFunction)InternMap_lookup_pair, METH_VARARGS,
@@ -1501,6 +1940,159 @@ static PyMethodDef InternMap_methods[] = {
      "pair_blob(lo, hi) -> journal wire-format bytes for rows [lo, hi)"},
     {NULL, NULL, 0, NULL},
 };
+
+/* delta_match_rows(rank_map, pr_new, po_new, pr_old, po_old, prev_of,
+ *                  rows_old, rows_out) -> matched pair count
+ *
+ * The per-market match pass of the epoch-persistent pair table
+ * (state/tensor_store.py): market *m* of the NEW batch matches old
+ * market prev_of[m] (identity when prev_of is None) iff their pair
+ * counts are equal and every pair's source rank maps elementwise
+ * (rank_map translates new ranks to the old batch's; None means the
+ * source tables are identical and the comparison is a raw memcmp).
+ * Matched markets copy their resolved rows from rows_old; every other
+ * position gets -1 — the miss set the interner then walks. One
+ * sequential O(P) pass at memcmp/memcpy speed, GIL released (pure
+ * buffers). Offsets must be non-decreasing and in range (checked).
+ *
+ * Buffers: rank_map i32[U] or None, pr_new i32[P_new], po_new i64[M+1],
+ * pr_old i32[P_old], po_old i64[M_old+1], prev_of i64[M] or None,
+ * rows_old i32[>= P_old], rows_out i32[P_new] (writable, fully
+ * overwritten).
+ */
+static PyObject *
+internmap_delta_match_rows(PyObject *Py_UNUSED(module), PyObject *args)
+{
+    PyObject *rank_obj, *prev_obj;
+    PyObject *prn_obj, *pon_obj, *pro_obj, *poo_obj, *rowso_obj, *out_obj;
+    if (!PyArg_ParseTuple(args, "OOOOOOOO", &rank_obj, &prn_obj, &pon_obj,
+                          &pro_obj, &poo_obj, &prev_obj, &rowso_obj,
+                          &out_obj))
+        return NULL;
+
+    Py_buffer rank_v, prn_v, pon_v, pro_v, poo_v, prev_v, rowso_v, out_v;
+    rank_v.obj = prn_v.obj = pon_v.obj = pro_v.obj = poo_v.obj =
+        prev_v.obj = rowso_v.obj = out_v.obj = NULL;
+#define FF_GETBUF(obj, view, flags)                                       \
+    do {                                                                  \
+        if (PyObject_GetBuffer(obj, &view, flags) < 0) goto fail;         \
+    } while (0)
+    if (rank_obj != Py_None) FF_GETBUF(rank_obj, rank_v, PyBUF_CONTIG_RO);
+    FF_GETBUF(prn_obj, prn_v, PyBUF_CONTIG_RO);
+    FF_GETBUF(pon_obj, pon_v, PyBUF_CONTIG_RO);
+    FF_GETBUF(pro_obj, pro_v, PyBUF_CONTIG_RO);
+    FF_GETBUF(poo_obj, poo_v, PyBUF_CONTIG_RO);
+    if (prev_obj != Py_None) FF_GETBUF(prev_obj, prev_v, PyBUF_CONTIG_RO);
+    FF_GETBUF(rowso_obj, rowso_v, PyBUF_CONTIG_RO);
+    FF_GETBUF(out_obj, out_v, PyBUF_CONTIG);
+#undef FF_GETBUF
+
+    if (pon_v.len < 8 || pon_v.len % 8 != 0 || poo_v.len < 8 ||
+        poo_v.len % 8 != 0 || prn_v.len % 4 != 0 || pro_v.len % 4 != 0 ||
+        rowso_v.len % 4 != 0 || out_v.len != prn_v.len ||
+        (rank_v.obj && rank_v.len % 4 != 0) ||
+        (prev_v.obj && prev_v.len % 8 != 0)) {
+        PyErr_SetString(PyExc_ValueError,
+                        "delta_match_rows: malformed buffer shapes");
+        goto fail;
+    }
+    const int32_t *rank_map = rank_v.obj ? (const int32_t *)rank_v.buf
+                                         : NULL;
+    Py_ssize_t n_rank = rank_v.obj ? rank_v.len / 4 : 0;
+    const int32_t *pr_new = (const int32_t *)prn_v.buf;
+    const int64_t *po_new = (const int64_t *)pon_v.buf;
+    const int32_t *pr_old = (const int32_t *)pro_v.buf;
+    const int64_t *po_old = (const int64_t *)poo_v.buf;
+    const int64_t *prev_of = prev_v.obj ? (const int64_t *)prev_v.buf
+                                        : NULL;
+    const int32_t *rows_old = (const int32_t *)rowso_v.buf;
+    int32_t *rows_out = (int32_t *)out_v.buf;
+    Py_ssize_t m_new = pon_v.len / 8 - 1;
+    Py_ssize_t m_old = poo_v.len / 8 - 1;
+    Py_ssize_t p_new = prn_v.len / 4;
+    Py_ssize_t p_old = pro_v.len / 4;
+    Py_ssize_t n_rows_old = rowso_v.len / 4;
+    if ((prev_v.obj && prev_v.len / 8 != m_new) ||
+        (!prev_v.obj && m_new > m_old) || n_rows_old < p_old) {
+        PyErr_SetString(PyExc_ValueError,
+                        "delta_match_rows: table sizes do not line up");
+        goto fail;
+    }
+
+    Py_ssize_t matched_pairs = 0;
+    Py_ssize_t bad_market = -1;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t m = 0; m < m_new; m++) {
+        int64_t lo = po_new[m], hi = po_new[m + 1];
+        if (lo < 0 || hi < lo || hi > p_new) {
+            bad_market = m;
+            break;
+        }
+        int64_t c = hi - lo;
+        int64_t pm = prev_of ? prev_of[m] : (int64_t)m;
+        int matched = 0;
+        int64_t plo = 0;
+        if (pm >= 0 && pm < m_old) {
+            plo = po_old[pm];
+            int64_t phi = po_old[pm + 1];
+            if (plo >= 0 && phi >= plo && phi <= p_old && phi - plo == c) {
+                if (!rank_map) {
+                    matched = memcmp(pr_new + lo, pr_old + plo,
+                                     (size_t)c * 4) == 0;
+                } else {
+                    matched = 1;
+                    for (int64_t k = 0; k < c; k++) {
+                        int32_t r = pr_new[lo + k];
+                        if (r < 0 || r >= n_rank ||
+                            rank_map[r] != pr_old[plo + k]) {
+                            matched = 0;
+                            break;
+                        }
+                    }
+                }
+            }
+        }
+        if (matched) {
+            memcpy(rows_out + lo, rows_old + plo, (size_t)c * 4);
+            matched_pairs += c;
+        } else {
+            for (int64_t k = lo; k < hi; k++) rows_out[k] = -1;
+        }
+    }
+    Py_END_ALLOW_THREADS
+#define FF_RELEASE_ALL()                                                  \
+    do {                                                                  \
+        if (rank_v.obj) PyBuffer_Release(&rank_v);                        \
+        PyBuffer_Release(&prn_v);                                         \
+        PyBuffer_Release(&pon_v);                                         \
+        PyBuffer_Release(&pro_v);                                         \
+        PyBuffer_Release(&poo_v);                                         \
+        if (prev_v.obj) PyBuffer_Release(&prev_v);                        \
+        PyBuffer_Release(&rowso_v);                                       \
+        PyBuffer_Release(&out_v);                                         \
+    } while (0)
+    if (bad_market >= 0) {
+        PyErr_Format(PyExc_ValueError,
+                     "delta_match_rows: offsets for market %zd out of "
+                     "range", bad_market);
+        FF_RELEASE_ALL();
+        return NULL;
+    }
+    FF_RELEASE_ALL();
+#undef FF_RELEASE_ALL
+    return PyLong_FromSsize_t(matched_pairs);
+
+fail:
+    if (rank_v.obj) PyBuffer_Release(&rank_v);
+    if (prn_v.obj) PyBuffer_Release(&prn_v);
+    if (pon_v.obj) PyBuffer_Release(&pon_v);
+    if (pro_v.obj) PyBuffer_Release(&pro_v);
+    if (poo_v.obj) PyBuffer_Release(&poo_v);
+    if (prev_v.obj) PyBuffer_Release(&prev_v);
+    if (rowso_v.obj) PyBuffer_Release(&rowso_v);
+    if (out_v.obj) PyBuffer_Release(&out_v);
+    return NULL;
+}
 
 /* sqlite_writer_available() -> bool: whether flush_sqlite can run here
  * (libsqlite3 dlopen()able). Lets callers choose a fallback up front
@@ -1535,6 +2127,9 @@ static PyMethodDef internmap_functions[] = {
      "flush_snapshot(path, blob) -> row count (GIL released during write)"},
     {"pack_strings", internmap_pack_strings, METH_O,
      "pack_strings(list[str]) -> u32-length-prefixed UTF-8 blob"},
+    {"delta_match_rows", internmap_delta_match_rows, METH_VARARGS,
+     "delta_match_rows(rank_map, pr_new, po_new, pr_old, po_old, "
+     "prev_of, rows_old, rows_out) -> matched pair count"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -565,6 +565,37 @@ class TestIngestLeg:
         assert isinstance(result["sub_second_4m"], bool)
         json.dumps(result)
 
+    def test_fast_leg_reports_drift_act(self):
+        """Act 3 (round 15): the drifting-topology packs over the
+        epoch-persistent pair table — per-variant intern_s +
+        delta_pairs, the full-mode floor beside each, the in-act
+        delta==full row-assignment coda, and the scaled acceptance
+        fields."""
+        result = bench.run_leg_inprocess("e2e_ingest", fast=True)
+        drift = result["drift"]
+        for side in ("stable", "drift1", "drift25"):
+            out = drift[side]
+            for key in ("wall_s", "intern_s", "delta_pairs",
+                        "matched_pairs", "intern_cold_s",
+                        "intern_full_s", "delta_parity", "wall_s_band",
+                        "repeats"):
+                assert key in out, (side, key)
+            assert out["delta_parity"] is True
+            assert side in result["drift_intern_s_per_4m"]
+        # The stable re-pack is the pair-fingerprint O(1) tier; the
+        # drifted packs intern strictly fewer pairs than the batch.
+        assert drift["stable"]["fingerprint_hit"] is True
+        assert drift["stable"]["delta_pairs"] == 0
+        assert 0 < drift["drift1"]["delta_pairs"] < result["signals"]
+        assert (
+            drift["drift1"]["delta_pairs"]
+            < drift["drift25"]["delta_pairs"]
+        )
+        assert isinstance(result["sub_100ms_drift_4m"], bool)
+        assert isinstance(result["sub_half_s_cold_4m"], bool)
+        assert result["cold_intern_s_per_4m"] > 0
+        json.dumps(result)
+
     def test_leg_is_registered_for_device_runs(self):
         assert "e2e_ingest" in bench.LEGS
         assert "e2e_ingest" in bench.DEVICE_LEG_ORDER

@@ -494,6 +494,130 @@ class TestForcedFallbackLane:
         assert lane_digests == expected
 
 
+_DELTA_FALLBACK_SCRIPT = textwrap.dedent(
+    """
+    import hashlib, json, sys
+
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.core import batch as batch_mod
+    from bayesian_consensus_engine_tpu.utils.interning import _load_internmap
+
+    assert batch_mod._fastpack is None, "fastpack not gated"
+    assert _load_internmap() is None, "internmap not gated"
+
+    from bayesian_consensus_engine_tpu.pipeline import (
+        stage_settlement_plan_columnar,
+    )
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    batches = json.load(open(sys.argv[1]))
+    store = TensorReliabilityStore()
+    for keys, sids, probs, offsets in batches:
+        plan = stage_settlement_plan_columnar(
+            keys, sids, np.asarray(probs, np.float64),
+            np.asarray(offsets, np.int64), intern_mode="auto",
+        ).bind(store)
+        digest = hashlib.blake2b(digest_size=16)
+        digest.update(plan.slot_rows.tobytes())
+        digest.update(plan.probs.tobytes())
+        digest.update(plan.mask.tobytes())
+        digest.update(repr(plan.binding).encode())
+        print(digest.hexdigest())
+    table = hashlib.blake2b(digest_size=16)
+    for pair in store._pairs.ids():
+        table.update(repr(pair).encode())
+    print(table.hexdigest())
+    """
+)
+
+
+class TestDeltaForcedFallbackLane:
+    """``BCE_NO_NATIVE=1`` over the DELTA-INTERNING chain (round 15): a
+    base + drifted + reordered batch sequence bound through the epoch-
+    persistent pair table on the pure-Python twins must produce plans,
+    row assignment, and pair-table contents byte-identical to this
+    process's native builds — including the sharded probe+commit route,
+    forced here at toy size."""
+
+    def _batches(self):
+        rng = np.random.default_rng(17)
+        markets = [f"mk-{i}" for i in range(12)]
+        base_sids, base_offsets = [], [0]
+        for _ in markets:
+            for _ in range(int(rng.integers(1, 4))):
+                base_sids.append(f"s-{int(rng.integers(0, 8))}")
+            base_offsets.append(len(base_sids))
+        base = (markets, base_sids,
+                rng.random(len(base_sids)).tolist(), base_offsets)
+        # Drift: re-draw the last market's sources.
+        d_sids = list(base_sids[: base_offsets[-2]]) + ["s-drift"]
+        d_offsets = base_offsets[:-1] + [len(d_sids)]
+        drift = (markets, d_sids,
+                 rng.random(len(d_sids)).tolist(), d_offsets)
+        # Reorder: reversed market order, spliced from base.
+        r_sids, r_offsets = [], [0]
+        for m in reversed(range(len(markets))):
+            r_sids.extend(base_sids[base_offsets[m]:base_offsets[m + 1]])
+            r_offsets.append(len(r_sids))
+        reorder = (list(reversed(markets)), r_sids,
+                   rng.random(len(r_sids)).tolist(), r_offsets)
+        return [base, drift, reorder]
+
+    def test_delta_twin_matches_native_sharded(self, tmp_path,
+                                               monkeypatch):
+        import hashlib as _hashlib
+
+        batches = self._batches()
+        batch_file = tmp_path / "batches.json"
+        batch_file.write_text(json.dumps(batches))
+
+        env = dict(os.environ)
+        env["BCE_NO_NATIVE"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-c", _DELTA_FALLBACK_SCRIPT,
+             str(batch_file)],
+            capture_output=True, text=True, env=env, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lane_digests = proc.stdout.split()
+        assert len(lane_digests) == len(batches) + 1
+
+        # This process: native delta chain with the sharded probe+commit
+        # route FORCED for every miss set.
+        from bayesian_consensus_engine_tpu.pipeline import (
+            stage_settlement_plan_columnar,
+        )
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+        from bayesian_consensus_engine_tpu.utils import interning
+
+        monkeypatch.setattr(interning, "SHARD_MIN_PAIRS", 1)
+        monkeypatch.setenv("BCE_INTERN_WORKERS", "2")
+        store = TensorReliabilityStore()
+        expected = []
+        for keys, sids, probs, offsets in batches:
+            plan = stage_settlement_plan_columnar(
+                keys, sids, np.asarray(probs, np.float64),
+                np.asarray(offsets, np.int64), intern_mode="auto",
+            ).bind(store)
+            digest = _hashlib.blake2b(digest_size=16)
+            digest.update(plan.slot_rows.tobytes())
+            digest.update(plan.probs.tobytes())
+            digest.update(plan.mask.tobytes())
+            digest.update(repr(plan.binding).encode())
+            expected.append(digest.hexdigest())
+        table = _hashlib.blake2b(digest_size=16)
+        for pair in store._pairs.ids():
+            table.update(repr(pair).encode())
+        expected.append(table.hexdigest())
+        assert lane_digests == expected
+
+
 class TestFallback:
     def test_python_path_always_available(self):
         markets = _random_markets(seed=2)

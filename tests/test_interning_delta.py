@@ -1,0 +1,687 @@
+"""Delta-pair interning (round 15): the epoch-persistent pair table and
+the sharded deterministic intern pass.
+
+The contract under test is BYTE parity: ``intern_mode="auto"`` (delta)
+and ``intern_mode="full"`` (the legacy every-pair walk) must produce
+identical plans, row assignment, store arrays, journal epoch payloads
+(wall_ts masked — the one legitimately run-varying field), and SQLite
+checkpoint bytes, across
+
+    {stable, drift, reorder, shrink, grow}   workload shapes
+  × {native, forced-fallback}                interner stacks
+  × {flat, sharded-resident}                 settle paths
+
+plus the sharded probe+commit pass against the serial intern, the
+numpy/C ``delta_match_rows`` twins, the ``known_rows=`` fast path, and
+the recovery rule (journal replay / ``absorb_replayed_rows`` drop the
+epoch table — a stale table must MISS, never serve wrong rows).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from bayesian_consensus_engine_tpu.core.batch import (
+    pair_fingerprint,
+    topology_fingerprint,
+)
+from bayesian_consensus_engine_tpu.pipeline import (
+    settle_stream,
+    stage_settlement_plan_columnar,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+from bayesian_consensus_engine_tpu.utils import interning
+
+
+# ---------------------------------------------------------------------------
+# Workloads: columnar batches over a drifting market/source universe.
+# ---------------------------------------------------------------------------
+
+
+def _columnar(rng, market_ids, universe, max_signals=4):
+    """One columnar batch: each market draws 1..max_signals sources
+    (with replacement — duplicate signals exercise the averaging path)."""
+    keys = list(market_ids)
+    sids, probs, offsets = [], [], [0]
+    for _ in keys:
+        for _ in range(int(rng.integers(1, max_signals + 1))):
+            sids.append(f"src-{int(rng.integers(0, universe))}")
+            probs.append(float(rng.random()))
+        offsets.append(len(sids))
+    return (
+        keys,
+        sids,
+        np.asarray(probs, dtype=np.float64),
+        np.asarray(offsets, dtype=np.int64),
+    )
+
+
+def matrix_batches(seed=31):
+    """The five-shape batch sequence: base, stable re-pack (same pair
+    set, new probabilities AND new duplicate pattern), 1-market drift,
+    full market reorder, shrink to a prefix, grow past the base."""
+    rng = np.random.default_rng(seed)
+    markets = [f"m-{i}" for i in range(24)]
+    base = _columnar(rng, markets, universe=12)
+
+    # Stable pair set with a different signal pattern: re-emit each
+    # market's UNIQUE source set once (drops duplicates), new probs —
+    # misses the topology fingerprint, hits the pair fingerprint.
+    keys, sids, _probs, offsets = base
+    stable_sids, stable_offsets = [], [0]
+    for m in range(len(keys)):
+        seen = dict.fromkeys(sids[offsets[m]:offsets[m + 1]])
+        stable_sids.extend(sorted(seen))
+        stable_offsets.append(len(stable_sids))
+    stable = (
+        list(keys),
+        stable_sids,
+        rng.random(len(stable_sids)),
+        np.asarray(stable_offsets, dtype=np.int64),
+    )
+
+    drift = _columnar(rng, markets, universe=12)
+    # ... but only 3 markets actually drift: splice the rest from base.
+    d_keys, d_sids, d_probs, d_offsets = drift
+    keep = [m for m in range(len(markets)) if m % 8 != 0]
+    sids2, probs2, offsets2 = [], [], [0]
+    for m in range(len(markets)):
+        src = base if m in set(keep) else drift
+        lo, hi = int(src[3][m]), int(src[3][m + 1])
+        sids2.extend(src[1][lo:hi])
+        probs2.extend(src[2][lo:hi].tolist())
+        offsets2.append(len(sids2))
+    drift = (
+        list(markets), sids2, np.asarray(probs2),
+        np.asarray(offsets2, dtype=np.int64),
+    )
+
+    perm = rng.permutation(len(markets))
+    r_sids, r_probs, r_offsets = [], [], [0]
+    for m in perm.tolist():
+        lo, hi = int(base[3][m]), int(base[3][m + 1])
+        r_sids.extend(base[1][lo:hi])
+        r_probs.extend(base[2][lo:hi].tolist())
+        r_offsets.append(len(r_sids))
+    reorder = (
+        [markets[m] for m in perm.tolist()], r_sids,
+        np.asarray(r_probs), np.asarray(r_offsets, dtype=np.int64),
+    )
+
+    half = len(markets) // 2
+    shrink = (
+        list(markets[:half]),
+        base[1][: int(base[3][half])],
+        base[2][: int(base[3][half])].copy(),
+        np.asarray(base[3][: half + 1], dtype=np.int64),
+    )
+
+    grown = markets + [f"m-new-{i}" for i in range(8)]
+    grow = _columnar(rng, grown, universe=16)
+
+    out = []
+    for batch in (base, stable, drift, reorder, shrink, grow):
+        n_markets = len(batch[0])
+        out.append(
+            (batch, [bool(b) for b in rng.integers(0, 2, n_markets)])
+        )
+    return out
+
+
+def journal_epochs_sans_clock(path):
+    """Decoded epoch frames with the wall-clock field (and its CRC)
+    masked — the byte-comparable journal content."""
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = hdr.unpack_from(blob, off)
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4
+    return epochs
+
+
+def replayed_state(journal_path):
+    """The durability truth a journal carries: replay it onto a fresh
+    store and take the comparable host state (the PR-6 convention for
+    free-running-prefetch streams, whose raw epoch membership is racy)."""
+    from bayesian_consensus_engine_tpu.state.journal import replay_journal
+
+    store, tag = replay_journal(journal_path)
+    return tag, store_state(store)
+
+
+def store_state(store):
+    """The comparable host truth: ids in row order + value columns."""
+    store.sync()
+    used = len(store._pairs)
+    return (
+        store._pairs.ids(),
+        store._rel[:used].tobytes(),
+        store._conf[:used].tobytes(),
+        store._days[:used].tobytes(),
+        store._exists[:used].tobytes(),
+        list(store._iso[:used]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The byte-parity matrix.
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaParityMatrix:
+    """delta ≡ full across workloads × interner stacks × settle paths."""
+
+    def _run_stream(self, tmp_path, name, intern_mode, mesh):
+        from bayesian_consensus_engine_tpu.state.journal import (
+            JournalWriter,
+        )
+
+        store = TensorReliabilityStore()
+        db = tmp_path / f"{name}.db"
+        jrnl = tmp_path / f"{name}.jrnl"
+        stats = []
+        results = list(
+            settle_stream(
+                store, matrix_batches(), steps=2, now=21_700.0,
+                db_path=db, checkpoint_every=2, columnar=True,
+                stats=stats, reuse_plans=True, mesh=mesh,
+                journal=JournalWriter(jrnl), intern_mode=intern_mode,
+            )
+        )
+        return store, results, db, jrnl, stats
+
+    @pytest.mark.parametrize("fallback", [False, True],
+                             ids=["native", "fallback"])
+    @pytest.mark.parametrize("sharded", [False, True],
+                             ids=["flat", "sharded-resident"])
+    def test_delta_equals_full_bytes(self, tmp_path, monkeypatch,
+                                     sharded, fallback):
+        if fallback:
+            monkeypatch.setenv("BCE_NO_NATIVE", "1")
+        mesh = None
+        if sharded:
+            from bayesian_consensus_engine_tpu.parallel.mesh import (
+                make_mesh,
+            )
+
+            mesh = make_mesh()  # markets-only: the bit-exact regime
+        s_delta, r_delta, db_delta, j_delta, stats_delta = (
+            self._run_stream(tmp_path, f"delta-{sharded}", "auto", mesh)
+        )
+        s_full, r_full, db_full, j_full, stats_full = (
+            self._run_stream(tmp_path, f"full-{sharded}", "full", mesh)
+        )
+        for mine, ref in zip(r_delta, r_full):
+            assert mine.market_keys == ref.market_keys
+            np.testing.assert_array_equal(
+                np.asarray(mine.consensus), np.asarray(ref.consensus)
+            )
+        assert store_state(s_delta) == store_state(s_full)
+        assert db_delta.read_bytes() == db_full.read_bytes()
+        # Journals: compare REPLAYED state, the free-running-prefetch
+        # convention (PR 6): the prefetch thread may intern batch N+1's
+        # pairs before or after epoch N's snapshot depending on timing,
+        # so raw epoch membership is racy on THIS surface either mode —
+        # the dispatch-ordered epoch-bytes contract is pinned by
+        # TestLockstepJournalBytes below.
+        assert replayed_state(j_delta) == replayed_state(j_full)
+        # The delta stream actually took the delta path: the drifted
+        # batch interned FEWER pairs than the full walk, and the stable
+        # re-pack (same pair set, new signal pattern) interned zero.
+        interned_delta = [s["interned_pairs"] for s in stats_delta]
+        interned_full = [s["interned_pairs"] for s in stats_full]
+        assert interned_delta[0] == interned_full[0]  # cold = everything
+        assert interned_delta[1] == 0  # pair-fingerprint O(1) tier
+        assert 0 < interned_delta[2] < interned_full[2]  # the pair-delta
+        # Reorder: the epoch table holds the DRIFT batch, so only the
+        # drifted markets' pairs re-walk — still a fraction of the full
+        # pass (which re-walks every pair of every market).
+        assert interned_delta[3] < interned_full[3]
+
+    @pytest.mark.parametrize("fallback", [False, True],
+                             ids=["native", "fallback"])
+    def test_forced_sharded_route_is_byte_identical(
+        self, tmp_path, monkeypatch, fallback
+    ):
+        """The same matrix with the sharded probe+commit FORCED for
+        every miss set (threshold 1, two workers) — the deterministic-
+        merge contract at toy sizes. The fallback stack has no probe
+        entry and must degrade to the serial pass, same bytes."""
+        if fallback:
+            monkeypatch.setenv("BCE_NO_NATIVE", "1")
+        monkeypatch.setattr(interning, "SHARD_MIN_PAIRS", 1)
+        monkeypatch.setenv("BCE_INTERN_WORKERS", "2")
+        s_delta, r_delta, db_delta, j_delta, _ = self._run_stream(
+            tmp_path, "sharded", "auto", None
+        )
+        monkeypatch.setattr(interning, "SHARD_MIN_PAIRS", 1 << 18)
+        s_full, r_full, db_full, j_full, _ = self._run_stream(
+            tmp_path, "serial", "full", None
+        )
+        assert store_state(s_delta) == store_state(s_full)
+        assert db_delta.read_bytes() == db_full.read_bytes()
+        assert replayed_state(j_delta) == replayed_state(j_full)
+
+
+class TestLockstepJournalBytes:
+    """The epoch-membership byte contract where it is actually promised:
+    interning on the DISPATCH thread in batch order (the serve path's
+    PlanCache + SessionDriver shape — no free-running prefetch), a delta
+    and a full run must write byte-identical journal epochs (wall_ts
+    masked), pinning "which epoch a new pair's table row lands in" as a
+    pure function of the trace."""
+
+    def _run(self, tmp_path, name, intern_mode, forced_shard,
+             monkeypatch):
+        from bayesian_consensus_engine_tpu.serve.driver import (
+            PlanCache,
+            SessionDriver,
+        )
+        from bayesian_consensus_engine_tpu.state.journal import (
+            JournalWriter,
+        )
+
+        if forced_shard:
+            monkeypatch.setattr(interning, "SHARD_MIN_PAIRS", 1)
+            monkeypatch.setenv("BCE_INTERN_WORKERS", "2")
+        else:
+            monkeypatch.setattr(interning, "SHARD_MIN_PAIRS", 1 << 18)
+        store = TensorReliabilityStore()
+        jrnl = tmp_path / f"{name}.jrnl"
+        cache = PlanCache(store, intern_mode=intern_mode)
+        driver = SessionDriver(
+            store, steps=2, journal=JournalWriter(jrnl),
+            owns_journal=True, checkpoint_every=2, sync_checkpoints=True,
+        )
+        try:
+            for i, (batch, outcomes) in enumerate(matrix_batches()):
+                keys, sids, probs, offsets = batch
+                plan = cache.bind(cache.stage(keys, sids, probs, offsets))
+                driver.dispatch(plan, outcomes, now=21_800.0 + i)
+                driver.checkpoint(i)
+        finally:
+            driver.finalize()
+        return store, jrnl
+
+    @pytest.mark.parametrize("fallback", [False, True],
+                             ids=["native", "fallback"])
+    def test_epoch_bytes_are_trace_pure(self, tmp_path, monkeypatch,
+                                        fallback):
+        if fallback:
+            monkeypatch.setenv("BCE_NO_NATIVE", "1")
+        s_delta, j_delta = self._run(
+            tmp_path, "delta", "auto", True, monkeypatch
+        )
+        s_full, j_full = self._run(
+            tmp_path, "full", "full", False, monkeypatch
+        )
+        assert store_state(s_delta) == store_state(s_full)
+        assert journal_epochs_sans_clock(j_delta) == (
+            journal_epochs_sans_clock(j_full)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Units: resolve tiers, twins, known_rows, sharded interner.
+# ---------------------------------------------------------------------------
+
+
+def _staged(batch, intern_mode="auto"):
+    keys, sids, probs, offsets = batch
+    return stage_settlement_plan_columnar(
+        keys, sids, probs, offsets, intern_mode=intern_mode,
+    )
+
+
+class TestResolveTiers:
+    def test_fingerprint_hit_is_o1_and_identical(self):
+        base = matrix_batches()[0][0]
+        stable = matrix_batches()[1][0]
+        store = TensorReliabilityStore()
+        plan0 = _staged(base).bind(store)
+        assert plan0.intern_stats["interned_pairs"] > 0
+        # Same pair set, different signal pattern: topology fingerprint
+        # differs, pair fingerprint matches.
+        s0, s1 = _staged(base), _staged(stable)
+        assert topology_fingerprint(
+            base[0], base[1], base[3]
+        ) != topology_fingerprint(stable[0], stable[1], stable[3])
+        assert s0.pair_fingerprint == s1.pair_fingerprint
+        plan1 = s1.bind(store)
+        assert plan1.intern_stats["fingerprint_hit"] is True
+        assert plan1.intern_stats["interned_pairs"] == 0
+        np.testing.assert_array_equal(plan1.slot_rows, plan0.slot_rows)
+
+    def test_full_mode_never_consults_or_updates_the_table(self):
+        base = matrix_batches()[0][0]
+        store = TensorReliabilityStore()
+        _staged(base, intern_mode="full").bind(store)
+        assert store._pair_epoch is None
+        plan = _staged(base).bind(store)
+        # First delta bind on a full-warmed store: everything re-walks
+        # the interner (all hits — no new rows), nothing was cached.
+        assert plan.intern_stats["matched_pairs"] == 0
+
+    @pytest.mark.parametrize("native", [None, False])
+    def test_trailing_empty_market_does_not_split_the_check(self, native):
+        """Regression (round-15 review): a zero-pair market at the END of
+        the batch must not truncate the previous market's match segment.
+        Batch {m0: [a,b,c], m1: []} with m0's LAST source drifted — the
+        drifted pair sits exactly where the old clamped reduceat dropped
+        it, so m0 must MISS (all −1), never serve the stale row."""
+        po = np.array([0, 3, 3], np.int64)  # m0: 3 pairs, m1: empty
+        pr_old = np.array([0, 1, 2], np.int32)   # a, b, c
+        pr_new = np.array([0, 1, 3], np.int32)   # a, b, z — last pair drifts
+        rows_old = np.array([10, 11, 12], np.int32)
+        got = interning.delta_match_rows(
+            None, pr_new, po, pr_old, po, None, rows_old, native=native,
+        )
+        np.testing.assert_array_equal(got, [-1, -1, -1])
+        # And the unchanged batch still matches whole.
+        same = interning.delta_match_rows(
+            None, pr_old, po, pr_old, po, None, rows_old, native=native,
+        )
+        np.testing.assert_array_equal(same, rows_old)
+
+    def test_trailing_empty_market_end_to_end_parity(self, monkeypatch):
+        """The full reproduction through bind, on the forced-fallback
+        (numpy-twin) stack: the drifted pair must intern a NEW row, byte-
+        equal to the full-mode oracle."""
+        monkeypatch.setenv("BCE_NO_NATIVE", "1")
+        base = (["m0", "m1"], ["a", "b", "c"],
+                np.array([0.2, 0.4, 0.6]), np.array([0, 3, 3], np.int64))
+        drifted = (["m0", "m1"], ["a", "b", "z"],
+                   np.array([0.3, 0.5, 0.7]), np.array([0, 3, 3], np.int64))
+        store = TensorReliabilityStore()
+        _staged(base).bind(store)
+        plan_delta = _staged(drifted).bind(store)
+        oracle = TensorReliabilityStore()
+        _staged(base, intern_mode="full").bind(oracle)
+        plan_full = _staged(drifted, intern_mode="full").bind(oracle)
+        np.testing.assert_array_equal(
+            plan_delta.slot_rows, plan_full.slot_rows
+        )
+        assert store._pairs.ids() == oracle._pairs.ids()
+
+    @pytest.mark.parametrize("native", [None, False])
+    def test_empty_epoch_table_misses_everything(self, native):
+        """Regression (round-15 review): a zero-market epoch table must
+        return all-miss like the C pass, not IndexError in the twin."""
+        got = interning.delta_match_rows(
+            None,
+            np.array([0, 1], np.int32),      # one market, two pairs
+            np.array([0, 2], np.int64),
+            np.empty(0, np.int32), np.array([0], np.int64),
+            np.array([-1], np.int64),        # prev_of: nothing maps
+            np.empty(0, np.int32),
+            native=native,
+        )
+        np.testing.assert_array_equal(got, [-1, -1])
+
+    def test_empty_then_nonempty_batch_on_fallback(self, monkeypatch):
+        """End-to-end: seed the table with an EMPTY batch on the forced-
+        fallback stack, then bind a real one — must resolve (all-miss),
+        byte-equal to full mode."""
+        monkeypatch.setenv("BCE_NO_NATIVE", "1")
+        empty = ([], [], np.empty(0), np.array([0], np.int64))
+        real = (["m0"], ["a", "b"], np.array([0.1, 0.9]),
+                np.array([0, 2], np.int64))
+        store = TensorReliabilityStore()
+        _staged(empty).bind(store)
+        plan = _staged(real).bind(store)
+        oracle = TensorReliabilityStore()
+        _staged(empty, intern_mode="full").bind(oracle)
+        ref = _staged(real, intern_mode="full").bind(oracle)
+        np.testing.assert_array_equal(plan.slot_rows, ref.slot_rows)
+        assert store._pairs.ids() == oracle._pairs.ids()
+
+    @pytest.mark.parametrize("native", [None, False])
+    def test_delta_match_twins_agree(self, native):
+        rng = np.random.default_rng(7)
+        for _ in range(20):
+            m_old = int(rng.integers(1, 9))
+            m_new = int(rng.integers(1, 9))
+            counts_old = rng.integers(0, 4, m_old)
+            counts_new = rng.integers(0, 4, m_new)
+            po = np.concatenate([[0], np.cumsum(counts_old)]).astype(
+                np.int64
+            )
+            pn = np.concatenate([[0], np.cumsum(counts_new)]).astype(
+                np.int64
+            )
+            pr_old = rng.integers(0, 6, int(po[-1])).astype(np.int32)
+            pr_new = rng.integers(0, 6, int(pn[-1])).astype(np.int32)
+            rows_old = np.arange(int(po[-1]), dtype=np.int32) + 100
+            prev_of = rng.integers(-1, m_old, m_new).astype(np.int64)
+            rank_map = rng.integers(-1, 6, 6).astype(np.int32)
+            got = interning.delta_match_rows(
+                rank_map, pr_new, pn, pr_old, po, prev_of, rows_old,
+                native=native,
+            )
+            ref = interning.delta_match_rows(
+                rank_map, pr_new, pn, pr_old, po, prev_of, rows_old,
+                native=False,
+            )
+            np.testing.assert_array_equal(got, ref)
+            # Spot-check semantics per market against a scalar oracle.
+            for m in range(m_new):
+                lo, hi = int(pn[m]), int(pn[m + 1])
+                pm = int(prev_of[m])
+                want_match = 0 <= pm < m_old
+                if want_match:
+                    plo, phi = int(po[pm]), int(po[pm + 1])
+                    want_match = (phi - plo == hi - lo) and all(
+                        0 <= int(pr_new[k]) < 6
+                        and rank_map[int(pr_new[k])]
+                        == pr_old[plo + (k - lo)]
+                        for k in range(lo, hi)
+                    )
+                if want_match:
+                    assert (got[lo:hi] >= 0).all()
+                else:
+                    assert (got[lo:hi] == -1).all()
+
+
+class TestShardedInterner:
+    def _columns(self, n_pairs, n_src=12, n_mkt=40, seed=3):
+        rng = np.random.default_rng(seed)
+        src_table = [f"s{i}" for i in range(n_src)]
+        mkt_table = [f"m{i}" for i in range(n_mkt)]
+        return (
+            src_table,
+            rng.integers(0, n_src, n_pairs).astype(np.int32),
+            mkt_table,
+            rng.integers(0, n_mkt, n_pairs).astype(np.int32),
+        )
+
+    def test_sharded_equals_serial_rows_and_table(self):
+        pytest.importorskip(
+            "bayesian_consensus_engine_tpu._native.internmap"
+        )
+        cols = self._columns(4096)
+        a = interning.make_pair_interner()
+        b = interning.make_pair_interner()
+        if not interning.probe_supported(a):
+            pytest.skip("probe entry points not built")
+        serial = np.asarray(a.intern_arrays_indexed(*cols))
+        sharded = b.intern_indexed_sharded(*cols, workers=3)
+        np.testing.assert_array_equal(serial, sharded)
+        assert a.ids() == b.ids()
+        # Warm re-probe: all hits, nothing committed, same rows.
+        again = b.intern_indexed_sharded(*cols, workers=2)
+        np.testing.assert_array_equal(serial, again)
+
+    def test_probe_then_commit_split(self):
+        pytest.importorskip(
+            "bayesian_consensus_engine_tpu._native.internmap"
+        )
+        cols = self._columns(512)
+        interner = interning.make_pair_interner()
+        if not interning.probe_supported(interner):
+            pytest.skip("probe entry points not built")
+        # Pre-intern a prefix so the probe sees hits AND misses.
+        prefix = tuple(c[:200] if isinstance(c, np.ndarray) else c
+                       for c in cols)
+        interner.intern_arrays_indexed(*prefix)
+        rows, hashes, slots, cap = interner.probe_pairs_sharded(
+            *cols, workers=2
+        )
+        miss_mask = rows < 0
+        assert miss_mask.any() and (~miss_mask).any()
+        committed = interner.commit_probed(*cols, rows, hashes, slots, cap)
+        assert committed == int(miss_mask.sum())
+        reference = interning.make_pair_interner()
+        np.testing.assert_array_equal(
+            rows, np.asarray(reference.intern_arrays_indexed(*cols))
+        )
+
+
+class TestKnownRows:
+    def test_known_rows_fast_path(self):
+        store = TensorReliabilityStore()
+        sources = ["a", "b", "a", "c"]
+        markets = ["m", "m", "n", "n"]
+        full = store.rows_for_arrays(sources, markets)
+        partial = np.array([full[0], -1, -1, -1], np.int32)
+        again = store.rows_for_arrays(
+            sources, markets, known_rows=partial
+        )
+        np.testing.assert_array_equal(again, full)
+        # Pair-tuple surface rides the same path.
+        pairs = list(zip(sources, markets))
+        np.testing.assert_array_equal(
+            store.rows_for_pairs(pairs, known_rows=full), full
+        )
+
+    def test_known_rows_assigns_in_batch_order(self):
+        reference = TensorReliabilityStore()
+        ref_rows = reference.rows_for_arrays(
+            ["a", "b", "c"], ["m", "m", "m"]
+        )
+        store = TensorReliabilityStore()
+        rows = store.rows_for_arrays(
+            ["a", "b", "c"], ["m", "m", "m"],
+            known_rows=np.array([-1, -1, -1], np.int32),
+        )
+        np.testing.assert_array_equal(rows, ref_rows)
+        assert store._pairs.ids() == reference._pairs.ids()
+
+    def test_known_rows_rejects_lookup_mode(self):
+        store = TensorReliabilityStore()
+        with pytest.raises(ValueError, match="allocate=False"):
+            store.rows_for_arrays(
+                ["a"], ["m"], allocate=False,
+                known_rows=np.array([-1], np.int32),
+            )
+
+
+class TestRecoveryInvalidation:
+    """Adoption/replay intern outside the bind trace: the epoch table
+    must DROP, and a post-recovery delta bind must re-witness (miss),
+    producing the same bytes as a full bind."""
+
+    def _warm(self):
+        store = TensorReliabilityStore()
+        base = matrix_batches()[0][0]
+        _staged(base).bind(store)
+        assert store._pair_epoch is not None
+        return store, base
+
+    def test_absorb_replayed_rows_drops_the_table(self):
+        store, _ = self._warm()
+        rows = store.rows_for_arrays(["x"], ["y"])
+        store.absorb_replayed_rows(
+            rows, np.array([0.7]), np.array([0.6]),
+            np.array([20_000.0]), np.array([True]),
+            ["2024-09-30T00:00:00+00:00"],
+        )
+        assert store._pair_epoch is None
+
+    def test_journal_replay_drops_the_table(self):
+        """The replay hook (`_apply_journal_epoch` — what
+        ``replay_journal`` and the cluster merge drive) interns outside
+        the bind trace: the warmed table must drop."""
+        replayed, base = self._warm()
+        assert replayed._pair_epoch is not None
+        replayed._apply_journal_epoch(
+            len(replayed._pairs) + 1,
+            [("zz", "qq")],
+            np.array([len(replayed._pairs)], dtype=np.int64),
+            np.array([0.5]), np.array([0.5]),
+            np.array([20_100.0]), np.array([True]),
+            ["2024-01-01T00:00:00+00:00"],
+        )
+        assert replayed._pair_epoch is None
+
+    def test_post_adoption_delta_bind_matches_full(self):
+        """After an adoption-shaped mutation, the next delta bind misses
+        (cold table) and still produces full-pass bytes."""
+        store, base = self._warm()
+        rows = store.rows_for_arrays(["adopted-src"], ["adopted-mkt"])
+        store.absorb_replayed_rows(
+            rows, np.array([0.9]), np.array([0.8]),
+            np.array([20_050.0]), np.array([True]),
+            ["2024-11-30T00:00:00+00:00"],
+        )
+        drift = matrix_batches()[2][0]
+        plan_delta = _staged(drift).bind(store)
+        assert plan_delta.intern_stats["matched_pairs"] == 0  # re-witness
+        reference = TensorReliabilityStore()
+        _staged(base, intern_mode="full").bind(reference)
+        ref_rows = reference.rows_for_arrays(
+            ["adopted-src"], ["adopted-mkt"]
+        )
+        reference.absorb_replayed_rows(
+            ref_rows, np.array([0.9]), np.array([0.8]),
+            np.array([20_050.0]), np.array([True]),
+            ["2024-11-30T00:00:00+00:00"],
+        )
+        plan_full = _staged(drift, intern_mode="full").bind(reference)
+        np.testing.assert_array_equal(
+            plan_delta.slot_rows, plan_full.slot_rows
+        )
+        assert store._pairs.ids() == reference._pairs.ids()
+
+
+class TestPairFingerprint:
+    def test_reorder_misses(self):
+        base = matrix_batches()[0][0]
+        reorder = matrix_batches()[3][0]
+        assert _staged(base).pair_fingerprint != (
+            _staged(reorder).pair_fingerprint
+        )
+
+    def test_full_mode_skips_the_digest(self):
+        base = matrix_batches()[0][0]
+        assert _staged(base, intern_mode="full").pair_fingerprint is None
+
+    def test_rejects_unknown_mode(self):
+        base = matrix_batches()[0][0]
+        with pytest.raises(ValueError, match="intern_mode"):
+            _staged(base, intern_mode="wat")
+
+    def test_tables_are_length_delimited(self):
+        # ("ab","c") vs ("a","bc") must not collide through the joined
+        # table bytes.
+        fp1 = pair_fingerprint(
+            ["m"], ["ab", "c"], np.array([0, 0], np.int32),
+            np.array([0, 1], np.int32), np.array([0, 2], np.int64),
+        )
+        fp2 = pair_fingerprint(
+            ["m"], ["a", "bc"], np.array([0, 0], np.int32),
+            np.array([0, 1], np.int32), np.array([0, 2], np.int64),
+        )
+        assert fp1 != fp2

@@ -198,6 +198,42 @@ class TestHistogramQuantile:
         assert "hbm_read" in rendered.splitlines()[0]
         assert "48MB" in rendered
 
+    def test_ledger_intern_min_across_repeats(self, tmp_path):
+        # extras.intern_s (the round-15 ingest/stream/serve legs:
+        # seconds inside the pair-interning pass) folds to the MIN
+        # across repeats and renders as the stats table's intern column.
+        path = tmp_path / "intern.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            for intern_s in (0.31, 0.024):
+                ledger.record(
+                    "e2e_ingest_drift.drift1", value=1.0, unit="s",
+                    extras={"intern_s": intern_s},
+                )
+            ledger.record("plain_leg", value=2.0, unit="s")
+        records = obs.read_ledger(path)
+        summary = obs.summarize(records)
+        assert summary["e2e_ingest_drift.drift1"]["intern_s"] == 0.024
+        assert "intern_s" not in summary["plain_leg"]
+        rendered = obs_ledger.render(records)
+        assert "intern" in rendered.splitlines()[0]
+
+    def test_diff_bands_carries_intern_metric(self, tmp_path):
+        def ledger_records(path, intern_s):
+            with obs.RunLedger(path, run_id="r") as ledger:
+                ledger.record(
+                    "e2e_ingest_drift.drift1", value=1.0, unit="s",
+                    extras={"intern_s": intern_s},
+                )
+            return obs.read_ledger(path)
+
+        old = ledger_records(tmp_path / "old.jsonl", 0.3)
+        new = ledger_records(tmp_path / "new.jsonl", 0.024)
+        diff = obs.diff_bands(old, new)
+        metric = diff["e2e_ingest_drift.drift1"]["metrics"]["intern_s"]
+        assert metric == {"old": 0.3, "new": 0.024}
+        rendered = obs.render_diff(diff)
+        assert "intern 0.3->0.024" in rendered
+
     def test_diff_bands_carries_hbm_read_metric(self, tmp_path):
         def ledger_records(path, read):
             with obs.RunLedger(path, run_id="r") as ledger:
