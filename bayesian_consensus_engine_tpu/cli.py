@@ -18,8 +18,10 @@ Extensions (additive, do not change reference-shaped outputs): ``--backend
 ``--db`` — the crash-recovery path without writing Python; ``lint`` runs
 graftlint, the repo's JAX/determinism/layering static analysis
 (docs/static-analysis.md); ``stats`` renders an obs run ledger
-(obs/ledger.py JSONL — the min-of-N bench discipline) as per-leg bands;
-``trace`` converts a request-tracing span log (obs/trace.py JSONL) to
+(obs/ledger.py JSONL — the min-of-N bench discipline) as per-leg bands
+and, with ``--live URL``, a running telemetry exporter's scraped
+snapshot + health verdict (obs/export.py) beside them; ``trace``
+converts a request-tracing span log (obs/trace.py JSONL) to
 Chrome/Perfetto trace-event JSON.
 """
 
@@ -234,14 +236,24 @@ def _run_stats(args: argparse.Namespace) -> None:
     overlap, driven toward zero for drifting topologies); legs carrying
     recovery accounting (``extras.recovery_s`` + ``extras.slo`` — the
     kill-soak leg) render the ``recovery`` column beside ``goodput``,
-    the failure story in one row. ``--json`` emits the machine-shaped
-    summary instead of the table.
+    the failure story in one row; SLO-carrying legs (the serve and
+    kill-soak legs) additionally render the ``slo`` column — the
+    absolute offered-but-not-met count beside the goodput fraction,
+    diffed by ``--against`` like ``hbm_read``. ``--json`` emits the
+    machine-shaped summary instead of the table.
 
     ``--against OLD.jsonl`` switches to cross-round diffing: each leg's
     band is compared against the old ledger's and flagged when the bands
     stopped overlapping (``shifted_up``/``shifted_down`` — the
     regression signal the VERDICT previously extracted by hand; which
     direction is the regression depends on the leg's unit).
+
+    ``--live URL`` scrapes a running telemetry exporter
+    (obs/export.py — the ``/snapshot`` and ``/healthz`` endpoints a
+    ``ConsensusService.start_telemetry`` or kill-soak worker serves) and
+    renders the live counters/gauges/latency histograms, phase sums, and
+    burn-rate health verdict — next to the ledger bands when a ledger is
+    also given, alone otherwise (the ledger argument becomes optional).
     """
     from bayesian_consensus_engine_tpu.obs.ledger import (
         diff_bands,
@@ -251,8 +263,36 @@ def _run_stats(args: argparse.Namespace) -> None:
         summarize,
     )
 
+    if args.ledger is None and not args.live:
+        print("Error: give a ledger path and/or --live URL", file=sys.stderr)
+        raise SystemExit(1)
+    if args.against and args.ledger is None:
+        # Diffing needs a NEW ledger to stand on — a live scrape is not
+        # band-shaped data, and diffing OLD against nothing would render
+        # every leg as removed.
+        print(
+            "Error: --against diffs two ledgers — give the new ledger "
+            "path too",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+
+    live_snapshot = live_health = None
+    if args.live:
+        from bayesian_consensus_engine_tpu.obs.export import scrape_endpoint
+
+        base = args.live.rstrip("/")
+        try:
+            _status, live_snapshot = scrape_endpoint(base + "/snapshot")
+            # A 503 /healthz (burning/degraded) still carries the
+            # verdict in its body — scrape_endpoint parses it either way.
+            _status, live_health = scrape_endpoint(base + "/healthz")
+        except (OSError, ValueError) as exc:
+            print(f"Error: scrape of {base} failed: {exc}", file=sys.stderr)
+            raise SystemExit(1) from exc
+
     try:
-        records = read_ledger(args.ledger)
+        records = read_ledger(args.ledger) if args.ledger else []
         old_records = (
             read_ledger(args.against) if args.against else None
         )
@@ -265,21 +305,45 @@ def _run_stats(args: argparse.Namespace) -> None:
             old_records = [
                 r for r in old_records if r.get("leg") == args.leg
             ]
+    def _print_live() -> None:
+        if live_snapshot is None:
+            return
+        from bayesian_consensus_engine_tpu.obs.export import (
+            render_live_snapshot,
+        )
+
+        print(f"{args.live} (live)")
+        print(render_live_snapshot(live_snapshot, live_health))
+
+    live_payload = (
+        {"url": args.live, "snapshot": live_snapshot,
+         "healthz": live_health}
+        if live_snapshot is not None else None
+    )
     if old_records is not None:
         diff = diff_bands(old_records, records)
         if args.json:
-            _emit({"ledger": args.ledger, "against": args.against,
-                   "legs": diff})
+            document = {"ledger": args.ledger, "against": args.against,
+                        "legs": diff}
+            if live_payload is not None:
+                document["live"] = live_payload
+            _emit(document)
         else:
             print(f"{args.ledger} vs {args.against}")
             print(render_diff(diff))
+            _print_live()
         return
     if args.json:
-        _emit({"ledger": args.ledger, "records": len(records),
-               "legs": summarize(records)})
+        document = {"ledger": args.ledger, "records": len(records),
+                    "legs": summarize(records)}
+        if live_payload is not None:
+            document["live"] = live_payload
+        _emit(document)
     else:
-        print(f"{args.ledger}: {len(records)} records")
-        print(render(records))
+        if args.ledger is not None:
+            print(f"{args.ledger}: {len(records)} records")
+            print(render(records))
+        _print_live()
 
 
 def _run_trace(args: argparse.Namespace) -> None:
@@ -421,7 +485,11 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     stats.add_argument(
-        "ledger", help="path to a JSONL run ledger (bench.py --ledger)"
+        "ledger", nargs="?", default=None,
+        help=(
+            "path to a JSONL run ledger (bench.py --ledger); optional "
+            "when --live is given"
+        ),
     )
     stats.add_argument("--leg", help="restrict to one leg name")
     stats.add_argument(
@@ -429,6 +497,13 @@ def build_parser() -> argparse.ArgumentParser:
         help=(
             "cross-round diff: compare each leg's band against this "
             "older ledger and flag bands that stopped overlapping"
+        ),
+    )
+    stats.add_argument(
+        "--live", metavar="URL",
+        help=(
+            "scrape a running telemetry exporter (obs/export.py) and "
+            "render its /snapshot + /healthz next to the ledger bands"
         ),
     )
     stats.add_argument(

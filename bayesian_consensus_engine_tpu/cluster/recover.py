@@ -34,15 +34,26 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass
 from pathlib import Path
+from time import perf_counter
 from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from bayesian_consensus_engine_tpu.obs.trace import active_tracer
 from bayesian_consensus_engine_tpu.state.journal import (
     MAGIC,
     _iter_frames,
     _read_exact,
 )
+
+#: Trace scope for live-recovery spans (obs/trace.py). Recovery runs
+#: between batches on a surviving host — if a dispatch failure lands
+#: WHILE an adoption is in flight, the crash postmortem must say so,
+#: which is why :func:`adopt_journal` records its start before the
+#: replay walk begins (the flight ring then holds an adopt_start with
+#: no adopt_done — recovery-in-progress, captured; pinned by
+#: tests/test_cluster.py).
+RECOVERY_SCOPE = "recovery"
 
 
 class ClusterModeUnsupported(RuntimeError):
@@ -164,8 +175,33 @@ def adopt_journal(store, path: Union[str, Path]) -> Tuple[Optional[int], int]:
     — split-brain), hence disjoint from every pending device recipe of
     the live stream: the adoption never stalls on, nor perturbs, the
     survivor's own deferred settlements.
+
+    When a tracer is active the adoption records a ``recovery``-scope
+    span chain (``adopt_start`` before the replay walk, ``adopt_done``
+    with the rows/tag after) on its own flight-recorder component — a
+    dispatch failure mid-adoption leaves a postmortem that SHOWS the
+    recovery in flight.
     """
-    return _replay_into(store, str(path))
+    tracer = active_tracer()
+    if tracer.enabled:
+        tracer.span_event(
+            RECOVERY_SCOPE, 0, "adopt_start",
+            args={"journal": str(path)}, component="recovery",
+        )
+    start = perf_counter()
+    tag, rows_adopted = _replay_into(store, str(path))
+    if tracer.enabled:
+        tracer.span_event(
+            RECOVERY_SCOPE, 0, "adopt_done",
+            dur_s=perf_counter() - start,
+            args={
+                "journal": str(path),
+                "rows_adopted": rows_adopted,
+                "tag": tag,
+            },
+            component="recovery",
+        )
+    return tag, rows_adopted
 
 
 def store_digest(store) -> str:

@@ -85,10 +85,31 @@ LAYER_IMPORT_OVERRIDES: dict[str, frozenset[str]] = {
 #: (``ops/uncertainty.py``, ``ops/propagate.py``) live in ``ops`` and so
 #: stay instrumentation-free like every other kernel — the round-12
 #: decision that keeps the bands math timeable without ever being able
-#: to time itself. bench/scripts/tests live outside
+#: to time itself. ``cluster`` joined in round 16: live recovery
+#: (``adopt_journal``) records ``recovery``-scope trace spans so a crash
+#: postmortem can show an adoption in flight — orchestration-adjacent
+#: instrumentation, same as analytics. bench/scripts/tests live outside
 #: the package and are unconstrained.
 OBS_ALLOWED_IMPORTERS: frozenset[str] = frozenset(
-    {"obs", "pipeline", "serve", "state", "cli", "analytics", "__init__"}
+    {
+        "obs", "pipeline", "serve", "state", "cli", "analytics",
+        "cluster", "__init__",
+    }
+)
+
+#: The READ side of obs (round 16): the telemetry exporter, the fleet
+#: merge, and the burn-rate health evaluator READ metrics back out.
+#: "Write-only obs" is only a structural property if the engine tiers
+#: can never grow a read-back path, so these submodules are confined
+#: further than the rest of obs: only ``serve`` (the service exposes the
+#: exporter and consumes the admission signal) and ``cli`` (``stats
+#: --live``) may import them — ``pipeline``/``state``/``analytics``/
+#: ``cluster`` may keep WRITING metrics/spans but must never import the
+#: read surface. Enforced by the LY303 extension.
+OBS_READ_SURFACE: frozenset[str] = frozenset({"export", "fleet", "health"})
+
+OBS_READ_SURFACE_IMPORTERS: frozenset[str] = frozenset(
+    {"obs", "serve", "cli"}
 )
 
 #: Deliberate exceptions to the layer map: (importer_segment,

@@ -13,6 +13,7 @@ the numeric map alone would let a kernel module import it.
 from __future__ import annotations
 
 import ast
+import sys
 
 from bayesian_consensus_engine_tpu.lint import config
 from bayesian_consensus_engine_tpu.lint.registry import rule
@@ -121,6 +122,14 @@ def check_layer_imports(ctx):
             )
 
 
+def _obs_submodule(dotted: str):
+    """``pkg.obs.export`` → ``export``; None for non-obs / bare obs."""
+    prefix = f"{config.PACKAGE}.obs."
+    if not dotted.startswith(prefix):
+        return None
+    return dotted[len(prefix):].split(".")[0]
+
+
 @rule(
     "LY303",
     name="obs-outside-orchestration",
@@ -129,16 +138,58 @@ def check_layer_imports(ctx):
         "the orchestration layers; a pure-math module that imports it is "
         "one refactor away from reading wall clock inside a kernel — "
         "only the segments in lint/config.OBS_ALLOWED_IMPORTERS may "
-        "import obs"
+        "import obs. Two round-16 extensions: obs itself is stdlib-only "
+        "(an obs module importing jax/numpy could drag a backend into "
+        "every orchestration import), and the READ surface (obs.export/"
+        "obs.fleet/obs.health) is confined to serve/cli — engine tiers "
+        "may write metrics but never read them back (write-only obs, "
+        "enforced)"
     ),
     scope=_package,
 )
 def check_obs_imports(ctx):
     seg = config.segment_of(ctx.rel)
-    if seg is None or seg in config.OBS_ALLOWED_IMPORTERS:
+    if seg is None:
+        return
+    if seg == "obs":
+        # obs is stdlib-only by contract: intra-obs imports are free
+        # (and intra-package imports are already pinned to nothing by
+        # the LY301 override); anything else must be standard library.
+        stdlib = getattr(sys, "stdlib_module_names", None)
+        if stdlib is None:  # pre-3.10 interpreter: nothing to check on
+            return
+        for lineno, target in _imported_modules(ctx):
+            if _segment_of_module(target) is not None:
+                continue
+            top = target.split(".")[0]
+            if top and top not in stdlib:
+                yield lineno, (
+                    f"`obs` is stdlib-only by contract but imports "
+                    f"`{top}` — host-side observability must never drag "
+                    "a third-party dependency into the orchestration "
+                    "layers"
+                )
         return
     for lineno, target in _imported_modules(ctx):
-        if _segment_of_module(target) == "obs":
+        if _segment_of_module(target) != "obs":
+            continue
+        sub = _obs_submodule(target)
+        if (
+            sub in config.OBS_READ_SURFACE
+            and seg not in config.OBS_READ_SURFACE_IMPORTERS
+        ):
+            allowed = ", ".join(
+                sorted(config.OBS_READ_SURFACE_IMPORTERS - {"obs"})
+            )
+            yield lineno, (
+                f"`{seg}` imports the obs READ surface (`obs.{sub}`) — "
+                f"write-only obs: engine modules may write metrics but "
+                f"never read them back; only {allowed} (plus bench/"
+                "scripts/tests outside the package) may import the "
+                "exporter/fleet/health surface"
+            )
+            continue
+        if seg not in config.OBS_ALLOWED_IMPORTERS:
             allowed = ", ".join(sorted(config.OBS_ALLOWED_IMPORTERS))
             yield lineno, (
                 f"`{seg}` imports `obs` — observability is confined to "

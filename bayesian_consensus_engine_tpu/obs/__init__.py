@@ -19,6 +19,15 @@ Five pillars, one contract:
   measurement (host load, backend, repeat index) plus the min-of-N
   repeat-policy helpers; rendered by ``bce-tpu stats``.
 
+Round 16 added the READ side — the live telemetry plane — as three
+modules that are deliberately NOT re-exported here (importers must name
+them, which is how lint rule LY303's read-surface extension confines
+them to ``serve``/``cli``): :mod:`~.obs.export` (the stdlib HTTP
+exporter: deterministic ``/metrics``, ``/snapshot``, ``/healthz``),
+:mod:`~.obs.fleet` (deterministic cross-host snapshot merge with
+explicit ``hosts_absent``), and :mod:`~.obs.health` (multi-window SLO
+burn-rate verdicts). See docs/observability.md.
+
 The contract: obs is pure host, stdlib-only, never traced by JAX, and
 write-only from the engine's point of view — enabling it changes NO
 settlement byte (golden-fixture parity pinned by tests/test_obs.py; the

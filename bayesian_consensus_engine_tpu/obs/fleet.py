@@ -1,0 +1,250 @@
+"""Deterministic cluster-wide metric aggregation: one fleet view, any
+observer.
+
+The cluster layer's one invariant — agreement without a coordinator
+(:class:`~.cluster.membership.MeshView` derives every layout from the
+sorted host set alone) — applies to telemetry too: if two survivors fold
+the same set of per-host snapshots into two different fleet views, the
+fleet has two health stories. This module makes the fold canonical:
+
+* :class:`HostSnapshot` — one host's ``(host_id, epoch, registry
+  export)`` record, exactly what the telemetry server's ``/snapshot``
+  endpoint serves (:func:`snapshot_from_wire` lifts a scraped payload);
+  :func:`snapshot_to_json` serialises it byte-deterministically.
+* :func:`merge_fleet` — fold a snapshot set into ONE fleet view using
+  the membership discipline: hosts sorted ascending, per-host a host's
+  HIGHEST epoch snapshot wins (a stale pre-recovery scrape never
+  overwrites a post-recovery one), counters SUM across hosts,
+  histograms merge by bucket-count summation (identical bounds
+  required — the layout is schema), and gauges stay PER-HOST series
+  (a gauge is a statement about one host; summing queue depths across
+  hosts would invent a queue nobody owns). Any two observers of the
+  same snapshot set produce the same view and — through
+  :func:`render_fleet_prometheus` / :func:`fleet_to_json` — the same
+  BYTES (pinned by tests/test_fleet_obs.py).
+* **Absence is explicit.** ``expected_hosts`` (a
+  :attr:`~.cluster.membership.MeshView.hosts`-shaped id sequence)
+  declares who SHOULD be reporting; members with no snapshot land in
+  ``hosts_absent`` and the rendered ``bce_fleet_hosts_absent`` gauge —
+  a ``degraded()`` membership change shows up as a first-class series,
+  never as silently missing data.
+
+Stdlib-only, read-side (LY303's read-surface extension confines
+importers to ``serve``/``cli`` plus bench/scripts/tests): the fold runs
+wherever an operator stands, never inside the engine.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence
+
+from bayesian_consensus_engine_tpu.obs.export import (
+    format_labels,
+    format_metric_value,
+    render_histogram_lines,
+    sanitize_metric_name,
+)
+
+
+@dataclass(frozen=True)
+class HostSnapshot:
+    """One host's epoch-tagged metric snapshot.
+
+    ``metrics`` is a :meth:`~.obs.metrics.MetricsRegistry.export`-shaped
+    dict (``counters``/``gauges``/``histograms``). Instances are what a
+    host publishes and what every observer folds — the fold never goes
+    back to the host.
+    """
+
+    host_id: int
+    epoch: int
+    metrics: Mapping[str, Mapping]
+
+    def __post_init__(self) -> None:
+        if self.epoch < 0:
+            raise ValueError(f"epoch must be >= 0; got {self.epoch}")
+
+
+def snapshot_host(host_id: int, epoch: int, registry) -> HostSnapshot:
+    """This host's snapshot of *registry*, tagged with its membership
+    identity — the publish half of the fleet fold."""
+    return HostSnapshot(
+        host_id=int(host_id), epoch=int(epoch), metrics=registry.export()
+    )
+
+
+def snapshot_to_json(snapshot: HostSnapshot) -> str:
+    """Byte-deterministic serialisation (sorted keys, fixed separators —
+    the DT203 contract): what a host writes to the wire or a soak dir."""
+    return json.dumps(
+        {
+            "host_id": snapshot.host_id,
+            "epoch": snapshot.epoch,
+            "metrics": snapshot.metrics,
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+
+
+def snapshot_from_json(raw: str) -> HostSnapshot:
+    return snapshot_from_wire(json.loads(raw))
+
+
+def snapshot_from_wire(payload: Mapping[str, object]) -> HostSnapshot:
+    """Lift a scraped ``/snapshot`` payload (or a
+    :func:`snapshot_to_json` round trip) into a :class:`HostSnapshot` —
+    extra endpoint fields (phases, trace, health) are ignored; the fleet
+    fold is a metrics fold."""
+    return HostSnapshot(
+        host_id=int(payload["host_id"]),
+        epoch=int(payload["epoch"]),
+        metrics=dict(payload["metrics"]),
+    )
+
+
+def merge_fleet(
+    snapshots: Sequence[HostSnapshot],
+    expected_hosts: Optional[Sequence[int]] = None,
+) -> Dict[str, object]:
+    """Fold a snapshot set into the canonical fleet view.
+
+    Deterministic regardless of input order: snapshots are keyed by
+    host, each host's highest-epoch snapshot wins (two snapshots for one
+    host at ONE epoch is a contradiction and raises — the telemetry
+    analogue of the split-brain refusal), hosts iterate sorted
+    ascending — the same ordering discipline ``MeshView`` lays bands out
+    with, which is what lets any observer reproduce any other's bytes.
+    """
+    if not snapshots:
+        raise ValueError("no snapshots to merge")
+    # Conflicts are checked per (host, epoch) over the WHOLE input —
+    # not just against the current winner — so the refusal itself is
+    # order-independent: a conflict at a superseded epoch still refuses
+    # no matter where the superseding snapshot sat in the sequence.
+    seen: Dict[tuple, HostSnapshot] = {}
+    latest: Dict[int, HostSnapshot] = {}
+    for snap in snapshots:
+        held_at_epoch = seen.get((snap.host_id, snap.epoch))
+        if held_at_epoch is None:
+            seen[(snap.host_id, snap.epoch)] = snap
+        elif held_at_epoch.metrics != snap.metrics:
+            raise ValueError(
+                f"two conflicting snapshots for host {snap.host_id} "
+                f"at epoch {snap.epoch} — refusing to merge"
+            )
+        held = latest.get(snap.host_id)
+        if held is None or snap.epoch > held.epoch:
+            latest[snap.host_id] = snap
+    hosts = sorted(latest)
+    epoch = max(snap.epoch for snap in latest.values())
+    expected = (
+        sorted(int(h) for h in expected_hosts)
+        if expected_hosts is not None else hosts
+    )
+    absent = sorted(set(expected) - set(hosts))
+
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, Dict[str, float]] = {}
+    histograms: Dict[str, Dict[str, object]] = {}
+    per_host_epochs: Dict[str, int] = {}
+    for host in hosts:
+        snap = latest[host]
+        per_host_epochs[str(host)] = snap.epoch
+        metrics = snap.metrics
+        for name in sorted(metrics.get("counters", {})):
+            counters[name] = counters.get(name, 0) + int(
+                metrics["counters"][name]
+            )
+        for name in sorted(metrics.get("gauges", {})):
+            gauges.setdefault(name, {})[str(host)] = float(
+                metrics["gauges"][name]
+            )
+        for name in sorted(metrics.get("histograms", {})):
+            snap_hist = metrics["histograms"][name]
+            merged = histograms.get(name)
+            if merged is None:
+                histograms[name] = {
+                    "bounds": list(snap_hist["bounds"]),
+                    "counts": list(snap_hist["counts"]),
+                    "count": int(snap_hist["count"]),
+                    "sum": float(snap_hist["sum"]),
+                }
+                continue
+            if list(snap_hist["bounds"]) != merged["bounds"]:
+                raise ValueError(
+                    f"histogram {name!r}: bucket layouts differ across "
+                    "hosts — the layout is schema; cannot merge"
+                )
+            merged["counts"] = [
+                a + b for a, b in zip(merged["counts"], snap_hist["counts"])
+            ]
+            merged["count"] += int(snap_hist["count"])
+            merged["sum"] += float(snap_hist["sum"])
+    return {
+        "epoch": epoch,
+        "hosts": hosts,
+        "host_epochs": per_host_epochs,
+        "expected_hosts": expected,
+        "hosts_absent": absent,
+        "counters": {name: counters[name] for name in sorted(counters)},
+        "gauges": {name: gauges[name] for name in sorted(gauges)},
+        "histograms": {
+            name: histograms[name] for name in sorted(histograms)
+        },
+    }
+
+
+def fleet_to_json(view: Mapping[str, object]) -> str:
+    """Byte-deterministic dump of a :func:`merge_fleet` view — the
+    observer-agreement witness (two observers, same snapshot set, same
+    bytes)."""
+    return json.dumps(view, sort_keys=True, separators=(",", ":"))
+
+
+def render_fleet_prometheus(
+    view: Mapping[str, object], prefix: str = "bce"
+) -> str:
+    """Prometheus text exposition of a fleet view.
+
+    Counters render fleet-summed (no labels), gauges render one labeled
+    series per host (``bce_x{host="0"}``, hosts sorted), histograms
+    render bucket-merged; ``bce_fleet_epoch`` / ``bce_fleet_hosts`` /
+    ``bce_fleet_hosts_absent`` carry the membership story. Same
+    determinism contract as the single-host renderer: identical view,
+    identical bytes.
+    """
+    lines: List[str] = []
+    for name, value in (
+        ("fleet.epoch", view["epoch"]),
+        ("fleet.hosts", len(view["hosts"])),
+        ("fleet.hosts_absent", len(view["hosts_absent"])),
+    ):
+        metric = sanitize_metric_name(name, prefix)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {format_metric_value(value)}")
+    for raw_name in sorted(view.get("counters", {})):
+        metric = sanitize_metric_name(raw_name, prefix)
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(
+            f"{metric} {format_metric_value(view['counters'][raw_name])}"
+        )
+    for raw_name in sorted(view.get("gauges", {})):
+        metric = sanitize_metric_name(raw_name, prefix)
+        lines.append(f"# TYPE {metric} gauge")
+        per_host = view["gauges"][raw_name]
+        for host in sorted(per_host, key=int):
+            lines.append(
+                f"{metric}{format_labels({'host': host})} "
+                f"{format_metric_value(per_host[host])}"
+            )
+    for raw_name in sorted(view.get("histograms", {})):
+        lines.extend(
+            render_histogram_lines(
+                sanitize_metric_name(raw_name, prefix),
+                view["histograms"][raw_name],
+            )
+        )
+    return "\n".join(lines) + "\n" if lines else ""

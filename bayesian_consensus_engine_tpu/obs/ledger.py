@@ -404,6 +404,13 @@ def _slo_summary(
         "slo_objective_s": objective,
         "slo_counts": merged,
         "goodput_within_slo": goodput_from_counts(merged),
+        # Every offered-but-not-met outcome, summed: the absolute SLO
+        # damage next to the goodput fraction (a goodput dip over 10
+        # offered and one over 10k read very differently) — the `slo`
+        # stats column, diffed by --against like hbm_read.
+        "slo_violations": sum(
+            int(v) for k, v in merged.items() if k != "met"
+        ),
     }
 
 
@@ -475,9 +482,9 @@ def diff_bands(
         entry: Dict[str, object] = {"leg": leg, "status": status,
                                     "old": old_band, "new": new_band}
         metrics: Dict[str, Dict[str, object]] = {}
-        for name in ("p50", "p99", "goodput_within_slo", "ingest_wait_s",
-                     "intern_s", "hbm_peak_bytes", "hbm_read_bytes",
-                     "recovery_s"):
+        for name in ("p50", "p99", "goodput_within_slo", "slo_violations",
+                     "ingest_wait_s", "intern_s", "hbm_peak_bytes",
+                     "hbm_read_bytes", "recovery_s"):
             old_value = (old_band or {}).get(name)
             new_value = (new_band or {}).get(name)
             if old_value is not None or new_value is not None:
@@ -511,6 +518,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             return f"{x:.4g}" if isinstance(x, (int, float)) else "-"
         label = {
             "goodput_within_slo": "goodput",
+            "slo_violations": "slo",
             "ingest_wait_s": "ingest_wait",
             "intern_s": "intern",
             "hbm_peak_bytes": "peak_mem",
@@ -530,9 +538,9 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             moved += 1
         trailer = "".join(
             metric_str(entry, name)
-            for name in ("p99", "goodput_within_slo", "ingest_wait_s",
-                         "intern_s", "hbm_peak_bytes", "hbm_read_bytes",
-                         "recovery_s")
+            for name in ("p99", "goodput_within_slo", "slo_violations",
+                         "ingest_wait_s", "intern_s", "hbm_peak_bytes",
+                         "hbm_read_bytes", "recovery_s")
         )
         lines.append(
             f"{leg:<34} {band_str(entry['old']):>16} "
@@ -552,9 +560,11 @@ def render(records: List[Dict[str, object]]) -> str:
 
     The ``p50``/``p99`` columns render for legs whose records carry
     per-request latency distributions (``extras.latency_hist`` — the
-    serving bench), ``goodput`` for legs carrying SLO accounting
-    (``extras.slo`` — the fraction of offered requests that completed
-    within the objective), ``ingest_w`` for legs carrying consumer
+    serving bench), ``goodput`` and ``slo`` for legs carrying SLO
+    accounting (``extras.slo`` — the fraction of offered requests that
+    completed within the objective, and the absolute count that did
+    NOT: violated + shed + rejected + failed, merged across repeats),
+    ``ingest_w`` for legs carrying consumer
     ingest-wait seconds (``extras.ingest_wait_s`` — the stream/serve
     legs; ≈ 0 means packing fully overlapped behind device compute),
     ``intern`` for legs carrying pair-interning seconds
@@ -571,7 +581,7 @@ def render(records: List[Dict[str, object]]) -> str:
         return "empty ledger"
     lines = [
         f"{'leg':<34} {'n':>3} {'min':>12} {'max':>12} "
-        f"{'spread':>7} {'p50':>9} {'p99':>9} {'goodput':>8} "
+        f"{'spread':>7} {'p50':>9} {'p99':>9} {'goodput':>8} {'slo':>7} "
         f"{'ingest_w':>9} {'intern':>9} {'peak_mem':>9} {'hbm_read':>9} "
         f"{'recovery':>9} {'load(1m)':>12} unit"
     ]
@@ -606,11 +616,18 @@ def render(records: List[Dict[str, object]]) -> str:
 
         peak_str = mb(band.get("hbm_peak_bytes"))
         read_str = mb(band.get("hbm_read_bytes"))
+        violations = band.get("slo_violations")
+        slo_str = (
+            str(int(violations))
+            if isinstance(violations, (int, float))
+            else "-"
+        )
         lines.append(
             f"{leg:<34} {band['n']:>3} {num(band['min']):>12} "
             f"{num(band['max']):>12} {spread:>7} "
             f"{num(band.get('p50')):>9} {num(band.get('p99')):>9} "
-            f"{goodput_str:>8} {num(band.get('ingest_wait_s')):>9} "
+            f"{goodput_str:>8} {slo_str:>7} "
+            f"{num(band.get('ingest_wait_s')):>9} "
             f"{num(band.get('intern_s')):>9} "
             f"{peak_str:>9} {read_str:>9} {num(band.get('recovery_s')):>9} "
             f"{load:>12} {band['unit'] or '-'}"

@@ -267,6 +267,13 @@ class Tracer:
                 f.write(json.dumps(event, sort_keys=True) + "\n")
         return len(events)
 
+    def ring_depths(self) -> Dict[str, int]:
+        """Events currently resident per flight-recorder component ring
+        (names sorted) — the ``/snapshot`` telemetry endpoint's measure
+        of how much postmortem context a crash would capture right now."""
+        with self._lock:
+            return {name: len(self._rings[name]) for name in sorted(self._rings)}
+
     def flight_dump(self, reason: Optional[str] = None) -> dict:
         """Snapshot the per-component rings — the postmortem artifact.
 
@@ -411,6 +418,9 @@ class NullTracer:
 
     def events(self) -> List[dict]:
         return []
+
+    def ring_depths(self) -> Dict[str, int]:
+        return {}
 
     def write_jsonl(self, path) -> int:
         """No events, no file: a disabled tracer never touches disk."""

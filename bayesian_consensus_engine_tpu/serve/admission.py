@@ -79,6 +79,23 @@ class AdmissionConfig:
     max_pending: int = 4096
     policy: str = "reject"
     retry_after_s: float = 0.05
+    #: The round-16 health signal: when True AND the service carries a
+    #: burn-rate monitor (``ConsensusService(health=...)``) that reports
+    #: :attr:`~.obs.health.HealthMonitor.burning`, arrivals follow the
+    #: overload policy even BELOW ``max_pending`` — the error budget
+    #: burning is overload by objective, not by queue depth. Off by
+    #: default: the flag is an explicit policy opt-in, and with it off
+    #: the admission sequence (and every settled byte) is unchanged.
+    shed_when_burning: bool = False
+    #: Probe admission under burn-driven overload: every Nth
+    #: burn-refused arrival is admitted anyway, so fresh outcomes keep
+    #: flowing into the monitor and a recovered service can CLEAR its
+    #: burning verdict — without a probe, ``policy="reject"`` + burning
+    #: would refuse everything forever (count-based windows never decay
+    #: with time; only new outcomes move them). Deterministic: the
+    #: probe is a pure function of the burn-refusal sequence. ``1``
+    #: probes every burn arrival (burning never refuses).
+    burn_probe_every: int = 8
 
     def __post_init__(self) -> None:
         if self.max_pending < 1:
@@ -89,6 +106,8 @@ class AdmissionConfig:
             )
         if self.retry_after_s < 0:
             raise ValueError("retry_after_s must be >= 0")
+        if self.burn_probe_every < 1:
+            raise ValueError("burn_probe_every must be >= 1")
 
 
 class AdmissionController:
@@ -105,13 +124,24 @@ class AdmissionController:
 
     def __init__(self, config: AdmissionConfig) -> None:
         self.config = config
+        self._burn_seq = 0
         registry = metrics_registry()
         self._admitted = registry.counter("serve.admitted")
         self._rejected = registry.counter("serve.rejected")
         self._shed = registry.counter("serve.shed")
 
-    def decide(self, pending: int) -> str:
-        if pending < self.config.max_pending:
+    def decide(self, pending: int, burning: bool = False) -> str:
+        over = pending >= self.config.max_pending
+        if not over and burning and self.config.shed_when_burning:
+            # Burn-rate overload: the SLO budget is being spent too
+            # fast, so the overload policy applies below the bound too
+            # (the obs→policy edge the health module documents — an
+            # admission input, never a settlement input). Every Nth
+            # burn arrival is admitted as a PROBE so the monitor keeps
+            # seeing real outcomes and the verdict can clear.
+            self._burn_seq += 1
+            over = self._burn_seq % self.config.burn_probe_every != 0
+        if not over:
             self._admitted.inc()
             return "accept"
         if self.config.policy == "reject":

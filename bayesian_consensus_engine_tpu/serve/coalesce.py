@@ -323,6 +323,7 @@ class ConsensusService:
         max_delay_s: Optional[float] = 0.005,
         admission: Optional[AdmissionConfig] = None,
         slo=None,
+        health=None,
         record_batches: bool = False,
         analytics=None,
         target_p99_s: Optional[float] = None,
@@ -384,6 +385,20 @@ class ConsensusService:
         #: SLO accounting (obs/slo.py): classify every request that left
         #: the service; None when no objective was declared.
         self._slo = SloTracker(slo) if slo is not None else None
+        #: Burn-rate health (obs/health.py, round 16): every outcome the
+        #: SLO tracker classifies also feeds the monitor, whose
+        #: ``burning`` verdict is (a) the ``/healthz`` answer when this
+        #: service runs a telemetry exporter and (b) the admission
+        #: signal ``AdmissionConfig(shed_when_burning=True)`` consumes.
+        if health is not None and slo is None:
+            raise ValueError(
+                "health= evaluates burn rates over SLO-classified "
+                "outcomes — declare slo= alongside it"
+            )
+        self._health = health
+        #: The live telemetry exporter (obs/export.py), when this
+        #: service started one via :meth:`start_telemetry`.
+        self.telemetry = None
         #: Submit sequence — the deterministic trace id. Every arrival
         #: burns one (admitted, shed, or rejected), so ids are a pure
         #: function of the request trace, never of timing or identity.
@@ -499,19 +514,35 @@ class ConsensusService:
         ctx = TraceContext(self._submit_seq, market_id)
         self._submit_seq += 1
         tracer = active_tracer()
+        burning = (
+            self._health.burning if self._health is not None else False
+        )
+        config = self._admission.config
+        # A burn-driven refusal (below the pending bound, refused only
+        # because the budget is burning) counts against goodput like any
+        # refusal but is NOT fed back into the health monitor: feeding
+        # it would hold the error windows full of our own refusals and
+        # the verdict could never clear — the monitor sees organic
+        # outcomes only.
+        burn_driven = bool(
+            burning and config.shed_when_burning
+            and self._resident < config.max_pending
+        )
         try:
-            decision = self._admission.decide(self._resident)
+            decision = self._admission.decide(self._resident, burning=burning)
         except Overloaded:
-            self._count_refused(ctx, "rejected")
+            self._count_refused(ctx, "rejected", feed_health=not burn_driven)
             raise
         if decision == "shed_oldest":
-            if self._shed_oldest():
+            if self._shed_oldest(feed_health=not burn_driven):
                 self._admission.count_shed()
             else:
                 # Everything resident is already dispatch-bound — nothing
                 # left to shed; degrade to rejection so the bound holds.
                 self._admission.count_degraded_reject()
-                self._count_refused(ctx, "rejected")
+                self._count_refused(
+                    ctx, "rejected", feed_health=not burn_driven
+                )
                 raise Overloaded(
                     self._admission.config.retry_after_s, self._resident
                 )
@@ -568,7 +599,7 @@ class ConsensusService:
         self._windows.append(window)
         return window
 
-    def _shed_oldest(self) -> bool:
+    def _shed_oldest(self, feed_health: bool = True) -> bool:
         """Drop the oldest not-yet-flushed request; False when none."""
         for window in self._windows:
             if window.requests:
@@ -585,20 +616,30 @@ class ConsensusService:
                             "overload (shed_oldest policy)"
                         )
                     )
-                self._count_refused(victim.ctx, "shed")
+                self._count_refused(
+                    victim.ctx, "shed", feed_health=feed_health
+                )
                 return True
         return False
 
-    def _count_refused(self, ctx: TraceContext, outcome: str) -> None:
+    def _count_refused(
+        self, ctx: TraceContext, outcome: str, feed_health: bool = True
+    ) -> None:
         """A request that will never settle: SLO-classify and trace it.
 
         Refused requests count AGAINST goodput (the whole point of the
         goodput-within-objective framing) but never enter the latency
         histograms — there is no completion latency to record.
+        ``feed_health=False`` marks a BURN-DRIVEN refusal: it still
+        counts against goodput, but the health monitor must not see its
+        own shedding as fresh budget burn (the feedback loop that would
+        pin the verdict at burning forever).
         """
         if self._slo is not None:
             self._slo.record(outcome)
             self._update_goodput_gauge()
+            if self._health is not None and feed_health:
+                self._health.record(outcome)
         tracer = active_tracer()
         if tracer.enabled:
             tracer.request_event(
@@ -620,6 +661,8 @@ class ConsensusService:
             return
         for _ in range(n):
             self._slo.record("failed")
+            if self._health is not None:
+                self._health.record("failed")
         self._update_goodput_gauge()
 
     # -- flushing (event-loop thread) ----------------------------------------
@@ -901,7 +944,50 @@ class ConsensusService:
             self._slo_met_counter if outcome == "met"
             else self._slo_violated_counter
         ).inc()
+        if self._health is not None:
+            self._health.record(outcome)
         self._update_goodput_gauge()
+
+    @property
+    def health(self):
+        """The burn-rate monitor (``None`` when not declared) — readable
+        so the shed policy, the telemetry exporter, and operators share
+        one verdict."""
+        return self._health
+
+    def start_telemetry(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        host_id: int = 0,
+        epoch: int = 0,
+    ):
+        """Expose this service's live telemetry plane (round 16).
+
+        Starts an :class:`~.obs.export.TelemetryServer` over the process
+        metrics registry, this service's burn-rate monitor (so
+        ``/healthz`` answers with the burn verdict), and the active
+        tracer's flight-ring depths; returns the server (also kept as
+        :attr:`telemetry` and shut down by :meth:`close`). ``port=0``
+        binds an ephemeral port — read ``server.port`` back. The server
+        only READS obs state: serving scrapes changes no settlement byte
+        (the write-only contract, pinned by tests/test_fleet_obs.py).
+        """
+        # Lazy import: the exporter is the read side of obs — only a
+        # service that actually serves telemetry pays for http.server.
+        from bayesian_consensus_engine_tpu.obs.export import TelemetryServer
+
+        if self.telemetry is not None:
+            return self.telemetry
+        self.telemetry = TelemetryServer(
+            health=self._health,
+            tracer=active_tracer(),
+            host=host,
+            port=port,
+            host_id=host_id,
+            epoch=epoch,
+        ).start()
+        return self.telemetry
 
     def goodput(self) -> Optional[dict]:
         """The SLO tracker's snapshot (``None`` without an objective):
@@ -963,6 +1049,8 @@ class ConsensusService:
         finally:
             self._pack_executor.shutdown(wait=True)
             self._executor.shutdown(wait=True)
+            if self.telemetry is not None:
+                self.telemetry.close()
             # The shutdown postmortem: a failure path already snapshotted
             # at the moment of failure (those rings are closer to the
             # truth) — a clean close records the final state.

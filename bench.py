@@ -3563,6 +3563,13 @@ def bench_e2e_kill_soak(markets=64, batches=12, kill_after=3,
     the three byte-coda fields must be True (adoption-time store +
     SQLite bytes equal the merged journal replay; the survivor's own
     journal ends self-contained).
+
+    Round 16 adds the LIVE health surface to the acceptance: the leg
+    JSON carries the ``/healthz`` transition timeline (the survivor must
+    read healthy → burning/degraded → healthy across the kill window,
+    with the endpoint answering over the wire while recovery runs) and
+    the deterministic fleet merge of the scraped snapshots (the dead
+    host as an explicit ``hosts_absent`` entry, fold order-independent).
     """
     import subprocess as _subprocess
 
@@ -3609,6 +3616,11 @@ def bench_e2e_kill_soak(markets=64, batches=12, kill_after=3,
             "survivor_journal_self_contained"
         ],
         "every_batch_durable": soak["every_batch_durable"],
+        "health_timeline": soak["health_timeline"],
+        "health_transitions_ok": soak["health_transitions_ok"],
+        "healthz_polls": soak["healthz_polls"],
+        "healthz_poll_ok": soak["healthz_poll_ok"],
+        "fleet": soak["fleet"],
         "killed_host": soak["killed_host"],
         "hosts": hosts,
         "batches_per_band": batches,

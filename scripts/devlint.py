@@ -12,7 +12,11 @@ stable:
                                      set), print findings, exit 1 on any
 
 Rule catalog (F401/F541/F811/F821/F841/E711/E712/E722 plus JX1xx/DT2xx/
-LY3xx): docs/static-analysis.md. ``# noqa`` / ``# noqa: ID`` suppress.
+LY3xx/SH4xx/PL5xx): docs/static-analysis.md. The round-16 LY303
+extension rides through here too: ``obs`` modules are held stdlib-only
+and the obs READ surface (``obs.export``/``obs.fleet``/``obs.health``)
+is import-confined to ``serve``/``cli`` — write-only obs, gated in CI by
+this shim like every other rule. ``# noqa`` / ``# noqa: ID`` suppress.
 """
 
 from __future__ import annotations

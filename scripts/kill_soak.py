@@ -28,7 +28,18 @@ recovery story end to end:
      ``replay_cluster_journals`` over the surviving journals, and at
      exit the survivor's OWN journal must replay to its final store —
      the adopted band rides its next epochs, so the dead journal is
-     needed once and never again.
+     needed once and never again;
+  5. the LIVE health surface (round 16): every worker serves the
+     telemetry plane (``obs/export.py`` — ``/metrics``, ``/snapshot``,
+     ``/healthz`` on an ephemeral port published to the shared dir) and
+     feeds a burn-rate monitor (``obs/health.py``) with its per-request
+     outcomes; the supervisor POLLS ``/healthz`` through the kill window
+     and scrapes ``/snapshot`` for the deterministic fleet merge
+     (``obs/fleet.py`` — the degraded epoch shows the dead host as an
+     explicit ``hosts_absent`` entry, and two fold orders must produce
+     identical bytes). The leg JSON records the health TRANSITION
+     timeline — healthy → burning/degraded → healthy — so recovery is
+     observable while it happens, not just post-hoc.
 
 Run from the repo root::
 
@@ -58,6 +69,17 @@ SOAK_SEED = 20260803
 
 def _membership_path(shared: str) -> str:
     return os.path.join(shared, "membership.json")
+
+
+def _telemetry_path(shared: str, host: int) -> str:
+    return os.path.join(shared, f"telemetry_{host}.json")
+
+
+def _write_telemetry_port(shared: str, host: int, port: int) -> None:
+    tmp = _telemetry_path(shared, host) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"host": host, "port": port}, f, sort_keys=True)
+    os.replace(tmp, _telemetry_path(shared, host))
 
 
 def _write_membership(shared: str, view, kill_ts=None) -> None:
@@ -161,6 +183,11 @@ def run_worker(args) -> int:
         replay_cluster_journals,
         store_digest,
     )
+    from bayesian_consensus_engine_tpu.obs.export import TelemetryServer
+    from bayesian_consensus_engine_tpu.obs.health import (
+        BurnWindow,
+        HealthMonitor,
+    )
     from bayesian_consensus_engine_tpu.obs.metrics import (
         MetricsRegistry,
         set_metrics_registry,
@@ -186,6 +213,27 @@ def run_worker(args) -> int:
     registry = MetricsRegistry()
     set_metrics_registry(registry)
 
+    # The live health surface: a burn-rate monitor fed with this band's
+    # per-request outcomes, exported over the stdlib telemetry server.
+    # Windows are sized to the band (one batch's worth of outcomes fast,
+    # four slow) so a crash-eaten batch re-driven as violations fires
+    # the fast window immediately and the next met-only batch clears it.
+    band_rows = max(1, len(list(view0_rows(args, me))))
+    monitor = HealthMonitor(
+        objective_goodput=0.9,
+        windows=(
+            BurnWindow(
+                fast=max(8, band_rows),
+                slow=4 * max(8, band_rows),
+                threshold=2.0,
+            ),
+        ),
+    )
+    server = TelemetryServer(
+        registry=registry, health=monitor, host_id=me, epoch=view.epoch,
+    ).start()
+    _write_telemetry_port(shared, me, server.port)
+
     store = TensorReliabilityStore()
     journal = JournalWriter(os.path.join(shared, f"band{me}.jrnl"))
     # Strict durability (sync epochs, every batch): a yielded batch IS
@@ -207,6 +255,21 @@ def run_worker(args) -> int:
         counters = registry.export().get("counters", {})
         return int(counters.get("stream.resident_fallbacks", 0))
 
+    last_verdict = [None]
+
+    def log_health(force: bool = False) -> None:
+        """Append a ``health`` progress line on every VERDICT CHANGE (or
+        forced) — the worker-side transition record the supervisor folds
+        into the leg JSON's health timeline (the HTTP polls prove the
+        endpoint is live; these lines make the sequence deterministic)."""
+        v = monitor.verdict()
+        if force or v["verdict"] != last_verdict[0]:
+            last_verdict[0] = v["verdict"]
+            log(
+                "health", verdict=v["verdict"], burning=v["burning"],
+                degraded=v["degraded"], epoch=view.epoch,
+            )
+
     own_next = 0
     orphans: list = []  # [host, next_index] bands adopted from the dead
     adoption_report = None
@@ -214,8 +277,16 @@ def run_worker(args) -> int:
     dispatch_index = 0
     now0 = 20_950.0
     drain_deadline = None
+    #: (band, index) → the DEAD worker's offer wall-ts for batches it
+    #: offered but never made durable: their re-drive latency is measured
+    #: from the ORIGINAL offer, so crash-eaten traffic lands as SLO
+    #: violations in the live monitor exactly like it does in the
+    #: supervisor's post-hoc accounting.
+    victim_offers: dict = {}
+    degraded_pending = False
 
     try:
+        log_health(force=True)  # the timeline's starting "healthy"
         while True:
             # Membership poll — the coordinator-free agreement point:
             # the view file names the epoch and survivors; this worker
@@ -228,6 +299,11 @@ def run_worker(args) -> int:
                 view = view.degraded(survivors)
                 if me not in view.hosts:
                     break  # not our story: this worker was voted dead
+                # Snapshot epoch tagging rides recovery: every survivor
+                # re-tags its telemetry identity with the degraded
+                # epoch, so a fleet fold of the scraped snapshots names
+                # the membership it observed.
+                server.set_epoch(view.epoch)
                 for host in dead:
                     # Exactly ONE survivor owns each orphan band — a pure
                     # function of (dead host, degraded view), so every
@@ -241,6 +317,27 @@ def run_worker(args) -> int:
                             owner=owner)
                         continue
                     dead_path = os.path.join(shared, f"band{host}.jrnl")
+                    # This survivor now carries the recovery: degraded
+                    # on the health surface until the orphan band flows
+                    # again. The dead worker's offered-but-undurable
+                    # batches keep their ORIGINAL offer timestamps so
+                    # their re-drive burns budget honestly.
+                    monitor.set_degraded(
+                        f"adopting band {host} (hosts absent: {dead})"
+                    )
+                    degraded_pending = True
+                    log_health()
+                    for line in _read_lines(
+                        os.path.join(shared, f"progress_{host}.jsonl")
+                    ):
+                        if line["kind"] != "offered":
+                            continue
+                        for band, index in line["parts"]:
+                            key = (band, index)
+                            victim_offers[key] = min(
+                                victim_offers.get(key, line["ts"]),
+                                line["ts"],
+                            )
                     adopt_start = time.perf_counter()
                     tag, rows_adopted = adopt_journal(store, dead_path)
                     adopt_s = time.perf_counter() - adopt_start
@@ -314,6 +411,7 @@ def run_worker(args) -> int:
             offsets = np.cumsum(offsets).astype(np.int64)
             outcomes = sum((c[4] for c in columns), [])
 
+            offer_wall = time.time()
             log("offered", parts=parts, requests=len(keys))
             time.sleep(args.interval)
             plan = cache.plan_for(keys, sids, probs, offsets)
@@ -323,8 +421,29 @@ def run_worker(args) -> int:
                 "durable", parts=parts, adopt=driver.last_adopt,
                 fallbacks=fallbacks(), batch=dispatch_index,
             )
+            # Feed the burn-rate monitor the batch's per-request
+            # outcomes against the offer→durable objective — re-driven
+            # crash-eaten parts measure from the DEAD worker's offer, so
+            # recovery burns budget live exactly as the supervisor's
+            # post-hoc goodput counts it.
+            durable_wall = time.time()
+            for i, (band, index) in enumerate(parts):
+                offer_ts = victim_offers.get((band, index), offer_wall)
+                outcome = (
+                    "met" if durable_wall - offer_ts <= args.slo
+                    else "violated"
+                )
+                for _ in range(len(columns[i][0])):
+                    monitor.record(outcome)
+            if degraded_pending and any(band != me for band, _ in parts):
+                # The orphan band just flowed through a durable batch on
+                # this host: the membership impairment is carried.
+                monitor.clear_degraded()
+                degraded_pending = False
+            log_health()
             dispatch_index += 1
 
+        log_health(force=True)  # the timeline's closing verdict
         result.update(
             ok=True,
             batches_settled=dispatch_index,
@@ -345,6 +464,7 @@ def run_worker(args) -> int:
             pass
         with open(os.path.join(shared, f"result_{me}.json"), "w") as f:
             json.dump(result, f, sort_keys=True)
+        server.close()
         progress.close()
     return 0
 
@@ -384,11 +504,96 @@ def _read_lines(path: str) -> list:
     return out
 
 
+class _HealthPoller:
+    """Supervisor-side live poller: ``/healthz`` + ``/snapshot`` of every
+    worker on a background thread through the whole soak — the proof the
+    health surface answers WHILE the kill and recovery are happening
+    (the worker-side progress lines make the transition sequence
+    deterministic; these polls make it observable over the wire)."""
+
+    def __init__(self, shared: str, hosts) -> None:
+        import threading
+
+        self._shared = shared
+        self._hosts = list(hosts)
+        self._ports: dict = {}
+        self._stop = threading.Event()
+        self.polls: list = []          # {ts, host, verdict, ok}
+        self.snapshots: dict = {}      # host → latest parsed /snapshot
+        self._thread = threading.Thread(
+            target=self._loop, name="soak-health-poller", daemon=True
+        )
+
+    def start(self) -> "_HealthPoller":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _port(self, host: int):
+        port = self._ports.get(host)
+        if port is not None:
+            return port
+        path = _telemetry_path(self._shared, host)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                port = int(json.load(f)["port"])
+        except (ValueError, KeyError, OSError):
+            return None
+        self._ports[host] = port
+        return port
+
+    def _loop(self) -> None:
+        from bayesian_consensus_engine_tpu.obs.export import scrape_endpoint
+
+        while not self._stop.is_set():
+            for host in self._hosts:
+                port = self._port(host)
+                if port is None:
+                    continue
+                base = f"http://127.0.0.1:{port}"
+                try:
+                    # 503 /healthz = burning/degraded; scrape_endpoint
+                    # parses the body either way — the body IS the answer.
+                    _status, payload = scrape_endpoint(
+                        base + "/healthz", timeout=0.5
+                    )
+                    self.polls.append(
+                        {
+                            "ts": time.time(), "host": host,
+                            "verdict": payload.get("verdict"), "ok": True,
+                        }
+                    )
+                    _status, snapshot = scrape_endpoint(
+                        base + "/snapshot", timeout=0.5
+                    )
+                    self.snapshots[host] = snapshot
+                except Exception:
+                    # Dead worker (the kill), not-yet-bound port, slow
+                    # scrape: absence of an answer is itself data.
+                    self.polls.append(
+                        {
+                            "ts": time.time(), "host": host,
+                            "verdict": None, "ok": False,
+                        }
+                    )
+            self._stop.wait(0.05)
+
+
 def run_supervisor(args) -> int:
     from bayesian_consensus_engine_tpu.cluster.membership import MeshView
     from bayesian_consensus_engine_tpu.cluster.recover import (
         replay_cluster_journals,
         store_digest,
+    )
+    from bayesian_consensus_engine_tpu.obs.fleet import (
+        fleet_to_json,
+        merge_fleet,
+        snapshot_from_wire,
     )
     from bayesian_consensus_engine_tpu.obs.ledger import RunLedger
     from bayesian_consensus_engine_tpu.obs.slo import (
@@ -431,6 +636,7 @@ def run_supervisor(args) -> int:
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, env=env,
         )
+    poller = _HealthPoller(shared, view.hosts).start()
 
     def durable_lines(host):
         return [
@@ -482,6 +688,7 @@ def run_supervisor(args) -> int:
                 f"survivor {host} failed rc={procs[host].returncode}:"
                 f"\n{out[-4000:]}"
             )
+    poller.stop()
 
     # -- adjudication ------------------------------------------------------
     # The orphan band's OWNER (the one survivor that adopted it — the
@@ -590,6 +797,85 @@ def run_supervisor(args) -> int:
         == survivor_result["final_store_digest"]
     )
 
+    # -- live health surface adjudication ----------------------------------
+    # The transition timeline comes from the workers' own health lines
+    # (deterministic: verdict changes, logged at the batch cadence); the
+    # HTTP polls prove the /healthz endpoint answered over the wire
+    # while the kill window was open. The recovery story must read
+    # healthy → burning/degraded → healthy ON THE ADOPTING SURVIVOR.
+    health_timeline = sorted(
+        (
+            {
+                "ts": line["ts"], "host": host,
+                "verdict": line["verdict"], "burning": line["burning"],
+                "degraded": line["degraded"], "epoch": line["epoch"],
+            }
+            for host in view.hosts
+            for line in _read_lines(
+                os.path.join(shared, f"progress_{host}.jsonl")
+            )
+            if line["kind"] == "health"
+        ),
+        key=lambda e: (e["ts"], e["host"]),
+    )
+    survivor_verdicts = [
+        e for e in health_timeline if e["host"] == survivor
+    ]
+    health_transitions_ok = bool(
+        survivor_verdicts
+        and survivor_verdicts[0]["verdict"] == "healthy"
+        and any(
+            e["verdict"] != "healthy" and e["ts"] >= kill_ts
+            for e in survivor_verdicts
+        )
+        and survivor_verdicts[-1]["verdict"] == "healthy"
+    )
+    polls_ok = [p for p in poller.polls if p["ok"]]
+    healthz_poll_ok = len(polls_ok) > 0
+    # Condensed over-the-wire verdict sequence per host (transitions
+    # only) — the liveness record beside the deterministic timeline.
+    poll_transitions: list = []
+    last_polled: dict = {}
+    for p in poller.polls:
+        if not p["ok"]:
+            continue
+        if last_polled.get(p["host"]) != p["verdict"]:
+            last_polled[p["host"]] = p["verdict"]
+            poll_transitions.append(
+                {"ts": p["ts"], "host": p["host"], "verdict": p["verdict"]}
+            )
+
+    # Fleet merge over the scraped survivor snapshots: the degraded
+    # membership shows the dead host as an EXPLICIT hosts_absent entry
+    # (never silently missing series), and the fold must be
+    # order-independent — two observers, identical bytes.
+    fleet_snaps = [
+        snapshot_from_wire(poller.snapshots[host])
+        for host in survivor_hosts
+        if host in poller.snapshots
+    ]
+    fleet_summary = None
+    fleet_ok = False
+    if fleet_snaps:
+        fleet_view = merge_fleet(fleet_snaps, expected_hosts=view.hosts)
+        fleet_ok = bool(
+            fleet_view["hosts_absent"] == [victim]
+            and fleet_view["epoch"] == degraded.epoch
+            and fleet_to_json(fleet_view) == fleet_to_json(
+                merge_fleet(
+                    list(reversed(fleet_snaps)),
+                    expected_hosts=view.hosts,
+                )
+            )
+        )
+        fleet_summary = {
+            "epoch": fleet_view["epoch"],
+            "hosts": fleet_view["hosts"],
+            "hosts_absent": fleet_view["hosts_absent"],
+            "host_epochs": fleet_view["host_epochs"],
+            "deterministic": fleet_ok,
+        }
+
     wall_s = time.perf_counter() - wall_start
     every_batch_durable = all(
         (band, index) in durable
@@ -604,6 +890,9 @@ def run_supervisor(args) -> int:
             and every_batch_durable
             and pre_kill_fallbacks == 0
             and survivor_fallbacks == 0
+            and health_transitions_ok
+            and healthz_poll_ok
+            and fleet_ok
         ),
         "hosts": args.hosts,
         "killed_host": victim,
@@ -623,6 +912,12 @@ def run_supervisor(args) -> int:
         "byte_equal_sqlite": adoption["byte_equal_sqlite"],
         "survivor_journal_self_contained": journal_self_contained,
         "every_batch_durable": every_batch_durable,
+        "health_timeline": health_timeline,
+        "health_transitions_ok": health_transitions_ok,
+        "healthz_polls": len(poller.polls),
+        "healthz_poll_ok": healthz_poll_ok,
+        "healthz_poll_transitions": poll_transitions,
+        "fleet": fleet_summary,
         "wall_s": wall_s,
     }
 
@@ -659,6 +954,15 @@ def run_supervisor(args) -> int:
             f"sqlite={adoption['byte_equal_sqlite']} "
             f"self_contained={journal_self_contained} "
             f"fallbacks={survivor_fallbacks}"
+        )
+        transitions = " -> ".join(
+            e["verdict"] for e in health_timeline if e["host"] == survivor
+        )
+        print(
+            f"  health: {transitions or '(none)'} "
+            f"(transitions_ok={health_transitions_ok}, "
+            f"{len(poller.polls)} /healthz polls, "
+            f"fleet absent={fleet_summary['hosts_absent'] if fleet_summary else '?'})"
         )
     print(json.dumps(payload, sort_keys=True))
     return 0 if payload["ok"] else 1

@@ -1055,6 +1055,8 @@ class TestKillSoakLeg:
             "survivor_adopt_modes", "byte_equal_store",
             "byte_equal_sqlite", "survivor_journal_self_contained",
             "every_batch_durable", "soak_ok",
+            "health_timeline", "health_transitions_ok",
+            "healthz_polls", "healthz_poll_ok", "fleet",
         ):
             assert key in result, key
         # The acceptance bars: the kill was recovered (a dead-band batch
@@ -1080,6 +1082,20 @@ class TestKillSoakLeg:
         assert not any(
             m.startswith("rebuild") for m in result["survivor_adopt_modes"]
         )
+        # Round 16: the recovery was observable WHILE it happened — the
+        # survivor's /healthz timeline left healthy and returned to it
+        # across the kill window, the endpoint answered over the wire,
+        # and the fleet merge named the dead host as explicitly absent
+        # (deterministically, any fold order).
+        assert result["health_transitions_ok"] is True
+        verdicts = {e["verdict"] for e in result["health_timeline"]}
+        assert "healthy" in verdicts
+        assert verdicts & {"degraded", "burning"}
+        assert result["healthz_poll_ok"] is True
+        assert result["healthz_polls"] > 0
+        assert result["fleet"] is not None
+        assert result["fleet"]["hosts_absent"] == [result["killed_host"]]
+        assert result["fleet"]["deterministic"] is True
         json.dumps(result)
         # The ledger record carries the recovery story the stats table
         # renders: goodput (extras.slo) + the recovery_s fold.

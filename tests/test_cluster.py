@@ -574,3 +574,119 @@ class TestDegradedFactorisationResume:
         )
         assert db_deg.read_bytes() == db_one.read_bytes()
         assert store_digest(s_deg) == store_digest(s_one)
+
+
+class TestRecoveryFlightRecorder:
+    """Round-16 satellite: a dispatch failure landing WHILE cluster
+    recovery (``adopt_journal``) is in progress must leave a crash
+    postmortem that SHOWS the recovery in flight — the ``recovery``
+    component ring holds the adoption's span chain (an ``adopt_start``
+    with no ``adopt_done`` = adoption mid-replay at the failure)."""
+
+    def _dead_band_journal(self, tmp_path):
+        store = TensorReliabilityStore()
+        journal = tmp_path / "dead_band.jrnl"
+        list(settle_stream(
+            store, _mixed_batches(markets=6, batches=2, seed=7, tag="d"),
+            steps=1, now=NOW, journal=journal, checkpoint_every=1,
+        ))
+        return journal
+
+    def test_dump_mid_adoption_captures_recovery_spans(
+        self, tmp_path, monkeypatch
+    ):
+        import asyncio
+        import threading
+
+        from bayesian_consensus_engine_tpu import obs
+        from bayesian_consensus_engine_tpu.cluster import recover
+        from bayesian_consensus_engine_tpu.serve import ConsensusService
+
+        dead_journal = self._dead_band_journal(tmp_path)
+
+        # Pause the adoption mid-flight: adopt_start is recorded, the
+        # replay walk blocks until released — the window in which the
+        # dispatch failure fires.
+        real_replay = recover._replay_into
+        adopt_started = threading.Event()
+        release_adopt = threading.Event()
+
+        def paused_replay(store, path):
+            adopt_started.set()
+            assert release_adopt.wait(timeout=30)
+            return real_replay(store, path)
+
+        monkeypatch.setattr(recover, "_replay_into", paused_replay)
+
+        # ...and a journal whose second epoch dies (the TestFlightRecorder
+        # failure mode): the service's dispatch worker takes the flight
+        # dump at the moment of failure.
+        real_flush = TensorReliabilityStore.flush_to_journal_async
+        calls = {"n": 0}
+
+        def broken_second(self, journal, tag=0):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("journal disk gone")
+            return real_flush(self, journal, tag=tag)
+
+        monkeypatch.setattr(
+            TensorReliabilityStore, "flush_to_journal_async", broken_second
+        )
+
+        tracer = obs.Tracer()
+        previous = obs.set_tracer(tracer)
+        survivor_store = TensorReliabilityStore()
+        adopter = threading.Thread(
+            target=recover.adopt_journal,
+            args=(survivor_store, dead_journal),
+            daemon=True,
+        )
+        try:
+            adopter.start()
+            assert adopt_started.wait(timeout=30)
+
+            async def main():
+                service = ConsensusService(
+                    TensorReliabilityStore(), steps=1, now=NOW,
+                    journal=tmp_path / "live.jrnl", checkpoint_every=1,
+                    max_batch=2, max_delay_s=None,
+                )
+                async with service:
+                    for i in range(4):
+                        service.submit(
+                            f"m{i}", [("s", 0.5 + 0.01 * i)], True
+                        )
+                    await service.drain()
+                return service
+
+            with pytest.raises(RuntimeError, match="journal disk gone"):
+                asyncio.run(main())
+
+            # The postmortem: the service's own rings PLUS the recovery
+            # ring, whose chain shows the adoption STARTED and not done.
+            dump = tracer.last_flight_dump
+            assert dump is not None
+            assert "dispatch failure" in dump["reason"]
+            assert "recovery" in dump["components"]
+            recovery_names = [
+                e["name"] for e in dump["components"]["recovery"]
+            ]
+            assert recovery_names == ["adopt_start"]
+            (start_event,) = dump["components"]["recovery"]
+            assert start_event["args"]["journal"] == str(dead_journal)
+        finally:
+            release_adopt.set()
+            adopter.join(timeout=30)
+            obs.set_tracer(previous)
+
+        # Once released, the adoption completes and closes its chain —
+        # the full log now carries start AND done with the adopted rows.
+        events = [
+            e for e in tracer.events() if e["scope"] == recover.RECOVERY_SCOPE
+        ]
+        assert [e["name"] for e in events] == ["adopt_start", "adopt_done"]
+        done = events[-1]
+        assert done["args"]["rows_adopted"] == len(survivor_store)
+        assert done["args"]["rows_adopted"] > 0
+        assert done["args"]["tag"] == 1  # two epochs, 0-indexed tags

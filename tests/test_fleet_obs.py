@@ -1,0 +1,732 @@
+"""Live telemetry plane (round 16): exporter, fleet merge, burn-rate
+health.
+
+The contracts under pin (ISSUE 14 acceptance):
+
+* **Deterministic wire bytes** — ``/metrics`` is rendered from
+  ``MetricsRegistry.export()`` with sorted names/labels/buckets: two
+  registries that saw the same observations produce identical BYTES
+  regardless of registration order (DT203 on the wire).
+* **Write-only exporter** — a server scraping mid-settle moves no
+  settlement byte: stream results, SQLite checkpoint bytes, and journal
+  heads are identical with the exporter running vs absent, and the
+  serve path's journal epochs (sans wall clock) + SQLite bytes are too.
+* **Fleet-merge determinism** — two observers folding the same snapshot
+  set (any order) produce identical fleet-view and ``/metrics`` bytes;
+  expected-but-missing hosts are EXPLICIT (``hosts_absent``), higher
+  epochs supersede, same-epoch conflicts and bucket-layout mismatches
+  refuse.
+* **Burn-rate health** — the verdict is a pure function of the
+  classified outcome sequence (fixed windows, fixed thresholds);
+  burning requires fast AND slow windows over threshold; ``degraded``
+  outranks ``burning``; recovery returns to ``healthy``.
+* **Serve wiring** — ``ConsensusService(health=)`` feeds every
+  SLO-classified outcome to the monitor, ``start_telemetry`` serves the
+  live plane, and ``AdmissionConfig(shed_when_burning=True)`` turns the
+  burning verdict into an admission decision (off by default — the
+  admission sequence is unchanged).
+"""
+
+import asyncio
+import hashlib
+import json
+import os
+import struct
+import tempfile
+import threading
+import urllib.error
+import urllib.request  # noqa: F811
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from bayesian_consensus_engine_tpu import obs
+from bayesian_consensus_engine_tpu.obs import export as obs_export
+from bayesian_consensus_engine_tpu.obs import fleet as obs_fleet
+from bayesian_consensus_engine_tpu.obs import health as obs_health
+from bayesian_consensus_engine_tpu.serve import (
+    AdmissionConfig,
+    ConsensusService,
+    Overloaded,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 22_300.0
+
+
+def _get(url, timeout=5.0):
+    """GET → (status, parsed-JSON-or-text); 503 bodies are answers."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            raw = r.read()
+            status = r.status
+    except urllib.error.HTTPError as exc:
+        raw = exc.read()
+        status = exc.code
+    try:
+        return status, json.loads(raw)
+    except ValueError:
+        return status, raw.decode()
+
+
+def _registry_ab(order):
+    """One registry fed the same observations in the given site order."""
+    registry = obs.MetricsRegistry()
+    sites = {
+        "c": lambda: registry.counter("serve.requests").inc(3),
+        "g": lambda: registry.gauge("stream.intern_wait_s").set(0.25),
+        "h": lambda: registry.histogram(
+            "serve.latency_total_s"
+        ).observe(0.003),
+    }
+    for key in order:
+        sites[key]()
+    return registry
+
+
+class TestPrometheusRender:
+    def test_bytes_independent_of_registration_order(self):
+        a = obs_export.render_prometheus(_registry_ab("cgh").export())
+        b = obs_export.render_prometheus(_registry_ab("hgc").export())
+        assert a == b
+        assert a.encode() == b.encode()
+
+    def test_counter_gauge_histogram_shapes(self):
+        text = obs_export.render_prometheus(_registry_ab("cgh").export())
+        lines = text.splitlines()
+        assert "# TYPE bce_serve_requests counter" in lines
+        assert "bce_serve_requests 3" in lines
+        assert "bce_stream_intern_wait_s 0.25" in lines
+        # Histogram: cumulative buckets, +Inf, _sum, _count.
+        buckets = [
+            line for line in lines
+            if line.startswith("bce_serve_latency_total_s_bucket")
+        ]
+        assert buckets[-1] == (
+            'bce_serve_latency_total_s_bucket{le="+Inf"} 1'
+        )
+        counts = [int(line.rsplit(" ", 1)[1]) for line in buckets]
+        assert counts == sorted(counts)  # cumulative never decreases
+        assert "bce_serve_latency_total_s_count 1" in lines
+        assert any(
+            line.startswith("bce_serve_latency_total_s_sum ")
+            for line in lines
+        )
+
+    def test_names_sorted(self):
+        text = obs_export.render_prometheus(_registry_ab("cgh").export())
+        type_lines = [
+            line.split()[2] for line in text.splitlines()
+            if line.startswith("# TYPE")
+        ]
+        # Sorted within each metric kind (counters, then gauges, then
+        # histograms) — the render contract the fleet fold relies on.
+        assert type_lines == [
+            "bce_serve_requests",
+            "bce_stream_intern_wait_s",
+            "bce_serve_latency_total_s",
+        ]
+
+    def test_empty_export_renders_empty(self):
+        assert obs_export.render_prometheus(
+            obs.MetricsRegistry().export()
+        ) == ""
+
+
+class TestTelemetryServer:
+    def test_endpoints_and_scrape_accounting(self):
+        registry = _registry_ab("cgh")
+        with obs_export.TelemetryServer(
+            registry=registry, host_id=7, epoch=3
+        ) as server:
+            status, text = _get(server.url + "/metrics")
+            assert status == 200
+            assert "bce_serve_requests 3" in text
+            status, snap = _get(server.url + "/snapshot")
+            assert status == 200
+            assert snap["host_id"] == 7 and snap["epoch"] == 3
+            assert snap["metrics"]["counters"]["serve.requests"] == 3
+            status, payload = _get(server.url + "/healthz")
+            assert status == 200
+            assert payload == {
+                "ok": True, "verdict": "healthy", "detail": None,
+            }
+            status, _ = _get(server.url + "/nope")
+            assert status == 404
+            # Scrapes self-account on the pinned layout.
+            export = registry.export()
+            assert export["counters"]["export.scrapes"] >= 3
+            hist = export["histograms"]["export.scrape_latency_s"]
+            assert tuple(hist["bounds"]) == (
+                obs_export.SCRAPE_LATENCY_BOUNDS
+            )
+            assert hist["count"] >= 3
+
+    def test_healthz_tracks_the_monitor(self):
+        monitor = obs_health.HealthMonitor(
+            objective_goodput=0.9,
+            windows=(obs_health.BurnWindow(4, 16, 2.0),),
+        )
+        with obs_export.TelemetryServer(
+            registry=obs.MetricsRegistry(), health=monitor
+        ) as server:
+            status, payload = _get(server.url + "/healthz")
+            assert (status, payload["verdict"]) == (200, "healthy")
+            for _ in range(16):
+                monitor.record("violated")
+            status, payload = _get(server.url + "/healthz")
+            assert (status, payload["verdict"]) == (503, "burning")
+            assert payload["ok"] is False
+            assert payload["detail"]["windows"][0]["burning"] is True
+            monitor.set_degraded("host 1 absent")
+            status, payload = _get(server.url + "/healthz")
+            assert (status, payload["verdict"]) == (503, "degraded")
+            monitor.clear_degraded()
+            for _ in range(16):
+                monitor.record("met")
+            status, payload = _get(server.url + "/healthz")
+            assert (status, payload["verdict"]) == (200, "healthy")
+
+    def test_set_epoch_moves_the_snapshot_tag(self):
+        with obs_export.TelemetryServer(
+            registry=obs.MetricsRegistry(), host_id=1, epoch=0
+        ) as server:
+            _, snap = _get(server.url + "/snapshot")
+            assert snap["epoch"] == 0
+            server.set_epoch(4)  # recovery adopted a degraded view
+            _, snap = _get(server.url + "/snapshot")
+            assert snap["epoch"] == 4
+
+    def test_snapshot_carries_trace_ring_depths(self):
+        tracer = obs.Tracer()
+        tracer.batch_event(0, "batch")
+        tracer.request_event(0, "enqueue")
+        with obs_export.TelemetryServer(
+            registry=obs.MetricsRegistry(), tracer=tracer
+        ) as server:
+            _, snap = _get(server.url + "/snapshot")
+        assert snap["trace"]["enabled"] is True
+        assert snap["trace"]["ring_depths"] == {"driver": 1, "service": 1}
+
+
+class TestHealthMonitor:
+    def _monitor(self, fast=4, slow=16, threshold=2.0, target=0.9):
+        return obs_health.HealthMonitor(
+            objective_goodput=target,
+            windows=(obs_health.BurnWindow(fast, slow, threshold),),
+        )
+
+    def test_verdict_is_pure_function_of_outcome_sequence(self):
+        trace = (
+            ["met"] * 20 + ["violated"] * 16 + ["met"] * 16
+        )
+        runs = []
+        for _ in range(2):
+            monitor = self._monitor()
+            verdicts = []
+            for outcome in trace:
+                monitor.record(outcome)
+                verdicts.append(monitor.verdict()["verdict"])
+            runs.append(verdicts)
+        assert runs[0] == runs[1]
+        assert "burning" in runs[0]          # the violation burst fires
+        assert runs[0][-1] == "healthy"      # ...and the met tail clears
+
+    def test_burning_requires_fast_and_slow(self):
+        monitor = self._monitor(fast=4, slow=16, threshold=2.0)
+        for _ in range(12):
+            monitor.record("met")
+        # 4 violations: fast window (4) is all-error (burn 10) but the
+        # slow window holds 4/16 = burn 2.5 >= 2 — both over, burning.
+        for _ in range(4):
+            monitor.record("violated")
+        assert monitor.burning is True
+        # One met resets the fast window below threshold: not burning,
+        # even though the slow window still carries the errors.
+        for _ in range(4):
+            monitor.record("met")
+        assert monitor.burning is False
+        state = monitor.verdict()["windows"][0]
+        assert state["fast_burn"] < state["threshold"]
+        assert state["slow_burn"] > 0
+
+    def test_every_non_met_outcome_burns_budget(self):
+        for outcome in ("violated", "shed", "rejected", "failed"):
+            monitor = self._monitor(fast=2, slow=4, threshold=1.0)
+            for _ in range(4):
+                monitor.record(outcome)
+            assert monitor.burning is True, outcome
+
+    def test_degraded_outranks_burning(self):
+        monitor = self._monitor()
+        for _ in range(16):
+            monitor.record("violated")
+        monitor.set_degraded("adopting band 1")
+        verdict = monitor.verdict()
+        assert verdict["verdict"] == "degraded"
+        assert verdict["burning"] is True  # both facts visible
+        monitor.clear_degraded()
+        assert monitor.verdict()["verdict"] == "burning"
+
+    def test_gauges_and_pinned_burn_histogram(self):
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            monitor = self._monitor(fast=4, slow=16)
+            for _ in range(8):
+                monitor.record("violated")
+        finally:
+            obs.set_metrics_registry(previous)
+        export = registry.export()
+        assert export["gauges"]["health.burning"] == 1.0
+        assert export["gauges"]["health.burn_rate_fast"] == (
+            pytest.approx(10.0)
+        )
+        hist = export["histograms"]["health.burn_rate"]
+        assert tuple(hist["bounds"]) == obs_health.BURN_RATE_BOUNDS
+        assert hist["count"] > 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="objective_goodput"):
+            obs_health.HealthMonitor(objective_goodput=1.0)
+        with pytest.raises(ValueError, match="slow window"):
+            obs_health.BurnWindow(8, 8, 1.0)
+        with pytest.raises(ValueError, match="outcome"):
+            self._monitor().record("mystery")
+
+
+def _snap(host, epoch, counters=None, gauges=None, hist=None):
+    registry = obs.MetricsRegistry()
+    for name, n in (counters or {}).items():
+        registry.counter(name).inc(n)
+    for name, value in (gauges or {}).items():
+        registry.gauge(name).set(value)
+    for name, values in (hist or {}).items():
+        h = registry.histogram(name, bounds=(0.01, 0.1, 1.0))
+        for value in values:
+            h.observe(value)
+    return obs_fleet.snapshot_host(host, epoch, registry)
+
+
+class TestFleetMerge:
+    def test_any_fold_order_same_bytes(self):
+        snaps = [
+            _snap(2, 1, {"serve.requests": 5}, {"stream.intern_wait_s": 1.0},
+                  {"lat": [0.05]}),
+            _snap(0, 1, {"serve.requests": 7}, {"stream.intern_wait_s": 2.0},
+                  {"lat": [0.5, 0.02]}),
+            _snap(5, 1, {"serve.requests": 1}, {}, {"lat": [0.05]}),
+        ]
+        views = [
+            obs_fleet.merge_fleet(order, expected_hosts=[0, 2, 5])
+            for order in (snaps, list(reversed(snaps)),
+                          [snaps[1], snaps[2], snaps[0]])
+        ]
+        as_json = {obs_fleet.fleet_to_json(v) for v in views}
+        assert len(as_json) == 1
+        rendered = {obs_fleet.render_fleet_prometheus(v) for v in views}
+        assert len(rendered) == 1
+
+    def test_counters_sum_gauges_stay_per_host(self):
+        view = obs_fleet.merge_fleet(
+            [
+                _snap(0, 0, {"serve.requests": 5}, {"depth": 2.0}),
+                _snap(1, 0, {"serve.requests": 7}, {"depth": 3.0}),
+            ]
+        )
+        assert view["counters"]["serve.requests"] == 12
+        assert view["gauges"]["depth"] == {"0": 2.0, "1": 3.0}
+        text = obs_fleet.render_fleet_prometheus(view)
+        assert 'bce_depth{host="0"} 2.0' in text
+        assert 'bce_depth{host="1"} 3.0' in text
+        assert "bce_serve_requests 12" in text
+
+    def test_histograms_merge_by_bucket_sum(self):
+        view = obs_fleet.merge_fleet(
+            [
+                _snap(0, 0, hist={"lat": [0.05, 0.5]}),
+                _snap(1, 0, hist={"lat": [0.05]}),
+            ]
+        )
+        assert view["histograms"]["lat"]["count"] == 3
+        assert view["histograms"]["lat"]["counts"] == [0, 2, 1, 0]
+
+    def test_histogram_layout_mismatch_refuses(self):
+        a = _snap(0, 0, hist={"lat": [0.05]})
+        registry = obs.MetricsRegistry()
+        registry.histogram("lat", bounds=(1.0, 2.0)).observe(1.5)
+        b = obs_fleet.snapshot_host(1, 0, registry)
+        with pytest.raises(ValueError, match="layouts differ"):
+            obs_fleet.merge_fleet([a, b])
+
+    def test_absent_hosts_are_explicit(self):
+        view = obs_fleet.merge_fleet(
+            [_snap(0, 1), _snap(2, 1)], expected_hosts=[0, 1, 2, 3]
+        )
+        assert view["hosts_absent"] == [1, 3]
+        text = obs_fleet.render_fleet_prometheus(view)
+        assert "bce_fleet_hosts_absent 2" in text
+
+    def test_higher_epoch_supersedes_same_epoch_conflict_refuses(self):
+        stale = _snap(0, 0, {"serve.requests": 1})
+        fresh = _snap(0, 2, {"serve.requests": 9})
+        view = obs_fleet.merge_fleet([stale, fresh])
+        assert view["counters"]["serve.requests"] == 9
+        assert view["epoch"] == 2
+        conflicting = _snap(0, 2, {"serve.requests": 10})
+        with pytest.raises(ValueError, match="conflicting"):
+            obs_fleet.merge_fleet([fresh, conflicting])
+        # ...but an identical duplicate (the same scrape seen twice) is
+        # not a conflict.
+        assert obs_fleet.merge_fleet(
+            [fresh, obs_fleet.snapshot_from_json(
+                obs_fleet.snapshot_to_json(fresh)
+            )]
+        )["counters"]["serve.requests"] == 9
+
+    def test_conflict_refusal_is_order_independent(self):
+        # A conflict at a SUPERSEDED epoch still refuses, wherever the
+        # superseding snapshot sits in the sequence — otherwise two
+        # observers of the same set could disagree (one refuses, one
+        # folds), which is exactly the split the refusal exists to stop.
+        a = _snap(0, 3, {"c": 1})
+        b = _snap(0, 3, {"c": 2})   # conflicts with a at epoch 3
+        c = _snap(0, 5, {"c": 9})   # supersedes both
+        for order in ([a, b, c], [a, c, b], [c, a, b], [b, c, a]):
+            with pytest.raises(ValueError, match="conflicting"):
+                obs_fleet.merge_fleet(order)
+
+    def test_wire_roundtrip(self):
+        snap = _snap(3, 2, {"c": 1}, {"g": 0.5}, {"lat": [0.05]})
+        back = obs_fleet.snapshot_from_json(obs_fleet.snapshot_to_json(snap))
+        assert back == snap
+
+
+class TestExporterByteParity:
+    """The acceptance bar: settlement bytes are identical with the
+    exporter running (and being scraped, hard) vs absent — write-only
+    obs holds end to end on the wire."""
+
+    def _stream(self, with_exporter):
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+
+        def batches():
+            rng = np.random.default_rng(11)
+            for b in range(3):
+                payloads = [
+                    (
+                        f"m{b}-{i}",
+                        [
+                            {"sourceId": f"s{j}",
+                             "probability": float(rng.random())}
+                            for j in range(3)
+                        ],
+                    )
+                    for i in range(6)
+                ]
+                yield payloads, (rng.random(6) < 0.5).tolist()
+
+        store = TensorReliabilityStore()
+        previous = obs.set_metrics_registry(obs.MetricsRegistry())
+        server = scraper = None
+        stop = threading.Event()
+        try:
+            if with_exporter:
+                server = obs_export.TelemetryServer().start()
+                url = server.url
+
+                def scrape_loop():
+                    while not stop.is_set():
+                        for endpoint in ("/metrics", "/snapshot",
+                                         "/healthz"):
+                            try:
+                                _get(url + endpoint, timeout=1.0)
+                            except Exception:
+                                pass
+
+                scraper = threading.Thread(target=scrape_loop, daemon=True)
+                scraper.start()
+            with tempfile.TemporaryDirectory() as tmp:
+                db = os.path.join(tmp, "ckpt.db")
+                journal = os.path.join(tmp, "ckpt.jrnl")
+                results = [
+                    result.by_market()
+                    for result in settle_stream(
+                        store, batches(), steps=2, now=NOW,
+                        db_path=db, journal=journal, checkpoint_every=2,
+                    )
+                ]
+                store.sync()
+                db_digest = hashlib.sha256(
+                    open(db, "rb").read()
+                ).hexdigest()
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=5.0)
+            if server is not None:
+                server.close()
+            obs.set_metrics_registry(previous)
+        return results, db_digest
+
+    def test_stream_bytes_identical_scraped_vs_unexported(self):
+        res_plain, db_plain = self._stream(False)
+        res_scraped, db_scraped = self._stream(True)
+        assert res_scraped == res_plain
+        assert db_scraped == db_plain
+
+
+def _journal_epochs_sans_clock(path):
+    """Decoded epoch frames with the wall-clock field masked (the
+    tests/test_serve.py helper, trimmed)."""
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = hdr.unpack_from(blob, off)
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4  # + crc32
+    return epochs
+
+
+def _serve_trace(n=12, width=4):
+    return [
+        (f"m-{i % width}", [("s", 0.5 + 0.01 * i)], i % 2 == 0)
+        for i in range(n)
+    ]
+
+
+def _run_service(tmp_path, name, **kwargs):
+    """Submit the standard trace, drain, close; returns the service."""
+
+    async def main():
+        service = ConsensusService(
+            TensorReliabilityStore(), steps=2, now=NOW, max_batch=4,
+            max_delay_s=None, checkpoint_every=2,
+            journal=tmp_path / f"{name}.jrnl",
+            db_path=tmp_path / f"{name}.db",
+            **kwargs,
+        )
+        async with service:
+            futures = [
+                service.submit(market, signals, outcome)
+                for market, signals, outcome in _serve_trace()
+            ]
+            await service.drain()
+        for future in futures:
+            future.result()
+        return service
+
+    return asyncio.run(main())
+
+
+class TestServiceTelemetry:
+    def test_health_fed_and_served_live(self, tmp_path):
+        monitor = obs_health.HealthMonitor(
+            objective_goodput=0.9,
+            windows=(obs_health.BurnWindow(8, 32, 2.0),),
+        )
+        scraped = {}
+
+        async def main():
+            service = ConsensusService(
+                TensorReliabilityStore(), steps=2, now=NOW, max_batch=4,
+                max_delay_s=None, slo=3600.0, health=monitor,
+            )
+            server = service.start_telemetry(host_id=3, epoch=1)
+            assert service.start_telemetry() is server  # idempotent
+            async with service:
+                futures = [
+                    service.submit(market, signals, outcome)
+                    for market, signals, outcome in _serve_trace()
+                ]
+                await service.drain()
+                for future in futures:
+                    future.result()
+                scraped["healthz"] = _get(server.url + "/healthz")
+                scraped["snapshot"] = _get(server.url + "/snapshot")
+                scraped["url"] = server.url
+            return service, server
+
+        service, server = asyncio.run(main())
+        # Every SLO-classified outcome reached the monitor.
+        verdict = monitor.verdict()
+        assert verdict["recorded"] == len(_serve_trace())
+        assert verdict["verdict"] == "healthy"
+        status, payload = scraped["healthz"]
+        assert (status, payload["verdict"]) == (200, "healthy")
+        _status, snap = scraped["snapshot"]
+        assert (snap["host_id"], snap["epoch"]) == (3, 1)
+        # close() shut the exporter down with the service.
+        with pytest.raises((OSError, urllib.error.URLError)):
+            urllib.request.urlopen(scraped["url"] + "/healthz", timeout=0.5)
+
+    def test_health_requires_slo(self):
+        monitor = obs_health.HealthMonitor(objective_goodput=0.9)
+        with pytest.raises(ValueError, match="slo"):
+            ConsensusService(
+                TensorReliabilityStore(), health=monitor
+            )
+
+    def test_shed_when_burning_is_an_admission_input(self, tmp_path):
+        monitor = obs_health.HealthMonitor(
+            objective_goodput=0.9,
+            windows=(obs_health.BurnWindow(2, 4, 1.0),),
+        )
+        for _ in range(4):
+            monitor.record("violated")
+        assert monitor.burning is True
+        recorded_before = monitor.verdict()["recorded"]
+
+        async def main():
+            service = ConsensusService(
+                TensorReliabilityStore(), steps=1, now=NOW, max_batch=4,
+                max_delay_s=None, slo=3600.0, health=monitor,
+                admission=AdmissionConfig(
+                    max_pending=64, policy="reject",
+                    shed_when_burning=True, burn_probe_every=2,
+                ),
+            )
+            futures = []
+            async with service:
+                with pytest.raises(Overloaded):
+                    service.submit("m-0", [("s", 0.5)], True)
+                # Probe admission (every 2nd burn arrival here): the
+                # monitor keeps seeing real outcomes, so a recovered
+                # service can CLEAR its burning verdict instead of
+                # rejecting everything forever.
+                futures.append(service.submit("m-1", [("s", 0.5)], True))
+                with pytest.raises(Overloaded):
+                    service.submit("m-2", [("s", 0.5)], True)
+                futures.append(service.submit("m-3", [("s", 0.5)], True))
+                await service.drain()
+                for future in futures:
+                    future.result()
+            return service
+
+        service = asyncio.run(main())
+        counts = service.goodput()["counts"]
+        # Refusals are SLO-accounted like any other rejection...
+        assert counts["rejected"] == 2
+        assert counts["met"] == 2
+        # ...but burn-DRIVEN refusals never feed the monitor (no
+        # feedback loop): it saw only the two probed completions.
+        assert monitor.verdict()["recorded"] == recorded_before + 2
+
+    def test_probes_let_burning_clear(self, tmp_path):
+        # The full loop: trip burning, then let probed traffic (all
+        # met) wash the windows — the verdict must return to healthy
+        # even though every non-probe arrival is being refused.
+        monitor = obs_health.HealthMonitor(
+            objective_goodput=0.9,
+            windows=(obs_health.BurnWindow(2, 4, 1.0),),
+        )
+        for _ in range(4):
+            monitor.record("violated")
+        assert monitor.burning is True
+
+        async def main():
+            service = ConsensusService(
+                TensorReliabilityStore(), steps=1, now=NOW, max_batch=1,
+                max_delay_s=None, slo=3600.0, health=monitor,
+                admission=AdmissionConfig(
+                    max_pending=64, policy="reject",
+                    shed_when_burning=True, burn_probe_every=2,
+                ),
+            )
+            async with service:
+                submitted = 0
+                while monitor.burning and submitted < 64:
+                    try:
+                        future = service.submit(
+                            "m-0", [("s", 0.5)], True
+                        )
+                    except Overloaded:
+                        pass
+                    else:
+                        await future
+                    submitted += 1
+            return submitted
+
+        submitted = asyncio.run(main())
+        assert monitor.burning is False
+        assert submitted < 64  # it actually converged, not timed out
+
+    def test_burning_without_the_flag_changes_nothing(self, tmp_path):
+        monitor = obs_health.HealthMonitor(
+            objective_goodput=0.9,
+            windows=(obs_health.BurnWindow(2, 4, 1.0),),
+        )
+        for _ in range(4):
+            monitor.record("violated")
+        service = _run_service(
+            tmp_path, "burning_default", slo=3600.0, health=monitor,
+        )
+        counts = service.goodput()["counts"]
+        assert counts["rejected"] == 0 and counts["shed"] == 0
+        assert counts["met"] == len(_serve_trace())
+
+    def test_serve_bytes_identical_with_exporter_scraping(self, tmp_path):
+        plain = _run_service(tmp_path, "plain", slo=3600.0)
+        del plain
+
+        monitor = obs_health.HealthMonitor(objective_goodput=0.9)
+        stop = threading.Event()
+        scraper = None
+
+        async def main():
+            service = ConsensusService(
+                TensorReliabilityStore(), steps=2, now=NOW, max_batch=4,
+                max_delay_s=None, checkpoint_every=2,
+                journal=tmp_path / "scraped.jrnl",
+                db_path=tmp_path / "scraped.db",
+                slo=3600.0, health=monitor,
+            )
+            server = service.start_telemetry()
+            url = server.url
+
+            def scrape_loop():
+                while not stop.is_set():
+                    for endpoint in ("/metrics", "/snapshot", "/healthz"):
+                        try:
+                            _get(url + endpoint, timeout=1.0)
+                        except Exception:
+                            pass
+
+            nonlocal scraper
+            scraper = threading.Thread(target=scrape_loop, daemon=True)
+            scraper.start()
+            async with service:
+                futures = [
+                    service.submit(market, signals, outcome)
+                    for market, signals, outcome in _serve_trace()
+                ]
+                await service.drain()
+                for future in futures:
+                    future.result()
+            return service
+
+        try:
+            asyncio.run(main())
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=5.0)
+        assert _journal_epochs_sans_clock(
+            tmp_path / "scraped.jrnl"
+        ) == _journal_epochs_sans_clock(tmp_path / "plain.jrnl")
+        assert (tmp_path / "scraped.db").read_bytes() == (
+            tmp_path / "plain.db"
+        ).read_bytes()

@@ -192,6 +192,26 @@ _CASES = [
         f"from {PKG}.utils import config\n",
     ),
     (
+        # Round 16: the obs READ surface (exporter/fleet/health) is
+        # confined further than obs itself — pipeline may WRITE metrics
+        # (the good twin) but must never read them back through the
+        # exporter (write-only obs, enforced structurally).
+        "LY303",
+        f"{PKG}/pipeline.py",
+        f"from {PKG}.obs.export import TelemetryServer\n",
+        f"from {PKG}.obs.metrics import metrics_registry\n",
+    ),
+    (
+        # Round 16: obs is stdlib-only by contract — an obs module that
+        # imports numpy would drag a backend into every orchestration
+        # import; stdlib (and intra-obs) imports are the good twin.
+        "LY303",
+        f"{PKG}/obs/case.py",
+        "import numpy as np\n",
+        "import json\nimport http.server\n"
+        f"from {PKG}.obs.metrics import metrics_registry\n",
+    ),
+    (
         # A PartitionSpec axis the mesh does not define: the typo'd
         # string is flagged; the axis-constant twin is the idiom.
         "SH401",
@@ -368,8 +388,38 @@ class TestLayeringResolution:
                 # adjacent (graph alignment, tuner resolution) — allowed;
                 # the analytics KERNELS live in ops/ and stay flagged.
                 f"{PKG}/analytics/bands.py",
+                # Round 16: cluster recovery records recovery-scope
+                # trace spans (the crash-postmortem ring) — allowed.
+                f"{PKG}/cluster/recover.py",
             ):
                 assert _codes(src, rel, select=["LY303"]) == [], (src, rel)
+
+    def test_obs_read_surface_confined_to_serve_and_cli(self):
+        # Round 16: the exporter/fleet/health READ surface — serve/cli
+        # may import it; every other segment (including the otherwise
+        # obs-allowed orchestration tiers) is flagged, lazy or not.
+        for sub in ("export", "fleet", "health"):
+            src = f"from {PKG}.obs.{sub} import anything\n"
+            lazy = (
+                f"def f():\n    from {PKG}.obs import {sub}\n"
+                f"    return {sub}\n"
+            )
+            for rel in (
+                f"{PKG}/serve/coalesce.py",
+                f"{PKG}/cli.py",
+            ):
+                assert _codes(src, rel, select=["LY303"]) == [], (sub, rel)
+            for rel in (
+                f"{PKG}/pipeline.py",
+                f"{PKG}/state/journal.py",
+                f"{PKG}/analytics/bands.py",
+                f"{PKG}/cluster/recover.py",
+                f"{PKG}/ops/case.py",
+            ):
+                for bad in (src, lazy):
+                    assert "LY303" in _codes(
+                        bad, rel, select=["LY303"]
+                    ), (sub, rel, bad)
 
     def test_obs_import_flagged_from_pure_math_layers(self):
         # `from pkg import obs` and lazy in-function imports both count.

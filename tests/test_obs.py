@@ -65,6 +65,39 @@ class TestHistogramLayout:
             registry.histogram("h", bounds=(1.0, 3.0))
 
 
+class TestWireBucketLayouts:
+    """Round-16 telemetry-plane layouts, frozen like the default one:
+    bucket edges are schema — a changed edge silently re-bins every
+    historical scrape/burn capture (ISSUE 14)."""
+
+    def test_scrape_latency_bounds_pinned(self):
+        from bayesian_consensus_engine_tpu.obs.export import (
+            SCRAPE_LATENCY_BOUNDS,
+        )
+
+        # 10 µs → 10 s, 2 per decade: 13 edges. A scrape is a registry
+        # snapshot + a text render — the span from a no-op handler tick
+        # to a pathological fleet-size export.
+        assert len(SCRAPE_LATENCY_BOUNDS) == 13
+        assert SCRAPE_LATENCY_BOUNDS[0] == 1e-5
+        assert SCRAPE_LATENCY_BOUNDS[-1] == pytest.approx(10.0)
+        expected = tuple(1e-5 * 10.0 ** (i / 2) for i in range(13))
+        assert SCRAPE_LATENCY_BOUNDS == expected
+
+    def test_burn_rate_bounds_pinned(self):
+        from bayesian_consensus_engine_tpu.obs.health import (
+            BURN_RATE_BOUNDS,
+        )
+
+        # 0.01× → 1000× of budget pace, 2 per decade: 11 edges — burn 1
+        # (spending exactly on budget) sits on an exact edge.
+        assert len(BURN_RATE_BOUNDS) == 11
+        assert BURN_RATE_BOUNDS[0] == 0.01
+        assert BURN_RATE_BOUNDS[-1] == pytest.approx(1000.0)
+        expected = tuple(0.01 * 10.0 ** (i / 2) for i in range(11))
+        assert BURN_RATE_BOUNDS == expected
+
+
 class TestHistogramQuantile:
     """Round-8 quantile surface: bucket-interpolated, EXACT when the
     rank lands on a log-bucket boundary, reproducible from counts alone
@@ -479,6 +512,24 @@ class TestLedger:
         with pytest.raises(ValueError, match="malformed"):
             obs.read_ledger(path)
 
+    def test_truncated_final_record_dropped_exactly(self, tmp_path):
+        # The explicit torn-tail case (ISSUE 14 satellite): a REAL
+        # record cut mid-bytes — a SIGKILL between write and flush
+        # boundary — must drop exactly that record, never a neighbour
+        # (the appended-garbage case above exercises a different tail).
+        path = tmp_path / "run.jsonl"
+        with obs.RunLedger(path, run_id="r1") as ledger:
+            for i in range(3):
+                ledger.record("leg", value=float(i), unit="s", repeat=i)
+        lines = path.read_bytes().splitlines(keepends=True)
+        assert len(lines) == 3
+        path.write_bytes(
+            b"".join(lines[:2]) + lines[2][: len(lines[2]) // 2]
+        )
+        records = obs.read_ledger(path)
+        assert [r["repeat"] for r in records] == [0, 1]
+        assert [r["value"] for r in records] == [0.0, 1.0]
+
     def test_min_of_repeats_band(self, tmp_path):
         path = tmp_path / "run.jsonl"
         with obs.RunLedger(path, run_id="r1") as ledger:
@@ -703,6 +754,59 @@ class TestLedgerDiff:
         assert "all shared legs overlap" in calm
 
 
+class TestSloColumn:
+    """Round-16 stats surface: the absolute offered-but-not-met count
+    (``slo_violations``) rides beside the goodput fraction, sourced from
+    the serve-leg records' ``extras.slo`` and diffed by ``--against``
+    like ``hbm_read``."""
+
+    @staticmethod
+    def _slo_records(leg, counts_list):
+        return [
+            {
+                "leg": leg, "value": 1.0, "unit": "s", "host": {},
+                "extras": {"slo": {"objective_s": 0.05, "counts": counts}},
+            }
+            for counts in counts_list
+        ]
+
+    def test_violations_merge_across_repeats(self):
+        records = self._slo_records(
+            "e2e_serve",
+            [
+                {"met": 90, "violated": 5, "shed": 3, "rejected": 2,
+                 "failed": 0},
+                {"met": 95, "violated": 1, "shed": 0, "rejected": 0,
+                 "failed": 4},
+            ],
+        )
+        band = obs.min_of_repeats(records, "e2e_serve")
+        assert band["slo_violations"] == 15  # every non-met outcome
+        assert band["goodput_within_slo"] == pytest.approx(185 / 200)
+
+    def test_render_has_slo_column(self):
+        records = self._slo_records(
+            "e2e_serve", [{"met": 9, "violated": 1}]
+        )
+        rendered = obs_ledger.render(records)
+        header, row = rendered.splitlines()[:2]
+        assert "slo" in header.split()
+        assert " 1 " in row  # the violation count renders as an integer
+        # Legs without SLO records dash the column.
+        plain = obs_ledger.render(
+            [{"leg": "plain", "value": 1.0, "unit": "s", "host": {}}]
+        )
+        assert "-" in plain.splitlines()[1]
+
+    def test_diff_carries_slo_violations(self):
+        old = self._slo_records("e2e_serve", [{"met": 99, "violated": 1}])
+        new = self._slo_records("e2e_serve", [{"met": 80, "violated": 20}])
+        diff = obs.diff_bands(old, new)
+        metric = diff["e2e_serve"]["metrics"]["slo_violations"]
+        assert (metric["old"], metric["new"]) == (1, 20)
+        assert "slo 1->20" in obs.render_diff(diff)
+
+
 class TestCliStats:
     def _main(self, argv, capsys):
         import sys
@@ -739,6 +843,51 @@ class TestCliStats:
         assert payload["legs"]["leg"]["min"] == 1.0
         assert payload["legs"]["leg"]["max"] == 2.0
         assert "other" not in payload["legs"]
+
+    def test_stats_live_scrapes_an_exporter(self, tmp_path, capsys):
+        # Round 16: --live renders a running exporter's snapshot +
+        # health verdict — next to the ledger bands when one is given,
+        # alone otherwise (the ledger argument becomes optional).
+        from bayesian_consensus_engine_tpu.obs.export import (
+            TelemetryServer,
+        )
+        from bayesian_consensus_engine_tpu.obs.health import (
+            BurnWindow,
+            HealthMonitor,
+        )
+
+        registry = obs_metrics.MetricsRegistry()
+        registry.counter("serve.requests").inc(41)
+        monitor = HealthMonitor(
+            objective_goodput=0.9, windows=(BurnWindow(2, 4, 1.0),)
+        )
+        for _ in range(4):
+            monitor.record("violated")
+        with TelemetryServer(
+            registry=registry, health=monitor, host_id=2, epoch=5
+        ) as server:
+            out = self._main(["stats", "--live", server.url], capsys).out
+            assert "live host 2 epoch 5" in out
+            assert "health=burning" in out  # 503 bodies are answers
+            assert "serve.requests" in out and "41" in out
+            path = tmp_path / "run.jsonl"
+            with obs.RunLedger(path, run_id="r1") as ledger:
+                ledger.record("leg", value=1.0, unit="s")
+            both = self._main(
+                ["stats", str(path), "--live", server.url], capsys
+            ).out
+            assert "leg" in both and "live host 2" in both
+            as_json = json.loads(
+                self._main(
+                    ["stats", "--json", "--live", server.url], capsys
+                ).out
+            )
+            assert as_json["live"]["healthz"]["verdict"] == "burning"
+            assert as_json["live"]["snapshot"]["host_id"] == 2
+
+    def test_stats_without_ledger_or_live_errors(self, capsys):
+        with pytest.raises(SystemExit):
+            self._main(["stats"], capsys)
 
     def test_stats_missing_file_errors(self, tmp_path, capsys):
         with pytest.raises(SystemExit) as excinfo:
