@@ -21,6 +21,9 @@ Layers (bottom → top), mirroring the reference's layer map but TPU-first:
   pipeline   payloads → plan → device settle → store → SQLite, end to end
              (sessions, the streamed service loop, mesh/band sharding)
   serve/     online micro-batch coalescing front end over the session
+             + multi-tenant per-class QoS (variance-aware shedding)
+  net/       stdlib socket front door: framed wire codec, N-acceptor
+             server feeding the one coalescer, blocking client
   cli        command-line surface (byte-compatible with the reference CLI)
 
 The scalar path imports no JAX; array paths import it lazily.

@@ -15,7 +15,12 @@ Extensions (additive, do not change reference-shaped outputs): ``--backend
 {python,jax,tpu}`` selects the consensus engine implementation;
 ``journal-export JRNL`` replays a ``settle_stream`` durability journal
 (state/journal.py) and exports the reference-compatible SQLite file to
-``--db`` — the crash-recovery path without writing Python; ``lint`` runs
+``--db`` — the crash-recovery path without writing Python; ``serve``
+runs the round-17 network front door — the net/ socket server over the
+coalescing ``ConsensusService``, with repeated ``--qos`` specs
+declaring multi-tenant classes (per-class SLO/budget/policy) — printing
+a banner JSON line on bind and a per-class goodput summary on exit;
+``lint`` runs
 graftlint, the repo's JAX/determinism/layering static analysis
 (docs/static-analysis.md); ``stats`` renders an obs run ledger
 (obs/ledger.py JSONL — the min-of-N bench discipline) as per-leg bands
@@ -30,6 +35,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 from typing import Any
 
@@ -346,6 +352,153 @@ def _run_stats(args: argparse.Namespace) -> None:
         _print_live()
 
 
+def _parse_qos_spec(spec: str):
+    """``name:slo_s:max_pending[:policy[:burning]]`` → QosClass.
+
+    The CLI shape of :class:`~.serve.admission.QosClass`:
+    ``premium:0.05:512`` declares class *premium* with a 50 ms SLO and
+    a 512-request budget (reject policy); a fourth field picks the
+    overload policy (``reject``/``shed_oldest``) and a literal fifth
+    field ``burning`` opts the class into shedding on its own burn-rate
+    verdict (``shed_when_burning``).
+    """
+    from bayesian_consensus_engine_tpu.serve import QosClass
+
+    parts = spec.split(":")
+    if len(parts) < 3 or len(parts) > 5:
+        raise ValueError(
+            f"--qos takes name:slo_s:max_pending[:policy[:burning]]; "
+            f"got {spec!r}"
+        )
+    if len(parts) == 5 and parts[4] != "burning":
+        raise ValueError(
+            f"--qos fifth field must be the literal 'burning'; got "
+            f"{parts[4]!r}"
+        )
+    return QosClass(
+        name=parts[0],
+        slo_s=float(parts[1]),
+        max_pending=int(parts[2]),
+        policy=parts[3] if len(parts) > 3 else "reject",
+        shed_when_burning=len(parts) == 5,
+    )
+
+
+def _run_serve(args: argparse.Namespace) -> None:
+    """Run the network front door: socket server → coalescer → session.
+
+    Composes the round-17 serving stack over one fresh in-memory store:
+    a :class:`~.serve.coalesce.ConsensusService` (journal durability
+    via ``--journal``, rolling SQLite via the global ``--db``, QoS
+    classes via repeated ``--qos`` specs, a global SLO via
+    ``--slo-ms``) behind a :class:`~.net.server.ConsensusServer`
+    (``--port 0`` binds ephemeral), optionally exposing the live
+    telemetry plane (``--telemetry-port``). Prints ONE banner JSON line
+    (address, port, classes, telemetry URL) when the socket is bound —
+    the line a launcher script parses — then serves for ``--duration``
+    seconds (0 = until interrupted). On exit the service drains and
+    closes (journal ends on a joined epoch) and a summary JSON document
+    (requests, batches, per-class goodput) lands on stdout.
+    """
+    import asyncio
+
+    try:
+        qos = [_parse_qos_spec(spec) for spec in (args.qos or [])]
+    except ValueError as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+
+    from bayesian_consensus_engine_tpu import obs
+    from bayesian_consensus_engine_tpu.net import ConsensusServer
+    from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
+    from bayesian_consensus_engine_tpu.serve import ConsensusService
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    # A live registry BEFORE the service binds its counters: without
+    # one, every metric is the shared no-op (obs disabled by default),
+    # the exit summary would report zeros, and --telemetry-port would
+    # export an empty plane — the one process where obs is the product.
+    obs.set_metrics_registry(obs.MetricsRegistry())
+    store = TensorReliabilityStore()
+
+    async def main() -> dict[str, Any]:
+        service = ConsensusService(
+            store,
+            steps=args.steps,
+            journal=args.journal,
+            db_path=args.db,
+            max_batch=args.max_batch,
+            max_delay_s=args.max_delay_ms / 1e3,
+            qos=qos or None,
+            slo=(args.slo_ms / 1e3) if args.slo_ms else None,
+        )
+        telemetry_url = None
+        if args.telemetry_port is not None:
+            telemetry = service.start_telemetry(port=args.telemetry_port)
+            telemetry_url = telemetry.url
+        server = await ConsensusServer(
+            service, host=args.host, port=args.port,
+            acceptors=args.acceptors,
+        ).start()
+        banner = {
+            "address": server.address,
+            "port": server.port,
+            "classes": [cls.name for cls in qos],
+            "telemetry": telemetry_url,
+        }
+        print(json.dumps(banner, sort_keys=True), flush=True)
+        # Ctrl-C must still land the exit summary: route SIGINT through
+        # a stop event so the interrupted path drains and returns like
+        # the --duration path, instead of cancelling main() before the
+        # summary is built (the outer KeyboardInterrupt catch is only
+        # the fallback for loops without signal-handler support).
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGINT, stop.set)
+            sigint_routed = True
+        except (NotImplementedError, RuntimeError, ValueError):
+            sigint_routed = False
+        try:
+            if args.duration > 0:
+                try:
+                    await asyncio.wait_for(stop.wait(), args.duration)
+                except asyncio.TimeoutError:
+                    pass
+            else:
+                await stop.wait()  # until interrupted
+        finally:
+            if sigint_routed:
+                loop.remove_signal_handler(signal.SIGINT)
+            await server.close()
+            await service.close()
+        counters = metrics_registry().export().get("counters", {})
+        return {
+            "served": {
+                "connections": counters.get("net.connections", 0),
+                "requests": counters.get("net.requests", 0),
+                "responses": counters.get("net.responses", 0),
+                "wireErrors": counters.get("net.wire_errors", 0),
+                "batches": counters.get("serve.batches", 0),
+            },
+            "goodputWithinSlo": (service.goodput() or {}).get(
+                "goodput_within_slo"
+            ),
+            "qos": service.qos_snapshot(),
+        }
+
+    try:
+        summary = asyncio.run(main())
+    except KeyboardInterrupt:
+        return
+    except Exception as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+    _emit(summary)
+
+
 def _run_trace(args: argparse.Namespace) -> None:
     """Convert a tracer span log (JSONL) to Chrome trace-event JSON.
 
@@ -476,6 +629,61 @@ def build_parser() -> argparse.ArgumentParser:
         "journal", help="path to the journal written by settle_stream"
     )
     journal.set_defaults(handler=_run_journal_export)
+
+    serve = sub.add_parser(
+        "serve",
+        help=(
+            "run the network front door: a length-prefixed socket "
+            "server (net/) over the coalescing consensus service, with "
+            "optional multi-tenant QoS classes"
+        ),
+    )
+    serve.add_argument(
+        "--host", default="127.0.0.1", help="bind address"
+    )
+    serve.add_argument(
+        "--port", type=int, default=0,
+        help="bind port (0 = ephemeral; read the banner JSON back)",
+    )
+    serve.add_argument(
+        "--acceptors", type=int, default=4,
+        help="asyncio acceptor tasks over the listening socket",
+    )
+    serve.add_argument(
+        "--qos", action="append", metavar="NAME:SLO_S:MAX_PENDING[:POLICY[:burning]]",
+        help=(
+            "declare one QoS class (repeatable; first = default class); "
+            "e.g. premium:0.05:512 besteffort:1.0:64:shed_oldest:burning"
+        ),
+    )
+    serve.add_argument(
+        "--steps", type=int, default=1, help="settlement steps per batch"
+    )
+    serve.add_argument(
+        "--max-batch", type=int, default=256,
+        help="coalescing window size bound",
+    )
+    serve.add_argument(
+        "--max-delay-ms", type=float, default=5.0,
+        help="coalescing window max delay",
+    )
+    serve.add_argument(
+        "--slo-ms", type=float, default=None,
+        help="service-wide latency objective (per-class SLOs ride --qos)",
+    )
+    serve.add_argument(
+        "--journal", default=None,
+        help="durability journal path (epochs per checkpoint cadence)",
+    )
+    serve.add_argument(
+        "--telemetry-port", type=int, default=None,
+        help="also expose the live telemetry plane on this port (0 = ephemeral)",
+    )
+    serve.add_argument(
+        "--duration", type=float, default=0.0,
+        help="serve this many seconds then drain (0 = until interrupted)",
+    )
+    serve.set_defaults(handler=_run_serve)
 
     stats = sub.add_parser(
         "stats",

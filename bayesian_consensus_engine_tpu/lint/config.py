@@ -57,6 +57,13 @@ LAYERS: dict[str, int] = {
     # pipeline — one orchestration tier, two faces (batch and online).
     "pipeline": 7,
     "serve": 7,
+    # net (the socket front door, round 17) shares the serve tier: the
+    # server submits into serve's coalescer and the client raises
+    # serve's exceptions — transport and policy are one tier, and the
+    # numeric rule keeps every engine tier below from importing net
+    # (an ops kernel that could open a socket would be an ops kernel
+    # one refactor from a host sync mid-dispatch).
+    "net": 7,
     "cli": 8,
     # The root facade re-exports for users; nothing inside imports it.
     "__init__": 99,
@@ -90,10 +97,14 @@ LAYER_IMPORT_OVERRIDES: dict[str, frozenset[str]] = {
 #: postmortem can show an adoption in flight — orchestration-adjacent
 #: instrumentation, same as analytics. bench/scripts/tests live outside
 #: the package and are unconstrained.
+#: ``net`` joined in round 17: the socket front door counts its
+#: connections/frames/wire errors (write surface only — the exporter/
+#: fleet/health READ surface stays confined below; the server serves
+#: requests, the service's telemetry exporter serves metrics).
 OBS_ALLOWED_IMPORTERS: frozenset[str] = frozenset(
     {
         "obs", "pipeline", "serve", "state", "cli", "analytics",
-        "cluster", "__init__",
+        "cluster", "net", "__init__",
     }
 )
 

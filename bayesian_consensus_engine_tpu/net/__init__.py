@@ -1,0 +1,44 @@
+"""net/ — the stdlib network front door (round 17).
+
+The serving tier's missing transport: until this package, "millions of
+clients" meant one Python process calling
+:meth:`~.serve.coalesce.ConsensusService.submit` on its own event loop.
+``net`` puts a real multi-client socket protocol in front of the SAME
+service without forking its batching discipline:
+
+* :mod:`~.net.wire` — the sans-IO framed codec: length-prefixed frames
+  with a versioned header, payload CRC, canonical-JSON payloads, and
+  explicit error frames (``overloaded``/``shed``/``closed``/``failed``
+  plus the transport tier: ``bad_frame``/``version_mismatch``/
+  ``oversized``).
+* :mod:`~.net.server` — :class:`ConsensusServer`: N asyncio acceptor
+  tasks over one listening socket, each connection's requests submitted
+  in wire arrival order into the ONE existing coalescer, responses
+  pipelined back in completion order by request id. Framing violations
+  kill only the offending connection.
+* :mod:`~.net.client` — :class:`ConsensusClient`: a blocking client for
+  tests, bench load generators, and the CLI; raises the same
+  serve-layer exceptions as in-process ``submit``.
+
+The byte contract is the headline: the same admitted-request trace
+served over the wire and submitted in-process yields identical results,
+journal epoch payloads (wall_ts masked), and SQLite bytes — flat AND
+sharded-resident (tests/test_net.py). Layer tier of ``serve`` in the
+lint map; engine tiers never import ``net``.
+"""
+
+from bayesian_consensus_engine_tpu.net.client import ConsensusClient
+from bayesian_consensus_engine_tpu.net.server import ConsensusServer
+from bayesian_consensus_engine_tpu.net.wire import (
+    MAX_FRAME_BYTES,
+    WIRE_VERSION,
+    WireError,
+)
+
+__all__ = [
+    "ConsensusClient",
+    "ConsensusServer",
+    "MAX_FRAME_BYTES",
+    "WIRE_VERSION",
+    "WireError",
+]

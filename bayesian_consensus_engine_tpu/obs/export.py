@@ -183,11 +183,18 @@ class TelemetryServer:
         port: int = 0,
         host_id: int = 0,
         epoch: int = 0,
+        qos=None,
     ) -> None:
         self._registry = registry
         self.health = health
         self._timeline = timeline
         self._tracer = tracer
+        #: Per-class QoS provider (round 17): a zero-arg callable (or a
+        #: static mapping) yielding class name → accounting dict — what
+        #: ``ConsensusService.start_telemetry`` wires so ``/snapshot``
+        #: carries the class-labeled goodput block the fleet merge and
+        #: ``stats --live`` consume. ``None`` keeps the block absent.
+        self._qos = qos
         self._host = host
         self._requested_port = int(port)
         self.host_id = int(host_id)
@@ -272,6 +279,7 @@ class TelemetryServer:
         :class:`~.obs.fleet.HostSnapshot` fields)."""
         tracer = self._tracer
         health = self.health
+        qos = self._qos() if callable(self._qos) else self._qos
         return {
             "host_id": self.host_id,
             "epoch": self.epoch,
@@ -282,6 +290,7 @@ class TelemetryServer:
                 "ring_depths": tracer.ring_depths() if tracer else {},
             },
             "health": health.verdict() if health is not None else None,
+            "qos": qos,
             "wall_ts": time.time(),
         }
 
@@ -416,6 +425,23 @@ def render_live_snapshot(
             lines.append(
                 f"    {name:<36} {int(snap.get('count', 0)):>7}"
                 f" {num(p50):>9} {num(p99):>9}"
+            )
+    qos = snapshot.get("qos") or {}
+    if qos:
+        lines.append(
+            "  qos classes (pending / offered / goodput / burning):"
+        )
+        for name in sorted(qos):
+            record = qos[name] or {}
+            goodput = record.get("goodput_within_slo")
+            goodput_str = (
+                f"{goodput * 100:.1f}%"
+                if isinstance(goodput, (int, float)) else "-"
+            )
+            lines.append(
+                f"    {name:<20} {record.get('pending', 0):>7}"
+                f" {record.get('offered', 0):>9} {goodput_str:>9}"
+                f" {'yes' if record.get('burning') else 'no':>8}"
             )
     phases = snapshot.get("phases") or {}
     if phases:

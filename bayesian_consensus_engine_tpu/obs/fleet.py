@@ -46,6 +46,7 @@ from bayesian_consensus_engine_tpu.obs.export import (
     render_histogram_lines,
     sanitize_metric_name,
 )
+from bayesian_consensus_engine_tpu.obs.slo import goodput_from_counts
 
 
 @dataclass(frozen=True)
@@ -53,40 +54,46 @@ class HostSnapshot:
     """One host's epoch-tagged metric snapshot.
 
     ``metrics`` is a :meth:`~.obs.metrics.MetricsRegistry.export`-shaped
-    dict (``counters``/``gauges``/``histograms``). Instances are what a
-    host publishes and what every observer folds — the fold never goes
-    back to the host.
+    dict (``counters``/``gauges``/``histograms``). ``qos`` (round 17) is
+    the host's per-class QoS block when its service declared tenant
+    classes — class name → ``{slo_s, counts, ...}``, exactly the
+    ``/snapshot`` endpoint's qos payload — and ``None`` on hosts without
+    one. Instances are what a host publishes and what every observer
+    folds — the fold never goes back to the host.
     """
 
     host_id: int
     epoch: int
     metrics: Mapping[str, Mapping]
+    qos: Optional[Mapping[str, Mapping]] = None
 
     def __post_init__(self) -> None:
         if self.epoch < 0:
             raise ValueError(f"epoch must be >= 0; got {self.epoch}")
 
 
-def snapshot_host(host_id: int, epoch: int, registry) -> HostSnapshot:
+def snapshot_host(
+    host_id: int, epoch: int, registry, qos=None
+) -> HostSnapshot:
     """This host's snapshot of *registry*, tagged with its membership
     identity — the publish half of the fleet fold."""
     return HostSnapshot(
-        host_id=int(host_id), epoch=int(epoch), metrics=registry.export()
+        host_id=int(host_id), epoch=int(epoch), metrics=registry.export(),
+        qos=dict(qos) if qos is not None else None,
     )
 
 
 def snapshot_to_json(snapshot: HostSnapshot) -> str:
     """Byte-deterministic serialisation (sorted keys, fixed separators —
     the DT203 contract): what a host writes to the wire or a soak dir."""
-    return json.dumps(
-        {
-            "host_id": snapshot.host_id,
-            "epoch": snapshot.epoch,
-            "metrics": snapshot.metrics,
-        },
-        sort_keys=True,
-        separators=(",", ":"),
-    )
+    payload = {
+        "host_id": snapshot.host_id,
+        "epoch": snapshot.epoch,
+        "metrics": snapshot.metrics,
+    }
+    if snapshot.qos is not None:
+        payload["qos"] = snapshot.qos
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"))
 
 
 def snapshot_from_json(raw: str) -> HostSnapshot:
@@ -97,11 +104,13 @@ def snapshot_from_wire(payload: Mapping[str, object]) -> HostSnapshot:
     """Lift a scraped ``/snapshot`` payload (or a
     :func:`snapshot_to_json` round trip) into a :class:`HostSnapshot` —
     extra endpoint fields (phases, trace, health) are ignored; the fleet
-    fold is a metrics fold."""
+    fold is a metrics (+ per-class QoS) fold."""
+    qos = payload.get("qos")
     return HostSnapshot(
         host_id=int(payload["host_id"]),
         epoch=int(payload["epoch"]),
         metrics=dict(payload["metrics"]),
+        qos=dict(qos) if qos is not None else None,
     )
 
 
@@ -130,7 +139,10 @@ def merge_fleet(
         held_at_epoch = seen.get((snap.host_id, snap.epoch))
         if held_at_epoch is None:
             seen[(snap.host_id, snap.epoch)] = snap
-        elif held_at_epoch.metrics != snap.metrics:
+        elif (
+            held_at_epoch.metrics != snap.metrics
+            or held_at_epoch.qos != snap.qos
+        ):
             raise ValueError(
                 f"two conflicting snapshots for host {snap.host_id} "
                 f"at epoch {snap.epoch} — refusing to merge"
@@ -183,7 +195,8 @@ def merge_fleet(
             ]
             merged["count"] += int(snap_hist["count"])
             merged["sum"] += float(snap_hist["sum"])
-    return {
+    qos = _merge_qos(hosts, latest)
+    view = {
         "epoch": epoch,
         "hosts": hosts,
         "host_epochs": per_host_epochs,
@@ -195,6 +208,71 @@ def merge_fleet(
             name: histograms[name] for name in sorted(histograms)
         },
     }
+    if qos is not None:
+        view["qos"] = qos
+    return view
+
+
+def _merge_qos(hosts, latest) -> Optional[Dict[str, object]]:
+    """Fold the class-labeled QoS blocks under the same discipline.
+
+    Hosts without a qos block contribute nothing (a host can serve
+    without tenant classes); hosts WITH one must agree on the class
+    VOCABULARY — the sorted class-name set and each class's ``slo_s``.
+    A disagreement refuses like a histogram-layout mismatch: the class
+    list is schema, and summing a "premium" that means 50 ms on host 0
+    into a "premium" that means 5 s on host 1 would be a number nobody
+    declared. Per class: outcome ``counts`` SUM (the SloTracker merge
+    rule), goodput is recomputed from the sum, ``pending`` stays a
+    per-host series, and ``hosts_burning`` lists the hosts whose class
+    monitor was burning — burning is a statement about one host's
+    budget, never a fleet average.
+    """
+    carrying = [
+        (host, latest[host].qos) for host in hosts
+        if latest[host].qos  # None or {} both mean "no tenant classes"
+    ]
+    if not carrying:
+        return None
+    vocabulary = None
+    vocabulary_host = None
+    for host, qos in carrying:
+        vocab = {
+            str(name): float((qos[name] or {}).get("slo_s") or 0.0)
+            for name in qos
+        }
+        if vocabulary is None:
+            vocabulary, vocabulary_host = vocab, host
+        elif vocab != vocabulary:
+            raise ValueError(
+                "QoS class vocabularies differ across hosts "
+                f"(host {vocabulary_host}: {sorted(vocabulary)} vs "
+                f"host {host}: {sorted(vocab)}, slo_s compared per "
+                "class) — the class list is schema; cannot merge"
+            )
+    merged: Dict[str, Dict[str, object]] = {}
+    for name in sorted(vocabulary):
+        counts: Dict[str, int] = {}
+        pending: Dict[str, int] = {}
+        burning_hosts = []
+        for host, qos in carrying:
+            record = qos[name] or {}
+            for outcome in sorted(record.get("counts") or {}):
+                value = record["counts"][outcome]
+                if isinstance(value, (int, float)):
+                    counts[outcome] = counts.get(outcome, 0) + int(value)
+            pending[str(host)] = int(record.get("pending") or 0)
+            if record.get("burning"):
+                burning_hosts.append(host)
+        merged[name] = {
+            "slo_s": vocabulary[name],
+            "counts": {k: counts[k] for k in sorted(counts)},
+            "offered": sum(counts.values()),
+            "goodput_within_slo": goodput_from_counts(counts),
+            "pending": pending,
+            "hosts_burning": burning_hosts,
+        }
+    return merged
 
 
 def fleet_to_json(view: Mapping[str, object]) -> str:
@@ -225,6 +303,28 @@ def render_fleet_prometheus(
         metric = sanitize_metric_name(name, prefix)
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {format_metric_value(value)}")
+    qos_view = view.get("qos") or {}
+    if qos_view:
+        # Class-labeled series: one ``class=`` label per declared tenant
+        # class, names sorted — same determinism contract as hosts.
+        offered_metric = sanitize_metric_name("qos.offered", prefix)
+        goodput_metric = sanitize_metric_name(
+            "qos.goodput_within_slo", prefix
+        )
+        lines.append(f"# TYPE {offered_metric} counter")
+        for name in sorted(qos_view):
+            lines.append(
+                f"{offered_metric}{format_labels({'class': name})} "
+                f"{format_metric_value(qos_view[name].get('offered', 0))}"
+            )
+        lines.append(f"# TYPE {goodput_metric} gauge")
+        for name in sorted(qos_view):
+            goodput = qos_view[name].get("goodput_within_slo")
+            if goodput is not None:
+                lines.append(
+                    f"{goodput_metric}{format_labels({'class': name})} "
+                    f"{format_metric_value(goodput)}"
+                )
     for raw_name in sorted(view.get("counters", {})):
         metric = sanitize_metric_name(raw_name, prefix)
         lines.append(f"# TYPE {metric} counter")

@@ -144,6 +144,7 @@ class HealthMonitor:
         self,
         objective_goodput: float = 0.99,
         windows: Sequence[BurnWindow] = DEFAULT_WINDOWS,
+        metric_prefix: str = "health",
     ) -> None:
         if not 0.0 < objective_goodput < 1.0:
             raise ValueError(
@@ -171,11 +172,19 @@ class HealthMonitor:
         #: per-arrival window rescan under the lock.
         self._last_burning = False
         registry = metrics_registry()
-        self._fast_gauge = registry.gauge("health.burn_rate_fast")
-        self._slow_gauge = registry.gauge("health.burn_rate_slow")
-        self._burning_gauge = registry.gauge("health.burning")
+        # ``metric_prefix`` namespaces the written series so several
+        # monitors can coexist in one process — the round-17 per-class
+        # QoS monitors write ``serve.qos.<class>.health.*`` while the
+        # service-wide monitor keeps the bare ``health.*`` vocabulary
+        # (two monitors on ONE prefix would silently overwrite each
+        # other's gauges).
+        self.metric_prefix = str(metric_prefix)
+        prefix = self.metric_prefix
+        self._fast_gauge = registry.gauge(f"{prefix}.burn_rate_fast")
+        self._slow_gauge = registry.gauge(f"{prefix}.burn_rate_slow")
+        self._burning_gauge = registry.gauge(f"{prefix}.burning")
         self._burn_hist = registry.histogram(
-            "health.burn_rate", bounds=BURN_RATE_BOUNDS
+            f"{prefix}.burn_rate", bounds=BURN_RATE_BOUNDS
         )
 
     # -- feeding -------------------------------------------------------------

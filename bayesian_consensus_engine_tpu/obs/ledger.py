@@ -197,6 +197,7 @@ def min_of_repeats(
     }
     band.update(_latency_quantiles(records, leg))
     band.update(_slo_summary(records, leg))
+    band.update(_qos_summary(records, leg))
     band.update(_ingest_wait_summary(records, leg))
     band.update(_intern_summary(records, leg))
     band.update(_peak_mem_summary(records, leg))
@@ -414,6 +415,70 @@ def _slo_summary(
     }
 
 
+def _qos_summary(
+    records: List[Dict[str, object]], leg: str
+) -> Dict[str, object]:
+    """Merged per-class QoS accounting over a leg's records (round 17).
+
+    Records carrying ``extras["qos"]`` (class name →
+    ``{slo_s, counts}`` — the ``e2e_netserve`` acts record the
+    service's :meth:`~.serve.coalesce.ConsensusService.qos_snapshot`)
+    merge across repeats by summing each class's per-outcome counts —
+    the same rule as the global ``extras.slo`` fold, applied per class.
+    The class vocabulary is schema: records of one leg disagreeing on
+    the class-name set or a class's ``slo_s`` refuse, like a
+    latency-histogram layout mismatch. The band gains
+    ``qos: {class: {slo_s, counts, goodput_within_slo,
+    slo_violations}}`` — the per-class goodput/slo columns ``bce-tpu
+    stats`` renders under the leg row and ``--against`` diffs.
+    """
+    from bayesian_consensus_engine_tpu.obs.slo import goodput_from_counts
+
+    merged: Dict[str, Dict[str, object]] = {}
+    for rec in records:
+        if rec.get("leg") != leg:
+            continue
+        qos = (rec.get("extras") or {}).get("qos")
+        if not isinstance(qos, dict) or not qos:
+            continue
+        if merged and sorted(qos) != sorted(merged):
+            raise ValueError(
+                f"leg {leg!r}: QoS class vocabularies differ across "
+                f"records ({sorted(merged)} vs {sorted(qos)}) — the "
+                "class list is schema; cannot merge repeats"
+            )
+        for name in sorted(qos):
+            record = qos[name] or {}
+            slo_s = record.get("slo_s")
+            held = merged.setdefault(
+                name, {"slo_s": slo_s, "counts": {}}
+            )
+            if held["slo_s"] != slo_s:
+                raise ValueError(
+                    f"leg {leg!r}: class {name!r} declares slo_s="
+                    f"{slo_s} vs {held['slo_s']} across records — "
+                    "cannot merge repeats"
+                )
+            counts = record.get("counts")
+            if not isinstance(counts, dict):
+                continue
+            for outcome in sorted(counts):
+                value = counts[outcome]
+                if isinstance(value, (int, float)):
+                    held["counts"][outcome] = (
+                        held["counts"].get(outcome, 0) + int(value)
+                    )
+    if not merged:
+        return {}
+    for name, held in merged.items():
+        counts = held["counts"]
+        held["goodput_within_slo"] = goodput_from_counts(counts)
+        held["slo_violations"] = sum(
+            int(v) for k, v in counts.items() if k != "met"
+        )
+    return {"qos": merged}
+
+
 def summarize(records: List[Dict[str, object]]) -> Dict[str, Dict[str, object]]:
     """Per-leg min/max bands over a whole ledger, legs sorted by name."""
     legs = sorted({rec.get("leg") for rec in records if rec.get("leg")})
@@ -489,6 +554,23 @@ def diff_bands(
             new_value = (new_band or {}).get(name)
             if old_value is not None or new_value is not None:
                 metrics[name] = {"old": old_value, "new": new_value}
+        # Per-class QoS metrics (round 17): each class's goodput and
+        # absolute SLO-damage count diff under a ``qos.<class>.<label>``
+        # key, so a premium-class regression shows up even when the
+        # global goodput (best-effort-dominated) moved the other way.
+        old_qos = (old_band or {}).get("qos") or {}
+        new_qos = (new_band or {}).get("qos") or {}
+        for cls in sorted(set(old_qos) | set(new_qos)):
+            for field, label in (
+                ("goodput_within_slo", "goodput"),
+                ("slo_violations", "slo"),
+            ):
+                old_value = (old_qos.get(cls) or {}).get(field)
+                new_value = (new_qos.get(cls) or {}).get(field)
+                if old_value is not None or new_value is not None:
+                    metrics[f"qos.{cls}.{label}"] = {
+                        "old": old_value, "new": new_value,
+                    }
         if metrics:
             entry["metrics"] = metrics
         out[leg] = entry
@@ -541,6 +623,11 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             for name in ("p99", "goodput_within_slo", "slo_violations",
                          "ingest_wait_s", "intern_s", "hbm_peak_bytes",
                          "hbm_read_bytes", "recovery_s")
+        )
+        trailer += "".join(
+            metric_str(entry, name)
+            for name in sorted(entry.get("metrics") or {})
+            if name.startswith("qos.")
         )
         lines.append(
             f"{leg:<34} {band_str(entry['old']):>16} "
@@ -632,4 +719,23 @@ def render(records: List[Dict[str, object]]) -> str:
             f"{peak_str:>9} {read_str:>9} {num(band.get('recovery_s')):>9} "
             f"{load:>12} {band['unit'] or '-'}"
         )
+        # QoS-carrying legs (extras.qos — the e2e_netserve acts) get a
+        # per-class goodput/slo follow-up line under the leg row: the
+        # tiering verdict reads class by class, not as one global
+        # fraction.
+        qos = band.get("qos")
+        if qos:
+            parts = []
+            for cls in sorted(qos):
+                record = qos[cls]
+                cls_goodput = record.get("goodput_within_slo")
+                cls_goodput_str = (
+                    f"{cls_goodput * 100:.1f}%"
+                    if isinstance(cls_goodput, (int, float)) else "-"
+                )
+                parts.append(
+                    f"{cls}: goodput {cls_goodput_str} "
+                    f"slo {record.get('slo_violations', '-')}"
+                )
+            lines.append(f"{'':<6}qos  " + " | ".join(parts))
     return "\n".join(lines)

@@ -20,6 +20,11 @@ settlement:
 * :mod:`~.serve.admission` — bounded admission with an explicit overload
   policy (reject-with-retry-after or shed-oldest) so queue growth — and
   therefore p99 — stays bounded when offered load exceeds capacity.
+  Round 17 grew it multi-tenant: :class:`QosClass` gives each tenant
+  class its own SLO, budget, overload policy, and burn-rate monitor,
+  and :func:`shed_rank_key` makes shedding variance-aware (widest
+  ``band_stderr`` first, ties oldest — deterministic given the trace).
+  The network front door over this service lives in :mod:`~.net`.
 
 The serving path is byte-exact with ``settle_stream`` over the same
 coalesced batch sequence (results, store state, journal epoch payloads,
@@ -30,8 +35,10 @@ tests/test_serve.py.
 from bayesian_consensus_engine_tpu.serve.admission import (
     AdmissionConfig,
     Overloaded,
+    QosClass,
     ServiceClosed,
     ShedError,
+    shed_rank_key,
 )
 from bayesian_consensus_engine_tpu.serve.coalesce import (
     AdaptiveWindow,
@@ -46,8 +53,10 @@ __all__ = [
     "ConsensusService",
     "Overloaded",
     "PlanCache",
+    "QosClass",
     "ServeResult",
     "ServiceClosed",
     "SessionDriver",
     "ShedError",
+    "shed_rank_key",
 ]

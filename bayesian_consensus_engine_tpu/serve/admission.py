@@ -24,11 +24,38 @@ The controller only decides and counts (``serve.admitted`` /
 ``serve.rejected`` / ``serve.shed`` counters); the coalescer owns the
 queue it bounds. Deciding is O(1) and lock-free — admission sits on the
 submit path of every request.
+
+**Multi-tenant QoS (round 17).** :class:`QosClass` grows the single
+bound into per-class policy: every class carries its OWN latency
+objective (``slo_s``), admission budget (``max_pending``), overload
+policy, and burn-rate windows — so one
+:class:`~.serve.coalesce.ConsensusService` can hold a premium class to
+a tight SLO while a best-effort class absorbs the shedding. Two rules
+make the tiering real rather than cosmetic:
+
+* **Per-class health, per-class shedding**: each class with
+  ``shed_when_burning=True`` consumes its OWN
+  :class:`~.obs.health.HealthMonitor` verdict (fed only that class's
+  outcomes, written under ``serve.qos.<name>.health.*``) — a
+  best-effort class burning its budget never trips the premium class
+  into refusing, and vice versa.
+* **Variance-aware shed ranking** (:func:`shed_rank_key`): under
+  overload the victim WITHIN a class is the pending request whose
+  market the analytics tier reports widest (highest ``band_stderr``) —
+  the market whose consensus the fleet knows least about loses its
+  update first, because that update moved the posterior least. Ties
+  and unknown-band markets fall back to arrival order (oldest first),
+  which makes the policy degrade EXACTLY to the round-8 shed-oldest
+  when no analytics ran. The ranking is a pure function of
+  ``(stderr, arrival order)`` — no clocks, no identity — so shed order
+  is deterministic given the trace and the stderr map (pinned by
+  tests/test_net.py).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional, Tuple
 
 from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
 
@@ -108,6 +135,82 @@ class AdmissionConfig:
             raise ValueError("retry_after_s must be >= 0")
         if self.burn_probe_every < 1:
             raise ValueError("burn_probe_every must be >= 1")
+
+
+@dataclass(frozen=True)
+class QosClass:
+    """One tenant class: its own SLO, admission budget, and burn policy.
+
+    ``name`` keys the class on the wire (request frames carry it), in
+    metric names (``serve.qos.<name>.*``), in snapshots, and in the
+    fleet merge — restricted to ``[a-z0-9_-]`` so every surface renders
+    it verbatim. ``slo_s`` is the class's latency objective (its OWN
+    :class:`~.obs.slo.SloTracker`); ``max_pending`` bounds the class's
+    resident requests; ``policy`` is the class overload policy
+    (``shed_oldest`` sheds variance-aware WITHIN the class).
+    ``burn_windows`` (a :class:`~.obs.health.BurnWindow` sequence, or
+    None for the defaults) shapes the class's burn-rate monitor when
+    ``shed_when_burning`` consumes it — per class, not global: one
+    tenant's burning budget never refuses another tenant's traffic.
+    """
+
+    name: str
+    slo_s: float
+    max_pending: int
+    policy: str = "reject"
+    retry_after_s: float = 0.05
+    burn_windows: Optional[Tuple] = None
+    shed_when_burning: bool = False
+    burn_probe_every: int = 8
+    objective_goodput: float = 0.99
+
+    def __post_init__(self) -> None:
+        if not self.name or not all(
+            c.isascii() and (c.isalnum() or c in "_-") for c in self.name
+        ):
+            raise ValueError(
+                "QosClass name must be non-empty [a-zA-Z0-9_-]; got "
+                f"{self.name!r}"
+            )
+        if not self.slo_s > 0:
+            raise ValueError(f"slo_s must be > 0; got {self.slo_s}")
+        if self.max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        if self.policy not in _POLICIES:
+            raise ValueError(
+                f"policy must be one of {_POLICIES}; got {self.policy!r}"
+            )
+        if self.burn_windows is not None:
+            object.__setattr__(
+                self, "burn_windows", tuple(self.burn_windows)
+            )
+        if not 0.0 < self.objective_goodput < 1.0:
+            raise ValueError(
+                "objective_goodput must be in (0, 1); got "
+                f"{self.objective_goodput}"
+            )
+
+
+def shed_rank_key(
+    band_stderr: Optional[float], arrival_seq: int
+) -> Tuple[int, float, int]:
+    """The variance-aware shed ordering, as a sortable key (min = victim).
+
+    Widest band first: a market with high ``band_stderr`` is the market
+    whose pending update the posterior will miss least (the analytics
+    tier's per-market standard error is exactly the uncertainty ranking
+    ROADMAP item 2 seeded). Markets with NO known band rank after every
+    known one, and ties (including the all-unknown case) break by
+    arrival order, oldest first — so without analytics the policy IS
+    the round-8 shed-oldest. Pure: three comparisons on two inputs,
+    nothing read from clocks or identity.
+    """
+    known = band_stderr is not None
+    return (
+        0 if known else 1,
+        -float(band_stderr) if known else 0.0,
+        int(arrival_seq),
+    )
 
 
 class AdmissionController:

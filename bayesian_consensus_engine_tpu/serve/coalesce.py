@@ -31,6 +31,23 @@ maximise). Drifted traffic (markets entering/leaving, source sets
 changing) misses the fingerprint once and pays one session ``adopt()`` —
 never a per-request rebuild.
 
+**Multi-tenant QoS** (round 17). ``qos=`` declares
+:class:`~.serve.admission.QosClass` tenant classes; every submit lands
+in one (``qos_class=`` names it; ``None`` takes the first declared).
+Each class runs its OWN latency objective, admission budget, overload
+policy, and — with ``shed_when_burning`` — its own burn-rate monitor
+fed only that class's outcomes, so one tenant's burning budget never
+refuses another's traffic. Shedding is variance-aware
+(:func:`~.serve.admission.shed_rank_key`): the victim within the scope
+is the pending request whose market the analytics tier reports widest
+(highest ``band_stderr``, maintained live from analytics dispatches or
+seeded via :meth:`ConsensusService.seed_band_stderr`), ties oldest
+first — deterministic given the trace and the stderr map, and exactly
+shed-oldest when no band is known. The class decision runs BEFORE the
+service-wide bound (the aggregate backstop), and a single-tenant
+service (``qos=None``) takes none of these paths: its admission
+sequence and settled bytes are unchanged.
+
 **Admission.** ``admission`` bounds the requests resident in the service
 (submitted, not yet settled). At the bound, ``policy="reject"`` refuses
 the arrival with :class:`~.serve.admission.Overloaded` (carrying the
@@ -112,6 +129,10 @@ from typing import Any, Mapping, Optional, Sequence, Union
 
 import numpy as np
 
+from bayesian_consensus_engine_tpu.obs.health import (
+    DEFAULT_WINDOWS,
+    HealthMonitor,
+)
 from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
 from bayesian_consensus_engine_tpu.obs.slo import SloTracker
 from bayesian_consensus_engine_tpu.obs.timeline import active_timeline
@@ -120,8 +141,10 @@ from bayesian_consensus_engine_tpu.serve.admission import (
     AdmissionConfig,
     AdmissionController,
     Overloaded,
+    QosClass,
     ServiceClosed,
     ShedError,
+    shed_rank_key,
 )
 from bayesian_consensus_engine_tpu.serve.driver import PlanCache, SessionDriver
 
@@ -238,20 +261,91 @@ class AdaptiveWindow:
 class _Request:
     __slots__ = (
         "market_id", "source_ids", "probabilities", "outcome", "future",
-        "ctx", "t_submit", "t_enqueued", "t_flush",
+        "ctx", "qos", "t_submit", "t_enqueued", "t_flush",
     )
 
     def __init__(self, market_id, source_ids, probabilities, outcome, future,
-                 ctx):
+                 ctx, qos=None):
         self.market_id = market_id
         self.source_ids = source_ids
         self.probabilities = probabilities
         self.outcome = outcome
         self.future = future
         self.ctx = ctx
+        self.qos = qos  # QoS class NAME; None on an unclassed service
         self.t_submit = 0.0
         self.t_enqueued = 0.0
         self.t_flush = 0.0
+
+
+class _QosState:
+    """One tenant class's live state inside the service: its own SLO
+    tracker, optional burn-rate monitor, pending count, and metric
+    family (``serve.qos.<name>.*`` — class-labeled series the fleet
+    merge folds per class)."""
+
+    __slots__ = (
+        "cls", "slo", "health", "pending", "burn_seq",
+        "admitted", "rejected", "shed", "goodput_gauge", "pending_gauge",
+    )
+
+    def __init__(self, cls: QosClass) -> None:
+        self.cls = cls
+        self.slo = SloTracker(cls.slo_s)
+        # The per-class monitor exists only where the class consumes it
+        # (shed_when_burning) or explicitly shapes it (burn_windows):
+        # a monitor nobody reads is ring-buffer churn per request.
+        self.health = (
+            HealthMonitor(
+                objective_goodput=cls.objective_goodput,
+                windows=cls.burn_windows or DEFAULT_WINDOWS,
+                metric_prefix=f"serve.qos.{cls.name}.health",
+            )
+            if (cls.shed_when_burning or cls.burn_windows is not None)
+            else None
+        )
+        self.pending = 0
+        self.burn_seq = 0
+        registry = metrics_registry()
+        prefix = f"serve.qos.{cls.name}"
+        self.admitted = registry.counter(f"{prefix}.admitted")
+        self.rejected = registry.counter(f"{prefix}.rejected")
+        self.shed = registry.counter(f"{prefix}.shed")
+        self.goodput_gauge = registry.gauge(f"{prefix}.goodput_within_slo")
+        self.pending_gauge = registry.gauge(f"{prefix}.pending")
+
+    def record_outcome(self, outcome: str, feed_health: bool = True) -> None:
+        """Classify one terminal outcome against THIS class's objective
+        and (unless burn-driven) feed the class monitor."""
+        self.slo.record(outcome)
+        if self.health is not None and feed_health:
+            self.health.record(outcome)
+        goodput = self.slo.goodput_within_slo()
+        if goodput is not None:
+            self.goodput_gauge.set(goodput)
+
+    def set_pending(self, pending: int) -> None:
+        self.pending = pending
+        self.pending_gauge.set(float(pending))
+
+    def snapshot(self) -> dict:
+        """The class as data — the ``/snapshot`` qos block's per-class
+        record, the fleet-merge unit, and the bench leg's ledger extra.
+        ``slo_s`` + sorted outcome ``counts`` are the merge vocabulary
+        (conflicting vocabularies refuse, like histogram layouts)."""
+        snap = self.slo.snapshot()
+        return {
+            "slo_s": self.cls.slo_s,
+            "max_pending": self.cls.max_pending,
+            "policy": self.cls.policy,
+            "pending": self.pending,
+            "counts": snap["counts"],
+            "offered": snap["offered"],
+            "goodput_within_slo": snap["goodput_within_slo"],
+            "burning": (
+                self.health.burning if self.health is not None else False
+            ),
+        }
 
 
 class _Window:
@@ -322,6 +416,7 @@ class ConsensusService:
         max_batch: int = 256,
         max_delay_s: Optional[float] = 0.005,
         admission: Optional[AdmissionConfig] = None,
+        qos: Optional[Sequence[QosClass]] = None,
         slo=None,
         health=None,
         record_batches: bool = False,
@@ -381,6 +476,32 @@ class ConsensusService:
         self._admission = AdmissionController(
             admission if admission is not None else AdmissionConfig()
         )
+        #: Multi-tenant QoS (round 17): class name → live per-class
+        #: state, in DECLARATION order (the first class is the default
+        #: for unclassed submits). None = the single-tenant service,
+        #: whose admission sequence and bytes are unchanged.
+        self._qos_states: "Optional[dict[str, _QosState]]" = None
+        if qos:
+            states: "dict[str, _QosState]" = {}
+            for cls in qos:
+                if not isinstance(cls, QosClass):
+                    raise TypeError(
+                        f"qos= takes QosClass instances; got {cls!r}"
+                    )
+                if cls.name in states:
+                    raise ValueError(f"duplicate QoS class {cls.name!r}")
+                states[cls.name] = _QosState(cls)
+            self._qos_states = states
+            self._default_class = next(iter(states))
+        else:
+            self._default_class = None
+        #: Per-market band standard error, maintained from every
+        #: analytics-mode dispatch (and seedable via
+        #: :meth:`seed_band_stderr`) — the variance-aware shed policy's
+        #: ranking input. Markets absent here rank NARROW (shed last,
+        #: in arrival order), so the policy degrades to shed-oldest
+        #: when no analytics ran.
+        self._band_stderr: "dict[str, float]" = {}
 
         #: SLO accounting (obs/slo.py): classify every request that left
         #: the service; None when no objective was declared.
@@ -490,7 +611,9 @@ class ConsensusService:
         return self._intern_wait_s
 
     def submit(self, market_id: str, signals: Sequence[Signal],
-               outcome: bool) -> "asyncio.Future[ServeResult]":
+               outcome: bool,
+               qos_class: Optional[str] = None,
+               ) -> "asyncio.Future[ServeResult]":
         """Enqueue one market's signal update + outcome report.
 
         Returns an :class:`asyncio.Future` resolving to
@@ -500,6 +623,13 @@ class ConsensusService:
         bound under the reject policy and :class:`ServiceClosed` after
         :meth:`close` began. Must be called on the event-loop thread —
         the coalescer is loop-owned state.
+
+        ``qos_class`` names the request's tenant class on a service
+        constructed with ``qos=`` (``None`` lands in the FIRST declared
+        class — the declaration order is policy); the class's own
+        budget/policy/burn verdict decides first, then the service-wide
+        bound backstops the aggregate. On an unclassed service passing
+        a class name is an error, never a silent ignore.
         """
         t_submit = _time.perf_counter()
         if self._closed:
@@ -510,51 +640,99 @@ class ConsensusService:
             ) from self._failure
         if self._loop is None:
             self._loop = asyncio.get_running_loop()
+        qos_state = self._resolve_class(qos_class)
         self._requests_counter.inc()
+        # Validate BEFORE any admission decision: a malformed request
+        # must refuse on its own defect, never first evict a healthy
+        # pending request under shed_oldest and then fail — via the
+        # net/ front door that ordering would let one bad frame kill
+        # one legitimate in-flight request per send.
+        source_ids, probabilities = _normalise_signals(signals)
         ctx = TraceContext(self._submit_seq, market_id)
         self._submit_seq += 1
         tracer = active_tracer()
-        burning = (
-            self._health.burning if self._health is not None else False
-        )
-        config = self._admission.config
-        # A burn-driven refusal (below the pending bound, refused only
-        # because the budget is burning) counts against goodput like any
-        # refusal but is NOT fed back into the health monitor: feeding
-        # it would hold the error windows full of our own refusals and
-        # the verdict could never clear — the monitor sees organic
-        # outcomes only.
-        burn_driven = bool(
-            burning and config.shed_when_burning
-            and self._resident < config.max_pending
-        )
-        try:
-            decision = self._admission.decide(self._resident, burning=burning)
-        except Overloaded:
-            self._count_refused(ctx, "rejected", feed_health=not burn_driven)
-            raise
-        if decision == "shed_oldest":
-            if self._shed_oldest(feed_health=not burn_driven):
-                self._admission.count_shed()
-            else:
-                # Everything resident is already dispatch-bound — nothing
-                # left to shed; degrade to rejection so the bound holds.
-                self._admission.count_degraded_reject()
+        # -- per-class admission: the tenant's own budget/policy/burn
+        # verdict decides first (class-scoped: a burning best-effort
+        # class sheds ITS pending, never the premium class's).
+        class_shed_replaced = False
+        if qos_state is not None:
+            decision, cls_burn_driven = self._class_decision(qos_state)
+            if decision == "reject":
+                self._refuse_rejected(
+                    ctx, qos_state, feed_health=not cls_burn_driven,
+                    retry_after_s=qos_state.cls.retry_after_s,
+                    pending=qos_state.pending,
+                )
+            if decision == "shed_oldest":
+                if self._shed_victim(
+                    class_name=qos_state.cls.name,
+                    feed_health=not cls_burn_driven,
+                ):
+                    # The arrival REPLACES its victim: aggregate pending
+                    # is unchanged, so the service-wide bound cannot
+                    # newly overflow — count_shed records the pair
+                    # (victim shed, arrival admitted) and the global
+                    # controller is NOT consulted again (consulting it
+                    # would count the same arrival admitted twice).
+                    self._admission.count_shed()
+                    class_shed_replaced = True
+                else:
+                    self._refuse_rejected(
+                        ctx, qos_state, feed_health=not cls_burn_driven,
+                        retry_after_s=qos_state.cls.retry_after_s,
+                        pending=qos_state.pending,
+                    )
+        if not class_shed_replaced:
+            burning = (
+                self._health.burning if self._health is not None else False
+            )
+            config = self._admission.config
+            # A burn-driven refusal (below the pending bound, refused
+            # only because the budget is burning) counts against goodput
+            # like any refusal but is NOT fed back into the health
+            # monitor: feeding it would hold the error windows full of
+            # our own refusals and the verdict could never clear — the
+            # monitor sees organic outcomes only.
+            burn_driven = bool(
+                burning and config.shed_when_burning
+                and self._resident < config.max_pending
+            )
+            try:
+                decision = self._admission.decide(
+                    self._resident, burning=burning
+                )
+            except Overloaded:
+                if qos_state is not None:
+                    qos_state.rejected.inc()
                 self._count_refused(
-                    ctx, "rejected", feed_health=not burn_driven
+                    ctx, "rejected", qos_state=qos_state,
+                    feed_health=not burn_driven,
                 )
-                raise Overloaded(
-                    self._admission.config.retry_after_s, self._resident
-                )
-        source_ids, probabilities = _normalise_signals(signals)
+                raise
+            if decision == "shed_oldest":
+                if self._shed_victim(feed_health=not burn_driven):
+                    self._admission.count_shed()
+                else:
+                    # Everything resident is already dispatch-bound —
+                    # nothing left to shed; degrade to rejection so the
+                    # bound holds.
+                    self._refuse_rejected(
+                        ctx, qos_state, feed_health=not burn_driven,
+                        retry_after_s=self._admission.config.retry_after_s,
+                        pending=self._resident,
+                    )
         request = _Request(
             market_id, source_ids, probabilities, bool(outcome),
             self._loop.create_future(), ctx,
+            qos=qos_state.cls.name if qos_state is not None else None,
         )
         request.t_submit = t_submit
         window = self._place(request)
         self._resident += 1
         self._pending_gauge.set(float(self._resident))
+        if qos_state is not None:
+            qos_state.admitted.inc()
+            qos_state.set_pending(qos_state.pending + 1)
         request.t_enqueued = _time.perf_counter()
         # The enqueue span is OBSERVED at flush time (with coalesce), so
         # a later-shed request never lands in the latency histograms as a
@@ -599,31 +777,145 @@ class ConsensusService:
         self._windows.append(window)
         return window
 
-    def _shed_oldest(self, feed_health: bool = True) -> bool:
-        """Drop the oldest not-yet-flushed request; False when none."""
-        for window in self._windows:
-            if window.requests:
-                victim = window.requests.pop(0)
-                window.markets.discard(victim.market_id)
-                if not window.requests:
-                    self._windows.remove(window)
-                self._resident -= 1
-                self._pending_gauge.set(float(self._resident))
-                if not victim.future.done():
-                    victim.future.set_exception(
-                        ShedError(
-                            f"request for {victim.market_id!r} shed under "
-                            "overload (shed_oldest policy)"
-                        )
-                    )
-                self._count_refused(
-                    victim.ctx, "shed", feed_health=feed_health
+    def _resolve_class(self, qos_class: Optional[str]):
+        """Class name → live state; validates against the declared set."""
+        if self._qos_states is None:
+            if qos_class is not None:
+                raise ValueError(
+                    f"request names QoS class {qos_class!r} but the "
+                    "service declared no qos= classes"
                 )
-                return True
-        return False
+            return None
+        name = qos_class if qos_class is not None else self._default_class
+        state = self._qos_states.get(name)
+        if state is None:
+            raise ValueError(
+                f"unknown QoS class {name!r}; declared: "
+                f"{sorted(self._qos_states)}"
+            )
+        return state
+
+    def _class_decision(self, state: _QosState):
+        """The per-class admission verdict: ``("accept" | "reject" |
+        "shed_oldest", burn_driven)``. Mirrors
+        :meth:`~.serve.admission.AdmissionController.decide` over the
+        class's own pending count and burn verdict — kept inline so the
+        class tier counts only its ``serve.qos.<name>.*`` series (the
+        service-wide controller owns the aggregate counters)."""
+        cls = state.cls
+        over = state.pending >= cls.max_pending
+        burn_driven = False
+        if (
+            not over and cls.shed_when_burning
+            and state.health is not None and state.health.burning
+        ):
+            # Same probe discipline as the global controller: every Nth
+            # burn arrival is admitted so organic outcomes keep flowing
+            # and a recovered class can clear its own verdict.
+            state.burn_seq += 1
+            burn_driven = over = (
+                state.burn_seq % cls.burn_probe_every != 0
+            )
+        if not over:
+            return "accept", False
+        if cls.policy == "reject":
+            return "reject", burn_driven
+        return "shed_oldest", burn_driven
+
+    def _shed_victim(
+        self, class_name: Optional[str] = None, feed_health: bool = True
+    ) -> bool:
+        """Drop the variance-aware shed victim among the not-yet-flushed
+        requests (optionally within one QoS class); False when none.
+
+        The victim is the MINIMUM of :func:`~.serve.admission.
+        shed_rank_key` over the candidates: widest known ``band_stderr``
+        first (the analytics tier's per-market standard error, live in
+        :attr:`market_band_stderr`), unknown-band markets after every
+        known one, ties oldest-first by submit sequence — a pure
+        function of (class, stderr ranking, arrival order), so a fixed
+        trace sheds a fixed sequence (pinned by tests/test_net.py).
+        With no stderr known this IS the round-8 shed-oldest — served by
+        an O(1) first-match pop rather than the ranking scan, so a
+        non-analytics service under sustained overload keeps the cheap
+        per-arrival shed it always had (the scan is O(pending) and only
+        analytics-fed services pay it).
+        """
+        victim = victim_window = victim_key = None
+        if not self._band_stderr:
+            # Every candidate ranks unknown: take the first pending
+            # request in window placement order — windows are created
+            # (and flushed) oldest-first, so this is exactly the
+            # round-8 victim choice, at the round-8 cost.
+            for window in self._windows:
+                for request in window.requests:
+                    if class_name is None or request.qos == class_name:
+                        victim, victim_window = request, window
+                        break
+                if victim is not None:
+                    break
+        else:
+            for window in self._windows:
+                for request in window.requests:
+                    if class_name is not None and request.qos != class_name:
+                        continue
+                    key = shed_rank_key(
+                        self._band_stderr.get(request.market_id),
+                        request.ctx.seq,
+                    )
+                    if victim_key is None or key < victim_key:
+                        victim, victim_window, victim_key = (
+                            request, window, key,
+                        )
+        if victim is None:
+            return False
+        victim_window.requests.remove(victim)
+        victim_window.markets.discard(victim.market_id)
+        if not victim_window.requests:
+            self._windows.remove(victim_window)
+        self._resident -= 1
+        self._pending_gauge.set(float(self._resident))
+        victim_state = (
+            self._qos_states.get(victim.qos)
+            if self._qos_states is not None and victim.qos is not None
+            else None
+        )
+        if victim_state is not None:
+            victim_state.shed.inc()
+            victim_state.set_pending(victim_state.pending - 1)
+        if not victim.future.done():
+            victim.future.set_exception(
+                ShedError(
+                    f"request for {victim.market_id!r} shed under "
+                    "overload (variance-aware shed policy)"
+                )
+            )
+        self._count_refused(
+            victim.ctx, "shed", qos_state=victim_state,
+            feed_health=feed_health,
+        )
+        return True
+
+    def _refuse_rejected(
+        self, ctx: TraceContext, qos_state, *, feed_health: bool,
+        retry_after_s: float, pending: int,
+    ) -> None:
+        """Degraded-reject bookkeeping shared by every refusal that the
+        admission CONTROLLER did not itself count: class-budget rejects,
+        failed class sheds, and the nothing-left-to-shed degrade. Counts
+        the refusal (class + service-wide + SLO/trace) and raises
+        :class:`~.serve.admission.Overloaded`."""
+        if qos_state is not None:
+            qos_state.rejected.inc()
+        self._admission.count_degraded_reject()
+        self._count_refused(
+            ctx, "rejected", qos_state=qos_state, feed_health=feed_health,
+        )
+        raise Overloaded(retry_after_s, pending)
 
     def _count_refused(
-        self, ctx: TraceContext, outcome: str, feed_health: bool = True
+        self, ctx: TraceContext, outcome: str, qos_state=None,
+        feed_health: bool = True,
     ) -> None:
         """A request that will never settle: SLO-classify and trace it.
 
@@ -631,39 +923,52 @@ class ConsensusService:
         goodput-within-objective framing) but never enter the latency
         histograms — there is no completion latency to record.
         ``feed_health=False`` marks a BURN-DRIVEN refusal: it still
-        counts against goodput, but the health monitor must not see its
-        own shedding as fresh budget burn (the feedback loop that would
-        pin the verdict at burning forever).
+        counts against goodput, but the health monitors (service-wide
+        AND per-class) must not see their own shedding as fresh budget
+        burn (the feedback loop that would pin the verdict at burning
+        forever). ``qos_state`` classifies the refusal against the
+        request's class too.
         """
         if self._slo is not None:
             self._slo.record(outcome)
             self._update_goodput_gauge()
             if self._health is not None and feed_health:
                 self._health.record(outcome)
+        if qos_state is not None:
+            qos_state.record_outcome(outcome, feed_health=feed_health)
         tracer = active_tracer()
         if tracer.enabled:
-            tracer.request_event(
-                ctx, outcome,
-                args={"market": ctx.market_id, "pending": self._resident},
-            )
+            args = {"market": ctx.market_id, "pending": self._resident}
+            if qos_state is not None:
+                args["class"] = qos_state.cls.name
+            tracer.request_event(ctx, outcome, args=args)
 
     def _update_goodput_gauge(self) -> None:
         goodput = self._slo.goodput_within_slo()
         if goodput is not None:
             self._goodput_gauge.set(goodput)
 
-    def _count_failed(self, n: int) -> None:
-        """*n* requests lost to a dispatch/journal failure (worker
-        thread): they count against goodput like refused traffic — a
-        goodput number that forgot crash-eaten requests would overstate
-        health exactly when it matters."""
-        if self._slo is None:
-            return
-        for _ in range(n):
-            self._slo.record("failed")
-            if self._health is not None:
-                self._health.record("failed")
-        self._update_goodput_gauge()
+    def _count_failed(self, requests) -> None:
+        """Requests lost to a dispatch/journal failure (worker thread):
+        they count against goodput like refused traffic — a goodput
+        number that forgot crash-eaten requests would overstate health
+        exactly when it matters. Classified per request so each QoS
+        class's goodput carries its own share of the damage."""
+        for request in requests:
+            if self._slo is not None:
+                self._slo.record("failed")
+                if self._health is not None:
+                    self._health.record("failed")
+            state = self._class_state_of(request)
+            if state is not None:
+                state.record_outcome("failed")
+        if self._slo is not None:
+            self._update_goodput_gauge()
+
+    def _class_state_of(self, request: _Request):
+        if self._qos_states is None or request.qos is None:
+            return None
+        return self._qos_states.get(request.qos)
 
     # -- flushing (event-loop thread) ----------------------------------------
 
@@ -794,7 +1099,7 @@ class ConsensusService:
                         request.ctx, "failed",
                         args={"batch": batch_index, "abandoned": True},
                     )
-            self._count_failed(len(requests))
+            self._count_failed(requests)
             for request in requests:
                 loop.call_soon_threadsafe(
                     self._resolve, request, None, failure
@@ -843,6 +1148,15 @@ class ConsensusService:
                     }
                     if prop_view is not None:
                         propagated = np.asarray(prop_view)
+                    # Refresh the variance-aware shed ranking with this
+                    # batch's live per-market standard errors (plain
+                    # dict assignment — GIL-atomic; the loop thread
+                    # reads it at shed time).
+                    stderr_col = bands["stderr"]
+                    for i, request in enumerate(requests):
+                        self._band_stderr[request.market_id] = float(
+                            stderr_col[i]
+                        )
                 t_settled = _time.perf_counter()
                 self._driver.checkpoint(batch_index)
                 if self._journal_mode:
@@ -865,7 +1179,7 @@ class ConsensusService:
                     reason=f"dispatch failure at batch {batch_index}: "
                            f"{exc!r}"
                 )
-            self._count_failed(len(requests))
+            self._count_failed(requests)
             for request in requests:
                 loop.call_soon_threadsafe(self._resolve, request, None, exc)
             return
@@ -910,7 +1224,9 @@ class ConsensusService:
             )
             if not self._journal_mode:
                 self._hist_total.observe(t_settled - request.t_submit)
-                self._classify_completion(t_settled - request.t_submit)
+                self._classify_completion(
+                    request, t_settled - request.t_submit
+                )
             loop.call_soon_threadsafe(self._resolve, request, value, None)
         self._observe_durable()
 
@@ -932,21 +1248,30 @@ class ConsensusService:
                         dur_s=t_durable - t_settled,
                         args={"batch": batch_index},
                     )
-                self._classify_completion(t_durable - request.t_submit)
+                self._classify_completion(
+                    request, t_durable - request.t_submit
+                )
 
-    def _classify_completion(self, latency_s: float) -> None:
+    def _classify_completion(self, request: _Request,
+                             latency_s: float) -> None:
         """SLO-classify one COMPLETED request (its strongest signal:
-        durable in journal mode, settled otherwise)."""
-        if self._slo is None:
-            return
-        outcome = self._slo.record_latency(latency_s)
-        (
-            self._slo_met_counter if outcome == "met"
-            else self._slo_violated_counter
-        ).inc()
-        if self._health is not None:
-            self._health.record(outcome)
-        self._update_goodput_gauge()
+        durable in journal mode, settled otherwise) — against the
+        service-wide objective AND the request's own class objective
+        (each QoS class meets or violates its OWN ``slo_s``, which is
+        what makes per-class goodput a tiering verdict rather than a
+        relabeling of the global one)."""
+        if self._slo is not None:
+            outcome = self._slo.record_latency(latency_s)
+            (
+                self._slo_met_counter if outcome == "met"
+                else self._slo_violated_counter
+            ).inc()
+            if self._health is not None:
+                self._health.record(outcome)
+            self._update_goodput_gauge()
+        state = self._class_state_of(request)
+        if state is not None:
+            state.record_outcome(state.slo.classify(latency_s))
 
     @property
     def health(self):
@@ -986,6 +1311,10 @@ class ConsensusService:
             port=port,
             host_id=host_id,
             epoch=epoch,
+            # The per-class QoS block (round 17): scraped live into
+            # /snapshot so `bce-tpu stats --live` and the fleet merge
+            # see class-labeled goodput, not just the global fraction.
+            qos=self.qos_snapshot,
         ).start()
         return self.telemetry
 
@@ -996,9 +1325,65 @@ class ConsensusService:
         ``e2e_serve`` overload act lands in the run ledger."""
         return self._slo.snapshot() if self._slo is not None else None
 
+    # -- multi-tenant QoS (round 17) -----------------------------------------
+
+    @property
+    def qos_classes(self) -> Optional[tuple]:
+        """The declared :class:`~.serve.admission.QosClass` set, in
+        declaration order (``None`` on a single-tenant service)."""
+        if self._qos_states is None:
+            return None
+        return tuple(state.cls for state in self._qos_states.values())
+
+    @property
+    def market_band_stderr(self) -> dict:
+        """The live per-market band standard errors the variance-aware
+        shed policy ranks by (read-only view semantics: mutate through
+        :meth:`seed_band_stderr` or by serving analytics batches).
+
+        Growth contract: one float per distinct market ever settled in
+        analytics mode — always strictly smaller than the per-market
+        reliability state the resident store holds for the same
+        markets, so the map never dominates the service's footprint.
+        Shed-time ranking over it is O(pending) per victim search,
+        bounded by the class's ``max_pending`` budget, not by market
+        cardinality."""
+        return dict(self._band_stderr)
+
+    def seed_band_stderr(self, stderr_by_market: Mapping[str, float]) -> None:
+        """Pre-rank markets for the variance-aware shed policy.
+
+        Analytics-mode dispatches maintain the ranking live; this seeds
+        (or overrides) it explicitly — a recovered service can import
+        the ranking from its analytics tier before the first batch
+        settles, and the fixed-trace shed-determinism tests pin the
+        policy against a known map.
+        """
+        for market, stderr in stderr_by_market.items():
+            self._band_stderr[str(market)] = float(stderr)
+
+    def qos_snapshot(self) -> Optional[dict]:
+        """Per-class QoS accounting as data (``None`` when no classes).
+
+        Class name → ``{slo_s, max_pending, policy, pending, counts,
+        offered, goodput_within_slo, burning}`` in declaration order —
+        the ``/snapshot`` qos block (:meth:`start_telemetry` wires it),
+        the :func:`~.obs.fleet.merge_fleet` per-class merge unit, and
+        the ``e2e_netserve`` leg's ledger extra.
+        """
+        if self._qos_states is None:
+            return None
+        return {
+            name: state.snapshot()
+            for name, state in self._qos_states.items()
+        }
+
     def _resolve(self, request: _Request, value, exc) -> None:
         self._resident -= 1
         self._pending_gauge.set(float(self._resident))
+        state = self._class_state_of(request)
+        if state is not None:
+            state.set_pending(state.pending - 1)
         if request.future.done():
             return
         if exc is not None:
@@ -1081,17 +1466,17 @@ class ConsensusService:
                 # but goodput must not credit a completion a crash may
                 # have eaten: classify against the objective as failed.
                 tracer = active_tracer()
-                n = 0
+                unconfirmed = []
                 for batch_index, entries in self._await_durable:
-                    n += len(entries)
-                    if tracer.enabled:
-                        for request, _t_settled in entries:
+                    for request, _t_settled in entries:
+                        unconfirmed.append(request)
+                        if tracer.enabled:
                             tracer.request_event(
                                 request.ctx, "durable_unconfirmed",
                                 args={"batch": batch_index},
                             )
                 self._await_durable.clear()
-                self._count_failed(n)
+                self._count_failed(unconfirmed)
 
     async def __aenter__(self) -> "ConsensusService":
         return self

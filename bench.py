@@ -1945,6 +1945,311 @@ def _q_ms(quantile_s):
     return None if quantile_s is None else round(quantile_s * 1e3, 3)
 
 
+def bench_e2e_netserve(markets=600, source_universe=150, requests=1600,
+                       concurrency=12, acceptors=4, max_batch=64,
+                       max_delay_ms=2.0, steps=3, premium_slo_ms=120.0,
+                       besteffort_slo_ms=120.0, besteffort_budget=24,
+                       trials=2):
+    """The round-17 front-door leg: mixed-class overload over the REAL
+    socket transport (net/), where premium-class goodput HOLDS while the
+    best-effort class sheds — the Ironwood "goodput under objective"
+    framing applied at the request tier, now with tenants.
+
+    One request = one market's update through
+    :class:`~.net.client.ConsensusClient` (blocking, one TCP connection
+    per load thread) → :class:`~.net.server.ConsensusServer` (N asyncio
+    acceptors) → the ONE coalescing :class:`ConsensusService` with two
+    :class:`~.serve.admission.QosClass` tenants: ``premium``
+    (*premium_slo_ms*, a budget sized to the offered load, reject
+    policy) and ``besteffort`` (same objective, a deliberately small
+    *besteffort_budget*, ``shed_oldest`` → the variance-aware policy).
+    Two acts, min-of-N alternating (BASELINE.md protocol):
+
+    * ``closed_loop`` — *concurrency* premium clients, each awaiting its
+      result before the next submit: the sustainable wire-path service
+      rate and the premium class's BASELINE goodput band.
+    * ``overload_mixed`` — the same premium closed-loop load running
+      WHILE a best-effort client offers its whole request share as one
+      pipelined burst into the small best-effort budget: overload by
+      construction. Acceptance: premium ``goodput_within_slo`` holds at
+      its closed-loop baseline (``premium_holds`` compares the acts)
+      while best-effort sheds (``besteffort_sheds``: shed+rejected > 0).
+
+    Per-act per-class accounting (``service.qos_snapshot()``) rides to
+    the run ledger as ``extras.qos`` — the ``bce-tpu stats`` per-class
+    goodput/slo columns, merged across repeats and diffed by
+    ``--against`` — next to the usual ``latency_hist``/``slo`` extras.
+    """
+    import asyncio
+    import gc
+    import tempfile as _tf
+
+    from bayesian_consensus_engine_tpu import obs
+    from bayesian_consensus_engine_tpu.net import (
+        ConsensusClient,
+        ConsensusServer,
+    )
+    from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+    from bayesian_consensus_engine_tpu.serve import (
+        ConsensusService,
+        Overloaded,
+        QosClass,
+        ShedError,
+    )
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+    import numpy as np
+
+    rng = np.random.default_rng(53)
+    source_lists = [
+        [f"src-{v}" for v in rng.integers(0, source_universe, n)]
+        for n in rng.integers(1, 4, markets)
+    ]
+
+    def request_stream(n, seed, prefix="m"):
+        req_rng = np.random.default_rng(seed)
+        market_ids = req_rng.integers(0, markets, n)
+        for i in range(n):
+            market = int(market_ids[i])
+            sources = source_lists[market]
+            probs = req_rng.random(len(sources))
+            yield (
+                f"{prefix}-{market}",
+                list(zip(sources, probs)),
+                bool(req_rng.random() < 0.5),
+            )
+
+    mesh = make_mesh()
+
+    # Warm the compiled settle shapes off the clock (same discipline as
+    # e2e_serve): the bucketed K ladder compiles a handful of programs.
+    warm_store = TensorReliabilityStore()
+
+    async def _warm():
+        service = ConsensusService(
+            warm_store, steps=steps, now=21_900.0, mesh=mesh,
+            max_batch=max_batch, max_delay_s=max_delay_ms / 1e3,
+        )
+        async with service:
+            for req in request_stream(min(requests, 4 * max_batch), 11):
+                service.submit(*req)
+            await service.drain()
+
+    asyncio.run(_warm())
+    warm_store.sync()
+
+    premium_requests = requests // 2
+    besteffort_requests = requests - premium_requests
+
+    def run(name):
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        gc.freeze()
+        try:
+            store = TensorReliabilityStore()
+            with _tf.TemporaryDirectory() as tmp:
+                qos = [
+                    QosClass(
+                        "premium", premium_slo_ms / 1e3,
+                        max(concurrency * 4, 64),
+                    ),
+                    QosClass(
+                        "besteffort", besteffort_slo_ms / 1e3,
+                        besteffort_budget, policy="shed_oldest",
+                    ),
+                ]
+                service = ConsensusService(
+                    store, steps=steps, now=21_900.0, mesh=mesh,
+                    journal=os.path.join(tmp, "netserve.jrnl"),
+                    checkpoint_every=4,
+                    max_batch=max_batch, max_delay_s=max_delay_ms / 1e3,
+                    qos=qos,
+                    slo=obs.LatencyObjective(premium_slo_ms / 1e3),
+                )
+                counts = {"served": 0, "refused": 0}
+
+                def premium_worker(worker_requests, port):
+                    served = refused = 0
+                    with ConsensusClient(port=port) as client:
+                        for req in worker_requests:
+                            try:
+                                client.submit(*req, qos_class="premium")
+                                served += 1
+                            except (Overloaded, ShedError):
+                                refused += 1
+                    return served, refused
+
+                def besteffort_burst(burst, port):
+                    # The whole best-effort share pipelined back to back
+                    # on one connection: the coalescer sees a burst the
+                    # small budget cannot hold — shedding is guaranteed
+                    # by construction, not by rate calibration.
+                    with ConsensusClient(port=port) as client:
+                        results = client.submit_pipelined(
+                            burst, qos_class="besteffort"
+                        )
+                    served = sum(
+                        1 for r in results
+                        if not isinstance(r, BaseException)
+                    )
+                    return served, len(results) - served
+
+                async def act():
+                    import concurrent.futures as _cf
+
+                    server = await ConsensusServer(
+                        service, acceptors=acceptors
+                    ).start()
+                    loop = asyncio.get_running_loop()
+                    port = server.port
+                    # One thread per load client: the default executor
+                    # caps at cpu+4 workers, which on a small host would
+                    # QUEUE the best-effort burst behind the premium
+                    # clients and quietly remove the overlap the
+                    # overload act exists to create.
+                    pool = _cf.ThreadPoolExecutor(
+                        max_workers=concurrency + 1,
+                        thread_name_prefix="bce-netserve-load",
+                    )
+                    try:
+                        premium = list(
+                            request_stream(premium_requests, 19)
+                        )
+                        shards = [
+                            premium[i::concurrency]
+                            for i in range(concurrency)
+                        ]
+                        jobs = []
+                        if name == "overload_mixed":
+                            # The burst launches FIRST so the small
+                            # best-effort budget is already overflowing
+                            # while premium traffic runs.
+                            burst = list(request_stream(
+                                besteffort_requests, 23, prefix="be",
+                            ))
+                            jobs.append(loop.run_in_executor(
+                                pool, besteffort_burst, burst, port
+                            ))
+                        jobs.extend(
+                            loop.run_in_executor(
+                                pool, premium_worker, shard, port
+                            )
+                            for shard in shards if shard
+                        )
+                        for served, refused in await asyncio.gather(*jobs):
+                            counts["served"] += served
+                            counts["refused"] += refused
+                        await service.drain()
+                    finally:
+                        pool.shutdown(wait=True)
+                        await server.close()
+                        await service.close()
+
+                start = time.perf_counter()
+                asyncio.run(act())
+                wall = time.perf_counter() - start
+                store.sync()
+
+            qos_snap = service.qos_snapshot()
+            slo_snap = service.goodput()
+            total = registry.histogram("serve.latency_total_s")
+            snapshot = total.snapshot()
+            summary = total.summary((0.5, 0.99))
+            counters = registry.export()["counters"]
+
+            def class_out(cls):
+                record = qos_snap[cls]
+                return {
+                    "offered": record["offered"],
+                    "counts": record["counts"],
+                    "goodput_within_slo": (
+                        None if record["goodput_within_slo"] is None
+                        else round(record["goodput_within_slo"], 4)
+                    ),
+                }
+
+            out = {
+                "wall_s": round(wall, 3),
+                "served": counts["served"],
+                "refused": counts["refused"],
+                "throughput_rps": round(
+                    counts["served"] / wall if wall > 0 else 0.0, 1
+                ),
+                "batches": counters.get("serve.batches", 0),
+                "connections": counters.get("net.connections", 0),
+                "wire_errors": counters.get("net.wire_errors", 0),
+                "p50_ms": _q_ms(summary["p50"]),
+                "p99_ms": _q_ms(summary["p99"]),
+                "premium": class_out("premium"),
+                "besteffort": class_out("besteffort"),
+                "ingest_wait_s": round(service.ingest_wait_s, 4),
+                "intern_s": round(service.intern_wait_s, 5),
+            }
+            # Per-class accounting to the ledger: the stats table's
+            # qos follow-up line merges these across repeats.
+            _ledger_record(
+                f"e2e_netserve.{name}.latency",
+                value=summary["p99"], unit="s",
+                extras={
+                    "latency_hist": {
+                        "bounds": snapshot["bounds"],
+                        "counts": snapshot["counts"],
+                    },
+                    "slo": {
+                        "objective_s": premium_slo_ms / 1e3,
+                        "counts": slo_snap["counts"],
+                    },
+                    "qos": {
+                        cls: {
+                            "slo_s": qos_snap[cls]["slo_s"],
+                            "counts": qos_snap[cls]["counts"],
+                        }
+                        for cls in qos_snap
+                    },
+                },
+            )
+            return out
+        finally:
+            gc.unfreeze()
+            obs.set_metrics_registry(previous)
+
+    best = _min_of_trials(
+        "e2e_netserve", ["closed_loop", "overload_mixed"], run, trials,
+    )
+    closed, overload = best["closed_loop"], best["overload_mixed"]
+    closed_premium = closed["premium"]["goodput_within_slo"] or 0.0
+    overload_premium = overload["premium"]["goodput_within_slo"] or 0.0
+    besteffort_counts = overload["besteffort"]["counts"]
+    besteffort_refused = (
+        besteffort_counts.get("shed", 0)
+        + besteffort_counts.get("rejected", 0)
+    )
+    return {
+        "workload": (
+            f"{requests} requests x {markets} markets over the net/ "
+            f"socket transport ({concurrency} premium closed-loop "
+            f"clients, best-effort burst of {besteffort_requests} into a "
+            f"{besteffort_budget}-deep shed_oldest budget), "
+            f"max_batch={max_batch}, premium SLO {premium_slo_ms}ms, "
+            f"min of {trials} alternating trials"
+        ),
+        "closed_loop": closed,
+        "overload_mixed": overload,
+        # The acceptance pair: the premium tenant's goodput under mixed
+        # overload holds at (a small tolerance under) its closed-loop
+        # baseline, while the best-effort tenant absorbed the overload
+        # as explicit policy.
+        "premium_goodput_closed": closed_premium,
+        "premium_goodput_overload": overload_premium,
+        "premium_holds": bool(
+            overload_premium >= closed_premium - 0.05
+        ),
+        "besteffort_refused": besteffort_refused,
+        "besteffort_sheds": bool(besteffort_refused > 0),
+    }
+
+
 def bench_obs_overhead(markets=60_000, batches=3, mean_slots=4, steps=10,
                        trials=3):
     """The obs contract's A/B/C: the streamed service with observability
@@ -3708,6 +4013,13 @@ LEGS = {
         dict(markets=200, source_universe=60, requests=160, concurrency=8,
              max_batch=32, steps=2, trials=1), 2000,
     ),
+    "e2e_netserve": (
+        bench_e2e_netserve, {},
+        dict(markets=120, source_universe=40, requests=240, concurrency=4,
+             max_batch=16, steps=2, besteffort_budget=8,
+             premium_slo_ms=1000.0, besteffort_slo_ms=1000.0, trials=1),
+        2000,
+    ),
     "obs_overhead": (
         bench_obs_overhead, {},
         dict(markets=2000, batches=2, steps=2, trials=6), 900,
@@ -3780,6 +4092,7 @@ DEVICE_LEG_ORDER = [
     "e2e_stream_delta",
     "e2e_stream_resident",
     "e2e_serve",
+    "e2e_netserve",
     "obs_overhead",
     "tiebreak_10k_agents",
     "e2e_ring_memory",
@@ -4099,6 +4412,7 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         "e2e_stream_delta": _show(results, "e2e_stream_delta"),
         "e2e_stream_resident": _show(results, "e2e_stream_resident"),
         "e2e_serve": _show(results, "e2e_serve"),
+        "e2e_netserve": _show(results, "e2e_netserve"),
         "dryrun_multichip": _show(results, "dryrun_multichip"),
         "obs_overhead": _show(results, "obs_overhead"),
         # Fallback-only leg: absent (not "failed") on healthy runs.

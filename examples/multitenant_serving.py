@@ -1,0 +1,266 @@
+"""Multi-tenant serving: premium + best-effort classes over the real
+socket front door.
+
+The round-17 request tier end to end, in three acts:
+
+* **Act 1 — tiered overload over the wire**: a ``ConsensusService``
+  declares two :class:`QosClass` tenants — *premium* (a budget sized to
+  its load, reject policy) and *besteffort* (a deliberately tiny budget,
+  ``shed_oldest``) — behind a :class:`ConsensusServer` on a real TCP
+  socket. A best-effort burst overflows its budget while premium
+  closed-loop traffic runs: premium ``goodput_within_slo`` holds, the
+  best-effort class absorbs the overload as explicit policy (sheds +
+  rejections, each an explicit error frame on the wire).
+* **Act 2 — variance-aware shed ranking**: with per-market band
+  standard errors seeded from the analytics tier's vocabulary, the shed
+  policy drops WIDE-band markets first — the pending update the
+  posterior will miss least — ties oldest-first; the shed order is a
+  pure function of (class, stderr ranking, arrival order).
+* **Act 3 — the byte-exactness coda**: the same admitted-request trace
+  submitted in-process and served over the wire yields identical
+  journal epoch payloads (wall_ts masked) and identical SQLite bytes —
+  the transport adds reach, never semantics (pinned for flat AND
+  sharded sessions by tests/test_net.py).
+
+Run from the repo root:  python examples/multitenant_serving.py
+"""
+
+import asyncio
+import pathlib
+import struct
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu.net import ConsensusClient, ConsensusServer
+from bayesian_consensus_engine_tpu.serve import (
+    ConsensusService,
+    QosClass,
+    ShedError,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 21_900.0
+MARKETS = 16
+
+rng = np.random.default_rng(5)
+SOURCES = [
+    [f"src-{v}" for v in rng.integers(0, 30, n)]
+    for n in rng.integers(1, 4, MARKETS)
+]
+
+
+def trace(n, seed, prefix="m"):
+    req_rng = np.random.default_rng(seed)
+    ids = req_rng.integers(0, MARKETS, n)
+    out = []
+    for i in range(n):
+        market = int(ids[i])
+        probs = req_rng.random(len(SOURCES[market]))
+        out.append((
+            f"{prefix}-{market}",
+            list(zip(SOURCES[market], probs)),
+            bool(req_rng.random() < 0.5),
+        ))
+    return out
+
+
+def act_1_tiered_overload():
+    print("=== Act 1: premium holds while best-effort sheds (real socket)")
+    store = TensorReliabilityStore()
+
+    async def main():
+        service = ConsensusService(
+            store, steps=1, now=NOW, max_batch=8, max_delay_s=0.002,
+            qos=[
+                QosClass("premium", slo_s=5.0, max_pending=128),
+                QosClass("besteffort", slo_s=5.0, max_pending=4,
+                         policy="shed_oldest"),
+            ],
+        )
+        server = await ConsensusServer(service).start()
+        loop = asyncio.get_running_loop()
+
+        def burst_client():
+            # The whole best-effort share pipelined on one connection:
+            # a burst the 4-deep budget cannot hold.
+            with ConsensusClient(port=server.port) as client:
+                return client.submit_pipelined(
+                    trace(48, seed=21, prefix="be"),
+                    qos_class="besteffort",
+                )
+
+        def premium_client():
+            with ConsensusClient(port=server.port) as client:
+                served = 0
+                for req in trace(32, seed=13):
+                    client.submit(*req, qos_class="premium")
+                    served += 1
+                return served
+
+        burst_future = loop.run_in_executor(None, burst_client)
+        premium_served = await loop.run_in_executor(None, premium_client)
+        burst_results = await burst_future
+        await service.drain()
+        await server.close()
+        await service.close()
+        return service, premium_served, burst_results
+
+    service, premium_served, burst_results = asyncio.run(main())
+    refused = sum(1 for r in burst_results if isinstance(r, BaseException))
+    snap = service.qos_snapshot()
+    for name in ("premium", "besteffort"):
+        record = snap[name]
+        goodput = record["goodput_within_slo"]
+        print(
+            f"  {name:<11} offered={record['offered']:>3} "
+            f"met={record['counts']['met']:>3} "
+            f"shed={record['counts']['shed']:>3} "
+            f"rejected={record['counts']['rejected']:>3} "
+            f"goodput={goodput:.2f}" if goodput is not None else name
+        )
+    assert premium_served == 32, "premium class must never be refused here"
+    assert refused > 0, "the burst must overflow the best-effort budget"
+    assert snap["premium"]["counts"]["rejected"] == 0
+    print(f"  best-effort refusals (explicit error frames): {refused}")
+
+
+def act_2_variance_aware_shedding():
+    print("=== Act 2: wide-band markets shed first (deterministic order)")
+    store = TensorReliabilityStore()
+    victims = []
+
+    async def main():
+        service = ConsensusService(
+            store, steps=1, now=NOW, max_batch=64, max_delay_s=None,
+            qos=[QosClass("be", slo_s=3600.0, max_pending=3,
+                          policy="shed_oldest")],
+        )
+        # The analytics tier's per-market stderr, seeded explicitly
+        # (a live analytics= service maintains this map per batch).
+        service.seed_band_stderr(
+            {"contested": 0.38, "leaning": 0.17, "settled": 0.03}
+        )
+        pending = {
+            market: service.submit(market, [("s", 0.6)], True)
+            for market in ("settled", "contested", "leaning")
+        }
+        for i in range(3):
+            pending[f"fresh-{i}"] = service.submit(
+                f"fresh-{i}", [("s", 0.6)], True
+            )
+            for market, future in list(pending.items()):
+                if future.done() and isinstance(
+                    future.exception(), ShedError
+                ):
+                    victims.append(market)
+                    del pending[market]
+        await service.drain()
+        await service.close()
+
+    asyncio.run(main())
+    print(f"  shed order under overflow: {victims}")
+    assert victims == ["contested", "leaning", "settled"], victims
+    print("  widest band first, narrowest last — arrival order only ties")
+
+
+def _journal_epochs_sans_clock(path):
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = hdr.unpack_from(blob, off)
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4
+    return epochs
+
+
+def act_3_byte_exactness(tmp):
+    print("=== Act 3: wire-served state == in-process state (the coda)")
+    tmp = pathlib.Path(tmp)
+    # Rounds of 8 DISTINCT markets: every window seals by size, so the
+    # batch sequence is a pure function of the submission order on both
+    # transports (a duplicate market would roll to the next window and
+    # wait on the flush timer — fine live, but this coda wants size-
+    # sealed windows only).
+    req_rng = np.random.default_rng(31)
+    requests = []
+    for rnd in range(5):
+        for m in range(8):
+            probs = req_rng.random(len(SOURCES[m]))
+            requests.append((
+                f"m-{m}",
+                list(zip(SOURCES[m], probs)),
+                bool(req_rng.random() < 0.5),
+            ))
+
+    def service_for(store, name):
+        return ConsensusService(
+            store, steps=2, now=NOW, checkpoint_every=2,
+            journal=tmp / f"{name}.jrnl", db_path=tmp / f"{name}.db",
+            max_batch=8, max_delay_s=None,
+        )
+
+    async def in_process():
+        store = TensorReliabilityStore()
+        service = service_for(store, "local")
+        async with service:
+            futures = [service.submit(*req) for req in requests]
+            await service.drain()
+        store.sync()
+        return [f.result().consensus for f in futures]
+
+    async def over_wire():
+        store = TensorReliabilityStore()
+        service = service_for(store, "wire")
+        server = await ConsensusServer(service).start()
+        loop = asyncio.get_running_loop()
+
+        def drive():
+            with ConsensusClient(port=server.port) as client:
+                return client.submit_pipelined(
+                    requests, return_exceptions=False
+                )
+
+        results = await loop.run_in_executor(None, drive)
+        await service.drain()
+        await server.close()
+        await service.close()
+        store.sync()
+        return [r.consensus for r in results]
+
+    local = asyncio.run(in_process())
+    wire = asyncio.run(over_wire())
+    assert local == wire, "per-request consensus differs across transports"
+    local_epochs = _journal_epochs_sans_clock(tmp / "local.jrnl")
+    wire_epochs = _journal_epochs_sans_clock(tmp / "wire.jrnl")
+    assert local_epochs == wire_epochs, "journal epochs differ"
+    local_db = (tmp / "local.db").read_bytes()
+    wire_db = (tmp / "wire.db").read_bytes()
+    assert local_db == wire_db, "SQLite interchange bytes differ"
+    print(
+        f"  {len(requests)} requests, {len(local_epochs)} journal epochs: "
+        "results, epoch payloads (sans wall_ts), and SQLite bytes all "
+        "IDENTICAL across transports"
+    )
+
+
+if __name__ == "__main__":
+    act_1_tiered_overload()
+    act_2_variance_aware_shedding()
+    with tempfile.TemporaryDirectory() as tmp:
+        act_3_byte_exactness(tmp)
+    print("done.")

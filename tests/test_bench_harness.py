@@ -1025,6 +1025,69 @@ class TestStreamLegBands:
             assert "trials" in inspect.signature(fn).parameters, leg
 
 
+class TestNetServeLeg:
+    """The round-17 front-door leg (``e2e_netserve``) at --fast shapes:
+    mixed-class overload over the REAL socket transport. The wire byte
+    parity, robustness, and shed determinism live in tests/test_net.py;
+    this pins the LEG's contract (JSON shape, per-class goodput in the
+    leg JSON and the ledger, the premium-holds/best-effort-sheds
+    acceptance pair)."""
+
+    def test_fast_leg_reports_per_class_goodput(self, tmp_path):
+        from bayesian_consensus_engine_tpu.obs.ledger import (
+            RunLedger,
+            read_ledger,
+            render,
+            summarize,
+        )
+
+        ledger_path = tmp_path / "netserve.jsonl"
+        old = bench._LEDGER
+        bench._LEDGER = RunLedger(ledger_path, backend="cpu")
+        try:
+            result = bench.run_leg_inprocess("e2e_netserve", fast=True)
+        finally:
+            bench._LEDGER.close()
+            bench._LEDGER = old
+        for act in ("closed_loop", "overload_mixed"):
+            side = result[act]
+            for key in (
+                "wall_s", "wall_s_band", "repeats", "served", "refused",
+                "throughput_rps", "batches", "connections",
+                "wire_errors", "p50_ms", "p99_ms", "premium",
+                "besteffort", "ingest_wait_s", "intern_s",
+            ):
+                assert key in side, (act, key)
+            for cls in ("premium", "besteffort"):
+                assert set(side[cls]) == {
+                    "offered", "counts", "goodput_within_slo",
+                }
+            assert side["wire_errors"] == 0
+            # The load actually travelled the socket transport.
+            assert side["connections"] >= 1
+        # The acceptance pair: premium holds at its closed-loop band
+        # while best-effort absorbed the overload as explicit policy.
+        assert result["premium_holds"] is True
+        assert result["besteffort_sheds"] is True
+        assert result["besteffort_refused"] > 0
+        overload = result["overload_mixed"]
+        be_counts = overload["besteffort"]["counts"]
+        assert be_counts["shed"] + be_counts["rejected"] > 0
+        json.dumps(result)
+        # Per-class accounting reached the ledger and folds into the
+        # stats table's qos follow-up line.
+        records = read_ledger(ledger_path)
+        bands = summarize(records)
+        overload_leg = "e2e_netserve.overload_mixed.latency"
+        assert overload_leg in bands
+        band = bands[overload_leg]
+        assert sorted(band["qos"]) == ["besteffort", "premium"]
+        assert band["qos"]["besteffort"]["slo_violations"] > 0
+        assert band["qos"]["premium"]["goodput_within_slo"] is not None
+        table = render(records)
+        assert "premium: goodput" in table
+
+
 class TestKillSoakLeg:
     """The round-13 failure-as-steady-state leg (``e2e_kill_soak``) at
     --fast shapes: a REAL worker SIGKILL mid-stream over the shared-

@@ -5,7 +5,11 @@ examples/*.py); only the fast scalar examples run here — the device-loop
 examples (settlement_cycle, compact_settlement, distributed_settlement,
 settlement_service, streaming_settlement, batched_consensus,
 fault_tolerant_service, columnar_ingest, coresident_tiebreak,
-uncertainty_bands, degraded_mesh_recovery, onepass_settlement — the
+uncertainty_bands, degraded_mesh_recovery, onepass_settlement,
+multitenant_serving — the round-17 multi-tenant front-door example's
+wire byte parity, robustness matrix, per-class QoS isolation, and
+variance-aware shed determinism live in tests/test_net.py, with the
+e2e leg smoked through tests/test_bench_harness.py::TestNetServeLeg; the
 ingest example's packer parity lives in tests/test_fastpack.py and
 tests/test_serve.py; the co-resident tie-break's chunk parity and fused
 session in tests/test_ring.py; the uncertainty-band/graph-sweep

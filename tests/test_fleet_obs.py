@@ -406,6 +406,151 @@ class TestFleetMerge:
         assert back == snap
 
 
+def _qos_snap(host, epoch, qos):
+    return obs_fleet.HostSnapshot(
+        host_id=host, epoch=epoch,
+        metrics={"counters": {}, "gauges": {}, "histograms": {}},
+        qos=qos,
+    )
+
+
+def _qos_block(slo_s=0.05, counts=None, pending=0, burning=False):
+    return {
+        "slo_s": slo_s,
+        "counts": dict(counts or {}),
+        "pending": pending,
+        "burning": burning,
+    }
+
+
+class TestQosFleetMerge:
+    """Round 17: class-labeled QoS series fold under the same sorted-
+    deterministic discipline — conflicting class vocabularies refuse
+    like histogram layout mismatches."""
+
+    def test_counts_sum_goodput_recomputed(self):
+        view = obs_fleet.merge_fleet([
+            _qos_snap(0, 1, {
+                "premium": _qos_block(0.05, {"met": 8, "violated": 2}),
+            }),
+            _qos_snap(1, 1, {
+                "premium": _qos_block(
+                    0.05, {"met": 6, "shed": 4}, pending=3, burning=True,
+                ),
+            }),
+        ])
+        premium = view["qos"]["premium"]
+        assert premium["counts"] == {"met": 14, "shed": 4, "violated": 2}
+        assert premium["offered"] == 20
+        assert premium["goodput_within_slo"] == 0.7
+        # Pending stays a per-host series; burning names hosts, never
+        # averages.
+        assert premium["pending"] == {"0": 0, "1": 3}
+        assert premium["hosts_burning"] == [1]
+
+    def test_fold_order_independent_bytes(self):
+        snaps = [
+            _qos_snap(2, 1, {"a": _qos_block(0.1, {"met": 1}),
+                             "b": _qos_block(1.0, {"shed": 2})}),
+            _qos_snap(0, 1, {"a": _qos_block(0.1, {"met": 4}),
+                             "b": _qos_block(1.0, {"met": 1})}),
+        ]
+        views = [
+            obs_fleet.merge_fleet(order)
+            for order in (snaps, list(reversed(snaps)))
+        ]
+        assert len({obs_fleet.fleet_to_json(v) for v in views}) == 1
+        assert len({
+            obs_fleet.render_fleet_prometheus(v) for v in views
+        }) == 1
+
+    def test_class_vocabulary_mismatch_refuses(self):
+        with pytest.raises(ValueError, match="vocabularies differ"):
+            obs_fleet.merge_fleet([
+                _qos_snap(0, 1, {"premium": _qos_block()}),
+                _qos_snap(1, 1, {"gold": _qos_block()}),
+            ])
+
+    def test_slo_disagreement_is_a_vocabulary_mismatch(self):
+        with pytest.raises(ValueError, match="vocabularies differ"):
+            obs_fleet.merge_fleet([
+                _qos_snap(0, 1, {"premium": _qos_block(slo_s=0.05)}),
+                _qos_snap(1, 1, {"premium": _qos_block(slo_s=5.0)}),
+            ])
+
+    def test_hosts_without_qos_contribute_nothing(self):
+        view = obs_fleet.merge_fleet([
+            _qos_snap(0, 1, {"premium": _qos_block(0.05, {"met": 3})}),
+            _snap(1, 1, {"serve.requests": 5}),
+        ])
+        assert view["qos"]["premium"]["counts"] == {"met": 3}
+        no_qos = obs_fleet.merge_fleet([_snap(0, 1), _snap(1, 1)])
+        assert "qos" not in no_qos
+
+    def test_same_epoch_qos_conflict_refuses(self):
+        a = _qos_snap(0, 1, {"premium": _qos_block(0.05, {"met": 3})})
+        b = _qos_snap(0, 1, {"premium": _qos_block(0.05, {"met": 4})})
+        with pytest.raises(ValueError, match="conflicting"):
+            obs_fleet.merge_fleet([a, b])
+
+    def test_wire_roundtrip_preserves_qos(self):
+        snap = _qos_snap(3, 2, {"premium": _qos_block(0.05, {"met": 1})})
+        back = obs_fleet.snapshot_from_json(
+            obs_fleet.snapshot_to_json(snap)
+        )
+        assert back == snap
+
+    def test_rendered_class_series(self):
+        view = obs_fleet.merge_fleet([
+            _qos_snap(0, 1, {
+                "premium": _qos_block(0.05, {"met": 3, "violated": 1}),
+            }),
+        ])
+        text = obs_fleet.render_fleet_prometheus(view)
+        assert 'bce_qos_offered{class="premium"} 4' in text
+        assert 'bce_qos_goodput_within_slo{class="premium"} 0.75' in text
+
+    def test_service_snapshot_carries_qos_block(self, tmp_path):
+        """End to end: a QoS service's exporter serves the per-class
+        block on /snapshot, and the fleet lift picks it up."""
+        from bayesian_consensus_engine_tpu.serve import QosClass
+
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            store = TensorReliabilityStore()
+
+            async def main():
+                service = ConsensusService(
+                    store, steps=1, now=21_900.0, max_batch=8,
+                    max_delay_s=None,
+                    qos=[QosClass("premium", 3600.0, 64),
+                         QosClass("besteffort", 3600.0, 64)],
+                )
+                telemetry = service.start_telemetry(port=0)
+                future = service.submit(
+                    "m-1", [("s-1", 0.7)], True, qos_class="premium"
+                )
+                await service.drain()
+                await future
+                status, payload = obs_export.scrape_endpoint(
+                    telemetry.url + "/snapshot"
+                )
+                await service.close()
+                return status, payload
+
+            status, payload = asyncio.run(main())
+            assert status == 200
+            assert sorted(payload["qos"]) == ["besteffort", "premium"]
+            assert payload["qos"]["premium"]["counts"]["met"] == 1
+            lifted = obs_fleet.snapshot_from_wire(payload)
+            assert lifted.qos["premium"]["slo_s"] == 3600.0
+            view = obs_fleet.merge_fleet([lifted])
+            assert view["qos"]["premium"]["offered"] == 1
+        finally:
+            obs.set_metrics_registry(previous)
+
+
 class TestExporterByteParity:
     """The acceptance bar: settlement bytes are identical with the
     exporter running (and being scraped, hard) vs absent — write-only

@@ -167,6 +167,26 @@ _CASES = [
         f"from {PKG}.state.journal import replay_journal\n",
     ),
     (
+        # Round 17: net (the socket front door) shares the serve tier —
+        # importing the CLI above it is an upward import; submitting
+        # into serve's coalescer and raising serve's exceptions is the
+        # designed direction.
+        "LY301",
+        f"{PKG}/net/case.py",
+        f"from {PKG}.cli import build_parser\n",
+        f"from {PKG}.serve.coalesce import ConsensusService\n"
+        f"from {PKG}.serve.admission import Overloaded\n",
+    ),
+    (
+        # ...and the inverse: an engine tier importing net would give a
+        # kernel module a socket — the numeric rule flags it (net sits
+        # at the serve tier, above every engine layer).
+        "LY301",
+        f"{PKG}/state/case.py",
+        f"from {PKG}.net.wire import encode_frame\n",
+        f"from {PKG}.core.batch import topology_fingerprint\n",
+    ),
+    (
         "LY302",
         f"{PKG}/core/case.py",
         "import jax.numpy as jnp\n\nSENTINEL = jnp.int32(0)\n",
@@ -391,6 +411,9 @@ class TestLayeringResolution:
                 # Round 16: cluster recovery records recovery-scope
                 # trace spans (the crash-postmortem ring) — allowed.
                 f"{PKG}/cluster/recover.py",
+                # Round 17: the socket front door counts connections/
+                # frames/wire errors — allowed (write surface only).
+                f"{PKG}/net/server.py",
             ):
                 assert _codes(src, rel, select=["LY303"]) == [], (src, rel)
 
@@ -415,6 +438,10 @@ class TestLayeringResolution:
                 f"{PKG}/analytics/bands.py",
                 f"{PKG}/cluster/recover.py",
                 f"{PKG}/ops/case.py",
+                # Round 17: net may WRITE metrics but is not a read-
+                # surface importer — the server serves requests; the
+                # service's exporter serves metrics.
+                f"{PKG}/net/server.py",
             ):
                 for bad in (src, lazy):
                     assert "LY303" in _codes(

@@ -1,0 +1,872 @@
+"""net/ + multi-tenant QoS: the round-17 network front door.
+
+The non-negotiable contracts, in four parts:
+
+* **Byte parity over the wire** — the same admitted-request trace served
+  through :class:`~.net.server.ConsensusServer` over a real socket and
+  submitted in-process through ``ConsensusService.submit`` yields
+  identical results, journal epoch payloads (wall_ts masked), and
+  SQLite bytes — flat AND sharded-resident. Structural (the server
+  submits into the SAME coalescer); these tests keep it structural.
+* **Wire robustness** — torn/truncated frames, partial writes from a
+  client dying mid-frame, oversized-frame refusal, and version-mismatch
+  error frames each kill ONLY the offending connection; the coalescer
+  keeps serving and the journal bytes are untouched.
+* **Deterministic variance-aware shedding** — the shed victim sequence
+  is a pure function of (class, per-market stderr ranking, arrival
+  order), pinned by a fixed trace; with no stderr known the policy IS
+  the round-8 shed-oldest.
+* **Per-class QoS** — each class runs its own budget, SLO accounting,
+  and burn-rate monitor: one class refusing (budget or burn) never
+  refuses another's traffic.
+"""
+
+import asyncio
+import socket
+import struct
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from bayesian_consensus_engine_tpu.net import (
+    ConsensusClient,
+    ConsensusServer,
+)
+from bayesian_consensus_engine_tpu.net import wire
+from bayesian_consensus_engine_tpu.obs.health import BurnWindow
+from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+from bayesian_consensus_engine_tpu.serve import (
+    ConsensusService,
+    Overloaded,
+    QosClass,
+    ShedError,
+    shed_rank_key,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 21_900.0
+
+
+def journal_epochs_sans_clock(path):
+    """Decoded epoch frames with the wall-clock field masked (same
+    helper as tests/test_serve.py)."""
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = hdr.unpack_from(blob, off)
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4  # + crc32
+    return epochs
+
+
+def mixed_trace(width=8):
+    """Hits, drift, and growth as one submission-ordered request trace
+    (tests/test_serve.py's shape: every round submits exactly *width*
+    distinct markets so ``max_batch=width`` seals one window per round)."""
+    trace = []
+    for rnd in range(2):
+        for m in range(width):
+            trace.append((
+                f"m-{m}",
+                [(f"s-{m}", 0.55 + 0.01 * rnd), (f"s-{(m + 1) % 5}", 0.40)],
+                (m + rnd) % 2 == 0,
+            ))
+    for rnd in range(2):
+        for m in range(width):
+            trace.append((
+                f"m-{m}",
+                [(f"s-{m}", 0.35 + 0.01 * rnd), ("s-drift", 0.70)],
+                (m + rnd) % 3 == 0,
+            ))
+    for m in range(2 * width):
+        trace.append((
+            f"fresh-{m}", [(f"s-{m % 5}", 0.62), (f"g-{m}", 0.48)],
+            m % 2 == 1,
+        ))
+    return trace
+
+
+def _service(store, tmp_path, name, mesh=None, width=8, **kwargs):
+    kwargs.setdefault("steps", 2)
+    kwargs.setdefault("now", NOW)
+    kwargs.setdefault("checkpoint_every", 2)
+    return ConsensusService(
+        store,
+        mesh=mesh,
+        journal=tmp_path / f"{name}.jrnl",
+        db_path=tmp_path / f"{name}.db",
+        max_batch=width,
+        max_delay_s=None,
+        record_batches=True,
+        **kwargs,
+    )
+
+
+def run_inprocess(store, trace, tmp_path, name, mesh=None, width=8,
+                  **kwargs):
+    """The in-process reference: the trace through plain ``submit``."""
+
+    async def main():
+        service = _service(store, tmp_path, name, mesh=mesh, width=width,
+                           **kwargs)
+        futures = []
+        async with service:
+            for market_id, signals, outcome in trace:
+                futures.append(service.submit(market_id, signals, outcome))
+            await service.drain()
+        return service, [f.result() for f in futures]
+
+    service, results = asyncio.run(main())
+    store.sync()
+    return service, results
+
+
+def run_over_wire(store, trace, tmp_path, name, mesh=None, width=8,
+                  misbehave=None, **kwargs):
+    """The same trace offered by ONE pipelined blocking client over a
+    real socket (submission order = wire order = the admitted trace).
+    ``misbehave(port)`` runs hostile raw-socket traffic BEFORE the real
+    trace — the robustness tests' injection point."""
+
+    async def main():
+        service = _service(store, tmp_path, name, mesh=mesh, width=width,
+                           **kwargs)
+        server = await ConsensusServer(service).start()
+        loop = asyncio.get_running_loop()
+
+        def drive():
+            if misbehave is not None:
+                misbehave(server.port)
+            with ConsensusClient(port=server.port) as client:
+                return client.submit_pipelined(
+                    trace, return_exceptions=False
+                )
+
+        try:
+            results = await loop.run_in_executor(None, drive)
+            await service.drain()
+        finally:
+            await server.close()
+            await service.close()
+        return service, results
+
+    service, results = asyncio.run(main())
+    store.sync()
+    return service, results
+
+
+class TestWireCodec:
+    def test_roundtrip(self):
+        frame = wire.encode_request(
+            "m-1", [("s-1", 0.5), {"sourceId": "s-2", "probability": 0.25}],
+            True, qos_class="premium", request_id=7,
+        )
+        kind, length, crc = wire.decode_header(frame[:wire.HEADER.size])
+        assert kind == wire.KIND_REQUEST
+        payload = wire.decode_payload(frame[wire.HEADER.size:], crc)
+        assert payload == {
+            "id": 7, "market": "m-1",
+            "signals": [["s-1", 0.5], ["s-2", 0.25]],
+            "outcome": True, "class": "premium",
+        }
+
+    def test_canonical_bytes(self):
+        a = wire.encode_request("m", [("s", 0.5)], False, request_id=3)
+        b = wire.encode_request("m", [("s", 0.5)], False, request_id=3)
+        assert a == b
+
+    def test_bad_magic(self):
+        frame = bytearray(wire.encode_frame(wire.KIND_REQUEST, {}))
+        frame[0] = 0x58
+        with pytest.raises(wire.BadMagic):
+            wire.decode_header(bytes(frame[:wire.HEADER.size]))
+
+    def test_version_mismatch(self):
+        frame = bytearray(wire.encode_frame(wire.KIND_REQUEST, {}))
+        frame[4] = wire.WIRE_VERSION + 1
+        with pytest.raises(wire.VersionMismatch) as excinfo:
+            wire.decode_header(bytes(frame[:wire.HEADER.size]))
+        assert excinfo.value.got == wire.WIRE_VERSION + 1
+
+    def test_oversized_refused_before_allocation(self):
+        header = wire.HEADER.pack(
+            wire.MAGIC, wire.WIRE_VERSION, wire.KIND_REQUEST, 0,
+            wire.MAX_FRAME_BYTES + 1, 0,
+        )
+        with pytest.raises(wire.FrameTooLarge):
+            wire.decode_header(header)
+
+    def test_crc_mismatch(self):
+        frame = bytearray(wire.encode_frame(wire.KIND_ERROR, {"code": "shed",
+                                                              "message": ""}))
+        frame[-1] ^= 0xFF
+        _kind, length, crc = wire.decode_header(
+            bytes(frame[:wire.HEADER.size])
+        )
+        with pytest.raises(wire.ChecksumMismatch):
+            wire.decode_payload(bytes(frame[wire.HEADER.size:]), crc)
+
+    def test_truncated_header(self):
+        with pytest.raises(wire.TruncatedFrame):
+            wire.decode_header(b"BC")
+
+    def test_error_payloads_lift_to_serve_exceptions(self):
+        with pytest.raises(Overloaded) as excinfo:
+            wire.raise_error_payload(
+                {"code": "overloaded", "message": "x",
+                 "retry_after_s": 0.25, "pending": 9}
+            )
+        assert excinfo.value.retry_after_s == 0.25
+        assert excinfo.value.pending == 9
+        with pytest.raises(ShedError):
+            wire.raise_error_payload({"code": "shed", "message": "x"})
+        with pytest.raises(wire.WireError):
+            wire.raise_error_payload({"code": "oversized", "message": "x"})
+
+
+class TestWireByteParity:
+    """The headline: wire-served bytes ≡ in-process bytes over the same
+    admitted-request trace — across topology hits, drift, and growth."""
+
+    @pytest.mark.parametrize("use_mesh", [False, True],
+                             ids=["flat", "sharded"])
+    def test_wire_equals_inprocess(self, tmp_path, use_mesh):
+        trace = mixed_trace()
+        mesh = make_mesh() if use_mesh else None
+
+        wire_store = TensorReliabilityStore()
+        wire_service, wire_results = run_over_wire(
+            wire_store, trace, tmp_path, "wire", mesh=mesh
+        )
+        ref_store = TensorReliabilityStore()
+        ref_service, ref_results = run_inprocess(
+            ref_store, trace, tmp_path, "ref", mesh=mesh
+        )
+
+        assert [r.market_id for r in wire_results] == [
+            r.market_id for r in ref_results
+        ]
+        assert [r.consensus for r in wire_results] == [
+            r.consensus for r in ref_results
+        ]
+        assert [r.batch_index for r in wire_results] == [
+            r.batch_index for r in ref_results
+        ]
+        # The coalescer saw the same trace → the same batch sequence
+        # (markets + outcomes per batch; the probability columns are
+        # covered bit-for-bit by the byte comparisons below)...
+        assert [
+            (batch[0][0], batch[1]) for batch in wire_service.batch_log
+        ] == [
+            (batch[0][0], batch[1]) for batch in ref_service.batch_log
+        ]
+        # ...and every derived byte matches.
+        assert journal_epochs_sans_clock(
+            tmp_path / "wire.jrnl"
+        ) == journal_epochs_sans_clock(tmp_path / "ref.jrnl")
+        assert (tmp_path / "wire.db").read_bytes() == (
+            tmp_path / "ref.db"
+        ).read_bytes()
+
+    def test_qos_classed_trace_same_bytes(self, tmp_path):
+        """Class labels route admission, never settlement: the same
+        trace with classes attached settles the same bytes."""
+        trace = mixed_trace()
+        qos = [QosClass("premium", 3600.0, 1 << 16),
+               QosClass("besteffort", 3600.0, 1 << 16)]
+
+        plain_store = TensorReliabilityStore()
+        run_inprocess(plain_store, trace, tmp_path, "plain")
+
+        classed_store = TensorReliabilityStore()
+
+        async def main():
+            service = _service(classed_store, tmp_path, "classed", qos=qos)
+            async with service:
+                futures = [
+                    service.submit(
+                        market, signals, outcome,
+                        qos_class=(
+                            "premium" if i % 2 == 0 else "besteffort"
+                        ),
+                    )
+                    for i, (market, signals, outcome) in enumerate(trace)
+                ]
+                await service.drain()
+                return [f.result() for f in futures]
+
+        asyncio.run(main())
+        classed_store.sync()
+        assert journal_epochs_sans_clock(
+            tmp_path / "classed.jrnl"
+        ) == journal_epochs_sans_clock(tmp_path / "plain.jrnl")
+        assert (tmp_path / "classed.db").read_bytes() == (
+            tmp_path / "plain.db"
+        ).read_bytes()
+
+
+def _raw(port):
+    return socket.create_connection(("127.0.0.1", port), timeout=10.0)
+
+
+def _read_error_code(sock):
+    """One frame off a raw socket; returns the error payload's code."""
+    header = b""
+    while len(header) < wire.HEADER.size:
+        chunk = sock.recv(wire.HEADER.size - len(header))
+        if not chunk:
+            return None
+        header += chunk
+    kind, length, crc = wire.decode_header(header)
+    body = b""
+    while len(body) < length:
+        body += sock.recv(length - len(body))
+    payload = wire.decode_payload(body, crc)
+    assert kind == wire.KIND_ERROR
+    return payload["code"]
+
+
+class TestWireRobustness:
+    """Hostile transport traffic: the connection dies cleanly, the
+    coalescer and the journal bytes are untouched."""
+
+    @staticmethod
+    def _misbehave(port):
+        # 1. Torn header: half a header, then the client dies.
+        with _raw(port) as sock:
+            sock.sendall(wire.MAGIC + b"\x01")
+        # 2. Partial write mid-frame: a valid header claiming 64 payload
+        #    bytes, 10 bytes sent, then death (the slow-client tear).
+        with _raw(port) as sock:
+            sock.sendall(
+                wire.HEADER.pack(
+                    wire.MAGIC, wire.WIRE_VERSION, wire.KIND_REQUEST, 0,
+                    64, 0,
+                ) + b"x" * 10
+            )
+        # 3. Oversized frame: refused with an explicit error frame.
+        with _raw(port) as sock:
+            sock.sendall(
+                wire.HEADER.pack(
+                    wire.MAGIC, wire.WIRE_VERSION, wire.KIND_REQUEST, 0,
+                    wire.MAX_FRAME_BYTES + 1, 0,
+                )
+            )
+            assert _read_error_code(sock) == "oversized"
+            assert sock.recv(1) == b""  # ...and the connection closed
+        # 4. Version mismatch: its own code, then close.
+        with _raw(port) as sock:
+            sock.sendall(
+                wire.HEADER.pack(
+                    wire.MAGIC, wire.WIRE_VERSION + 1, wire.KIND_REQUEST,
+                    0, 2, 0,
+                )
+            )
+            assert _read_error_code(sock) == "version_mismatch"
+            assert sock.recv(1) == b""
+        # 5. Garbage magic.
+        with _raw(port) as sock:
+            sock.sendall(b"HTTP/1.1 GET /\r\n" + b"\x00" * 16)
+            assert _read_error_code(sock) == "bad_frame"
+            assert sock.recv(1) == b""
+        # 6. Corrupted payload (CRC disagrees).
+        with _raw(port) as sock:
+            frame = bytearray(
+                wire.encode_request("m-x", [("s", 0.5)], True)
+            )
+            frame[-1] ^= 0xFF
+            sock.sendall(bytes(frame))
+            assert _read_error_code(sock) == "bad_frame"
+            assert sock.recv(1) == b""
+        # 7. A response frame from a "client": protocol violation.
+        with _raw(port) as sock:
+            sock.sendall(wire.encode_frame(wire.KIND_RESPONSE, {"id": 0}))
+            assert _read_error_code(sock) == "bad_frame"
+            assert sock.recv(1) == b""
+        # 8. Well-framed request with a non-integer id: refused as
+        #    bad_request BEFORE submit — every reply path echoes the id
+        #    through int(), so discovering it at respond time would kill
+        #    the reply task after the request settled and the client
+        #    would never get a frame.
+        with _raw(port) as sock:
+            sock.sendall(
+                wire.encode_frame(
+                    wire.KIND_REQUEST,
+                    {
+                        "id": "abc", "market": "m-x",
+                        "signals": [["s", 0.5]], "outcome": True,
+                    },
+                )
+            )
+            assert _read_error_code(sock) == "bad_request"
+
+    def test_violations_leave_bytes_untouched(self, tmp_path):
+        trace = mixed_trace()
+        hostile_store = TensorReliabilityStore()
+        service, results = run_over_wire(
+            hostile_store, trace, tmp_path, "hostile",
+            misbehave=self._misbehave,
+        )
+        assert len(results) == len(trace)
+        clean_store = TensorReliabilityStore()
+        run_over_wire(clean_store, trace, tmp_path, "clean")
+        assert journal_epochs_sans_clock(
+            tmp_path / "hostile.jrnl"
+        ) == journal_epochs_sans_clock(tmp_path / "clean.jrnl")
+        assert (tmp_path / "hostile.db").read_bytes() == (
+            tmp_path / "clean.db"
+        ).read_bytes()
+
+
+class TestShedRankKey:
+    def test_widest_band_first_then_arrival(self):
+        ranked = sorted(
+            [
+                ("narrow", shed_rank_key(0.01, 0)),
+                ("wide", shed_rank_key(0.4, 3)),
+                ("mid", shed_rank_key(0.2, 1)),
+                ("unknown-old", shed_rank_key(None, 2)),
+                ("unknown-new", shed_rank_key(None, 5)),
+            ],
+            key=lambda pair: pair[1],
+        )
+        assert [name for name, _ in ranked] == [
+            "wide", "mid", "narrow", "unknown-old", "unknown-new",
+        ]
+
+    def test_tie_breaks_oldest_first(self):
+        assert shed_rank_key(0.3, 1) < shed_rank_key(0.3, 2)
+
+
+class TestVarianceAwareShedding:
+    """Acceptance: shed order is a pure function of (class, stderr
+    ranking, arrival order), pinned by a fixed trace."""
+
+    def test_fixed_trace_fixed_shed_sequence(self):
+        """Budget 3; arrivals 4..6 each shed the widest pending market:
+        m-wide (0.40), then m-mid (0.20), then m-narrow (0.05)."""
+        first = self._collect_victims()
+        second = self._collect_victims()
+        assert first == ["m-wide", "m-mid", "m-narrow"]
+        assert second == first  # same trace, same order, run to run
+
+    def _collect_victims(self):
+        store = TensorReliabilityStore()
+        victims = []
+
+        async def main():
+            service = ConsensusService(
+                store, steps=1, now=NOW, max_batch=64, max_delay_s=None,
+                qos=[QosClass("be", 3600.0, 3, policy="shed_oldest")],
+            )
+            service.seed_band_stderr(
+                {"m-wide": 0.40, "m-mid": 0.20, "m-narrow": 0.05}
+            )
+            pending = {}
+            for market in ("m-narrow", "m-wide", "m-mid"):
+                pending[market] = service.submit(
+                    market, [("s", 0.6)], True, qos_class="be"
+                )
+            for i in range(3):
+                overflow = service.submit(
+                    f"m-fresh-{i}", [("s", 0.6)], True, qos_class="be"
+                )
+                pending[f"m-fresh-{i}"] = overflow
+                for market, future in list(pending.items()):
+                    if future.done() and isinstance(
+                        future.exception(), ShedError
+                    ):
+                        victims.append(market)
+                        del pending[market]
+            await service.drain()
+            await service.close()
+
+        asyncio.run(main())
+        return victims
+
+    def test_malformed_request_cannot_evict_pending(self):
+        """Signal validation runs BEFORE the admission decision: a
+        malformed arrival against a full shed_oldest budget refuses on
+        its own defect — it must never first shed a healthy pending
+        request and then fail (via the wire that ordering would let one
+        bad frame kill one legitimate in-flight request per send)."""
+        store = TensorReliabilityStore()
+
+        async def main():
+            service = ConsensusService(
+                store, steps=1, now=NOW, max_batch=64, max_delay_s=None,
+                qos=[QosClass("be", 3600.0, 2, policy="shed_oldest")],
+            )
+            first = service.submit("m-a", [("s", 0.6)], True)
+            second = service.submit("m-b", [("s", 0.6)], True)
+            with pytest.raises(ValueError):
+                service.submit("m-c", [("s", 0.6, "extra")], True)
+            with pytest.raises(ValueError):
+                service.submit("m-d", [("s", "not-a-prob")], True)
+            # Both healthy requests are still pending — no victim was
+            # taken for an arrival that could never be admitted.
+            assert not first.done() and not second.done()
+            snap = service.qos_snapshot()
+            assert snap["be"]["counts"]["shed"] == 0
+            assert snap["be"]["pending"] == 2
+            await service.drain()
+            await service.close()
+
+        asyncio.run(main())
+
+    def test_no_stderr_degrades_to_shed_oldest(self):
+        store = TensorReliabilityStore()
+        victims = []
+
+        async def main():
+            service = ConsensusService(
+                store, steps=1, now=NOW, max_batch=64, max_delay_s=None,
+                qos=[QosClass("be", 3600.0, 2, policy="shed_oldest")],
+            )
+            first = service.submit("m-a", [("s", 0.6)], True)
+            second = service.submit("m-b", [("s", 0.6)], True)
+            service.submit("m-c", [("s", 0.6)], True)
+            assert isinstance(first.exception(), ShedError)
+            assert not second.done() or second.exception() is None
+            victims.append("m-a")
+            await service.drain()
+            await service.close()
+
+        asyncio.run(main())
+        assert victims == ["m-a"]
+
+
+class TestQosClasses:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="name"):
+            QosClass("bad name", 1.0, 4)
+        with pytest.raises(ValueError, match="slo_s"):
+            QosClass("x", 0.0, 4)
+        with pytest.raises(ValueError, match="policy"):
+            QosClass("x", 1.0, 4, policy="drop_all")
+        with pytest.raises(ValueError, match="duplicate"):
+            ConsensusService(
+                TensorReliabilityStore(),
+                qos=[QosClass("x", 1.0, 4), QosClass("x", 2.0, 4)],
+            )
+
+    def test_unknown_class_and_unclassed_service_raise(self):
+        store = TensorReliabilityStore()
+
+        async def main():
+            service = ConsensusService(
+                store, max_delay_s=None,
+                qos=[QosClass("premium", 1.0, 4)],
+            )
+            with pytest.raises(ValueError, match="unknown QoS class"):
+                service.submit("m", [("s", 0.5)], True, qos_class="nope")
+            await service.close()
+            unclassed = ConsensusService(store, max_delay_s=None)
+            with pytest.raises(ValueError, match="declared no qos"):
+                unclassed.submit("m", [("s", 0.5)], True,
+                                 qos_class="premium")
+            await unclassed.close()
+
+        asyncio.run(main())
+
+    def test_per_class_budget_is_isolated(self):
+        """The best-effort budget refusing never touches premium."""
+        store = TensorReliabilityStore()
+
+        async def main():
+            service = ConsensusService(
+                store, steps=1, now=NOW, max_batch=64, max_delay_s=None,
+                qos=[QosClass("premium", 3600.0, 64),
+                     QosClass("be", 3600.0, 2)],
+            )
+            service.submit("m-1", [("s", 0.6)], True, qos_class="be")
+            service.submit("m-2", [("s", 0.6)], True, qos_class="be")
+            with pytest.raises(Overloaded):
+                service.submit("m-3", [("s", 0.6)], True, qos_class="be")
+            # Premium admits freely at the same moment.
+            future = service.submit(
+                "m-4", [("s", 0.6)], True, qos_class="premium"
+            )
+            snap = service.qos_snapshot()
+            assert snap["be"]["counts"]["rejected"] == 1
+            assert snap["premium"]["counts"]["rejected"] == 0
+            await service.drain()
+            await future
+            await service.close()
+            return service
+
+        service = asyncio.run(main())
+        snap = service.qos_snapshot()
+        assert snap["premium"]["counts"]["met"] == 1
+        assert snap["be"]["counts"]["met"] == 2
+        # Goodput is per class: be = 2/3 (the refusal counts against),
+        # premium = 1/1.
+        assert snap["premium"]["goodput_within_slo"] == 1.0
+        assert abs(snap["be"]["goodput_within_slo"] - 2 / 3) < 1e-12
+
+    def test_per_class_burn_shedding_with_probe(self):
+        """A class burning its own budget refuses ITS arrivals below its
+        bound (every Nth admitted as a probe); the other class and the
+        service-wide bound never notice."""
+        store = TensorReliabilityStore()
+
+        async def main():
+            service = ConsensusService(
+                store, steps=1, now=NOW, max_batch=64, max_delay_s=None,
+                qos=[
+                    QosClass("premium", 3600.0, 64),
+                    QosClass(
+                        "be", 3600.0, 64, shed_when_burning=True,
+                        burn_probe_every=2, objective_goodput=0.5,
+                        burn_windows=(BurnWindow(2, 4, 1.0),),
+                    ),
+                ],
+            )
+            monitor = service._qos_states["be"].health
+            for _ in range(8):
+                monitor.record("violated")
+            assert monitor.burning
+            outcomes = []
+            for i in range(4):
+                try:
+                    service.submit(
+                        f"m-{i}", [("s", 0.6)], True, qos_class="be"
+                    )
+                    outcomes.append("admitted")
+                except Overloaded:
+                    outcomes.append("rejected")
+            # burn_probe_every=2: reject, probe, reject, probe.
+            assert outcomes == [
+                "rejected", "admitted", "rejected", "admitted",
+            ]
+            # Premium admits throughout.
+            service.submit("m-p", [("s", 0.6)], True, qos_class="premium")
+            await service.drain()
+            await service.close()
+            return service
+
+        service = asyncio.run(main())
+        snap = service.qos_snapshot()
+        assert snap["be"]["counts"]["rejected"] == 2
+        assert snap["premium"]["counts"]["rejected"] == 0
+
+    def test_class_shed_keeps_aggregate_counters_consistent(self):
+        """A class-scoped shed replaces its victim: the arrival is
+        counted admitted ONCE (review-pass regression: consulting the
+        global controller after count_shed double-counted it, so
+        serve.admitted could exceed serve.requests)."""
+        from bayesian_consensus_engine_tpu import obs
+
+        registry = obs.MetricsRegistry()
+        previous = obs.set_metrics_registry(registry)
+        try:
+            store = TensorReliabilityStore()
+
+            async def main():
+                service = ConsensusService(
+                    store, steps=1, now=NOW, max_batch=64,
+                    max_delay_s=None,
+                    qos=[QosClass("be", 3600.0, 2,
+                                  policy="shed_oldest")],
+                )
+                for i in range(6):
+                    service.submit(f"m-{i}", [("s", 0.6)], True)
+                await service.drain()
+                await service.close()
+
+            asyncio.run(main())
+            counters = registry.export()["counters"]
+            assert counters["serve.requests"] == 6
+            # 6 arrivals, budget 2: four sheds, every arrival admitted
+            # exactly once — admitted == requests, never more.
+            assert counters["serve.admitted"] == 6
+            assert counters["serve.shed"] == 4
+            assert counters.get("serve.rejected", 0) == 0
+            assert counters["serve.qos.be.admitted"] == 6
+            assert counters["serve.qos.be.shed"] == 4
+        finally:
+            obs.set_metrics_registry(previous)
+
+    def test_first_declared_class_is_default(self):
+        store = TensorReliabilityStore()
+
+        async def main():
+            service = ConsensusService(
+                store, steps=1, now=NOW, max_batch=64, max_delay_s=None,
+                qos=[QosClass("premium", 3600.0, 64),
+                     QosClass("be", 3600.0, 64)],
+            )
+            future = service.submit("m-1", [("s", 0.6)], True)
+            await service.drain()
+            await future
+            await service.close()
+            return service
+
+        service = asyncio.run(main())
+        snap = service.qos_snapshot()
+        assert snap["premium"]["offered"] == 1
+        assert snap["be"]["offered"] == 0
+
+
+class TestQosLedger:
+    """extras.qos → merged per-class bands, rendered and diffed."""
+
+    @staticmethod
+    def _record(counts_by_class, leg="e2e_netserve.overload"):
+        return {
+            "leg": leg,
+            "value": 1.0,
+            "unit": "s",
+            "extras": {
+                "qos": {
+                    name: {"slo_s": slo, "counts": counts}
+                    for name, (slo, counts) in counts_by_class.items()
+                }
+            },
+        }
+
+    def test_counts_sum_across_repeats(self):
+        from bayesian_consensus_engine_tpu.obs.ledger import min_of_repeats
+
+        records = [
+            self._record({
+                "premium": (0.05, {"met": 9, "violated": 1}),
+                "be": (1.0, {"met": 4, "shed": 6}),
+            }),
+            self._record({
+                "premium": (0.05, {"met": 8, "violated": 2}),
+                "be": (1.0, {"met": 5, "shed": 5}),
+            }),
+        ]
+        band = min_of_repeats(records, "e2e_netserve.overload")
+        assert band["qos"]["premium"]["counts"] == {
+            "met": 17, "violated": 3,
+        }
+        assert band["qos"]["premium"]["goodput_within_slo"] == 0.85
+        assert band["qos"]["be"]["slo_violations"] == 11
+
+    def test_vocabulary_mismatch_refuses(self):
+        from bayesian_consensus_engine_tpu.obs.ledger import min_of_repeats
+
+        records = [
+            self._record({"premium": (0.05, {"met": 1})}),
+            self._record({"gold": (0.05, {"met": 1})}),
+        ]
+        with pytest.raises(ValueError, match="vocabularies differ"):
+            min_of_repeats(records, "e2e_netserve.overload")
+
+    def test_slo_mismatch_refuses(self):
+        from bayesian_consensus_engine_tpu.obs.ledger import min_of_repeats
+
+        records = [
+            self._record({"premium": (0.05, {"met": 1})}),
+            self._record({"premium": (0.5, {"met": 1})}),
+        ]
+        with pytest.raises(ValueError, match="slo_s"):
+            min_of_repeats(records, "e2e_netserve.overload")
+
+    def test_render_and_diff_carry_class_columns(self):
+        from bayesian_consensus_engine_tpu.obs.ledger import (
+            diff_bands,
+            render,
+            render_diff,
+        )
+
+        old = [self._record({"premium": (0.05, {"met": 8, "violated": 2})})]
+        new = [self._record({"premium": (0.05, {"met": 6, "violated": 4})})]
+        table = render(new)
+        assert "premium: goodput 60.0% slo 4" in table
+        diff = diff_bands(old, new)
+        entry = diff["e2e_netserve.overload"]
+        assert entry["metrics"]["qos.premium.goodput"] == {
+            "old": 0.8, "new": 0.6,
+        }
+        assert "qos.premium.goodput 0.8->0.6" in render_diff(diff)
+
+
+class TestServeCli:
+    """`bce-tpu serve`: the banner/summary contract, end to end over a
+    real subprocess socket."""
+
+    def test_serve_round_trip(self, tmp_path):
+        import json
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "bayesian_consensus_engine_tpu.cli",
+                "serve", "--port", "0", "--duration", "20",
+                "--qos", "premium:5.0:256",
+                "--qos", "besteffort:5.0:64:shed_oldest",
+                "--max-delay-ms", "1",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        try:
+            banner = json.loads(proc.stdout.readline())
+            assert banner["classes"] == ["premium", "besteffort"]
+            with ConsensusClient(port=banner["port"]) as client:
+                result = client.submit(
+                    "m-1", [("s-1", 0.7)], True, qos_class="premium"
+                )
+                assert result.market_id == "m-1"
+                assert 0.0 <= result.consensus <= 1.0
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
+
+    def test_sigint_lands_the_exit_summary(self):
+        """Ctrl-C in the default run-until-interrupted mode still
+        drains and prints the documented per-class summary JSON —
+        SIGINT routes through the stop event instead of cancelling the
+        serve coroutine before the summary is built."""
+        import json
+        import signal as _signal
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "bayesian_consensus_engine_tpu.cli",
+                "serve", "--port", "0", "--duration", "0",
+                "--qos", "premium:5.0:256",
+                "--max-delay-ms", "1",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        try:
+            banner = json.loads(proc.stdout.readline())
+            with ConsensusClient(port=banner["port"]) as client:
+                result = client.submit(
+                    "m-1", [("s-1", 0.7)], True, qos_class="premium"
+                )
+                assert result.market_id == "m-1"
+            proc.send_signal(_signal.SIGINT)
+            stdout, _stderr = proc.communicate(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        assert proc.returncode == 0
+        summary = json.loads(stdout)
+        assert summary["served"]["requests"] == 1
+        assert "premium" in summary["qos"]
