@@ -547,6 +547,8 @@ def _run_lint(args: argparse.Namespace) -> None:
         argv += ["--format", args.format]
     if args.select:
         argv += ["--select", args.select]
+    if args.cache:
+        argv += ["--cache", args.cache]
     raise SystemExit(lint_main(argv))
 
 
@@ -757,6 +759,14 @@ def build_parser() -> argparse.ArgumentParser:
     lint.add_argument(
         "--select",
         help="comma-separated rule IDs to run (default: all)",
+    )
+    lint.add_argument(
+        "--cache",
+        metavar="PATH",
+        help=(
+            "JSON sidecar for per-file result caching — warm runs of an "
+            "unchanged tree skip re-parsing entirely"
+        ),
     )
     lint.add_argument(
         "--list-rules",

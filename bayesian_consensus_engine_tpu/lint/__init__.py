@@ -18,6 +18,22 @@ they are already broken:
 Plus the migrated ``scripts/devlint.py`` pyflakes-lite family (F4xx/F8xx/
 E7xx) so there is exactly one engine behind every gate.
 
+Round 16 adds a **whole-program tier**: a ``ProjectContext`` (module
+graph, re-export-aware function index, jit traced set — see
+``lint/project.py``) built once per run, feeding project rules:
+
+* **JX110** applies the JX102/103/104 traced-body hazards to helpers
+  jit-wrapped from *another* module (the ``parallel/sharded.py`` →
+  ``ops/cycle_math.py`` shape), naming the trace chain.
+* **AS6xx** guards the asyncio request tier (``serve/``, ``net/``,
+  ``obs/export.py``): blocking calls on the event loop (AS601),
+  discarded coroutines (AS602), threading locks held across an await
+  (AS603).
+
+``--cache`` (or ``run(cache=…)``) keys per-file findings on mtime+size
+and project findings on a gate-set digest, so warm gate runs skip
+re-parsing unchanged files entirely.
+
 Run it as ``python -m bayesian_consensus_engine_tpu.lint`` or via the
 ``lint`` subcommand of the package CLI. ``# noqa`` on the offending line
 suppresses every rule; ``# noqa: JX101,DT201`` suppresses just those IDs.
@@ -28,6 +44,7 @@ package (enforced by its own LY301 rule) so it can never drag JAX — or a
 bug in the code under analysis — into the analysis itself.
 """
 
+from bayesian_consensus_engine_tpu.lint.cache import LintCache
 from bayesian_consensus_engine_tpu.lint.engine import (
     Finding,
     check_file,
@@ -36,10 +53,17 @@ from bayesian_consensus_engine_tpu.lint.engine import (
     main,
     run,
 )
-from bayesian_consensus_engine_tpu.lint.registry import RULES, Rule, rule
+from bayesian_consensus_engine_tpu.lint.project import ProjectContext
+from bayesian_consensus_engine_tpu.lint.registry import (
+    RULES,
+    Rule,
+    project_rule,
+    rule,
+)
 
 # Importing the rule modules registers every rule (decorator side effect).
 from bayesian_consensus_engine_tpu.lint import (  # noqa: F401
+    rules_async,
     rules_determinism,
     rules_jax,
     rules_layering,
@@ -50,8 +74,11 @@ from bayesian_consensus_engine_tpu.lint import (  # noqa: F401
 
 __all__ = [
     "Finding",
+    "LintCache",
+    "ProjectContext",
     "Rule",
     "RULES",
+    "project_rule",
     "rule",
     "check_file",
     "check_source",

@@ -1,0 +1,159 @@
+"""Per-file lint result cache: mtime+size keyed, stdlib JSON sidecar.
+
+The bench/perf_lab pre-measure gate lints the whole gate set (~120
+files) before every run; between runs almost nothing changes. This
+cache makes the warm case cheap without ever trading correctness for
+speed:
+
+* **File-rule findings** are keyed per file on ``(mtime_ns, size)`` — a
+  touched file misses and re-lints, everything else replays its stored
+  findings byte-identically.
+* **Project-rule findings** are keyed on the **gate-set digest** (a hash
+  over every file's path, mtime and size, plus the rule catalog and the
+  ``--select`` set): whole-program findings depend on files *other*
+  than the one they land on (editing the jit-wrap site changes what
+  JX110 says about an untouched helper), so any change anywhere
+  invalidates the project tier while per-file results stay reusable.
+* A digest hit for the **whole** gate set short-circuits parsing
+  entirely — the fully-warm run is a stat pass plus a JSON read.
+
+The sidecar is versioned, tolerant of corruption (an unreadable cache
+is an empty cache, never an error), and written atomically. ``hits`` /
+``misses`` counters exist so tests can assert the warm path actually
+ran warm instead of just being fast.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pathlib
+from dataclasses import asdict
+from typing import Optional
+
+_VERSION = 1
+
+
+def gate_digest(
+    entries: list[tuple[str, int, int]],
+    rules_key: str,
+    select_key: str,
+) -> str:
+    """Digest of the whole gate set: (path, mtime_ns, size) per file,
+    plus the rule catalog and selection — anything that could change any
+    finding anywhere changes the digest."""
+    h = hashlib.sha256()
+    h.update(f"v{_VERSION}|{rules_key}|{select_key}".encode())
+    for path, mtime_ns, size in sorted(entries):
+        h.update(f"\n{path}|{mtime_ns}|{size}".encode())
+    return h.hexdigest()
+
+
+class LintCache:
+    """One JSON sidecar holding per-file findings for one gate set."""
+
+    def __init__(self, path):
+        self.path = pathlib.Path(path)
+        self.hits = 0
+        self.misses = 0
+        #: True when the stored gate digest matches the current one —
+        #: the precondition for replaying project-rule findings.
+        self.gate_fresh = False
+        self._files: dict[str, dict] = {}
+        self._stats: dict[str, int] = {}
+        self._digest = ""
+        self._header_ok = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def open(self, rules_key: str, select_key: str, digest: str) -> None:
+        """Load the sidecar and validate it against this run's shape."""
+        self._digest = digest
+        data: dict = {}
+        try:
+            data = json.loads(self.path.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            data = {}
+        self._header_ok = (
+            data.get("version") == _VERSION
+            and data.get("rules_key") == rules_key
+            and data.get("select_key") == select_key
+        )
+        if not self._header_ok:
+            data = {}
+        self._rules_key = rules_key
+        self._select_key = select_key
+        self._files = data.get("files", {})
+        self._stats = data.get("project_stats", {})
+        self.gate_fresh = self._header_ok and data.get("digest") == digest
+
+    def save(self, project_stats: dict) -> None:
+        """Atomically persist the current state of the cache."""
+        payload = {
+            "version": _VERSION,
+            "rules_key": self._rules_key,
+            "select_key": self._select_key,
+            "digest": self._digest,
+            "project_stats": dict(project_stats),
+            "files": self._files,
+        }
+        tmp = self.path.with_name(self.path.name + ".tmp")
+        try:
+            tmp.write_text(
+                json.dumps(payload, sort_keys=True), encoding="utf-8"
+            )
+            os.replace(tmp, self.path)
+        except OSError:
+            # A read-only location degrades to "no cache", never a crash.
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+
+    # -- per-file entries -----------------------------------------------------
+
+    def file_fresh(self, key: str, stamp: tuple[int, int]) -> bool:
+        """True when *key*'s per-file entry matches (mtime_ns, size)."""
+        entry = self._files.get(key)
+        return (
+            entry is not None
+            and entry.get("mtime_ns") == stamp[0]
+            and entry.get("size") == stamp[1]
+        )
+
+    def cached_file_findings(self, key: str) -> list[dict]:
+        return list(self._files[key].get("file", []))
+
+    def cached_project_findings(self, key: str) -> list[dict]:
+        return list(self._files[key].get("project", []))
+
+    def record(
+        self,
+        key: str,
+        stamp: tuple[int, int],
+        file_findings,
+        project_findings,
+    ) -> None:
+        self._files[key] = {
+            "mtime_ns": stamp[0],
+            "size": stamp[1],
+            "file": [asdict(f) for f in file_findings],
+            "project": [asdict(f) for f in project_findings],
+        }
+
+    def prune(self, keys) -> None:
+        """Drop entries for files no longer in the gate set."""
+        keep = set(keys)
+        self._files = {k: v for k, v in self._files.items() if k in keep}
+
+    @property
+    def project_stats(self) -> dict:
+        return dict(self._stats)
+
+
+def resolve_cache(cache) -> Optional[LintCache]:
+    """Accept a LintCache, a path, or None (engine convenience)."""
+    if cache is None or isinstance(cache, LintCache):
+        return cache
+    return LintCache(cache)

@@ -153,6 +153,18 @@ CLOCK_FREE_PREFIXES = (
 #: and metric exports must be byte-stable across dict orderings.
 SERIALIZATION_PREFIXES = (f"{PACKAGE}/state/", f"{PACKAGE}/obs/")
 
+#: The asyncio request tier: the AS6xx async-safety family applies here.
+#: ``serve/`` (the coalescer runs the one event loop the determinism
+#: contract depends on), ``net/`` (the socket front door's acceptor and
+#: connection tasks), and the telemetry exporter (it serves HTTP beside
+#: the request path). One blocking call on any of these loops stalls
+#: every connection behind one request.
+ASYNC_TIER_PREFIXES = (
+    f"{PACKAGE}/serve/",
+    f"{PACKAGE}/net/",
+    f"{PACKAGE}/obs/export.py",
+)
+
 
 def in_package(rel: str | None) -> bool:
     """True for files inside the package tree (layer + determinism scope)."""

@@ -5,19 +5,34 @@ path, shared import-alias map) and yield ``(lineno, message)`` pairs; the
 engine turns those into :class:`Finding`s, applies ``# noqa`` suppression,
 renders text or JSON, and returns the exit code. Severity ``error`` gates
 (exit 1); ``warning`` reports without failing the run.
+
+Two rule kinds run through the same pipeline. File rules see one file.
+Project rules additionally see the whole-program
+:class:`~bayesian_consensus_engine_tpu.lint.project.ProjectContext` —
+built once per :func:`run` over every parseable file in the gate set —
+and report per file like everything else, so ``# noqa``, severities,
+``--select`` and both output formats compose unchanged. ``--cache``
+plugs in the mtime+size sidecar from
+:mod:`~bayesian_consensus_engine_tpu.lint.cache`.
 """
 
 from __future__ import annotations
 
 import argparse
 import ast
+import difflib
 import json
 import pathlib
 from dataclasses import asdict, dataclass
 from functools import cached_property
-from typing import Iterable, Optional, Sequence
+from typing import Iterable, Mapping, Optional, Sequence
 
 from bayesian_consensus_engine_tpu.lint import config
+from bayesian_consensus_engine_tpu.lint.cache import (
+    LintCache,
+    gate_digest,
+    resolve_cache,
+)
 from bayesian_consensus_engine_tpu.lint.registry import RULES
 
 
@@ -113,17 +128,80 @@ def _suppressed(ctx: FileContext, finding: Finding) -> bool:
     return not ids or finding.rule_id in ids
 
 
+def _validate_select(
+    select: Optional[Iterable[str]],
+) -> Optional[frozenset[str]]:
+    """Normalise *select*, rejecting unknown IDs with catalog near-misses.
+
+    A typo'd ``--select JX9999`` in a CI step used to run zero rules and
+    exit 0 — a silently-green gate. Unknown IDs are now a ValueError
+    naming the closest catalog entries.
+    """
+    if select is None:
+        return None
+    wanted = [s for s in select]
+    unknown = [i for i in wanted if i not in RULES]
+    if unknown:
+        catalog = list(RULES)
+        parts = []
+        for u in unknown:
+            close = difflib.get_close_matches(u, catalog, n=3, cutoff=0.5)
+            if not close:  # fall back to the rule family (same prefix)
+                close = [i for i in catalog if i[:2] == u[:2]][:3]
+            hint = f" (did you mean: {', '.join(close)}?)" if close else ""
+            parts.append(f"{u!r}{hint}")
+        raise ValueError(
+            "unknown rule id(s) in select: "
+            + "; ".join(parts)
+            + " — run --list-rules for the catalog"
+        )
+    return frozenset(wanted)
+
+
+def _apply_rules(
+    ctx: FileContext,
+    pctx,
+    wanted: Optional[frozenset[str]],
+    kinds: tuple[str, ...] = ("file", "project"),
+) -> list[Finding]:
+    """Run every applicable rule of *kinds* on one file; dedupe,
+    suppress, and order the findings for humans."""
+    findings: list[Finding] = []
+    for r in RULES.values():
+        if r.kind not in kinds:
+            continue
+        if wanted is not None and r.id not in wanted:
+            continue
+        if not r.applies_to(ctx.rel):
+            continue
+        out = r.check(ctx) if r.kind == "file" else r.check(pctx, ctx)
+        for lineno, message in out:
+            findings.append(
+                Finding(ctx.path, lineno, r.id, message, r.severity)
+            )
+    findings = list(dict.fromkeys(findings))  # nested walks can repeat
+    findings = [f for f in findings if not _suppressed(ctx, f)]
+    findings.sort(key=lambda f: (f.line, f.rule_id, f.message))
+    return findings
+
+
 def check_source(
     src: str,
     rel: Optional[str],
     path: Optional[str] = None,
     select: Optional[Iterable[str]] = None,
+    project: Optional[Mapping[str, str]] = None,
 ) -> list[Finding]:
     """Lint a source string as if it lived at repo-relative path *rel*.
 
     The fixture-testing entry point: rules scoped to e.g. ``ops/`` can be
-    exercised without writing files into the repo.
+    exercised without writing files into the repo. *project* maps
+    repo-relative paths to sources for synthetic sibling files, so
+    project rules (JX110, AS6xx) can be exercised on multi-file shapes —
+    only findings for the *rel* file are returned, exactly as ``run()``
+    would report them for that file.
     """
+    wanted = _validate_select(select)
     shown = path or rel or "<source>"
     try:
         tree = ast.parse(src)
@@ -132,20 +210,25 @@ def check_source(
             Finding(shown, exc.lineno or 1, "E999", f"syntax error: {exc.msg}")
         ]
     ctx = FileContext(shown, src, tree, rel)
-    wanted = set(select) if select is not None else None
-    findings: list[Finding] = []
-    for r in RULES.values():
-        if wanted is not None and r.id not in wanted:
+    contexts = [ctx]
+    for prel in sorted(project or ()):
+        if prel == rel:
             continue
-        if not r.applies_to(rel):
-            continue
-        for lineno, message in r.check(ctx):
-            findings.append(Finding(shown, lineno, r.id, message, r.severity))
-    # Dedupe (nested walks can repeat), suppress, and order for humans.
-    findings = list(dict.fromkeys(findings))
-    findings = [f for f in findings if not _suppressed(ctx, f)]
-    findings.sort(key=lambda f: (f.line, f.rule_id, f.message))
-    return findings
+        try:
+            ptree = ast.parse(project[prel])
+        except SyntaxError:
+            continue  # a broken sibling can't contribute to the index
+        contexts.append(FileContext(prel, project[prel], ptree, prel))
+    pctx = _project_context(contexts)
+    return _apply_rules(ctx, pctx, wanted)
+
+
+def _project_context(contexts):
+    # Deferred import: project.py pulls in rules_jax's detectors, and
+    # importing it lazily keeps engine importable during registration.
+    from bayesian_consensus_engine_tpu.lint.project import ProjectContext
+
+    return ProjectContext(contexts)
 
 
 def _repo_root() -> pathlib.Path:
@@ -159,17 +242,39 @@ def _relativize(path: pathlib.Path, root: pathlib.Path) -> Optional[str]:
         return None
 
 
+def _parse_file(
+    path, root: pathlib.Path
+) -> tuple[Optional[FileContext], Optional[Finding]]:
+    """Parse one file into a FileContext, or an E999 finding."""
+    p = pathlib.Path(path)
+    src = p.read_text()
+    rel = _relativize(p, root)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as exc:
+        return None, Finding(
+            str(path), exc.lineno or 1, "E999", f"syntax error: {exc.msg}"
+        )
+    return FileContext(str(path), src, tree, rel), None
+
+
 def check_file(
     path,
     root: Optional[pathlib.Path] = None,
     select: Optional[Iterable[str]] = None,
 ) -> list[Finding]:
-    """Lint one file; scoped rules key off its path relative to *root*."""
-    p = pathlib.Path(path)
-    rel = _relativize(p, root or _repo_root())
-    return check_source(
-        p.read_text(), rel, path=str(path), select=select
-    )
+    """Lint one file; scoped rules key off its path relative to *root*.
+
+    Single-file entry point: project rules see a one-file project, so
+    cross-module trace chains need :func:`run` (or ``check_source`` with
+    ``project=``) to appear.
+    """
+    wanted = _validate_select(select)
+    base = root or _repo_root()
+    ctx, err = _parse_file(path, base)
+    if err is not None:
+        return [err]
+    return _apply_rules(ctx, _project_context([ctx]), wanted)
 
 
 def iter_target_files(
@@ -189,23 +294,37 @@ def iter_target_files(
     return files
 
 
+def _findings_from_cache(rows: list[dict]) -> list[Finding]:
+    return [Finding(**row) for row in rows]
+
+
 def run(
     paths: Optional[Sequence[str]] = None,
     root: Optional[pathlib.Path] = None,
     select: Optional[Iterable[str]] = None,
+    cache=None,
+    stats: Optional[dict] = None,
 ) -> tuple[int, list[Finding]]:
     """Lint *paths* (default: the repo gate set); return (n_files, findings).
 
     An explicitly-named path that matches no Python files is an E902 error
     finding — a typo'd path in a CI step must not pass as "0 findings".
+    Overlapping targets (``pkg`` and ``pkg/lint``) are deduped by resolved
+    path: each file is linted and counted exactly once.
+
+    *cache* is a :class:`~bayesian_consensus_engine_tpu.lint.cache.LintCache`
+    or a sidecar path; *stats*, when given, is filled with the project-tier
+    numbers (traced set size etc.) for display.
     """
+    wanted = _validate_select(select)
     base = root or _repo_root()
     explicit = paths is not None
     findings: list[Finding] = []
-    n_files = 0
+    files: list[tuple[str, pathlib.Path]] = []  # (resolved key, path)
+    seen: set[str] = set()
     for t in paths or config.DEFAULT_PATHS:
-        files = iter_target_files([t], base)
-        if not files and explicit:
+        matched = iter_target_files([t], base)
+        if not matched and explicit:
             findings.append(
                 Finding(
                     str(t), 1, "E902",
@@ -213,9 +332,79 @@ def run(
                 )
             )
             continue
-        n_files += len(files)
-        for f in files:
-            findings.extend(check_file(f, root=base, select=select))
+        for f in matched:
+            key = str(f.resolve())
+            if key not in seen:
+                seen.add(key)
+                files.append((key, f))
+    n_files = len(files)
+
+    store: Optional[LintCache] = resolve_cache(cache)
+    stamps: dict[str, tuple[int, int]] = {}
+    if store is not None:
+        for key, f in files:
+            st = f.stat()
+            stamps[key] = (st.st_mtime_ns, st.st_size)
+        rules_key = ",".join(RULES)
+        select_key = ",".join(sorted(wanted)) if wanted is not None else "*"
+        digest = gate_digest(
+            [(key, *stamps[key]) for key, _ in files], rules_key, select_key
+        )
+        store.open(rules_key, select_key, digest)
+        if store.gate_fresh and all(
+            store.file_fresh(key, stamps[key]) for key, _ in files
+        ):
+            # Fully warm: nothing changed anywhere — replay everything
+            # (file and project tiers) without parsing a single file.
+            for key, _ in files:
+                store.hits += 1
+                merged = _findings_from_cache(
+                    store.cached_file_findings(key)
+                ) + _findings_from_cache(store.cached_project_findings(key))
+                merged.sort(key=lambda f: (f.line, f.rule_id, f.message))
+                findings.extend(merged)
+            if stats is not None:
+                stats.update(store.project_stats)
+            return n_files, findings
+
+    # Cold (or partially warm): parse everything — the project tier needs
+    # the full gate set — then reuse per-file findings where files are
+    # byte-unchanged and recompute the project tier against the new shape.
+    ctxs: dict[str, Optional[FileContext]] = {}
+    parse_errors: dict[str, Finding] = {}
+    for key, f in files:
+        ctx, err = _parse_file(f, base)
+        ctxs[key] = ctx
+        if err is not None:
+            parse_errors[key] = err
+    pctx = _project_context(
+        [c for c in ctxs.values() if c is not None]
+    )
+    if stats is not None:
+        stats.update(pctx.stats)
+    for key, f in files:
+        ctx = ctxs[key]
+        if ctx is None:
+            file_fnd, project_fnd = [parse_errors[key]], []
+        else:
+            if store is not None and store.file_fresh(key, stamps[key]):
+                store.hits += 1
+                file_fnd = _findings_from_cache(
+                    store.cached_file_findings(key)
+                )
+            else:
+                if store is not None:
+                    store.misses += 1
+                file_fnd = _apply_rules(ctx, pctx, wanted, kinds=("file",))
+            project_fnd = _apply_rules(ctx, pctx, wanted, kinds=("project",))
+        if store is not None:
+            store.record(key, stamps[key], file_fnd, project_fnd)
+        merged = file_fnd + project_fnd
+        merged.sort(key=lambda f: (f.line, f.rule_id, f.message))
+        findings.extend(merged)
+    if store is not None:
+        store.prune([key for key, _ in files])
+        store.save(pctx.stats)
     return n_files, findings
 
 
@@ -243,6 +432,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="comma-separated rule IDs to run (default: all)",
     )
     parser.add_argument(
+        "--cache",
+        metavar="PATH",
+        help="JSON sidecar for per-file result caching (see docs)",
+    )
+    parser.add_argument(
         "--list-rules",
         action="store_true",
         help="print the rule catalog and exit",
@@ -259,11 +453,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.select
         else None
     )
-    n_files, findings = run(args.paths or None, select=select)
+    stats: dict = {}
+    try:
+        n_files, findings = run(
+            args.paths or None, select=select, cache=args.cache, stats=stats
+        )
+    except ValueError as exc:
+        import sys
+
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
     if args.format == "json":
         print(
             json.dumps(
-                {"files": n_files, "findings": [asdict(f) for f in findings]},
+                {
+                    "files": n_files,
+                    "stats": stats,
+                    "findings": [asdict(f) for f in findings],
+                },
                 indent=2,
             )
         )
@@ -272,6 +479,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f.render())
         errors = sum(f.severity == "error" for f in findings)
         warnings = len(findings) - errors
+        print(
+            "graftlint: traced set: "
+            f"{stats.get('traced_functions', 0)} functions across "
+            f"{stats.get('traced_modules', 0)} modules "
+            f"({stats.get('unknown_callees', 0)} unknown callees skipped)"
+        )
         print(
             f"graftlint: {n_files} files, {errors} errors, "
             f"{warnings} warnings"

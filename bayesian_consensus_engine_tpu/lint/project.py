@@ -1,0 +1,424 @@
+"""Whole-program context for graftlint: module graph, index, traced set.
+
+The per-file rules stop at module boundaries by construction: JX102–104
+walk the bodies of functions a file jit-wraps *itself*, so a helper that
+``parallel/sharded.py`` traces out of ``ops/cycle_math.py`` is invisible
+to them. :class:`ProjectContext` is the missing half — built once per
+``run()`` from every parsed file in the gate set, it provides:
+
+* a **module graph**: repo-relative path ↔ dotted module name, plus a
+  per-file import map that resolves relative imports and aliases to
+  absolute dotted origins;
+* a **function-definition index**: module-level defs per module for
+  cross-file resolution (re-export-aware — ``sharded.py`` re-exporting
+  ``cycle_math`` names via ``from … import`` resolves through the chain),
+  and an every-def index per file for local resolution;
+* the **traced set**: every function transitively reachable, across
+  files, from a ``jax.jit`` / ``shard_map`` / ``pl.pallas_call`` /
+  ``jax.vmap`` entry point. The walk is bounded (depth
+  :data:`MAX_TRACE_DEPTH`) and conservative: a callee that cannot be
+  resolved to a project definition (a parameter, a closure variable, an
+  attribute on an object) is skipped and counted in
+  :attr:`ProjectContext.unknown_callees` rather than guessed at;
+* a **call graph with caller async-ness** for the AS6xx family: which
+  defs call which, and whether each caller is an ``async def``.
+
+Project rules receive ``(ProjectContext, FileContext)`` and report on
+the second argument's file, so findings land where the offending line
+lives and ``# noqa`` works unchanged.
+
+Like the rest of the lint subpackage this is stdlib-only tool code: it
+never imports JAX — tracing wrappers are recognised textually via the
+same dotted-origin table the JX rules use.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from dataclasses import dataclass
+from functools import cached_property
+from typing import Iterable, Optional
+
+from bayesian_consensus_engine_tpu.lint import config
+from bayesian_consensus_engine_tpu.lint.rules_jax import (
+    _is_tracing_wrapper,
+    _jitted_defs,
+    _wrapped_fn_name,
+)
+
+#: Call-chain depth bound for the traced-set walk. Deep enough for any
+#: real dispatch chain in this repo (entry → loop math → phase helpers
+#: is depth 3); bounded so a pathological cycle of mutual recursion
+#: cannot spin the linter.
+MAX_TRACE_DEPTH = 12
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: Builtin names that look like unresolved callees but are not project
+#: functions — they never count toward ``unknown_callees``.
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+
+def _display(rel: str, name: str) -> str:
+    """Human form of one trace-chain element: ``parallel/sharded.py:f``."""
+    short = rel
+    prefix = config.PACKAGE + "/"
+    if short.startswith(prefix):
+        short = short[len(prefix):]
+    return f"{short}:{name}"
+
+
+@dataclass(frozen=True)
+class TracedFunction:
+    """One member of the traced set, with the chain that put it there."""
+
+    rel: str
+    name: str
+    node: ast.AST  # the def node inside the owning file's tree
+    #: display chain from the jit-wrap site down to this function, e.g.
+    #: ``("parallel/sharded.py:build_loop", "ops/cycle_math.py:read_phase")``.
+    chain: tuple[str, ...]
+
+    def chain_text(self) -> str:
+        return " → ".join(self.chain)
+
+
+def module_name_of(rel: str) -> Optional[str]:
+    """Dotted module name for a repo-relative ``*.py`` path.
+
+    ``pkg/ops/cycle_math.py`` → ``pkg.ops.cycle_math``;
+    ``pkg/lint/__init__.py`` → ``pkg.lint``. Non-``.py`` paths → None.
+    """
+    if not rel.endswith(".py"):
+        return None
+    parts = rel[:-3].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    if not parts:
+        return None
+    return ".".join(parts)
+
+
+class ProjectContext:
+    """Whole-program index over one gate set, computed once per run."""
+
+    def __init__(self, contexts: Iterable):
+        #: file key → FileContext for every parseable file in the gate
+        #: set. The key is the repo-relative path when there is one, the
+        #: display path otherwise — out-of-repo files still get local
+        #: trace analysis, they just can't be imported by dotted name.
+        self.files = {}
+        for c in contexts:
+            key = c.rel if c.rel is not None else c.path
+            if key is not None:
+                self.files[key] = c
+        #: dotted module name → file key (repo-relative files only).
+        self.modules: dict[str, str] = {}
+        for key, c in self.files.items():
+            if c.rel is not None:
+                mod = module_name_of(c.rel)
+                if mod is not None:
+                    self.modules[mod] = key
+        # Per-file indexes, all built in one pass per file.
+        self._top_defs: dict[str, dict[str, ast.AST]] = {}
+        self._local_defs: dict[str, dict[str, ast.AST]] = {}
+        self._async_names: dict[str, set[str]] = {}
+        self._imports: dict[str, dict[str, str]] = {}
+        for rel, ctx in self.files.items():
+            top: dict[str, ast.AST] = {}
+            for node in ctx.tree.body:
+                if isinstance(node, _DEFS):
+                    top.setdefault(node.name, node)
+            local: dict[str, ast.AST] = {}
+            async_names: set[str] = set()
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, _DEFS):
+                    local.setdefault(node.name, node)
+                    if isinstance(node, ast.AsyncFunctionDef):
+                        async_names.add(node.name)
+            self._top_defs[rel] = top
+            self._local_defs[rel] = local
+            self._async_names[rel] = async_names
+            self._imports[rel] = self._absolute_imports(rel, ctx)
+        #: callees the traced walk could not resolve to a project def —
+        #: the honest measure of how conservative the pass had to be.
+        self.unknown_callees = 0
+        #: (rel, name) → TracedFunction for the whole gate set.
+        self.traced: dict[tuple[str, str], TracedFunction] = {}
+        self._build_traced_set()
+
+    # -- import / name resolution --------------------------------------------
+
+    def _absolute_imports(self, rel: str, ctx) -> dict[str, str]:
+        """Local name → absolute dotted origin (relative levels resolved)."""
+        mod = module_name_of(rel) or ""
+        # Containing package: for pkg/sub/mod.py the anchor is pkg.sub;
+        # for pkg/sub/__init__.py the module IS the package.
+        pkg_parts = mod.split(".") if mod else []
+        if not rel.endswith("/__init__.py") and pkg_parts:
+            pkg_parts = pkg_parts[:-1]
+        out: dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    bound = (a.asname or a.name).split(".")[0]
+                    out[bound] = a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    anchor = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    base = ".".join(anchor + ([base] if base else []))
+                if not base:
+                    continue
+                for a in node.names:
+                    if a.name != "*":
+                        out[a.asname or a.name] = f"{base}.{a.name}"
+        return out
+
+    def dotted_origin(self, rel: str, node: ast.AST) -> Optional[str]:
+        """Absolute dotted origin of a name/attribute chain in *rel*."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self._imports.get(rel, {}).get(node.id, node.id)
+        return ".".join([root, *reversed(parts)])
+
+    def resolve_function(
+        self, dotted: Optional[str], _depth: int = 0
+    ) -> Optional[tuple[str, str]]:
+        """Resolve a dotted origin to a project (rel, def-name), or None.
+
+        Follows re-export chains: ``pkg.parallel.sharded.read_phase``
+        resolves through sharded's ``from …cycle_math import read_phase``
+        to ``(pkg/ops/cycle_math.py, read_phase)``. Bounded so an import
+        cycle cannot loop.
+        """
+        if dotted is None or _depth > 8:
+            return None
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            rel = self.modules.get(mod)
+            if rel is None:
+                continue
+            if i != len(parts) - 1:
+                return None  # attribute chain into a module (Class.method…)
+            name = parts[-1]
+            if name in self._top_defs[rel]:
+                return (rel, name)
+            alias = self._imports[rel].get(name)
+            if alias is not None and alias != dotted:
+                return self.resolve_function(alias, _depth + 1)
+            return None
+        return None
+
+    def function_def(self, rel: str, name: str) -> Optional[ast.AST]:
+        return self._local_defs.get(rel, {}).get(name)
+
+    def is_async_def(self, rel: str, name: str) -> bool:
+        return name in self._async_names.get(rel, set())
+
+    # -- callee extraction ----------------------------------------------------
+
+    def _resolve_callee(
+        self, rel: str, node: ast.AST
+    ) -> tuple[Optional[tuple[str, str]], bool]:
+        """(resolved project (rel, name) or None, counts-as-unknown)."""
+        if isinstance(node, ast.Name):
+            if node.id in self._local_defs[rel]:
+                return (rel, node.id), False
+            origin = self._imports[rel].get(node.id)
+            if origin is not None:
+                hit = self.resolve_function(origin)
+                return hit, hit is None
+            # A bare name bound to neither a def nor an import: a local
+            # variable holding a callable — unresolvable, and exactly the
+            # conservative gap worth counting (builtins excluded).
+            return None, node.id not in _BUILTIN_NAMES
+        if isinstance(node, ast.Attribute):
+            dotted = self.dotted_origin(rel, node)
+            hit = self.resolve_function(dotted)
+            # Attribute chains into non-project modules (jnp.dot, …) are
+            # known-external, not unknown.
+            return hit, False
+        return None, False
+
+    def _callees_of(self, rel: str, fn: ast.AST):
+        """Project defs referenced from *fn*'s body (nested defs included).
+
+        Two reference shapes count: a direct call, and a function name
+        passed as an argument to a call (``jax.lax.fori_loop(0, n, body,
+        x)`` traces ``body`` exactly as a call would).
+        """
+        seen: set[tuple[str, str]] = set()
+        for stmt in fn.body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit, unknown = self._resolve_callee(rel, node.func)
+                if unknown:
+                    self.unknown_callees += 1
+                if hit is not None and hit not in seen:
+                    seen.add(hit)
+                    yield hit
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        ahit, _ = self._resolve_callee(rel, arg)
+                        if ahit is not None and ahit not in seen:
+                            seen.add(ahit)
+                            yield ahit
+
+    # -- traced set -----------------------------------------------------------
+
+    def _entry_points(self):
+        """Yield (rel, name, wrap-site display) for every jit entry."""
+        for rel in sorted(self.files):
+            ctx = self.files[rel]
+            # (a) defs this file jit-wraps itself (decorators + wrapper
+            # calls naming a local def) — rules_jax's own detector.
+            for fn in _jitted_defs(ctx):
+                yield rel, fn.name, _display(rel, fn.name)
+            # (b) wrapper calls naming an IMPORTED function: the wrap
+            # site lives here, the entry def lives in another module.
+            enclosing = self._enclosing_names(ctx.tree)
+            for node in ast.walk(ctx.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and _is_tracing_wrapper(ctx, node.func)
+                    and node.args
+                ):
+                    continue
+                name = _wrapped_fn_name(node.args[0])
+                if name is None or name in self._local_defs[rel]:
+                    continue
+                origin = self._imports[rel].get(name)
+                hit = self.resolve_function(origin)
+                if hit is not None:
+                    site = enclosing.get(id(node), "<module>")
+                    yield hit[0], hit[1], _display(rel, site)
+
+    @staticmethod
+    def _enclosing_names(tree: ast.AST) -> dict[int, str]:
+        """id(node) → name of the nearest enclosing function def."""
+        out: dict[int, str] = {}
+
+        def visit(node: ast.AST, owner: str):
+            for child in ast.iter_child_nodes(node):
+                name = child.name if isinstance(child, _DEFS) else owner
+                out[id(child)] = name
+                visit(child, name)
+
+        visit(tree, "<module>")
+        return out
+
+    def _build_traced_set(self):
+        queue: list[tuple[str, str, tuple[str, ...]]] = []
+        for rel, name, site in self._entry_points():
+            fn = self._local_defs.get(rel, {}).get(name)
+            if fn is None:
+                continue
+            elem = _display(rel, name)
+            chain = (site,) if site == elem else (site, elem)
+            queue.append((rel, name, chain))
+        # Breadth-first so the recorded chain is a shortest one — the
+        # most readable explanation of why a function is traced.
+        head = 0
+        while head < len(queue):
+            rel, name, chain = queue[head]
+            head += 1
+            key = (rel, name)
+            if key in self.traced:
+                continue
+            fn = self._local_defs[rel].get(name)
+            if fn is None:
+                continue
+            self.traced[key] = TracedFunction(rel, name, fn, chain)
+            if len(chain) >= MAX_TRACE_DEPTH:
+                continue
+            for crel, cname in self._callees_of(rel, fn):
+                if (crel, cname) not in self.traced:
+                    queue.append(
+                        (crel, cname, chain + (_display(crel, cname),))
+                    )
+
+    def traced_in(self, rel: Optional[str]) -> list[TracedFunction]:
+        """Traced-set members defined in *rel*, in source order."""
+        if rel is None:
+            return []
+        out = [tf for (r, _), tf in self.traced.items() if r == rel]
+        out.sort(key=lambda tf: (tf.node.lineno, tf.name))
+        return out
+
+    # -- stats ----------------------------------------------------------------
+
+    @property
+    def stats(self) -> dict[str, int]:
+        """The project-tier stats line's raw numbers (JSON-stable)."""
+        return {
+            "traced_functions": len(self.traced),
+            "traced_modules": len({r for r, _ in self.traced}),
+            "unknown_callees": self.unknown_callees,
+            "files": len(self.files),
+        }
+
+    # -- call graph (AS6xx) ---------------------------------------------------
+
+    @cached_property
+    def callers(self) -> dict[tuple[str, str], set[tuple[str, str, bool]]]:
+        """(rel, def-name) → {(caller_rel, caller_name, caller_is_async)}.
+
+        Built on first use (only the AS6xx family needs it). A caller is
+        the nearest enclosing def of a *direct* call — a function merely
+        passed as an argument (``executor.submit(self._work)``) is not
+        "called" by the submitting scope, which is exactly the semantics
+        AS601 needs: handed to an executor means NOT on the event loop.
+        ``self.method()`` resolves within the same file.
+        """
+        out: dict[tuple[str, str], set[tuple[str, str, bool]]] = {}
+        for rel, ctx in self.files.items():
+            # Exhaustive def list (same-named methods each scanned).
+            defs = [
+                n for n in ast.walk(ctx.tree) if isinstance(n, _DEFS)
+            ]
+            for fn in defs:
+                is_async = isinstance(fn, ast.AsyncFunctionDef)
+                for node in self._direct_body(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    hit = self._call_target(rel, node.func)
+                    if hit is not None:
+                        out.setdefault(hit, set()).add(
+                            (rel, fn.name, is_async)
+                        )
+        return out
+
+    def _call_target(
+        self, rel: str, func: ast.AST
+    ) -> Optional[tuple[str, str]]:
+        """Project def a call expression targets (incl. ``self.m()``)."""
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and func.attr in self._local_defs.get(rel, {})
+        ):
+            return (rel, func.attr)
+        hit, _ = self._resolve_callee(rel, func)
+        return hit
+
+    @staticmethod
+    def _direct_body(fn: ast.AST):
+        """Walk a def's body WITHOUT descending into nested defs."""
+        stack = [
+            n for n in fn.body if not isinstance(n, _DEFS)
+        ]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, _DEFS):
+                    stack.append(child)

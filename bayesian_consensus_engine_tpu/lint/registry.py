@@ -6,6 +6,14 @@ engine iterates ``RULES`` and applies each rule whose ``scope`` accepts
 the file's repo-relative path. Rules never format paths or handle
 ``# noqa`` — the engine owns both, so every rule gets suppression and
 output formatting for free.
+
+Two rule kinds share the table. ``kind="file"`` rules (the default) see
+one :class:`~bayesian_consensus_engine_tpu.lint.engine.FileContext`.
+``kind="project"`` rules — registered with :func:`project_rule` — see
+``(ProjectContext, FileContext)``: the whole-program index (module
+graph, cross-file function index, jit traced set) plus the file under
+report. Both yield the same ``(lineno, message)`` pairs and get the same
+suppression/severity/output machinery.
 """
 
 from __future__ import annotations
@@ -20,6 +28,11 @@ from typing import Callable, Iterable, Optional
 SEVERITIES = ("error", "warning")
 
 
+#: The two rule kinds. ``file`` checks receive ``(ctx)``; ``project``
+#: checks receive ``(pctx, ctx)`` — whole-program context first.
+KINDS = ("file", "project")
+
+
 @dataclass(frozen=True)
 class Rule:
     id: str
@@ -27,6 +40,8 @@ class Rule:
     severity: str  # one of SEVERITIES
     rationale: str
     check: Callable  # check(ctx) -> Iterable[tuple[int, str]]
+    #: one of KINDS; decides the check call signature.
+    kind: str = "file"
     #: rel-path predicate; None means "every checked file".
     scope: Optional[Callable[[Optional[str]], bool]] = None
     tags: tuple[str, ...] = field(default=())
@@ -46,6 +61,7 @@ def rule(
     severity: str = "error",
     scope: Optional[Callable[[Optional[str]], bool]] = None,
     tags: Iterable[str] = (),
+    kind: str = "file",
 ):
     """Register ``check(ctx)`` under *rule_id*; returns the function."""
 
@@ -57,15 +73,47 @@ def rule(
                 f"rule {rule_id!r}: severity must be one of {SEVERITIES}, "
                 f"got {severity!r}"
             )
+        if kind not in KINDS:
+            raise ValueError(
+                f"rule {rule_id!r}: kind must be one of {KINDS}, got {kind!r}"
+            )
         RULES[rule_id] = Rule(
             id=rule_id,
             name=name,
             severity=severity,
             rationale=rationale,
             check=fn,
+            kind=kind,
             scope=scope,
             tags=tuple(tags),
         )
         return fn
 
     return deco
+
+
+def project_rule(
+    rule_id: str,
+    name: str,
+    rationale: str,
+    severity: str = "error",
+    scope: Optional[Callable[[Optional[str]], bool]] = None,
+    tags: Iterable[str] = (),
+):
+    """Register ``check(pctx, ctx)`` under *rule_id* (whole-program kind).
+
+    Project rules still report per file: the engine calls the check once
+    per checked file whose rel-path the ``scope`` accepts, passing the
+    shared :class:`~bayesian_consensus_engine_tpu.lint.project.ProjectContext`
+    first. Findings land on the file under report, so ``# noqa`` on the
+    offending line suppresses exactly like a file rule.
+    """
+    return rule(
+        rule_id,
+        name=name,
+        rationale=rationale,
+        severity=severity,
+        scope=scope,
+        tags=tags,
+        kind="project",
+    )

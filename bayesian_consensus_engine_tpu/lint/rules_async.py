@@ -1,0 +1,259 @@
+"""AS6xx — async-safety rules for the request tier (serve/, net/, obs).
+
+The coalescer contract ("one event loop, deterministic admitted order")
+and the socket front door's fairness guarantees both die quietly when
+something blocks the loop: every connection stalls behind one request,
+timeouts fire in bursts, and the admitted-trace ordering the replay
+tests pin stops being a function of arrival order. None of that raises
+— it shows up as tail latency in a soak run. These rules catch the
+three shapes statically:
+
+* **AS601** — a blocking call (``time.sleep``, subprocess, blocking
+  socket/url op, ``Thread.join``) inside an ``async def``; or, via the
+  project call graph, inside a sync helper that only ``async def``s
+  call — the indirection that hides the stall from a per-file reader.
+* **AS602** — calling an ``async def`` and discarding the coroutine:
+  the body never runs, the reply is never sent (the dropped-reply bug
+  class). Resolution goes through the project function index, so an
+  imported coroutine function is recognised across modules.
+* **AS603** — holding a ``threading.Lock`` across an ``await``: the
+  lock is held while the loop runs other tasks; any of them touching
+  the same lock deadlocks the loop from inside.
+
+Scoped to :data:`config.ASYNC_TIER_PREFIXES`. AS601/602 are project
+rules (they need the cross-file call graph / function index); AS603 is
+a plain file rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from functools import partial
+
+from bayesian_consensus_engine_tpu.lint import config
+from bayesian_consensus_engine_tpu.lint.registry import project_rule, rule
+
+_async_tier = partial(config.matches, prefixes=config.ASYNC_TIER_PREFIXES)
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: Dotted origins (alias-resolved) that block the calling thread. Each
+#: entry is a call that has no business on an event loop.
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep() stalls the loop; use asyncio.sleep",
+    "os.system": "os.system() blocks on a subprocess",
+    "subprocess.run": "subprocess.run() blocks until the child exits",
+    "subprocess.call": "subprocess.call() blocks until the child exits",
+    "subprocess.check_call": "subprocess.check_call() blocks on the child",
+    "subprocess.check_output": "subprocess.check_output() blocks on the child",
+    "subprocess.getoutput": "subprocess.getoutput() blocks on the child",
+    "socket.create_connection": (
+        "socket.create_connection() is a blocking connect; use the loop's "
+        "sock_connect/open_connection"
+    ),
+    "socket.getaddrinfo": (
+        "socket.getaddrinfo() is a blocking DNS lookup; use "
+        "loop.getaddrinfo"
+    ),
+    "urllib.request.urlopen": (
+        "urllib.request.urlopen() is a blocking HTTP round-trip"
+    ),
+}
+
+
+def _scope_body(fn):
+    """Walk a def's own statements without entering nested defs —
+    a nested def's body runs when *it* is called, not here."""
+    stack = [n for n in fn.body if not isinstance(n, _DEFS)]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, _DEFS):
+                stack.append(child)
+
+
+def _thread_locals(fn) -> set[str]:
+    """Names bound to ``threading.Thread(...)`` in this def's scope."""
+    names: set[str] = set()
+    for node in _scope_body(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            attr_chain = (
+                isinstance(f, ast.Attribute) and f.attr == "Thread"
+            ) or (isinstance(f, ast.Name) and f.id == "Thread")
+            if attr_chain:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _blocking_calls_in(ctx, fn):
+    """Yield (lineno, why) for blocking calls in *fn*'s own scope."""
+    threads = _thread_locals(fn)
+    for node in _scope_body(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.dotted(node.func)
+        why = _BLOCKING_CALLS.get(dotted)
+        if why is not None:
+            yield node.lineno, why
+            continue
+        # <thread>.join() — blocks until another thread finishes.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in threads
+        ):
+            yield node.lineno, "Thread.join() blocks the loop on a thread"
+
+
+@project_rule(
+    "AS601",
+    name="blocking-call-in-event-loop",
+    rationale=(
+        "a blocking call (time.sleep, subprocess, blocking socket op, "
+        "Thread.join) inside an async def — or inside a sync helper "
+        "only async defs call — stalls every connection behind one "
+        "request; hand it to an executor or use the async equivalent"
+    ),
+    scope=_async_tier,
+)
+def check_blocking_in_event_loop(pctx, ctx):
+    all_defs = [n for n in ast.walk(ctx.tree) if isinstance(n, _DEFS)]
+    for fn in all_defs:
+        if isinstance(fn, ast.AsyncFunctionDef):
+            for lineno, why in _blocking_calls_in(ctx, fn):
+                yield lineno, f"{why} (inside `async def {fn.name}`)"
+        else:
+            # The indirect form: a sync helper whose only direct callers
+            # are async defs runs on the loop just the same. A helper
+            # with any sync caller (or none the call graph can see) is
+            # left alone — executor-submitted work arrives as an
+            # argument, not a call, so it never counts as a caller.
+            callers = pctx.callers.get((ctx.rel, fn.name), set())
+            if not callers or not all(is_a for _, _, is_a in callers):
+                continue
+            names = ", ".join(
+                sorted(f"{r}:{n}" for r, n, _ in callers)
+            )
+            for lineno, why in _blocking_calls_in(ctx, fn):
+                yield (
+                    lineno,
+                    f"{why} (sync helper `{fn.name}` is reachable only "
+                    f"from async defs: {names})",
+                )
+
+
+@project_rule(
+    "AS602",
+    name="unawaited-coroutine",
+    rationale=(
+        "calling an async def and discarding the result never runs the "
+        "body — the frame is never sent (the classic dropped-reply "
+        "bug); await it, or hand it to create_task/ensure_future"
+    ),
+    scope=_async_tier,
+)
+def check_unawaited_coroutine(pctx, ctx):
+    """Statement-level ``f()`` where ``f`` resolves to an ``async def``.
+
+    Only the bare-expression-statement shape is a finding: an assigned,
+    awaited, gathered or task-wrapped coroutine all consume the object.
+    Resolution covers local defs, ``self.method()``, and names imported
+    from project modules (through the re-export-aware index).
+    """
+    local_async = {
+        n.name
+        for n in ast.walk(ctx.tree)
+        if isinstance(n, ast.AsyncFunctionDef)
+    }
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+        ):
+            continue
+        func = node.value.func
+        target = None
+        if isinstance(func, ast.Name):
+            if func.id in local_async:
+                target = func.id
+            else:
+                hit = pctx.resolve_function(
+                    pctx.dotted_origin(ctx.rel, func)
+                )
+                if hit is not None and pctx.is_async_def(*hit):
+                    target = func.id
+        elif (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and func.attr in local_async
+        ):
+            target = f"self.{func.attr}"
+        if target is not None:
+            yield (
+                node.lineno,
+                f"`{target}(...)` is an async def called without await — "
+                "the coroutine is discarded and its body never runs",
+            )
+
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock")
+
+
+@rule(
+    "AS603",
+    name="lock-across-await",
+    rationale=(
+        "holding a threading.Lock across an await keeps it locked while "
+        "the loop runs other tasks; any of them taking the same lock "
+        "deadlocks the loop (use asyncio.Lock, or release before "
+        "awaiting)"
+    ),
+    scope=_async_tier,
+)
+def check_lock_across_await(ctx):
+    # Names/attributes bound to a threading lock anywhere in the file —
+    # ``self._lock = threading.Lock()`` in __init__ is the usual shape.
+    lock_names: set[str] = set()
+    lock_attrs: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if ctx.dotted(node.value.func) in _LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lock_names.add(t.id)
+                    elif isinstance(t, ast.Attribute) and isinstance(
+                        t.value, ast.Name
+                    ):
+                        lock_attrs.add(t.attr)
+    if not lock_names and not lock_attrs:
+        return
+
+    def is_lock(expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in lock_names
+        if isinstance(expr, ast.Attribute):
+            return expr.attr in lock_attrs
+        return False
+
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in _scope_body(fn):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(is_lock(item.context_expr) for item in node.items):
+                continue
+            if any(
+                isinstance(inner, ast.Await) for inner in ast.walk(node)
+            ):
+                yield (
+                    node.lineno,
+                    "threading lock held across an await (the loop keeps "
+                    "running other tasks while the lock is held — "
+                    "deadlock hazard; use asyncio.Lock)",
+                )

@@ -15,7 +15,7 @@ import ast
 from functools import partial
 
 from bayesian_consensus_engine_tpu.lint import config
-from bayesian_consensus_engine_tpu.lint.registry import rule
+from bayesian_consensus_engine_tpu.lint.registry import project_rule, rule
 
 _hot = partial(config.matches, prefixes=config.HOT_PATH_PREFIXES)
 _kernel = partial(config.matches, prefixes=config.KERNEL_PREFIXES)
@@ -129,6 +129,53 @@ def check_item_call(ctx):
             yield node.lineno, "`.item()` forces a host sync in a hot path"
 
 
+# -- the three traced-body detectors -----------------------------------------
+#
+# Shared by the per-file rules (JX102/103/104, which walk the bodies a
+# file jit-wraps itself) and the whole-program rule (JX110, which walks
+# any traced-set member wherever the wrap happened). One detector each,
+# so the two tiers can never drift apart on what counts as a hazard.
+
+
+def _scalar_cast_violation(ctx, node):
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in ("float", "int")
+        and node.args
+        and not isinstance(node.args[0], ast.Constant)
+    ):
+        return (
+            f"`{node.func.id}()` on a non-literal inside a jitted "
+            "function (host sync / trace abort hazard)"
+        )
+    return None
+
+
+def _asarray_violation(ctx, node):
+    if isinstance(node, ast.Call):
+        dotted = ctx.dotted(node.func)
+        if dotted in ("numpy.asarray", "numpy.array", "numpy.asanyarray"):
+            return (
+                f"`{dotted}` inside a jitted function (host "
+                "materialisation hazard; use jnp)"
+            )
+    return None
+
+
+def _print_violation(ctx, node):
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "print"
+    ):
+        return (
+            "`print()` inside a jitted function (trace-time only; "
+            "use jax.debug.print)"
+        )
+    return None
+
+
 @rule(
     "JX102",
     name="scalar-cast-in-jit",
@@ -140,18 +187,9 @@ def check_item_call(ctx):
 )
 def check_scalar_cast_in_jit(ctx):
     for node in _walk_jitted_bodies(ctx):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id in ("float", "int")
-            and node.args
-            and not isinstance(node.args[0], ast.Constant)
-        ):
-            yield (
-                node.lineno,
-                f"`{node.func.id}()` on a non-literal inside a jitted "
-                "function (host sync / trace abort hazard)",
-            )
+        msg = _scalar_cast_violation(ctx, node)
+        if msg is not None:
+            yield node.lineno, msg
 
 
 @rule(
@@ -166,14 +204,9 @@ def check_scalar_cast_in_jit(ctx):
 )
 def check_np_asarray_in_jit(ctx):
     for node in _walk_jitted_bodies(ctx):
-        if isinstance(node, ast.Call):
-            dotted = ctx.dotted(node.func)
-            if dotted in ("numpy.asarray", "numpy.array", "numpy.asanyarray"):
-                yield (
-                    node.lineno,
-                    f"`{dotted}` inside a jitted function (host "
-                    "materialisation hazard; use jnp)",
-                )
+        msg = _asarray_violation(ctx, node)
+        if msg is not None:
+            yield node.lineno, msg
 
 
 @rule(
@@ -187,16 +220,54 @@ def check_np_asarray_in_jit(ctx):
 )
 def check_print_in_jit(ctx):
     for node in _walk_jitted_bodies(ctx):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-        ):
-            yield (
-                node.lineno,
-                "`print()` inside a jitted function (trace-time only; "
-                "use jax.debug.print)",
-            )
+        msg = _print_violation(ctx, node)
+        if msg is not None:
+            yield node.lineno, msg
+
+
+@project_rule(
+    "JX110",
+    name="traced-helper-boundary",
+    rationale=(
+        "JX102/103/104 applied across module boundaries: a helper that "
+        "another file jit/shard_map/pallas-wraps (directly or through a "
+        "re-export) runs under tracing exactly like a local jitted body, "
+        "so the same scalar-cast/np.asarray/print hazards apply — the "
+        "finding names the trace chain so the reviewer sees why"
+    ),
+    scope=_hot,
+)
+def check_traced_helper_boundary(pctx, ctx):
+    """Traced-set members in this file that no local wrap covers.
+
+    Functions the file jit-wraps itself are already walked by the
+    per-file rules — JX110 only reports the remainder, so a violation is
+    flagged exactly once, by exactly one tier.
+    """
+    locally_covered = {id(fn) for fn in _jitted_defs(ctx)}
+    members = pctx.traced_in(ctx.rel)
+    # A nested def that is a traced member in its own right reports under
+    # its own chain — skip its subtree when walking the enclosing body so
+    # one hazard never yields two chains for the same line.
+    own_nodes = {id(tf.node) for tf in members}
+    for tf in members:
+        if id(tf.node) in locally_covered:
+            continue
+        suffix = f" [traced via {tf.chain_text()}]"
+        stack = list(tf.node.body)
+        while stack:
+            node = stack.pop()
+            if id(node) in own_nodes:
+                continue
+            for detect in (
+                _scalar_cast_violation,
+                _asarray_violation,
+                _print_violation,
+            ):
+                msg = detect(ctx, node)
+                if msg is not None:
+                    yield node.lineno, msg + suffix
+            stack.extend(ast.iter_child_nodes(node))
 
 
 @rule(

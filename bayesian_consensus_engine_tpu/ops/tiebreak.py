@@ -377,13 +377,18 @@ def ring_tiebreak_math(
     weight = weight.astype(f32)
     conf = conf.astype(f32)
     rel = rel.astype(f32)
-    scale = float(10**precision)
+    # Same idiom as batched_tiebreak: 10.0**p is exact for p ≤ 22, and
+    # spelling it without float() keeps the static-knob computation
+    # visibly cast-free under the cross-module jit rules (JX110).
+    scale = 10.0**precision
     NEG = f32(-jnp.inf)
     SENT = jnp.int32(_SENTINEL)
 
     agents_axis = (pred.ndim - 1) if agents_last else 0
     a_loc = pred.shape[agents_axis]
-    chunk = a_loc if chunk_agents is None else max(1, min(int(chunk_agents), a_loc))
+    # chunk_agents is a static Python knob closed over by the compile
+    # wrappers, never a traced value — the int() runs at trace time.
+    chunk = a_loc if chunk_agents is None else max(1, min(int(chunk_agents), a_loc))  # noqa: JX110  # static knob
     n_full, tail = divmod(a_loc, chunk)
 
     keys = jnp.where(

@@ -114,7 +114,9 @@ def resolve_chunk_slots(chunk_slots: "int | None", width: int) -> int:
     padded = _pow2_at_least(max(width, 1))
     if chunk_slots is None:
         return padded
-    return min(_pow2_at_most(max(int(chunk_slots), 1)), padded)
+    # chunk_slots is a static Python knob (config resolution happens at
+    # trace time when a kernel calls this); the int() never sees a tracer.
+    return min(_pow2_at_most(max(int(chunk_slots), 1)), padded)  # noqa: JX110  # static knob
 
 
 def _tree_sum(x: Array, axis: int) -> Array:

@@ -12,11 +12,18 @@ stable:
                                      set), print findings, exit 1 on any
 
 Rule catalog (F401/F541/F811/F821/F841/E711/E712/E722 plus JX1xx/DT2xx/
-LY3xx/SH4xx/PL5xx): docs/static-analysis.md. The round-16 LY303
+LY3xx/SH4xx/PL5xx/AS6xx): docs/static-analysis.md. The round-16 LY303
 extension rides through here too: ``obs`` modules are held stdlib-only
 and the obs READ surface (``obs.export``/``obs.fleet``/``obs.health``)
 is import-confined to ``serve``/``cli`` — write-only obs, gated in CI by
 this shim like every other rule. ``# noqa`` / ``# noqa: ID`` suppress.
+
+``main`` runs the engine's whole-program tier too: ``run()`` builds a
+ProjectContext over the full gate set, so the cross-module rules (JX110
+traced-helper-boundary, the AS6xx async-safety family) gate through
+this shim exactly like the per-file families. ``check_file`` stays a
+single-file probe — project rules see a one-file project there, which
+is what the per-rule fixtures want.
 """
 
 from __future__ import annotations

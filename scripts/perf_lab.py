@@ -230,7 +230,9 @@ def main(argv=None):
     )
     args = parser.parse_args(argv)
     # Same contract as bench.py: lab numbers from a lint-dirty tree are
-    # not comparable to the adjudicated baselines.
+    # not comparable to the adjudicated baselines. The gate rides
+    # bench's lint cache sidecar, so between-experiment re-checks of an
+    # unchanged tree are a stat pass, not a 150-file re-parse.
     bench.lint_gate(args.no_lint)
     ledger = None
     if args.ledger:

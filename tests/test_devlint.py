@@ -117,3 +117,336 @@ class TestWholeRepoClean:
     def test_repo_passes_devlint(self):
         # The gate the CI fallback step runs; keep it green.
         assert devlint.main([]) == 0
+
+
+# -- whole-program tier: multi-file fixture matrix ----------------------------
+#
+# check_source(project=…) builds a synthetic multi-file gate set, so the
+# cross-module rules can be pinned without writing files into the repo.
+
+from bayesian_consensus_engine_tpu import lint  # noqa: E402
+from bayesian_consensus_engine_tpu.lint import config as lint_config  # noqa: E402
+
+PKG = lint_config.PACKAGE
+
+
+def _ids(src, rel, project=None, select=None):
+    return [
+        f.rule_id
+        for f in lint.check_source(src, rel, project=project, select=select)
+    ]
+
+
+class TestJX110Matrix:
+    """The jit wrap and the offending helper live in different modules."""
+
+    _WRAP = (
+        f"import jax\nfrom {PKG}.ops.helper import helper\n\n"
+        "def build():\n    return jax.jit(helper)\n"
+    )
+
+    def test_helper_one_module_away(self):
+        helper = "import numpy as np\n\ndef helper(x):\n    return np.asarray(x)\n"
+        findings = lint.check_source(
+            helper,
+            f"{PKG}/ops/helper.py",
+            project={f"{PKG}/parallel/wrap.py": self._WRAP},
+        )
+        assert [f.rule_id for f in findings] == ["JX110"]
+        # The finding names the trace chain: wrap site first, helper last.
+        assert "parallel/wrap.py:build" in findings[0].message
+        assert "ops/helper.py:helper" in findings[0].message
+
+    def test_helper_two_modules_away(self):
+        deep = "import numpy as np\n\ndef inner(x):\n    return np.asarray(x)\n"
+        mid = (
+            f"from {PKG}.ops.deep import inner\n\n"
+            "def mid(x):\n    return inner(x)\n"
+        )
+        wrap = (
+            f"import jax\nfrom {PKG}.ops.mid import mid\n\n"
+            "def build():\n    return jax.jit(mid)\n"
+        )
+        findings = lint.check_source(
+            deep,
+            f"{PKG}/ops/deep.py",
+            project={
+                f"{PKG}/ops/mid.py": mid,
+                f"{PKG}/parallel/wrap.py": wrap,
+            },
+        )
+        assert [f.rule_id for f in findings] == ["JX110"]
+        # Full chain: wrap → mid → inner.
+        assert "parallel/wrap.py:build" in findings[0].message
+        assert "ops/mid.py:mid" in findings[0].message
+        assert "ops/deep.py:inner" in findings[0].message
+
+    def test_reexported_name_resolves(self):
+        # sharded.py's shape: the wrap imports the name from a module
+        # that merely re-exports it; the def lives one layer further.
+        impl = "def fn(x):\n    return float(x)\n"
+        reexport = (
+            f"from {PKG}.ops.impl import fn\n\n__all__ = ['fn']\n"
+        )
+        wrap = (
+            f"import jax\nfrom {PKG}.parallel.facade import fn\n\n"
+            "def build():\n    return jax.jit(fn)\n"
+        )
+        findings = lint.check_source(
+            impl,
+            f"{PKG}/ops/impl.py",
+            project={
+                f"{PKG}/parallel/facade.py": reexport,
+                f"{PKG}/parallel/wrap.py": wrap,
+            },
+        )
+        assert [f.rule_id for f in findings] == ["JX110"]
+        assert "ops/impl.py:fn" in findings[0].message
+
+    def test_noqa_at_helper_line_suppresses(self):
+        helper = (
+            "import numpy as np\n\ndef helper(x):\n"
+            "    return np.asarray(x)  # noqa: JX110\n"
+        )
+        assert _ids(
+            helper,
+            f"{PKG}/ops/helper.py",
+            project={f"{PKG}/parallel/wrap.py": self._WRAP},
+        ) == []
+
+    def test_clean_helper_is_quiet(self):
+        helper = "def helper(x):\n    return x * 2.0\n"
+        assert _ids(
+            helper,
+            f"{PKG}/ops/helper.py",
+            project={f"{PKG}/parallel/wrap.py": self._WRAP},
+        ) == []
+
+    def test_unwrapped_helper_is_quiet(self):
+        # Same hazard, but nothing traces the helper: not JX110's business.
+        helper = "import numpy as np\n\ndef helper(x):\n    return np.asarray(x)\n"
+        nowrap = f"from {PKG}.ops.helper import helper\n\nout = helper(1)\n"
+        assert _ids(
+            helper,
+            f"{PKG}/ops/helper.py",
+            project={f"{PKG}/parallel/wrap.py": nowrap},
+        ) == []
+
+
+class TestAS6xxMatrix:
+    """Async-safety shapes the per-file tier cannot see."""
+
+    _REL = f"{PKG}/serve/case.py"
+
+    def test_as601_sync_helper_reachable_only_from_async(self):
+        src = (
+            "import time\n\n"
+            "def pack():\n    time.sleep(0.5)\n\n"
+            "async def handle():\n    pack()\n"
+        )
+        findings = lint.check_source(src, self._REL, select=["AS601"])
+        assert [f.rule_id for f in findings] == ["AS601"]
+        assert "pack" in findings[0].message
+
+    def test_as601_mixed_callers_stay_quiet(self):
+        # A helper with any sync caller is legitimately blocking code.
+        src = (
+            "import time\n\n"
+            "def pack():\n    time.sleep(0.5)\n\n"
+            "def batch_entry():\n    pack()\n\n"
+            "async def handle():\n    pack()\n"
+        )
+        assert _ids(src, self._REL, select=["AS601"]) == []
+
+    def test_as601_executor_submit_is_not_a_call(self):
+        # Handing the helper to an executor is the FIX, not the bug.
+        src = (
+            "import time\nfrom concurrent.futures import ThreadPoolExecutor\n\n"
+            "def pack():\n    time.sleep(0.5)\n\n"
+            "async def handle(ex: ThreadPoolExecutor):\n"
+            "    ex.submit(pack)\n"
+        )
+        assert _ids(src, self._REL, select=["AS601"]) == []
+
+    def test_as601_thread_join_in_async_def(self):
+        src = (
+            "import threading\n\n"
+            "async def handle():\n"
+            "    t = threading.Thread(target=print)\n"
+            "    t.start()\n"
+            "    t.join()\n"
+        )
+        findings = lint.check_source(src, self._REL, select=["AS601"])
+        assert [f.rule_id for f in findings] == ["AS601"]
+
+    def test_as602_imported_coroutine_dropped(self):
+        conn = "async def send_reply(frame):\n    return frame\n"
+        src = (
+            f"from {PKG}.serve.conn import send_reply\n\n"
+            "async def handle(frame):\n    send_reply(frame)\n"
+        )
+        findings = lint.check_source(
+            src,
+            self._REL,
+            project={f"{PKG}/serve/conn.py": conn},
+            select=["AS602"],
+        )
+        assert [f.rule_id for f in findings] == ["AS602"]
+
+    def test_as602_task_wrapped_coroutine_is_quiet(self):
+        conn = "async def send_reply(frame):\n    return frame\n"
+        src = (
+            f"import asyncio\nfrom {PKG}.serve.conn import send_reply\n\n"
+            "async def handle(frame):\n"
+            "    asyncio.create_task(send_reply(frame))\n"
+        )
+        assert _ids(
+            src,
+            self._REL,
+            project={f"{PKG}/serve/conn.py": conn},
+            select=["AS602"],
+        ) == []
+
+    def test_as602_self_method_dropped(self):
+        src = (
+            "class Conn:\n"
+            "    async def _send(self):\n        return 1\n"
+            "    async def handle(self):\n        self._send()\n"
+        )
+        findings = lint.check_source(src, self._REL, select=["AS602"])
+        assert [f.rule_id for f in findings] == ["AS602"]
+
+    def test_as603_attr_lock_across_await(self):
+        src = (
+            "import asyncio\nimport threading\n\n"
+            "class Conn:\n"
+            "    def __init__(self):\n"
+            "        self._wl = threading.Lock()\n"
+            "    async def write(self, b):\n"
+            "        with self._wl:\n"
+            "            await asyncio.sleep(0)\n"
+        )
+        findings = lint.check_source(src, self._REL, select=["AS603"])
+        assert [f.rule_id for f in findings] == ["AS603"]
+
+    def test_as603_lock_without_await_is_quiet(self):
+        src = (
+            "import threading\n\n"
+            "class Conn:\n"
+            "    def __init__(self):\n"
+            "        self._wl = threading.Lock()\n"
+            "    async def write(self, b):\n"
+            "        with self._wl:\n"
+            "            return b\n"
+        )
+        assert _ids(src, self._REL, select=["AS603"]) == []
+
+    def test_scope_excludes_non_async_tier(self):
+        # The same blocking shape in ops/ is not this family's business.
+        src = "import time\n\nasync def handle():\n    time.sleep(1)\n"
+        assert _ids(src, f"{PKG}/ops/case.py", select=["AS601"]) == []
+
+
+class TestNewRulesDocumented:
+    def test_every_new_id_in_docs(self):
+        docs = (
+            pathlib.Path(__file__).resolve().parents[1]
+            / "docs" / "static-analysis.md"
+        ).read_text()
+        for rule_id in ("JX110", "AS601", "AS602", "AS603"):
+            assert rule_id in docs, f"{rule_id} missing from the catalog"
+
+
+class TestLintCache:
+    """The mtime+size sidecar: warm runs replay byte-identically and
+    measurably faster; any relevant change invalidates precisely."""
+
+    def _tree(self, tmp_path, n=24):
+        for i in range(n):
+            (tmp_path / f"m{i:02d}.py").write_text(
+                "import jax\n\n"
+                f"def helper_{i}(x):\n    return x + {i}\n\n"
+                "@jax.jit\n"
+                f"def entry_{i}(x):\n    return helper_{i}(x)\n"
+            )
+        # One seeded finding so "byte-identical" compares real output.
+        (tmp_path / "dirty.py").write_text("x = f'const'\n")
+
+    def test_warm_run_is_faster_and_byte_identical(self, tmp_path):
+        import time as _time
+
+        self._tree(tmp_path)
+        sidecar = tmp_path / "cache.json"
+
+        t0 = _time.perf_counter()
+        n_cold, cold = lint.run(["."], root=tmp_path, cache=sidecar)
+        t_cold = _time.perf_counter() - t0
+
+        warm_cache = lint.LintCache(sidecar)
+        t0 = _time.perf_counter()
+        n_warm, warm = lint.run(["."], root=tmp_path, cache=warm_cache)
+        t_warm = _time.perf_counter() - t0
+
+        assert n_warm == n_cold == 25
+        assert [f.render() for f in warm] == [f.render() for f in cold]
+        assert warm_cache.hits == 25 and warm_cache.misses == 0
+        # "Measurably faster": the warm pass is stat+JSON only — even on
+        # a loaded box it beats re-parsing 25 files by a wide margin.
+        assert t_warm < t_cold / 2, (t_warm, t_cold)
+
+    def test_touched_file_misses_and_updates(self, tmp_path):
+        self._tree(tmp_path)
+        sidecar = tmp_path / "cache.json"
+        lint.run(["."], root=tmp_path, cache=sidecar)
+
+        target = tmp_path / "m00.py"
+        target.write_text(target.read_text() + "y = f'const'\n")
+        c = lint.LintCache(sidecar)
+        _, findings = lint.run(["."], root=tmp_path, cache=c)
+        assert c.misses == 1 and c.hits == 24
+        assert any(
+            f.rule_id == "F541" and f.path.endswith("m00.py")
+            for f in findings
+        )
+
+    def test_project_findings_keyed_on_gate_digest(self, tmp_path):
+        # The correctness property that makes per-file caching safe for
+        # whole-program rules: editing the WRAP file must resurface the
+        # JX110 finding on the UNCHANGED helper file.
+        pkg_dir = tmp_path / PKG / "ops"
+        pkg_dir.mkdir(parents=True)
+        par_dir = tmp_path / PKG / "parallel"
+        par_dir.mkdir(parents=True)
+        helper = pkg_dir / "helper.py"
+        helper.write_text(
+            "import numpy as np\n\ndef helper(x):\n    return np.asarray(x)\n"
+        )
+        wrap = par_dir / "wrap.py"
+        wrap.write_text(
+            f"from {PKG}.ops.helper import helper\n\nout = helper\n"
+        )
+        sidecar = tmp_path / "cache.json"
+
+        _, before = lint.run(["."], root=tmp_path, cache=sidecar)
+        assert not any(f.rule_id == "JX110" for f in before)
+
+        wrap.write_text(
+            f"import jax\nfrom {PKG}.ops.helper import helper\n\n"
+            "out = jax.jit(helper)\n"
+        )
+        c = lint.LintCache(sidecar)
+        _, after = lint.run(["."], root=tmp_path, cache=c)
+        jx = [f for f in after if f.rule_id == "JX110"]
+        assert len(jx) == 1 and jx[0].path.endswith("helper.py")
+        # …while the helper's per-file entry still served from cache.
+        assert c.hits >= 1
+
+    def test_select_change_invalidates(self, tmp_path):
+        self._tree(tmp_path)
+        sidecar = tmp_path / "cache.json"
+        lint.run(["."], root=tmp_path, cache=sidecar, select=["F541"])
+        c = lint.LintCache(sidecar)
+        _, findings = lint.run(["."], root=tmp_path, cache=c)
+        # Different select → different header → no stale replay.
+        assert c.hits == 0
+        assert any(f.rule_id == "F541" for f in findings)
