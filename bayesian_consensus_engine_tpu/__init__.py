@@ -24,6 +24,8 @@ Layers (bottom → top), mirroring the reference's layer map but TPU-first:
              + multi-tenant per-class QoS (variance-aware shedding)
   net/       stdlib socket front door: framed wire codec, N-acceptor
              server feeding the one coalescer, blocking client
+  replay/    counterfactual replay lab: journal trace sidecars re-driven
+             under K altered configs via one vmapped settlement program
   cli        command-line surface (byte-compatible with the reference CLI)
 
 The scalar path imports no JAX; array paths import it lazily.

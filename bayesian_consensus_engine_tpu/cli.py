@@ -20,7 +20,10 @@ runs the round-17 network front door — the net/ socket server over the
 coalescing ``ConsensusService``, with repeated ``--qos`` specs
 declaring multi-tenant classes (per-class SLO/budget/policy) — printing
 a banner JSON line on bind and a per-class goodput summary on exit;
-``lint`` runs
+``replay`` re-drives a recorded journal's trace sidecar under K
+altered parameter configs through one vmapped settlement program
+(replay/ — lane 0 reproduces the recorded run byte-for-byte and can
+export its SQLite file to ``--db``); ``lint`` runs
 graftlint, the repo's JAX/determinism/layering static analysis
 (docs/static-analysis.md); ``stats`` renders an obs run ledger
 (obs/ledger.py JSONL — the min-of-N bench discipline) as per-leg bands
@@ -217,6 +220,163 @@ def _run_list_sources(args: argparse.Namespace) -> None:
     except Exception as exc:
         print(f"Error: {exc}", file=sys.stderr)
         raise SystemExit(1) from exc
+
+
+def _parse_replay_config(spec: str):
+    """``field=value[,field=value...]`` → :class:`~.replay.ReplayConfig`.
+
+    Fields are the sweep's knobs (``half_life_days``, ``decay_floor``,
+    ``base_learning_rate``, ``max_update_step``, ``band_z``,
+    ``graph_damping``, ``graph_steps``); unnamed fields keep the
+    recorded constants, so ``--configs half_life_days=20`` is "the live
+    run, but with a 20-day decay half-life".
+    """
+    from bayesian_consensus_engine_tpu.replay import ReplayConfig
+
+    kwargs: dict[str, Any] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, value = part.partition("=")
+        name = name.strip()
+        if not eq or name not in ReplayConfig._fields:
+            raise ValueError(
+                f"--configs takes field=value pairs over "
+                f"{', '.join(ReplayConfig._fields)}; got {part!r}"
+            )
+        kwargs[name] = (
+            int(value) if name == "graph_steps" else float(value)
+        )
+    return ReplayConfig(**kwargs)
+
+
+def _run_replay(args: argparse.Namespace) -> None:
+    """Counterfactual replay: re-drive a journal's trace under K configs.
+
+    Loads the trace sidecar of one recorded journal (or the merged
+    trace of several fleet band journals), then runs one
+    :func:`~.replay.replay_sweep` — every ``--configs`` spec is a lane
+    beside the always-present recorded lane 0, all lanes advancing
+    through one vmapped settlement program per batch. Prints a per-lane
+    sweep table (markets settled, Brier mean, credible band width) with
+    each lane's Brier diffed against the recorded lane, ``--against``-
+    style (``brier recorded->lane``); ``--json`` emits the machine
+    document instead. The global ``--db`` exports lane 0's rebuilt
+    state as the SQLite interchange file (refused if the target exists,
+    like ``journal-export``; ``--dry-run`` skips the write), and the
+    printed lane-0 ``digest`` is the byte-contract witness — equal to
+    the live run's store digest by construction.
+
+    ``--strict`` refuses a torn trace tail
+    (:class:`~.state.journal.TornTraceError`) instead of replaying to
+    the last joined epoch. Lanes with ``graph_steps > 0`` need the
+    market graph the relaxation runs over — that sweep is Python-API
+    only (:func:`~.replay.replay_sweep` with ``graph=``).
+    """
+    try:
+        configs = [_parse_replay_config(s) for s in (args.configs or [])]
+    except ValueError as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+    if any(config.graph_steps > 0 for config in configs):
+        print(
+            "Error: graph_steps > 0 needs a MarketGraph — use the "
+            "Python API (replay.replay_sweep(..., graph=...))",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    export_db = args.db if not args.dry_run else None
+    if (
+        export_db
+        and os.path.exists(export_db)
+        and os.path.getsize(export_db) > 0
+    ):
+        print(
+            f"Error: export target {export_db} already exists — "
+            "replay writes a fresh interchange file",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    try:
+        from bayesian_consensus_engine_tpu.replay import (
+            RECORDED_CONFIG,
+            load_cluster_trace,
+            load_trace,
+            replay_sweep,
+        )
+
+        if len(args.journals) == 1:
+            trace = load_trace(args.journals[0], strict=args.strict)
+        else:
+            trace = load_cluster_trace(args.journals, strict=args.strict)
+        result = replay_sweep(trace, configs, db_path=export_db)
+    except Exception as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        raise SystemExit(1) from exc
+
+    recorded = result.lanes[0]
+
+    def delta_fields(config) -> dict[str, Any]:
+        return {
+            field: getattr(config, field)
+            for field in RECORDED_CONFIG._fields
+            if getattr(config, field) != getattr(RECORDED_CONFIG, field)
+        }
+
+    if args.json:
+        _emit(
+            {
+                "journals": list(args.journals),
+                "batches": result.batches,
+                "digest": result.digest,
+                "resultDigest": result.result_digest,
+                "exportedTo": export_db,
+                "dryRun": args.dry_run,
+                "lanes": [
+                    {
+                        "config": dict(lane.config._asdict()),
+                        "delta": delta_fields(lane.config),
+                        "marketsSettled": lane.markets_settled,
+                        "brierMean": lane.brier_mean,
+                        "bandWidthMean": lane.band_width_mean,
+                        "graphBrierMean": lane.graph_brier_mean,
+                    }
+                    for lane in result.lanes
+                ],
+            }
+        )
+        return
+
+    def num(x: float) -> str:
+        return f"{x:.4g}" if x == x else "-"  # NaN when nothing settled
+
+    print(
+        f"{', '.join(args.journals)}: {result.batches} batches, "
+        f"{len(result.lanes)} lanes, lane-0 digest {result.digest}"
+    )
+    print(
+        f"{'lane':>4} {'settled':>8} {'brier':>9} {'band_w':>9} "
+        f"{'g_brier':>9}  config"
+    )
+    for index, lane in enumerate(result.lanes):
+        delta = delta_fields(lane.config)
+        label = (
+            "recorded"
+            if not delta
+            else ",".join(f"{k}={v:g}" for k, v in sorted(delta.items()))
+        )
+        trailer = (
+            f"  brier {num(recorded.brier_mean)}->{num(lane.brier_mean)}"
+            if index else ""
+        )
+        print(
+            f"{index:>4} {lane.markets_settled:>8} "
+            f"{num(lane.brier_mean):>9} {num(lane.band_width_mean):>9} "
+            f"{num(lane.graph_brier_mean):>9}  {label}{trailer}"
+        )
+    if export_db:
+        print(f"exported lane-0 state to {export_db}")
 
 
 def _run_stats(args: argparse.Namespace) -> None:
@@ -686,6 +846,45 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve this many seconds then drain (0 = until interrupted)",
     )
     serve.set_defaults(handler=_run_serve)
+
+    replay = sub.add_parser(
+        "replay",
+        help=(
+            "counterfactual replay: re-drive a recorded journal's trace "
+            "sidecar under K altered configs through one vmapped "
+            "settlement program (lane 0 = the recorded run, "
+            "byte-exact)"
+        ),
+    )
+    replay.add_argument(
+        "journals", nargs="+",
+        help=(
+            "journal(s) with trace sidecars (settle_stream trace=); "
+            "several paths merge as one fleet trace"
+        ),
+    )
+    replay.add_argument(
+        "--configs", action="append",
+        metavar="FIELD=VALUE[,FIELD=VALUE...]",
+        help=(
+            "one counterfactual lane (repeatable); fields: "
+            "half_life_days, decay_floor, base_learning_rate, "
+            "max_update_step, band_z, graph_damping, graph_steps — "
+            "e.g. --configs half_life_days=20,max_update_step=0.05"
+        ),
+    )
+    replay.add_argument(
+        "--strict", action="store_true",
+        help=(
+            "refuse a torn trace tail instead of replaying to the last "
+            "joined epoch"
+        ),
+    )
+    replay.add_argument(
+        "--json", action="store_true",
+        help="machine-readable sweep document instead of the table",
+    )
+    replay.set_defaults(handler=_run_replay)
 
     stats = sub.add_parser(
         "stats",

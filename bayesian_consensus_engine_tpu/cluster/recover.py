@@ -42,8 +42,11 @@ import numpy as np
 from bayesian_consensus_engine_tpu.obs.trace import active_tracer
 from bayesian_consensus_engine_tpu.state.journal import (
     MAGIC,
+    TornTraceError,
+    TraceBatch,
     _iter_frames,
     _read_exact,
+    extract_trace,
 )
 
 #: Trace scope for live-recovery spans (obs/trace.py). Recovery runs
@@ -202,6 +205,83 @@ def adopt_journal(store, path: Union[str, Path]) -> Tuple[Optional[int], int]:
             component="recovery",
         )
     return tag, rows_adopted
+
+
+def extract_cluster_trace(
+    paths: Sequence[Union[str, Path]],
+    strict: bool = False,
+) -> Tuple[List[TraceBatch], Tuple[Optional[int], ...]]:
+    """Merge N band journals' trace sidecars into ONE replayable workload.
+
+    The fleet-journal half of the counterfactual replay lab
+    (``replay/``): each band's trace (``<journal>.trace``) is bounded by
+    its own journal's durable tag exactly as
+    :func:`~.state.journal.extract_trace` bounds a single host's, then
+    batch ``i`` of the merged workload concatenates every band's batch
+    ``i`` in the order *paths* are given — the same order convention as
+    :func:`replay_cluster_journals`, so the merged batch covers the same
+    markets the fleet settled that cadence. The merged length is the
+    SHORTEST band's covered prefix; ``strict=True`` refuses
+    (:class:`~.state.journal.TornTraceError`) when bands disagree on it
+    (a band lost trace or journal tail) instead of silently shortening.
+    Bands must agree on each batch's settlement day and step count — a
+    fleet driven on wall clock records per-host days and cannot be
+    merged; record with an explicit ``now`` schedule.
+
+    Returns ``(batches, tags)`` with ``tags[i]`` journal *i*'s watermark.
+    """
+    if not paths:
+        raise ValueError("no journals to extract a trace from")
+    per_band: List[List[TraceBatch]] = []
+    tags: List[Optional[int]] = []
+    for path in paths:
+        covered, tag = extract_trace(str(path), strict=strict)
+        per_band.append(covered)
+        tags.append(tag)
+    length = min(len(covered) for covered in per_band)
+    if strict and any(len(covered) != length for covered in per_band):
+        raise TornTraceError(
+            "bands disagree on the covered batch count "
+            f"({[len(c) for c in per_band]}); strict replay refuses a "
+            "workload some band never made durable"
+        )
+    merged: List[TraceBatch] = []
+    for i in range(length):
+        bands = [covered[i] for covered in per_band]
+        first = bands[0]
+        for band_batch, path in zip(bands, paths):
+            if band_batch.now_days != first.now_days or (
+                band_batch.steps != first.steps
+            ):
+                raise ValueError(
+                    f"{path}: batch {i} settlement day/steps "
+                    f"({band_batch.now_days}, {band_batch.steps}) disagree "
+                    f"with {paths[0]} ({first.now_days}, {first.steps}); "
+                    "merged replay needs an explicit now schedule"
+                )
+        offsets = [0]
+        for band_batch in bands:
+            for width in np.diff(band_batch.offsets):
+                offsets.append(offsets[-1] + int(width))
+        merged.append(
+            TraceBatch(
+                index=i,
+                market_keys=tuple(
+                    k for b in bands for k in b.market_keys
+                ),
+                source_ids=tuple(
+                    s for b in bands for s in b.source_ids
+                ),
+                probabilities=np.concatenate(
+                    [b.probabilities for b in bands]
+                ),
+                offsets=np.asarray(offsets, dtype=np.int64),
+                outcomes=np.concatenate([b.outcomes for b in bands]),
+                now_days=first.now_days,
+                steps=first.steps,
+            )
+        )
+    return merged, tuple(tags)
 
 
 def store_digest(store) -> str:

@@ -64,6 +64,12 @@ LAYERS: dict[str, int] = {
     # (an ops kernel that could open a socket would be an ops kernel
     # one refactor from a host sync mid-dispatch).
     "net": 7,
+    # replay (the counterfactual replay lab, round 18) also shares the
+    # orchestration tier: the sweep re-drives serve's SessionDriver and
+    # builds plans through pipeline, so it must see both — and the
+    # numeric rule keeps every engine tier below from importing a
+    # harness that re-drives them.
+    "replay": 7,
     "cli": 8,
     # The root facade re-exports for users; nothing inside imports it.
     "__init__": 99,
@@ -101,10 +107,13 @@ LAYER_IMPORT_OVERRIDES: dict[str, frozenset[str]] = {
 #: connections/frames/wire errors (write surface only — the exporter/
 #: fleet/health READ surface stays confined below; the server serves
 #: requests, the service's telemetry exporter serves metrics).
+#: ``replay`` joined in round 18: the sweep counts its batches/lanes and
+#: the trace writer its frames (write surface only, like the tiers it
+#: re-drives).
 OBS_ALLOWED_IMPORTERS: frozenset[str] = frozenset(
     {
         "obs", "pipeline", "serve", "state", "cli", "analytics",
-        "cluster", "net", "__init__",
+        "cluster", "net", "replay", "__init__",
     }
 )
 

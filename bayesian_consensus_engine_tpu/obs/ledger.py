@@ -203,6 +203,7 @@ def min_of_repeats(
     band.update(_peak_mem_summary(records, leg))
     band.update(_hbm_read_summary(records, leg))
     band.update(_recovery_summary(records, leg))
+    band.update(_replay_summary(records, leg))
     return band
 
 
@@ -246,6 +247,26 @@ def _recovery_summary(
     degraded.
     """
     return _min_extras_summary(records, leg, "recovery_s")
+
+
+def _replay_summary(
+    records: List[Dict[str, object]], leg: str
+) -> Dict[str, object]:
+    """Worst-case replay-sweep throughput over a leg's records.
+
+    Records carrying ``extras["replay_batches_per_s"]`` (the round-18
+    ``e2e_replay_sweep`` leg: recorded batches re-driven per second by
+    the K-lane vmapped sweep) fold to their MINIMUM across repeats — for
+    a throughput the min is the conservative publishable reading, the
+    same policy as every other extras column (host load only ever
+    SHRINKS a rate). A regression that de-amortises the sweep (per-lane
+    plan builds creeping back, a program-cache miss per batch) shows up
+    as this column collapsing toward the sequential baseline in the
+    same ``bce-tpu stats``/``--against`` workflow as hbm_read.
+    """
+    return _min_extras_summary(
+        records, leg, "replay_batches_per_s", positive_only=True
+    )
 
 
 def _peak_mem_summary(
@@ -549,7 +570,8 @@ def diff_bands(
         metrics: Dict[str, Dict[str, object]] = {}
         for name in ("p50", "p99", "goodput_within_slo", "slo_violations",
                      "ingest_wait_s", "intern_s", "hbm_peak_bytes",
-                     "hbm_read_bytes", "recovery_s"):
+                     "hbm_read_bytes", "recovery_s",
+                     "replay_batches_per_s"):
             old_value = (old_band or {}).get(name)
             new_value = (new_band or {}).get(name)
             if old_value is not None or new_value is not None:
@@ -606,6 +628,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             "hbm_peak_bytes": "peak_mem",
             "hbm_read_bytes": "hbm_read",
             "recovery_s": "recovery",
+            "replay_batches_per_s": "replay",
         }.get(name, name)
         return f"  {label} {num(metric['old'])}->{num(metric['new'])}"
 
@@ -622,7 +645,8 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             metric_str(entry, name)
             for name in ("p99", "goodput_within_slo", "slo_violations",
                          "ingest_wait_s", "intern_s", "hbm_peak_bytes",
-                         "hbm_read_bytes", "recovery_s")
+                         "hbm_read_bytes", "recovery_s",
+                         "replay_batches_per_s")
         )
         trailer += "".join(
             metric_str(entry, name)
@@ -661,7 +685,11 @@ def render(records: List[Dict[str, object]]) -> str:
     high-water mark (``extras.hbm_peak_bytes``, min across repeats — the
     memory-diet regression signal), and ``hbm_read`` for legs carrying
     per-settle bytes-read captures (``extras.hbm_read_bytes`` — the
-    round-14 one-pass sweep signal); every other leg shows dashes.
+    round-14 one-pass sweep signal), and ``replay`` for legs carrying
+    the counterfactual-sweep throughput (``extras.replay_batches_per_s``
+    — the round-18 ``e2e_replay_sweep`` leg: recorded batches per
+    second through the K-lane vmapped replay, min across repeats);
+    every other leg shows dashes.
     """
     summary = summarize(records)
     if not summary:
@@ -670,7 +698,7 @@ def render(records: List[Dict[str, object]]) -> str:
         f"{'leg':<34} {'n':>3} {'min':>12} {'max':>12} "
         f"{'spread':>7} {'p50':>9} {'p99':>9} {'goodput':>8} {'slo':>7} "
         f"{'ingest_w':>9} {'intern':>9} {'peak_mem':>9} {'hbm_read':>9} "
-        f"{'recovery':>9} {'load(1m)':>12} unit"
+        f"{'recovery':>9} {'replay':>8} {'load(1m)':>12} unit"
     ]
     for leg, band in summary.items():
 
@@ -717,6 +745,7 @@ def render(records: List[Dict[str, object]]) -> str:
             f"{num(band.get('ingest_wait_s')):>9} "
             f"{num(band.get('intern_s')):>9} "
             f"{peak_str:>9} {read_str:>9} {num(band.get('recovery_s')):>9} "
+            f"{num(band.get('replay_batches_per_s')):>8} "
             f"{load:>12} {band['unit'] or '-'}"
         )
         # QoS-carrying legs (extras.qos — the e2e_netserve acts) get a

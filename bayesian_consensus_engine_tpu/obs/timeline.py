@@ -61,6 +61,10 @@ from typing import Dict, Optional
 #: between cadences — the async-durability win is literally this phase
 #: staying empty); ``checkpoint`` is checkpoint-call overhead around the
 #: inner phases; ``interchange_export`` is the SQLite interchange write.
+#: ``replay`` is the counterfactual replay lab's phase (``replay/``):
+#: trace-frame capture inside a recording ``settle_stream``, and the
+#: sweep's per-batch device dispatch when a replay harness runs under a
+#: recording timeline.
 PHASES = (
     "pack",
     "upload",
@@ -72,6 +76,7 @@ PHASES = (
     "journal_async_wait",
     "checkpoint",
     "interchange_export",
+    "replay",
 )
 
 _tls = threading.local()

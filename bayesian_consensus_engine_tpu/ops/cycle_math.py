@@ -39,9 +39,35 @@ import jax.numpy as jnp
 from bayesian_consensus_engine_tpu.ops.decay import decayed_reliability_at
 from bayesian_consensus_engine_tpu.ops.update import outcome_update
 from bayesian_consensus_engine_tpu.utils.config import (
+    BASE_LEARNING_RATE,
+    CONFIDENCE_GROWTH_RATE,
+    DECAY_HALF_LIFE_DAYS,
+    DECAY_MINIMUM,
     DEFAULT_CONFIDENCE,
     DEFAULT_RELIABILITY,
+    MAX_UPDATE_STEP,
 )
+
+
+class CycleParams(NamedTuple):
+    """The cycle's tunable scalars, as one (possibly traced) struct.
+
+    Every field defaults to its module constant, and every consumer in
+    this file treats ``params=None`` as "pass the constants exactly as
+    before" — the default trace is the byte-identical program the golden
+    fixtures pin. The counterfactual replay sweep (``replay/``) instead
+    fills the fields with ``(C,)``-lane traced scalars under ``vmap``, so
+    K altered configs ride one settlement program. ``confidence_growth``
+    is carried for completeness but is NOT swept by the replay lab: the
+    settled-confidence trajectory is data-independent and host-replayed
+    in exact arithmetic (:func:`~.pipeline._replay_confidences`).
+    """
+
+    half_life_days: jax.Array | float = DECAY_HALF_LIFE_DAYS
+    decay_floor: jax.Array | float = DECAY_MINIMUM
+    base_learning_rate: jax.Array | float = BASE_LEARNING_RATE
+    max_update_step: jax.Array | float = MAX_UPDATE_STEP
+    confidence_growth: jax.Array | float = CONFIDENCE_GROWTH_RATE
 
 
 class MarketBlockState(NamedTuple):
@@ -69,7 +95,8 @@ class CycleResult(NamedTuple):
 
 
 def read_phase(
-    state: MarketBlockState, now_days: jax.Array
+    state: MarketBlockState, now_days: jax.Array,
+    params: CycleParams | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Decay-on-read with cold-start defaults; returns (read_rel, read_conf).
 
@@ -78,14 +105,18 @@ def read_phase(
     defaults by contract (see MarketBlockState), so gating decay on "ever
     updated" alone reproduces the masked reads.
     """
+    half_life = DECAY_HALF_LIFE_DAYS if params is None else params.half_life_days
+    floor = DECAY_MINIMUM if params is None else params.decay_floor
     if state.exists is None:
         read_rel = decayed_reliability_at(
-            state.reliability, state.updated_days, now_days, jnp.asarray(True)
+            state.reliability, state.updated_days, now_days, jnp.asarray(True),
+            half_life_days=half_life, floor=floor,
         )
         read_conf = state.confidence
     else:
         stored = decayed_reliability_at(
-            state.reliability, state.updated_days, now_days, state.exists
+            state.reliability, state.updated_days, now_days, state.exists,
+            half_life_days=half_life, floor=floor,
         )
         read_rel = jnp.where(state.exists, stored, DEFAULT_RELIABILITY)
         read_conf = jnp.where(state.exists, state.confidence, DEFAULT_CONFIDENCE)
@@ -145,6 +176,7 @@ def update_phase(
     read_conf: jax.Array,
     now_days: jax.Array,
     slots_axis: int = -1,
+    params: CycleParams | None = None,
 ) -> MarketBlockState:
     """Outcome correctness + capped update on the UNDECAYED stored state.
 
@@ -160,7 +192,15 @@ def update_phase(
         update_base = state.reliability
     else:
         update_base = jnp.where(state.exists, state.reliability, DEFAULT_RELIABILITY)
-    updated_rel, updated_conf = outcome_update(update_base, read_conf, correct)
+    if params is None:
+        updated_rel, updated_conf = outcome_update(update_base, read_conf, correct)
+    else:
+        updated_rel, updated_conf = outcome_update(
+            update_base, read_conf, correct,
+            base_lr=params.base_learning_rate,
+            max_step=params.max_update_step,
+            confidence_growth=params.confidence_growth,
+        )
     return MarketBlockState(
         reliability=jnp.where(mask, updated_rel, state.reliability),
         confidence=jnp.where(mask, updated_conf, state.confidence),
@@ -177,6 +217,7 @@ def _cycle_math(
     now_days: jax.Array,     # scalar, relative epoch-days
     axis_name: str | None,
     slots_axis: int = -1,
+    params: CycleParams | None = None,
 ) -> CycleResult:
     """The full cycle on one shard; psum over *axis_name* if sharded.
 
@@ -188,7 +229,7 @@ def _cycle_math(
     # (utils/profiling.trace / auto_trace show per-phase time, not one
     # opaque fused blob). Zero runtime cost — names only.
     with jax.named_scope("bce.read_decay"):
-        read_rel, read_conf = read_phase(state, now_days)
+        read_rel, read_conf = read_phase(state, now_days, params)
 
     with jax.named_scope("bce.consensus_reduce"):
         consensus, confidence_out, total_weight = consensus_reduce(
@@ -196,7 +237,8 @@ def _cycle_math(
         )
     with jax.named_scope("bce.outcome_update"):
         new_state = update_phase(
-            probs, mask, outcome, state, read_conf, now_days, slots_axis
+            probs, mask, outcome, state, read_conf, now_days, slots_axis,
+            params,
         )
     return CycleResult(new_state, consensus, confidence_out, total_weight)
 
@@ -211,6 +253,7 @@ def _fast_cycle_math(
     prev_now: jax.Array,     # scalar: the previous step's day
     axis_name: str | None,
     slots_axis: int = -1,
+    params: CycleParams | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One mid-loop cycle with the decay read driven by SCALAR time.
 
@@ -242,7 +285,12 @@ def _fast_cycle_math(
         # bit-identity tests). The broadcast costs no HBM traffic.
         stamps = jnp.broadcast_to(prev_now, reliability.shape)
         read_rel = decayed_reliability_at(
-            reliability, stamps, now_days, jnp.asarray(True)
+            reliability, stamps, now_days, jnp.asarray(True),
+            half_life_days=(
+                DECAY_HALF_LIFE_DAYS if params is None
+                else params.half_life_days
+            ),
+            floor=DECAY_MINIMUM if params is None else params.decay_floor,
         )
 
     with jax.named_scope("bce.consensus_reduce"):
@@ -252,7 +300,15 @@ def _fast_cycle_math(
 
     with jax.named_scope("bce.outcome_update"):
         correct = (probs >= 0.5) == jnp.expand_dims(outcome, slots_axis)
-        new_rel, new_conf = outcome_update(reliability, confidence, correct)
+        if params is None:
+            new_rel, new_conf = outcome_update(reliability, confidence, correct)
+        else:
+            new_rel, new_conf = outcome_update(
+                reliability, confidence, correct,
+                base_lr=params.base_learning_rate,
+                max_step=params.max_update_step,
+                confidence_growth=params.confidence_growth,
+            )
         reliability = jnp.where(mask, new_rel, reliability)
         confidence = jnp.where(mask, new_conf, confidence)
     return reliability, confidence, consensus

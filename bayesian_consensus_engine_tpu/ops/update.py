@@ -30,13 +30,24 @@ def outcome_update(
     reliability: Array,
     confidence: Array,
     correct: Array,          # bool[...]
+    *,
+    base_lr=BASE_LEARNING_RATE,
+    max_step=MAX_UPDATE_STEP,
+    confidence_growth=CONFIDENCE_GROWTH_RATE,
 ) -> tuple[Array, Array]:
-    """Elementwise update for every entry; returns (reliability', confidence')."""
+    """Elementwise update for every entry; returns (reliability', confidence').
+
+    The keyword parameters default to the module constants — the default
+    call traces the exact program it always has — and accept traced
+    scalars, which is what lets the counterfactual replay sweep vmap one
+    settlement program over a stacked axis of altered learning rates and
+    step caps (``replay/``) without forking the update math.
+    """
     direction = jnp.where(correct, 1.0, -1.0)
-    delta = jnp.clip(BASE_LEARNING_RATE * direction, -MAX_UPDATE_STEP, MAX_UPDATE_STEP)
+    delta = jnp.clip(base_lr * direction, -max_step, max_step)
     new_rel = jnp.clip(reliability + delta, 0.0, 1.0)
     new_conf = jnp.minimum(
-        1.0, confidence + (1.0 - confidence) * CONFIDENCE_GROWTH_RATE
+        1.0, confidence + (1.0 - confidence) * confidence_growth
     )
     return new_rel, new_conf
 

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from bayesian_consensus_engine_tpu.parallel._jax_compat import shard_map, pcast_varying
 
 from bayesian_consensus_engine_tpu.ops.cycle_math import (
+    CycleParams,
     CycleResult,
     MarketBlockState,
     _cycle_math,
@@ -59,6 +60,7 @@ from bayesian_consensus_engine_tpu.utils.config import (
 
 __all__ = [
     # re-exports from ops/cycle_math.py (the pre-round-14 home)
+    "CycleParams",
     "CycleResult",
     "MarketBlockState",
     "consensus_epilogue",
@@ -72,6 +74,7 @@ __all__ = [
     "build_cycle_loop",
     "build_cycle_tiebreak_loop",
     "build_cycle_analytics_loop",
+    "build_replay_sweep_step",
     "relayout_slot_state",
     "pad_markets",
     "init_block_state",
@@ -752,3 +755,220 @@ def init_block_state(
         updated_days=jnp.zeros(shape, dtype=dtype),
         exists=jnp.zeros(shape, dtype=bool),
     )
+
+
+def _lane_damped_relax(
+    values, neighbor_idx, neighbor_w, damping, lane_steps, max_steps: int
+):
+    """One replay lane's damped graph relaxation with TRACED λ and depth.
+
+    The traced twin of :func:`~.ops.propagate.damped_sweep_math`: that
+    kernel casts ``f32(damping)`` and closes over a static ``steps``, so
+    it cannot ride a vmapped config axis. Same per-iteration expression
+    (gather → masked neighbour mean → damped blend, NaN neighbours
+    excluded, no-edge rows untouched); the lane's depth is enforced by
+    freezing iterations past ``lane_steps`` inside a static
+    ``max_steps``-trip fori — every lane runs the same program, shallower
+    lanes just stop mixing. Single-shard only (replay lanes never shard
+    the markets axis).
+    """
+    f32 = jnp.float32
+    values = values.astype(f32)
+    weights = jnp.where(neighbor_idx >= 0, neighbor_w.astype(f32), f32(0.0))
+    lam = damping.astype(f32)
+    keep = f32(1.0) - lam
+
+    def body(i, v):
+        nb = v[jnp.clip(neighbor_idx, 0)]
+        ok = (neighbor_idx >= 0) & jnp.isfinite(nb)
+        w = jnp.where(ok, weights, f32(0.0))
+        wsum = jnp.sum(w, axis=-1)
+        wval = jnp.sum(w * jnp.where(ok, nb, f32(0.0)), axis=-1)
+        mixes = (wsum > 0) & jnp.isfinite(v) & (i < lane_steps)
+        blended = keep * v + lam * (
+            wval / jnp.where(wsum > 0, wsum, f32(1.0))
+        )
+        return jnp.where(mixes, blended, v)
+
+    if max_steps <= 0:
+        return values
+    return jax.lax.fori_loop(0, max_steps, body, values)
+
+
+#: Compiled replay-sweep programs, keyed ``(steps, max_graph_steps)`` —
+#: module-level so every sweep in a process (and every batch of one
+#: sweep) reuses the same executable; the AOT warm path then pays
+#: staging once for all K lanes of all batches.
+_REPLAY_SWEEP_CACHE: dict = {}
+
+
+def build_replay_sweep_step(steps: int, max_graph_steps: int = 0):
+    """Compile the K-lane counterfactual settlement step (``replay/``).
+
+    One jit dispatch advances C alternate-history copies of the flat
+    store state through one recorded batch: the flat gather → N-cycle
+    loop → scatter program of :func:`~.pipeline._settle_math`, vmapped
+    over a stacked lane axis with the plan arrays (slot_rows / probs /
+    mask / outcome — the recorded workload) broadcast and the cycle's
+    tunable scalars (:class:`CycleParams` + band z + graph λ/depth)
+    per-lane. Markets and slots never shard here — the lane axis IS the
+    parallelism — so staging, interning, and plan build are paid once
+    for all C configs (the ≥6×-over-sequential contract of the
+    ``e2e_replay_sweep`` bench leg).
+
+    Returns ``step(state, metrics, params, band_z, graph, slot_rows,
+    probs, mask, outcome, now0, neighbors) -> (state', metrics')`` where
+
+    * ``state`` is a ``(rel, conf, days, exists)`` tuple of ``(C, R)``
+      stacked flat columns (donated — lanes advance in place);
+    * ``metrics`` is the ``(C, 4)`` f32 running accumulator
+      ``[n_settled, brier_sum, band_width_sum, graph_brier_sum]``
+      (donated). Brier terms sum ``(consensus − outcome)²`` over markets
+      that settled with weight; band width sums the two-sided
+      ``2·z·stderr`` credible width over the SAME pre-update decayed
+      read the live analytics weighs with (:func:`~.ops.uncertainty`
+      moments, per-lane z applied outside the fixed epilogue);
+    * ``params`` is a :class:`CycleParams` of ``(C,)`` lane scalars,
+      ``band_z`` a ``(C,)`` vector, ``graph`` either ``()`` (built with
+      ``max_graph_steps=0``) or a ``(damping, steps)`` pair of ``(C,)``
+      lane vectors, ``neighbors`` either ``()`` or the static
+      ``(neighbor_idx, neighbor_w)`` market-graph blocks.
+
+    Determinism: every lane runs the identical program over identical
+    inputs — the sweep result is a pure function of (trace, config
+    stack), and lane metrics never depend on lane order. The per-lane
+    trace reuses the exact `_settle_math` scaffold (sink-row extend,
+    exists-carried loop, permutation scatter), so a lane pinned to the
+    recorded config computes the recorded history (cross-checked
+    against the authoritative re-drive by tests/test_replay.py).
+    """
+    key = (int(steps), int(max_graph_steps))
+    cached = _REPLAY_SWEEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    from bayesian_consensus_engine_tpu.ops.uncertainty import band_sums
+
+    has_graph = max_graph_steps > 0
+    f32 = jnp.float32
+
+    def lane_math(
+        rel, conf, days, exists, metrics_row, params, band_z, graph,
+        slot_rows, probs, mask, outcome, now0, neighbors,
+    ):
+        def ext(x, fill):
+            return jnp.concatenate([x, jnp.full((1,), fill, x.dtype)])
+
+        rel_e = ext(rel, DEFAULT_RELIABILITY)
+        conf_e = ext(conf, DEFAULT_CONFIDENCE)
+        days_e = ext(days, 0.0)
+        exists_e = ext(exists, False)
+        block = MarketBlockState(
+            reliability=rel_e[slot_rows],
+            confidence=conf_e[slot_rows],
+            updated_days=days_e[slot_rows],
+            exists=exists_e[slot_rows],
+        )
+
+        # Band-width metric: the same pre-update decayed read the live
+        # analytics programs weigh with, at this batch's now0; the fixed
+        # tree moments + epilogue give the z-free stderr, then the
+        # lane's z scales it (band_epilogue's own f32(z) cast rejects
+        # tracers, deliberately — its barriers pin the LIVE roundings).
+        with jax.named_scope("bce.replay_band_width"):
+            read_rel, _ = read_phase(block, now0, params)
+            sums, _count = band_sums(
+                probs, mask, read_rel,
+                axis_name=None, axis_size=1, agents_last=False,
+            )
+            # band_epilogue's stderr math, minus its optimization
+            # barriers: barriers have no vmap batching rule, and the
+            # pins exist to keep the LIVE programs' lo/hi bit-stable —
+            # the replay metric is its own pure function of (trace,
+            # configs) and carries its own run-twice contract.
+            sw, swp, swp2, sw2 = sums[0], sums[1], sums[2], sums[3]
+            has_weight = sw != 0
+            safe_w = jnp.where(has_weight, sw, f32(1.0))
+            mean = jnp.where(has_weight, swp / safe_w, f32(0.0))
+            ex2 = jnp.where(has_weight, swp2 / safe_w, f32(0.0))
+            variance = jnp.maximum(ex2 - mean * mean, f32(0.0))
+            n_eff = jnp.where(
+                sw2 > 0, (sw * sw) / jnp.where(sw2 > 0, sw2, f32(1.0)),
+                f32(0.0),
+            )
+            stderr = jnp.where(
+                n_eff > 0,
+                jnp.sqrt(variance / jnp.maximum(n_eff, f32(1e-30))),
+                f32(0.0),
+            )
+            band_width = jnp.sum(f32(2.0) * band_z.astype(f32) * stderr)
+
+        cycle_fn = partial(
+            _cycle_math, axis_name=None, slots_axis=0, params=params
+        )
+        fast_fn = partial(
+            _fast_cycle_math, axis_name=None, slots_axis=0, params=params
+        )
+        loop_math = make_loop_math(cycle_fn, steps, fast_cycle_fn=fast_fn)
+        new_block, consensus = loop_math(probs, mask, outcome, block, now0)
+
+        new_rel = rel_e.at[slot_rows].set(new_block.reliability)[:-1]
+        new_conf = conf_e.at[slot_rows].set(new_block.confidence)[:-1]
+        new_days = days_e.at[slot_rows].set(new_block.updated_days)[:-1]
+        new_exists = exists_e.at[slot_rows].set(new_block.exists)[:-1]
+
+        with jax.named_scope("bce.replay_metrics"):
+            settled = jnp.isfinite(consensus)
+            outcome_f = outcome.astype(f32)
+            cons = jnp.where(settled, consensus.astype(f32), f32(0.0))
+            brier = jnp.sum(
+                jnp.where(settled, (cons - outcome_f) ** 2, f32(0.0))
+            )
+            if has_graph:
+                damping, lane_steps = graph
+                neighbor_idx, neighbor_w = neighbors
+                relaxed = _lane_damped_relax(
+                    consensus, neighbor_idx, neighbor_w,
+                    damping, lane_steps, max_graph_steps,
+                )
+                graph_brier = jnp.sum(jnp.where(
+                    settled,
+                    (jnp.where(settled, relaxed, f32(0.0)) - outcome_f) ** 2,
+                    f32(0.0),
+                ))
+            else:
+                # No relaxation compiled in: the graph Brier IS the
+                # plain Brier (the graph_steps=0 lane contract,
+                # matching the frozen-relax lane inside graph sweeps).
+                graph_brier = brier
+            delta = jnp.stack([
+                jnp.sum(settled).astype(f32), brier, band_width, graph_brier,
+            ])
+        return (
+            new_rel, new_conf, new_days, new_exists,
+            metrics_row + delta.astype(metrics_row.dtype),
+        )
+
+    lanes = jax.vmap(
+        lane_math,
+        in_axes=(
+            0, 0, 0, 0, 0,          # stacked state columns + metrics row
+            0, 0, 0,                # params / band_z / graph lane scalars
+            None, None, None, None, None, None,  # shared plan + graph blocks
+        ),
+    )
+
+    def sweep_math(
+        state, metrics, params, band_z, graph,
+        slot_rows, probs, mask, outcome, now0, neighbors,
+    ):
+        rel, conf, days, exists = state
+        new_rel, new_conf, new_days, new_exists, new_metrics = lanes(
+            rel, conf, days, exists, metrics, params, band_z, graph,
+            slot_rows, probs, mask, outcome, now0, neighbors,
+        )
+        return (new_rel, new_conf, new_days, new_exists), new_metrics
+
+    fn = jax.jit(sweep_math, donate_argnums=(0, 1))
+    _REPLAY_SWEEP_CACHE[key] = fn
+    return fn

@@ -2301,6 +2301,7 @@ def settle_stream(
     sync_checkpoints: bool = False,
     resident_session: bool = True,
     intern_mode: str = "auto",
+    trace=None,
 ):
     """The streamed settle-and-checkpoint service loop, fully overlapped.
 
@@ -2519,6 +2520,19 @@ def settle_stream(
     today's pre-async semantics. The flag is journal-mode only: rolling
     SQLite checkpoints were already backgrounded and keep their
     semantics either way.
+
+    *trace*, a :class:`~.state.journal.TraceWriter` (or a path, which
+    opens one), records each batch's INPUTS — the columnar payload
+    columns, outcomes, the resolved settlement day, and ``steps`` — as
+    the journal's replayable-workload sidecar (the capture half of the
+    counterfactual replay lab, ``replay/``; the journal itself stores
+    only output deltas). Requires ``columnar=True`` (the trace format IS
+    the columnar payload). With ``now=None`` the wall-clock day is
+    resolved host-side per batch and the SAME value drives the settle
+    and the trace frame, so a replay reproduces the recorded stamps
+    exactly. Pair it with ``journal=``:
+    :func:`~.state.journal.extract_trace` bounds the replayable workload
+    by the journal's durable tag.
     """
     import time as _time
 
@@ -2550,6 +2564,17 @@ def settle_stream(
 
         journal = JournalWriter(journal)
         owns_journal = True
+    if trace is not None and not columnar:
+        raise ValueError(
+            "trace= records the columnar payload columns verbatim; "
+            "pass columnar=True (the replay workload format)"
+        )
+    owns_trace = False
+    if trace is not None and not hasattr(trace, "append_batch"):
+        from bayesian_consensus_engine_tpu.state.journal import TraceWriter
+
+        trace = TraceWriter(trace)
+        owns_trace = True
     # The loop body — session lifecycle, checkpoint cadence, exit contract
     # — is the serve-layer SessionDriver (round 8): this stream and the
     # online coalescing front end drive the same object, which is what
@@ -2558,10 +2583,13 @@ def settle_stream(
     from bayesian_consensus_engine_tpu.serve.driver import SessionDriver
 
     outcome_queue: "deque" = _collections.deque()
+    trace_queue: "deque" = _collections.deque()
 
     def payload_stream():
         for payloads, outcomes in batches:
             outcome_queue.append(outcomes)
+            if trace is not None:
+                trace_queue.append(payloads)
             yield payloads
 
     # Observability (obs/): phase spans land on this thread's active
@@ -2632,6 +2660,20 @@ def settle_stream(
                 index += 1
                 outcomes = outcome_queue.popleft()
                 batch_now = None if now is None else now + index
+                if trace is not None:
+                    # The trace frame and the settle must stamp the SAME
+                    # day: resolve wall clock once here (the settle would
+                    # otherwise resolve its own inside the dispatch).
+                    if batch_now is None:
+                        batch_now = _now_days()
+                    t_keys, t_sids, t_probs, t_offsets = (
+                        trace_queue.popleft()
+                    )
+                    with timeline.span("replay"):
+                        trace.append_batch(
+                            t_keys, t_sids, t_probs, t_offsets, outcomes,
+                            now_days=float(batch_now), steps=steps,
+                        )
                 # Captured BEFORE the settle: the delta-upload path
                 # consumes (and drops) the refresh back-reference.
                 plan_reused = (
@@ -2710,4 +2752,8 @@ def settle_stream(
         # with a tail epoch/flush (never a batch that raised mid-settle),
         # skips the tail epoch when the loop is exiting BECAUSE a journal
         # write failed, closes an owned journal, and tail-flushes SQLite.
-        driver.finalize()
+        try:
+            driver.finalize()
+        finally:
+            if owns_trace:
+                trace.close()

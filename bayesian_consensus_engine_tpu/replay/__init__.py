@@ -1,0 +1,40 @@
+"""Counterfactual replay lab: batched journal replay at device speed.
+
+See :mod:`~.replay.lab` for the full story. Surface:
+
+* :func:`replay_sweep` — K altered configs through one vmapped
+  settlement program per recorded batch; lane 0 is the recorded config,
+  re-driven authoritatively (byte contract witness).
+* :func:`replay_single` — one config, full staging paid per call (the
+  sequential baseline the sweep's ≥6× acceptance measures against).
+* :func:`load_trace` / :func:`load_cluster_trace` /
+  :func:`trace_from_batches` — workload sources: a journal's trace
+  sidecar, a fleet's merged band sidecars, a serving front end's
+  ``record_batches`` log.
+* :class:`ReplayConfig` / :data:`RECORDED_CONFIG`, :class:`LaneReport`,
+  :class:`SweepResult`.
+"""
+
+from bayesian_consensus_engine_tpu.replay.lab import (
+    RECORDED_CONFIG,
+    LaneReport,
+    ReplayConfig,
+    SweepResult,
+    load_cluster_trace,
+    load_trace,
+    replay_single,
+    replay_sweep,
+    trace_from_batches,
+)
+
+__all__ = [
+    "RECORDED_CONFIG",
+    "LaneReport",
+    "ReplayConfig",
+    "SweepResult",
+    "load_cluster_trace",
+    "load_trace",
+    "replay_single",
+    "replay_sweep",
+    "trace_from_batches",
+]

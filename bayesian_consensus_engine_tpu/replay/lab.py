@@ -1,0 +1,514 @@
+"""The counterfactual replay lab — the journal as a time machine.
+
+A recorded run leaves two artefacts: the journal (OUTPUT deltas — the
+durability tier) and its trace sidecar (INPUTS — the columnar payload
+columns, outcomes, settlement days, step count per admitted batch;
+:class:`~.state.journal.TraceWriter`, recorded by ``settle_stream``'s
+``trace=`` or rebuilt from a serving front end's ``record_batches``
+batch log). This module re-drives that workload under K altered
+parameter configs at device speed:
+
+* **Lane 0 is authoritative.** The recorded config re-drives through
+  the REAL settle machinery (:func:`~.serve.driver.drive_trace`'s loop
+  body: :class:`~.serve.driver.PlanCache` stage/bind in admission order,
+  one :class:`~.serve.driver.SessionDriver`, flat or sharded-resident),
+  so "lane 0 reproduces the live run byte-for-byte" (store digest +
+  SQLite bytes) is structural — the live loop over the live inputs —
+  not a parallel implementation kept honest.
+* **Lanes ride one program.** All K configs advance through ONE vmapped
+  settlement step per batch
+  (:func:`~.parallel.sharded.build_replay_sweep_step`): the flat
+  gather → N-cycle loop → scatter program with the plan arrays
+  broadcast and the cycle's tunable scalars per-lane. Plan build,
+  interning, and the plan's host→device upload are paid ONCE for all K
+  lanes (and shared with lane 0's authoritative dispatch via the plan's
+  device-array cache) — the ≥6×-over-sequential contract the
+  ``e2e_replay_sweep`` bench leg pins.
+
+Determinism: a sweep's :class:`SweepResult` carries no timing and is a
+pure function of (trace, config set) — run twice, byte-identical
+(``result_digest``). ``confidence_growth`` is deliberately NOT a sweep
+dimension: the settled-confidence trajectory is data-independent and
+host-replayed in exact arithmetic (:func:`~.pipeline._replay_confidences`),
+so sweeping it device-side would diverge from what any live run could
+produce.
+
+Layer 7 (lint LY301, alongside ``serve``): may import pipeline/serve
+and everything below, never ``cli``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
+from bayesian_consensus_engine_tpu.obs.timeline import active_timeline
+from bayesian_consensus_engine_tpu.ops.propagate import DEFAULT_DAMPING
+from bayesian_consensus_engine_tpu.ops.uncertainty import Z_95
+from bayesian_consensus_engine_tpu.state.journal import TraceBatch
+from bayesian_consensus_engine_tpu.utils.config import (
+    BASE_LEARNING_RATE,
+    DECAY_HALF_LIFE_DAYS,
+    DECAY_MINIMUM,
+    DEFAULT_CONFIDENCE,
+    DEFAULT_RELIABILITY,
+    MAX_UPDATE_STEP,
+)
+
+
+class ReplayConfig(NamedTuple):
+    """One counterfactual parameter point — a lane of the sweep.
+
+    Defaults are the RECORDED constants, so ``ReplayConfig()`` is the
+    live run's config (:data:`RECORDED_CONFIG`). ``graph_steps=0``
+    disables the correlated-market relaxation for that lane (its
+    ``graph_brier`` then equals its plain ``brier``); any lane with
+    ``graph_steps > 0`` makes the sweep require a
+    :class:`~.analytics.graph.MarketGraph`.
+    """
+
+    half_life_days: float = DECAY_HALF_LIFE_DAYS
+    decay_floor: float = DECAY_MINIMUM
+    base_learning_rate: float = BASE_LEARNING_RATE
+    max_update_step: float = MAX_UPDATE_STEP
+    band_z: float = Z_95
+    graph_damping: float = DEFAULT_DAMPING
+    graph_steps: int = 0
+
+
+#: The live run's parameter point — always lane 0 of a sweep.
+RECORDED_CONFIG = ReplayConfig()
+
+
+class LaneReport(NamedTuple):
+    """One lane's accumulated sweep metrics (sums over all batches).
+
+    ``markets_settled`` counts market-settlements with nonzero consensus
+    weight (a market re-settling in later batches counts each time);
+    ``brier_sum`` is Σ(consensus − outcome)² over those, ``band_width_sum``
+    the Σ of two-sided ``2·z·stderr`` credible widths over the pre-update
+    read (the same weights the live analytics band), and
+    ``graph_brier_sum`` the Brier after the lane's damped graph
+    relaxation (equal to ``brier_sum`` for ``graph_steps=0`` lanes).
+    Means divide by ``markets_settled`` (``nan`` when nothing settled).
+    """
+
+    config: ReplayConfig
+    markets_settled: int
+    brier_sum: float
+    band_width_sum: float
+    graph_brier_sum: float
+
+    @property
+    def brier_mean(self) -> float:
+        return (
+            self.brier_sum / self.markets_settled
+            if self.markets_settled else float("nan")
+        )
+
+    @property
+    def band_width_mean(self) -> float:
+        return (
+            self.band_width_sum / self.markets_settled
+            if self.markets_settled else float("nan")
+        )
+
+    @property
+    def graph_brier_mean(self) -> float:
+        return (
+            self.graph_brier_sum / self.markets_settled
+            if self.markets_settled else float("nan")
+        )
+
+
+@dataclass(frozen=True)
+class SweepResult:
+    """A finished sweep: per-lane reports + the authoritative rebuild.
+
+    ``lanes[0]`` is always :data:`RECORDED_CONFIG`. ``store`` and
+    ``digest`` are the lane-0 authoritative rebuild (the byte contract's
+    witness) — ``None`` when the sweep ran with ``rebuild=False``.
+    ``lane_state`` holds the final stacked flat columns
+    ``(reliability, confidence, updated_days, exists)``, each
+    ``(K, rows)`` — lane k's row r is what the store's row r would hold
+    had the run used config k (stamps relative to ``epoch0``).
+    ``result_digest`` hashes (configs, raw metric bytes, lane-0 digest):
+    equal digests ⇔ the same sweep happened, which is the run-twice
+    determinism pin.
+    """
+
+    lanes: Tuple[LaneReport, ...]
+    batches: int
+    epoch0: float
+    lane_state: Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]
+    result_digest: str
+    store: object = None
+    digest: Optional[str] = None
+
+    def by_config(self) -> "dict[ReplayConfig, LaneReport]":
+        return {lane.config: lane for lane in self.lanes}
+
+
+def load_trace(journal_path, strict: bool = False) -> List[TraceBatch]:
+    """The replayable workload of one recorded journal.
+
+    :func:`~.state.journal.extract_trace` bounded by the journal's
+    durable tag: a journal cut mid-frame replays to its last joined
+    epoch; ``strict=True`` refuses (:class:`~.state.journal.
+    TornTraceError`) instead of silently shortening.
+    """
+    from bayesian_consensus_engine_tpu.state.journal import extract_trace
+
+    batches, _tag = extract_trace(str(journal_path), strict=strict)
+    return batches
+
+
+def load_cluster_trace(paths, strict: bool = False) -> List[TraceBatch]:
+    """The merged replayable workload of N fleet band journals
+    (:func:`~.cluster.recover.extract_cluster_trace`)."""
+    from bayesian_consensus_engine_tpu.cluster.recover import (
+        extract_cluster_trace,
+    )
+
+    batches, _tags = extract_cluster_trace(paths, strict=strict)
+    return batches
+
+
+def trace_from_batches(
+    batch_log: Sequence,
+    now: float,
+    steps: int = 1,
+) -> List[TraceBatch]:
+    """A serving front end's ``record_batches`` log as a trace.
+
+    *batch_log* entries are ``((market_keys, source_ids, probabilities,
+    offsets), outcomes)`` — :attr:`~.serve.coalesce.ConsensusService.
+    batch_log`'s shape, which is also ``settle_stream``'s columnar batch
+    shape. *now* is the first batch's settlement day, advancing one day
+    per batch (the stream's ``now=float`` cadence — services recording
+    for replay should drive an explicit day schedule).
+    """
+    batches: List[TraceBatch] = []
+    for index, (payload, outcomes) in enumerate(batch_log):
+        market_keys, source_ids, probabilities, offsets = payload
+        batches.append(TraceBatch(
+            index=index,
+            market_keys=tuple(market_keys),
+            source_ids=tuple(source_ids),
+            probabilities=np.ascontiguousarray(
+                probabilities, dtype=np.float64
+            ),
+            offsets=np.ascontiguousarray(offsets, dtype=np.int64),
+            outcomes=np.asarray(outcomes, dtype=bool),
+            now_days=float(now) + index,
+            steps=int(steps),
+        ))
+    return batches
+
+
+def _uniform_steps(batches: Sequence[TraceBatch]) -> int:
+    steps_seen = {int(batch.steps) for batch in batches}
+    if len(steps_seen) != 1:
+        raise ValueError(
+            f"trace mixes step counts {sorted(steps_seen)}; one sweep "
+            "runs one compiled step shape — split the trace"
+        )
+    return steps_seen.pop()
+
+
+def _plan_device_arrays(plan, cdtype):
+    """The plan's device copies, cached on the plan — the SAME cache (and
+    tuple format, including the refresh-donor fast path) the flat
+    :func:`~.pipeline.settle` keeps, so a sweep running beside the
+    lane-0 authoritative dispatch re-uses its uploads instead of paying
+    the host→device topology transfer twice."""
+    import jax.numpy as jnp
+
+    touched = getattr(plan, "_touched_rows", None)
+    if touched is None:
+        touched = plan.slot_rows[plan.mask]
+        touched.setflags(write=False)
+        object.__setattr__(plan, "_touched_rows", touched)
+    device_plan = getattr(plan, "_device_arrays", None)
+    if device_plan is None or device_plan[0] != str(cdtype):
+        parent = getattr(plan, "_refreshed_from", None)
+        donor = (
+            getattr(parent, "_device_arrays", None)
+            if parent is not None else None
+        )
+        if donor is not None and donor[0] == str(cdtype):
+            device_plan = (
+                donor[0],
+                donor[1],
+                jnp.asarray(plan.probs, dtype=cdtype),
+                donor[3],
+                donor[4],
+            )
+            object.__setattr__(parent, "_device_arrays", None)
+        else:
+            device_plan = (
+                str(cdtype),
+                jnp.asarray(plan.slot_rows),
+                jnp.asarray(plan.probs, dtype=cdtype),
+                jnp.asarray(plan.mask),
+                jnp.asarray(touched),
+            )
+        object.__setattr__(plan, "_device_arrays", device_plan)
+        if parent is not None:
+            object.__setattr__(plan, "_refreshed_from", None)
+    return device_plan
+
+
+def _result_digest(
+    lanes: Sequence[ReplayConfig],
+    metrics: np.ndarray,
+    lane0_digest: Optional[str],
+) -> str:
+    h = hashlib.blake2b(digest_size=16)
+
+    def put(raw: bytes) -> None:
+        h.update(len(raw).to_bytes(8, "little"))
+        h.update(raw)
+
+    for config in lanes:
+        put(np.asarray(config, dtype=np.float64).tobytes())
+    put(np.ascontiguousarray(metrics, dtype=np.float32).tobytes())
+    put((lane0_digest or "").encode())
+    return h.hexdigest()
+
+
+def replay_sweep(
+    trace: Sequence[TraceBatch],
+    configs: Sequence[ReplayConfig] = (),
+    *,
+    graph=None,
+    dtype=None,
+    rebuild: bool = True,
+    journal=None,
+    db_path=None,
+    checkpoint_every: int = 1,
+    num_slots: "int | str | None" = "bucket",
+    intern_mode: str = "auto",
+) -> SweepResult:
+    """Re-drive *trace* under the recorded config + every *configs* lane.
+
+    One pass over the trace: each batch's plan stages and binds ONCE (in
+    recorded admission order, against the lane-0 store — row assignment
+    reproduces the live interner), lane 0 dispatches through the real
+    :class:`~.serve.driver.SessionDriver` (``rebuild=True``; *journal* /
+    *db_path* / *checkpoint_every* run the recorded durability cadence
+    against fresh files, and ``SweepResult.digest`` witnesses the byte
+    contract), and ALL lanes advance through one vmapped device step.
+    ``rebuild=False`` skips the authoritative lane — the pure sweep
+    (what the bench times), same lane metrics, no store/digest.
+
+    *graph*, a :class:`~.analytics.graph.MarketGraph`, is required when
+    any lane sets ``graph_steps > 0``; each batch's neighbour blocks
+    align once and serve every lane (λ and depth are per-lane traced
+    scalars inside the program).
+
+    Device work per batch is ONE jit dispatch regardless of lane count;
+    programs cache per (steps, max graph depth) and compile per distinct
+    batch shape (``num_slots="bucket"`` keeps wobbling widths shared,
+    exactly as live).
+    """
+    import jax.numpy as jnp
+
+    from bayesian_consensus_engine_tpu.parallel.sharded import (
+        CycleParams,
+        build_replay_sweep_step,
+    )
+    from bayesian_consensus_engine_tpu.serve.driver import (
+        PlanCache,
+        SessionDriver,
+    )
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+    from bayesian_consensus_engine_tpu.utils.dtypes import (
+        default_float_dtype,
+    )
+
+    batches = list(trace)
+    if not batches:
+        raise ValueError("empty trace: nothing to replay")
+    steps = _uniform_steps(batches)
+    lanes: Tuple[ReplayConfig, ...] = tuple(configs)
+    if not lanes or lanes[0] != RECORDED_CONFIG:
+        lanes = (RECORDED_CONFIG,) + lanes
+    num_lanes = len(lanes)
+    max_graph_steps = max(int(config.graph_steps) for config in lanes)
+    if max_graph_steps > 0 and graph is None:
+        raise ValueError(
+            "a lane sets graph_steps > 0 but no graph= was given — the "
+            "relaxation sweep needs the MarketGraph the lanes vary over"
+        )
+
+    timeline = active_timeline()
+    registry = metrics_registry()
+    batch_counter = registry.counter("replay.sweep_batches")
+    registry.gauge("replay.sweep_lanes").set(float(num_lanes))
+
+    # Plan build (stage + bind, admission order) — paid once for every
+    # lane. The store doubles as lane 0's authoritative store.
+    store = TensorReliabilityStore()
+    plans = PlanCache(store, num_slots=num_slots, intern_mode=intern_mode)
+    with timeline.span("replay"):
+        plan_list = [
+            plans.plan_for(
+                list(batch.market_keys),
+                list(batch.source_ids),
+                batch.probabilities,
+                batch.offsets,
+            )
+            for batch in batches
+        ]
+    rows = len(store)
+
+    cdtype = default_float_dtype() if dtype is None else jnp.dtype(dtype)
+    # Stamps are relative; one epoch strictly before the first batch's
+    # day keeps every stamp positive ("> 0" means "ever updated").
+    epoch0 = float(batches[0].now_days) - 1.0
+
+    def stacked(fill, dt):
+        return jnp.full((num_lanes, rows), fill, dtype=dt)
+
+    state = (
+        stacked(DEFAULT_RELIABILITY, cdtype),
+        stacked(DEFAULT_CONFIDENCE, cdtype),
+        stacked(0.0, cdtype),
+        stacked(False, bool),
+    )
+    metrics = jnp.zeros((num_lanes, 4), jnp.float32)
+    lane_f32 = lambda field: jnp.asarray(  # noqa: E731 - five-field stamp
+        [float(getattr(config, field)) for config in lanes], jnp.float32
+    )
+    params = CycleParams(
+        half_life_days=lane_f32("half_life_days"),
+        decay_floor=lane_f32("decay_floor"),
+        base_learning_rate=lane_f32("base_learning_rate"),
+        max_update_step=lane_f32("max_update_step"),
+        confidence_growth=jnp.full(
+            num_lanes, np.float32(CycleParams().confidence_growth),
+            jnp.float32,
+        ),
+    )
+    band_z = lane_f32("band_z")
+    graph_lanes = (
+        (
+            lane_f32("graph_damping"),
+            jnp.asarray(
+                [int(config.graph_steps) for config in lanes], jnp.int32
+            ),
+        )
+        if max_graph_steps > 0 else ()
+    )
+    step = build_replay_sweep_step(steps, max_graph_steps)
+
+    driver = None
+    if rebuild:
+        owns_journal = False
+        if journal is not None and not hasattr(journal, "append_epoch"):
+            from bayesian_consensus_engine_tpu.state.journal import (
+                JournalWriter,
+            )
+
+            journal = JournalWriter(journal)
+            owns_journal = True
+        driver = SessionDriver(
+            store,
+            steps=steps,
+            journal=journal,
+            owns_journal=owns_journal,
+            db_path=db_path,
+            checkpoint_every=checkpoint_every,
+        )
+    try:
+        for index, (batch, plan) in enumerate(zip(batches, plan_list)):
+            if driver is not None:
+                driver.dispatch(
+                    plan, batch.outcomes, now=float(batch.now_days)
+                )
+                driver.checkpoint(index)
+            with timeline.span("replay"):
+                _, slot_rows_d, probs_d, mask_d, _ = _plan_device_arrays(
+                    plan, cdtype
+                )
+                neighbors = ()
+                if max_graph_steps > 0:
+                    neighbor_idx, neighbor_w = graph.align(
+                        list(batch.market_keys)
+                    )
+                    neighbors = (
+                        jnp.asarray(neighbor_idx), jnp.asarray(neighbor_w)
+                    )
+                state, metrics = step(
+                    state, metrics, params, band_z, graph_lanes,
+                    slot_rows_d, probs_d, mask_d,
+                    jnp.asarray(np.asarray(batch.outcomes, dtype=bool)),
+                    jnp.asarray(float(batch.now_days) - epoch0, cdtype),
+                    neighbors,
+                )
+            batch_counter.inc()
+    finally:
+        if driver is not None:
+            driver.finalize()
+
+    metrics_np = np.asarray(metrics)
+    lane_state = tuple(np.asarray(column) for column in state)
+    digest = None
+    if rebuild:
+        from bayesian_consensus_engine_tpu.cluster.recover import (
+            store_digest,
+        )
+
+        digest = store_digest(store)
+    reports = tuple(
+        LaneReport(
+            config=config,
+            markets_settled=int(metrics_np[lane, 0]),
+            brier_sum=float(metrics_np[lane, 1]),
+            band_width_sum=float(metrics_np[lane, 2]),
+            graph_brier_sum=float(metrics_np[lane, 3]),
+        )
+        for lane, config in enumerate(lanes)
+    )
+    return SweepResult(
+        lanes=reports,
+        batches=len(batches),
+        epoch0=epoch0,
+        lane_state=lane_state,
+        result_digest=_result_digest(lanes, metrics_np, digest),
+        store=store if rebuild else None,
+        digest=digest,
+    )
+
+
+def replay_single(
+    trace: Sequence[TraceBatch],
+    config: ReplayConfig = RECORDED_CONFIG,
+    *,
+    graph=None,
+    dtype=None,
+) -> LaneReport:
+    """One config's replay, paying the full staging cost itself.
+
+    The sequential baseline the sweep amortises: each call builds its
+    own fresh store, re-stages and re-interns every plan, and runs a
+    1-wide device program (plus the recorded lane when *config* differs
+    — the sweep always carries lane 0). K calls ≈ K× the host cost ONE
+    :func:`replay_sweep` pays once; the ``e2e_replay_sweep`` leg records
+    the ratio (acceptance ≥6× at 16 configs).
+    """
+    result = replay_sweep(
+        trace,
+        () if config == RECORDED_CONFIG else (config,),
+        graph=graph,
+        dtype=dtype,
+        rebuild=False,
+    )
+    return result.by_config()[config]

@@ -392,6 +392,12 @@ class ConsensusService:
     ``settle_stream``. Off by default: a long-running service must not
     grow an unbounded log.
 
+    ``band_stderr_bound`` caps the variance-aware shed ranking's
+    per-market stderr map (round 18): past the bound the oldest-settled
+    markets are evicted first (ties by market id, live markets never),
+    so a long-running analytics service stops growing the map without
+    ever changing the shed order among pending requests.
+
     ``slo`` declares the per-request latency objective (seconds or a
     :class:`~.obs.slo.LatencyObjective`): every request that leaves the
     service is classified met / violated / shed / rejected and
@@ -423,9 +429,12 @@ class ConsensusService:
         analytics=None,
         target_p99_s: Optional[float] = None,
         intern_mode: str = "auto",
+        band_stderr_bound: int = 4096,
     ) -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if band_stderr_bound < 1:
+            raise ValueError("band_stderr_bound must be >= 1")
         if max_delay_s is not None and max_delay_s < 0:
             raise ValueError("max_delay_s must be >= 0 (or None)")
         if target_p99_s is not None and (
@@ -500,8 +509,20 @@ class ConsensusService:
         #: :meth:`seed_band_stderr`) — the variance-aware shed policy's
         #: ranking input. Markets absent here rank NARROW (shed last,
         #: in arrival order), so the policy degrades to shed-oldest
-        #: when no analytics ran.
+        #: when no analytics ran. BOUNDED (round 18): the map holds at
+        #: most ``band_stderr_bound`` markets; past the bound the
+        #: oldest-settled markets are evicted first (ties by market id),
+        #: and markets with a pending request are never evicted — so
+        #: eviction can never reorder the shed ranking among LIVE
+        #: markets (pinned by tests/test_replay.py).
         self._band_stderr: "dict[str, float]" = {}
+        #: Settled-age stamps for the eviction order: market id → the
+        #: value of ``_stderr_seq`` when its stderr last refreshed. One
+        #: seq tick per settled batch (or seed call), so every market in
+        #: a batch shares an age and ties break by market id.
+        self._stderr_settled_at: "dict[str, int]" = {}
+        self._stderr_seq = 0
+        self._band_stderr_bound = band_stderr_bound
 
         #: SLO accounting (obs/slo.py): classify every request that left
         #: the service; None when no objective was declared.
@@ -1151,12 +1172,18 @@ class ConsensusService:
                     # Refresh the variance-aware shed ranking with this
                     # batch's live per-market standard errors (plain
                     # dict assignment — GIL-atomic; the loop thread
-                    # reads it at shed time).
+                    # reads it at shed time). One age tick for the
+                    # whole batch, then evict past the bound.
                     stderr_col = bands["stderr"]
+                    self._stderr_seq += 1
                     for i, request in enumerate(requests):
                         self._band_stderr[request.market_id] = float(
                             stderr_col[i]
                         )
+                        self._stderr_settled_at[request.market_id] = (
+                            self._stderr_seq
+                        )
+                    self._evict_band_stderr()
                 t_settled = _time.perf_counter()
                 self._driver.checkpoint(batch_index)
                 if self._journal_mode:
@@ -1341,11 +1368,14 @@ class ConsensusService:
         shed policy ranks by (read-only view semantics: mutate through
         :meth:`seed_band_stderr` or by serving analytics batches).
 
-        Growth contract: one float per distinct market ever settled in
-        analytics mode — always strictly smaller than the per-market
-        reliability state the resident store holds for the same
-        markets, so the map never dominates the service's footprint.
-        Shed-time ranking over it is O(pending) per victim search,
+        Growth contract (round 18): at most ``band_stderr_bound``
+        markets — past the bound the oldest-settled markets (by the
+        per-batch age stamp, ties by market id) are evicted first, and
+        markets with a pending request are never evicted, so eviction
+        cannot change the shed order among live markets. An evicted
+        market simply re-ranks as unknown-band (shed last, arrival
+        order) until its next analytics settle refreshes it.
+        Shed-time ranking over the map is O(pending) per victim search,
         bounded by the class's ``max_pending`` budget, not by market
         cardinality."""
         return dict(self._band_stderr)
@@ -1357,10 +1387,44 @@ class ConsensusService:
         (or overrides) it explicitly — a recovered service can import
         the ranking from its analytics tier before the first batch
         settles, and the fixed-trace shed-determinism tests pin the
-        policy against a known map.
+        policy against a known map. Seeded entries share one age stamp
+        (ties break by market id) and count against
+        ``band_stderr_bound`` like settled ones.
         """
+        self._stderr_seq += 1
         for market, stderr in stderr_by_market.items():
             self._band_stderr[str(market)] = float(stderr)
+            self._stderr_settled_at[str(market)] = self._stderr_seq
+        self._evict_band_stderr()
+
+    def _evict_band_stderr(self) -> None:
+        """Trim the shed-ranking stderr map back under its bound.
+
+        Victims are the OLDEST-settled markets first (smallest age
+        stamp, ties by market id — a pure function of the settle/seed
+        trace, never of timing), and a market with a pending request is
+        never evicted: the shed ranking the loop thread reads for LIVE
+        markets is exactly what it would be unbounded. Runs on the
+        dispatch worker thread; the live-market snapshot copies each
+        window's market set with one C-level ``list()`` per set, so the
+        loop thread's concurrent window edits can't break iteration.
+        """
+        excess = len(self._band_stderr) - self._band_stderr_bound
+        if excess <= 0:
+            return
+        live: set = set()
+        for window in list(self._windows):
+            live.update(list(window.markets))
+        evictable = sorted(
+            (
+                (self._stderr_settled_at.get(market, 0), market)
+                for market in self._band_stderr
+                if market not in live
+            ),
+        )[:excess]
+        for _age, market in evictable:
+            del self._band_stderr[market]
+            self._stderr_settled_at.pop(market, None)
 
     def qos_snapshot(self) -> Optional[dict]:
         """Per-class QoS accounting as data (``None`` when no classes).

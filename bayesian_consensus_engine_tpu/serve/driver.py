@@ -508,3 +508,81 @@ class SessionDriver:
                     self._handle.result()
                 if self._flushed_through != self._started_through:
                     store.flush_to_sqlite(self._db_path)
+
+
+def drive_trace(
+    store,
+    trace,
+    mesh=None,
+    dtype=None,
+    journal=None,
+    db_path=None,
+    checkpoint_every: int = 1,
+    num_slots: "int | str | None" = "bucket",
+    intern_mode: str = "auto",
+):
+    """Re-drive a recorded trace through the REAL settle machinery.
+
+    The authoritative lane of the counterfactual replay lab
+    (``replay/``): each :class:`~.state.journal.TraceBatch` re-plans from
+    its recorded columnar columns (the same :class:`PlanCache`
+    stage/bind chain the serving front end runs, so pair interning
+    happens in the recorded admission order), dispatches through ONE
+    :class:`SessionDriver` — flat (``mesh=None``) or sharded-resident —
+    at the recorded settlement day and step count, and runs the recorded
+    checkpoint cadence against *journal* / *db_path* when given. Because
+    this IS the live loop body over the live inputs, the rebuilt store is
+    byte-identical to the recorded run's settled state (digest + SQLite
+    bytes — the lane-0 contract tests/test_replay.py pins) structurally,
+    not by a parallel implementation kept honest.
+
+    Returns the per-batch :class:`~.pipeline.SettlementResult` list.
+    """
+    batches = list(trace)
+    results: list = []
+    if not batches:
+        return results
+    steps_seen = {int(batch.steps) for batch in batches}
+    if len(steps_seen) != 1:
+        raise ValueError(
+            f"trace mixes step counts {sorted(steps_seen)}; one driver "
+            "runs one compiled step shape — split the trace"
+        )
+    owns_journal = False
+    if journal is not None and not hasattr(journal, "append_epoch"):
+        from bayesian_consensus_engine_tpu.state.journal import (
+            JournalWriter,
+        )
+
+        journal = JournalWriter(journal)
+        owns_journal = True
+    driver = SessionDriver(
+        store,
+        steps=steps_seen.pop(),
+        mesh=mesh,
+        dtype=dtype,
+        journal=journal,
+        owns_journal=owns_journal,
+        db_path=db_path,
+        checkpoint_every=checkpoint_every,
+    )
+    plans = PlanCache(store, num_slots=num_slots, intern_mode=intern_mode)
+    timeline = active_timeline()
+    try:
+        for position, batch in enumerate(batches):
+            with timeline.span("replay"):
+                plan = plans.plan_for(
+                    list(batch.market_keys),
+                    list(batch.source_ids),
+                    batch.probabilities,
+                    batch.offsets,
+                )
+                results.append(
+                    driver.dispatch(
+                        plan, batch.outcomes, now=float(batch.now_days)
+                    )
+                )
+                driver.checkpoint(position)
+    finally:
+        driver.finalize()
+    return results

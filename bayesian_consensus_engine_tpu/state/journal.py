@@ -72,7 +72,7 @@ import struct
 import time
 import zlib
 from pathlib import Path
-from typing import List, Optional, Tuple, Union
+from typing import List, NamedTuple, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -82,6 +82,29 @@ from bayesian_consensus_engine_tpu.obs.trace import active_tracer
 
 MAGIC = b"BCEJRNL1"
 _EPOCH_HDR = struct.Struct("<QQQQQdQ")
+
+# -- admitted-trace sidecar ---------------------------------------------------
+#
+# The journal records settlement OUTPUT deltas — post-update rows. The
+# inputs are not recoverable from them (the capped update destroys the
+# probability magnitudes), so a journal alone cannot be re-DRIVEN, only
+# re-LOADED. The trace sidecar (``<journal>.trace`` by convention) is the
+# missing half: the admitted columnar batches themselves, CRC-framed in
+# admitted order with the per-batch settlement day and step count, which
+# makes the pair ``(journal, trace)`` a complete replayable workload for
+# the counterfactual replay lab (``replay/``). The journal's epoch tag
+# remains the durability watermark: replay is bounded by the last
+# complete epoch's tag, exactly as crash recovery is.
+TRACE_MAGIC = b"BCETRAC1"
+# batch_index u64, markets u64, signals u64, keys_blob_len u64,
+# src_blob_len u64, now_days f64, steps u64
+_TRACE_HDR = struct.Struct("<QQQQQdQ")
+
+
+class TornTraceError(ValueError):
+    """A trace sidecar ends mid-frame (or disagrees with its journal) and
+    the caller demanded ``strict`` completeness instead of the default
+    replay-to-the-last-complete-frame semantics."""
 
 
 def _fsync_dir(path: str) -> None:
@@ -521,3 +544,275 @@ def replay_journal(path: Union[str, Path]):
             )
             last_tag = int(tag)
     return store, last_tag
+
+
+class TraceBatch(NamedTuple):
+    """One admitted columnar batch as the trace sidecar records it.
+
+    ``offsets[m] : offsets[m+1]`` slices market ``m``'s signals out of
+    ``source_ids``/``probabilities`` — the exact shape
+    :func:`~.pipeline.stage_settlement_plan_columnar` ingests, so a trace
+    batch re-drives the planner without any reshaping. ``now_days`` is
+    the settlement day the live run used (absolute epoch-days) and
+    ``steps`` its cycle count; both are inputs to the byte contract.
+    """
+
+    index: int
+    market_keys: Tuple[str, ...]
+    source_ids: Tuple[str, ...]
+    probabilities: np.ndarray   # f64[signals]
+    offsets: np.ndarray         # i64[markets + 1]
+    outcomes: np.ndarray        # bool[markets]
+    now_days: float
+    steps: int
+
+
+def trace_path_for(journal_path: Union[str, Path]) -> str:
+    """The conventional sidecar path for a journal: ``<journal>.trace``."""
+    return str(journal_path) + ".trace"
+
+
+class TraceWriter:
+    """Appends admitted batches to a trace sidecar.
+
+    Same framing discipline as :class:`JournalWriter`: dense frame
+    indices, CRC over header+body, a torn tail truncated on a failed
+    append, and ``resume=True`` required to append to an existing file
+    (the scan drops any torn tail first). ``fsync`` defaults to False —
+    the trace is a replayable *workload* record, not the durability tier;
+    the journal's own fsync still defines the durable point, and replay
+    is bounded by the journal tag regardless of how many trace frames
+    survived a crash.
+    """
+
+    def __init__(self, path: Union[str, Path], fsync: bool = False,
+                 resume: bool = False) -> None:
+        self._path = str(path)
+        self._fsync = fsync
+        existing = (
+            os.path.exists(self._path) and os.path.getsize(self._path) > 0
+        )
+        if existing and not resume:
+            raise ValueError(
+                f"{self._path} already holds a trace; refusing to "
+                "truncate recorded batches — pass resume=True or use a "
+                "fresh path"
+            )
+        if existing:
+            valid_end, count = _scan_trace_end(self._path)
+            self._file = open(self._path, "r+b")
+            try:
+                self._file.truncate(valid_end)
+                self._file.seek(valid_end)
+            except Exception:
+                self._file.close()
+                raise
+            self.batch_index = count
+            return
+        self._file = open(self._path, "wb")
+        try:
+            self._file.write(TRACE_MAGIC)
+            self._file.flush()
+            if fsync:
+                os.fsync(self._file.fileno())
+                _fsync_dir(self._path)
+        except Exception:
+            self._file.close()
+            raise
+        self.batch_index = 0
+
+    def append_batch(
+        self,
+        market_keys: Sequence[str],
+        source_ids: Sequence[str],
+        probabilities: np.ndarray,
+        offsets: np.ndarray,
+        outcomes: Sequence[bool],
+        now_days: float,
+        steps: int,
+    ) -> None:
+        """Record one admitted batch; frame index assigned densely."""
+        probs = np.ascontiguousarray(probabilities, dtype=np.float64)
+        offs = np.ascontiguousarray(offsets, dtype=np.int64)
+        outs = np.ascontiguousarray(
+            np.asarray(outcomes, dtype=bool), dtype=np.uint8
+        )
+        markets = len(market_keys)
+        if len(offs) != markets + 1 or len(outs) != markets:
+            raise ValueError(
+                f"offsets/outcomes shape mismatch: {markets} markets, "
+                f"{len(offs)} offsets, {len(outs)} outcomes"
+            )
+        if int(offs[-1]) != len(probs) or len(source_ids) != len(probs):
+            raise ValueError(
+                f"signal count mismatch: offsets end at {int(offs[-1])}, "
+                f"{len(probs)} probabilities, {len(source_ids)} source ids"
+            )
+        keys_blob = _pack_iso_blob(list(market_keys))
+        src_blob = _pack_iso_blob(list(source_ids))
+        header = _TRACE_HDR.pack(
+            self.batch_index, markets, len(probs), len(keys_blob),
+            len(src_blob), float(now_days), int(steps),
+        )
+        payload = b"".join(
+            (header, keys_blob, src_blob, probs.tobytes(), offs.tobytes(),
+             outs.tobytes())
+        )
+        start = self._file.tell()
+        try:
+            self._file.write(payload)
+            self._file.write(struct.pack("<I", zlib.crc32(payload)))
+            self._file.flush()
+            if self._fsync:
+                os.fsync(self._file.fileno())
+        except BaseException:
+            try:
+                self._file.truncate(start)
+                self._file.seek(start)
+            except (OSError, ValueError):
+                pass
+            raise
+        registry = metrics_registry()
+        registry.counter("replay.trace_batches").inc()
+        registry.counter("replay.trace_bytes").inc(len(payload) + 4)
+        self.batch_index += 1
+
+    def close(self) -> None:
+        if not self._file.closed:
+            self._file.close()
+
+    def __enter__(self) -> "TraceWriter":
+        return self
+
+    def __exit__(self, *exc_info: object) -> None:
+        self.close()
+
+
+def _iter_trace_frames(f):
+    """Yield ``(TraceBatch, end_offset)`` per complete CRC-valid frame,
+    stopping at the first torn/corrupt/malformed one — the same walk
+    shape as the journal's ``_iter_frames``, so writer resume and reader
+    agree on the valid end."""
+    expected = 0
+    while True:
+        header = _read_exact(f, _TRACE_HDR.size)
+        if header is None:
+            return
+        fields = _TRACE_HDR.unpack(header)
+        (index, markets, signals, keys_blob_len, src_blob_len,
+         now_days, steps) = fields
+        if index != expected:
+            return
+        body_len = (
+            keys_blob_len + src_blob_len + signals * 8 + (markets + 1) * 8
+            + markets
+        )
+        body = _read_exact(f, body_len)
+        if body is None:
+            return
+        crc_raw = _read_exact(f, 4)
+        if crc_raw is None:
+            return
+        (crc,) = struct.unpack("<I", crc_raw)
+        if zlib.crc32(header + body) != crc:
+            return
+        keys = _unpack_iso(body[:keys_blob_len], markets)
+        off = keys_blob_len
+        sources = _unpack_iso(body[off:off + src_blob_len], signals)
+        off += src_blob_len
+        probs = np.frombuffer(body, np.float64, signals, off).copy()
+        off += signals * 8
+        offsets = np.frombuffer(body, np.int64, markets + 1, off).copy()
+        off += (markets + 1) * 8
+        outcomes = np.frombuffer(body, np.uint8, markets, off).astype(bool)
+        if keys is None or sources is None or (
+            signals and (offsets[0] != 0 or offsets[-1] != signals
+                         or (np.diff(offsets) < 0).any())
+        ):
+            return  # CRC-of-garbage: stop exactly like journal replay
+        yield TraceBatch(
+            index=int(index),
+            market_keys=tuple(keys),
+            source_ids=tuple(sources),
+            probabilities=probs,
+            offsets=offsets,
+            outcomes=outcomes,
+            now_days=float(now_days),
+            steps=int(steps),
+        ), f.tell()
+        expected += 1
+
+
+def _scan_trace_end(path: str) -> Tuple[int, int]:
+    """(valid_byte_end, complete_frame_count) of a trace sidecar."""
+    with open(path, "rb") as f:
+        if _read_exact(f, len(TRACE_MAGIC)) != TRACE_MAGIC:
+            raise ValueError(f"{path}: not a BCE trace (bad magic)")
+        end = f.tell()
+        count = 0
+        for _batch, off in _iter_trace_frames(f):
+            end = off
+            count += 1
+        return end, count
+
+
+def read_trace(
+    path: Union[str, Path], strict: bool = False
+) -> List[TraceBatch]:
+    """Read a trace sidecar's complete frames, in admitted order.
+
+    A torn/CRC-failing tail frame is dropped (crash mid-append), matching
+    journal replay; ``strict=True`` raises :class:`TornTraceError`
+    instead of silently shortening the workload.
+    """
+    path = str(path)
+    batches: List[TraceBatch] = []
+    with open(path, "rb") as f:
+        if _read_exact(f, len(TRACE_MAGIC)) != TRACE_MAGIC:
+            raise ValueError(f"{path}: not a BCE trace (bad magic)")
+        end = f.tell()
+        for batch, off in _iter_trace_frames(f):
+            batches.append(batch)
+            end = off
+        f.seek(0, os.SEEK_END)
+        if strict and f.tell() != end:
+            raise TornTraceError(
+                f"{path}: trace ends mid-frame after batch "
+                f"{len(batches) - 1}; strict replay refuses a shortened "
+                "workload (re-record, or pass strict=False to replay the "
+                "complete prefix)"
+            )
+    return batches
+
+
+def extract_trace(
+    journal_path: Union[str, Path],
+    trace_path: Optional[Union[str, Path]] = None,
+    strict: bool = False,
+) -> Tuple[List[TraceBatch], Optional[int]]:
+    """The replayable workload of a recorded run: ``(batches, tag)``.
+
+    Reads the journal's durable watermark (the last complete epoch's
+    ``tag`` — the settled batch index durability covers) and the trace
+    sidecar (``<journal>.trace`` unless *trace_path* names another), and
+    returns only the trace batches the journal actually covers: a crash
+    mid-epoch leaves trace frames beyond the durable point, and replaying
+    them would "settle" batches the live run never made durable.
+    ``strict=True`` refuses — :class:`TornTraceError` — whenever the
+    bounded workload is shorter than the recorded trace (torn trace tail
+    OR journal watermark behind the trace), instead of silently
+    shortening.
+    """
+    trace_path = (
+        trace_path_for(journal_path) if trace_path is None else trace_path
+    )
+    _end, _epochs, _rows, tag = _scan_valid_end(str(journal_path))
+    batches = read_trace(trace_path, strict=strict)
+    covered = [] if tag is None else [b for b in batches if b.index <= tag]
+    if strict and len(covered) != len(batches):
+        raise TornTraceError(
+            f"{journal_path}: journal covers batches through tag={tag} "
+            f"but the trace records {len(batches)}; strict replay "
+            "refuses a workload the live run never made durable"
+        )
+    return covered, tag

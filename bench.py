@@ -214,6 +214,9 @@ def _min_of_trials(leg_name, variant_names, run_variant, trials):
                     # AOT executable that ran) — the `bce-tpu stats`
                     # hbm_read column (round 14 one-pass legs).
                     "hbm_read_bytes": out.get("hbm_read_bytes"),
+                    # Counterfactual-sweep throughput (the round-18
+                    # replay leg) — the `bce-tpu stats` replay column.
+                    "replay_batches_per_s": out.get("replay_batches_per_s"),
                 },
             )
             if name not in best or out["wall_s"] < best[name]["wall_s"]:
@@ -3954,6 +3957,170 @@ def bench_e2e_kill_soak(markets=64, batches=12, kill_after=3,
     return result
 
 
+def bench_e2e_replay_sweep(markets=2000, batches=6, mean_slots=4, steps=2,
+                           sweep_configs=16, trials=2):
+    """Round-18 counterfactual-replay leg: the K-lane sweep vs K
+    sequential replays, over one recorded workload.
+
+    Records a live ``settle_stream`` run with its trace sidecar
+    (``trace=`` — the INPUT columns per admitted batch), then re-drives
+    the trace through the replay lab two ways on identical inputs:
+
+    1. **sweep** — ONE :func:`~.replay.replay_sweep` over *sweep_configs*
+       lanes (the recorded config + altered decay half-life / capped
+       step / learning rate / band z points): plans stage+bind once,
+       every batch is one vmapped device dispatch for all lanes.
+    2. **sequential** — *sweep_configs* :func:`~.replay.replay_single`
+       calls, each paying its own store, interning pass, and 1-wide
+       program: the host cost the sweep amortises, K times over.
+
+    Acceptance (ISSUE 17): at 16 configs the sweep is **≥6×** faster
+    than sequential (``sweep_speedup``); the rebuild sweep's lane-0
+    store digest equals the live run's (``byte_equal_store``); and two
+    sweeps over the same trace produce identical ``result_digest``
+    (``run_twice_identical``). The timed number feeds the ``bce-tpu
+    stats`` replay column via ``extras.replay_batches_per_s`` (recorded
+    batches re-driven per second across all lanes).
+    """
+    import gc
+    import tempfile as _tf
+
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.cluster.recover import store_digest
+    from bayesian_consensus_engine_tpu.pipeline import settle_stream
+    from bayesian_consensus_engine_tpu.replay import (
+        RECORDED_CONFIG,
+        ReplayConfig,
+        load_trace,
+        replay_single,
+        replay_sweep,
+    )
+    from bayesian_consensus_engine_tpu.state.tensor_store import (
+        TensorReliabilityStore,
+    )
+
+    per_batch = markets // batches
+    rng = np.random.default_rng(18)
+    batch_data = []
+    for b in range(batches):
+        counts = rng.poisson(mean_slots - 1, per_batch) + 1
+        total = int(counts.sum())
+        # Half the keys recur across batches (re-settlement rows), half
+        # are fresh — the interning mix a live service actually feeds.
+        keys = [
+            f"m{m}" if m % 2 == 0 else f"b{b}-m{m}" for m in range(per_batch)
+        ]
+        sids = [f"src-{v}" for v in rng.integers(0, SOURCE_UNIVERSE, total)]
+        probs = rng.random(total)
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        outcomes = (rng.random(per_batch) < 0.5).tolist()
+        batch_data.append(((keys, sids, probs, offsets), outcomes))
+    gc.freeze()
+
+    # Lane 0 is the recorded config; the rest walk a deterministic grid
+    # over the four swept knobs (no RNG — the sweep result is pinned to
+    # be a pure function of (trace, config set)).
+    configs = [RECORDED_CONFIG] + [
+        ReplayConfig(
+            half_life_days=15.0 + 5.0 * (i % 4),
+            base_learning_rate=0.04 + 0.02 * (i % 3),
+            max_update_step=0.06 + 0.03 * (i % 3),
+            band_z=1.25 + 0.25 * (i % 4),
+        )
+        for i in range(sweep_configs - 1)
+    ]
+
+    with _tf.TemporaryDirectory() as tmp:
+        jrnl = os.path.join(tmp, "live.jrnl")
+        live_store = TensorReliabilityStore()
+        for _result in settle_stream(
+            live_store, batch_data, steps=steps, now=21_900.0,
+            journal=jrnl, trace=jrnl + ".trace", columnar=True,
+        ):
+            pass
+        live_digest = store_digest(live_store)
+        trace = load_trace(jrnl)
+
+        # Warm both arms off the clock (compiles land in the program
+        # caches; the trials time steady-state host+dispatch work).
+        warm = replay_sweep(trace, configs, rebuild=False)
+        replay_single(trace, RECORDED_CONFIG)  # 1-lane program
+        replay_single(trace, configs[1])       # 2-lane program
+
+        def run_variant(name):
+            start = time.perf_counter()
+            if name == "sweep":
+                result = replay_sweep(trace, configs, rebuild=False)
+                settled = result.lanes[0].markets_settled
+            else:
+                settled = None
+                for config in configs:
+                    lane = replay_single(trace, config)
+                    if config is RECORDED_CONFIG:
+                        settled = lane.markets_settled
+            wall = time.perf_counter() - start
+            out = {
+                "wall_s": round(wall, 4),
+                "configs": len(configs),
+                "lane0_markets_settled": settled,
+            }
+            if name == "sweep":
+                out["replay_batches_per_s"] = round(len(trace) / wall, 2)
+            return out
+
+        best = _min_of_trials(
+            "e2e_replay_sweep", ["sequential", "sweep"], run_variant,
+            trials,
+        )
+
+        # Acceptance codas, off the clock: the lane-0 byte contract
+        # (rebuild sweep == live run) and run-twice determinism.
+        full = replay_sweep(
+            trace, configs,
+            journal=os.path.join(tmp, "replay.jrnl"),
+            db_path=os.path.join(tmp, "replay.db"),
+        )
+        again = replay_sweep(trace, configs, rebuild=False)
+
+    speedup = round(
+        best["sequential"]["wall_s"] / max(best["sweep"]["wall_s"], 1e-9), 2
+    )
+    batches_per_s = best["sweep"]["replay_batches_per_s"]
+    result = {
+        "workload": (
+            f"{markets} markets x {batches} batches, {steps} steps, "
+            f"{len(configs)} configs"
+        ),
+        "sweep": best["sweep"],
+        "sequential": best["sequential"],
+        "wall_s": best["sweep"]["wall_s"],
+        "sweep_speedup": speedup,
+        "speedup_ok": bool(speedup >= 6.0) if sweep_configs >= 16 else None,
+        "replay_batches_per_s": batches_per_s,
+        "byte_equal_store": bool(full.digest == live_digest),
+        "run_twice_identical": bool(
+            warm.result_digest == again.result_digest
+        ),
+        "lane0_brier_mean": round(full.lanes[0].brier_mean, 6),
+    }
+    _ledger_record(
+        "e2e_replay_sweep", value=best["sweep"]["wall_s"], unit="s",
+        extras={
+            "loadavg_1m_before": _loadavg_1m(),
+            "replay_batches_per_s": batches_per_s,
+            "sweep_speedup": speedup,
+        },
+    )
+    print(
+        f"e2e_replay_sweep: {len(configs)} configs x {len(batch_data)} "
+        f"batches — sweep {best['sweep']['wall_s']}s vs sequential "
+        f"{best['sequential']['wall_s']}s ({speedup}x), "
+        f"byte_equal_store={result['byte_equal_store']}"
+    )
+    return result
+
+
 LEGS = {
     "probe": (leg_probe, {}, {}, 240),
     "headline_f32": (
@@ -4047,6 +4214,11 @@ LEGS = {
         dict(markets=32, batches=8, kill_after=2, interval=0.08,
              slo_s=0.25), 600,
     ),
+    "e2e_replay_sweep": (
+        bench_e2e_replay_sweep, {},
+        dict(markets=240, batches=3, steps=2, sweep_configs=4, trials=1),
+        1200,
+    ),
     "pallas_ab": (
         bench_pallas_ab, {},
         dict(num_markets=1024, slots=8, timed_steps=8,
@@ -4099,6 +4271,7 @@ DEVICE_LEG_ORDER = [
     "e2e_analytics",
     "e2e_onepass",
     "e2e_kill_soak",
+    "e2e_replay_sweep",
     "pallas_ab",
     "dryrun_multichip",
 ]
@@ -4426,6 +4599,7 @@ def compose(results, degraded, probe_info, elapsed_s, fast=False,
         "e2e_analytics": _show(results, "e2e_analytics"),
         "e2e_onepass": _show(results, "e2e_onepass"),
         "e2e_kill_soak": _show(results, "e2e_kill_soak"),
+        "e2e_replay_sweep": _show(results, "e2e_replay_sweep"),
         "per_slot_throughput": slot_updates,
         "harness": harness,
         "notes": (

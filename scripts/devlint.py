@@ -46,7 +46,23 @@ def check_file(path) -> list[str]:
 
 
 def main(argv: list[str]) -> int:
-    n_files, findings = _engine.run(argv or None, root=_ROOT)
+    # ``--cache [PATH]`` forwards the engine's lint-cache sidecar (round
+    # 18): a warm run of an unchanged tree replays cached findings
+    # without re-parsing. Everything else is a path.
+    cache = None
+    paths: list[str] = []
+    args = list(argv)
+    while args:
+        arg = args.pop(0)
+        if arg == "--cache":
+            cache = args.pop(0) if args else str(
+                _ROOT / ".lint_cache.json"
+            )
+        elif arg.startswith("--cache="):
+            cache = arg.split("=", 1)[1]
+        else:
+            paths.append(arg)
+    n_files, findings = _engine.run(paths or None, root=_ROOT, cache=cache)
     for f in findings:
         print(f.render())
     print(f"devlint: {n_files} files, {len(findings)} findings")

@@ -1269,6 +1269,66 @@ class TestServeLeg:
         ).parameters
 
 
+class TestReplaySweepLeg:
+    """The round-18 counterfactual-replay leg (``e2e_replay_sweep``) at
+    --fast shapes: one vmapped K-lane sweep A/B'd against K sequential
+    single-config replays over the same recorded trace. The replay
+    semantics (byte contract, torn tails, determinism) are pinned by
+    tests/test_replay.py; this pins the LEG contract — the JSON shape,
+    the acceptance fields, and the ``replay_batches_per_s`` ledger
+    extras record the stats table's replay column reads."""
+
+    def test_fast_leg_reports_sweep_vs_sequential(self, tmp_path):
+        from bayesian_consensus_engine_tpu.obs.ledger import (
+            RunLedger,
+            read_ledger,
+            summarize,
+        )
+
+        ledger_path = tmp_path / "replay.jsonl"
+        old = bench._LEDGER
+        bench._LEDGER = RunLedger(ledger_path, backend="cpu")
+        try:
+            result = bench.run_leg_inprocess("e2e_replay_sweep", fast=True)
+        finally:
+            bench._LEDGER.close()
+            bench._LEDGER = old
+        for key in (
+            "workload", "sweep", "sequential", "wall_s", "sweep_speedup",
+            "speedup_ok", "replay_batches_per_s", "byte_equal_store",
+            "run_twice_identical", "lane0_brier_mean",
+        ):
+            assert key in result, key
+        # The acceptance bars the fast shape CAN hold: the rebuilt
+        # lane-0 store byte-equals the live run and the sweep is
+        # run-twice deterministic. The ≥6x speedup bar is only asserted
+        # at the full 16-config shape (speedup_ok is None under 16).
+        assert result["byte_equal_store"] is True
+        assert result["run_twice_identical"] is True
+        assert result["speedup_ok"] is None
+        assert result["sweep"]["wall_s"] > 0
+        assert result["sequential"]["wall_s"] > 0
+        assert result["replay_batches_per_s"] > 0
+        assert result["sweep"]["lane0_markets_settled"] == (
+            result["sequential"]["lane0_markets_settled"]
+        )
+        json.dumps(result)
+        # The ledger rows carry the throughput the stats table renders:
+        # min-across-repeats of extras.replay_batches_per_s.
+        records = read_ledger(ledger_path)
+        band = summarize(records)["e2e_replay_sweep"]
+        assert band["replay_batches_per_s"] == pytest.approx(
+            result["replay_batches_per_s"], rel=1e-6
+        )
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_replay_sweep" in bench.LEGS
+        assert "e2e_replay_sweep" in bench.DEVICE_LEG_ORDER
+        assert "e2e_replay_sweep" in bench.compose(
+            {}, [], None, 0.0
+        )[0]["extras"]
+
+
 class TestDryrunMultichipLeg:
     """The scaled virtual-mesh leg (VERDICT r5 #3): the north-star band
     over 8 virtual devices with a REAL psum epilogue, parity-asserted

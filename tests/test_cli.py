@@ -350,3 +350,110 @@ class TestJournalExport:
         proc = run_cli(["--db", str(tmp_path / "x.db"), "journal-export", str(bad)])
         assert proc.returncode == 1
         assert "Error:" in proc.stderr
+
+
+class TestReplay:
+    """The counterfactual replay subcommand: re-drive a recorded
+    journal's trace sidecar under K altered configs without writing
+    Python. The sweep semantics live in tests/test_replay.py; this pins
+    the CLI surface — table + JSON shapes, the lane-0 digest witness,
+    the --db export, --strict, and the config-spec error paths."""
+
+    def _journal(self, tmp_path: Path) -> Path:
+        import numpy as np
+
+        from bayesian_consensus_engine_tpu.cluster.recover import (
+            store_digest,
+        )
+        from bayesian_consensus_engine_tpu.pipeline import settle_stream
+        from bayesian_consensus_engine_tpu.state.tensor_store import (
+            TensorReliabilityStore,
+        )
+
+        rng = np.random.default_rng(7)
+        batches = []
+        for b in range(2):
+            counts = rng.integers(1, 4, 6)
+            keys = [f"rp-b{b}-m{m}" if m % 2 else f"rp-m{m}" for m in range(6)]
+            sids = [f"s{v}" for v in rng.integers(0, 4, int(counts.sum()))]
+            probs = rng.random(int(counts.sum()))
+            offsets = np.concatenate([[0], np.cumsum(counts)]).astype(
+                np.int64
+            )
+            outcomes = (rng.random(6) < 0.5).tolist()
+            batches.append(((keys, sids, probs, offsets), outcomes))
+        jrnl = tmp_path / "rp.jrnl"
+        store = TensorReliabilityStore()
+        for _result in settle_stream(
+            store, batches, steps=1, now=21_800.0,
+            journal=jrnl, trace=str(jrnl) + ".trace", columnar=True,
+        ):
+            pass
+        self._live_digest = store_digest(store)
+        return jrnl
+
+    def test_json_sweep_lane0_is_the_live_run(self, tmp_path: Path):
+        jrnl = self._journal(tmp_path)
+        proc = run_cli([
+            "replay", str(jrnl),
+            "--configs", "half_life_days=12,base_learning_rate=0.05",
+            "--json",
+        ])
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["batches"] == 2
+        # The byte-contract witness: lane 0 rebuilt the recorded run.
+        assert out["digest"] == self._live_digest
+        assert len(out["lanes"]) == 2
+        assert out["lanes"][0]["delta"] == {}
+        assert out["lanes"][1]["delta"] == {
+            "half_life_days": 12.0, "base_learning_rate": 0.05,
+        }
+        for lane in out["lanes"]:
+            assert lane["marketsSettled"] == 12
+
+    def test_table_diffs_each_lane_against_recorded(self, tmp_path: Path):
+        jrnl = self._journal(tmp_path)
+        proc = run_cli(["replay", str(jrnl), "--configs", "band_z=1.25"])
+        assert proc.returncode == 0, proc.stderr
+        assert "recorded" in proc.stdout
+        assert "band_z=1.25" in proc.stdout
+        assert "brier" in proc.stdout  # the recorded->lane trailer
+
+    def test_db_exports_lane0_state(self, tmp_path: Path):
+        jrnl = self._journal(tmp_path)
+        db = tmp_path / "lane0.db"
+        proc = run_cli(["--db", str(db), "replay", str(jrnl), "--json"])
+        assert proc.returncode == 0, proc.stderr
+        assert json.loads(proc.stdout)["exportedTo"] == str(db)
+        listed = run_cli(["--db", str(db), "list-sources"])
+        assert listed.returncode == 0
+        assert json.loads(listed.stdout)["count"] > 0
+        # A fresh interchange file only: an existing target refuses.
+        proc = run_cli(["--db", str(db), "replay", str(jrnl)])
+        assert proc.returncode == 1
+        assert "already exists" in proc.stderr
+
+    def test_strict_refuses_a_torn_tail(self, tmp_path: Path):
+        jrnl = self._journal(tmp_path)
+        with open(jrnl, "r+b") as f:
+            f.truncate(jrnl.stat().st_size - 9)
+        torn = run_cli(["replay", str(jrnl), "--strict"])
+        assert torn.returncode == 1
+        assert "Error:" in torn.stderr and "durable" in torn.stderr
+        # Non-strict replays to the last joined epoch.
+        proc = run_cli(["replay", str(jrnl), "--json"])
+        assert proc.returncode == 0, proc.stderr
+        assert json.loads(proc.stdout)["batches"] == 1
+
+    def test_bad_config_spec_errors(self, tmp_path: Path):
+        jrnl = self._journal(tmp_path)
+        proc = run_cli(["replay", str(jrnl), "--configs", "nope=1"])
+        assert proc.returncode == 1
+        assert "Error:" in proc.stderr and "half_life_days" in proc.stderr
+
+    def test_graph_lane_is_python_api_only(self, tmp_path: Path):
+        jrnl = self._journal(tmp_path)
+        proc = run_cli(["replay", str(jrnl), "--configs", "graph_steps=2"])
+        assert proc.returncode == 1
+        assert "MarketGraph" in proc.stderr

@@ -188,6 +188,26 @@ _CASES = [
         f"from {PKG}.core.batch import topology_fingerprint\n",
     ),
     (
+        # Round 18: replay (the counterfactual replay lab) shares the
+        # orchestration tier — importing the CLI above it is an upward
+        # import; re-driving serve's SessionDriver and the sweep step
+        # below is the designed direction.
+        "LY301",
+        f"{PKG}/replay/case.py",
+        f"from {PKG}.cli import build_parser\n",
+        f"from {PKG}.serve.driver import SessionDriver\n"
+        f"from {PKG}.parallel.sharded import build_replay_sweep_step\n",
+    ),
+    (
+        # ...and the inverse: an engine tier importing replay would let
+        # a kernel re-drive the harness that re-drives it — the numeric
+        # rule flags it (replay sits at the serve tier).
+        "LY301",
+        f"{PKG}/parallel/case.py",
+        f"from {PKG}.replay.lab import replay_sweep\n",
+        f"from {PKG}.ops.cycle_math import CycleParams\n",
+    ),
+    (
         "LY302",
         f"{PKG}/core/case.py",
         "import jax.numpy as jnp\n\nSENTINEL = jnp.int32(0)\n",

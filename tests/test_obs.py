@@ -453,7 +453,7 @@ class TestTimeline:
         assert obs.PHASES == (
             "pack", "upload", "state_adopt", "settle_dispatch",
             "analytics", "fetch", "journal_fsync", "journal_async_wait",
-            "checkpoint", "interchange_export",
+            "checkpoint", "interchange_export", "replay",
         )
 
 

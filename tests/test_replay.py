@@ -1,0 +1,358 @@
+"""Counterfactual replay lab: the round-18 acceptance pins.
+
+Four non-negotiable contracts:
+
+* **Lane-0 byte contract** — re-driving a recorded journal's trace
+  sidecar under the recorded config reproduces the live run's settled
+  state byte-for-byte: :func:`~.cluster.recover.store_digest` AND the
+  flushed SQLite file bytes, flat and sharded-resident.
+* **Torn tails** — a journal cut mid-frame replays to its last joined
+  epoch (the durable-tag bound, never past it); ``strict=True`` refuses
+  (:class:`~.state.journal.TornTraceError`) instead of silently
+  shortening the workload. Same for a trace sidecar cut mid-frame.
+* **Sweep determinism** — the sweep result is a pure function of
+  (trace, config set): run twice, identical ``result_digest`` and
+  lane-state bytes; a sweep lane equals the same config replayed alone.
+* **Bounded shed-stderr map** — the variance-aware shed ranking's
+  per-market stderr map holds at most ``band_stderr_bound`` markets,
+  eviction is deterministic (oldest settled-age first, ties by market
+  id) and NEVER changes the shed order for live markets.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from bayesian_consensus_engine_tpu.cluster.recover import store_digest
+from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+from bayesian_consensus_engine_tpu.pipeline import settle_stream
+from bayesian_consensus_engine_tpu.replay import (
+    RECORDED_CONFIG,
+    ReplayConfig,
+    load_trace,
+    replay_single,
+    replay_sweep,
+    trace_from_batches,
+)
+from bayesian_consensus_engine_tpu.serve import (
+    ConsensusService,
+    QosClass,
+    ShedError,
+)
+from bayesian_consensus_engine_tpu.serve.driver import drive_trace
+from bayesian_consensus_engine_tpu.state.journal import (
+    TornTraceError,
+    read_trace,
+    trace_path_for,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 21_900.0
+
+# Two counterfactual lanes walking the swept knobs — a deterministic
+# grid, no RNG (the sweep must be a pure function of (trace, configs)).
+ALTERED = (
+    ReplayConfig(half_life_days=12.0, base_learning_rate=0.05),
+    ReplayConfig(max_update_step=0.04, band_z=1.25),
+)
+
+
+def _columnar_batches(n_batches=3, per_batch=16, seed=18):
+    """A small service-shaped workload: half the keys recur across
+    batches (the refresh path), half are fresh (the intern path)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for b in range(n_batches):
+        counts = rng.integers(1, 5, per_batch)
+        total = int(counts.sum())
+        keys = [
+            f"m{m}" if m % 2 == 0 else f"b{b}-m{m}"
+            for m in range(per_batch)
+        ]
+        sids = [f"src-{v}" for v in rng.integers(0, 12, total)]
+        probs = rng.random(total)
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        outcomes = (rng.random(per_batch) < 0.5).tolist()
+        out.append(((keys, sids, probs, offsets), outcomes))
+    return out
+
+
+def _record_live(tmp_path, batches, steps=2, name="live.jrnl"):
+    """Run the REAL streamed service loop with journal + trace sidecar;
+    returns (settled store, journal path)."""
+    jrnl = str(tmp_path / name)
+    store = TensorReliabilityStore()
+    for _result in settle_stream(
+        store, batches, steps=steps, now=NOW,
+        journal=jrnl, trace=jrnl + ".trace", columnar=True,
+    ):
+        pass
+    return store, jrnl
+
+
+def _truncate(path, drop=9):
+    """Cut *drop* bytes off the file's tail — mid-frame, the way a crash
+    tears an append (frames are far larger than 9 bytes)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - drop)
+
+
+class TestTraceSidecar:
+    """The trace sidecar records the INPUTS the journal's deltas came
+    from, in admitted order, replayable bit-for-bit."""
+
+    def test_roundtrip_preserves_the_recorded_workload(self, tmp_path):
+        batches = _columnar_batches()
+        _store, jrnl = _record_live(tmp_path, batches)
+        trace = read_trace(trace_path_for(jrnl))
+        assert [b.index for b in trace] == [0, 1, 2]
+        for b, ((keys, sids, probs, offsets), outcomes) in zip(
+            trace, batches
+        ):
+            assert list(b.market_keys) == keys
+            assert list(b.source_ids) == sids
+            np.testing.assert_array_equal(b.probabilities, probs)
+            np.testing.assert_array_equal(b.offsets, offsets)
+            assert b.outcomes.tolist() == outcomes
+            assert b.steps == 2
+        # The stream's now=float cadence: one day per batch.
+        assert [b.now_days for b in trace] == [NOW, NOW + 1, NOW + 2]
+
+    def test_load_trace_covers_a_healthy_journal_fully(self, tmp_path):
+        _store, jrnl = _record_live(tmp_path, _columnar_batches())
+        assert len(load_trace(jrnl)) == 3
+        assert len(load_trace(jrnl, strict=True)) == 3
+
+    def test_trace_from_batches_is_replay_equivalent(self, tmp_path):
+        """A serving front end's batch log, converted in-process, drives
+        the same rebuild as the recorded sidecar."""
+        batches = _columnar_batches()
+        live, _jrnl = _record_live(tmp_path, batches)
+        trace = trace_from_batches(batches, now=NOW, steps=2)
+        result = replay_sweep(trace)
+        assert result.digest == store_digest(live)
+
+
+class TestTornTails:
+    """Satellite: torn/truncated journal tails entering the replay lab."""
+
+    def test_journal_cut_mid_frame_replays_to_last_joined_epoch(
+        self, tmp_path
+    ):
+        batches = _columnar_batches()
+        _store, jrnl = _record_live(tmp_path, batches)
+        _truncate(jrnl)
+        trace = load_trace(jrnl)
+        # The torn final epoch is NOT replayed: the workload stops at
+        # the journal's durable tag...
+        assert len(trace) == 2
+        # ...and the bounded replay equals a live run that only ever saw
+        # those batches — byte-for-byte.
+        expect, _ = _record_live(tmp_path, batches[:2], name="short.jrnl")
+        assert replay_sweep(trace).digest == store_digest(expect)
+
+    def test_strict_refuses_a_torn_journal(self, tmp_path):
+        _store, jrnl = _record_live(tmp_path, _columnar_batches())
+        _truncate(jrnl)
+        with pytest.raises(TornTraceError, match="durable"):
+            load_trace(jrnl, strict=True)
+        # TornTraceError is a ValueError: pre-round-18 callers that
+        # guard extraction with ValueError keep working.
+        assert issubclass(TornTraceError, ValueError)
+
+    def test_torn_trace_tail_drops_only_the_torn_frame(self, tmp_path):
+        _store, jrnl = _record_live(tmp_path, _columnar_batches())
+        _truncate(trace_path_for(jrnl))
+        assert len(read_trace(trace_path_for(jrnl))) == 2
+        assert len(load_trace(jrnl)) == 2
+        with pytest.raises(TornTraceError, match="mid-frame"):
+            load_trace(jrnl, strict=True)
+
+
+class TestLane0ByteContract:
+    """Lane 0 pinned to the recorded config IS the live run."""
+
+    def test_flat_rebuild_matches_live_digest_and_sqlite_bytes(
+        self, tmp_path
+    ):
+        live, jrnl = _record_live(tmp_path, _columnar_batches())
+        result = replay_sweep(load_trace(jrnl), ALTERED)
+        assert result.digest == store_digest(live)
+        # Same settled state ⇒ same checkpoint file, byte for byte.
+        p_live = tmp_path / "live.db"
+        p_replay = tmp_path / "replay.db"
+        live.flush_to_sqlite(p_live)
+        result.store.flush_to_sqlite(p_replay)
+        assert p_live.read_bytes() == p_replay.read_bytes()
+
+    def test_sharded_resident_rebuild_matches_live_digest(self, tmp_path):
+        live, jrnl = _record_live(tmp_path, _columnar_batches())
+        rebuilt = TensorReliabilityStore()
+        drive_trace(rebuilt, load_trace(jrnl), mesh=make_mesh())
+        assert store_digest(rebuilt) == store_digest(live)
+
+
+class TestSweepDeterminism:
+    """The sweep is a pure function of (trace, config set)."""
+
+    def test_run_twice_identical(self, tmp_path):
+        _store, jrnl = _record_live(tmp_path, _columnar_batches())
+        trace = load_trace(jrnl)
+        first = replay_sweep(trace, ALTERED, rebuild=False)
+        second = replay_sweep(trace, ALTERED, rebuild=False)
+        assert first.result_digest == second.result_digest
+        for a, b in zip(first.lane_state, second.lane_state):
+            assert a.tobytes() == b.tobytes()
+
+    def test_lane0_is_always_the_recorded_config(self, tmp_path):
+        _store, jrnl = _record_live(tmp_path, _columnar_batches())
+        result = replay_sweep(load_trace(jrnl), ALTERED, rebuild=False)
+        assert result.lanes[0].config == RECORDED_CONFIG
+        assert len(result.lanes) == 1 + len(ALTERED)
+        assert set(result.by_config()) == {RECORDED_CONFIG, *ALTERED}
+
+    def test_altered_lanes_actually_diverge(self, tmp_path):
+        _store, jrnl = _record_live(tmp_path, _columnar_batches())
+        result = replay_sweep(load_trace(jrnl), ALTERED, rebuild=False)
+        reliability = result.lane_state[0]
+        assert not np.array_equal(reliability[0], reliability[1])
+        # The band_z lane reads back through the band-width metric.
+        by = result.by_config()
+        assert by[ALTERED[1]].band_width_sum != pytest.approx(
+            by[RECORDED_CONFIG].band_width_sum
+        )
+
+    def test_replay_single_equals_the_sweep_lane(self, tmp_path):
+        """The sequential baseline and the vmapped lane run the SAME
+        per-lane math — K-lane batching must not change any lane."""
+        _store, jrnl = _record_live(tmp_path, _columnar_batches())
+        trace = load_trace(jrnl)
+        sweep = replay_sweep(trace, ALTERED, rebuild=False).by_config()
+        for config in (RECORDED_CONFIG,) + ALTERED:
+            alone = replay_single(trace, config)
+            lane = sweep[config]
+            assert alone.markets_settled == lane.markets_settled
+            assert alone.brier_sum == pytest.approx(
+                lane.brier_sum, rel=1e-6
+            )
+            assert alone.band_width_sum == pytest.approx(
+                lane.band_width_sum, rel=1e-6
+            )
+
+
+class TestSweepValidation:
+    def test_empty_trace_refuses(self):
+        with pytest.raises(ValueError, match="empty trace"):
+            replay_sweep([])
+
+    def test_mixed_step_counts_refuse(self, tmp_path):
+        _store, jrnl = _record_live(tmp_path, _columnar_batches())
+        trace = load_trace(jrnl)
+        trace[-1] = trace[-1]._replace(steps=trace[-1].steps + 1)
+        with pytest.raises(ValueError, match="mixes step counts"):
+            replay_sweep(trace, rebuild=False)
+
+    def test_graph_lane_without_graph_refuses(self, tmp_path):
+        _store, jrnl = _record_live(tmp_path, _columnar_batches())
+        with pytest.raises(ValueError, match="graph_steps > 0"):
+            replay_sweep(
+                load_trace(jrnl),
+                (ReplayConfig(graph_steps=2),),
+                rebuild=False,
+            )
+
+
+class TestBoundedShedStderr:
+    """PR-15 follow-up: the shed-ranking stderr map stops growing
+    without bound, and eviction never changes the shed order for live
+    markets."""
+
+    def test_bound_must_be_positive(self):
+        with pytest.raises(ValueError, match="band_stderr_bound"):
+            ConsensusService(
+                TensorReliabilityStore(), steps=1, now=NOW,
+                band_stderr_bound=0,
+            )
+
+    def test_eviction_is_deterministic_oldest_first_ties_by_id(self):
+        store = TensorReliabilityStore()
+        survivors = []
+
+        async def main():
+            service = ConsensusService(
+                store, steps=1, now=NOW, max_batch=64, max_delay_s=None,
+                band_stderr_bound=3,
+            )
+            # Three seed waves = three settled-age stamps. Nothing is
+            # pending, so eviction is purely (age, market id) ascending:
+            # the wave-1 pair goes first, 'w1-a' before 'w1-b'.
+            service.seed_band_stderr({"w1-b": 0.2, "w1-a": 0.4})
+            service.seed_band_stderr({"w2-c": 0.3})
+            service.seed_band_stderr({"w3-d": 0.1, "w3-e": 0.5})
+            survivors.extend(sorted(service.market_band_stderr))
+            await service.drain()
+            await service.close()
+
+        asyncio.run(main())
+        assert survivors == ["w2-c", "w3-d", "w3-e"]
+
+    def test_eviction_never_changes_live_shed_order(self):
+        """The satellite pin: force eviction while the live markets'
+        overflow trace is in flight — the victim sequence must equal the
+        unbounded run's, and only non-live entries may be evicted."""
+        unbounded = self._collect_victims(bound=4096, stale=False)
+        bounded = self._collect_victims(bound=3, stale=True)
+        assert unbounded == ["m-wide", "m-mid", "m-narrow"]
+        assert bounded == unbounded
+
+    def _collect_victims(self, bound, stale):
+        store = TensorReliabilityStore()
+        victims = []
+
+        async def main():
+            service = ConsensusService(
+                store, steps=1, now=NOW, max_batch=64, max_delay_s=None,
+                qos=[QosClass("be", 3600.0, 3, policy="shed_oldest")],
+                band_stderr_bound=bound,
+            )
+            pending = {}
+            for market in ("m-narrow", "m-wide", "m-mid"):
+                pending[market] = service.submit(
+                    market, [("s", 0.6)], True, qos_class="be"
+                )
+            service.seed_band_stderr(
+                {"m-wide": 0.40, "m-mid": 0.20, "m-narrow": 0.05}
+            )
+            if stale:
+                # Two younger non-live entries push the map past
+                # bound=3. Live (pending) markets are NEVER evicted —
+                # the stale newcomers go instead, so the ranking the
+                # shed policy reads is untouched.
+                service.seed_band_stderr(
+                    {"z-stale-1": 0.90, "z-stale-2": 0.95}
+                )
+                assert sorted(service.market_band_stderr) == [
+                    "m-mid", "m-narrow", "m-wide",
+                ]
+            for i in range(3):
+                pending[f"m-fresh-{i}"] = service.submit(
+                    f"m-fresh-{i}", [("s", 0.6)], True, qos_class="be"
+                )
+                for market, future in list(pending.items()):
+                    if future.done() and isinstance(
+                        future.exception(), ShedError
+                    ):
+                        victims.append(market)
+                        del pending[market]
+            await service.drain()
+            await service.close()
+
+        asyncio.run(main())
+        return victims
