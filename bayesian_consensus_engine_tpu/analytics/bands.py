@@ -80,6 +80,19 @@ class AnalyticsOptions:
     outputs, sources axis unsharded), ``"auto"`` the honesty-guarded
     shape tuner (knob ``settle_kernel``; the kernel ships per shape
     only when it strictly beat XLA on the same clock).
+
+    **Round 18 (infer/).** ``inference`` takes an
+    :class:`~.infer.bp.InferenceOptions`: the graph sweep upgrades to
+    moment-pair belief propagation (precision-weighted mixing seeded
+    from the band stderr; propagated output becomes a
+    :class:`~.ops.propagate.PropagatedBeliefs`), optionally with the
+    deterministic residual early-exit. ``blocks`` takes a
+    :class:`~.infer.blocks.MarketBlocks`: constraint-typed
+    combinatorial declarations compiled to graph edges when no
+    ``graph=`` is given, plus the deterministic post-sweep projection
+    applied to the propagated means. Both are typed loosely here —
+    analytics (layer 6) must not import infer (layer 7); the pipeline
+    (layer 8) imports both and validates.
     """
 
     z: float = Z_95
@@ -89,6 +102,8 @@ class AnalyticsOptions:
     precision: int = 6
     tiebreak: "bool | str" = True
     kernel: str = "xla"
+    inference: Optional[object] = None
+    blocks: Optional[object] = None
 
 
 def _tuned_chunk_slots(mesh: Mesh, z: float, shape: tuple) -> "int | None":

@@ -227,7 +227,8 @@ def _parse_replay_config(spec: str):
 
     Fields are the sweep's knobs (``half_life_days``, ``decay_floor``,
     ``base_learning_rate``, ``max_update_step``, ``band_z``,
-    ``graph_damping``, ``graph_steps``); unnamed fields keep the
+    ``graph_damping``, ``graph_steps``, ``graph_tol``); unnamed
+    fields keep the
     recorded constants, so ``--configs half_life_days=20`` is "the live
     run, but with a 20-day decay half-life".
     """
@@ -869,7 +870,8 @@ def build_parser() -> argparse.ArgumentParser:
         help=(
             "one counterfactual lane (repeatable); fields: "
             "half_life_days, decay_floor, base_learning_rate, "
-            "max_update_step, band_z, graph_damping, graph_steps — "
+            "max_update_step, band_z, graph_damping, graph_steps, "
+            "graph_tol — "
             "e.g. --configs half_life_days=20,max_update_step=0.05"
         ),
     )

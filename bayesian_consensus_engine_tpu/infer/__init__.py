@@ -1,0 +1,52 @@
+"""infer/ — MRF-grade graph inference over correlated markets.
+
+Round 18's new subsystem (LY301 layer 7, between ``analytics`` and
+``pipeline``/``serve``): the graph sweep grows from a fixed-iteration
+point relaxation into moment-propagating, convergence-aware belief
+propagation, and the combinatorial-market workload opens on top of it.
+
+* :mod:`~.infer.bp` — :class:`InferenceOptions` (the moments /
+  early-exit / depth knobs carried by
+  ``AnalyticsOptions(inference=...)``) and
+  :func:`propagate_beliefs`, the host-facing single-call form of
+  :func:`~.ops.propagate.bp_sweep_math`.
+* :mod:`~.infer.partition` — cross-band MarketGraph partitioning:
+  band-local CSR blocks plus an explicit halo exchange of boundary
+  market moments, bit-equal to the whole-axis sweep (the PR 11
+  follow-up that lets banded sessions serve graph analytics).
+* :mod:`~.infer.blocks` — combinatorial market blocks:
+  constraint-typed edges (``mutually_exclusive`` partitions,
+  ``implies`` chains) compiled to MarketGraph edges plus a
+  deterministic post-sweep projection.
+
+The device math itself lives in ``ops/propagate.py`` (layer 1, obs-
+and clock-free); this package is the orchestration and workload layer
+over it. Everything here is ADDITIVE analytics: point consensus,
+store, journal, and SQLite bytes are untouched (the byte contract
+pinned by tests/test_infer.py and tests/test_analytics.py).
+"""
+
+from bayesian_consensus_engine_tpu.ops.propagate import (  # noqa: F401
+    PropagatedBeliefs,
+)
+
+from .blocks import MarketBlock, MarketBlocks  # noqa: F401
+from .bp import InferenceOptions, propagate_beliefs  # noqa: F401
+from .partition import (  # noqa: F401
+    BandedGraph,
+    banded_bp_sweep,
+    exchange_halos,
+    partition_csr,
+)
+
+__all__ = [
+    "BandedGraph",
+    "InferenceOptions",
+    "MarketBlock",
+    "MarketBlocks",
+    "PropagatedBeliefs",
+    "banded_bp_sweep",
+    "exchange_halos",
+    "partition_csr",
+    "propagate_beliefs",
+]

@@ -1,0 +1,111 @@
+"""Belief-propagation options and the host-facing sweep entry.
+
+:class:`InferenceOptions` is the knob block that rides
+``AnalyticsOptions(inference=...)`` into
+:meth:`~.pipeline.ShardedSettlementSession.settle_with_analytics` (and
+therefore ``ConsensusService(analytics=...)``): it upgrades the graph
+sweep from the legacy point relaxation to the moment-pair form and
+optionally arms the deterministic adaptive early-exit. The device math
+is :func:`~.ops.propagate.bp_sweep_math`; this module only resolves
+defaults against the :class:`~.analytics.graph.MarketGraph` the sweep
+runs over.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from bayesian_consensus_engine_tpu.ops.propagate import (
+    PropagatedBeliefs,
+    bp_sweep_math,
+)
+
+
+@dataclass(frozen=True)
+class InferenceOptions:
+    """How the correlated-market sweep runs (round 18).
+
+    ``moments=True`` (the default) propagates ``(mean, variance)``
+    pairs — neighbour mixing is precision-weighted, the variance seed
+    is the band stderr, and the propagated analytics output becomes a
+    :class:`~.ops.propagate.PropagatedBeliefs`. ``tol`` arms the
+    deterministic adaptive early-exit: the sweep stops once the
+    all-reduced ``max |Δmean|`` residual drops to the tolerance,
+    within the static ``max_steps`` bound (``None`` → the graph's own
+    ``steps``). ``damping=None`` likewise defers to the graph's λ.
+    The iteration count is a pure function of the inputs and identical
+    on every mesh factorisation — see ops/propagate.py for the
+    determinism argument.
+    """
+
+    moments: bool = True
+    tol: Optional[float] = None
+    max_steps: Optional[int] = None
+    damping: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.tol is not None and not self.tol > 0:
+            raise ValueError(
+                f"tol={self.tol!r}: a positive residual tolerance, or "
+                "None for the fixed-depth sweep"
+            )
+        if self.max_steps is not None and self.max_steps < 0:
+            raise ValueError(f"max_steps={self.max_steps!r}: must be >= 0")
+        if self.damping is not None and not 0.0 <= self.damping <= 1.0:
+            raise ValueError(
+                f"damping={self.damping!r}: must lie in [0, 1]"
+            )
+        if not self.moments and self.tol is not None:
+            raise ValueError(
+                "tol (the adaptive early-exit) rides the moments sweep "
+                "— set moments=True"
+            )
+
+    def resolve(self, graph) -> "tuple[float, int, Optional[float]]":
+        """``(damping, max_steps, tol)`` with graph defaults filled in."""
+        damping = self.damping if self.damping is not None else graph.damping
+        max_steps = (
+            self.max_steps if self.max_steps is not None else graph.steps
+        )
+        return float(damping), int(max_steps), self.tol
+
+
+def propagate_beliefs(
+    means,
+    variances,
+    graph,
+    market_keys,
+    padded_total: int,
+    *,
+    options: InferenceOptions | None = None,
+) -> PropagatedBeliefs:
+    """One-call host form: align the graph, run the moment sweep.
+
+    ``means``/``variances`` are per-market vectors over *market_keys*
+    padded to *padded_total* (NaN for markets without evidence —
+    exactly the session's consensus / band-stderr² columns).
+    Returns :class:`~.ops.propagate.PropagatedBeliefs` over the same
+    padded axis. Single-shard (``axis_name=None``); the sharded form
+    lives inside the fused analytics program
+    (:func:`~.parallel.sharded.build_cycle_analytics_loop`).
+    """
+    import jax.numpy as jnp
+
+    options = options or InferenceOptions()
+    damping, max_steps, tol = options.resolve(graph)
+    neighbor_idx, neighbor_w = graph.align(market_keys, padded_total)
+    mean, var, iters, residual = bp_sweep_math(
+        jnp.asarray(means),
+        jnp.asarray(variances) if options.moments else None,
+        neighbor_idx,
+        neighbor_w,
+        damping=damping,
+        max_steps=max_steps,
+        tol=tol,
+    )
+    stderr = (
+        jnp.sqrt(var) if var is not None
+        else jnp.full_like(mean, jnp.nan)
+    )
+    return PropagatedBeliefs(mean, stderr, iters, residual)

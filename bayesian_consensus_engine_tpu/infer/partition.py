@@ -1,0 +1,269 @@
+"""Cross-band MarketGraph partitioning with explicit halo exchange.
+
+The PR 11 follow-up: band plans split the markets axis into
+shared-nothing contiguous row ranges, but the graph sweep gathers
+neighbours from the GLOBAL axis — so banded sessions historically
+refused graph analytics (``ClusterModeUnsupported``). This module
+closes that gap structurally: :func:`partition_csr` splits the aligned
+dense neighbour blocks (:meth:`~.analytics.graph.MarketGraph.align`)
+into band-local blocks whose out-of-band references are remapped onto
+an explicit per-band **halo** — the sorted set of boundary market
+positions owned by other bands — and :func:`banded_bp_sweep` runs the
+moment sweep band-by-band, exchanging only halo moments between
+iterations.
+
+Bit parity is the whole point, and it falls out of the sweep's shape:
+every per-row update in :func:`~.ops.propagate.bp_sweep_math` reads
+exactly the row's neighbour values and reduces row-locally, so a band
+iterating over ``[own rows ; halo values]`` sees the identical
+operands in the identical order as the whole-axis sweep — the ghost-
+zone argument. The convergence residual is a max-reduce, exactly
+associative, so folding per-band maxima reproduces the global residual
+bit-for-bit and every band agrees on the adaptive trip count (pinned
+by tests/test_infer.py).
+
+Host-level orchestration (layer 7): the device math stays in
+ops/propagate.py; bands here are Python-loop sequential, which is the
+honest single-process form of the multi-host exchange.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from functools import partial
+
+import jax
+
+from bayesian_consensus_engine_tpu.ops.propagate import VAR_EPS
+
+
+class BandBlock(NamedTuple):
+    """One band's local view of the dense neighbour blocks.
+
+    ``neighbor_idx`` is remapped: position ``p < rows`` is the band's
+    own row ``lo + p``; position ``p >= rows`` is halo entry
+    ``p - rows``; ``-1`` stays padding. ``halo`` holds the GLOBAL
+    positions this band must import each iteration, sorted ascending;
+    ``halo_owner``/``halo_local`` locate each entry in its owning
+    band's local array (the exchange routing table).
+    """
+
+    lo: int
+    hi: int
+    neighbor_idx: np.ndarray
+    neighbor_w: np.ndarray
+    halo: np.ndarray
+    halo_owner: np.ndarray
+    halo_local: np.ndarray
+
+
+class BandedGraph(NamedTuple):
+    """The partitioned graph: per-band blocks + exchange metadata."""
+
+    blocks: Tuple[BandBlock, ...]
+    num_markets: int
+    cross_edges: int
+
+
+def partition_csr(
+    neighbor_idx,
+    neighbor_w,
+    bands: Sequence[Tuple[int, int]],
+) -> BandedGraph:
+    """Split aligned ``(T, D)`` neighbour blocks into band-local blocks.
+
+    *bands* is a sequence of ``(lo, hi)`` row ranges that must tile
+    ``[0, T)`` contiguously in order (the band-plan layout). Each
+    band's out-of-band neighbour references are collected into its
+    halo and remapped; ``cross_edges`` counts the remapped references
+    (the exchange volume the halo saves relative to a full gather).
+    """
+    idx = np.asarray(neighbor_idx, np.int32)
+    w = np.asarray(neighbor_w, np.float32)
+    total = idx.shape[0]
+    spans = [(int(lo), int(hi)) for lo, hi in bands]
+    cursor = 0
+    for lo, hi in spans:
+        if lo != cursor or hi <= lo:
+            raise ValueError(
+                f"bands must tile [0, {total}) contiguously in order; "
+                f"got span ({lo}, {hi}) at cursor {cursor}"
+            )
+        cursor = hi
+    if cursor != total:
+        raise ValueError(
+            f"bands cover [0, {cursor}) but the neighbour blocks have "
+            f"{total} rows"
+        )
+
+    los = np.asarray([lo for lo, _ in spans], np.int64)
+    blocks = []
+    cross_edges = 0
+    for band_index, (lo, hi) in enumerate(spans):
+        rows = idx[lo:hi]
+        valid = rows >= 0
+        local = valid & (rows >= lo) & (rows < hi)
+        remote = valid & ~local
+        cross_edges += int(remote.sum())
+        halo = np.unique(rows[remote]).astype(np.int32)
+        size = hi - lo
+        remapped = np.full_like(rows, -1)
+        remapped[local] = rows[local] - lo
+        if halo.size:
+            remapped[remote] = size + np.searchsorted(
+                halo, rows[remote]
+            ).astype(np.int32)
+        owner = (
+            np.searchsorted(los, halo, side="right").astype(np.int32) - 1
+        )
+        halo_local = halo - los[owner].astype(np.int32)
+        blocks.append(BandBlock(
+            lo=lo,
+            hi=hi,
+            neighbor_idx=remapped,
+            neighbor_w=w[lo:hi],
+            halo=halo,
+            halo_owner=owner,
+            halo_local=halo_local.astype(np.int32),
+        ))
+    return BandedGraph(
+        blocks=tuple(blocks), num_markets=total, cross_edges=cross_edges
+    )
+
+
+def exchange_halos(band_values, banded: BandedGraph):
+    """One exchange round: each band's halo values, gathered from owners.
+
+    *band_values* is the per-band list of local vectors; returns the
+    per-band list of halo vectors (empty where a band needs nothing).
+    Only halo positions move — the explicit-exchange contract; no band
+    ever materialises the global axis.
+    """
+    out = []
+    for block in banded.blocks:
+        if block.halo.size == 0:
+            out.append(jnp.zeros((0,), jnp.float32))
+            continue
+        vals = jnp.zeros((block.halo.size,), jnp.float32)
+        for owner in np.unique(block.halo_owner):
+            sel = block.halo_owner == owner
+            vals = vals.at[np.where(sel)[0]].set(
+                jnp.asarray(band_values[owner], jnp.float32)[
+                    block.halo_local[sel]
+                ]
+            )
+        out.append(vals)
+    return out
+
+
+# Compiled (not eager) on purpose: the whole-axis sweep's fori body is
+# an XLA-compiled program, and XLA's instruction selection (FMA
+# contraction) rounds differently from op-by-op eager dispatch — the
+# band step must go through the same compiler to hold bit parity.
+@partial(jax.jit, static_argnames=("moments", "damping", "has_halo"))
+def _band_step_math(
+    v, s, halo_v, halo_s, idx, raw_w, *,
+    moments: bool, damping: float, has_halo: bool,
+):
+    """One band's sweep iteration — op-for-op the whole-axis body."""
+    f32 = jnp.float32
+    weights = jnp.where(idx >= 0, raw_w.astype(f32), f32(0.0))
+    lam = f32(damping)
+    keep = f32(1.0) - lam
+    full = jnp.concatenate([v, halo_v]) if has_halo else v
+    nb = full[jnp.clip(idx, 0)]
+    ok = (idx >= 0) & jnp.isfinite(nb)
+    if moments:
+        full_s = jnp.concatenate([s, halo_s]) if has_halo else s
+        nb_var = full_s[jnp.clip(idx, 0)]
+        ok = ok & jnp.isfinite(nb_var)
+        prec = f32(1.0) / (nb_var + f32(VAR_EPS))
+        w = jnp.where(ok, weights * prec, f32(0.0))
+    else:
+        w = jnp.where(ok, weights, f32(0.0))
+    wsum = jnp.sum(w, axis=-1)
+    wval = jnp.sum(w * jnp.where(ok, nb, f32(0.0)), axis=-1)
+    mixes = (wsum > 0) & jnp.isfinite(v)
+    denom = jnp.where(wsum > 0, wsum, f32(1.0))
+    blended = keep * v + lam * (wval / denom)
+    new_v = jnp.where(mixes, blended, v)
+    if moments:
+        wvar = jnp.sum(w * w * jnp.where(ok, nb_var, f32(0.0)), axis=-1)
+        blended_s = keep * keep * s + lam * lam * (
+            wvar / (denom * denom)
+        )
+        new_s = jnp.where(mixes, blended_s, s)
+    else:
+        new_s = None
+    delta = jnp.max(jnp.where(mixes, jnp.abs(new_v - v), f32(0.0)))
+    return new_v, new_s, delta
+
+
+def banded_bp_sweep(
+    means,
+    variances,
+    banded: BandedGraph,
+    *,
+    damping: float,
+    max_steps: int,
+    tol: Optional[float] = None,
+):
+    """The banded moment sweep: halo exchange between iterations.
+
+    Same signature shape and return as
+    :func:`~.ops.propagate.bp_sweep_math` —
+    ``(means, variances, iters_run, residual)`` over the global padded
+    axis — and bit-equal to it on the same inputs (the ghost-zone
+    argument, pinned by tests/test_infer.py). The residual each
+    iteration is the exact fold of per-band maxima, so the adaptive
+    trip count is identical on every banding.
+    """
+    f32 = jnp.float32
+    means = jnp.asarray(means, f32)
+    moments = variances is not None
+    if moments:
+        variances = jnp.asarray(variances, f32)
+    band_v = [means[b.lo:b.hi] for b in banded.blocks]
+    band_s = (
+        [variances[b.lo:b.hi] for b in banded.blocks] if moments
+        else [None] * len(banded.blocks)
+    )
+    empty = jnp.zeros((0,), f32)
+    iters = 0
+    residual = float("inf")
+    for _ in range(max(0, int(max_steps))):
+        if tol is not None and not residual > tol:
+            break
+        halos_v = exchange_halos(band_v, banded)
+        halos_s = (
+            exchange_halos(band_s, banded) if moments
+            else [empty] * len(banded.blocks)
+        )
+        deltas = []
+        for j, block in enumerate(banded.blocks):
+            band_v[j], band_s[j], delta = _band_step_math(
+                band_v[j], band_s[j], halos_v[j], halos_s[j],
+                jnp.asarray(block.neighbor_idx),
+                jnp.asarray(block.neighbor_w),
+                moments=moments,
+                damping=float(damping),
+                has_halo=bool(block.halo.size),
+            )
+            deltas.append(float(delta))
+        residual = max(deltas) if deltas else 0.0
+        iters += 1
+    out_v = jnp.concatenate(band_v) if band_v else means
+    out_s = jnp.concatenate(band_s) if moments else None
+    if iters == 0:
+        residual = 0.0
+    return (
+        out_v,
+        out_s,
+        jnp.int32(iters),
+        jnp.asarray(residual, f32),
+    )

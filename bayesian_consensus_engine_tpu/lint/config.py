@@ -52,25 +52,31 @@ LAYERS: dict[str, int] = {
     # journal, and is orchestrated by pipeline/serve and the soak
     # scripts — importable from above, never importing upward.
     "cluster": 6,
+    # infer (round 18) sits between analytics and orchestration: it
+    # composes analytics' graph alignment with the ops sweep math
+    # (moment-pair BP, band partitioning, combinatorial blocks) and is
+    # consumed by pipeline/serve — importable from above, never
+    # importing upward into the tiers that orchestrate it.
+    "infer": 7,
     # pipeline and serve share a layer: settle_stream runs on the serve
     # layer's SessionDriver while serve's coalescer builds plans through
     # pipeline — one orchestration tier, two faces (batch and online).
-    "pipeline": 7,
-    "serve": 7,
+    "pipeline": 8,
+    "serve": 8,
     # net (the socket front door, round 17) shares the serve tier: the
     # server submits into serve's coalescer and the client raises
     # serve's exceptions — transport and policy are one tier, and the
     # numeric rule keeps every engine tier below from importing net
     # (an ops kernel that could open a socket would be an ops kernel
     # one refactor from a host sync mid-dispatch).
-    "net": 7,
+    "net": 8,
     # replay (the counterfactual replay lab, round 18) also shares the
     # orchestration tier: the sweep re-drives serve's SessionDriver and
     # builds plans through pipeline, so it must see both — and the
     # numeric rule keeps every engine tier below from importing a
     # harness that re-drives them.
-    "replay": 7,
-    "cli": 8,
+    "replay": 8,
+    "cli": 9,
     # The root facade re-exports for users; nothing inside imports it.
     "__init__": 99,
 }

@@ -204,6 +204,7 @@ def min_of_repeats(
     band.update(_hbm_read_summary(records, leg))
     band.update(_recovery_summary(records, leg))
     band.update(_replay_summary(records, leg))
+    band.update(_bp_iters_summary(records, leg))
     return band
 
 
@@ -267,6 +268,22 @@ def _replay_summary(
     return _min_extras_summary(
         records, leg, "replay_batches_per_s", positive_only=True
     )
+
+
+def _bp_iters_summary(
+    records: List[Dict[str, object]], leg: str
+) -> Dict[str, object]:
+    """Best-case adaptive sweep depth over a leg's records.
+
+    Records carrying ``extras["bp_iters"]`` (the round-18 ``e2e_infer``
+    leg: the adaptive moment sweep's deterministic trip count on the
+    sparse workload) fold to their MINIMUM across repeats — though the
+    count is a pure function of the inputs, so repeats agree and the
+    fold is a formality; a CHANGE in this column between ledgers is the
+    signal (``--against`` diffs it): the sweep math, the tolerance, or
+    the workload moved, never the host.
+    """
+    return _min_extras_summary(records, leg, "bp_iters", positive_only=True)
 
 
 def _peak_mem_summary(
@@ -571,7 +588,7 @@ def diff_bands(
         for name in ("p50", "p99", "goodput_within_slo", "slo_violations",
                      "ingest_wait_s", "intern_s", "hbm_peak_bytes",
                      "hbm_read_bytes", "recovery_s",
-                     "replay_batches_per_s"):
+                     "replay_batches_per_s", "bp_iters"):
             old_value = (old_band or {}).get(name)
             new_value = (new_band or {}).get(name)
             if old_value is not None or new_value is not None:
@@ -629,6 +646,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             "hbm_read_bytes": "hbm_read",
             "recovery_s": "recovery",
             "replay_batches_per_s": "replay",
+            "bp_iters": "iters",
         }.get(name, name)
         return f"  {label} {num(metric['old'])}->{num(metric['new'])}"
 
@@ -646,7 +664,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             for name in ("p99", "goodput_within_slo", "slo_violations",
                          "ingest_wait_s", "intern_s", "hbm_peak_bytes",
                          "hbm_read_bytes", "recovery_s",
-                         "replay_batches_per_s")
+                         "replay_batches_per_s", "bp_iters")
         )
         trailer += "".join(
             metric_str(entry, name)
@@ -688,7 +706,10 @@ def render(records: List[Dict[str, object]]) -> str:
     round-14 one-pass sweep signal), and ``replay`` for legs carrying
     the counterfactual-sweep throughput (``extras.replay_batches_per_s``
     — the round-18 ``e2e_replay_sweep`` leg: recorded batches per
-    second through the K-lane vmapped replay, min across repeats);
+    second through the K-lane vmapped replay, min across repeats), and
+    ``iters`` for legs carrying the adaptive sweep's deterministic trip
+    count (``extras.bp_iters`` — the round-18 ``e2e_infer`` leg; a
+    change here means the sweep math, tolerance, or workload moved);
     every other leg shows dashes.
     """
     summary = summarize(records)
@@ -698,7 +719,7 @@ def render(records: List[Dict[str, object]]) -> str:
         f"{'leg':<34} {'n':>3} {'min':>12} {'max':>12} "
         f"{'spread':>7} {'p50':>9} {'p99':>9} {'goodput':>8} {'slo':>7} "
         f"{'ingest_w':>9} {'intern':>9} {'peak_mem':>9} {'hbm_read':>9} "
-        f"{'recovery':>9} {'replay':>8} {'load(1m)':>12} unit"
+        f"{'recovery':>9} {'replay':>8} {'iters':>6} {'load(1m)':>12} unit"
     ]
     for leg, band in summary.items():
 
@@ -746,6 +767,7 @@ def render(records: List[Dict[str, object]]) -> str:
             f"{num(band.get('intern_s')):>9} "
             f"{peak_str:>9} {read_str:>9} {num(band.get('recovery_s')):>9} "
             f"{num(band.get('replay_batches_per_s')):>8} "
+            f"{num(band.get('bp_iters')):>6} "
             f"{load:>12} {band['unit'] or '-'}"
         )
         # QoS-carrying legs (extras.qos — the e2e_netserve acts) get a

@@ -4,21 +4,43 @@ market-dependency graph, as dense gather arithmetic.
 Markets are not independent: a constituent market's consensus carries
 information about the composites that depend on it ("Graphical
 Representations of Consensus Belief", PAPERS.md). This module is the
-device half of that coupling: a FIXED-ITERATION damped relaxation
+device half of that coupling: a damped relaxation
 
     c'_i = (1 − λ)·c_i + λ · (Σ_j w_ij·c_j) / (Σ_j w_ij)
 
-iterated ``steps`` times over a dense per-row neighbour block — the
-market-graph analogue of one synchronous belief-propagation sweep per
-iteration, with damping λ in place of message normalisation. No
-sampler, no sparse scatter: the CSR edge structure is padded host-side
+iterated over a dense per-row neighbour block — the market-graph
+analogue of one synchronous belief-propagation sweep per iteration,
+with damping λ in place of message normalisation. No sampler, no
+sparse scatter: the CSR edge structure is padded host-side
 (analytics/graph.py) to a static ``(markets, max_degree)`` neighbour
 index/weight block, so each iteration is one gather + two masked
 reductions — embarrassingly parallel over the markets axis except for
 one ``all_gather`` of the tiny per-market vector when that axis is
 sharded.
 
-Semantics at the edges of the domain:
+Round 18 upgrades the sweep to MRF-grade belief propagation
+("Accelerating Markov Random Field Inference with Uncertainty
+Quantification", PAPERS.md) along two axes, both in
+:func:`bp_sweep_math`:
+
+* **Moment pairs** — when a per-market ``variances`` vector rides
+  along, neighbour mixing is PRECISION-weighted: each edge weight is
+  multiplied by ``1/(var_j + VAR_EPS)`` so tight neighbours pull
+  harder than loose ones, and the blended variance
+  ``keep²·var_i + λ²·Σq²var_j/(Σq)²`` shrinks where independent
+  evidence accumulates — neighbours exchange *bands*, not points.
+* **Deterministic adaptive early-exit** — an optional residual
+  tolerance: the per-sweep convergence residual ``max |Δmean|`` over
+  mixing rows is reduced with ``lax.pmax`` (max is exactly
+  associative and commutative, so the residual — and therefore the
+  trip count — is bit-identical on every mesh factorisation) and the
+  loop stops once ``residual <= tol`` or ``max_steps`` is reached.
+  The iteration count is a pure function of the inputs; every shard
+  sees the same replicated residual, so no shard diverges from the
+  collective schedule.
+
+Semantics at the edges of the domain (unchanged from the
+fixed-iteration point sweep):
 
 * ``neighbor_idx < 0`` lanes are padding (rows with fewer than
   ``max_degree`` dependencies) — they contribute nothing.
@@ -30,15 +52,19 @@ Semantics at the edges of the domain:
   consensus and the reliability state are never written back from here
   (the byte-parity contract of the analytics tier).
 
-Determinism: ``steps``, λ, and ``max_degree`` are static; every
-reduction is a fixed-width row-local sum, and the gathered vector is
-the same on every device — so the sweep is a pure bit-stable function
-of (values, neighbor_idx, neighbor_w) on any mesh factorisation
-(pinned by tests/test_analytics.py). Layer 1 (ops): no obs, no clock,
-explicit dtypes.
+Determinism: λ, ``max_degree``, and the ``max_steps`` bound are
+static; every reduction is a fixed-width row-local sum, the gathered
+vector is the same on every device, and the early-exit residual is a
+pure max-reduce — so the sweep is a bit-stable function of
+(values, variances, neighbor_idx, neighbor_w) on any mesh
+factorisation (pinned by tests/test_analytics.py and
+tests/test_infer.py). Layer 1 (ops): no obs, no clock, explicit
+dtypes.
 """
 
 from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +79,153 @@ DEFAULT_DAMPING = 0.5
 #: neighbour-of-neighbour influence without letting long cycles ring.
 DEFAULT_SWEEP_STEPS = 2
 
+#: Precision floor: a zero-variance neighbour would otherwise divide by
+#: zero; 1e-12 keeps the weight finite while letting genuinely tight
+#: bands dominate loose ones by many orders of magnitude.
+VAR_EPS = 1e-12
+
+
+class PropagatedBeliefs(NamedTuple):
+    """The moment-pair sweep's additive analytics output.
+
+    ``mean``/``stderr`` are per-market vectors on the (possibly
+    sharded) markets axis; ``iters_run`` (i32 scalar) and ``residual``
+    (f32 scalar, the last measured ``max |Δmean|``) are replicated —
+    the deterministic early-exit's audit trail. ``stderr`` is the
+    square root of the propagated variance, directly comparable to the
+    band stderr that seeds it (and to the variance-aware shed ranking
+    in serve/admission.py).
+    """
+
+    mean: Array
+    stderr: Array
+    iters_run: Array
+    residual: Array
+
+
+def bp_sweep_math(
+    means: Array,                    # f32[M_loc] per-market means
+    variances: Optional[Array],      # f32[M_loc] or None → point sweep
+    neighbor_idx: Array,             # i32[M_loc, D] GLOBAL positions; -1 pad
+    neighbor_w: Array,               # f32[M_loc, D] edge weights
+    *,
+    damping: float = DEFAULT_DAMPING,
+    max_steps: int = DEFAULT_SWEEP_STEPS,
+    tol: Optional[float] = None,
+    axis_name: "str | None" = None,
+) -> Tuple[Array, Optional[Array], Array, Array]:
+    """Moment-propagating, convergence-aware belief sweep.
+
+    Returns ``(means, variances, iters_run, residual)`` — the relaxed
+    moments plus the early-exit audit pair. ``variances=None`` runs
+    the point form: the precision multiply is skipped entirely, so the
+    mean arithmetic is op-for-op the legacy fixed sweep
+    (:func:`damped_sweep_math` delegates here) and the returned
+    variances are ``None``. ``tol=None`` runs exactly ``max_steps``
+    iterations (a static ``fori_loop``); a positive ``tol`` switches
+    to a ``while_loop`` that stops once the replicated residual
+    ``max |Δmean|`` drops to ``tol`` or below. Inside ``shard_map``
+    the markets axis may be sharded over *axis_name*; the residual is
+    ``lax.pmax``-reduced over it so every shard agrees on the trip
+    count (max is exactly order-independent — the determinism
+    argument, see the module docstring).
+    """
+    f32 = jnp.float32
+    means = means.astype(f32)
+    moments = variances is not None
+    if moments:
+        variances = variances.astype(f32)
+    else:
+        # A dummy carry leg keeps the loop structure uniform; it is
+        # never read on the point path.
+        variances = jnp.zeros((), f32)
+    weights = jnp.where(
+        neighbor_idx >= 0, neighbor_w.astype(f32), f32(0.0)
+    )
+    lam = f32(damping)
+    keep = f32(1.0) - lam
+
+    def sweep_once(v, s):
+        full = (
+            jax.lax.all_gather(v, axis_name, tiled=True)
+            if axis_name is not None
+            else v
+        )
+        nb = full[jnp.clip(neighbor_idx, 0)]
+        ok = (neighbor_idx >= 0) & jnp.isfinite(nb)
+        if moments:
+            full_s = (
+                jax.lax.all_gather(s, axis_name, tiled=True)
+                if axis_name is not None
+                else s
+            )
+            nb_var = full_s[jnp.clip(neighbor_idx, 0)]
+            ok = ok & jnp.isfinite(nb_var)
+            prec = f32(1.0) / (nb_var + f32(VAR_EPS))
+            w = jnp.where(ok, weights * prec, f32(0.0))
+        else:
+            w = jnp.where(ok, weights, f32(0.0))
+        wsum = jnp.sum(w, axis=-1)
+        wval = jnp.sum(w * jnp.where(ok, nb, f32(0.0)), axis=-1)
+        mixes = (wsum > 0) & jnp.isfinite(v)
+        denom = jnp.where(wsum > 0, wsum, f32(1.0))
+        blended = keep * v + lam * (wval / denom)
+        new_v = jnp.where(mixes, blended, v)
+        if moments:
+            wvar = jnp.sum(
+                w * w * jnp.where(ok, nb_var, f32(0.0)), axis=-1
+            )
+            blended_s = keep * keep * s + lam * lam * (
+                wvar / (denom * denom)
+            )
+            new_s = jnp.where(mixes, blended_s, s)
+        else:
+            new_s = s
+        # max |Δmean| over mixing rows; exactly order-independent, so
+        # the pmax below makes it bit-identical (and replicated) on
+        # every mesh factorisation.
+        delta = jnp.max(
+            jnp.where(mixes, jnp.abs(new_v - v), f32(0.0))
+        )
+        if axis_name is not None:
+            delta = jax.lax.pmax(delta, axis_name)
+        return new_v, new_s, delta
+
+    iters0 = jnp.int32(0)
+    if max_steps <= 0:
+        return (
+            means,
+            variances if moments else None,
+            iters0,
+            f32(0.0),
+        )
+
+    if tol is None:
+        def body(_, carry):
+            v, s, _ = carry
+            return sweep_once(v, s)
+
+        v, s, residual = jax.lax.fori_loop(
+            0, max_steps, body, (means, variances, f32(jnp.inf))
+        )
+        iters = jnp.int32(max_steps)
+    else:
+        tol_f = f32(tol)
+
+        def cond(carry):
+            i, _, _, residual = carry
+            return (i < max_steps) & (residual > tol_f)
+
+        def wbody(carry):
+            i, v, s, _ = carry
+            v, s, residual = sweep_once(v, s)
+            return (i + jnp.int32(1), v, s, residual)
+
+        iters, v, s, residual = jax.lax.while_loop(
+            cond, wbody, (iters0, means, variances, f32(jnp.inf))
+        )
+    return v, (s if moments else None), iters, residual
+
 
 def damped_sweep_math(
     values: Array,        # f32[M_loc] this shard's per-market values
@@ -65,6 +238,9 @@ def damped_sweep_math(
 ) -> Array:
     """Run *steps* damped propagation sweeps; returns the relaxed values.
 
+    The legacy point entry: delegates to :func:`bp_sweep_math` with no
+    variances and no tolerance, which runs the identical fixed-depth
+    mean arithmetic (bit-parity pinned by tests/test_infer.py).
     Inside ``shard_map`` the markets axis may be sharded over
     *axis_name*: each iteration all-gathers the per-market vector
     (tiled, so positions stay global) and gathers neighbours from the
@@ -72,31 +248,14 @@ def damped_sweep_math(
     markets axis. ``axis_name=None`` is the single-shard form (values
     already global).
     """
-    f32 = jnp.float32
-    values = values.astype(f32)
-    weights = jnp.where(
-        neighbor_idx >= 0, neighbor_w.astype(f32), f32(0.0)
+    relaxed, _, _, _ = bp_sweep_math(
+        values,
+        None,
+        neighbor_idx,
+        neighbor_w,
+        damping=damping,
+        max_steps=steps,
+        tol=None,
+        axis_name=axis_name,
     )
-    lam = f32(damping)
-    keep = f32(1.0) - lam
-
-    def body(_, v):
-        full = (
-            jax.lax.all_gather(v, axis_name, tiled=True)
-            if axis_name is not None
-            else v
-        )
-        nb = full[jnp.clip(neighbor_idx, 0)]
-        ok = (neighbor_idx >= 0) & jnp.isfinite(nb)
-        w = jnp.where(ok, weights, f32(0.0))
-        wsum = jnp.sum(w, axis=-1)
-        wval = jnp.sum(w * jnp.where(ok, nb, f32(0.0)), axis=-1)
-        mixes = (wsum > 0) & jnp.isfinite(v)
-        blended = keep * v + lam * (
-            wval / jnp.where(wsum > 0, wsum, f32(1.0))
-        )
-        return jnp.where(mixes, blended, v)
-
-    if steps <= 0:
-        return values
-    return jax.lax.fori_loop(0, steps, body, values)
+    return relaxed

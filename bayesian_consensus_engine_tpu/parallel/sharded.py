@@ -334,6 +334,8 @@ def build_cycle_analytics_loop(
     z: float = 1.959964,
     damping: float = 0.5,
     sweep_steps: int = 0,
+    sweep_mode: str = "point",
+    sweep_tol: float | None = None,
     with_tiebreak: bool = True,
     with_bands: bool = True,
     tiebreak_kind: str = "ring",
@@ -393,8 +395,26 @@ def build_cycle_analytics_loop(
     production default. ``interpret=None`` resolves to interpret mode
     off-TPU (the tier-1 CPU oracle); pass ``False`` to force a real
     Mosaic compile.
+
+    **Round 18 knobs.** ``sweep_mode="moments"`` upgrades the graph
+    sweep to MRF-grade belief propagation
+    (:func:`~.ops.propagate.bp_sweep_math`): the band stderr seeds a
+    per-market variance, neighbour mixing is precision-weighted, and
+    the propagated output becomes a
+    :class:`~.ops.propagate.PropagatedBeliefs` of
+    ``(mean, stderr, iters_run, residual)`` instead of a bare vector
+    (``with_bands`` is therefore required). ``sweep_tol`` (moments
+    mode only) arms the deterministic adaptive early-exit:
+    ``sweep_steps`` becomes the static worst-case bound and the loop
+    stops once the pmax-reduced ``max |Δmean|`` residual reaches the
+    tolerance — the trip count is a pure function of the inputs,
+    identical on every mesh factorisation. ``sweep_mode="point"`` with
+    ``sweep_tol=None`` (the default) is the legacy fixed-depth point
+    sweep, bit-for-bit.
     """
     from bayesian_consensus_engine_tpu.ops.propagate import (
+        PropagatedBeliefs,
+        bp_sweep_math,
         damped_sweep_math,
     )
     from bayesian_consensus_engine_tpu.ops.tiebreak import (
@@ -410,6 +430,28 @@ def build_cycle_analytics_loop(
     block, market, slots_axis = _specs(slot_major=True)
     n_sources = mesh.shape[SOURCES_AXIS]
     with_graph = sweep_steps > 0
+    if sweep_mode not in ("point", "moments"):
+        raise ValueError(
+            f"sweep_mode={sweep_mode!r}: 'point' (the legacy damped "
+            "relaxation) or 'moments' (precision-weighted belief "
+            "propagation over (mean, variance) pairs)"
+        )
+    if sweep_tol is not None and sweep_mode != "moments":
+        raise ValueError(
+            "sweep_tol (the adaptive early-exit) rides the moments "
+            "sweep — build with sweep_mode='moments'"
+        )
+    if sweep_tol is not None and not sweep_tol > 0:
+        raise ValueError(
+            f"sweep_tol={sweep_tol!r}: a positive residual tolerance, "
+            "or None for the fixed-depth sweep"
+        )
+    moments_sweep = with_graph and sweep_mode == "moments"
+    if moments_sweep and not with_bands:
+        raise ValueError(
+            "sweep_mode='moments' seeds each market's variance from "
+            "the band stderr — build with with_bands=True"
+        )
     if tiebreak_kind not in ("ring", "sorted"):
         raise ValueError(
             f"tiebreak_kind={tiebreak_kind!r}: 'ring' (the chunked "
@@ -457,17 +499,32 @@ def build_cycle_analytics_loop(
         )
         loop_math = make_loop_math(cycle_fn, steps, fast_cycle_fn=fast_fn)
 
-        def sweep(consensus, graph_args):
+        def sweep(consensus, bands, graph_args):
             neighbor_idx, neighbor_w = graph_args
             with jax.named_scope("bce.consensus_sweep"):
-                return damped_sweep_math(
-                    consensus, neighbor_idx, neighbor_w,
-                    damping=damping, steps=sweep_steps,
-                    axis_name=MARKETS_AXIS,
+                if not moments_sweep:
+                    return damped_sweep_math(
+                        consensus, neighbor_idx, neighbor_w,
+                        damping=damping, steps=sweep_steps,
+                        axis_name=MARKETS_AXIS,
+                    )
+                # Moment pairs: the band stderr seeds the variance, so
+                # neighbours exchange bands, not points; the stderr out
+                # is directly comparable to the band stderr it seeds
+                # from (and to the shed ranking it feeds).
+                variances = bands.stderr * bands.stderr
+                mean, var, iters, residual = bp_sweep_math(
+                    consensus, variances, neighbor_idx, neighbor_w,
+                    damping=damping, max_steps=sweep_steps,
+                    tol=sweep_tol, axis_name=MARKETS_AXIS,
+                )
+                return PropagatedBeliefs(
+                    mean, jnp.sqrt(var), iters, residual
                 )
 
         def fused_math(probs, mask, outcome, state, now0, *graph_args):
             out = []
+            bands = None
             if with_tiebreak or with_bands:
                 read_rel, read_conf = read_phase(state, now0)
             if with_tiebreak:
@@ -491,17 +548,18 @@ def build_cycle_analytics_loop(
                         ))
             if with_bands:
                 with jax.named_scope("bce.uncertainty_bands"):
-                    out.append(band_math(
+                    bands = band_math(
                         probs, mask, read_rel,
                         axis_name=SOURCES_AXIS,
                         axis_size=n_sources,
                         z=z,
                         chunk_slots=chunk_slots,
                         agents_last=False,
-                    ))
+                    )
+                    out.append(bands)
             new_state, consensus = loop_math(probs, mask, outcome, state, now0)
             if with_graph:
-                out.append(sweep(consensus, graph_args))
+                out.append(sweep(consensus, bands, graph_args))
             return (new_state, consensus, *out)
 
         def onepass_math(probs, mask, outcome, state, now0, *graph_args):
@@ -528,7 +586,7 @@ def build_cycle_analytics_loop(
                 )
             out = [tiebreak, bands]
             if with_graph:
-                out.append(sweep(consensus, graph_args))
+                out.append(sweep(consensus, bands, graph_args))
             return (new_state, consensus, *out)
 
         state_spec = MarketBlockState(
@@ -538,12 +596,20 @@ def build_cycle_analytics_loop(
         in_specs = (block, block, market, state_spec, P()) + (
             (nb_spec, nb_spec) if with_graph else ()
         )
+        if moments_sweep:
+            # Per-market moments ride the markets axis; the early-exit
+            # audit pair (iters_run, residual) is pmax-replicated.
+            prop_spec = (PropagatedBeliefs(market, market, P(), P()),)
+        elif with_graph:
+            prop_spec = (market,)
+        else:
+            prop_spec = ()
         out_specs = (
             (state_spec, market)
             + ((RingTieBreakResult(*([market] * 6)),) if with_tiebreak
                else ())
             + ((UncertaintyBands(*([market] * 6)),) if with_bands else ())
-            + ((market,) if with_graph else ())
+            + prop_spec
         )
         fn = shard_map(
             onepass_math if use_pallas else fused_math,
@@ -758,41 +824,61 @@ def init_block_state(
 
 
 def _lane_damped_relax(
-    values, neighbor_idx, neighbor_w, damping, lane_steps, max_steps: int
+    values, neighbor_idx, neighbor_w, damping, lane_steps, max_steps: int,
+    lane_tol=None,
 ):
-    """One replay lane's damped graph relaxation with TRACED λ and depth.
+    """One replay lane's damped graph relaxation with TRACED λ, depth,
+    and residual tolerance.
 
-    The traced twin of :func:`~.ops.propagate.damped_sweep_math`: that
-    kernel casts ``f32(damping)`` and closes over a static ``steps``, so
-    it cannot ride a vmapped config axis. Same per-iteration expression
-    (gather → masked neighbour mean → damped blend, NaN neighbours
-    excluded, no-edge rows untouched); the lane's depth is enforced by
-    freezing iterations past ``lane_steps`` inside a static
-    ``max_steps``-trip fori — every lane runs the same program, shallower
-    lanes just stop mixing. Single-shard only (replay lanes never shard
-    the markets axis).
+    The traced twin of :func:`~.ops.propagate.damped_sweep_math` /
+    :func:`~.ops.propagate.bp_sweep_math`: those kernels cast
+    ``f32(damping)`` and close over static depth/tolerance, so they
+    cannot ride a vmapped config axis (and ``while_loop`` under vmap
+    runs every lane to the slowest lane's trip count). Same
+    per-iteration expression (gather → masked neighbour mean → damped
+    blend, NaN neighbours excluded, no-edge rows untouched); the lane's
+    depth is enforced by freezing iterations past ``lane_steps`` inside
+    a static ``max_steps``-trip fori — every lane runs the same
+    program, shallower lanes just stop mixing. ``lane_tol`` (a traced
+    per-lane scalar) freezes the lane early once the previous sweep's
+    ``max |Δvalue|`` residual drops to the tolerance — the counterfactual
+    twin of the round-18 adaptive early-exit; ``lane_tol <= 0`` (or
+    ``None``) keeps the pure depth gate. Once frozen the residual reads
+    zero, so a converged lane stays converged. Single-shard only
+    (replay lanes never shard the markets axis).
     """
     f32 = jnp.float32
     values = values.astype(f32)
     weights = jnp.where(neighbor_idx >= 0, neighbor_w.astype(f32), f32(0.0))
     lam = damping.astype(f32)
     keep = f32(1.0) - lam
+    tol = None if lane_tol is None else lane_tol.astype(f32)
 
-    def body(i, v):
+    def body(i, carry):
+        v, residual = carry
         nb = v[jnp.clip(neighbor_idx, 0)]
         ok = (neighbor_idx >= 0) & jnp.isfinite(nb)
         w = jnp.where(ok, weights, f32(0.0))
         wsum = jnp.sum(w, axis=-1)
         wval = jnp.sum(w * jnp.where(ok, nb, f32(0.0)), axis=-1)
         mixes = (wsum > 0) & jnp.isfinite(v) & (i < lane_steps)
+        if tol is not None:
+            mixes = mixes & ((tol <= 0) | (residual > tol))
         blended = keep * v + lam * (
             wval / jnp.where(wsum > 0, wsum, f32(1.0))
         )
-        return jnp.where(mixes, blended, v)
+        new_v = jnp.where(mixes, blended, v)
+        new_residual = jnp.max(
+            jnp.where(mixes, jnp.abs(new_v - v), f32(0.0))
+        )
+        return new_v, new_residual
 
     if max_steps <= 0:
         return values
-    return jax.lax.fori_loop(0, max_steps, body, values)
+    relaxed, _ = jax.lax.fori_loop(
+        0, max_steps, body, (values, f32(jnp.inf))
+    )
+    return relaxed
 
 
 #: Compiled replay-sweep programs, keyed ``(steps, max_graph_steps)`` —
@@ -830,8 +916,10 @@ def build_replay_sweep_step(steps: int, max_graph_steps: int = 0):
       moments, per-lane z applied outside the fixed epilogue);
     * ``params`` is a :class:`CycleParams` of ``(C,)`` lane scalars,
       ``band_z`` a ``(C,)`` vector, ``graph`` either ``()`` (built with
-      ``max_graph_steps=0``) or a ``(damping, steps)`` pair of ``(C,)``
-      lane vectors, ``neighbors`` either ``()`` or the static
+      ``max_graph_steps=0``) or a ``(damping, steps, tol)`` triple of
+      ``(C,)`` lane vectors (``tol`` is the round-18 adaptive
+      early-exit residual tolerance; 0 keeps the pure depth gate),
+      ``neighbors`` either ``()`` or the static
       ``(neighbor_idx, neighbor_w)`` market-graph blocks.
 
     Determinism: every lane runs the identical program over identical
@@ -925,11 +1013,11 @@ def build_replay_sweep_step(steps: int, max_graph_steps: int = 0):
                 jnp.where(settled, (cons - outcome_f) ** 2, f32(0.0))
             )
             if has_graph:
-                damping, lane_steps = graph
+                damping, lane_steps, lane_tol = graph
                 neighbor_idx, neighbor_w = neighbors
                 relaxed = _lane_damped_relax(
                     consensus, neighbor_idx, neighbor_w,
-                    damping, lane_steps, max_graph_steps,
+                    damping, lane_steps, max_graph_steps, lane_tol,
                 )
                 graph_brier = jnp.sum(jnp.where(
                     settled,

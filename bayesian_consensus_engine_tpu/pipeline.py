@@ -58,6 +58,7 @@ from bayesian_consensus_engine_tpu.core.batch import (
     topology_fingerprint,
 )
 from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
+from bayesian_consensus_engine_tpu.ops.propagate import PropagatedBeliefs
 from bayesian_consensus_engine_tpu.obs.timeline import (
     PhaseTimeline,
     active_timeline,
@@ -1204,12 +1205,14 @@ _analytics_loop_cache: dict = {}
 
 def _cached_analytics_loop(mesh, chunk_agents, chunk_slots, precision,
                            z, damping, sweep_steps, with_tiebreak,
-                           tiebreak_kind="ring", kernel="xla"):
+                           tiebreak_kind="ring", kernel="xla",
+                           sweep_mode="point", sweep_tol=None):
     """One fused cycle(+tiebreak)+bands(+sweep) loop per configuration —
     shared across sessions like :func:`_cached_cycle_loop` (the jit
     tracing cache lives on the wrapper instance)."""
     key = (mesh, chunk_agents, chunk_slots, precision, z, damping,
-           sweep_steps, with_tiebreak, tiebreak_kind, kernel)
+           sweep_steps, with_tiebreak, tiebreak_kind, kernel,
+           sweep_mode, sweep_tol)
     loop = _analytics_loop_cache.get(key)
     if loop is None:
         from bayesian_consensus_engine_tpu.parallel.sharded import (
@@ -1219,7 +1222,8 @@ def _cached_analytics_loop(mesh, chunk_agents, chunk_slots, precision,
         loop = build_cycle_analytics_loop(
             mesh, chunk_agents=chunk_agents, chunk_slots=chunk_slots,
             donate=True, precision=precision, z=z, damping=damping,
-            sweep_steps=sweep_steps, with_tiebreak=with_tiebreak,
+            sweep_steps=sweep_steps, sweep_mode=sweep_mode,
+            sweep_tol=sweep_tol, with_tiebreak=with_tiebreak,
             tiebreak_kind=tiebreak_kind, kernel=kernel,
         )
         _analytics_loop_cache[key] = loop
@@ -1695,18 +1699,57 @@ class ShardedSettlementSession:
                 "hybrid DCN×ICI analytics program is the ROADMAP "
                 "follow-up this error names"
             )
-        if self._band is not None and graph is not None:
-            raise ClusterModeUnsupported(
-                "the correlated-market sweep needs the GLOBAL market "
-                "axis, but this session serves a band plan covering only "
-                "rows [{}, {}) of it — cross-band neighbour pulls would "
-                "silently drop. Serve graph analytics from a whole-axis "
-                "session, or partition the MarketGraph by the same "
-                "cluster.membership.MeshView bands so every edge stays "
-                "in-band".format(self._lo, self._lo + self._plan.num_markets)
+        # Round 18: graph + band plans no longer refuse. On a single
+        # controller the plan cache only admits the band that covers the
+        # whole global axis (rows [0, M) — `process_market_rows` pins
+        # lo to 0), so the fused sweep's all_gather sees every market
+        # and the banded session runs the IDENTICAL program as the
+        # whole-axis session (byte parity pinned by tests/test_infer.py
+        # and tests/test_cluster.py). Genuine multi-host sub-bands stop
+        # at the multi-controller gate above; the cross-band halo
+        # machinery their hybrid program will stand on lives in
+        # infer/partition.py (bit parity pinned at host level).
+        inference = options.inference
+        blocks = options.blocks
+        if blocks is not None:
+            from bayesian_consensus_engine_tpu.infer.blocks import (
+                MarketBlocks,
             )
+
+            if not isinstance(blocks, MarketBlocks):
+                raise TypeError(
+                    "analytics.blocks takes an infer.MarketBlocks; got "
+                    f"{type(blocks).__name__}"
+                )
+            if graph is None:
+                # Blocks alone ARE the graph: compile the constraint
+                # edges. A caller composing blocks with correlation
+                # edges builds the merged graph once via
+                # MarketBlocks.to_graph(extra_edges=...) and passes it
+                # as graph= (the projection still applies).
+                graph = blocks.to_graph()
+        sweep_mode = "point"
+        sweep_tol = None
         sweep_steps = graph.steps if graph is not None else 0
         damping = graph.damping if graph is not None else 0.0
+        if inference is not None:
+            from bayesian_consensus_engine_tpu.infer.bp import (
+                InferenceOptions,
+            )
+
+            if not isinstance(inference, InferenceOptions):
+                raise TypeError(
+                    "analytics.inference takes an infer.InferenceOptions; "
+                    f"got {type(inference).__name__}"
+                )
+            if graph is None:
+                raise ValueError(
+                    "analytics.inference needs a graph to sweep over — "
+                    "set graph= (correlation edges) or blocks= "
+                    "(combinatorial constraints)"
+                )
+            damping, sweep_steps, sweep_tol = inference.resolve(graph)
+            sweep_mode = "moments" if inference.moments else "point"
 
         now_abs, conf_exact, outcome_band = self._settle_preamble(
             outcomes, now
@@ -1724,7 +1767,7 @@ class ShardedSettlementSession:
             loop = _cached_analytics_loop(
                 self._mesh, chunk_agents, chunk_slots, options.precision,
                 options.z, damping, sweep_steps, bool(tiebreak_opt),
-                tiebreak_kind, kernel,
+                tiebreak_kind, kernel, sweep_mode, sweep_tol,
             )
         with active_timeline().span("settle_dispatch"):
             outcome_g = global_market(
@@ -1738,6 +1781,21 @@ class ShardedSettlementSession:
             )
         self._settle_commit(new_state, steps, now_abs, conf_exact)
         live, keys = self._band_live()
+        if propagated is not None and blocks is not None:
+            propagated = self._project_blocks(
+                propagated, blocks, keys, live
+            )
+        if propagated is None:
+            prop_out = None
+        elif isinstance(propagated, PropagatedBeliefs):
+            prop_out = PropagatedBeliefs(
+                mean=_BandView(propagated.mean, self._lo, live),
+                stderr=_BandView(propagated.stderr, self._lo, live),
+                iters_run=propagated.iters_run,
+                residual=propagated.residual,
+            )
+        else:
+            prop_out = _BandView(propagated, self._lo, live)
         return (
             SettlementResult(
                 market_keys=keys,
@@ -1752,11 +1810,42 @@ class ShardedSettlementSession:
             UncertaintyBands(
                 *(_BandView(x, self._lo, live) for x in bands)
             ),
-            (
-                _BandView(propagated, self._lo, live)
-                if propagated is not None else None
-            ),
+            prop_out,
         )
+
+    def _project_blocks(self, propagated, blocks, keys, live: int):
+        """Apply the combinatorial-block projection to the propagated
+        analytics output (host-side, deterministic — infer/blocks.py).
+
+        Only the ADDITIVE propagated vector is rewritten; consensus,
+        bands, state, and every byte surface stay exactly as the fused
+        program left them.
+        """
+        import jax.numpy as jnp
+
+        lo = self._lo
+        is_moments = isinstance(propagated, PropagatedBeliefs)
+        mean_arr = np.asarray(
+            propagated.mean if is_moments else propagated
+        ).copy()
+        stderr_arr = (
+            np.asarray(propagated.stderr).copy() if is_moments else None
+        )
+        proj_mean, proj_stderr = blocks.project(
+            keys,
+            mean_arr[lo:lo + live],
+            None if stderr_arr is None else stderr_arr[lo:lo + live],
+        )
+        mean_arr[lo:lo + live] = proj_mean
+        if is_moments:
+            stderr_arr[lo:lo + live] = proj_stderr
+            return PropagatedBeliefs(
+                mean=jnp.asarray(mean_arr),
+                stderr=jnp.asarray(stderr_arr),
+                iters_run=propagated.iters_run,
+                residual=propagated.residual,
+            )
+        return jnp.asarray(mean_arr)
 
     def refresh(self, plan: SettlementPlan) -> None:
         """Adopt a probability-only twin of the session's plan in place.

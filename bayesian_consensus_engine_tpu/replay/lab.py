@@ -68,7 +68,12 @@ class ReplayConfig(NamedTuple):
     disables the correlated-market relaxation for that lane (its
     ``graph_brier`` then equals its plain ``brier``); any lane with
     ``graph_steps > 0`` makes the sweep require a
-    :class:`~.analytics.graph.MarketGraph`.
+    :class:`~.analytics.graph.MarketGraph`. ``graph_tol > 0`` arms the
+    round-18 adaptive early-exit for that lane: iterations freeze once
+    the relaxation's ``max |Δvalue|`` residual drops to the tolerance,
+    inside the same static ``graph_steps`` bound — so ``bce-tpu
+    replay`` can counterfactually tune the inference depth/tolerance
+    trade-off over a recorded trace.
     """
 
     half_life_days: float = DECAY_HALF_LIFE_DAYS
@@ -78,6 +83,7 @@ class ReplayConfig(NamedTuple):
     band_z: float = Z_95
     graph_damping: float = DEFAULT_DAMPING
     graph_steps: int = 0
+    graph_tol: float = 0.0
 
 
 #: The live run's parameter point — always lane 0 of a sweep.
@@ -404,6 +410,7 @@ def replay_sweep(
             jnp.asarray(
                 [int(config.graph_steps) for config in lanes], jnp.int32
             ),
+            lane_f32("graph_tol"),
         )
         if max_graph_steps > 0 else ()
     )

@@ -137,6 +137,7 @@ from bayesian_consensus_engine_tpu.obs.metrics import metrics_registry
 from bayesian_consensus_engine_tpu.obs.slo import SloTracker
 from bayesian_consensus_engine_tpu.obs.timeline import active_timeline
 from bayesian_consensus_engine_tpu.obs.trace import TraceContext, active_tracer
+from bayesian_consensus_engine_tpu.ops.propagate import PropagatedBeliefs
 from bayesian_consensus_engine_tpu.serve.admission import (
     AdmissionConfig,
     AdmissionController,
@@ -160,8 +161,12 @@ class ServeResult:
     the credible interval around the point consensus,
     ``band_stderr`` is its standard error, and ``propagated`` is the
     graph-relaxed consensus when the options carry a
-    :class:`~.analytics.graph.MarketGraph`. All ``None`` with analytics
-    off — and the point ``consensus`` is byte-identical either way (the
+    :class:`~.analytics.graph.MarketGraph`. Under the round-18 moments
+    sweep (``AnalyticsOptions(inference=...)``) ``propagated_stderr``
+    additionally carries the sweep's propagated standard error — the
+    neighbour-tightened uncertainty that also refreshes the
+    variance-aware shed ranking. All ``None`` with analytics off — and
+    the point ``consensus`` is byte-identical either way (the
     analytics on/off parity contract)."""
 
     market_id: str
@@ -171,6 +176,7 @@ class ServeResult:
     band_hi: Optional[float] = None
     band_stderr: Optional[float] = None
     propagated: Optional[float] = None
+    propagated_stderr: Optional[float] = None
 
 
 class AdaptiveWindow:
@@ -1157,7 +1163,7 @@ class ConsensusService:
                     plan, outcomes, now=batch_now, band=None
                 )
                 consensus = np.asarray(result.consensus)
-                bands = propagated = None
+                bands = propagated = prop_stderr = None
                 if self._analytics_mode:
                     _tiebreak, band_views, prop_view = (
                         self._driver.last_analytics
@@ -1167,19 +1173,33 @@ class ConsensusService:
                         "hi": np.asarray(band_views.hi),
                         "stderr": np.asarray(band_views.stderr),
                     }
-                    if prop_view is not None:
+                    if isinstance(prop_view, PropagatedBeliefs):
+                        # The round-18 moments sweep: the propagated
+                        # view is a (mean, stderr, iters, residual)
+                        # bundle rather than a bare mean vector.
+                        propagated = np.asarray(prop_view.mean)
+                        prop_stderr = np.asarray(prop_view.stderr)
+                    elif prop_view is not None:
                         propagated = np.asarray(prop_view)
                     # Refresh the variance-aware shed ranking with this
                     # batch's live per-market standard errors (plain
                     # dict assignment — GIL-atomic; the loop thread
-                    # reads it at shed time). One age tick for the
-                    # whole batch, then evict past the bound.
+                    # reads it at shed time). When the moments sweep
+                    # ran, a finite propagated stderr supersedes the
+                    # band stderr: neighbour evidence tightens a
+                    # market's uncertainty, and the shed policy should
+                    # rank on what the sweep knows, not what the band
+                    # alone shows. One age tick for the whole batch,
+                    # then evict past the bound.
                     stderr_col = bands["stderr"]
                     self._stderr_seq += 1
                     for i, request in enumerate(requests):
-                        self._band_stderr[request.market_id] = float(
-                            stderr_col[i]
-                        )
+                        live_stderr = float(stderr_col[i])
+                        if prop_stderr is not None and np.isfinite(
+                            prop_stderr[i]
+                        ):
+                            live_stderr = float(prop_stderr[i])
+                        self._band_stderr[request.market_id] = live_stderr
                         self._stderr_settled_at[request.market_id] = (
                             self._stderr_seq
                         )
@@ -1246,6 +1266,10 @@ class ConsensusService:
                 ),
                 propagated=(
                     float(propagated[i]) if propagated is not None
+                    else None
+                ),
+                propagated_stderr=(
+                    float(prop_stderr[i]) if prop_stderr is not None
                     else None
                 ),
             )

@@ -1,0 +1,177 @@
+"""Combinatorial markets + adaptive belief propagation (round 18).
+
+Round 12's graph sweep carried point values through a fixed number of
+damped iterations. The round-18 ``infer/`` tier upgrades the workload
+in three moves, shown here end to end:
+
+1. **Constraint-typed blocks** — a 4-way election is declared as ONE
+   ``mutually_exclusive`` block and a 2-leg parlay as one ``implies``
+   block; ``MarketBlocks.to_graph()`` compiles the constraints to the
+   MarketGraph edges the device sweep consumes. No hand-wired edges.
+2. **Moment-pair adaptive BP** — ``InferenceOptions`` switches the
+   sweep to (mean, variance) pairs with a deterministic early-exit:
+   the sweep runs until max |Δmean| dips under ``tol`` (device-resident
+   residual, bit-stable trip count on every mesh factorisation) instead
+   of a fixed step budget.
+3. **Deterministic projection** — after the sweep, the election's
+   outcomes are renormalised to SUM TO 1 and the parlay's composite is
+   clamped to its tightest leg — host-side, pure, order-independent.
+4. The byte-exactness coda: the identical batch settled WITHOUT
+   analytics produces the identical point consensus and identical
+   store bytes — blocks + BP + projection are pure-additive reads
+   (tests/test_infer.py pins the journal/SQLite matrix).
+
+Run from the repo root:  python examples/combinatorial_markets.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from bayesian_consensus_engine_tpu.analytics import AnalyticsOptions
+from bayesian_consensus_engine_tpu.infer import (
+    InferenceOptions,
+    MarketBlock,
+    MarketBlocks,
+)
+from bayesian_consensus_engine_tpu.parallel.mesh import make_mesh
+from bayesian_consensus_engine_tpu.pipeline import (
+    ShardedSettlementSession,
+    build_settlement_plan,
+)
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 21_900.0
+
+# ---------------------------------------------------------------------------
+# Act 1 — the combinatorial scenario, declared as constraints.
+# ---------------------------------------------------------------------------
+# A 4-way election (exactly one candidate wins) where the sources
+# overprice the field — the raw consensus sums well past 1 — plus a
+# 2-leg parlay whose composite the sources price ABOVE one of its legs
+# (an arbitrage the implication constraint forbids).
+payloads = [
+    ("cand-a", [
+        {"sourceId": f"s-{i}", "probability": p}
+        for i, p in enumerate([0.45, 0.50, 0.48])
+    ]),
+    ("cand-b", [
+        {"sourceId": f"s-{i}", "probability": p}
+        for i, p in enumerate([0.35, 0.32, 0.30])
+    ]),
+    ("cand-c", [
+        {"sourceId": f"s-{i}", "probability": p}
+        for i, p in enumerate([0.22, 0.25, 0.20])
+    ]),
+    ("cand-d", [
+        {"sourceId": f"s-{i}", "probability": p}
+        for i, p in enumerate([0.10, 0.12, 0.08])
+    ]),
+    ("parlay", [
+        {"sourceId": f"s-{i}", "probability": p}
+        for i, p in enumerate([0.50, 0.55])
+    ]),
+    ("leg-1", [
+        {"sourceId": f"s-{i}", "probability": p}
+        for i, p in enumerate([0.62, 0.60])
+    ]),
+    ("leg-2", [
+        {"sourceId": f"s-{i}", "probability": p}
+        for i, p in enumerate([0.40, 0.38])
+    ]),
+]
+outcomes = [True, False, False, False, False, True, False]
+
+blocks = MarketBlocks([
+    MarketBlock(
+        "mutually_exclusive", ("cand-a", "cand-b", "cand-c", "cand-d")
+    ),
+    MarketBlock("implies", ("parlay", "leg-1", "leg-2")),
+])
+
+mesh = make_mesh()
+store = TensorReliabilityStore()
+plan = build_settlement_plan(store, payloads, num_slots=8)
+
+with ShardedSettlementSession(store, plan, mesh) as session:
+    result, tiebreak, bands, prop = session.settle_with_analytics(
+        outcomes, steps=2, now=NOW,
+        analytics=AnalyticsOptions(
+            blocks=blocks,
+            inference=InferenceOptions(
+                tol=2e-2, max_steps=16, damping=0.2
+            ),
+        ),
+    )
+
+keys = result.market_keys
+consensus = np.asarray(result.consensus)
+mean = np.asarray(prop.mean)
+stderr = np.asarray(prop.stderr)
+
+print("constraint blocks → graph edges → adaptive BP → projection\n")
+print(f"{'market':>8}  {'consensus':>9}  {'projected':>9}  {'stderr':>7}")
+for row, key in enumerate(keys):
+    print(
+        f"{key:>8}  {consensus[row]:9.4f}  {mean[row]:9.4f}  "
+        f"{stderr[row]:7.4f}"
+    )
+
+# ---------------------------------------------------------------------------
+# Act 2 — what the constraints bought.
+# ---------------------------------------------------------------------------
+cand_rows = [keys.index(k) for k in ("cand-a", "cand-b", "cand-c", "cand-d")]
+raw_sum = float(consensus[cand_rows].sum())
+proj_sum = float(mean[cand_rows].sum())
+assert abs(proj_sum - 1.0) < 1e-6
+# The gentle damping + early-exit stop BEFORE the averaging fixed point
+# flattens the field: the candidates keep their market-implied ordering.
+assert list(mean[cand_rows]) == sorted(mean[cand_rows], reverse=True)
+print(
+    f"\nelection: raw consensus sums to {raw_sum:.4f} (overpriced field) "
+    f"— projected outcomes sum to {proj_sum:.4f}\nwith the ordering "
+    "intact. Exactly-one-winner is a DECLARED invariant, not a hope."
+)
+
+parlay, leg1, leg2 = (keys.index(k) for k in ("parlay", "leg-1", "leg-2"))
+assert mean[parlay] <= mean[leg1] + 1e-6
+assert mean[parlay] <= mean[leg2] + 1e-6
+print(
+    f"parlay: priced {consensus[parlay]:.4f} vs legs "
+    f"{consensus[leg1]:.4f}/{consensus[leg2]:.4f} — the implication "
+    f"clamp settles it at {mean[parlay]:.4f}\n(a conjunction can never "
+    "beat its weakest leg)."
+)
+assert int(prop.iters_run) < 16
+print(
+    f"adaptive BP converged in {int(prop.iters_run)} sweeps "
+    f"(residual {float(prop.residual):.2e} <= tol 2e-02, bound 16) — "
+    "the trip count is a pure\nfunction of the inputs, identical on "
+    "every mesh factorisation."
+)
+
+# ---------------------------------------------------------------------------
+# Act 3 — the byte-exactness coda: the settle never felt any of it.
+# ---------------------------------------------------------------------------
+plain_store = TensorReliabilityStore()
+plain_plan = build_settlement_plan(plain_store, payloads, num_slots=8)
+with ShardedSettlementSession(plain_store, plain_plan, mesh) as plain:
+    plain_result = plain.settle(outcomes, steps=2, now=NOW)
+
+np.testing.assert_array_equal(
+    consensus, np.asarray(plain_result.consensus)
+)
+rows = np.arange(plain_store.live_row_count())
+for got, want in zip(store.host_rows(rows), plain_store.host_rows(rows)):
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+print(
+    "\ncoda: point consensus and stored reliability state are "
+    "BIT-IDENTICAL with\nblocks+BP on or off — constraints, sweep, and "
+    "projection are pure-additive reads.\nbench.py --leg e2e_infer "
+    "carries the adaptive-vs-fixed sweep-count capture."
+)

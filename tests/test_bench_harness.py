@@ -1329,6 +1329,62 @@ class TestReplaySweepLeg:
         )[0]["extras"]
 
 
+class TestInferLeg:
+    """The round-18 inference leg (``e2e_infer``) at --fast shapes:
+    fixed-depth vs adaptive moment sweeps over sparse and dense graphs
+    through the fused settle+analytics program. The sweep semantics
+    (bit parity, determinism, early-exit) are pinned by
+    tests/test_infer.py; this pins the LEG contract — the JSON shape,
+    the acceptance fields, and the ``bp_iters`` ledger extras record
+    the stats table's iters column reads."""
+
+    def test_fast_leg_reports_adaptive_vs_fixed(self, tmp_path):
+        from bayesian_consensus_engine_tpu.obs.ledger import (
+            RunLedger,
+            read_ledger,
+            summarize,
+        )
+
+        ledger_path = tmp_path / "infer.jsonl"
+        old = bench._LEDGER
+        bench._LEDGER = RunLedger(ledger_path, backend="cpu")
+        try:
+            result = bench.run_leg_inprocess("e2e_infer", fast=True)
+        finally:
+            bench._LEDGER.close()
+            bench._LEDGER = old
+        for key in (
+            "workload", "fixed_sparse", "adaptive_sparse", "fixed_dense",
+            "adaptive_dense", "wall_s", "bp_iters",
+            "adaptive_saves_sweeps", "sparse_fewer_sweeps",
+            "adaptive_matches_fixed",
+        ):
+            assert key in result, key
+        # The acceptance bars hold at every shape: the sparse graph
+        # settles under the static bound and in fewer sweeps than the
+        # dense one, at outputs matching the fixed-depth sweep; the
+        # fixed variants always pay the full depth.
+        assert result["adaptive_saves_sweeps"] is True
+        assert result["sparse_fewer_sweeps"] is True
+        assert result["adaptive_matches_fixed"] is True
+        assert result["bp_iters"] == (
+            result["adaptive_sparse"]["iters_run"]
+        )
+        assert result["fixed_sparse"]["iters_run"] > result["bp_iters"]
+        assert result["adaptive_sparse"]["wall_s"] > 0
+        json.dumps(result)
+        # The ledger rows carry the trip count the stats table renders:
+        # min-across-repeats of extras.bp_iters.
+        records = read_ledger(ledger_path)
+        band = summarize(records)["e2e_infer"]
+        assert band["bp_iters"] == result["bp_iters"]
+
+    def test_leg_is_registered_for_device_runs(self):
+        assert "e2e_infer" in bench.LEGS
+        assert "e2e_infer" in bench.DEVICE_LEG_ORDER
+        assert "e2e_infer" in bench.compose({}, [], None, 0.0)[0]["extras"]
+
+
 class TestDryrunMultichipLeg:
     """The scaled virtual-mesh leg (VERDICT r5 #3): the north-star band
     over 8 virtual devices with a REAL psum epilogue, parity-asserted

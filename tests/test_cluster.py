@@ -450,7 +450,11 @@ class TestAnalyticsClusterGate:
             np.asarray(tb_b.prediction), np.asarray(tb_p.prediction)
         )
 
-    def test_graph_sweep_on_band_session_names_the_route(self):
+    def test_graph_sweep_on_band_session_serves_with_parity(self):
+        """Round 18 closes the PR-11 refusal: graph analytics on a
+        band session no longer raises — it serves the same bits as the
+        whole-axis session (the full byte-parity matrix lives in
+        tests/test_infer.py::TestBandedGraphSession)."""
         from bayesian_consensus_engine_tpu.analytics.bands import (
             AnalyticsOptions,
         )
@@ -458,16 +462,25 @@ class TestAnalyticsClusterGate:
             MarketGraph,
         )
 
-        session, outcomes = self._session(band=(0, 12))
         graph = MarketGraph.from_edges([("m-0", "m-1", 0.5)])
-        with session:
-            with pytest.raises(
-                ClusterModeUnsupported, match="cluster.membership"
-            ):
-                session.settle_with_analytics(
-                    outcomes, steps=1, now=NOW,
-                    analytics=AnalyticsOptions(graph=graph),
-                )
+        options = AnalyticsOptions(graph=graph)
+        banded, outcomes = self._session(band=(0, 12))
+        with banded:
+            _, _, bands_b, prop_b = banded.settle_with_analytics(
+                outcomes, steps=1, now=NOW, analytics=options
+            )
+        plain, _ = self._session()
+        with plain:
+            _, _, bands_p, prop_p = plain.settle_with_analytics(
+                outcomes, steps=1, now=NOW, analytics=options
+            )
+        assert prop_b is not None
+        np.testing.assert_array_equal(
+            np.asarray(prop_b), np.asarray(prop_p)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(bands_b.stderr), np.asarray(bands_p.stderr)
+        )
 
     def test_multi_controller_names_the_route(self, monkeypatch):
         import bayesian_consensus_engine_tpu.pipeline as pipeline_mod

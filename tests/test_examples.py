@@ -6,7 +6,13 @@ examples (settlement_cycle, compact_settlement, distributed_settlement,
 settlement_service, streaming_settlement, batched_consensus,
 fault_tolerant_service, columnar_ingest, coresident_tiebreak,
 uncertainty_bands, degraded_mesh_recovery, onepass_settlement,
-multitenant_serving — the round-17 multi-tenant front-door example's
+multitenant_serving, combinatorial_markets — the round-18
+combinatorial-markets example's moment-pair sweep bit matrix,
+adaptive-early-exit determinism, banded byte parity, block projection
+invariants, and analytics-off byte coda live in tests/test_infer.py,
+with the adaptive-vs-fixed sweep-count capture smoked through
+tests/test_bench_harness.py::TestInferLeg; the round-17 multi-tenant
+front-door example's
 wire byte parity, robustness matrix, per-class QoS isolation, and
 variance-aware shed determinism live in tests/test_net.py, with the
 e2e leg smoked through tests/test_bench_harness.py::TestNetServeLeg; the

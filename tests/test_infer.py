@@ -1,0 +1,816 @@
+"""infer/: MRF-grade adaptive belief propagation (round 18).
+
+The non-negotiable contracts, mirroring tests/test_analytics.py's shape:
+
+* **Moment-pair bit matrix** — ``bp_sweep_math`` is a bit-stable pure
+  function of (means, variances, neighbor blocks) on every mesh
+  factorisation, the point path is op-for-op the legacy fixed sweep
+  (``damped_sweep_math`` delegates), and the fused session's moments
+  output is bit-identical across chunk settings and the factorisations
+  that keep its in-program inputs bit-equal.
+* **Deterministic early-exit** — the adaptive trip count is a pure
+  function of the inputs: identical on every mesh factorisation (ops
+  level AND through the session), with the residual bits agreeing too.
+* **Banded graph analytics** — a band session with graph+bands no
+  longer raises ``ClusterModeUnsupported``: it serves the identical
+  program, byte-for-byte (store digest, journal epochs sans wall
+  clock, SQLite bytes) and bit-for-bit (analytics outputs) vs the
+  whole-axis session; ``infer/partition.py``'s explicit-halo sweep is
+  bit-equal to the whole-axis sweep on every banding (the ghost-zone
+  argument).
+* **Combinatorial blocks** — constraint declarations compile to graph
+  edges, the post-sweep projection renormalises mutually-exclusive
+  partitions to sum to 1 and clamps implication composites, and the
+  whole path stays additive (the settle's bytes never move).
+"""
+
+import random
+import struct
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from bayesian_consensus_engine_tpu.analytics import (
+    AnalyticsOptions,
+    MarketGraph,
+)
+from bayesian_consensus_engine_tpu.cluster.recover import store_digest
+from bayesian_consensus_engine_tpu.infer import (
+    BandedGraph,
+    InferenceOptions,
+    MarketBlock,
+    MarketBlocks,
+    PropagatedBeliefs,
+    banded_bp_sweep,
+    exchange_halos,
+    partition_csr,
+    propagate_beliefs,
+)
+from bayesian_consensus_engine_tpu.ops.propagate import (
+    bp_sweep_math,
+    damped_sweep_math,
+)
+from bayesian_consensus_engine_tpu.parallel._jax_compat import shard_map
+from bayesian_consensus_engine_tpu.parallel.mesh import (
+    MARKETS_AXIS,
+    make_mesh,
+)
+from bayesian_consensus_engine_tpu.pipeline import (
+    ShardedSettlementSession,
+    build_settlement_plan,
+)
+from bayesian_consensus_engine_tpu.state.journal import JournalWriter
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 21_400.0
+
+MESH_SHAPES = [(4, 2), (2, 4), (8, 1), (1, 8)]
+
+
+def _graph_blocks(m=32, degree=3, seed=5, edge_p=0.6):
+    """One dense per-row neighbour block pair with -1 padding."""
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, m, (m, degree)).astype(np.int32)
+    idx[rng.random((m, degree)) > edge_p] = -1
+    w = rng.uniform(0.2, 1.8, (m, degree)).astype(np.float32)
+    return jnp.asarray(idx), jnp.asarray(w)
+
+
+def _moment_seeds(m=32, seed=6, nan_rows=()):
+    rng = np.random.default_rng(seed)
+    means = rng.random(m).astype(np.float32)
+    variances = rng.uniform(1e-4, 0.05, m).astype(np.float32)
+    for row in nan_rows:
+        means[row] = np.nan
+        variances[row] = np.nan
+    return jnp.asarray(means), jnp.asarray(variances)
+
+
+def _market_payloads(markets=12, universe=8, seed=8):
+    rng = random.Random(seed)
+    payloads = []
+    for m in range(markets):
+        n = rng.randint(1, 3)
+        payloads.append((
+            f"m-{m}",
+            [
+                {
+                    "sourceId": f"s{rng.randrange(universe)}",
+                    "probability": round(rng.random(), 6),
+                }
+                for _ in range(n)
+            ],
+        ))
+    return payloads, [True] * markets
+
+
+#: The session fixture's dependency graph: two components over the
+#: twelve markets, damping/steps deliberately non-default.
+_SESSION_EDGES = [
+    ("m-0", "m-1", 0.5), ("m-1", "m-2", 0.7), ("m-3", "m-4", 0.4),
+]
+
+
+def _session_run(mesh_shape, band=None, analytics=None, markets=12):
+    payloads, outcomes = _market_payloads(markets)
+    store = TensorReliabilityStore()
+    plan = build_settlement_plan(store, payloads, num_slots=4,
+                                 fingerprint=True)
+    session = ShardedSettlementSession(
+        store, plan, make_mesh(mesh_shape), band=band
+    )
+    with session:
+        out = session.settle_with_analytics(
+            outcomes, steps=1, now=NOW, analytics=analytics
+        )
+    store.sync()
+    return store, out
+
+
+def _moments_options(tol=1e-6, max_steps=32, graph_edges=_SESSION_EDGES):
+    graph = MarketGraph.from_edges(graph_edges, damping=0.4, steps=4)
+    return AnalyticsOptions(
+        graph=graph,
+        inference=InferenceOptions(tol=tol, max_steps=max_steps),
+    )
+
+
+def _journal_epochs_sans_clock(path):
+    """Decoded epoch frames with the wall-clock field masked (same
+    helper as test_analytics/test_serve)."""
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = hdr.unpack_from(blob, off)
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4  # + crc32
+    return epochs
+
+
+class TestBpSweepMath:
+    def test_one_moment_step_hand_computed(self):
+        # Markets 0 and 1 exchange one edge; 2 is isolated; 3 reads
+        # both 0 and 1 with unequal edge weights, so the precision
+        # weighting (1/var) is exercised against a by-hand mix.
+        means = jnp.asarray([0.2, 0.8, 0.5, 0.5], jnp.float32)
+        variances = jnp.asarray([0.04, 0.01, 0.09, 0.09], jnp.float32)
+        idx = jnp.asarray(
+            [[1, -1], [0, -1], [-1, -1], [0, 1]], jnp.int32
+        )
+        w = jnp.asarray(
+            [[1.0, 0.0], [1.0, 0.0], [0.0, 0.0], [1.0, 2.0]], jnp.float32
+        )
+        mean, var, iters, residual = bp_sweep_math(
+            means, variances, idx, w, damping=0.4, max_steps=1
+        )
+        lam, keep = 0.4, 0.6
+        # Rows 0/1: one neighbour each — the precision cancels in the
+        # mean; the variance blends keep²·own + λ²·neighbour.
+        assert float(mean[0]) == pytest.approx(keep * 0.2 + lam * 0.8)
+        assert float(var[0]) == pytest.approx(
+            keep**2 * 0.04 + lam**2 * 0.01
+        )
+        assert float(mean[1]) == pytest.approx(keep * 0.8 + lam * 0.2)
+        assert float(var[1]) == pytest.approx(
+            keep**2 * 0.01 + lam**2 * 0.04
+        )
+        # Row 2: no edges — untouched.
+        assert float(mean[2]) == pytest.approx(0.5)
+        assert float(var[2]) == pytest.approx(0.09)
+        # Row 3: precision-weighted two-neighbour mix.
+        q0, q1 = 1.0 / 0.04, 2.0 / 0.01
+        mix = (q0 * 0.2 + q1 * 0.8) / (q0 + q1)
+        wvar = (q0**2 * 0.04 + q1**2 * 0.01) / (q0 + q1) ** 2
+        assert float(mean[3]) == pytest.approx(
+            keep * 0.5 + lam * mix, rel=1e-5
+        )
+        assert float(var[3]) == pytest.approx(
+            keep**2 * 0.09 + lam**2 * wvar, rel=1e-5
+        )
+        assert int(iters) == 1
+        assert float(residual) == pytest.approx(0.24, rel=1e-5)
+
+    def test_point_path_is_damped_sweep(self):
+        idx, w = _graph_blocks()
+        means, _ = _moment_seeds(nan_rows=(3, 17))
+        legacy = damped_sweep_math(
+            means, idx, w, damping=0.35, steps=3
+        )
+        mean, var, iters, _ = bp_sweep_math(
+            means, None, idx, w, damping=0.35, max_steps=3
+        )
+        np.testing.assert_array_equal(np.asarray(legacy), np.asarray(mean))
+        assert var is None
+        assert int(iters) == 3
+
+    def test_nan_pad_and_edgeless_semantics(self):
+        # Row 0 reads a NaN-mean neighbour and a finite one: the NaN is
+        # excluded, not poisoning. Row 1 is itself NaN: held. Row 2
+        # reads ONLY the NaN market: no finite neighbour, held. Row 3
+        # reads a neighbour with NaN VARIANCE: excluded on the moments
+        # path (precision undefined), so row 3 is held too.
+        means = jnp.asarray([0.5, jnp.nan, 0.5, 0.7, 0.9], jnp.float32)
+        variances = jnp.asarray(
+            [0.01, jnp.nan, 0.01, 0.04, jnp.nan], jnp.float32
+        )
+        idx = jnp.asarray(
+            [[1, 3], [0, -1], [1, -1], [4, -1], [-1, -1]], jnp.int32
+        )
+        w = jnp.ones((5, 2), jnp.float32)
+        mean, var, _, _ = bp_sweep_math(
+            means, variances, idx, w, damping=0.4, max_steps=1
+        )
+        assert float(mean[0]) == pytest.approx(0.6 * 0.5 + 0.4 * 0.7)
+        assert float(var[0]) == pytest.approx(0.36 * 0.01 + 0.16 * 0.04)
+        assert np.isnan(float(mean[1]))
+        assert float(mean[2]) == 0.5 and float(var[2]) == pytest.approx(0.01)
+        assert float(mean[3]) == pytest.approx(
+            0.7
+        )  # NaN-variance neighbour excluded
+        # On the POINT path the same neighbour still mixes (only the
+        # mean needs to be finite there).
+        pmean, _, _, _ = bp_sweep_math(
+            means, None, idx, w, damping=0.4, max_steps=1
+        )
+        assert float(pmean[3]) == pytest.approx(0.6 * 0.7 + 0.4 * 0.9)
+
+    def test_max_steps_zero_is_identity(self):
+        idx, w = _graph_blocks()
+        means, variances = _moment_seeds()
+        mean, var, iters, residual = bp_sweep_math(
+            means, variances, idx, w, max_steps=0, tol=1e-6
+        )
+        np.testing.assert_array_equal(np.asarray(mean), np.asarray(means))
+        np.testing.assert_array_equal(np.asarray(var), np.asarray(variances))
+        assert int(iters) == 0 and float(residual) == 0.0
+
+    def test_adaptive_early_exit_stops_under_the_bound(self):
+        idx, w = _graph_blocks()
+        means, variances = _moment_seeds()
+        _, _, fixed_iters, _ = bp_sweep_math(
+            means, variances, idx, w, damping=0.4, max_steps=128
+        )
+        mean, var, iters, residual = bp_sweep_math(
+            means, variances, idx, w, damping=0.4, max_steps=128, tol=1e-5
+        )
+        assert int(fixed_iters) == 128
+        assert 0 < int(iters) < 128
+        assert float(residual) <= 1e-5
+        # At convergence the adaptive sweep matches the full-depth one.
+        full, _, _, _ = bp_sweep_math(
+            means, variances, idx, w, damping=0.4, max_steps=128
+        )
+        np.testing.assert_allclose(
+            np.asarray(mean), np.asarray(full), rtol=0, atol=1e-4
+        )
+
+    def test_adaptive_rejects_bad_knobs_in_options(self):
+        with pytest.raises(ValueError, match="tol"):
+            InferenceOptions(tol=0.0)
+        with pytest.raises(ValueError, match="max_steps"):
+            InferenceOptions(max_steps=-1)
+        with pytest.raises(ValueError, match="damping"):
+            InferenceOptions(damping=1.5)
+        with pytest.raises(ValueError, match="moments"):
+            InferenceOptions(moments=False, tol=1e-4)
+
+    def test_propagate_beliefs_aligns_and_sweeps(self):
+        graph = MarketGraph.from_edges(
+            [("a", "b", 1.0), ("b", "a", 1.0)], damping=0.4, steps=8
+        )
+        means = jnp.asarray([0.2, 0.8, jnp.nan], jnp.float32)
+        variances = jnp.asarray([0.01, 0.01, jnp.nan], jnp.float32)
+        out = propagate_beliefs(
+            means, variances, graph, ["a", "b", "pad"], 3,
+            options=InferenceOptions(tol=1e-7, max_steps=100),
+        )
+        assert isinstance(out, PropagatedBeliefs)
+        # The coupled pair converges toward its precision-weighted
+        # midpoint; the pad row stays NaN.
+        assert abs(float(out.mean[0]) - float(out.mean[1])) < 1e-4
+        assert np.isnan(float(out.mean[2]))
+        assert int(out.iters_run) < 100
+
+
+class TestDeterminism:
+    """The ISSUE-18 acceptance: trip counts and sweep bits are pure
+    functions of the inputs — the mesh factorisation is invisible."""
+
+    def _sharded(self, mesh_shape, means, variances, idx, w, *, tol,
+                 max_steps):
+        mesh = make_mesh(mesh_shape)
+        market = P(MARKETS_AXIS)
+
+        def math(v, s, i, wt):
+            return bp_sweep_math(
+                v, s, i, wt, damping=0.4, max_steps=max_steps, tol=tol,
+                axis_name=MARKETS_AXIS,
+            )
+
+        fn = shard_map(
+            math, mesh=mesh,
+            in_specs=(market, market, market, market),
+            out_specs=(market, market, P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(fn)(means, variances, idx, w)
+
+    @pytest.mark.parametrize("tol", [None, 1e-3])
+    def test_ops_bitwise_parity_across_mesh_factorisations(self, tol):
+        idx, w = _graph_blocks()
+        means, variances = _moment_seeds(nan_rows=(3,))
+        reference = None
+        for shape in MESH_SHAPES:
+            mean, var, iters, residual = self._sharded(
+                shape, means, variances, idx, w, tol=tol, max_steps=64
+            )
+            got = (
+                np.asarray(mean), np.asarray(var),
+                int(iters), np.asarray(residual),
+            )
+            if reference is None:
+                reference = got
+                continue
+            np.testing.assert_array_equal(got[0], reference[0])
+            np.testing.assert_array_equal(got[1], reference[1])
+            assert got[2] == reference[2]
+            np.testing.assert_array_equal(got[3], reference[3])
+        if tol is not None:
+            assert reference[2] < 64  # the early-exit actually fired
+
+    def test_session_iters_identical_on_every_mesh(self):
+        counts = {}
+        for shape in MESH_SHAPES:
+            _, (_, _, _, prop) = _session_run(
+                shape, analytics=_moments_options(max_steps=64)
+            )
+            counts[shape] = (
+                int(prop.iters_run),
+                np.asarray(prop.residual).tobytes(),
+            )
+        assert len(set(counts.values())) == 1, counts
+        assert 0 < counts[(4, 2)][0] < 64
+
+    def test_session_moments_bitwise_across_preserving_factorisations(self):
+        # (4, 2) and (2, 4) keep the fused program's in-program inputs
+        # bit-equal (the pre-existing consensus parity envelope — other
+        # factorisations may move the CONSENSUS bits upstream of the
+        # sweep, which the sweep then faithfully propagates).
+        _, (_, _, bands_a, prop_a) = _session_run(
+            (4, 2), analytics=_moments_options()
+        )
+        _, (_, _, bands_b, prop_b) = _session_run(
+            (2, 4), analytics=_moments_options()
+        )
+        np.testing.assert_array_equal(
+            np.asarray(prop_a.mean), np.asarray(prop_b.mean)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(prop_a.stderr), np.asarray(prop_b.stderr)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(bands_a.stderr), np.asarray(bands_b.stderr)
+        )
+
+    @pytest.mark.parametrize("chunk_slots", [None, 2, "default"])
+    def test_session_moments_bitwise_across_chunk_settings(
+        self, chunk_slots
+    ):
+        base = _moments_options()
+        _, (_, _, _, reference) = _session_run((4, 2), analytics=base)
+        _, (_, _, _, prop) = _session_run(
+            (4, 2),
+            analytics=AnalyticsOptions(
+                graph=base.graph, inference=base.inference,
+                chunk_slots=chunk_slots,
+            ),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(reference.mean), np.asarray(prop.mean)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(reference.stderr), np.asarray(prop.stderr)
+        )
+        assert int(reference.iters_run) == int(prop.iters_run)
+
+
+class TestSessionInference:
+    def test_moments_session_returns_propagated_beliefs(self):
+        _, (_, _, bands, prop) = _session_run(
+            (4, 2), analytics=_moments_options()
+        )
+        assert isinstance(prop, PropagatedBeliefs)
+        stderr = np.asarray(prop.stderr)
+        assert stderr.shape == np.asarray(prop.mean).shape
+        assert int(prop.iters_run) > 0
+        # Neighbour evidence moves the uncertainty where the graph
+        # reaches and ONLY there: markets outside the graph keep their
+        # band stderr bit-for-bit, while at least one connected market
+        # comes out strictly tighter (a certain neighbour lends its
+        # precision) — the widening direction is equally legal (a
+        # near-certain market coupled to a wide one inherits doubt).
+        band_stderr = np.asarray(bands.stderr)
+        connected = np.zeros(12, bool)
+        for i in range(5):  # _SESSION_EDGES covers m-0..m-4
+            connected[i] = True
+        np.testing.assert_array_equal(
+            stderr[~connected], band_stderr[~connected]
+        )
+        finite = connected & np.isfinite(stderr) & np.isfinite(band_stderr)
+        assert np.any(stderr[finite] < band_stderr[finite] - 1e-5)
+
+    def test_point_session_keeps_legacy_output(self):
+        graph = MarketGraph.from_edges(_SESSION_EDGES)
+        _, (_, _, _, prop) = _session_run(
+            (4, 2), analytics=AnalyticsOptions(graph=graph)
+        )
+        assert not isinstance(prop, PropagatedBeliefs)
+        assert np.asarray(prop).shape == (12,)
+
+    def test_inference_requires_a_graph(self):
+        with pytest.raises(ValueError, match="graph"):
+            _session_run(
+                (4, 2),
+                analytics=AnalyticsOptions(inference=InferenceOptions()),
+            )
+
+    def test_inference_and_blocks_type_checked(self):
+        graph = MarketGraph.from_edges(_SESSION_EDGES)
+        with pytest.raises(TypeError, match="InferenceOptions"):
+            _session_run(
+                (4, 2),
+                analytics=AnalyticsOptions(graph=graph, inference="yes"),
+            )
+        with pytest.raises(TypeError, match="MarketBlocks"):
+            _session_run(
+                (4, 2),
+                analytics=AnalyticsOptions(blocks=["m-0", "m-1"]),
+            )
+
+
+class TestBandedGraphSession:
+    """PR 11's refusal, closed: band sessions serve graph analytics."""
+
+    def test_banded_session_serves_graph_analytics(self):
+        _, (_, _, _, prop) = _session_run(
+            (4, 2), band=(0, 12), analytics=_moments_options()
+        )
+        assert isinstance(prop, PropagatedBeliefs)
+        assert int(prop.iters_run) > 0
+
+    def test_banded_byte_and_bit_parity_vs_whole_axis(self, tmp_path):
+        store_a, (res_a, tb_a, bands_a, prop_a) = _session_run(
+            (4, 2), analytics=_moments_options()
+        )
+        store_b, (res_b, tb_b, bands_b, prop_b) = _session_run(
+            (4, 2), band=(0, 12), analytics=_moments_options()
+        )
+        # Bit parity on every analytics output...
+        np.testing.assert_array_equal(
+            np.asarray(prop_a.mean), np.asarray(prop_b.mean)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(prop_a.stderr), np.asarray(prop_b.stderr)
+        )
+        assert int(prop_a.iters_run) == int(prop_b.iters_run)
+        np.testing.assert_array_equal(
+            np.asarray(bands_a.stderr), np.asarray(bands_b.stderr)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_a.consensus), np.asarray(res_b.consensus)
+        )
+        # ...and byte parity on every settlement artifact: store
+        # digest, journal epochs (wall clock masked), SQLite bytes.
+        assert store_digest(store_a) == store_digest(store_b)
+        for name, store in (("whole", store_a), ("band", store_b)):
+            writer = JournalWriter(tmp_path / f"{name}.jrnl")
+            store.flush_to_journal(writer)
+            writer.close()
+            store.flush_to_sqlite(tmp_path / f"{name}.db")
+        assert _journal_epochs_sans_clock(tmp_path / "whole.jrnl") == (
+            _journal_epochs_sans_clock(tmp_path / "band.jrnl")
+        )
+        assert (tmp_path / "whole.db").read_bytes() == (
+            tmp_path / "band.db"
+        ).read_bytes()
+
+    def test_multi_controller_still_refuses(self, monkeypatch):
+        import bayesian_consensus_engine_tpu.pipeline as pl
+
+        monkeypatch.setattr(pl, "_process_count", lambda: 2)
+        from bayesian_consensus_engine_tpu.cluster.recover import (
+            ClusterModeUnsupported,
+        )
+
+        with pytest.raises(ClusterModeUnsupported, match="MeshView"):
+            _session_run((4, 2), analytics=_moments_options())
+
+
+class TestPartition:
+    def _bandings(self, m):
+        return [
+            [(0, m)],
+            [(0, m // 2), (m // 2, m)],
+            [(0, m // 4), (m // 4, m // 2), (m // 2, m)],
+        ]
+
+    def test_partition_validates_contiguous_tiling(self):
+        idx, w = _graph_blocks(m=8)
+        with pytest.raises(ValueError, match="contiguously"):
+            partition_csr(idx, w, [(0, 4), (5, 8)])
+        with pytest.raises(ValueError, match="contiguously"):
+            partition_csr(idx, w, [(0, 4), (4, 4), (4, 8)])
+        with pytest.raises(ValueError, match="8 rows"):
+            partition_csr(idx, w, [(0, 4)])
+
+    def test_partition_remaps_and_counts_cross_edges(self):
+        idx = jnp.asarray(
+            [[1, -1], [2, -1], [0, 3], [-1, -1]], jnp.int32
+        )
+        w = jnp.ones((4, 2), jnp.float32)
+        banded = partition_csr(idx, w, [(0, 2), (2, 4)])
+        assert isinstance(banded, BandedGraph)
+        # Band 0 imports row 2; band 1 imports row 0 — two cross edges.
+        assert banded.cross_edges == 2
+        b0, b1 = banded.blocks
+        assert b0.halo.tolist() == [2]
+        assert b0.halo_owner.tolist() == [1]
+        assert b0.halo_local.tolist() == [0]
+        # Row 1's neighbour 2 remaps onto the halo slot (size 2 + 0).
+        assert b0.neighbor_idx[1, 0] == 2
+        assert b1.halo.tolist() == [0]
+        # Row 2's neighbours: 0 is remote (slot 2 + 0), 3 is local (1).
+        assert b1.neighbor_idx[0].tolist() == [2, 1]
+
+    def test_exchange_moves_only_halo_positions(self):
+        idx = jnp.asarray(
+            [[1, -1], [2, -1], [0, 3], [-1, -1]], jnp.int32
+        )
+        w = jnp.ones((4, 2), jnp.float32)
+        banded = partition_csr(idx, w, [(0, 2), (2, 4)])
+        values = [
+            jnp.asarray([0.1, 0.2], jnp.float32),
+            jnp.asarray([0.3, 0.4], jnp.float32),
+        ]
+        halos = exchange_halos(values, banded)
+        assert halos[0].tolist() == [pytest.approx(0.3)]
+        assert halos[1].tolist() == [pytest.approx(0.1)]
+
+    @pytest.mark.parametrize("moments", [True, False])
+    @pytest.mark.parametrize("tol", [None, 1e-5])
+    def test_banded_sweep_bit_equal_to_whole_axis(self, moments, tol):
+        m = 32
+        idx, w = _graph_blocks(m=m)
+        means, variances = _moment_seeds(m=m, nan_rows=(3,))
+        if not moments:
+            if tol is not None:
+                pytest.skip("tol rides the moments sweep")
+            variances = None
+        ref_mean, ref_var, ref_iters, ref_res = bp_sweep_math(
+            means, variances, idx, w, damping=0.4, max_steps=48, tol=tol
+        )
+        for bands in self._bandings(m):
+            mean, var, iters, residual = banded_bp_sweep(
+                means, variances, partition_csr(idx, w, bands),
+                damping=0.4, max_steps=48, tol=tol,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(mean), np.asarray(ref_mean)
+            )
+            if moments:
+                np.testing.assert_array_equal(
+                    np.asarray(var), np.asarray(ref_var)
+                )
+            else:
+                assert var is None
+            assert int(iters) == int(ref_iters)
+            np.testing.assert_array_equal(
+                np.asarray(residual), np.asarray(ref_res)
+            )
+
+
+class TestBlocks:
+    def test_block_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            MarketBlock("xor", ("a", "b"))
+        with pytest.raises(ValueError, match="at least 2"):
+            MarketBlock("implies", ("a",))
+        with pytest.raises(ValueError, match="duplicate"):
+            MarketBlock("mutually_exclusive", ("a", "a"))
+        with pytest.raises(ValueError, match="weight"):
+            MarketBlock("implies", ("a", "b"), weight=0.0)
+        with pytest.raises(TypeError, match="MarketBlock"):
+            MarketBlocks(["not-a-block"])
+
+    def test_edges_compile_clique_and_chain(self):
+        blocks = MarketBlocks([
+            MarketBlock("mutually_exclusive", ("a", "b", "c"), weight=2.0),
+            MarketBlock("implies", ("parlay", "leg1", "leg2")),
+        ])
+        edges = blocks.to_edges()
+        # 3-clique both ways (6) + two composite↔leg pairs (4).
+        assert len(edges) == 10
+        assert ("a", "b", 2.0) in edges and ("b", "a", 2.0) in edges
+        assert ("parlay", "leg1", 1.0) in edges
+        assert ("leg1", "parlay", 1.0) in edges
+        assert ("leg1", "leg2", 1.0) not in edges  # legs don't couple
+        graph = blocks.to_graph(damping=0.3, steps=5)
+        assert isinstance(graph, MarketGraph)
+        assert graph.damping == 0.3 and graph.steps == 5
+
+    def test_projection_renormalises_partition(self):
+        blocks = MarketBlocks([
+            MarketBlock("mutually_exclusive", ("a", "b", "c")),
+        ])
+        means = np.asarray([0.5, 0.3, 0.2, 0.9], np.float32)
+        stderr = np.asarray([0.1, 0.1, 0.1, 0.2], np.float32)
+        # Pre-scaled so the divisor is non-trivial.
+        means[:3] *= 2.0
+        out_mean, out_stderr = blocks.project(
+            ["a", "b", "c", "other"], means, stderr
+        )
+        assert float(np.sum(out_mean[:3])) == pytest.approx(1.0)
+        np.testing.assert_allclose(
+            out_mean[:3], [0.5, 0.3, 0.2], rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            out_stderr[:3], np.asarray([0.1, 0.1, 0.1]) / 2.0, rtol=1e-6
+        )
+        # Untouched market, untouched inputs.
+        assert float(out_mean[3]) == pytest.approx(0.9)
+        assert float(means[0]) == pytest.approx(1.0)
+
+    def test_projection_skips_absent_and_nonfinite(self):
+        blocks = MarketBlocks([
+            MarketBlock("mutually_exclusive", ("a", "b", "c")),
+        ])
+        means = np.asarray([0.4, np.nan], np.float32)
+        out_mean, _ = blocks.project(["a", "b"], means)
+        # Only one finite present member — nothing to renormalise.
+        assert float(out_mean[0]) == pytest.approx(0.4)
+        assert np.isnan(out_mean[1])
+
+    def test_projection_clamps_implication_composite(self):
+        blocks = MarketBlocks([
+            MarketBlock("implies", ("parlay", "leg1", "leg2")),
+        ])
+        means = np.asarray([0.6, 0.5, 0.3], np.float32)
+        stderr = np.asarray([0.1, 0.1, 0.1], np.float32)
+        out_mean, out_stderr = blocks.project(
+            ["parlay", "leg1", "leg2"], means, stderr
+        )
+        assert float(out_mean[0]) == pytest.approx(0.3)  # tightest leg
+        assert float(out_stderr[0]) == pytest.approx(0.1)  # untouched
+        # A composite already below its legs is left alone.
+        means2 = np.asarray([0.1, 0.5, 0.3], np.float32)
+        out2, _ = blocks.project(["parlay", "leg1", "leg2"], means2)
+        assert float(out2[0]) == pytest.approx(0.1)
+
+    def test_blocks_through_the_session_sum_to_one(self):
+        blocks = MarketBlocks([
+            MarketBlock(
+                "mutually_exclusive", ("m-0", "m-1", "m-2", "m-3")
+            ),
+        ])
+        _, (_, _, _, prop) = _session_run(
+            (4, 2),
+            analytics=AnalyticsOptions(
+                blocks=blocks, inference=InferenceOptions()
+            ),
+        )
+        assert isinstance(prop, PropagatedBeliefs)
+        total = float(np.asarray(prop.mean)[:4].sum())
+        assert total == pytest.approx(1.0, abs=1e-6)
+
+    def test_blocks_leave_settlement_bytes_untouched(self, tmp_path):
+        blocks = MarketBlocks([
+            MarketBlock(
+                "mutually_exclusive", ("m-0", "m-1", "m-2", "m-3")
+            ),
+        ])
+        store_off, (res_off, *_rest) = _session_run((4, 2))
+        store_on, (res_on, _, _, prop) = _session_run(
+            (4, 2),
+            analytics=AnalyticsOptions(
+                blocks=blocks, inference=InferenceOptions()
+            ),
+        )
+        assert prop is not None
+        np.testing.assert_array_equal(
+            np.asarray(res_off.consensus), np.asarray(res_on.consensus)
+        )
+        assert store_digest(store_off) == store_digest(store_on)
+        store_off.flush_to_sqlite(tmp_path / "off.db")
+        store_on.flush_to_sqlite(tmp_path / "on.db")
+        assert (tmp_path / "off.db").read_bytes() == (
+            tmp_path / "on.db"
+        ).read_bytes()
+
+
+class TestShedRankFromPropagatedStderr:
+    """Neighbour evidence moves the variance-aware shed policy: the
+    moments sweep's tightened stderr feeds the serve tier's ranking, so
+    graph-connected markets shed LATER than the band stderr alone would
+    rank them (they're better known than their own band shows)."""
+
+    def _serve_stderr(self, analytics):
+        import asyncio
+
+        from bayesian_consensus_engine_tpu.serve import ConsensusService
+
+        trace = []
+        for rnd in range(2):
+            for m in range(6):
+                trace.append((
+                    f"m-{m}",
+                    [(f"s-{m}", 0.55 + 0.01 * rnd), (f"s-{(m + 1) % 3}", 0.4)],
+                    (m + rnd) % 2 == 0,
+                ))
+
+        async def main():
+            store = TensorReliabilityStore()
+            service = ConsensusService(
+                store, steps=2, now=NOW, mesh=make_mesh(),
+                max_batch=6, max_delay_s=None, analytics=analytics,
+            )
+            futures = []
+            async with service:
+                for market_id, signals, outcome in trace:
+                    futures.append(
+                        service.submit(market_id, signals, outcome)
+                    )
+                await service.drain()
+            return service, [f.result() for f in futures]
+
+        return asyncio.run(main())
+
+    def _shed_order(self, stderr_by_market):
+        from bayesian_consensus_engine_tpu.serve.admission import (
+            shed_rank_key,
+        )
+
+        markets = sorted(stderr_by_market)
+        return sorted(
+            markets,
+            key=lambda m: shed_rank_key(
+                stderr_by_market[m], markets.index(m)
+            ),
+        )
+
+    def test_propagated_stderr_changes_the_shed_sequence(self):
+        graph = MarketGraph.from_edges(
+            [("m-0", "m-1", 0.5), ("m-1", "m-2", 0.7),
+             ("m-3", "m-4", 0.4)],
+            damping=0.4, steps=4,
+        )
+        svc_point, res_point = self._serve_stderr(
+            AnalyticsOptions(graph=graph)
+        )
+        svc_bp, res_bp = self._serve_stderr(
+            AnalyticsOptions(
+                graph=graph,
+                inference=InferenceOptions(tol=1e-6, max_steps=32),
+            )
+        )
+        # The point sweep leaves the shed ranking on the band stderr:
+        # the even-outcome markets (m-0/2/4) band a hair wider than the
+        # odd ones, so they head the victim order, ties by arrival.
+        point_order = self._shed_order(svc_point.market_band_stderr)
+        assert point_order == ["m-0", "m-2", "m-4", "m-1", "m-3", "m-5"]
+        # The moments sweep tightens the graph-connected markets —
+        # m-0 (coupled to m-1, which couples to m-2) halves its stderr
+        # twice over and drops to the BACK of the victim order, m-1 and
+        # m-3 halve once, while the graph-blind m-5 rises to the front
+        # block. The full sequence is pinned: neighbour evidence
+        # REORDERS who sheds first.
+        bp_order = self._shed_order(svc_bp.market_band_stderr)
+        assert bp_order == ["m-2", "m-4", "m-5", "m-1", "m-3", "m-0"]
+        assert bp_order != point_order
+        assert bp_order[-1] == "m-0"  # best-connected market sheds last
+        # The per-request results carry both stderrs; the propagated
+        # one is tighter wherever the graph reaches.
+        tightened = {
+            r.market_id
+            for r in res_bp
+            if r.propagated_stderr is not None
+            and r.band_stderr is not None
+            and r.propagated_stderr < r.band_stderr - 1e-6
+        }
+        assert tightened  # neighbour evidence reached the serve tier
+        for r in res_point:
+            assert r.propagated_stderr is None

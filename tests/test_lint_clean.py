@@ -208,6 +208,27 @@ _CASES = [
         f"from {PKG}.ops.cycle_math import CycleParams\n",
     ),
     (
+        # Round 18: infer (moment-pair BP + band partitioning + blocks)
+        # sits between analytics and orchestration — importing the
+        # pipeline that orchestrates it is an upward import; composing
+        # analytics' graph alignment with the ops sweep math below is
+        # the designed direction.
+        "LY301",
+        f"{PKG}/infer/case.py",
+        f"from {PKG}.pipeline import settle_stream\n",
+        f"from {PKG}.analytics.graph import MarketGraph\n"
+        f"from {PKG}.ops.propagate import bp_sweep_math\n",
+    ),
+    (
+        # ...and the inverse: analytics importing infer would invert
+        # the composition (infer builds ON analytics' graph surface) —
+        # the numeric rule flags it (infer sits a layer above).
+        "LY301",
+        f"{PKG}/analytics/case.py",
+        f"from {PKG}.infer.bp import InferenceOptions\n",
+        f"from {PKG}.ops.uncertainty import band_math\n",
+    ),
+    (
         "LY302",
         f"{PKG}/core/case.py",
         "import jax.numpy as jnp\n\nSENTINEL = jnp.int32(0)\n",
