@@ -93,6 +93,15 @@ class AnalyticsOptions:
     applied to the propagated means. Both are typed loosely here —
     analytics (layer 6) must not import infer (layer 7); the pipeline
     (layer 8) imports both and validates.
+
+    **Round 19.** ``sweep_kernel`` picks the graph sweep's execution
+    route, orthogonal to ``kernel``: ``"xla"`` (default) is the
+    ``while_loop`` sweep, ``"pallas"`` the VMEM-resident
+    belief-propagation kernel (``ops/pallas_bp.py`` — the (mean,
+    variance) state pinned in VMEM across all iterations, bit-identical
+    outputs including the early-exit audit pair), ``"auto"`` the
+    honesty-guarded shape tuner (knob ``sweep_kernel``). Needs a graph
+    (or blocks) — there is no sweep to offload otherwise.
     """
 
     z: float = Z_95
@@ -102,6 +111,7 @@ class AnalyticsOptions:
     precision: int = 6
     tiebreak: "bool | str" = True
     kernel: str = "xla"
+    sweep_kernel: str = "xla"
     inference: Optional[object] = None
     blocks: Optional[object] = None
 

@@ -79,6 +79,7 @@ def propagate_beliefs(
     padded_total: int,
     *,
     options: InferenceOptions | None = None,
+    kernel: str = "xla",
 ) -> PropagatedBeliefs:
     """One-call host form: align the graph, run the moment sweep.
 
@@ -89,21 +90,55 @@ def propagate_beliefs(
     padded axis. Single-shard (``axis_name=None``); the sharded form
     lives inside the fused analytics program
     (:func:`~.parallel.sharded.build_cycle_analytics_loop`).
+
+    ``kernel="pallas"`` (round 19) runs the VMEM-resident
+    belief-propagation kernel (``ops/pallas_bp.py``) instead of the
+    XLA ``while_loop`` — bit-identical outputs including the
+    ``(iters_run, residual)`` audit pair. A zero-step sweep is an
+    identity either way and stays on the XLA path (there is no kernel
+    grid to launch).
     """
     import jax.numpy as jnp
 
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(
+            f"kernel={kernel!r}: 'xla' (the while_loop sweep, the "
+            "default) or 'pallas' (the VMEM-resident BP kernel); the "
+            "honesty-guarded 'auto' route lives on the fused session "
+            "program (AnalyticsOptions.sweep_kernel)"
+        )
     options = options or InferenceOptions()
     damping, max_steps, tol = options.resolve(graph)
     neighbor_idx, neighbor_w = graph.align(market_keys, padded_total)
-    mean, var, iters, residual = bp_sweep_math(
-        jnp.asarray(means),
-        jnp.asarray(variances) if options.moments else None,
-        neighbor_idx,
-        neighbor_w,
-        damping=damping,
-        max_steps=max_steps,
-        tol=tol,
-    )
+    if kernel == "pallas" and max_steps >= 1:
+        import jax
+
+        from bayesian_consensus_engine_tpu.ops.pallas_bp import (
+            build_bp_sweep,
+        )
+
+        bp = build_bp_sweep(
+            int(neighbor_idx.shape[0]), int(neighbor_idx.shape[1]),
+            max_steps,
+            damping=damping, tol=tol, moments=options.moments,
+            interpret=jax.default_backend() != "tpu",
+        )
+        mean, var, iters, residual = bp(
+            jnp.asarray(means),
+            jnp.asarray(variances) if options.moments else None,
+            neighbor_idx,
+            neighbor_w,
+        )
+    else:
+        mean, var, iters, residual = bp_sweep_math(
+            jnp.asarray(means),
+            jnp.asarray(variances) if options.moments else None,
+            neighbor_idx,
+            neighbor_w,
+            damping=damping,
+            max_steps=max_steps,
+            tol=tol,
+        )
     stderr = (
         jnp.sqrt(var) if var is not None
         else jnp.full_like(mean, jnp.nan)

@@ -1,0 +1,325 @@
+"""Kernel-resident belief propagation: the adaptive moments sweep in
+one VMEM residency (Pallas TPU kernel, round 19).
+
+The XLA sweep (``ops/propagate.py::bp_sweep_math``) is a
+``while_loop`` whose carry — the per-market (mean, variance) pair —
+lives in HBM: every one of up to ``max_steps`` iterations writes the
+updated moments back, re-reads them, re-gathers the full consensus
+vector, and re-reads the dense ``(M, D)`` neighbour blocks. That is
+``2·max_steps`` state round-trips plus ``max_steps`` neighbour-block
+streams for a loop whose entire working set — two f32[M] vectors and
+one (TILE, D) neighbour window — fits comfortably in 16 MB of VMEM.
+
+This kernel keeps the moment state **in VMEM across all sweep
+iterations**. The grid is ``(max_steps, num_tiles)`` — Pallas iterates
+the last axis fastest, so each outer step is one full Jacobi sweep over
+the market tiles:
+
+* the (mean, variance) vectors ride as constant-``index_map`` full
+  blocks, fetched from HBM once at launch and written back once at the
+  end (``input_output_aliases`` pins them in place — the seed arrays
+  ARE the result buffers);
+* a VMEM scratch pair snapshots the previous iteration's moments at
+  the first tile of each sweep, so every tile mixes against the same
+  frozen vector — synchronous (Jacobi) semantics, exactly the XLA
+  loop's carry discipline, not Gauss–Seidel;
+* the aligned neighbour blocks stream tile-by-tile from HBM once per
+  iteration — the only unavoidable traffic (the gather's indices are
+  data-dependent, the blocks are O(M·D) and cannot all sit resident);
+* the convergence residual (tree-max ``|Δmean|``) accumulates in SMEM
+  tile-by-tile; once it drops to ``tol`` every later grid step is a
+  masked no-op — state, residual, and the trip counter are untouched —
+  so the reported ``(iters_run, residual)`` audit pair is a pure
+  function of the inputs under the static ``max_steps`` bound.
+
+**Bit parity is structural, not empirical** (the round-14 one-pass
+discipline): each tile calls the SAME per-row mixing function the XLA
+loop traces — :func:`~.ops.propagate.bp_row_mix` — over the same full
+gathered vector, and the residual is a max-reduce, which is exactly
+associative, so the kernel's sequential tile-max equals the XLA
+``jnp.max``/``pmax`` on every mesh factorisation. The point sweep
+(``damped_sweep_math``) rides the same kernel as a degenerate lane:
+``moments=False`` statically prunes the variance buffers from the
+kernel signature (a literal zero-variance vector would change the
+rounding of the precision multiply — pruning keeps the mean arithmetic
+op-for-op the legacy sweep).
+
+Sharded meshes: the kernel is a single-device launch over the FULL
+padded markets axis. ``parallel.sharded`` all-gathers the seeds and
+neighbour blocks once per settle (tiled, so positions stay global),
+runs the identical launch redundantly on every shard, and slices the
+local rows back out — the per-iteration gather the XLA sweep pays
+``max_steps`` times collapses to one, and every shard sees the same
+bits by construction, so the trip count needs no collective at all.
+
+XLA stays the production default; the kernel ships per-shape only when
+the honesty-guarded A/B says it wins (``ShapeTuner`` knob
+``sweep_kernel``, ``sweep_kernel="auto"``). ``bench.py --leg
+e2e_infer`` (kernel arm) and the ``pallas_ab`` BP bracket are the
+standing re-adjudication.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bayesian_consensus_engine_tpu.ops.propagate import bp_row_mix
+
+#: The moment state held by the launch: (in, out, prev-scratch) windows
+#: per carried vector — constant-index blocks, so NOT double-buffered
+#: (one VMEM window each for the whole launch). Neighbour tiles are the
+#: pipelined, double-buffered traffic. Same conservative budget posture
+#: as ``pallas_settle.resolve_tile_markets``: a tile this model admits
+#: should never fail the Mosaic scoped-VMEM check, and the autotuned
+#: A/B records any residual failure as ineligible rather than shipping.
+_STATE_WINDOWS_PER_VECTOR = 3
+_VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+_TILE_CANDIDATES = (2048, 1024, 512, 256, 128)
+
+
+def resolve_tile_sweep(
+    num_markets: int, max_degree: int, moments: bool
+) -> int:
+    """The largest standard tile dividing *num_markets* that keeps the
+    resident state windows plus the double-buffered neighbour tiles
+    inside the 16 MB scoped-VMEM budget.
+
+    Falls back to ``num_markets`` itself (one tile per sweep) when no
+    standard tile divides it — the ragged case never reaches the kernel
+    grid (the divisibility guard in :func:`build_bp_sweep` is the PL501
+    contract).
+    """
+    vectors = 2 if moments else 1
+    state_bytes = _STATE_WINDOWS_PER_VECTOR * vectors * num_markets * 4
+    for tile in _TILE_CANDIDATES:
+        if num_markets % tile:
+            continue
+        # idx + weights tiles, double-buffered by the pipelined grid.
+        nb_bytes = 2 * tile * max_degree * 4 * 2
+        if state_bytes + nb_bytes <= _VMEM_BUDGET_BYTES:
+            return tile
+    return num_markets
+
+
+def _bp_kernel(
+    idx_ref,        # VMEM (TILE, D) i32 — this tile's neighbour rows
+    w_ref,          # VMEM (TILE, D) f32 — this tile's edge weights
+    *refs,          # mean_in[, var_in], outputs, scratch (see below)
+    moments: bool,
+    tol,            # float | None — static; None = fixed-depth sweep
+    damping: float,
+    tile: int,
+    num_tiles: int,
+):
+    if moments:
+        (mean_in_ref, var_in_ref,
+         mean_out_ref, var_out_ref, iters_ref, res_ref,
+         prev_m_ref, prev_s_ref, acc_ref) = refs
+    else:
+        (mean_in_ref,
+         mean_out_ref, iters_ref, res_ref,
+         prev_m_ref, acc_ref) = refs
+        var_in_ref = var_out_ref = prev_s_ref = None
+
+    f32 = jnp.float32
+    it = pl.program_id(0)
+    t = pl.program_id(1)
+    lam = f32(damping)
+    keep = f32(1.0) - lam
+
+    @pl.when((it == 0) & (t == 0))
+    def _seed_audit():
+        iters_ref[0, 0] = jnp.int32(0)
+        res_ref[0, 0] = f32(jnp.inf)
+
+    # The early-exit mask: once the residual is at/below tol, every
+    # remaining grid step is a no-op — state, residual, and the trip
+    # counter freeze, replicating the while_loop's cond bit-for-bit
+    # under the static max_steps grid bound. tol=None is the
+    # fixed-depth sweep: every iteration runs.
+    if tol is None:
+        run = it >= 0
+    else:
+        run = res_ref[0, 0] > f32(tol)
+
+    # Snapshot the previous iteration's moments at the first tile of
+    # each sweep: tiles mix against this frozen copy (Jacobi), never
+    # against rows another tile already updated (Gauss–Seidel). The
+    # first iteration reads the seed INPUT windows — the aliased input
+    # blocks keep their launch-time fetch, so they still hold the seed
+    # even though the output windows share their HBM buffer.
+    @pl.when(run & (it == 0) & (t == 0))
+    def _snapshot_seed():
+        prev_m_ref[0, :] = mean_in_ref[0, :]
+        if moments:
+            prev_s_ref[0, :] = var_in_ref[0, :]
+
+    @pl.when(run & (it > 0) & (t == 0))
+    def _snapshot_carry():
+        prev_m_ref[0, :] = mean_out_ref[0, :]
+        if moments:
+            prev_s_ref[0, :] = var_out_ref[0, :]
+
+    @pl.when(run & (t == 0))
+    def _reset_residual_acc():
+        acc_ref[0, 0] = f32(0.0)
+
+    @pl.when(run)
+    def _mix_tile():
+        rows = pl.ds(t * tile, tile)
+        v = prev_m_ref[0, rows]
+        full = prev_m_ref[0, :]
+        if moments:
+            s = prev_s_ref[0, rows]
+            full_s = prev_s_ref[0, :]
+        else:
+            s = full_s = None
+        neighbor_idx = idx_ref[...]
+        weights = jnp.where(
+            neighbor_idx >= 0, w_ref[...].astype(f32), f32(0.0)
+        )
+        new_v, new_s, delta_rows = bp_row_mix(
+            v, s, full, full_s, neighbor_idx, weights,
+            lam=lam, keep=keep, moments=moments,
+        )
+        mean_out_ref[0, rows] = new_v
+        if moments:
+            var_out_ref[0, rows] = new_s
+        acc_ref[0, 0] = jnp.maximum(acc_ref[0, 0], jnp.max(delta_rows))
+
+    @pl.when(run & (t == num_tiles - 1))
+    def _close_sweep():
+        res_ref[0, 0] = acc_ref[0, 0]
+        iters_ref[0, 0] = iters_ref[0, 0] + jnp.int32(1)
+
+
+def build_bp_sweep(
+    num_markets: int,
+    max_degree: int,
+    max_steps: int,
+    *,
+    damping: float,
+    tol: "float | None" = None,
+    moments: bool = True,
+    tile_markets: "int | None" = None,
+    interpret: bool = False,
+):
+    """The VMEM-resident belief-propagation launch for one padded shape.
+
+    Returns ``sweep(means, variances, neighbor_idx, neighbor_w) ->
+    (means, variances | None, iters_run, residual)`` over the FULL
+    padded markets axis — 1-D f32[M] moment vectors, i32/f32 (M, D)
+    aligned neighbour blocks (global row indices, −1 padding), the
+    same contract (and the same bits, pinned by tests/test_pallas_bp.py)
+    as :func:`~.ops.propagate.bp_sweep_math` at
+    ``axis_name=None``. ``moments=False`` is the point lane: pass
+    ``variances=None`` and the variance buffers are statically pruned
+    from the kernel (op-for-op :func:`~.ops.propagate.damped_sweep_math`).
+
+    The callable is meant to be traced inside a surrounding jit /
+    ``shard_map`` body (``parallel.sharded`` builds it at trace time
+    from the gathered global shape); it is not jitted here.
+    ``num_markets`` must be a multiple of the resolved ``tile_markets``
+    (``None`` → :func:`resolve_tile_sweep`).
+    """
+    if max_steps < 1:
+        raise ValueError(
+            f"max_steps={max_steps}: the kernel grid needs at least one "
+            "sweep — a zero-step sweep never reaches the kernel route"
+        )
+    if tol is not None and not tol > 0:
+        raise ValueError(
+            f"tol={tol!r}: a positive residual tolerance, or None for "
+            "the fixed-depth sweep"
+        )
+    tile = (
+        resolve_tile_sweep(num_markets, max_degree, moments)
+        if tile_markets is None
+        else int(tile_markets)
+    )
+    if num_markets % tile:
+        raise ValueError(
+            f"num_markets={num_markets} not a multiple of "
+            f"tile_markets={tile} — pad the markets axis (pad_markets) "
+            "before the kernel; a ragged tail tile would be dropped"
+        )
+    num_tiles = num_markets // tile
+    grid = (max_steps, num_tiles)
+
+    f32 = jnp.float32
+    nb_block = pl.BlockSpec(
+        (tile, max_degree), lambda it, t: (t, 0), memory_space=pltpu.VMEM
+    )
+    # Constant index_map: ONE VMEM window for the whole launch — the
+    # revisiting/accumulator pattern; Pallas flushes it to HBM once at
+    # the end instead of per grid step.
+    vec = pl.BlockSpec(
+        (1, num_markets), lambda it, t: (0, 0), memory_space=pltpu.VMEM
+    )
+    audit = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    m1 = jax.ShapeDtypeStruct((1, num_markets), f32)
+    n_vec = 2 if moments else 1
+    in_specs = [nb_block, nb_block] + [vec] * n_vec
+    out_specs = [vec] * n_vec + [audit, audit]
+    out_shape = [m1] * n_vec + [
+        jax.ShapeDtypeStruct((1, 1), jnp.int32),   # iters_run
+        jax.ShapeDtypeStruct((1, 1), f32),         # residual
+    ]
+    # The moment vectors update in place: seed inputs alias the result
+    # outputs (input 2+j -> output j), so the state is fetched from HBM
+    # once at launch and written back once at the end — zero per-sweep
+    # state round-trips, the kernel's whole point.
+    aliases = {2: 0, 3: 1} if moments else {2: 0}
+    scratch = [pltpu.VMEM((1, num_markets), f32)] * n_vec + [
+        pltpu.SMEM((1, 1), f32)
+    ]
+
+    call = pl.pallas_call(
+        partial(
+            _bp_kernel,
+            moments=moments,
+            tol=None if tol is None else float(tol),
+            damping=float(damping),
+            tile=tile,
+            num_tiles=num_tiles,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )
+
+    def sweep(means, variances, neighbor_idx, neighbor_w):
+        if moments and variances is None:
+            raise ValueError(
+                "built with moments=True but called without variances — "
+                "rebuild with moments=False for the point lane"
+            )
+        if not moments and variances is not None:
+            raise ValueError(
+                "built with moments=False (the point lane) but called "
+                "with variances — rebuild with moments=True"
+            )
+        args = [
+            neighbor_idx,
+            neighbor_w.astype(f32),
+            means.astype(f32)[None, :],
+        ]
+        if moments:
+            args.append(variances.astype(f32)[None, :])
+        out = call(*args)
+        mean = out[0][0]
+        var = out[1][0] if moments else None
+        iters, residual = out[n_vec][0, 0], out[n_vec + 1][0, 0]
+        return mean, var, iters, residual
+
+    return sweep

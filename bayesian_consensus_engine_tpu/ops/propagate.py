@@ -85,6 +85,64 @@ DEFAULT_SWEEP_STEPS = 2
 VAR_EPS = 1e-12
 
 
+def bp_row_mix(
+    v: Array,                  # f32[R] this row block's current means
+    s: "Array | None",         # f32[R] variances (moments) — unread otherwise
+    full: Array,               # f32[M] the FULL gathered mean vector
+    full_s: "Array | None",    # f32[M] the full variance vector (moments)
+    neighbor_idx: Array,       # i32[R, D] GLOBAL positions; -1 pad
+    weights: Array,            # f32[R, D] edge weights, padding already zeroed
+    *,
+    lam: Array,
+    keep: Array,
+    moments: bool,
+) -> Tuple[Array, "Array | None", Array]:
+    """One row block of the precision-weighted damped mix — the SHARED
+    per-row arithmetic of the sweep.
+
+    Both :func:`bp_sweep_math` (the XLA reference loop) and the
+    VMEM-resident kernel (``ops/pallas_bp.py``) trace THIS function, so
+    their bit-parity is structural, not empirical: every gather, masked
+    sum, and blend is literally the same traced op sequence
+    (the round-14 one-pass discipline, applied to inference). All
+    arithmetic is row-local given the full gathered vector(s); callers
+    own the gather and the cross-row residual reduction.
+
+    Returns ``(new_v, new_s, delta_rows)`` where ``delta_rows`` is the
+    per-row ``|Δmean|`` masked to mixing rows (zero elsewhere) — the
+    caller max-reduces it into the convergence residual (max is exactly
+    associative, so any reduction tiling gives the same bits).
+    """
+    f32 = jnp.float32
+    nb = full[jnp.clip(neighbor_idx, 0)]
+    ok = (neighbor_idx >= 0) & jnp.isfinite(nb)
+    if moments:
+        nb_var = full_s[jnp.clip(neighbor_idx, 0)]
+        ok = ok & jnp.isfinite(nb_var)
+        prec = f32(1.0) / (nb_var + f32(VAR_EPS))
+        w = jnp.where(ok, weights * prec, f32(0.0))
+    else:
+        w = jnp.where(ok, weights, f32(0.0))
+    wsum = jnp.sum(w, axis=-1)
+    wval = jnp.sum(w * jnp.where(ok, nb, f32(0.0)), axis=-1)
+    mixes = (wsum > 0) & jnp.isfinite(v)
+    denom = jnp.where(wsum > 0, wsum, f32(1.0))
+    blended = keep * v + lam * (wval / denom)
+    new_v = jnp.where(mixes, blended, v)
+    if moments:
+        wvar = jnp.sum(
+            w * w * jnp.where(ok, nb_var, f32(0.0)), axis=-1
+        )
+        blended_s = keep * keep * s + lam * lam * (
+            wvar / (denom * denom)
+        )
+        new_s = jnp.where(mixes, blended_s, s)
+    else:
+        new_s = s
+    delta_rows = jnp.where(mixes, jnp.abs(new_v - v), f32(0.0))
+    return new_v, new_s, delta_rows
+
+
 class PropagatedBeliefs(NamedTuple):
     """The moment-pair sweep's additive analytics output.
 
@@ -151,42 +209,22 @@ def bp_sweep_math(
             if axis_name is not None
             else v
         )
-        nb = full[jnp.clip(neighbor_idx, 0)]
-        ok = (neighbor_idx >= 0) & jnp.isfinite(nb)
         if moments:
             full_s = (
                 jax.lax.all_gather(s, axis_name, tiled=True)
                 if axis_name is not None
                 else s
             )
-            nb_var = full_s[jnp.clip(neighbor_idx, 0)]
-            ok = ok & jnp.isfinite(nb_var)
-            prec = f32(1.0) / (nb_var + f32(VAR_EPS))
-            w = jnp.where(ok, weights * prec, f32(0.0))
         else:
-            w = jnp.where(ok, weights, f32(0.0))
-        wsum = jnp.sum(w, axis=-1)
-        wval = jnp.sum(w * jnp.where(ok, nb, f32(0.0)), axis=-1)
-        mixes = (wsum > 0) & jnp.isfinite(v)
-        denom = jnp.where(wsum > 0, wsum, f32(1.0))
-        blended = keep * v + lam * (wval / denom)
-        new_v = jnp.where(mixes, blended, v)
-        if moments:
-            wvar = jnp.sum(
-                w * w * jnp.where(ok, nb_var, f32(0.0)), axis=-1
-            )
-            blended_s = keep * keep * s + lam * lam * (
-                wvar / (denom * denom)
-            )
-            new_s = jnp.where(mixes, blended_s, s)
-        else:
-            new_s = s
+            full_s = None
+        new_v, new_s, delta_rows = bp_row_mix(
+            v, s, full, full_s, neighbor_idx, weights,
+            lam=lam, keep=keep, moments=moments,
+        )
         # max |Δmean| over mixing rows; exactly order-independent, so
         # the pmax below makes it bit-identical (and replicated) on
         # every mesh factorisation.
-        delta = jnp.max(
-            jnp.where(mixes, jnp.abs(new_v - v), f32(0.0))
-        )
+        delta = jnp.max(delta_rows)
         if axis_name is not None:
             delta = jax.lax.pmax(delta, axis_name)
         return new_v, new_s, delta

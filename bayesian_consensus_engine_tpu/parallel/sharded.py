@@ -325,6 +325,98 @@ def _tuned_settle_kernel(
     )
 
 
+def _tuned_sweep_kernel(
+    mesh: Mesh,
+    num_slots: int,
+    num_markets: int,
+    steps: int,
+    max_degree: int,
+    sweep_steps: int,
+    sweep_mode: str,
+    sweep_tol,
+    damping: float,
+    chunk_agents,
+    chunk_slots,
+    precision: int,
+    z: float,
+) -> str:
+    """Resolve ``sweep_kernel="auto"`` for one settle + graph shape.
+
+    Same discipline as :func:`_tuned_settle_kernel`, knob
+    ``sweep_kernel``: the two candidate programs differ ONLY in the
+    sweep stage's route (XLA ``while_loop`` vs the VMEM-resident BP
+    kernel, ``ops/pallas_bp.py``), raced end-to-end on one clock
+    through the process :class:`~.utils.autotune.ShapeTuner`. The
+    kernel ships for this shape ONLY on a strict win; a candidate that
+    fails to compile records as ineligible rather than shipping.
+    Disabled (``BCE_AUTOTUNE`` unset) it resolves straight to
+    ``"xla"``.
+    """
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.utils.autotune import (
+        default_tuner,
+        time_best_of,
+    )
+
+    def measure(kind: str) -> float:
+        import jax.numpy as jnp
+
+        loop = build_cycle_analytics_loop(
+            mesh, chunk_agents=chunk_agents, chunk_slots=chunk_slots,
+            donate=False, precision=precision, z=z, damping=damping,
+            sweep_steps=sweep_steps, sweep_mode=sweep_mode,
+            sweep_tol=sweep_tol, sweep_kernel=kind,
+        )
+        rng = np.random.default_rng(47)
+        k, m, d = num_slots, num_markets, max_degree
+        probs = jnp.asarray(rng.random((k, m)), jnp.float32)
+        mask = jnp.asarray(rng.random((k, m)) < 0.9)
+        outcome = jnp.asarray(rng.random(m) < 0.5)
+        state = MarketBlockState(
+            reliability=jnp.asarray(
+                rng.uniform(0.1, 1.0, (k, m)), jnp.float32
+            ),
+            confidence=jnp.asarray(
+                rng.uniform(0.0, 1.0, (k, m)), jnp.float32
+            ),
+            updated_days=jnp.zeros((k, m), jnp.float32),
+            exists=jnp.asarray(rng.random((k, m)) < 0.7),
+        )
+        now = jnp.asarray(400.0, jnp.float32)
+        neighbor_idx = jnp.asarray(
+            rng.integers(0, m, (m, d)), jnp.int32
+        )
+        neighbor_w = jnp.asarray(
+            rng.uniform(0.1, 1.0, (m, d)), jnp.float32
+        )
+
+        def run() -> None:
+            out = loop(
+                probs, mask, outcome, state, now, steps,
+                neighbor_idx, neighbor_w,
+            )
+            prop = out[4]
+            np.asarray(  # fence: force the propagated mean to host
+                prop.mean if hasattr(prop, "mean") else prop
+            )
+
+        return time_best_of(run, repeats=2, warmup=1)
+
+    # The graph knobs are part of the key: degree changes the neighbour
+    # stream, mode/tol change the loop structure of BOTH programs — a
+    # verdict raced at one config must never answer for another.
+    return default_tuner().tune(
+        "sweep_kernel",
+        (num_slots, num_markets, steps, max_degree, sweep_steps,
+         sweep_mode, None if sweep_tol is None else float(sweep_tol),
+         *(int(s) for s in mesh.devices.shape)),
+        ["pallas"],
+        measure,
+        "xla",
+    )
+
+
 def build_cycle_analytics_loop(
     mesh: Mesh,
     chunk_agents: int | None = None,
@@ -340,6 +432,7 @@ def build_cycle_analytics_loop(
     with_bands: bool = True,
     tiebreak_kind: str = "ring",
     kernel: str = "xla",
+    sweep_kernel: str = "xla",
     interpret: bool | None = None,
 ):
     """THE fused co-resident scaffold: N cycles + optional tie-break +
@@ -411,6 +504,26 @@ def build_cycle_analytics_loop(
     identical on every mesh factorisation. ``sweep_mode="point"`` with
     ``sweep_tol=None`` (the default) is the legacy fixed-depth point
     sweep, bit-for-bit.
+
+    **Round 19 knob.** ``sweep_kernel="pallas"`` routes the graph sweep
+    (either mode) through the VMEM-resident belief-propagation kernel
+    (``ops/pallas_bp.py``): the (mean, variance) state stays in VMEM
+    across all sweep iterations instead of round-tripping HBM
+    ``2·max_steps`` times, neighbour blocks stream once per iteration
+    (the only traffic), and the deterministic early-exit runs in-kernel
+    as masked no-ops under the static bound — bit-identical outputs,
+    including the ``(iters_run, residual)`` audit pair, on every mesh
+    factorisation. Composes orthogonally with ``kernel=``: the one-pass
+    settle kernel and the BP kernel ride the SAME ``shard_map`` program
+    (settle kernel → BP kernel, no XLA stage between). On sharded
+    markets axes the seeds and neighbour blocks are all-gathered ONCE
+    per settle and each shard runs the full global sweep redundantly in
+    VMEM — one gather total vs the XLA sweep's gather per iteration.
+    ``sweep_kernel="auto"`` asks the honesty-guarded shape tuner
+    (:func:`_tuned_sweep_kernel`, knob ``sweep_kernel``): XLA ships
+    unless the kernel strictly won this shape's A/B — XLA stays the
+    production default. Requires ``sweep_steps > 0`` (there is no sweep
+    to offload otherwise).
     """
     from bayesian_consensus_engine_tpu.ops.propagate import (
         PropagatedBeliefs,
@@ -463,6 +576,20 @@ def build_cycle_analytics_loop(
             "the default), 'pallas' (the one-pass settlement kernel), "
             "or 'auto' (the honesty-guarded shape tuner)"
         )
+    if sweep_kernel not in ("xla", "pallas", "auto"):
+        raise ValueError(
+            f"sweep_kernel={sweep_kernel!r}: 'xla' (the while_loop "
+            "sweep, the default), 'pallas' (the VMEM-resident BP "
+            "kernel), or 'auto' (the honesty-guarded shape tuner)"
+        )
+    if not with_graph and sweep_kernel == "pallas":
+        raise ValueError(
+            "sweep_kernel='pallas' with sweep_steps=0: there is no "
+            "graph sweep to offload — build with sweep_steps > 0"
+        )
+    if not with_graph and sweep_kernel == "auto":
+        # Nothing to adjudicate: the ineligible-auto convention.
+        sweep_kernel = "xla"
     if tiebreak_kind == "sorted" and with_tiebreak and n_sources > 1:
         raise ValueError(
             "tiebreak_kind='sorted' needs the full agent row on one "
@@ -488,9 +615,13 @@ def build_cycle_analytics_loop(
         kernel = "xla"
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    compiled: dict[tuple[int, bool, bool], object] = {}
+    n_market_shards = mesh.shape[MARKETS_AXIS]
+    compiled: dict[tuple[int, bool, bool, bool], object] = {}
 
-    def compile_for(steps: int, has_exists: bool, use_pallas: bool):
+    def compile_for(
+        steps: int, has_exists: bool, use_pallas: bool,
+        use_sweep_pallas: bool,
+    ):
         cycle_fn = partial(
             _cycle_math, axis_name=SOURCES_AXIS, slots_axis=slots_axis
         )
@@ -499,9 +630,61 @@ def build_cycle_analytics_loop(
         )
         loop_math = make_loop_math(cycle_fn, steps, fast_cycle_fn=fast_fn)
 
+        def kernel_sweep(consensus, bands, neighbor_idx, neighbor_w):
+            # The VMEM-resident route (ops/pallas_bp.py): gather the
+            # seeds + neighbour blocks ONCE, run the full global sweep
+            # redundantly on every shard with the moment state pinned
+            # in VMEM, slice the local rows back out. The XLA loop
+            # pays the gather per iteration; here it collapses to one,
+            # and the audit pair needs no collective — every shard
+            # computes the same bits from the same full inputs.
+            from bayesian_consensus_engine_tpu.ops.pallas_bp import (
+                build_bp_sweep,
+            )
+
+            m_loc = consensus.shape[0]
+            variances = (
+                bands.stderr * bands.stderr if moments_sweep else None
+            )
+            if n_market_shards > 1:
+                gather = partial(
+                    jax.lax.all_gather, axis_name=MARKETS_AXIS,
+                    tiled=True,
+                )
+                consensus = gather(consensus)
+                neighbor_idx = gather(neighbor_idx)
+                neighbor_w = gather(neighbor_w)
+                if moments_sweep:
+                    variances = gather(variances)
+            bp = build_bp_sweep(
+                int(consensus.shape[0]), int(neighbor_idx.shape[1]),
+                sweep_steps,
+                damping=damping, tol=sweep_tol, moments=moments_sweep,
+                interpret=interpret,
+            )
+            mean, var, iters, residual = bp(
+                consensus, variances, neighbor_idx, neighbor_w
+            )
+            if n_market_shards > 1:
+                start = jax.lax.axis_index(MARKETS_AXIS) * m_loc
+                mean = jax.lax.dynamic_slice(mean, (start,), (m_loc,))
+                if moments_sweep:
+                    var = jax.lax.dynamic_slice(
+                        var, (start,), (m_loc,)
+                    )
+            if not moments_sweep:
+                return mean
+            return PropagatedBeliefs(
+                mean, jnp.sqrt(var), iters, residual
+            )
+
         def sweep(consensus, bands, graph_args):
             neighbor_idx, neighbor_w = graph_args
             with jax.named_scope("bce.consensus_sweep"):
+                if use_sweep_pallas:
+                    return kernel_sweep(
+                        consensus, bands, neighbor_idx, neighbor_w
+                    )
                 if not moments_sweep:
                     return damped_sweep_math(
                         consensus, neighbor_idx, neighbor_w,
@@ -630,6 +813,17 @@ def build_cycle_analytics_loop(
             chunk_agents, chunk_slots, precision, z,
         ) == "pallas"
 
+    def resolve_sweep_kernel(probs, steps: int, graph_args) -> bool:
+        if sweep_kernel == "pallas":
+            return True
+        if sweep_kernel == "xla":
+            return False
+        return _tuned_sweep_kernel(
+            mesh, int(probs.shape[0]), int(probs.shape[1]), steps,
+            int(graph_args[0].shape[1]), sweep_steps, sweep_mode,
+            sweep_tol, damping, chunk_agents, chunk_slots, precision, z,
+        ) == "pallas"
+
     def loop(probs, mask, outcome, state, now0, steps: int, *graph_args):
         if with_graph and len(graph_args) != 2:
             raise ValueError(
@@ -641,7 +835,12 @@ def build_cycle_analytics_loop(
                 "sweep_steps=0 — rebuild with sweep_steps > 0 to run "
                 "the graph sweep"
             )
-        key = (steps, state.exists is not None, resolve_kernel(probs, steps))
+        key = (
+            steps,
+            state.exists is not None,
+            resolve_kernel(probs, steps),
+            with_graph and resolve_sweep_kernel(probs, steps, graph_args),
+        )
         fn = compiled.get(key)
         if fn is None:
             fn = compiled[key] = compile_for(*key)

@@ -1206,13 +1206,14 @@ _analytics_loop_cache: dict = {}
 def _cached_analytics_loop(mesh, chunk_agents, chunk_slots, precision,
                            z, damping, sweep_steps, with_tiebreak,
                            tiebreak_kind="ring", kernel="xla",
-                           sweep_mode="point", sweep_tol=None):
+                           sweep_mode="point", sweep_tol=None,
+                           sweep_kernel="xla"):
     """One fused cycle(+tiebreak)+bands(+sweep) loop per configuration —
     shared across sessions like :func:`_cached_cycle_loop` (the jit
     tracing cache lives on the wrapper instance)."""
     key = (mesh, chunk_agents, chunk_slots, precision, z, damping,
            sweep_steps, with_tiebreak, tiebreak_kind, kernel,
-           sweep_mode, sweep_tol)
+           sweep_mode, sweep_tol, sweep_kernel)
     loop = _analytics_loop_cache.get(key)
     if loop is None:
         from bayesian_consensus_engine_tpu.parallel.sharded import (
@@ -1225,6 +1226,7 @@ def _cached_analytics_loop(mesh, chunk_agents, chunk_slots, precision,
             sweep_steps=sweep_steps, sweep_mode=sweep_mode,
             sweep_tol=sweep_tol, with_tiebreak=with_tiebreak,
             tiebreak_kind=tiebreak_kind, kernel=kernel,
+            sweep_kernel=sweep_kernel,
         )
         _analytics_loop_cache[key] = loop
     return loop
@@ -1591,6 +1593,7 @@ class ShardedSettlementSession:
         now: Optional[float] = None,
         analytics=None,
         kernel: Optional[str] = None,
+        sweep_kernel: Optional[str] = None,
     ) -> tuple:
         """Settle AND analyse the batch in ONE compiled program per chip.
 
@@ -1633,6 +1636,16 @@ class ShardedSettlementSession:
         pinned by tests/test_pallas_settle.py); ``"auto"`` — the
         honesty-guarded shape tuner (knob ``settle_kernel``): XLA ships
         unless the kernel strictly won this shape's A/B.
+
+        *sweep_kernel* (round 19; ``None`` defers to
+        ``analytics.sweep_kernel``) routes the graph sweep, orthogonal
+        to *kernel*: ``"pallas"`` runs the VMEM-resident
+        belief-propagation kernel (``ops/pallas_bp.py`` — moment state
+        carried in VMEM across all sweep iterations, outputs AND store
+        bytes bit-identical to the XLA sweep, pinned by
+        tests/test_pallas_bp.py); ``"auto"`` asks the honesty-guarded
+        tuner (knob ``sweep_kernel``). Needs a graph (or blocks) — the
+        loop builder refuses ``"pallas"`` with no sweep to offload.
         """
         import jax.numpy as jnp
 
@@ -1679,6 +1692,10 @@ class ShardedSettlementSession:
             )
         tiebreak_kind = "sorted" if tiebreak_opt == "sorted" else "ring"
         kernel = kernel if kernel is not None else options.kernel
+        sweep_kernel = (
+            sweep_kernel if sweep_kernel is not None
+            else options.sweep_kernel
+        )
         graph = options.graph
         # Cluster posture (round 13): bands and the tie-break are
         # per-market reductions over the sources axis, so they serve a
@@ -1768,6 +1785,7 @@ class ShardedSettlementSession:
                 self._mesh, chunk_agents, chunk_slots, options.precision,
                 options.z, damping, sweep_steps, bool(tiebreak_opt),
                 tiebreak_kind, kernel, sweep_mode, sweep_tol,
+                sweep_kernel,
             )
         with active_timeline().span("settle_dispatch"):
             outcome_g = global_market(

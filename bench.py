@@ -896,6 +896,92 @@ def _onepass_rate(num_markets, slots, timed_steps):
     return timed_best_of(loop_call, fresh_state, timed_steps)
 
 
+#: The BP bracket arm's shape (round 19). The markets cap keeps the
+#: kernel's resident state set (3 VMEM windows x 2 moment vectors x 4
+#: bytes/market ≈ 24 B/market) safely inside the 16 MB VMEM budget —
+#: the uncapped 1M-market regime is the kernel's recorded infeasibility,
+#: not this arm's workload.
+BP_SWEEP_MARKETS = 262_144
+BP_SWEEP_DEPTH = 24
+
+
+def _bp_rate(markets, degree, max_steps, kind, reps=3):
+    """Best-of-N full-depth moment sweeps/sec for ONE sweep route.
+
+    ``kind="xla"`` is the ``while_loop`` sweep
+    (:func:`~.ops.propagate.bp_sweep_math`), ``"pallas"`` the
+    VMEM-resident BP kernel (``ops/pallas_bp.py``) — the same dense
+    ``degree``-regular (M, D) workload AOT-compiled either way, so the
+    bracket times exactly the route swap. Interpret mode off-TPU, real
+    Mosaic on TPU.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bayesian_consensus_engine_tpu.ops.pallas_bp import build_bp_sweep
+    from bayesian_consensus_engine_tpu.ops.propagate import bp_sweep_math
+
+    rng = np.random.default_rng(19)
+    means = jnp.asarray(rng.random(markets), jnp.float32)
+    variances = jnp.asarray(
+        rng.uniform(1e-4, 0.05, markets), jnp.float32
+    )
+    idx = jnp.asarray(
+        rng.integers(0, markets, (markets, degree)), jnp.int32
+    )
+    w = jnp.asarray(rng.uniform(0.5, 1.5, (markets, degree)), jnp.float32)
+    if kind == "pallas":
+        fn = build_bp_sweep(
+            markets, degree, max_steps, damping=0.5,
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        def fn(v, s, i, wt):
+            return bp_sweep_math(
+                v, s, i, wt, damping=0.5, max_steps=max_steps
+            )
+    exe = jax.jit(fn).lower(means, variances, idx, w).compile()
+    _fence(exe(means, variances, idx, w)[0])  # warm off the clock
+    best = float("inf")
+    for _ in range(reps):
+        start = time.perf_counter()
+        _fence(exe(means, variances, idx, w)[0])
+        best = min(best, time.perf_counter() - start)
+    return round(max_steps / best, 1)
+
+
+def _bp_autotune_decision(markets, slots):
+    """Race the fused program's sweep routes through the honesty-guarded
+    tuner (knob ``sweep_kernel``) and return the recorded verdict.
+
+    The race itself is :func:`~.parallel.sharded._tuned_sweep_kernel` —
+    the two candidate programs differ only in the sweep stage — so the
+    leg's JSON carries the same adjudication record the ``"auto"``
+    route would act on (choice, default, beat_default).
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from bayesian_consensus_engine_tpu.parallel.sharded import (
+        _tuned_sweep_kernel,
+    )
+    from bayesian_consensus_engine_tpu.utils.autotune import default_tuner
+
+    mesh = Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1), ("markets", "sources")
+    )
+    _tuned_sweep_kernel(
+        mesh, slots, markets, 1, 8, BP_SWEEP_DEPTH, "moments", None,
+        0.5, None, None, 6, 1.959964,
+    )
+    return default_tuner().decision(
+        "sweep_kernel",
+        (slots, markets, 1, 8, BP_SWEEP_DEPTH, "moments", None, 1, 1),
+    )
+
+
 def bench_pallas_ab(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
                     timed_steps=TIMED_STEPS, large_k_attempt=True):
     """Adjudicate the Pallas kernel vs the XLA loop, interleaved in ONE
@@ -914,6 +1000,13 @@ def bench_pallas_ab(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
     block alone is 5.1 MB; the kernel holds ~10) is recorded as data,
     not a crash. The returned ``verdict``/``onepass_verdict`` are the
     win-or-retire decision inputs (VERDICT r4 #6; ISSUE 12).
+
+    Round 19 adds the FOURTH bracket arm: the correlated-market sweep,
+    XLA ``while_loop`` vs the VMEM-resident BP kernel at the
+    VMEM-bounded dense shape (``_bp_rate``), infeasible-as-data like
+    the other kernel arms, plus the honesty-guarded tuner's recorded
+    ``sweep_kernel`` adjudication (``bp_autotune_decision``) for the
+    fused route at the same shape.
     """
     from bayesian_consensus_engine_tpu.ops.pallas_cycle import _tuned_tile
 
@@ -966,6 +1059,21 @@ def bench_pallas_ab(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
                 f"infeasible: {type(exc).__name__}: {str(exc)[:200]}"
             )
 
+        # Round 19: the BP sweep bracket at the VMEM-bounded dense
+        # shape. Either route failing to compile is the recorded
+        # datum; the tuner's fused-route verdict rides along.
+        bp_m = min(num_markets, BP_SWEEP_MARKETS)
+        try:
+            out["bp_xla_sweeps_per_sec"] = _bp_rate(
+                bp_m, 8, BP_SWEEP_DEPTH, "xla"
+            )
+            out["bp_pallas_sweeps_per_sec"] = _bp_rate(
+                bp_m, 8, BP_SWEEP_DEPTH, "pallas"
+            )
+        except Exception as exc:
+            out["bp_sweep"] = _infeasible(exc)
+        out["bp_autotune_decision"] = _bp_autotune_decision(bp_m, slots)
+
         if large_k_attempt:
             try:
                 out["pallas_16k10k_cycles_per_sec"] = _pallas_rate(
@@ -1003,6 +1111,16 @@ def bench_pallas_ab(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
             f"onepass_wins_1m16 ({onepass:.1f} vs {xla_best:.1f})"
             if onepass > xla_best
             else f"xla_wins_onepass_1m16 ({xla_best:.1f} vs {onepass:.1f})"
+        )
+    bp_pallas = out.get("bp_pallas_sweeps_per_sec")
+    if bp_pallas is not None:
+        # Same-workload, same-clock: the sweep bracket is its own
+        # apples-to-apples pair, no cross-arm lower-bounding needed.
+        bp_xla = out["bp_xla_sweeps_per_sec"]
+        out["bp_verdict"] = (
+            f"bp_kernel_wins ({bp_pallas:.1f} vs {bp_xla:.1f})"
+            if bp_pallas > bp_xla
+            else f"xla_wins_bp ({bp_xla:.1f} vs {bp_pallas:.1f})"
         )
     return out
 
@@ -4134,6 +4252,14 @@ def bench_e2e_infer(markets=1024, slots=32, sparse_degree=2, dense_degree=8,
     * **adaptive_sparse / adaptive_dense** — the deterministic
       early-exit (``tol``): the sweep stops once the all-reduced
       ``max |Δmean|`` residual drops to the tolerance.
+    * **xla_sweep / pallas_sweep** — the round-19 kernel arm: the SAME
+      dense fixed-depth moment sweep as a standalone AOT stage, XLA
+      ``while_loop`` vs the VMEM-resident BP kernel
+      (``ops/pallas_bp.py``). Both executables ride the same
+      min-of-N clock AND the same ``_hbm_read_capture`` — the
+      ``sweep_read_capture`` ratio is args+temps of the kernel program
+      over the XLA program (the per-sweep gather temps the kernel keeps
+      in VMEM), the ``bce-tpu stats`` hbm_read column for this leg.
 
     The two graph shapes are the point of the comparison: *sparse*
     pairs each market with one partner (tiny components — the damped
@@ -4202,6 +4328,39 @@ def bench_e2e_infer(markets=1024, slots=32, sparse_degree=2, dense_degree=8,
             ).lower(probs, mask, outcome, state, now0, gi, gw).compile()
             exes[f"{policy}_{shape}"] = (exe, gi, gw)
 
+    # Round 19: the kernel arm — the dense fixed-depth sweep stage
+    # alone, both routes AOT-compiled so the read capture and the clock
+    # run off the SAME executables.
+    from bayesian_consensus_engine_tpu.ops.pallas_bp import (
+        build_bp_sweep,
+        resolve_tile_sweep,
+    )
+    from bayesian_consensus_engine_tpu.ops.propagate import bp_sweep_math
+
+    sweep_means = jnp.asarray(rng.random(m), jnp.float32)
+    sweep_vars = jnp.asarray(
+        rng.uniform(1e-4, 0.05, m), jnp.float32
+    )
+    sweep_tile = resolve_tile_sweep(m, dense_degree, True)
+    bp = build_bp_sweep(
+        m, dense_degree, max_steps, damping=0.5, moments=True,
+        interpret=jax.default_backend() != "tpu",
+    )
+    sweep_exes = {
+        "xla_sweep": jax.jit(
+            lambda v, s, gi, gw: bp_sweep_math(
+                v, s, gi, gw, damping=0.5, max_steps=max_steps
+            )
+        ).lower(sweep_means, sweep_vars, dense_idx, dense_w).compile(),
+        "pallas_sweep": jax.jit(bp).lower(
+            sweep_means, sweep_vars, dense_idx, dense_w
+        ).compile(),
+    }
+    sweep_reads = {
+        name: _hbm_read_capture(exe.memory_analysis())["hbm_read_bytes"]
+        for name, exe in sweep_exes.items()
+    }
+
     def dispatch(name):
         exe, gi, gw = exes[name]
         out = exe(probs, mask, outcome, state, now0, gi, gw)
@@ -4210,6 +4369,19 @@ def bench_e2e_infer(markets=1024, slots=32, sparse_degree=2, dense_degree=8,
         return prop
 
     def run_variant(name):
+        if name in sweep_exes:
+            exe = sweep_exes[name]
+            best = float("inf")
+            for _ in range(reps):
+                start = time.perf_counter()
+                out = exe(sweep_means, sweep_vars, dense_idx, dense_w)
+                _fence(out[0])
+                best = min(best, time.perf_counter() - start)
+            return {
+                "wall_s": round(best, 4),
+                "sweeps_per_sec": round(max_steps / best, 1),
+                "hbm_read_bytes": sweep_reads[name],
+            }
         best = float("inf")
         for _ in range(reps):
             start = time.perf_counter()
@@ -4224,9 +4396,17 @@ def bench_e2e_infer(markets=1024, slots=32, sparse_degree=2, dense_degree=8,
 
     variants = [
         "fixed_sparse", "adaptive_sparse", "fixed_dense", "adaptive_dense",
+        "xla_sweep", "pallas_sweep",
     ]
     for name in variants:  # warm off the clock
-        dispatch(name)
+        if name in sweep_exes:
+            _fence(
+                sweep_exes[name](
+                    sweep_means, sweep_vars, dense_idx, dense_w
+                )[0]
+            )
+        else:
+            dispatch(name)
     best = _min_of_trials("e2e_infer", variants, run_variant, trials)
 
     # Acceptance codas, off the clock: adaptive == fixed at convergence
@@ -4241,6 +4421,14 @@ def bench_e2e_infer(markets=1024, slots=32, sparse_degree=2, dense_degree=8,
     )
     iters_sparse = best["adaptive_sparse"]["iters_run"]
     iters_dense = best["adaptive_dense"]["iters_run"]
+    # The kernel arm's read story, off the same executables that raced:
+    # the shared one-pass capture fields plus this leg's own bar (the
+    # kernel must cut the sweep stage's bytes-read floor to ≤ 0.6 of
+    # the XLA program's at the dense shape — ISSUE 19).
+    sweep_fields = _onepass_ratio_fields(
+        sweep_reads["xla_sweep"], sweep_reads["pallas_sweep"],
+        m, sweep_tile,
+    )
     result = {
         "workload": (
             f"{m} markets x {k} slots, sweep depth {max_steps}, "
@@ -4252,6 +4440,12 @@ def bench_e2e_infer(markets=1024, slots=32, sparse_degree=2, dense_degree=8,
         "adaptive_saves_sweeps": bool(iters_sparse < max_steps),
         "sparse_fewer_sweeps": bool(iters_sparse < iters_dense),
         "adaptive_matches_fixed": matches,
+        "sweep_read_capture": {
+            **sweep_fields,
+            "sweep_read_leq_0p6": bool(
+                sweep_fields["read_ratio"] <= 0.6
+            ),
+        },
     }
     _ledger_record(
         "e2e_infer", value=best["adaptive_sparse"]["wall_s"], unit="s",
@@ -4259,13 +4453,19 @@ def bench_e2e_infer(markets=1024, slots=32, sparse_degree=2, dense_degree=8,
             "loadavg_1m_before": _loadavg_1m(),
             "bp_iters": iters_sparse,
             "bp_iters_dense": iters_dense,
+            # The kernel sweep's bytes-read floor — the stats table's
+            # hbm_read column for this leg, diffed by --against.
+            "hbm_read_bytes": sweep_reads["pallas_sweep"],
         },
     )
     print(
         f"e2e_infer: sparse settles in {iters_sparse}/{max_steps} sweeps "
         f"(dense {iters_dense}), adaptive {best['adaptive_sparse']['wall_s']}s "
         f"vs fixed {best['fixed_sparse']['wall_s']}s, "
-        f"matches_fixed={matches}"
+        f"matches_fixed={matches}; kernel sweep read_ratio "
+        f"{sweep_fields['read_ratio']} "
+        f"({best['pallas_sweep']['wall_s']}s vs "
+        f"{best['xla_sweep']['wall_s']}s)"
     )
     return result
 

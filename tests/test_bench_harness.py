@@ -307,7 +307,8 @@ class TestPallasAdjudication:
 
     def _run(self, monkeypatch, xla=(887.0, 900.0), pallas2048=620.0,
              auto_tile=1024, pallas_auto=700.0, large_k_error=None,
-             onepass=720.0, onepass_error=None):
+             onepass=720.0, onepass_error=None,
+             bp=(300.0, 480.0), bp_error=None):
         xla_values = iter(xla)
         monkeypatch.setattr(
             bench, "bench_headline", lambda *a, **k: next(xla_values)
@@ -325,8 +326,20 @@ class TestPallasAdjudication:
                 raise onepass_error
             return onepass
 
+        def fake_bp_rate(markets, degree, max_steps, kind):
+            if kind == "pallas" and bp_error is not None:
+                raise bp_error
+            return bp[0] if kind == "xla" else bp[1]
+
         monkeypatch.setattr(bench, "_pallas_rate", fake_rate)
         monkeypatch.setattr(bench, "_onepass_rate", fake_onepass_rate)
+        monkeypatch.setattr(bench, "_bp_rate", fake_bp_rate)
+        monkeypatch.setattr(
+            bench, "_bp_autotune_decision",
+            lambda m, s: {
+                "choice": "xla", "default": "xla", "beat_default": False,
+            },
+        )
         monkeypatch.setattr(
             "bayesian_consensus_engine_tpu.ops.pallas_cycle._tuned_tile",
             lambda m, k: auto_tile,
@@ -380,6 +393,28 @@ class TestPallasAdjudication:
         assert out["onepass_settle"].startswith("infeasible: RuntimeError")
         assert "onepass_verdict" not in out
         assert out["verdict"]
+
+    def test_bp_arm_adjudicates_the_sweep_routes(self, monkeypatch):
+        # Round 19: the fourth bracket arm is its own apples-to-apples
+        # pair (same workload, same depth), and the tuner's fused-route
+        # verdict rides the JSON.
+        out = self._run(monkeypatch, bp=(300.0, 480.0))
+        assert out["bp_xla_sweeps_per_sec"] == 300.0
+        assert out["bp_pallas_sweeps_per_sec"] == 480.0
+        assert out["bp_verdict"].startswith("bp_kernel_wins (480.0 vs 300.0")
+        assert out["bp_autotune_decision"]["default"] == "xla"
+        out = self._run(monkeypatch, bp=(480.0, 300.0))
+        assert out["bp_verdict"].startswith("xla_wins_bp (480.0 vs 300.0")
+
+    def test_bp_infeasibility_is_data_not_a_crash(self, monkeypatch):
+        out = self._run(
+            monkeypatch, bp_error=RuntimeError("VMEM OOM: 24MB > 16MB")
+        )
+        assert out["bp_xla_sweeps_per_sec"] == 300.0
+        assert "bp_pallas_sweeps_per_sec" not in out
+        assert out["bp_sweep"].startswith("infeasible: RuntimeError")
+        assert "bp_verdict" not in out
+        assert out["verdict"]  # the settle bracket still renders
 
 
 class TestOrchestrate:
@@ -1357,7 +1392,8 @@ class TestInferLeg:
             "workload", "fixed_sparse", "adaptive_sparse", "fixed_dense",
             "adaptive_dense", "wall_s", "bp_iters",
             "adaptive_saves_sweeps", "sparse_fewer_sweeps",
-            "adaptive_matches_fixed",
+            "adaptive_matches_fixed", "xla_sweep", "pallas_sweep",
+            "sweep_read_capture",
         ):
             assert key in result, key
         # The acceptance bars hold at every shape: the sparse graph
@@ -1372,12 +1408,31 @@ class TestInferLeg:
         )
         assert result["fixed_sparse"]["iters_run"] > result["bp_iters"]
         assert result["adaptive_sparse"]["wall_s"] > 0
+        # Round 19: the kernel arm races the standalone dense sweep
+        # both ways off the same AOT executables and captures their
+        # bytes-read floors; the ratio fields are the shared one-pass
+        # capture shape plus this leg's own ≤0.6 bar.
+        capture = result["sweep_read_capture"]
+        assert capture["multi_pass_read_bytes"] > 0
+        assert capture["one_pass_read_bytes"] > 0
+        assert capture["read_ratio"] > 0
+        assert capture["sweep_read_leq_0p6"] == (
+            capture["read_ratio"] <= 0.6
+        )
+        for name in ("xla_sweep", "pallas_sweep"):
+            assert result[name]["wall_s"] > 0
+            assert result[name]["sweeps_per_sec"] > 0
+            assert result[name]["hbm_read_bytes"] > 0
         json.dumps(result)
         # The ledger rows carry the trip count the stats table renders:
-        # min-across-repeats of extras.bp_iters.
+        # min-across-repeats of extras.bp_iters — and, round 19, the
+        # kernel sweep's bytes-read floor as the leg's hbm_read column.
         records = read_ledger(ledger_path)
         band = summarize(records)["e2e_infer"]
         assert band["bp_iters"] == result["bp_iters"]
+        assert band["hbm_read_bytes"] == (
+            result["pallas_sweep"]["hbm_read_bytes"]
+        )
 
     def test_leg_is_registered_for_device_runs(self):
         assert "e2e_infer" in bench.LEGS

@@ -330,6 +330,38 @@ _CASES = [
         "                          input_output_aliases={0: 0})\n",
     ),
     (
+        # Round 19 (VMEM-resident BP): the iteration-outer 2-D grid
+        # ``(steps, m // tile)`` with constant-index full-vector state
+        # windows. The bad twin floor-divides the markets axis with no
+        # guard AND double-bills the launch-resident state pair (in +
+        # out counted separately past the budget); the good twin guards
+        # the ragged tail and declares the literal
+        # ``input_output_aliases`` the in-place moment update actually
+        # uses (``ops/pallas_bp.py``), so the aliased windows count
+        # once and fit.
+        "PL501",
+        f"{PKG}/ops/case.py",
+        "from jax.experimental import pallas as pl\n\n\n"
+        "def build(m, tile, steps):\n"
+        "    grid = (steps, m // tile)\n"
+        "    state = pl.BlockSpec((1, 1048576), lambda it, t: (0, 0))\n"
+        "    nb = pl.BlockSpec((2048, 8), lambda it, t: (t, 0))\n"
+        "    return pl.pallas_call(None, grid=grid,\n"
+        "                          in_specs=[nb, state, state],\n"
+        "                          out_specs=[state, state])\n",
+        "from jax.experimental import pallas as pl\n\nM_STATE = 524288\n\n\n"
+        "def build(m, tile, steps):\n"
+        "    if m % tile:\n"
+        "        raise ValueError('markets axis must tile exactly')\n"
+        "    grid = (steps, m // tile)\n"
+        "    state = pl.BlockSpec((1, M_STATE), lambda it, t: (0, 0))\n"
+        "    nb = pl.BlockSpec((2048, 8), lambda it, t: (t, 0))\n"
+        "    return pl.pallas_call(None, grid=grid,\n"
+        "                          in_specs=[nb, state, state],\n"
+        "                          out_specs=[state, state],\n"
+        "                          input_output_aliases={1: 0, 2: 1})\n",
+    ),
+    (
         "F401",
         "tests/case.py",
         "import os\n\n\ndef f():\n    return 1\n",

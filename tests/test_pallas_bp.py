@@ -1,0 +1,606 @@
+"""Round 19: the VMEM-resident belief-propagation kernel
+(``ops/pallas_bp.py``) against the XLA sweep it replaces.
+
+The acceptance contract (ISSUE 19):
+
+* **Bit parity by matrix cell** — ``build_bp_sweep`` equals
+  ``bp_sweep_math`` (mean, variance, iters_run, residual — all four,
+  bit-for-bit) on {sparse deg-2, dense deg-8, edgeless, NaN-neighbour}
+  × {point, moments} × {fixed-depth, adaptive early-exit}, in
+  interpret mode on the tier-1 CPU backend, at forced multi-tile
+  grids. Parity is structural (both trace
+  :func:`~.ops.propagate.bp_row_mix`), so these tests are the
+  regression net over the scaffolding around the shared row math: the
+  Jacobi snapshot, the masked early-exit, the aliased VMEM windows.
+* **Mesh-factorisation invisibility** — the gather-once kernel route
+  produces the same bits as the single-shard reference on
+  (4,2)/(2,4)/(8,1)/(1,8), ops level and through the routed fused
+  program (same-mesh ``sweep_kernel="xla"`` vs ``"pallas"``).
+* **Session byte parity** — ``settle_with_analytics`` with
+  ``sweep_kernel="pallas"`` leaves every settlement artifact
+  byte-identical (store digest, journal epochs sans wall clock,
+  SQLite bytes) and every analytics output bit-identical.
+* **Routing honesty** — ``sweep_kernel="auto"`` rides the ShapeTuner
+  contract (knob ``sweep_kernel``): off → XLA without measuring; the
+  ineligible shapes raise by name.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from bayesian_consensus_engine_tpu.analytics import (
+    AnalyticsOptions,
+    MarketGraph,
+)
+from bayesian_consensus_engine_tpu.cluster.recover import store_digest
+from bayesian_consensus_engine_tpu.infer import (
+    InferenceOptions,
+    propagate_beliefs,
+)
+from bayesian_consensus_engine_tpu.ops.pallas_bp import (
+    build_bp_sweep,
+    resolve_tile_sweep,
+)
+from bayesian_consensus_engine_tpu.ops.propagate import bp_sweep_math
+from bayesian_consensus_engine_tpu.parallel._jax_compat import shard_map
+from bayesian_consensus_engine_tpu.parallel.mesh import (
+    MARKETS_AXIS,
+    make_mesh,
+)
+from bayesian_consensus_engine_tpu.parallel.sharded import (
+    MarketBlockState,
+    build_cycle_analytics_loop,
+)
+from bayesian_consensus_engine_tpu.pipeline import (
+    ShardedSettlementSession,
+    build_settlement_plan,
+)
+from bayesian_consensus_engine_tpu.state.journal import JournalWriter
+from bayesian_consensus_engine_tpu.state.tensor_store import (
+    TensorReliabilityStore,
+)
+
+NOW = 21_400.0
+
+MESH_SHAPES = [(4, 2), (2, 4), (8, 1), (1, 8)]
+
+
+def _workload(kind: str, m: int = 256, seed: int = 9):
+    """Moment seeds + neighbour blocks for one parity-matrix cell."""
+    rng = np.random.default_rng(seed)
+    means = rng.random(m).astype(np.float32)
+    variances = rng.uniform(1e-4, 0.05, m).astype(np.float32)
+    if kind == "sparse_deg2":
+        d = 2
+        idx = rng.integers(0, m, (m, d)).astype(np.int32)
+        idx[rng.random((m, d)) < 0.5] = -1
+    elif kind == "dense_deg8":
+        d = 8
+        idx = rng.integers(0, m, (m, d)).astype(np.int32)
+    elif kind == "edgeless":
+        d = 4
+        idx = np.full((m, d), -1, np.int32)
+    elif kind == "nan_neighbour":
+        d = 4
+        idx = rng.integers(0, m, (m, d)).astype(np.int32)
+        # NaN means AND NaN variances land on different rows, so both
+        # exclusion paths (mean-finite, variance-finite) fire.
+        means[::7] = np.nan
+        variances[3::11] = np.nan
+    else:  # pragma: no cover - test bug
+        raise AssertionError(kind)
+    w = rng.uniform(0.1, 1.5, idx.shape).astype(np.float32)
+    return (
+        jnp.asarray(means), jnp.asarray(variances),
+        jnp.asarray(idx), jnp.asarray(w),
+    )
+
+
+def _assert_quad_equal(got, want, label):
+    names = ("mean", "variance", "iters_run", "residual")
+    for name, g, w in zip(names, got, want):
+        if g is None or w is None:
+            assert g is None and w is None, f"{label}:{name}"
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(w), err_msg=f"{label}:{name}"
+        )
+
+
+WORKLOADS = ["sparse_deg2", "dense_deg8", "edgeless", "nan_neighbour"]
+
+
+class TestBpKernelParityMatrix:
+    """build_bp_sweep ≡ bp_sweep_math, every cell, interpret mode."""
+
+    @pytest.mark.parametrize("workload", WORKLOADS)
+    @pytest.mark.parametrize("moments", [True, False], ids=["moments", "point"])
+    @pytest.mark.parametrize("tol", [None, 1e-4], ids=["fixed", "adaptive"])
+    def test_bit_parity(self, workload, moments, tol):
+        if not moments and tol is not None:
+            pytest.skip("adaptive point sweep is not a routed config")
+        means, variances, idx, w = _workload(workload)
+        v_in = variances if moments else None
+        want = bp_sweep_math(
+            means, v_in, idx, w, damping=0.45, max_steps=16, tol=tol
+        )
+        sweep = build_bp_sweep(
+            means.shape[0], idx.shape[1], 16,
+            damping=0.45, tol=tol, moments=moments, interpret=True,
+        )
+        got = jax.jit(
+            lambda v, s, i, wt: sweep(v, s if moments else None, i, wt)
+        )(means, variances, idx, w)
+        _assert_quad_equal(got, want, f"{workload}/{moments}/{tol}")
+
+    @pytest.mark.parametrize("tile", [128, 64])
+    def test_multi_tile_grids_move_no_bits(self, tile):
+        # Forced small tiles: 2 and 4 tiles per sweep. The residual is
+        # a sequential max over tile maxes — exact associativity is the
+        # determinism argument; this pins it.
+        means, variances, idx, w = _workload("dense_deg8")
+        want = bp_sweep_math(
+            means, variances, idx, w, damping=0.45, max_steps=16,
+            tol=1e-4,
+        )
+        sweep = build_bp_sweep(
+            means.shape[0], idx.shape[1], 16,
+            damping=0.45, tol=1e-4, moments=True, tile_markets=tile,
+            interpret=True,
+        )
+        got = jax.jit(sweep)(means, variances, idx, w)
+        _assert_quad_equal(got, want, f"tile={tile}")
+
+    def test_adaptive_early_exit_freezes_the_audit_pair(self):
+        # Edgeless: the first sweep measures residual 0, every later
+        # grid step must be a masked no-op — iters stays 1.
+        means, variances, idx, w = _workload("edgeless")
+        sweep = build_bp_sweep(
+            means.shape[0], idx.shape[1], 24,
+            damping=0.45, tol=1e-4, moments=True, interpret=True,
+        )
+        mean, var, iters, residual = jax.jit(sweep)(
+            means, variances, idx, w
+        )
+        assert int(iters) == 1
+        assert float(residual) == 0.0
+        np.testing.assert_array_equal(np.asarray(mean), np.asarray(means))
+        np.testing.assert_array_equal(
+            np.asarray(var), np.asarray(variances)
+        )
+
+
+class TestKernelAcrossMeshFactorisations:
+    """The gather-once route: same bits as single-shard bp_sweep_math
+    on every factorisation of the markets axis."""
+
+    def _kernel_sharded(self, mesh_shape, means, variances, idx, w, *,
+                        tol, max_steps):
+        mesh = make_mesh(mesh_shape)
+        market = P(MARKETS_AXIS)
+        sweep = build_bp_sweep(
+            means.shape[0], idx.shape[1], max_steps,
+            damping=0.4, tol=tol, moments=True, interpret=True,
+        )
+
+        def math(v, s, i, wt):
+            # The routed program's exact shard structure: gather once,
+            # run the full global launch redundantly, slice local rows.
+            m_loc = v.shape[0]
+            gather = lambda x: jax.lax.all_gather(
+                x, MARKETS_AXIS, tiled=True
+            )
+            mean, var, iters, residual = sweep(
+                gather(v), gather(s), gather(i), gather(wt)
+            )
+            start = jax.lax.axis_index(MARKETS_AXIS) * m_loc
+            return (
+                jax.lax.dynamic_slice(mean, (start,), (m_loc,)),
+                jax.lax.dynamic_slice(var, (start,), (m_loc,)),
+                iters,
+                residual,
+            )
+
+        fn = shard_map(
+            math, mesh=mesh,
+            in_specs=(market, market, market, market),
+            out_specs=(market, market, P(), P()),
+            check_vma=False,
+        )
+        return jax.jit(fn)(means, variances, idx, w)
+
+    @pytest.mark.parametrize("tol", [None, 1e-3])
+    def test_ops_bitwise_parity_across_mesh_factorisations(self, tol):
+        means, variances, idx, w = _workload("sparse_deg2", m=64)
+        want = bp_sweep_math(
+            means, variances, idx, w, damping=0.4, max_steps=64, tol=tol
+        )
+        for shape in MESH_SHAPES:
+            got = self._kernel_sharded(
+                shape, means, variances, idx, w, tol=tol, max_steps=64
+            )
+            _assert_quad_equal(got, want, f"mesh={shape}")
+
+    @pytest.mark.parametrize("mesh_shape", MESH_SHAPES)
+    def test_routed_loop_same_mesh_xla_vs_kernel(self, mesh_shape):
+        # The full fused program per factorisation: swapping ONLY the
+        # sweep route moves no bits anywhere in the output tuple.
+        rng = np.random.default_rng(11)
+        k, m, d = 8, 256, 4
+        probs = jnp.asarray(rng.random((k, m)), jnp.float32)
+        mask = jnp.asarray(rng.random((k, m)) < 0.9)
+        outcome = jnp.asarray(rng.random(m) < 0.5)
+        state = MarketBlockState(
+            reliability=jnp.asarray(
+                rng.uniform(0.1, 1.0, (k, m)), jnp.float32
+            ),
+            confidence=jnp.asarray(
+                rng.uniform(0.0, 1.0, (k, m)), jnp.float32
+            ),
+            updated_days=jnp.zeros((k, m), jnp.float32),
+            exists=jnp.asarray(rng.random((k, m)) < 0.7),
+        )
+        now = jnp.asarray(400.0, jnp.float32)
+        nidx = jnp.asarray(rng.integers(0, m, (m, d)), jnp.int32)
+        nw = jnp.asarray(rng.uniform(0.1, 1.0, (m, d)), jnp.float32)
+        mesh = make_mesh(mesh_shape)
+
+        def run(sweep_kernel):
+            loop = build_cycle_analytics_loop(
+                mesh, donate=False, sweep_steps=12,
+                sweep_mode="moments", sweep_tol=1e-4,
+                sweep_kernel=sweep_kernel,
+            )
+            return loop(probs, mask, outcome, state, now, 2, nidx, nw)
+
+        want, got = run("xla"), run("pallas")
+        for slot, (a, b) in enumerate(zip(want, got)):
+            for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                np.testing.assert_array_equal(
+                    np.asarray(la), np.asarray(lb),
+                    err_msg=f"mesh={mesh_shape} slot={slot}",
+                )
+
+    def test_settle_kernel_composes_with_sweep_kernel(self):
+        # One shard_map program, kernel → kernel: the one-pass settle
+        # kernel feeds the BP kernel with no XLA stage between, and the
+        # whole tuple still matches the all-XLA program bit-for-bit.
+        rng = np.random.default_rng(13)
+        k, m, d = 8, 256, 3
+        probs = jnp.asarray(rng.random((k, m)), jnp.float32)
+        mask = jnp.asarray(rng.random((k, m)) < 0.9)
+        outcome = jnp.asarray(rng.random(m) < 0.5)
+        state = MarketBlockState(
+            reliability=jnp.asarray(
+                rng.uniform(0.1, 1.0, (k, m)), jnp.float32
+            ),
+            confidence=jnp.asarray(
+                rng.uniform(0.0, 1.0, (k, m)), jnp.float32
+            ),
+            updated_days=jnp.zeros((k, m), jnp.float32),
+            exists=jnp.asarray(rng.random((k, m)) < 0.7),
+        )
+        now = jnp.asarray(400.0, jnp.float32)
+        nidx = jnp.asarray(rng.integers(0, m, (m, d)), jnp.int32)
+        nw = jnp.asarray(rng.uniform(0.1, 1.0, (m, d)), jnp.float32)
+        mesh = make_mesh((8, 1))
+
+        def run(kernel, sweep_kernel):
+            loop = build_cycle_analytics_loop(
+                mesh, donate=False, sweep_steps=8,
+                sweep_mode="moments", sweep_tol=1e-5,
+                kernel=kernel, sweep_kernel=sweep_kernel,
+            )
+            return loop(probs, mask, outcome, state, now, 2, nidx, nw)
+
+        want = run("xla", "xla")
+        got = run("pallas", "pallas")
+        for slot, (a, b) in enumerate(zip(want, got)):
+            for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                np.testing.assert_array_equal(
+                    np.asarray(la), np.asarray(lb), err_msg=f"slot={slot}"
+                )
+
+
+def _journal_epochs_sans_clock(path):
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = hdr.unpack_from(blob, off)
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4  # + crc32
+    return epochs
+
+
+_SESSION_EDGES = [
+    ("m-0", "m-1", 0.5), ("m-1", "m-2", 0.7), ("m-3", "m-4", 0.4),
+]
+
+
+def _session_run(sweep_kernel, analytics, markets=12, seed=8):
+    import random
+
+    rng = random.Random(seed)
+    payloads = []
+    for m in range(markets):
+        payloads.append((
+            f"m-{m}",
+            [
+                {
+                    "sourceId": f"s{rng.randrange(8)}",
+                    "probability": round(rng.random(), 6),
+                }
+                for _ in range(rng.randint(1, 3))
+            ],
+        ))
+    outcomes = [True] * markets
+    store = TensorReliabilityStore()
+    plan = build_settlement_plan(store, payloads, num_slots=4,
+                                 fingerprint=True)
+    session = ShardedSettlementSession(store, plan, make_mesh((4, 2)))
+    with session:
+        out = session.settle_with_analytics(
+            outcomes, steps=1, now=NOW, analytics=analytics,
+            sweep_kernel=sweep_kernel,
+        )
+    store.sync()
+    return store, out
+
+
+class TestSessionSweepKernelParity:
+    """The fused session under sweep_kernel='pallas': identical
+    analytics bits, identical settlement bytes."""
+
+    @pytest.mark.parametrize(
+        "analytics",
+        [
+            AnalyticsOptions(
+                graph=MarketGraph.from_edges(
+                    _SESSION_EDGES, damping=0.4, steps=4
+                ),
+                inference=InferenceOptions(tol=1e-6, max_steps=32),
+            ),
+            AnalyticsOptions(
+                graph=MarketGraph.from_edges(_SESSION_EDGES, steps=3)
+            ),
+        ],
+        ids=["moments_adaptive", "point"],
+    )
+    def test_session_bit_and_byte_parity(self, analytics, tmp_path):
+        store_a, (res_a, tb_a, bands_a, prop_a) = _session_run(
+            "xla", analytics
+        )
+        store_b, (res_b, tb_b, bands_b, prop_b) = _session_run(
+            "pallas", analytics
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_a.consensus), np.asarray(res_b.consensus)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(bands_a.stderr), np.asarray(bands_b.stderr)
+        )
+        for pa, pb in zip(
+            jax.tree.leaves(prop_a), jax.tree.leaves(prop_b)
+        ):
+            np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+        # Byte parity on every settlement artifact: the sweep is an
+        # additive analytics read — the kernel route must not move a
+        # single stored byte.
+        assert store_digest(store_a) == store_digest(store_b)
+        for name, store in (("xla", store_a), ("pallas", store_b)):
+            writer = JournalWriter(tmp_path / f"{name}.jrnl")
+            store.flush_to_journal(writer)
+            writer.close()
+            store.flush_to_sqlite(tmp_path / f"{name}.db")
+        assert _journal_epochs_sans_clock(tmp_path / "xla.jrnl") == (
+            _journal_epochs_sans_clock(tmp_path / "pallas.jrnl")
+        )
+        assert (tmp_path / "xla.db").read_bytes() == (
+            tmp_path / "pallas.db"
+        ).read_bytes()
+
+    def test_analytics_options_carry_the_knob(self):
+        analytics = AnalyticsOptions(
+            graph=MarketGraph.from_edges(
+                _SESSION_EDGES, damping=0.4, steps=4
+            ),
+            inference=InferenceOptions(tol=1e-6, max_steps=16),
+            sweep_kernel="pallas",
+        )
+        ref = AnalyticsOptions(
+            graph=analytics.graph, inference=analytics.inference
+        )
+        _, (_, _, _, prop_k) = _session_run(None, analytics)
+        _, (_, _, _, prop_x) = _session_run(None, ref)
+        for pa, pb in zip(
+            jax.tree.leaves(prop_k), jax.tree.leaves(prop_x)
+        ):
+            np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+
+
+class TestHostEntryKernel:
+    def test_propagate_beliefs_kernel_parity(self):
+        keys = [f"m{i}" for i in range(10)]
+        graph = MarketGraph.from_edges(
+            [(f"m{i}", f"m{(i + 1) % 10}", 0.8) for i in range(10)],
+            steps=8, damping=0.4,
+        )
+        rng = np.random.default_rng(5)
+        means = np.full(128, np.nan, np.float32)
+        means[:10] = rng.random(10)
+        variances = np.full(128, np.nan, np.float32)
+        variances[:10] = rng.uniform(0.001, 0.1, 10)
+        options = InferenceOptions(tol=1e-5, max_steps=16)
+        want = propagate_beliefs(
+            means, variances, graph, keys, 128, options=options
+        )
+        got = propagate_beliefs(
+            means, variances, graph, keys, 128, options=options,
+            kernel="pallas",
+        )
+        _assert_quad_equal(
+            (got.mean, got.stderr, got.iters_run, got.residual),
+            (want.mean, want.stderr, want.iters_run, want.residual),
+            "host_entry",
+        )
+
+    def test_unknown_kernel_rejected(self):
+        graph = MarketGraph.from_edges([("a", "b", 1.0)])
+        with pytest.raises(ValueError, match="kernel="):
+            propagate_beliefs(
+                np.zeros(2, np.float32), np.ones(2, np.float32),
+                graph, ["a", "b"], 2, kernel="mosaic",
+            )
+
+
+class TestRoutingAndBuilders:
+    def test_sweep_kernel_option_validated(self):
+        with pytest.raises(ValueError, match="sweep_kernel="):
+            build_cycle_analytics_loop(
+                make_mesh((4, 2)), sweep_steps=2, sweep_kernel="cuda"
+            )
+
+    def test_pallas_sweep_needs_a_graph(self):
+        with pytest.raises(ValueError, match="no graph sweep"):
+            build_cycle_analytics_loop(
+                make_mesh((4, 2)), sweep_kernel="pallas"
+            )
+
+    def test_auto_without_graph_resolves_xla(self):
+        # Nothing to adjudicate — the ineligible-auto convention: the
+        # loop builds and never consults the tuner.
+        loop = build_cycle_analytics_loop(
+            make_mesh((4, 2)), sweep_kernel="auto"
+        )
+        assert callable(loop)
+
+    def test_builder_rejects_bad_knobs(self):
+        with pytest.raises(ValueError, match="max_steps"):
+            build_bp_sweep(128, 2, 0, damping=0.5)
+        with pytest.raises(ValueError, match="tol"):
+            build_bp_sweep(128, 2, 2, damping=0.5, tol=0.0)
+        with pytest.raises(ValueError, match="not a multiple"):
+            build_bp_sweep(130, 2, 2, damping=0.5, tile_markets=64)
+
+    def test_call_shape_matches_build_mode(self):
+        sweep = build_bp_sweep(
+            128, 2, 2, damping=0.5, moments=True, interpret=True
+        )
+        v = jnp.zeros(128, jnp.float32)
+        idx = jnp.zeros((128, 2), jnp.int32)
+        w = jnp.ones((128, 2), jnp.float32)
+        with pytest.raises(ValueError, match="without variances"):
+            sweep(v, None, idx, w)
+        point = build_bp_sweep(
+            128, 2, 2, damping=0.5, moments=False, interpret=True
+        )
+        with pytest.raises(ValueError, match="point lane"):
+            point(v, v, idx, w)
+
+    def test_tile_resolver_budget(self):
+        # Small shapes take the whole axis as one tile; the resolver
+        # never admits a state set over the 16 MB budget.
+        assert resolve_tile_sweep(256, 8, True) == 256
+        tile = resolve_tile_sweep(1024 * 512, 8, True)
+        assert (1024 * 512) % tile == 0
+
+
+class TestSweepKernelAutotune:
+    """sweep_kernel='auto' rides the ShapeTuner contract (knob
+    ``sweep_kernel``): off → XLA without measuring; on → the honesty
+    guard races the kernel against the XLA default on the same clock."""
+
+    def test_auto_resolves_through_tuner(self, monkeypatch):
+        from bayesian_consensus_engine_tpu.parallel import sharded
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        seen = {}
+
+        class FakeTuner:
+            def tune(self, knob, shape_key, candidates, measure, default):
+                seen.update(
+                    knob=knob, shape_key=shape_key,
+                    candidates=candidates, default=default,
+                )
+                return "pallas"
+
+        monkeypatch.setattr(autotune, "default_tuner", lambda: FakeTuner())
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        choice = sharded._tuned_sweep_kernel(
+            mesh, 16, 256, 2, 4, 8, "moments", 1e-4, 0.5,
+            None, None, 6, 1.959964,
+        )
+        assert choice == "pallas"
+        assert seen["knob"] == "sweep_kernel"
+        # Graph knobs ride the key: degree/mode/tol change both raced
+        # programs, so a verdict at one config never answers another.
+        assert seen["shape_key"] == (
+            16, 256, 2, 4, 8, "moments", 1e-4, 1, 1
+        )
+        assert seen["candidates"] == ["pallas"]
+        assert seen["default"] == "xla"
+
+    def test_default_off_resolves_xla_without_measuring(
+        self, monkeypatch, tmp_path
+    ):
+        from bayesian_consensus_engine_tpu.parallel import sharded
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        monkeypatch.delenv("BCE_AUTOTUNE", raising=False)
+        monkeypatch.setattr(autotune, "_default_tuner", None)
+        monkeypatch.setattr(
+            autotune, "_default_cache_path",
+            lambda: str(tmp_path / "never.json"),
+        )
+        mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+        choice = sharded._tuned_sweep_kernel(
+            mesh, 16, 256, 2, 4, 8, "moments", 1e-4, 0.5,
+            None, None, 6, 1.959964,
+        )
+        assert choice == "xla"
+        assert not (tmp_path / "never.json").exists()
+
+    def test_real_race_records_honesty_verdict(self, tmp_path):
+        # A REAL (tiny-shape) race through an enabled tuner: whatever
+        # wins, the cache entry must carry the default and the verdict —
+        # a tuned "pallas" may only ship with beat_default=True.
+        from bayesian_consensus_engine_tpu.parallel import sharded
+        from bayesian_consensus_engine_tpu.utils.autotune import ShapeTuner
+        from bayesian_consensus_engine_tpu.utils import autotune
+
+        tuner = ShapeTuner(
+            cache_path=str(tmp_path / "cache.json"), enabled=True
+        )
+        orig = autotune.default_tuner
+        autotune.default_tuner = lambda: tuner
+        try:
+            mesh = make_mesh((1, 1), devices=jax.devices()[:1])
+            choice = sharded._tuned_sweep_kernel(
+                mesh, 4, 16, 1, 2, 2, "moments", None, 0.5,
+                None, None, 6, 1.959964,
+            )
+            decision = tuner.decision(
+                "sweep_kernel", (4, 16, 1, 2, 2, "moments", None, 1, 1)
+            )
+        finally:
+            autotune.default_tuner = orig
+        assert decision is not None
+        assert decision["default"] == "xla"
+        assert decision["choice"] == choice
+        if choice == "pallas":
+            assert decision["beat_default"] is True
