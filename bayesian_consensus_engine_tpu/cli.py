@@ -30,7 +30,9 @@ graftlint, the repo's JAX/determinism/layering static analysis
 and, with ``--live URL``, a running telemetry exporter's scraped
 snapshot + health verdict (obs/export.py) beside them; ``trace``
 converts a request-tracing span log (obs/trace.py JSONL) to
-Chrome/Perfetto trace-event JSON.
+Chrome/Perfetto trace-event JSON; ``bank`` round-trips the versioned
+autotune bank (utils/autotune.py — shippable kernel-knob verdicts keyed
+by device generation and shape, loadable via ``BCE_AUTOTUNE_BANK``).
 """
 
 from __future__ import annotations
@@ -713,6 +715,87 @@ def _run_lint(args: argparse.Namespace) -> None:
     raise SystemExit(lint_main(argv))
 
 
+def _run_bank(args: argparse.Namespace) -> None:
+    """Round-trip the versioned autotune bank (utils/autotune.py).
+
+    ``export`` folds a host's honesty-guarded tuner cache into a
+    shippable bank payload (verdicts keyed by knob/shape/device
+    generation, evidence embedded); ``merge`` combines banks and REFUSES
+    on a verdict flip (same identity, different adjudication — a human
+    re-races, the tool never picks a side); ``show`` schema-validates a
+    bank and renders its verdicts. A deployment loads a bank with
+    ``BCE_AUTOTUNE_BANK=/path`` (or ``ShapeTuner(bank=...)``) and starts
+    from the recorded decisions without re-racing.
+    """
+    # Lazy import: tool code, off the hot consensus path.
+    from bayesian_consensus_engine_tpu.utils.autotune import (
+        export_bank,
+        load_bank,
+        merge_banks,
+        validate_bank,
+    )
+
+    def write_or_print(payload: dict) -> None:
+        text = json.dumps(payload, indent=2, sort_keys=True)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as fh:
+                fh.write(text + "\n")
+            print(
+                f"wrote {len(payload['entries'])} verdicts to {args.out}"
+            )
+        else:
+            print(text)
+
+    if args.bank_verb == "export":
+        payload = export_bank(args.cache, device_kind=args.device_kind)
+        if not payload["entries"]:
+            print(
+                "Error: no adjudicated verdicts to export (race some "
+                "shapes with BCE_AUTOTUNE=1 first)",
+                file=sys.stderr,
+            )
+            raise SystemExit(1)
+        write_or_print(payload)
+    elif args.bank_verb == "merge":
+        payloads = []
+        for path in args.banks:
+            payload = load_bank(path)
+            if payload is None:
+                print(f"Error: {path} is not a valid bank", file=sys.stderr)
+                raise SystemExit(1)
+            payloads.append(payload)
+        try:
+            merged = merge_banks(*payloads)
+        except ValueError as exc:
+            print(f"Error: {exc}", file=sys.stderr)
+            raise SystemExit(1) from exc
+        write_or_print(merged)
+    else:  # show
+        try:
+            with open(args.bank, encoding="utf-8") as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print(f"Error: {exc}", file=sys.stderr)
+            raise SystemExit(1) from exc
+        errors = validate_bank(payload)
+        if errors:
+            for err in errors:
+                print(f"Error: {err}", file=sys.stderr)
+            raise SystemExit(1)
+        print(f"{args.bank}: schema {payload['schema']}, "
+              f"{len(payload['entries'])} verdicts")
+        for entry in payload["entries"]:
+            verdict = (
+                "beat default" if entry["beat_default"] else "default held"
+            )
+            shape = ",".join(str(s) for s in entry["shape_key"])
+            print(
+                f"  {entry['generation']}  {entry['knob']}[{shape}] -> "
+                f"{entry['choice']} ({verdict}; default "
+                f"{entry['default']})"
+            )
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="bce-tpu",
@@ -975,6 +1058,54 @@ def build_parser() -> argparse.ArgumentParser:
         help="print the rule catalog and exit",
     )
     lint.set_defaults(handler=_run_lint)
+
+    bank = sub.add_parser(
+        "bank",
+        help=(
+            "export/merge/show the versioned autotune bank — shippable "
+            "kernel-knob verdicts keyed by (device generation, shape)"
+        ),
+    )
+    bank_sub = bank.add_subparsers(dest="bank_verb", required=True)
+    bank_export = bank_sub.add_parser(
+        "export",
+        help="fold a host's tuner cache into a shippable bank payload",
+    )
+    bank_export.add_argument(
+        "--cache",
+        help=(
+            "tuner cache to export (default: the live BCE_AUTOTUNE_CACHE "
+            "resolution)"
+        ),
+    )
+    bank_export.add_argument(
+        "--device-kind",
+        help="export only this accelerator's verdicts (default: all)",
+    )
+    bank_export.add_argument(
+        "-o", "--out", help="write the bank here (default: stdout)"
+    )
+    bank_export.set_defaults(handler=_run_bank)
+    bank_merge = bank_sub.add_parser(
+        "merge",
+        help=(
+            "merge bank files; refuses on a verdict flip (same "
+            "knob/shape/generation, different adjudication)"
+        ),
+    )
+    bank_merge.add_argument(
+        "banks", nargs="+", help="bank files to merge"
+    )
+    bank_merge.add_argument(
+        "-o", "--out", help="write the merged bank here (default: stdout)"
+    )
+    bank_merge.set_defaults(handler=_run_bank)
+    bank_show = bank_sub.add_parser(
+        "show",
+        help="schema-validate a bank file and render its verdicts",
+    )
+    bank_show.add_argument("bank", help="bank file to inspect")
+    bank_show.set_defaults(handler=_run_bank)
 
     return parser
 

@@ -16,11 +16,18 @@ they are decidable statically:
   resolves to a literal int (directly or through a module-level
   constant), the summed f32 block footprint — double-buffered, the
   pipelined launch's working set — must stay under the 16 MB scoped-VMEM
-  budget. Outputs aliased onto inputs via a LITERAL
-  ``input_output_aliases={in: out}`` dict (the one-pass settlement
-  kernel's in-place state idiom, ``ops/pallas_settle.py``) share the
-  input's buffer and are counted ONCE. Symbolic shapes — and computed
-  alias maps — are skipped: the runtime guard and the autotuner's
+  budget. Outputs aliased onto inputs via ``input_output_aliases`` (the
+  one-pass settlement kernel's in-place state idiom,
+  ``ops/pallas_settle.py``) share the input's buffer and are counted
+  ONCE. Since round 20 the alias map may be a LITERAL dict OR the
+  partials-kernel comprehension idiom ``{base + j: j for j in
+  range(N)}`` with a statically decidable ``base``/``N`` — the
+  multi-output partial-emitting launches (state blocks aliased in
+  place, fresh partial/view outputs merged outside the body) are
+  validated against the budget, not skipped. Spec lists built with
+  list arithmetic (``[a, b] + [block] * N``) resolve the same way.
+  Symbolic shapes — and alias maps/list lengths the resolver cannot
+  decide — are skipped: the runtime guard and the autotuner's
   measured ineligibility (a candidate tile whose compile raises) own
   the dynamic case.
 
@@ -125,27 +132,119 @@ def _resolve_dim(entry: ast.AST, module_consts: dict):
     return None
 
 
-def _aliased_output_indices(call: ast.Call):
+def _resolve_int(node: ast.AST, local: dict, module_consts: dict,
+                 depth: int = 0):
+    """An int expression when statically decidable, else None.
+
+    Literals, module-level constants, same-function names bound to
+    either, and ``+``/``-``/``*`` over decidable operands — enough for
+    the builders' ``base + j`` alias arithmetic and ``[block] * N``
+    spec lists, nothing speculative.
+    """
+    if depth > 4:
+        return None
+    if (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, int)
+        and not isinstance(node.value, bool)
+    ):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in module_consts:
+            return module_consts[node.id]
+        if node.id in local:
+            return _resolve_int(
+                local[node.id], local, module_consts, depth + 1
+            )
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Add, ast.Sub, ast.Mult)
+    ):
+        left = _resolve_int(node.left, local, module_consts, depth + 1)
+        right = _resolve_int(node.right, local, module_consts, depth + 1)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        return left * right
+    return None
+
+
+def _eval_alias_comprehension(comp: ast.DictComp, local: dict,
+                              module_consts: dict):
+    """Output indices of the partials alias idiom, else None.
+
+    Evaluates ``{<key>: j for j in range(N)}`` and
+    ``{<key>: j + base for j in range(N)}`` — one generator, no
+    filters, the loop variable indexing the OUTPUT side — with ``N``
+    (and ``base``) statically decidable. Anything else is undecidable.
+    """
+    if len(comp.generators) != 1:
+        return None
+    gen = comp.generators[0]
+    if gen.ifs or gen.is_async or not isinstance(gen.target, ast.Name):
+        return None
+    it = gen.iter
+    if not (
+        isinstance(it, ast.Call)
+        and isinstance(it.func, ast.Name)
+        and it.func.id == "range"
+        and len(it.args) == 1
+        and not it.keywords
+    ):
+        return None
+    n = _resolve_int(it.args[0], local, module_consts)
+    if n is None or not 0 <= n <= 256:
+        return None
+    loop_var = gen.target.id
+    value = comp.value
+    if isinstance(value, ast.Name) and value.id == loop_var:
+        return set(range(n))
+    if isinstance(value, ast.BinOp) and isinstance(value.op, ast.Add):
+        for var_side, base_side in (
+            (value.left, value.right), (value.right, value.left)
+        ):
+            if (
+                isinstance(var_side, ast.Name)
+                and var_side.id == loop_var
+            ):
+                base = _resolve_int(base_side, local, module_consts)
+                if base is not None:
+                    return {base + j for j in range(n)}
+    return None
+
+
+def _aliased_output_indices(call: ast.Call, local: dict,
+                            module_consts: dict):
     """Output indices aliased onto inputs, when statically decidable.
 
-    Reads a LITERAL ``input_output_aliases={in: out, ...}`` dict — the
-    dict's VALUES are output positions whose HBM buffers are the
-    aliased inputs' buffers, so the one-pass settlement idiom (state
-    tensors updated in place) is not double-billed by this rule. This
-    makes the lint the PERMISSIVE side of a deliberate asymmetry: the
-    pipelined launch may still hold separate VMEM windows for an
-    aliased pair, which is why the runtime tile resolver
+    Reads ``input_output_aliases`` — the map's VALUES are output
+    positions whose HBM buffers are the aliased inputs' buffers, so
+    the one-pass settlement idiom (state tensors updated in place) is
+    not double-billed by this rule. Decidable forms: a LITERAL
+    ``{in: out, ...}`` dict, a same-function name bound to one, and —
+    round 20, the partials-kernel idiom — the comprehension
+    ``{base + j: j for j in range(N)}`` with ``base``/``N`` resolving
+    to ints (:func:`_eval_alias_comprehension`). This makes the lint
+    the PERMISSIVE side of a deliberate asymmetry: the pipelined
+    launch may still hold separate VMEM windows for an aliased pair,
+    which is why the runtime tile resolver
     (``ops.pallas_settle.resolve_tile_markets``) counts them separately
     — the static rule flags only unambiguous overshoot, and the
     conservative resolver plus the autotuner's measured ineligibility
-    own the margin between the two models. A computed alias map
-    (comprehension, Name) returns ``None`` — undecidable, counted
-    conservatively.
+    own the margin between the two models. An alias map the resolver
+    cannot decide returns ``None`` — counted conservatively.
     """
     for kw in call.keywords:
         if kw.arg != "input_output_aliases":
             continue
         value = kw.value
+        if isinstance(value, ast.Name):
+            value = local.get(value.id, value)
+        if isinstance(value, ast.DictComp):
+            return _eval_alias_comprehension(value, local, module_consts)
         if not isinstance(value, ast.Dict):
             return None
         out: set[int] = set()
@@ -156,6 +255,47 @@ def _aliased_output_indices(call: ast.Call):
                 return None
         return out
     return set()
+
+
+def _resolve_spec_list(value: ast.AST, local: dict, module_consts: dict,
+                       depth: int = 0):
+    """A spec-list expression as a list of element nodes, else None.
+
+    Handles the builders' list arithmetic — ``[a, b] + [block] * N``
+    with ``N`` statically decidable — on top of plain lists/tuples and
+    same-function names (round 20: the partials builder's
+    ``[block] * n_state + [row3, row4, ...]`` out-spec shape).
+    """
+    if depth > 4:
+        return None
+    if isinstance(value, ast.Name):
+        bound = local.get(value.id)
+        if bound is None:
+            return None
+        return _resolve_spec_list(bound, local, module_consts, depth + 1)
+    if isinstance(value, (ast.List, ast.Tuple)):
+        return list(value.elts)
+    if isinstance(value, ast.BinOp):
+        if isinstance(value.op, ast.Add):
+            left = _resolve_spec_list(
+                value.left, local, module_consts, depth + 1
+            )
+            right = _resolve_spec_list(
+                value.right, local, module_consts, depth + 1
+            )
+            if left is not None and right is not None:
+                return left + right
+        if isinstance(value.op, ast.Mult):
+            for lst_side, n_side in (
+                (value.left, value.right), (value.right, value.left)
+            ):
+                lst = _resolve_spec_list(
+                    lst_side, local, module_consts, depth + 1
+                )
+                n = _resolve_int(n_side, local, module_consts)
+                if lst is not None and n is not None and 0 <= n <= 256:
+                    return lst * n
+    return None
 
 
 def _block_shapes(ctx, call: ast.Call, local, module_consts):
@@ -171,15 +311,16 @@ def _block_shapes(ctx, call: ast.Call, local, module_consts):
     for kw in call.keywords:
         if kw.arg in ("in_specs", "out_specs"):
             is_out = kw.arg == "out_specs"
-            value = kw.value
-            if isinstance(value, ast.Name):
-                value = local.get(value.id, value)
-            if isinstance(value, (ast.List, ast.Tuple)):
+            elts = _resolve_spec_list(kw.value, local, module_consts)
+            if elts is not None:
                 specs.extend(
                     (elt, i if is_out else None)
-                    for i, elt in enumerate(value.elts)
+                    for i, elt in enumerate(elts)
                 )
             else:
+                value = kw.value
+                if isinstance(value, ast.Name):
+                    value = local.get(value.id, value)
                 specs.append((value, 0 if is_out else None))
     for spec, out_index in specs:
         if isinstance(spec, ast.Name):
@@ -243,7 +384,7 @@ def check_pallas_grid_shape(ctx):
                             "dropped; guard and raise (see "
                             "ops/pallas_cycle.py)"
                         )
-            aliased = _aliased_output_indices(node)
+            aliased = _aliased_output_indices(node, local, module_consts)
             total = 0
             decidable = True
             for _lineno, dims, out_index in _block_shapes(

@@ -205,7 +205,42 @@ def min_of_repeats(
     band.update(_recovery_summary(records, leg))
     band.update(_replay_summary(records, leg))
     band.update(_bp_iters_summary(records, leg))
+    band.update(_autotune_summary(records, leg))
     return band
+
+
+def _autotune_summary(
+    records: List[Dict[str, object]], leg: str
+) -> Dict[str, object]:
+    """Recorded tuner verdicts carried by a leg's records (round 20).
+
+    Kernel-bearing legs record the honesty-guarded adjudication next to
+    their timings (``extras["autotune_decision"]`` /
+    ``extras["bp_autotune_decision"]`` / any ``*autotune_decision`` key —
+    the ``choice``/``default``/``beat_default`` entry plus the round-20
+    ``source`` tag: ``"race"`` for a verdict this host measured,
+    ``"bank"`` for one served from a loaded autotune bank). The LAST
+    recorded verdict per key wins (repeats re-read the same cache entry;
+    the freshest read is the one the leg acted on). Rendered as a
+    follow-up line under the leg row, and diffed by ``--against`` with
+    an explicit verdict-flip flag.
+    """
+    decisions: Dict[str, Dict[str, object]] = {}
+    for rec in records:
+        if rec.get("leg") != leg:
+            continue
+        extras = rec.get("extras") or {}
+        for key, value in extras.items():
+            if not str(key).endswith("autotune_decision"):
+                continue
+            if isinstance(value, dict) and "choice" in value:
+                decisions[str(key)] = {
+                    field: value.get(field)
+                    for field in (
+                        "choice", "default", "beat_default", "source"
+                    )
+                }
+    return {"autotune": decisions} if decisions else {}
 
 
 def _min_extras_summary(
@@ -528,6 +563,10 @@ def summarize(records: List[Dict[str, object]]) -> Dict[str, Dict[str, object]]:
             band = {"leg": leg, "n": n, "min": None, "max": None,
                     "spread_pct": None, "unit": None,
                     "loadavg_1m_range": None}
+            # Value-less summary records (the --leg entry point's
+            # dict-result legs, e.g. pallas_ab) still carry tuner
+            # adjudications worth rendering (round 20).
+            band.update(_autotune_summary(records, leg))
         out[leg] = band
     return out
 
@@ -610,6 +649,31 @@ def diff_bands(
                     metrics[f"qos.{cls}.{label}"] = {
                         "old": old_value, "new": new_value,
                     }
+        # Tuner verdicts (round 20): diff each recorded adjudication's
+        # choice and flag a VERDICT FLIP explicitly — a kernel that won
+        # last round and lost this one is exactly the re-adjudication
+        # signal the honesty guard exists to surface, and it can flip
+        # with both wall bands still overlapping.
+        old_autotune = (old_band or {}).get("autotune") or {}
+        new_autotune = (new_band or {}).get("autotune") or {}
+        for name in sorted(set(old_autotune) | set(new_autotune)):
+            old_verdict = old_autotune.get(name)
+            new_verdict = new_autotune.get(name)
+            old_choice = (old_verdict or {}).get("choice")
+            new_choice = (new_verdict or {}).get("choice")
+            record: Dict[str, object] = {
+                "old": old_choice, "new": new_choice,
+            }
+            if (
+                old_verdict is not None
+                and new_verdict is not None
+                and old_choice != new_choice
+            ):
+                record["verdict_flip"] = True
+            source = (new_verdict or {}).get("source")
+            if source is not None:
+                record["source"] = source
+            metrics[f"autotune.{name}"] = record
         if metrics:
             entry["metrics"] = metrics
         out[leg] = entry
@@ -621,7 +685,9 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
 
     Legs with merged latency/SLO metrics get a ``p99 old→new`` (and
     ``goodput old→new``) trailer so the serving leg's per-request story
-    diffs alongside its wall band.
+    diffs alongside its wall band.  Kernel-bearing legs with recorded
+    autotune adjudications get an ``autotune.* old->new`` trailer, with
+    ``FLIP`` appended when the verdict changed between rounds.
     """
     if not diff:
         return "no legs in either ledger"
@@ -636,6 +702,8 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
         if not metric:
             return ""
         def num(x):
+            if isinstance(x, str):
+                return x
             return f"{x:.4g}" if isinstance(x, (int, float)) else "-"
         label = {
             "goodput_within_slo": "goodput",
@@ -648,7 +716,8 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
             "replay_batches_per_s": "replay",
             "bp_iters": "iters",
         }.get(name, name)
-        return f"  {label} {num(metric['old'])}->{num(metric['new'])}"
+        flip = " FLIP" if metric.get("verdict_flip") else ""
+        return f"  {label} {num(metric['old'])}->{num(metric['new'])}{flip}"
 
     lines = [
         f"{'leg':<34} {'old band':>16} {'new band':>16} {'status':>13} unit"
@@ -669,7 +738,7 @@ def render_diff(diff: Dict[str, Dict[str, object]]) -> str:
         trailer += "".join(
             metric_str(entry, name)
             for name in sorted(entry.get("metrics") or {})
-            if name.startswith("qos.")
+            if name.startswith("qos.") or name.startswith("autotune.")
         )
         lines.append(
             f"{leg:<34} {band_str(entry['old']):>16} "
@@ -789,4 +858,24 @@ def render(records: List[Dict[str, object]]) -> str:
                     f"slo {record.get('slo_violations', '-')}"
                 )
             lines.append(f"{'':<6}qos  " + " | ".join(parts))
+        # Kernel-bearing legs with recorded tuner adjudications
+        # (extras.*autotune_decision — the pallas_ab/bp_ab benches) get
+        # a provenance follow-up line: which kernel was chosen, and
+        # whether it came from a live race or a shipped bank.
+        autotune = band.get("autotune")
+        if autotune:
+            parts = []
+            for name in sorted(autotune):
+                verdict = autotune[name]
+                source = verdict.get("source") or "race"
+                verdict_str = (
+                    "beat default"
+                    if verdict.get("beat_default")
+                    else "default held"
+                )
+                parts.append(
+                    f"{name}: {verdict.get('choice')} "
+                    f"({source}; {verdict_str})"
+                )
+            lines.append(f"{'':<6}autotune  " + " | ".join(parts))
     return "\n".join(lines)

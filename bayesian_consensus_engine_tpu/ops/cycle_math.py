@@ -123,6 +123,31 @@ def read_phase(
     return read_rel, read_conf
 
 
+def consensus_local_sums(
+    probs: jax.Array,
+    mask: jax.Array,
+    read_rel: jax.Array,
+    read_conf: jax.Array,
+    slots_axis: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The shard-local half of the consensus reduction: the three masked
+    weighted sums over the LOCAL slots axis, before any psum.
+
+    Split out of :func:`consensus_reduce` in round 20 so the sources-
+    sharded one-pass kernel can emit these raw per-shard sums from inside
+    its VMEM sweep and leave the cross-device psum + epilogue to plain
+    XLA outside the kernel body — the same
+    local-sums → psum → :func:`consensus_epilogue` pipeline the fused XLA
+    program traces, so parity is structural. Returns
+    ``(total_weight, weighted_prob, weighted_conf)``.
+    """
+    w = jnp.where(mask, read_rel, 0.0)
+    total_weight = jnp.sum(w, axis=slots_axis)
+    weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=slots_axis)
+    weighted_conf = jnp.sum(jnp.where(mask, read_conf, 0.0) * w, axis=slots_axis)
+    return total_weight, weighted_prob, weighted_conf
+
+
 def consensus_reduce(
     probs: jax.Array,
     mask: jax.Array,
@@ -137,10 +162,9 @@ def consensus_reduce(
     paths so the reduction semantics (masking, psum axis, epilogue) exist
     exactly once. Returns (consensus, confidence_out, total_weight).
     """
-    w = jnp.where(mask, read_rel, 0.0)
-    total_weight = jnp.sum(w, axis=slots_axis)
-    weighted_prob = jnp.sum(jnp.where(mask, probs, 0.0) * w, axis=slots_axis)
-    weighted_conf = jnp.sum(jnp.where(mask, read_conf, 0.0) * w, axis=slots_axis)
+    total_weight, weighted_prob, weighted_conf = consensus_local_sums(
+        probs, mask, read_rel, read_conf, slots_axis
+    )
     if axis_name is not None:
         total_weight = jax.lax.psum(total_weight, axis_name)
         weighted_prob = jax.lax.psum(weighted_prob, axis_name)
@@ -312,6 +336,96 @@ def _fast_cycle_math(
         reliability = jnp.where(mask, new_rel, reliability)
         confidence = jnp.where(mask, new_conf, confidence)
     return reliability, confidence, consensus
+
+
+def _sums_cycle_math(
+    probs: jax.Array,
+    mask: jax.Array,
+    outcome: jax.Array,
+    state: MarketBlockState,
+    now_days: jax.Array,
+    slots_axis: int = -1,
+    params: CycleParams | None = None,
+) -> CycleResult:
+    """:func:`_cycle_math` with the consensus slot carrying RAW local sums.
+
+    The sources-sharded one-pass route (round 20) cannot finish the
+    consensus inside the kernel — each shard holds only K_local slots —
+    so this variant stacks the three shard-local sums
+    (Σw, Σw·p, Σw·conf; see :func:`consensus_local_sums`) as a
+    (3, M) block in ``CycleResult.consensus`` for the cross-device
+    psum + :func:`consensus_epilogue` to consume OUTSIDE the kernel body.
+    Only ``.state`` and ``.consensus`` are meaningful; the scalar-shaped
+    fields carry the raw local values for structural convenience. Must be
+    paired with :func:`_sums_fast_cycle_math` under
+    :func:`make_loop_math` (the plain fori carry assumes an (M,)
+    consensus) and with ``steps >= 1`` (zero raw sums are not the XLA
+    program's zero consensus — the caller refuses steps == 0).
+    """
+    with jax.named_scope("bce.read_decay"):
+        read_rel, read_conf = read_phase(state, now_days, params)
+
+    with jax.named_scope("bce.consensus_local_sums"):
+        tw, wp, wc = consensus_local_sums(
+            probs, mask, read_rel, read_conf, slots_axis
+        )
+    with jax.named_scope("bce.outcome_update"):
+        new_state = update_phase(
+            probs, mask, outcome, state, read_conf, now_days, slots_axis,
+            params,
+        )
+    return CycleResult(new_state, jnp.stack([tw, wp, wc]), wc, tw)
+
+
+def _sums_fast_cycle_math(
+    probs: jax.Array,
+    mask: jax.Array,
+    outcome: jax.Array,
+    reliability: jax.Array,
+    confidence: jax.Array,
+    now_days: jax.Array,
+    prev_now: jax.Array,
+    slots_axis: int = -1,
+    params: CycleParams | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`_fast_cycle_math` emitting RAW local sums as the consensus.
+
+    Mirrors the fast path exactly — the broadcast-stamps decay read (the
+    bit-parity trick documented on :func:`_fast_cycle_math`), the shared
+    outcome update, the where-masked state write — but returns the (3, M)
+    local-sums stack instead of the finished consensus. Returns
+    ``(reliability', confidence', sums)``.
+    """
+    with jax.named_scope("bce.read_decay"):
+        stamps = jnp.broadcast_to(prev_now, reliability.shape)
+        read_rel = decayed_reliability_at(
+            reliability, stamps, now_days, jnp.asarray(True),
+            half_life_days=(
+                DECAY_HALF_LIFE_DAYS if params is None
+                else params.half_life_days
+            ),
+            floor=DECAY_MINIMUM if params is None else params.decay_floor,
+        )
+
+    with jax.named_scope("bce.consensus_local_sums"):
+        tw, wp, wc = consensus_local_sums(
+            probs, mask, read_rel, confidence, slots_axis
+        )
+
+    with jax.named_scope("bce.outcome_update"):
+        correct = (probs >= 0.5) == jnp.expand_dims(outcome, slots_axis)
+        if params is None:
+            new_rel, new_conf = outcome_update(reliability, confidence, correct)
+        else:
+            new_rel, new_conf = outcome_update(
+                reliability, confidence, correct,
+                base_lr=params.base_learning_rate,
+                max_step=params.max_update_step,
+                confidence_growth=params.confidence_growth,
+            )
+        reliability = jnp.where(mask, new_rel, reliability)
+        confidence = jnp.where(mask, new_conf, confidence)
+    return reliability, confidence, jnp.stack([tw, wp, wc])
 
 
 def run_fast_loop(state_carry, consensus0, fast_step, steps: int, now0):

@@ -37,11 +37,22 @@ interpret mode on the tier-1 CPU backend: store bytes, tie-break, bands
 — at every ``chunk_agents``/``chunk_slots`` setting
 (tests/test_pallas_settle.py).
 
-Scope: the kernel serves meshes whose SOURCES axis is unsharded (the
-1M-market north-star regime — markets sharded, K source slots local);
-``parallel.sharded.build_cycle_analytics_loop(kernel="pallas")`` owns
-the routing and raises for sources-sharded meshes. XLA stays the
-production default; the kernel ships per-shape only when the
+Scope: :func:`build_onepass_settle` serves meshes whose SOURCES axis is
+unsharded (markets sharded, K source slots local) and finishes all three
+result families in-kernel. Round 20 extends the route to 2-D meshes:
+:func:`build_onepass_partials` runs the same sweep over each shard's
+LOCAL (K_local, TILE_M) block and emits *partials* — the raw shard-local
+consensus sums, the band-moment tree roots, the per-slot decayed read
+views, and the per-shard loop-carried state — for a small deterministic
+cross-device merge OUTSIDE the kernel body (psum + epilogue for the
+exactly-associative sums, ``band_merge`` for the moment roots, the full
+axis-gated ``ring_tiebreak_math`` over the emitted read views for the
+tie-break). ``parallel.sharded.build_cycle_analytics_loop`` owns the
+routing between the two builders; ``kernel="pallas"`` still raises only
+for genuinely unsupported combinations (a disabled analytics stage,
+``tiebreak_kind="sorted"``, or ``steps=0`` on a sources-sharded mesh —
+zero raw sums cannot reproduce the XLA program's zero consensus). XLA
+stays the production default; the kernel ships per-shape only when the
 honesty-guarded A/B says it wins (``ShapeTuner`` knob ``settle_kernel``,
 ``kernel="auto"``). ``bench.py --leg e2e_onepass`` is the standing
 re-adjudication.
@@ -64,6 +75,8 @@ from bayesian_consensus_engine_tpu.ops.cycle_math import (
     MarketBlockState,
     _cycle_math,
     _fast_cycle_math,
+    _sums_cycle_math,
+    _sums_fast_cycle_math,
     make_loop_math,
 )
 from bayesian_consensus_engine_tpu.ops.tiebreak import (
@@ -86,11 +99,17 @@ from bayesian_consensus_engine_tpu.ops.uncertainty import (
 #: scoped-VMEM check, and the autotuner records any residual failure as
 #: ineligible).
 _BLOCKS_PER_TILE = 11
+#: The partials variant trades the in-kernel tie-break for two extra
+#: full (K, TILE_M) output blocks (the decayed read views the outside
+#: ring merge consumes): 7 inputs + 4 state outputs + 2 view outputs.
+_PARTIALS_BLOCKS_PER_TILE = 13
 _VMEM_BUDGET_BYTES = 16 * 1024 * 1024
 _TILE_CANDIDATES = (2048, 1024, 512, 256, 128)
 
 
-def resolve_tile_markets(num_markets: int, num_slots: int) -> int:
+def resolve_tile_markets(
+    num_markets: int, num_slots: int, blocks_per_tile: int = _BLOCKS_PER_TILE
+) -> int:
     """The largest standard tile dividing *num_markets* that keeps the
     double-buffered block set inside the 16 MB scoped-VMEM budget.
 
@@ -99,12 +118,13 @@ def resolve_tile_markets(num_markets: int, num_slots: int) -> int:
     (the divisibility guard in :func:`build_onepass_settle` is the PL501
     contract), and a one-tile launch over the VMEM budget fails at TPU
     compile time, which the autotuned A/B records as "ineligible" rather
-    than shipping.
+    than shipping. ``blocks_per_tile`` is the launch's (K, TILE_M) block
+    count — the partials variant carries two more than the fused kernel.
     """
     for tile in _TILE_CANDIDATES:
         if num_markets % tile:
             continue
-        bytes_ = num_slots * tile * 4 * _BLOCKS_PER_TILE * 2
+        bytes_ = num_slots * tile * 4 * blocks_per_tile * 2
         if bytes_ <= _VMEM_BUDGET_BYTES:
             return tile
     return num_markets
@@ -324,3 +344,217 @@ def build_onepass_settle(
         return new_state, consensus, tb, bands
 
     return onepass
+
+
+def _onepass_partials_kernel(
+    now_ref,        # SMEM (1, 1)
+    probs_ref,      # VMEM (K_local, TM) f32
+    mask_ref,       # VMEM (K_local, TM) f32 0/1
+    outcome_ref,    # VMEM (1, TM) f32 0/1
+    rel_ref,        # VMEM (K_local, TM) f32
+    conf_ref,       # VMEM (K_local, TM) f32
+    upd_ref,        # VMEM (K_local, TM) f32
+    *refs,          # [ex_ref] + output refs (see build_onepass_partials)
+    steps: int,
+    has_exists: bool,
+    chunk_slots,
+):
+    if has_exists:
+        ex_ref, refs = refs[0], refs[1:]
+        exists = ex_ref[...] > 0.0
+        state_out_refs, refs = refs[:4], refs[4:]
+    else:
+        exists = None
+        state_out_refs, refs = refs[:3], refs[3:]
+    (csum_ref, bsum_ref, b_count_ref, rrel_ref, rconf_ref) = refs
+
+    now = now_ref[0, 0]
+    probs = probs_ref[...]
+    mask = mask_ref[...] > 0.0
+    outcome = outcome_ref[...][0] > 0.0          # (TM,)
+    state = MarketBlockState(
+        reliability=rel_ref[...],
+        confidence=conf_ref[...],
+        updated_days=upd_ref[...],
+        exists=exists,
+    )
+
+    # The ONE decayed read of this shard's slots. Unlike the fused
+    # kernel, the views themselves are OUTPUTS: the tie-break needs
+    # bit-identical GLOBAL stats per quantised-key group (a group can
+    # span shards), so the axis-gated ring merge consumes these blocks
+    # outside the kernel body instead of a per-shard fold inside it.
+    from bayesian_consensus_engine_tpu.ops.cycle_math import read_phase
+
+    read_rel, read_conf = read_phase(state, now)
+
+    with jax.named_scope("bce.uncertainty_bands"):
+        # RAW shard-local moment roots only; band_merge + band_epilogue
+        # run outside (same reasoning as the fused kernel: barriers are
+        # stripped in kernel bodies, and the cross-shard fold needs the
+        # mesh axis).
+        sums, count = band_sums(
+            probs, mask, read_rel,
+            axis_name=None,
+            axis_size=1,
+            chunk_slots=chunk_slots,
+            agents_last=False,
+        )
+    loop_math = make_loop_math(
+        partial(_sums_cycle_math, slots_axis=0),
+        steps,
+        fast_cycle_fn=partial(_sums_fast_cycle_math, slots_axis=0),
+    )
+    new_state, csums = loop_math(probs, mask, outcome, state, now)
+
+    f32 = jnp.float32
+    state_out_refs[0][...] = new_state.reliability
+    state_out_refs[1][...] = new_state.confidence
+    state_out_refs[2][...] = new_state.updated_days
+    if has_exists:
+        state_out_refs[3][...] = new_state.exists.astype(f32)
+    csum_ref[...] = csums                 # (3, TM) Σw / Σw·p / Σw·conf
+    bsum_ref[...] = sums                  # (4, TM) band tree roots
+    b_count_ref[...] = count[None, :]
+    rrel_ref[...] = read_rel
+    rconf_ref[...] = read_conf
+
+
+def build_onepass_partials(
+    num_markets: int,
+    num_slots: int,
+    steps: int,
+    *,
+    has_exists: bool = True,
+    tile_markets: "int | None" = None,
+    chunk_slots: "int | None" = None,
+    interpret: bool = False,
+):
+    """The sources-sharded one-pass launch: per-shard kernel PARTIALS.
+
+    Returns ``partials(probs, mask, outcome, state, now) ->
+    (MarketBlockState, consensus_sums, band_sums, band_count,
+    read_rel, read_conf)`` over one shard's slot-major float32
+    (K_local, M_local) block:
+
+    * ``consensus_sums`` — (3, M) LAST-step raw local sums
+      (Σw, Σw·p, Σw·conf) for the three cross-device psums +
+      :func:`~.ops.cycle_math.consensus_epilogue` outside;
+    * ``band_sums``/``band_count`` — (4, M) shard-local tree roots +
+      i32 (M,) count for :func:`~.ops.uncertainty.band_merge` +
+      :func:`~.ops.uncertainty.band_epilogue` outside;
+    * ``read_rel``/``read_conf`` — the (K_local, M) decayed read views
+      for the full axis-gated ``ring_tiebreak_math`` outside (a
+      quantised-key tie-break group can span shards, so no per-shard
+      fold is exact);
+    * the returned state is the N-step loop's, exact per shard with NO
+      collectives (``update_phase`` never consumes the consensus — the
+      state evolution is embarrassingly parallel over sources).
+
+    The caller merges the partials INSIDE its shard_map body, tracing the
+    identical ``ops/cycle_math.py`` / ``ops/uncertainty.py`` phases the
+    fused XLA program traces — parity stays structural.
+    ``steps`` must be ≥ 1: zero raw sums normalise to NaN, not the XLA
+    program's zero-step zero consensus.
+    """
+    if steps < 1:
+        raise ValueError(
+            "build_onepass_partials needs steps >= 1: the kernel emits "
+            "RAW last-step consensus sums for the cross-device merge, and "
+            "a zero-step program's zero consensus is not representable as "
+            "sums (the epilogue of all-zero sums is NaN); route steps=0 "
+            "through kernel='xla'"
+        )
+    tile = (
+        resolve_tile_markets(
+            num_markets, num_slots, blocks_per_tile=_PARTIALS_BLOCKS_PER_TILE
+        )
+        if tile_markets is None
+        else int(tile_markets)
+    )
+    if num_markets % tile:
+        raise ValueError(
+            f"num_markets={num_markets} not a multiple of "
+            f"tile_markets={tile} — pad the markets axis (pad_markets) "
+            "before the kernel; a ragged tail tile would be dropped"
+        )
+    grid = (num_markets // tile,)
+
+    block = pl.BlockSpec(
+        (num_slots, tile), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    row = pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    row3 = pl.BlockSpec((3, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    row4 = pl.BlockSpec((4, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    scalar = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    f32 = jnp.float32
+    km = jax.ShapeDtypeStruct((num_slots, num_markets), f32)
+    m3 = jax.ShapeDtypeStruct((3, num_markets), f32)
+    m4 = jax.ShapeDtypeStruct((4, num_markets), f32)
+    m1_i32 = jax.ShapeDtypeStruct((1, num_markets), jnp.int32)
+
+    n_state = 4 if has_exists else 3
+    in_specs = [scalar, block, block, row] + [block] * n_state
+    out_specs = [block] * n_state + [row3, row4, row, block, block]
+    out_shape = (
+        [km] * n_state
+        + [m3]          # consensus raw sums
+        + [m4, m1_i32]  # band moment roots + count
+        + [km, km]      # decayed read views for the outside ring merge
+    )
+    # State tensors update in place: state inputs alias the state outputs
+    # (input 4+j -> output j) exactly as in build_onepass_settle; the
+    # view/partial outputs are fresh buffers.
+    aliases = {4 + j: j for j in range(n_state)}
+
+    call = pl.pallas_call(
+        partial(
+            _onepass_partials_kernel,
+            steps=steps,
+            has_exists=has_exists,
+            chunk_slots=chunk_slots,
+        ),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )
+
+    def partials(probs, mask, outcome, state: MarketBlockState, now):
+        if state.reliability.dtype != f32:
+            raise ValueError(
+                "the one-pass kernel serves float32 state blocks only "
+                f"(got {state.reliability.dtype}); keep kernel='xla' for "
+                "other compute dtypes"
+            )
+        now_arr = jnp.reshape(jnp.asarray(now, f32), (1, 1))
+        args = [
+            now_arr,
+            probs.astype(f32),
+            mask.astype(f32),
+            outcome.astype(f32)[None, :],
+            state.reliability,
+            state.confidence,
+            state.updated_days,
+        ]
+        if has_exists:
+            args.append(state.exists.astype(f32))
+        out = call(*args)
+        state_out, rest = out[:n_state], out[n_state:]
+        new_state = MarketBlockState(
+            reliability=state_out[0],
+            confidence=state_out[1],
+            updated_days=state_out[2],
+            exists=state_out[3] > 0.0 if has_exists else None,
+        )
+        csums = rest[0]          # (3, M)
+        bsums = rest[1]          # (4, M)
+        b_count = rest[2][0]     # (M,) i32
+        read_rel = rest[3]
+        read_conf = rest[4]
+        return new_state, csums, bsums, b_count, read_rel, read_conf
+
+    return partials

@@ -214,6 +214,25 @@ def band_sums(
         sums = _tree_sum(buf, 0)
 
     count = jnp.sum(mask, axis=slots_axis).astype(jnp.int32)
+    return band_merge(sums, count, axis_name=axis_name, axis_size=axis_size)
+
+
+def band_merge(
+    sums: Array,
+    count: Array,
+    *,
+    axis_name: "str | None",
+    axis_size: int,
+) -> tuple:
+    """Fold per-shard band-moment roots across the mesh axis.
+
+    The cross-device tail of :func:`band_sums`, split out in round 20 so
+    the sources-sharded one-pass kernel can emit its LOCAL (4, M) roots
+    from inside the VMEM sweep and have the identical merge — all-gather,
+    zero-pad to a power-of-two device count, the same balanced tree in
+    fixed device order, psum of the i32 count — traced OUTSIDE the kernel
+    body by the shard_map wrapper. A no-op on an unsharded axis.
+    """
     if axis_name is not None and axis_size > 1:
         # Per-shard roots folded in fixed device order with the same
         # balanced tree (padded to a power-of-two device count with

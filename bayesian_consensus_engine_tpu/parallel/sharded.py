@@ -480,8 +480,14 @@ def build_cycle_analytics_loop(
     ops/tiebreak.py). ``kernel="pallas"`` routes the whole program —
     cycles, tie-break, bands — through the one-pass settlement kernel
     (``ops/pallas_settle.py``): one HBM sweep per tile instead of 2–3
-    reduce passes, bit-identical outputs, sources axis unsharded and
-    ring tie-break + bands required (that trio IS the kernel).
+    reduce passes, bit-identical outputs, ring tie-break + bands
+    required (that trio IS the kernel). On a sources-sharded (2-D) mesh
+    (round 20) each shard's kernel sweeps its local block and emits
+    partials — raw consensus sums, band tree roots, decayed read views,
+    per-shard state — merged by a small deterministic cross-device
+    stage tracing the same layer-1 phases (psum + epilogue, band_merge,
+    the axis-gated ring tie-break); ``steps=0`` on that route raises
+    (zero raw sums cannot reproduce the zero-step consensus).
     ``kernel="auto"`` asks the honesty-guarded shape tuner
     (:func:`_tuned_settle_kernel`, knob ``settle_kernel``): XLA ships
     unless the kernel strictly won this shape's A/B — XLA stays the
@@ -598,12 +604,7 @@ def build_cycle_analytics_loop(
             "sources-sharded meshes"
         )
     pallas_ineligible = None
-    if n_sources > 1:
-        pallas_ineligible = (
-            "the one-pass kernel holds the full K slot axis per tile, "
-            f"but this mesh shards the sources axis {n_sources} ways"
-        )
-    elif not (with_tiebreak and with_bands) or tiebreak_kind != "ring":
+    if not (with_tiebreak and with_bands) or tiebreak_kind != "ring":
         pallas_ineligible = (
             "the one-pass kernel IS cycles + ring tie-break + bands in "
             "one sweep; disabling a stage (or tiebreak_kind='sorted') "
@@ -772,6 +773,67 @@ def build_cycle_analytics_loop(
                 out.append(sweep(consensus, bands, graph_args))
             return (new_state, consensus, *out)
 
+        def onepass_partials_math(
+            probs, mask, outcome, state, now0, *graph_args
+        ):
+            # The sources-sharded one-pass route (round 20): each shard's
+            # kernel sweeps its local (K_local, M_loc) block and emits
+            # PARTIALS; the cross-device merge below traces the SAME
+            # layer-1 phases the fused XLA body traces — the three
+            # consensus psums + epilogue, band_merge + band_epilogue,
+            # and the full axis-gated ring tie-break over the emitted
+            # decayed read views (a quantised-key group can span shards,
+            # so no per-shard fold is exact). The state needs NO merge:
+            # update_phase never consumes the consensus, so per-shard
+            # state evolution is already the global answer.
+            from bayesian_consensus_engine_tpu.ops.pallas_settle import (
+                build_onepass_partials,
+            )
+            from bayesian_consensus_engine_tpu.ops.uncertainty import (
+                band_epilogue,
+                band_merge,
+            )
+
+            k_loc, m_loc = probs.shape
+            partials = build_onepass_partials(
+                m_loc, k_loc, steps,
+                has_exists=has_exists,
+                chunk_slots=chunk_slots,
+                interpret=interpret,
+            )
+            with jax.named_scope("bce.onepass_partials"):
+                (new_state, csums, bsums, b_count,
+                 read_rel, read_conf) = partials(
+                    probs, mask, outcome, state, now0
+                )
+            with jax.named_scope("bce.ring_tiebreak"):
+                tiebreak = ring_tiebreak_math(
+                    probs, read_rel, read_conf, read_rel, mask,
+                    axis_name=SOURCES_AXIS,
+                    axis_size=n_sources,
+                    precision=precision,
+                    chunk_agents=chunk_agents,
+                    agents_last=False,  # slot-major: agents on axis 0
+                )
+            with jax.named_scope("bce.uncertainty_bands"):
+                bsums, b_count = band_merge(
+                    bsums, b_count,
+                    axis_name=SOURCES_AXIS, axis_size=n_sources,
+                )
+                bands = band_epilogue(bsums, b_count, z)
+            with jax.named_scope("bce.consensus_merge"):
+                # Same psum order as consensus_reduce: Σw, Σw·p, Σw·conf.
+                total_weight = jax.lax.psum(csums[0], SOURCES_AXIS)
+                weighted_prob = jax.lax.psum(csums[1], SOURCES_AXIS)
+                weighted_conf = jax.lax.psum(csums[2], SOURCES_AXIS)
+                consensus, _ = consensus_epilogue(
+                    total_weight, weighted_prob, weighted_conf
+                )
+            out = [tiebreak, bands]
+            if with_graph:
+                out.append(sweep(consensus, bands, graph_args))
+            return (new_state, consensus, *out)
+
         state_spec = MarketBlockState(
             block, block, block, block if has_exists else None
         )
@@ -794,8 +856,12 @@ def build_cycle_analytics_loop(
             + ((UncertaintyBands(*([market] * 6)),) if with_bands else ())
             + prop_spec
         )
+        if use_pallas:
+            body = onepass_partials_math if n_sources > 1 else onepass_math
+        else:
+            body = fused_math
         fn = shard_map(
-            onepass_math if use_pallas else fused_math,
+            body,
             mesh=mesh,
             in_specs=in_specs,
             out_specs=out_specs,
@@ -835,10 +901,27 @@ def build_cycle_analytics_loop(
                 "sweep_steps=0 — rebuild with sweep_steps > 0 to run "
                 "the graph sweep"
             )
+        use_pallas = resolve_kernel(probs, steps)
+        if use_pallas and n_sources > 1 and steps == 0:
+            # The partials kernel emits RAW last-step consensus sums for
+            # the cross-device merge; a zero-step program's zero
+            # consensus is not representable as sums (the epilogue of
+            # all-zero sums normalises to NaN, the XLA program returns
+            # zeros). Genuinely unsupported — refuse explicitly, resolve
+            # "auto" to the XLA program.
+            if kernel == "pallas":
+                raise ValueError(
+                    "kernel='pallas' unavailable: steps=0 on a "
+                    f"sources-sharded mesh ({n_sources} source shards) — "
+                    "the partials kernel cannot emit a zero-step "
+                    "consensus as raw sums; use kernel='xla' for "
+                    "zero-step settles"
+                )
+            use_pallas = False
         key = (
             steps,
             state.exists is not None,
-            resolve_kernel(probs, steps),
+            use_pallas,
             with_graph and resolve_sweep_kernel(probs, steps, graph_args),
         )
         fn = compiled.get(key)

@@ -15,6 +15,26 @@ byte-for-byte what they were before this module existed. Opt in with
 (default ``~/.cache/bce_autotune.json``). The cache key includes the
 device kind, so a cache written on one accelerator never answers for
 another.
+
+**The shippable bank (round 20).** The local cache is per-host and
+per-accelerator; the BANK is the same adjudicated verdicts made
+portable: a versioned JSON payload (:data:`BANK_SCHEMA`) of entries
+keyed by ``(knob, shape_key, device generation)`` with the honesty-guard
+evidence embedded (recorded default, per-candidate timings, the
+strict-win bit), so a fresh deployment on the same device generation
+starts from recorded verdicts instead of re-racing — the
+TPU-generations paper's architectural-stability bet (PAPERS.md). Load
+one via ``BCE_AUTOTUNE_BANK=/path/to/file.bank.json`` or
+``ShapeTuner(bank=...)``; the bank is its OWN opt-in (a banked verdict
+serves even with ``BCE_AUTOTUNE`` unset — it was measured, not
+guessed), but it answers only when its recorded default matches the
+caller's default and its choice is still among the caller's candidates;
+schema drift, a parse error, or a default mismatch all fall through to
+the pre-bank behaviour exactly like the PR-5 honesty guard's
+stale-entry fall-through. ``bce-tpu bank export|merge|show``
+round-trips the format; :func:`merge_banks` REFUSES on a verdict flip
+(two banks disagreeing about the same identity) rather than silently
+picking a side.
 """
 
 from __future__ import annotations
@@ -38,6 +58,10 @@ def _default_cache_path() -> str:
     )
 
 
+def _default_bank_path() -> Optional[str]:
+    return os.environ.get("BCE_AUTOTUNE_BANK") or None
+
+
 def _device_kind() -> str:
     try:
         import jax
@@ -45,6 +69,41 @@ def _device_kind() -> str:
         return jax.devices()[0].device_kind
     except Exception:  # noqa: BLE001 — no backend: still a usable key
         return "unknown"
+
+
+#: Version tag every bank payload must carry verbatim. Bump it whenever
+#: the entry shape changes: an old binary reading a new bank (or vice
+#: versa) then ignores the WHOLE bank and falls through to measurement —
+#: schema drift degrades to the pre-bank behaviour, never to a
+#: misread verdict.
+BANK_SCHEMA = "bce-autotune-bank/v1"
+
+#: The fields a bank entry must carry — the honesty-guard evidence
+#: travels WITH the verdict, so a loaded decision is auditable and
+#: ``bce-tpu stats`` can render why it shipped.
+_BANK_ENTRY_FIELDS = (
+    "knob", "shape_key", "generation", "choice", "default", "beat_default",
+    "timings_s",
+)
+
+
+def normalize_generation(device_kind: str) -> str:
+    """Device kind → the bank's generation key (``"TPU v5e"`` → ``"tpu-v5e"``).
+
+    Deliberately coarse: the TPU-generations paper's observation is that
+    kernel-level decisions are stable WITHIN a generation, so the bank
+    keys on the generation string, not the exact board/topology.
+    """
+    return "-".join(str(device_kind).strip().lower().split())
+
+
+def _entry_identity(entry: dict) -> tuple:
+    """The (knob, shape_key, generation) triple a bank entry answers for."""
+    return (
+        entry.get("knob"),
+        json.dumps(entry.get("shape_key")),
+        entry.get("generation"),
+    )
 
 
 class ShapeTuner:
@@ -80,12 +139,18 @@ class ShapeTuner:
         cache_path: Optional[str] = None,
         enabled: Optional[bool] = None,
         device_kind: Optional[str] = None,
+        bank=None,
     ) -> None:
         self._cache_path = cache_path or _default_cache_path()
         self._enabled = _default_enabled() if enabled is None else enabled
         self._device_kind = device_kind
         self._lock = threading.Lock()
         self._cache: Optional[dict] = None
+        # *bank*: a payload dict, a path to a bank file, or None (the
+        # BCE_AUTOTUNE_BANK env var, if set). Loaded lazily; an invalid
+        # bank resolves to "no bank" (fall through to measurement).
+        self._bank_source = bank if bank is not None else _default_bank_path()
+        self._bank_index: Optional[dict] = None
 
     @property
     def enabled(self) -> bool:
@@ -115,6 +180,35 @@ class ShapeTuner:
         except OSError:  # pragma: no cover — cache is an optimisation only
             pass
 
+    def _banked(self) -> dict:
+        """The bank's entries indexed by identity; {} when no/invalid bank.
+
+        A bank that fails to parse or validate is ignored WHOLE — a
+        partially-trusted bank could serve a verdict whose evidence
+        fields are the corrupted part, so drift degrades to the
+        pre-bank behaviour (measurement), exactly like a stale cache
+        entry under the honesty guard.
+        """
+        if self._bank_index is None:
+            payload = load_bank(self._bank_source)
+            index: dict = {}
+            if payload is not None:
+                for entry in payload["entries"]:
+                    index[_entry_identity(entry)] = entry
+            self._bank_index = index
+        return self._bank_index
+
+    def _bank_entry(self, knob: str, shape_key: tuple) -> Optional[dict]:
+        index = self._banked()
+        if not index:
+            return None
+        if self._device_kind is None:
+            self._device_kind = _device_kind()
+        return index.get(
+            (knob, json.dumps(list(shape_key)),
+             normalize_generation(self._device_kind))
+        )
+
     def tune(
         self,
         knob: str,
@@ -123,27 +217,44 @@ class ShapeTuner:
         measure: Callable[[object], float],
         default,
     ):
-        if not self._enabled or not candidates:
+        if not candidates:
             return default
         with self._lock:
-            key = self._key(knob, shape_key)
-            cache = self._load()
-            entry = cache.get(key)
-            # .get twice: a malformed entry (hand-edited / other-schema
-            # cache file) falls through to re-measurement — the cache is an
-            # optimisation only, never a crash.
-            # A cached verdict only answers when it was adjudicated
-            # against THIS default ("default" matching): entries from the
-            # pre-guard schema (no recorded default — argmin winners that
-            # were never raced against the default, exactly the VERDICT
-            # r5 #9 failure) and entries tuned against a different
-            # default both fall through to re-measurement.
-            if entry is not None and isinstance(entry, dict) and (
-                entry.get("default") == default
-            ):
-                cached = entry.get("choice")
-                if cached in list(candidates) or cached == default:
-                    return cached
+            if self._enabled:
+                key = self._key(knob, shape_key)
+                cache = self._load()
+                entry = cache.get(key)
+                # .get twice: a malformed entry (hand-edited / other-schema
+                # cache file) falls through to re-measurement — the cache is
+                # an optimisation only, never a crash.
+                # A cached verdict only answers when it was adjudicated
+                # against THIS default ("default" matching): entries from
+                # the pre-guard schema (no recorded default — argmin winners
+                # that were never raced against the default, exactly the
+                # VERDICT r5 #9 failure) and entries tuned against a
+                # different default both fall through to re-measurement.
+                if entry is not None and isinstance(entry, dict) and (
+                    entry.get("default") == default
+                ):
+                    cached = entry.get("choice")
+                    if cached in list(candidates) or cached == default:
+                        return cached
+            # The bank: recorded verdicts from a SAME-GENERATION race,
+            # below the live local cache, above re-measurement. The bank
+            # is its own opt-in (passing one / setting BCE_AUTOTUNE_BANK
+            # means "serve these adjudicated defaults"), so it answers
+            # even with BCE_AUTOTUNE unset — but only under the same
+            # validity rule as the cache: recorded default == the
+            # caller's default, choice still a legal answer. A banked
+            # answer is NOT copied into the local cache — re-enabling
+            # measurement without the bank re-races from scratch.
+            banked = self._bank_entry(knob, shape_key)
+            if banked is not None and banked.get("default") == default:
+                from_bank = banked.get("choice")
+                if from_bank in list(candidates) or from_bank == default:
+                    return from_bank
+            if not self._enabled:
+                return default
             to_measure = list(candidates)
             if default not in to_measure:
                 # The honesty guard needs the default on the same clock.
@@ -176,10 +287,26 @@ class ShapeTuner:
     def decision(self, knob: str, shape_key: tuple):
         """The recorded tuning verdict for (knob, shape) — the cache entry
         (``choice``/``default``/``beat_default``/``timings_s``), or
-        ``None`` when nothing was measured/persisted yet."""
+        ``None`` when nothing was measured/persisted yet.
+
+        Tagged with its provenance: ``"source": "race"`` for a verdict
+        this host measured (the local cache), ``"source": "bank"`` for
+        one served from a loaded bank — ``bce-tpu stats`` renders the
+        distinction next to kernel-bearing legs.
+        """
         with self._lock:
             entry = self._load().get(self._key(knob, shape_key))
-            return dict(entry) if isinstance(entry, dict) else None
+            if isinstance(entry, dict):
+                return dict(entry, source="race")
+            banked = self._bank_entry(knob, shape_key)
+            if isinstance(banked, dict):
+                verdict = {
+                    k: banked.get(k)
+                    for k in ("choice", "default", "beat_default", "timings_s")
+                }
+                verdict["source"] = "bank"
+                return verdict
+            return None
 
 
 def time_best_of(
@@ -203,6 +330,183 @@ def time_best_of(
         run()
         best = min(best, time.perf_counter() - start)
     return best
+
+
+def validate_bank(payload) -> list:
+    """Schema-validate a bank payload; returns a list of error strings.
+
+    Empty list ⇒ valid. The same checks gate both the loader (an invalid
+    bank is ignored whole) and the devlint ``*.bank.json`` step (a
+    hand-edited bank cannot ship silently): exact schema tag, an
+    ``entries`` list, every entry carrying every field with sane types,
+    no duplicate (knob, shape_key, generation) identities.
+    """
+    errors: list = []
+    if not isinstance(payload, dict):
+        return [f"bank payload is {type(payload).__name__}, expected object"]
+    schema = payload.get("schema")
+    if schema != BANK_SCHEMA:
+        errors.append(
+            f"schema {schema!r} != {BANK_SCHEMA!r} (unversioned or drifted "
+            "bank; regenerate with 'bce-tpu bank export')"
+        )
+        return errors  # entry layout is undefined under another schema
+    entries = payload.get("entries")
+    if not isinstance(entries, list):
+        errors.append("'entries' missing or not a list")
+        return errors
+    seen: dict = {}
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            errors.append(f"entries[{i}]: not an object")
+            continue
+        missing = [f for f in _BANK_ENTRY_FIELDS if f not in entry]
+        if missing:
+            errors.append(f"entries[{i}]: missing fields {missing}")
+            continue
+        if not isinstance(entry["knob"], str) or not entry["knob"]:
+            errors.append(f"entries[{i}]: 'knob' must be a non-empty string")
+        if not isinstance(entry["shape_key"], list):
+            errors.append(f"entries[{i}]: 'shape_key' must be a list")
+        generation = entry["generation"]
+        if not isinstance(generation, str) or not generation:
+            errors.append(
+                f"entries[{i}]: 'generation' must be a non-empty string"
+            )
+        elif generation != normalize_generation(generation):
+            errors.append(
+                f"entries[{i}]: generation {generation!r} is not "
+                f"normalised (expected {normalize_generation(generation)!r})"
+            )
+        if not isinstance(entry["beat_default"], bool):
+            errors.append(f"entries[{i}]: 'beat_default' must be a bool")
+        if not isinstance(entry["timings_s"], dict):
+            errors.append(f"entries[{i}]: 'timings_s' must be an object")
+        elif not all(
+            isinstance(v, (int, float)) and not isinstance(v, bool)
+            for v in entry["timings_s"].values()
+        ):
+            errors.append(f"entries[{i}]: 'timings_s' values must be numbers")
+        identity = _entry_identity(entry)
+        if identity in seen:
+            errors.append(
+                f"entries[{i}]: duplicate identity {identity} "
+                f"(first at entries[{seen[identity]}])"
+            )
+        else:
+            seen[identity] = i
+    return errors
+
+
+def load_bank(source):
+    """Load + validate a bank from a path or payload dict; None if invalid.
+
+    The one loader every consumer routes through (ShapeTuner, the CLI
+    verbs): a missing file, a parse error, or a failed
+    :func:`validate_bank` all resolve to ``None`` — the caller falls
+    through to measurement, never crashes on a bad bank.
+    """
+    if source is None:
+        return None
+    payload = source
+    if isinstance(source, (str, Path)):
+        try:
+            with open(source) as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError):
+            return None
+    if validate_bank(payload):
+        return None
+    return payload
+
+
+def export_bank(
+    cache_path: Optional[str] = None,
+    device_kind: Optional[str] = None,
+) -> dict:
+    """Fold a local tuner cache into a shippable bank payload.
+
+    Reads the honesty-guarded cache (``cache_path`` defaulting to the
+    live ``BCE_AUTOTUNE_CACHE`` resolution) and emits one bank entry per
+    adjudicated verdict, the device kind normalised to its generation
+    key. ``device_kind`` filters to one accelerator's verdicts (pass the
+    exact kind string the cache recorded); ``None`` exports everything.
+    Pre-guard cache entries (no recorded default) are skipped — a bank
+    ships ADJUDICATED verdicts only.
+    """
+    path = cache_path or _default_cache_path()
+    try:
+        with open(path) as fh:
+            cache = json.load(fh)
+    except (OSError, ValueError):
+        cache = {}
+    entries = []
+    for key, entry in sorted(cache.items()):
+        try:
+            knob, shape_key, kind = json.loads(key)
+        except (ValueError, TypeError):
+            continue
+        if not isinstance(entry, dict) or "default" not in entry:
+            continue  # pre-guard schema: never raced against the default
+        if device_kind is not None and kind != device_kind:
+            continue
+        entries.append({
+            "knob": knob,
+            "shape_key": shape_key,
+            "generation": normalize_generation(kind),
+            "choice": entry.get("choice"),
+            "default": entry.get("default"),
+            "beat_default": bool(entry.get("beat_default")),
+            "timings_s": dict(entry.get("timings_s") or {}),
+        })
+    return {"schema": BANK_SCHEMA, "entries": entries}
+
+
+def merge_banks(*payloads) -> dict:
+    """Merge bank payloads; REFUSE on a verdict flip.
+
+    Two entries with the same (knob, shape_key, generation) identity must
+    agree on the adjudication — ``choice``, ``default`` and
+    ``beat_default`` — or the merge raises ``ValueError``: a flip means
+    the two hosts measured different winners for the same generation and
+    a human must adjudicate (re-race, or drop one bank), not a merge
+    tool. Agreeing duplicates keep the entry whose recorded choice
+    timing is lower (the better-evidenced copy of the same verdict).
+    """
+    merged: dict = {}
+    for payload in payloads:
+        errors = validate_bank(payload)
+        if errors:
+            raise ValueError(f"invalid bank: {errors[0]}")
+        for entry in payload["entries"]:
+            identity = _entry_identity(entry)
+            prior = merged.get(identity)
+            if prior is None:
+                merged[identity] = entry
+                continue
+            verdict = ("choice", "default", "beat_default")
+            if any(prior.get(f) != entry.get(f) for f in verdict):
+                raise ValueError(
+                    "verdict flip for knob "
+                    f"{entry['knob']!r} shape {entry['shape_key']} "
+                    f"generation {entry['generation']!r}: "
+                    f"{prior.get('choice')!r} (beat_default="
+                    f"{prior.get('beat_default')}) vs "
+                    f"{entry.get('choice')!r} (beat_default="
+                    f"{entry.get('beat_default')}) — re-race this shape "
+                    "or drop one bank; a merge must not pick a side"
+                )
+
+            def choice_time(e):
+                t = e.get("timings_s", {}).get(str(e.get("choice")))
+                return t if isinstance(t, (int, float)) else float("inf")
+
+            if choice_time(entry) < choice_time(prior):
+                merged[identity] = entry
+    return {
+        "schema": BANK_SCHEMA,
+        "entries": [merged[k] for k in sorted(merged, key=repr)],
+    }
 
 
 _default_tuner: Optional[ShapeTuner] = None

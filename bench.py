@@ -896,6 +896,119 @@ def _onepass_rate(num_markets, slots, timed_steps):
     return timed_best_of(loop_call, fresh_state, timed_steps)
 
 
+def _sharded_onepass_capture(markets, slots, steps, mesh_shape,
+                             chunk_agents, chunk_slots, reps=2):
+    """The round-20 sources-sharded A/B: partials route vs fused XLA.
+
+    Builds BOTH routes on the SAME 2-D mesh (markets × sources), AOT
+    compiles each, captures the per-settle bytes-read floor off the
+    executables that run (the `_hbm_read_capture` definition every
+    one-pass leg shares), and times best-of-N. ``per_shard_read_bytes``
+    divides the program total by the device count — each shard's kernel
+    streams only its local (K_local, M_local) block.
+
+    The recorded ``read_ratio`` compares what ONE device streams per
+    settle: the sharded one-pass route's per-shard read vs the
+    single-device multi-pass program at the same global shape and
+    chunking — the deployment question this route exists to answer (the
+    dense shapes that RESOURCE_EXHAUSTED on one device must not forfeit
+    the one-pass diet by sharding). ``program_read_ratio`` records the
+    whole-program sharded one-pass vs sharded multi-pass comparison
+    alongside (≈1 when the per-shard block fits one kernel tile —
+    ``grid_tiles`` says which regime the ratio came from, exactly like
+    the 1-D capture). Raises when the host has fewer devices than the
+    mesh needs or a route fails to compile — callers record that as
+    the infeasibility datum, never a crash.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from bayesian_consensus_engine_tpu.ops.pallas_settle import (
+        resolve_tile_markets,
+    )
+    from bayesian_consensus_engine_tpu.parallel.sharded import (
+        build_cycle_analytics_loop,
+        init_block_state,
+    )
+
+    n_devices = mesh_shape[0] * mesh_shape[1]
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"mesh {mesh_shape} needs {n_devices} devices, "
+            f"have {len(jax.devices())}"
+        )
+    mesh = Mesh(
+        np.array(jax.devices()[:n_devices]).reshape(mesh_shape),
+        ("markets", "sources"),
+    )
+    m_loc = markets // mesh_shape[0]
+    k_loc = slots // mesh_shape[1]
+    rng = np.random.default_rng(20)
+    probs = jnp.asarray(rng.random((slots, markets)), jnp.float32)
+    mask = jnp.asarray(rng.random((slots, markets)) < 0.9)
+    outcome = jnp.asarray(rng.random(markets) < 0.5)
+    state0 = jax.tree.map(
+        lambda x: x.T, init_block_state(markets, slots)
+    )
+    now0 = jnp.asarray(400.0, jnp.float32)
+    ca, cs = min(chunk_agents, k_loc), min(chunk_slots, k_loc)
+
+    out = {
+        "workload": (
+            f"{markets} markets x {slots} slots, {steps} steps, "
+            f"mesh {mesh_shape[0]}x{mesh_shape[1]}"
+        ),
+        "mesh_shape": list(mesh_shape),
+        "per_shard_shape": [k_loc, m_loc],
+    }
+    for name, kwargs in (
+        ("multi_pass", {}), ("one_pass", {"kernel": "pallas"})
+    ):
+        loop = build_cycle_analytics_loop(
+            mesh, chunk_agents=ca, chunk_slots=cs, donate=False, **kwargs
+        )
+        exe = jax.jit(
+            lambda p, ma, o, s, n, _loop=loop: _loop(p, ma, o, s, n, steps)
+        ).lower(probs, mask, outcome, state0, now0).compile()
+        read = _hbm_read_capture(exe.memory_analysis())
+        _fence(exe(probs, mask, outcome, state0, now0)[1])  # warm
+        best = float("inf")
+        for _ in range(reps):
+            start = time.perf_counter()
+            _fence(exe(probs, mask, outcome, state0, now0)[1])
+            best = min(best, time.perf_counter() - start)
+        out[name] = {
+            "wall_s": round(best, 4),
+            "markets_per_sec": round(markets / best, 1),
+            **read,
+            "per_shard_read_bytes": read["hbm_read_bytes"] // n_devices,
+        }
+    # The single-device reference at the same global shape and chunking
+    # — matched ring folds, so the ratio isolates what sharding buys.
+    mesh1 = Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1), ("markets", "sources")
+    )
+    ref_loop = build_cycle_analytics_loop(
+        mesh1, chunk_agents=ca, chunk_slots=cs, donate=False
+    )
+    ref = jax.jit(
+        lambda p, ma, o, s, n: ref_loop(p, ma, o, s, n, steps)
+    ).lower(probs, mask, outcome, state0, now0).compile()
+    out["unsharded_multi_pass"] = _hbm_read_capture(ref.memory_analysis())
+    tile = resolve_tile_markets(m_loc, k_loc)
+    out.update(_onepass_ratio_fields(
+        out["unsharded_multi_pass"]["hbm_read_bytes"],
+        out["one_pass"]["per_shard_read_bytes"], m_loc, tile,
+    ))
+    out["program_read_ratio"] = round(
+        out["one_pass"]["hbm_read_bytes"]
+        / max(out["multi_pass"]["hbm_read_bytes"], 1), 3
+    )
+    return out
+
+
 #: The BP bracket arm's shape (round 19). The markets cap keeps the
 #: kernel's resident state set (3 VMEM windows x 2 moment vectors x 4
 #: bytes/market ≈ 24 B/market) safely inside the 16 MB VMEM budget —
@@ -983,7 +1096,8 @@ def _bp_autotune_decision(markets, slots):
 
 
 def bench_pallas_ab(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
-                    timed_steps=TIMED_STEPS, large_k_attempt=True):
+                    timed_steps=TIMED_STEPS, large_k_attempt=True,
+                    sharded_markets=2048, sharded_slots=512):
     """Adjudicate the Pallas kernel vs the XLA loop, interleaved in ONE
     process — the only A/B this host makes meaningful (tunnel bandwidth
     swings up to ~3x between processes).
@@ -1007,6 +1121,12 @@ def bench_pallas_ab(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
     the other kernel arms, plus the honesty-guarded tuner's recorded
     ``sweep_kernel`` adjudication (``bp_autotune_decision``) for the
     fused route at the same shape.
+
+    Round 20 adds the FIFTH bracket arm: the sources-sharded one-pass
+    route (partials kernel + cross-device merge) vs the fused XLA
+    program on a (2, 4) mesh (``_sharded_onepass_capture``) —
+    infeasible-as-data on hosts short of 8 devices, same posture as
+    the BP bracket.
     """
     from bayesian_consensus_engine_tpu.ops.pallas_cycle import _tuned_tile
 
@@ -1074,6 +1194,16 @@ def bench_pallas_ab(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
             out["bp_sweep"] = _infeasible(exc)
         out["bp_autotune_decision"] = _bp_autotune_decision(bp_m, slots)
 
+        # Round 20: the sources-sharded bracket arm. The partials
+        # route and the fused program race on the SAME (2, 4) mesh;
+        # a host short of 8 devices records the shortage as data.
+        try:
+            out["sharded_onepass"] = _sharded_onepass_capture(
+                sharded_markets, sharded_slots, 2, (2, 4), 1024, 1024,
+            )
+        except Exception as exc:
+            out["sharded_onepass"] = _infeasible(exc)
+
         if large_k_attempt:
             try:
                 out["pallas_16k10k_cycles_per_sec"] = _pallas_rate(
@@ -1121,6 +1251,18 @@ def bench_pallas_ab(num_markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
             f"bp_kernel_wins ({bp_pallas:.1f} vs {bp_xla:.1f})"
             if bp_pallas > bp_xla
             else f"xla_wins_bp ({bp_xla:.1f} vs {bp_pallas:.1f})"
+        )
+    sharded = out.get("sharded_onepass")
+    if isinstance(sharded, dict):
+        # Same-mesh, same-clock pair — its own apples-to-apples verdict.
+        sharded_one = sharded["one_pass"]["wall_s"]
+        sharded_multi = sharded["multi_pass"]["wall_s"]
+        out["sharded_verdict"] = (
+            f"sharded_onepass_wins ({sharded_one:.4f}s vs "
+            f"{sharded_multi:.4f}s)"
+            if sharded_one < sharded_multi
+            else f"xla_wins_sharded ({sharded_multi:.4f}s vs "
+                 f"{sharded_one:.4f}s)"
         )
     return out
 
@@ -3203,7 +3345,8 @@ def bench_e2e_analytics(markets=1024, slots=512, chunk_slots=256,
 
 def bench_e2e_onepass(markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
                       steps=4, chunk_agents=1024, chunk_slots=1024,
-                      reps=3, trials=2):
+                      reps=3, trials=2, sharded_markets=2048,
+                      sharded_slots=512):
     """ISSUE-12 acceptance leg: one-pass settlement at the 1M-market
     projection shape.
 
@@ -3230,6 +3373,14 @@ def bench_e2e_onepass(markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
        tile the interpret-mode program degenerates to the XLA program
        and the ratio is ~1 by construction). Feeds the ``bce-tpu
        stats`` hbm_read column via the per-repeat ledger records.
+
+    Round 20 adds the ``sharded_sources`` arm: the SAME A/B on a 2-D
+    (2, 4) markets × sources mesh at the (``sharded_markets`` ×
+    ``sharded_slots``) co-resident shape — the partials kernel +
+    cross-device merge vs the fused XLA program, with the per-shard
+    read capture and ``read_ratio`` recorded next to the 1-D capture.
+    A host short of 8 devices (or a Mosaic/shard_map failure) records
+    the infeasibility as data, never a crash.
 
     The markets default is the 1M-market north-star projection (lane
     padding applied); ``--fast`` shrinks to a self-test shape.
@@ -3351,6 +3502,23 @@ def bench_e2e_onepass(markets=NUM_MARKETS, slots=SLOTS_PER_MARKET,
             best["one_pass"]["hbm_read_bytes"], m, tile,
         ),
     })
+    # Round 20: the sources-sharded arm — the same A/B at a 2-D mesh.
+    try:
+        result["sharded_sources"] = _sharded_onepass_capture(
+            sharded_markets, sharded_slots, max(steps, 1), (2, 4),
+            chunk_agents, chunk_slots, reps=min(reps, 2),
+        )
+    except Exception as exc:
+        result["sharded_sources"] = _infeasible(exc)
+    else:
+        sharded = result["sharded_sources"]
+        _ledger_record(
+            "e2e_onepass_sharded",
+            value=sharded["one_pass"]["wall_s"], unit="s",
+            extras={
+                "hbm_read_bytes": sharded["one_pass"]["hbm_read_bytes"],
+            },
+        )
     return result
 
 
@@ -4556,7 +4724,8 @@ LEGS = {
     "e2e_onepass": (
         bench_e2e_onepass, {},
         dict(markets=256, slots=32, steps=2, chunk_agents=16,
-             chunk_slots=16, reps=1, trials=1), 2000,
+             chunk_slots=16, reps=1, trials=1, sharded_markets=256,
+             sharded_slots=32), 2000,
     ),
     "e2e_kill_soak": (
         bench_e2e_kill_soak, {},
@@ -4576,7 +4745,8 @@ LEGS = {
     "pallas_ab": (
         bench_pallas_ab, {},
         dict(num_markets=1024, slots=8, timed_steps=8,
-             large_k_attempt=False), 1500,
+             large_k_attempt=False, sharded_markets=256,
+             sharded_slots=32), 1500,
     ),
     "headline_f32_cpu": (
         bench_headline, dict(timed_steps=CPU_FALLBACK_STEPS),
@@ -5202,11 +5372,21 @@ def _run_leg_with_obs(args):
     if untracked > 0:
         phases["untracked"] = round(untracked, 6)
     if _LEDGER is not None:
+        extras = {"wall_s": round(wall, 3)}
+        if isinstance(value, dict):
+            # Kernel-bearing legs (pallas_ab) carry the honesty-guarded
+            # tuner adjudications — recorded so `bce-tpu stats` renders
+            # the bank-vs-race provenance and `--against` can flag a
+            # verdict flip (round 20).
+            extras.update({
+                key: val for key, val in value.items()
+                if str(key).endswith("autotune_decision")
+            })
         _ledger_record(
             args.leg,
             value=value if isinstance(value, (int, float)) else None,
             phases=phases,
-            extras={"wall_s": round(wall, 3)},
+            extras=extras,
         )
         _LEDGER.close()
     return {

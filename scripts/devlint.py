@@ -24,6 +24,12 @@ traced-helper-boundary, the AS6xx async-safety family) gate through
 this shim exactly like the per-file families. ``check_file`` stays a
 single-file probe — project rules see a one-file project there, which
 is what the per-rule fixtures want.
+
+Round 20: ``main`` additionally schema-validates every ``*.bank.json``
+in the tree (``utils.autotune.validate_bank``) — a shipped autotune
+bank is adjudicated evidence, and a hand-edited one that no longer
+parses would otherwise fail SILENTLY at load time (an invalid bank is
+ignored whole by design). Bank errors gate like lint errors.
 """
 
 from __future__ import annotations
@@ -43,6 +49,35 @@ DEFAULT_PATHS = list(_engine.config.DEFAULT_PATHS)
 def check_file(path) -> list[str]:
     """Lint one file; returns rendered ``path:line: CODE message`` strings."""
     return [f.render() for f in _engine.check_file(path, root=_ROOT)]
+
+
+def check_banks(root: pathlib.Path = _ROOT) -> list[str]:
+    """Schema-validate every checked-in ``*.bank.json`` under *root*.
+
+    Returns ``path: message`` strings — empty when every bank parses
+    and validates. The runtime loader ignores an invalid bank WHOLE
+    (falling back to live measurement), so this is the only gate that
+    makes a hand-edited bank fail loudly instead of silently
+    de-adjudicating every entry it carried.
+    """
+    import json
+
+    from bayesian_consensus_engine_tpu.utils.autotune import validate_bank
+
+    errors: list[str] = []
+    for path in sorted(root.rglob("*.bank.json")):
+        if any(part.startswith(".") for part in path.parts):
+            continue  # .git, editor litter
+        rel = path.relative_to(root)
+        try:
+            payload = json.loads(path.read_text())
+        except ValueError as exc:
+            errors.append(f"{rel}: not valid JSON ({exc})")
+            continue
+        errors.extend(
+            f"{rel}: {problem}" for problem in validate_bank(payload)
+        )
+    return errors
 
 
 def main(argv: list[str]) -> int:
@@ -65,9 +100,18 @@ def main(argv: list[str]) -> int:
     n_files, findings = _engine.run(paths or None, root=_ROOT, cache=cache)
     for f in findings:
         print(f.render())
-    print(f"devlint: {n_files} files, {len(findings)} findings")
-    # Same severity gating as engine.main: warnings report, errors gate.
-    return 1 if any(f.severity == "error" for f in findings) else 0
+    bank_errors = check_banks()
+    for err in bank_errors:
+        print(f"BANK {err}")
+    print(
+        f"devlint: {n_files} files, {len(findings)} findings, "
+        f"{len(bank_errors)} bank error(s)"
+    )
+    # Same severity gating as engine.main: warnings report, errors gate
+    # — and an invalid shipped bank gates like an error.
+    return 1 if bank_errors or any(
+        f.severity == "error" for f in findings
+    ) else 0
 
 
 if __name__ == "__main__":

@@ -566,3 +566,178 @@ class TestHonestyGuard:
         assert tuner.tune(
             "tile", (11,), [1, 2], {1: 2.0, 2: 1.0, 3: 0.5}.__getitem__, 3
         ) == 3
+
+
+class TestAutotuneBank:
+    """The shippable bank (round 20): adjudicated verdicts exported on
+    host A serve on host B of the same device generation WITHOUT a
+    re-race; any drift — schema, default, generation — falls through to
+    the pre-bank behaviour; a merge never silently picks a side on a
+    verdict flip."""
+
+    KIND = "TPU v5e"
+
+    def _raced_cache(self, tmp_path):
+        """Race one shape on 'host A' and return its cache path."""
+        tuner = ShapeTuner(
+            cache_path=str(tmp_path / "hostA.json"),
+            enabled=True,
+            device_kind=self.KIND,
+        )
+        choice = tuner.tune(
+            "settle_kernel", (16, 256, 2), ["pallas"],
+            {"pallas": 1.0, "xla": 2.0}.__getitem__, "xla",
+        )
+        assert choice == "pallas"
+        return tuner._cache_path
+
+    def test_export_load_serves_without_rerace(self, tmp_path):
+        from bayesian_consensus_engine_tpu.utils.autotune import export_bank
+
+        bank = export_bank(self._raced_cache(tmp_path))
+        assert bank["schema"] == "bce-autotune-bank/v1"
+        (entry,) = bank["entries"]
+        assert entry["generation"] == "tpu-v5e"
+        assert entry["beat_default"] is True
+        assert entry["timings_s"] == {"pallas": 1.0, "xla": 2.0}
+
+        # "Host B": tuner OFF (BCE_AUTOTUNE unset posture), fresh cache,
+        # same generation. The bank is its own opt-in: the verdict
+        # serves, and a measure that would raise proves no re-race ran.
+        def never(_candidate):
+            raise AssertionError("banked verdict must not re-race")
+
+        host_b = ShapeTuner(
+            cache_path=str(tmp_path / "hostB.json"),
+            enabled=False,
+            device_kind=self.KIND,
+            bank=bank,
+        )
+        assert host_b.tune(
+            "settle_kernel", (16, 256, 2), ["pallas"], never, "xla"
+        ) == "pallas"
+        decision = host_b.decision("settle_kernel", (16, 256, 2))
+        assert decision["choice"] == "pallas"
+        assert decision["source"] == "bank"
+
+    def test_bank_loads_from_path_and_env(self, tmp_path, monkeypatch):
+        from bayesian_consensus_engine_tpu.utils.autotune import export_bank
+
+        bank = export_bank(self._raced_cache(tmp_path))
+        path = tmp_path / "v5e.bank.json"
+        path.write_text(json.dumps(bank))
+
+        by_path = ShapeTuner(
+            cache_path=str(tmp_path / "b1.json"), enabled=False,
+            device_kind=self.KIND, bank=str(path),
+        )
+        assert by_path.tune(
+            "settle_kernel", (16, 256, 2), ["pallas"], None, "xla"
+        ) == "pallas"
+
+        monkeypatch.setenv("BCE_AUTOTUNE_BANK", str(path))
+        by_env = ShapeTuner(
+            cache_path=str(tmp_path / "b2.json"), enabled=False,
+            device_kind=self.KIND,
+        )
+        assert by_env.tune(
+            "settle_kernel", (16, 256, 2), ["pallas"], None, "xla"
+        ) == "pallas"
+
+    def test_drifted_default_falls_through(self, tmp_path):
+        from bayesian_consensus_engine_tpu.utils.autotune import export_bank
+
+        bank = export_bank(self._raced_cache(tmp_path))
+        tuner = ShapeTuner(
+            cache_path=str(tmp_path / "drift.json"), enabled=True,
+            device_kind=self.KIND, bank=bank,
+        )
+        # Caller's default moved since the bank was recorded: the banked
+        # adjudication (vs "xla") does not answer for "fused" — the
+        # honesty guard re-races against the NEW default.
+        calls = []
+
+        def clock(candidate):
+            calls.append(candidate)
+            return {"pallas": 2.0, "fused": 1.0}[candidate]
+
+        assert tuner.tune(
+            "settle_kernel", (16, 256, 2), ["pallas"], clock, "fused"
+        ) == "fused"
+        assert sorted(calls) == ["fused", "pallas"]
+
+    def test_other_generation_falls_through(self, tmp_path):
+        from bayesian_consensus_engine_tpu.utils.autotune import export_bank
+
+        bank = export_bank(self._raced_cache(tmp_path))
+        other = ShapeTuner(
+            cache_path=str(tmp_path / "other.json"), enabled=False,
+            device_kind="TPU v4", bank=bank,
+        )
+        # A v5e verdict never answers for v4: disabled + no applicable
+        # bank entry → the caller's default, measure untouched.
+        assert other.tune(
+            "settle_kernel", (16, 256, 2), ["pallas"], None, "xla"
+        ) == "xla"
+
+    def test_schema_drift_ignores_bank_whole(self, tmp_path):
+        from bayesian_consensus_engine_tpu.utils.autotune import (
+            export_bank,
+            load_bank,
+        )
+
+        bank = export_bank(self._raced_cache(tmp_path))
+        bank["schema"] = "bce-autotune-bank/v0"
+        assert load_bank(bank) is None
+        tuner = ShapeTuner(
+            cache_path=str(tmp_path / "drifted.json"), enabled=False,
+            device_kind=self.KIND, bank=bank,
+        )
+        assert tuner.tune(
+            "settle_kernel", (16, 256, 2), ["pallas"], None, "xla"
+        ) == "xla"
+
+    def test_validate_bank_catches_drift(self):
+        from bayesian_consensus_engine_tpu.utils.autotune import validate_bank
+
+        entry = {
+            "knob": "settle_kernel", "shape_key": [4], "generation":
+            "tpu-v5e", "choice": "pallas", "default": "xla",
+            "beat_default": True, "timings_s": {"pallas": 1.0},
+        }
+        good = {"schema": "bce-autotune-bank/v1", "entries": [entry]}
+        assert validate_bank(good) == []
+        assert validate_bank({"schema": "???", "entries": []})
+        assert validate_bank(
+            {"schema": "bce-autotune-bank/v1", "entries": [
+                {k: v for k, v in entry.items() if k != "default"}
+            ]}
+        )
+        assert validate_bank(
+            {"schema": "bce-autotune-bank/v1", "entries": [entry, entry]}
+        )  # duplicate identity
+        assert validate_bank(
+            {"schema": "bce-autotune-bank/v1", "entries": [
+                dict(entry, generation="TPU v5e")  # un-normalised
+            ]}
+        )
+
+    def test_merge_keeps_better_evidence_and_refuses_flips(self, tmp_path):
+        from bayesian_consensus_engine_tpu.utils.autotune import merge_banks
+
+        entry = {
+            "knob": "settle_kernel", "shape_key": [4], "generation":
+            "tpu-v5e", "choice": "pallas", "default": "xla",
+            "beat_default": True, "timings_s": {"pallas": 1.0, "xla": 2.0},
+        }
+        faster = dict(entry, timings_s={"pallas": 0.5, "xla": 2.0})
+        a = {"schema": "bce-autotune-bank/v1", "entries": [entry]}
+        b = {"schema": "bce-autotune-bank/v1", "entries": [faster]}
+        merged = merge_banks(a, b)
+        (kept,) = merged["entries"]
+        assert kept["timings_s"]["pallas"] == 0.5
+
+        flip = dict(entry, choice="xla", beat_default=False)
+        c = {"schema": "bce-autotune-bank/v1", "entries": [flip]}
+        with pytest.raises(ValueError, match="verdict flip"):
+            merge_banks(a, c)

@@ -457,3 +457,86 @@ class TestReplay:
         proc = run_cli(["replay", str(jrnl), "--configs", "graph_steps=2"])
         assert proc.returncode == 1
         assert "MarketGraph" in proc.stderr
+
+
+class TestBankVerbs:
+    """``bce-tpu bank export|merge|show`` — the shippable autotune bank
+    round-trip at the process level (round 20)."""
+
+    def _entry(self, **over):
+        entry = {
+            "knob": "settle_kernel",
+            "shape_key": [16, 256, 2],
+            "generation": "tpu-v5e",
+            "choice": "pallas",
+            "default": "xla",
+            "beat_default": True,
+            "timings_s": {"pallas": 1.0, "xla": 2.0},
+        }
+        entry.update(over)
+        return entry
+
+    def _cache(self, tmp_path: Path) -> Path:
+        # A tuner cache as ShapeTuner persists it: key is the JSON of
+        # [knob, shape_key, device_kind].
+        cache = tmp_path / "tune.json"
+        key = json.dumps(["settle_kernel", [16, 256, 2], "TPU v5e"])
+        cache.write_text(json.dumps({key: {
+            "choice": "pallas", "default": "xla", "beat_default": True,
+            "timings_s": {"pallas": 1.0, "xla": 2.0},
+        }}))
+        return cache
+
+    def test_export_show_round_trip(self, tmp_path: Path):
+        cache = self._cache(tmp_path)
+        out = tmp_path / "v5e.bank.json"
+        proc = run_cli([
+            "bank", "export", "--cache", str(cache), "-o", str(out)
+        ])
+        assert proc.returncode == 0, proc.stderr
+        payload = json.loads(out.read_text())
+        assert payload["schema"] == "bce-autotune-bank/v1"
+        (entry,) = payload["entries"]
+        assert entry["generation"] == "tpu-v5e"
+        show = run_cli(["bank", "show", str(out)])
+        assert show.returncode == 0, show.stderr
+        assert "1 verdicts" in show.stdout
+        assert "beat default" in show.stdout
+
+    def test_export_empty_cache_errors(self, tmp_path: Path):
+        cache = tmp_path / "empty.json"
+        cache.write_text("{}")
+        proc = run_cli(["bank", "export", "--cache", str(cache)])
+        assert proc.returncode == 1
+        assert "no adjudicated verdicts" in proc.stderr
+
+    def test_merge_refuses_verdict_flip(self, tmp_path: Path):
+        a = tmp_path / "a.bank.json"
+        b = tmp_path / "b.bank.json"
+        a.write_text(json.dumps(
+            {"schema": "bce-autotune-bank/v1", "entries": [self._entry()]}
+        ))
+        b.write_text(json.dumps({
+            "schema": "bce-autotune-bank/v1",
+            "entries": [self._entry(choice="xla", beat_default=False)],
+        }))
+        merged = tmp_path / "m.bank.json"
+        proc = run_cli([
+            "bank", "merge", str(a), str(b), "-o", str(merged)
+        ])
+        assert proc.returncode == 1
+        assert "verdict flip" in proc.stderr
+        assert not merged.exists()
+        # Agreeing banks merge fine.
+        ok = run_cli(["bank", "merge", str(a), str(a), "-o", str(merged)])
+        assert ok.returncode == 0, ok.stderr
+        assert len(json.loads(merged.read_text())["entries"]) == 1
+
+    def test_show_rejects_drifted_schema(self, tmp_path: Path):
+        bad = tmp_path / "bad.bank.json"
+        bad.write_text(json.dumps(
+            {"schema": "bce-autotune-bank/v0", "entries": []}
+        ))
+        proc = run_cli(["bank", "show", str(bad)])
+        assert proc.returncode == 1
+        assert "schema" in proc.stderr

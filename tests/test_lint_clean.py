@@ -362,6 +362,43 @@ _CASES = [
         "                          input_output_aliases={1: 0, 2: 1})\n",
     ),
     (
+        # Round 20 (sources-sharded partials): a multi-output launch
+        # whose state blocks alias in place through the COMPREHENSION
+        # idiom ``{base + j: j for j in range(N)}`` and whose spec
+        # lists use list arithmetic (``[a, b] + [block] * N``). The
+        # good twin fits the budget ONLY because the evaluated alias
+        # map credits the four aliased state outputs once — double-
+        # billing them (the pre-round-20 undecidable fallback) would
+        # read 20 MB double-buffered. The bad twin's block set is past
+        # the budget even WITH the aliasing credited.
+        "PL501",
+        f"{PKG}/ops/case.py",
+        "from jax.experimental import pallas as pl\n\nN_STATE = 4\n\n\n"
+        "def build():\n"
+        "    grid = (4,)\n"
+        "    block = pl.BlockSpec((512, 1024), lambda i: (0, i))\n"
+        "    row = pl.BlockSpec((4, 1024), lambda i: (0, i))\n"
+        "    in_specs = [block, block] + [block] * N_STATE\n"
+        "    out_specs = [block] * N_STATE + [row]\n"
+        "    return pl.pallas_call(\n"
+        "        None, grid=grid, in_specs=in_specs,\n"
+        "        out_specs=out_specs,\n"
+        "        input_output_aliases={2 + j: j for j in range(N_STATE)},\n"
+        "    )\n",
+        "from jax.experimental import pallas as pl\n\nN_STATE = 4\n\n\n"
+        "def build():\n"
+        "    grid = (4,)\n"
+        "    block = pl.BlockSpec((256, 1024), lambda i: (0, i))\n"
+        "    row = pl.BlockSpec((4, 1024), lambda i: (0, i))\n"
+        "    in_specs = [block, block] + [block] * N_STATE\n"
+        "    out_specs = [block] * N_STATE + [row]\n"
+        "    return pl.pallas_call(\n"
+        "        None, grid=grid, in_specs=in_specs,\n"
+        "        out_specs=out_specs,\n"
+        "        input_output_aliases={2 + j: j for j in range(N_STATE)},\n"
+        "    )\n",
+    ),
+    (
         "F401",
         "tests/case.py",
         "import os\n\n\ndef f():\n    return 1\n",

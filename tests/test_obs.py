@@ -807,6 +807,60 @@ class TestSloColumn:
         assert "slo 1->20" in obs.render_diff(diff)
 
 
+class TestAutotuneProvenance:
+    """Round-20 stats surface: kernel-bearing legs render their tuner
+    verdict WITH provenance (a local ``race`` vs a loaded ``bank``),
+    and ``--against`` flags a verdict FLIP — the regression that
+    matters when a shipped bank drifts from what this host would
+    measure."""
+
+    @staticmethod
+    def _records(leg, choice, source="race", beat=True):
+        decision = {
+            "choice": choice, "default": "xla", "beat_default": beat,
+            "timings_s": {}, "source": source,
+        }
+        return [{
+            "leg": leg, "value": 1.0, "unit": "s", "host": {},
+            "extras": {"settle_autotune_decision": decision},
+        }]
+
+    def test_band_and_render_carry_provenance(self):
+        records = self._records("pallas_ab", "pallas", source="bank")
+        band = obs.min_of_repeats(records, "pallas_ab")
+        verdict = band["autotune"]["settle_autotune_decision"]
+        assert verdict["choice"] == "pallas"
+        assert verdict["source"] == "bank"
+        rendered = obs_ledger.render(records)
+        assert "settle_autotune_decision: pallas (bank; beat default)" in (
+            rendered
+        )
+        # Legs without a decision render no autotune trailer.
+        plain = obs_ledger.render(
+            [{"leg": "plain", "value": 1.0, "unit": "s", "host": {}}]
+        )
+        assert "autotune" not in plain
+
+    def test_diff_flags_verdict_flip(self):
+        old = self._records("pallas_ab", "pallas")
+        new = self._records("pallas_ab", "xla", source="bank", beat=False)
+        diff = obs.diff_bands(old, new)
+        metric = diff["pallas_ab"]["metrics"][
+            "autotune.settle_autotune_decision"
+        ]
+        assert (metric["old"], metric["new"]) == ("pallas", "xla")
+        assert metric["verdict_flip"] is True
+        assert metric["source"] == "bank"
+        rendered = obs.render_diff(diff)
+        assert "pallas->xla FLIP" in rendered
+        # Same verdict both rounds: reported, not flagged.
+        calm = obs.diff_bands(old, old)
+        same = calm["pallas_ab"]["metrics"][
+            "autotune.settle_autotune_decision"
+        ]
+        assert "verdict_flip" not in same
+
+
 class TestCliStats:
     def _main(self, argv, capsys):
         import sys

@@ -4,7 +4,8 @@ The non-negotiable contract: ``build_cycle_analytics_loop(kernel="pallas")``
 — the Pallas kernel computing consensus + tie-break + band moments in one
 HBM sweep per tile — is BIT-IDENTICAL to the multi-pass XLA fused program
 on the tier-1 CPU backend, across chunk settings, mesh factorisations
-(markets-sharded; the kernel serves unsharded-sources meshes only),
+(markets-sharded AND, since round 20, sources-sharded: each shard's
+kernel emits partials merged by a deterministic cross-device stage),
 workloads, and step counts. The parity is structural (the kernel body
 traces the same layer-1 functions — ops/cycle_math, ring_tiebreak_math,
 band_sums — the XLA program traces under shard_map); these tests are the
@@ -15,6 +16,8 @@ Also here: the sorted tie-break through the fused session surface
 byte-equal to the ring path on exactly-representable weights, and the
 ``settle_kernel`` honesty-guard wiring (``kernel="auto"``).
 """
+
+import struct
 
 import numpy as np
 import pytest
@@ -37,6 +40,7 @@ from bayesian_consensus_engine_tpu.pipeline import (
     ShardedSettlementSession,
     build_settlement_plan,
 )
+from bayesian_consensus_engine_tpu.state import JournalWriter
 from bayesian_consensus_engine_tpu.state.tensor_store import (
     TensorReliabilityStore,
 )
@@ -113,12 +117,17 @@ def _run(mesh, kernel, args, steps, chunk_agents, chunk_slots):
 
 
 class TestOnepassParityMatrix:
-    """ISSUE-12 acceptance: the one-pass kernel bit-identical to the
-    multi-pass XLA fused program — store tensors, consensus, tie-break,
-    bands — at every chunk setting, across markets-mesh factorisations
-    and step counts, in interpret mode on the tier-1 backend."""
+    """ISSUE-12 acceptance (extended by round 20 to 2-D meshes): the
+    one-pass kernel bit-identical to the multi-pass XLA fused program —
+    store tensors, consensus, tie-break, bands — at every chunk setting,
+    across mesh factorisations (including sources-sharded, where the
+    kernel emits per-shard partials and the cross-device merge must not
+    move a bit) and step counts, in interpret mode on the tier-1
+    backend."""
 
-    @pytest.mark.parametrize("mesh_shape", [(1, 1), (8, 1)])
+    @pytest.mark.parametrize(
+        "mesh_shape", [(1, 1), (8, 1), (4, 2), (2, 4), (1, 8)]
+    )
     @pytest.mark.parametrize(
         "workload", ["random", "mask_holes", "all_tied", "single_agent"]
     )
@@ -209,10 +218,32 @@ class TestOnepassRouting:
     """The kernel routing contract: clear errors where the kernel cannot
     serve, silent XLA fallback only for kernel='auto'."""
 
-    def test_sources_sharded_mesh_rejected(self):
+    def test_sources_sharded_mesh_served(self):
+        # Round 20: kernel="pallas" on a sources-sharded mesh is a
+        # served route (per-shard partials + cross-device merge), no
+        # longer a build-time ValueError.
         mesh = make_mesh((1, 8))
-        with pytest.raises(ValueError, match="sources axis"):
-            build_cycle_analytics_loop(mesh, kernel="pallas")
+        loop = build_cycle_analytics_loop(mesh, kernel="pallas",
+                                          donate=False)
+        st, cons, tb, bands, _ = loop(*_inputs("random", seed=6), 1)
+        assert np.isfinite(np.asarray(cons)).all()
+
+    def test_sources_sharded_zero_steps_rejected(self):
+        # The one genuinely unsupported combination left on the 2-D
+        # route: the partials kernel emits RAW last-step consensus sums,
+        # and a zero-step program's zero consensus is not representable
+        # as sums. The refusal names the route and the fix.
+        mesh = make_mesh((1, 8))
+        loop = build_cycle_analytics_loop(mesh, kernel="pallas",
+                                          donate=False)
+        args = _inputs("random", seed=6)
+        with pytest.raises(ValueError, match="steps=0 on a"):
+            loop(*args, 0)
+        # auto degrades to the XLA program instead of refusing.
+        auto = build_cycle_analytics_loop(mesh, kernel="auto",
+                                          donate=False)
+        st, cons, tb, bands, _ = auto(*args, 0)
+        assert np.isfinite(np.asarray(cons)).all()
 
     def test_stage_off_rejected(self):
         mesh = make_mesh((1, 1), devices=jax.devices()[:1])
@@ -226,8 +257,9 @@ class TestOnepassRouting:
             )
 
     def test_auto_falls_back_where_ineligible(self):
-        # auto on a sources-sharded mesh resolves to XLA without a
-        # tuner race (there is nothing to race).
+        # auto on a sources-sharded mesh resolves through the tuner
+        # like any other shape (round 20 made the route raceable);
+        # with BCE_AUTOTUNE unset the tuner is off and XLA ships.
         mesh = make_mesh((1, 8))
         loop = build_cycle_analytics_loop(mesh, kernel="auto", donate=False)
         args = _inputs("random", seed=2)
@@ -429,6 +461,72 @@ class TestSessionKernelParity:
                 np.asarray(getattr(bands_x, name)),
                 err_msg=f"bands.{name}",
             )
+
+
+def _journal_epochs_sans_clock(path):
+    """Decoded epoch frames with the wall-clock field masked (same
+    helper as test_serve/test_analytics)."""
+    blob = path.read_bytes()
+    assert blob[:8] == b"BCEJRNL1"
+    hdr = struct.Struct("<QQQQQdQ")
+    off = 8
+    epochs = []
+    while off < len(blob):
+        (epoch_index, used_after, pair_len, dirty, iso_len,
+         _wall_ts, tag) = hdr.unpack_from(blob, off)
+        payload_len = pair_len + 33 * dirty + iso_len
+        start = off + hdr.size
+        epochs.append((
+            (epoch_index, used_after, pair_len, dirty, iso_len, tag),
+            blob[start:start + payload_len],
+        ))
+        off = start + payload_len + 4  # + crc32
+    return epochs
+
+
+class TestShardedSessionByteParity:
+    """Round-20 acceptance: the partials route through the FUSED session
+    surface on a sources-sharded ``(2, 4)`` mesh — store digest (every
+    live row), journal epoch payloads (wall_ts masked), and SQLite
+    bytes all byte-equal to the XLA default over chained banded
+    settles. Settlement is durable state; the kernel may not move a
+    byte of it."""
+
+    def _run(self, kernel, tmp_path):
+        payloads, outcomes = _grid_payloads(markets=10, srcs=4, seed=5)
+        store = TensorReliabilityStore()
+        plan = build_settlement_plan(store, payloads, num_slots=8)
+        options = AnalyticsOptions(chunk_slots=4, chunk_agents=3)
+        mesh = make_mesh((2, 4))
+        with ShardedSettlementSession(store, plan, mesh) as session:
+            session.settle_with_analytics(
+                outcomes, steps=2, now=NOW, analytics=options, kernel=kernel
+            )
+            session.settle_with_analytics(
+                outcomes, steps=2, now=NOW + 1, analytics=options,
+                kernel=kernel,
+            )
+        jrnl = tmp_path / f"{kernel}.jrnl"
+        with JournalWriter(jrnl) as journal:
+            store.flush_to_journal(journal, tag=1)
+        db = tmp_path / f"{kernel}.db"
+        store.flush_to_sqlite(db)
+        return store, jrnl, db
+
+    def test_store_journal_sqlite_byte_equal(self, tmp_path):
+        store_x, jrnl_x, db_x = self._run("xla", tmp_path)
+        store_p, jrnl_p, db_p = self._run("pallas", tmp_path)
+        rows = np.arange(store_x.live_row_count())
+        for i, (a, b) in enumerate(
+            zip(store_p.host_rows(rows), store_x.host_rows(rows))
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=f"store array {i}"
+            )
+        assert _journal_epochs_sans_clock(jrnl_p) == (
+            _journal_epochs_sans_clock(jrnl_x)
+        )
+        assert db_p.read_bytes() == db_x.read_bytes()
 
 
 class TestSettleKernelAutotune:
